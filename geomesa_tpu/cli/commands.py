@@ -1,0 +1,22 @@
+"""CLI subcommand registry.
+
+Commands land here as their subsystems are built; each mirrors a
+geomesa-tools command (create-schema, describe-schema, ingest, export,
+explain, stats-*) [upstream, unverified].
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def register(sub: "argparse._SubParsersAction") -> None:
+    version = sub.add_parser("version", help="print version")
+    version.set_defaults(func=_version)
+
+
+def _version(args) -> int:
+    import geomesa_tpu
+
+    print(geomesa_tpu.__version__)
+    return 0

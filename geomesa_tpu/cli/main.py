@@ -1,0 +1,36 @@
+"""geomesa-tpu CLI entry point.
+
+Parity: the geomesa-tools command surface (geomesa-accumulo/geomesa-fs
+launcher scripts) [upstream, unverified]. Subcommands are registered as the
+corresponding subsystems land; unknown commands list what exists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="geomesa-tpu",
+        description="TPU-native geospatial analytics (GeoMesa capabilities on JAX)",
+    )
+    sub = p.add_subparsers(dest="command")
+    from geomesa_tpu.cli import commands
+
+    commands.register(sub)
+    return p
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "func", None):
+        parser.print_help()
+        return 1
+    return args.func(args) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

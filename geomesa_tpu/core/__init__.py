@@ -1,0 +1,6 @@
+"""Core feature model: SimpleFeatureType schemas and columnar batches."""
+
+from geomesa_tpu.core.sft import AttributeDescriptor, SimpleFeatureType
+from geomesa_tpu.core.columnar import FeatureBatch, GeometryColumn
+
+__all__ = ["AttributeDescriptor", "SimpleFeatureType", "FeatureBatch", "GeometryColumn"]

@@ -1,0 +1,402 @@
+"""Columnar feature batches: the device-native data model.
+
+This replaces the reference's row-oriented SimpleFeature + KryoFeatureSerializer
+(geomesa-features) with a struct-of-arrays layout that maps 1:1 onto Arrow
+record batches and device arrays — the canonical layout called for by the
+survey's C13 analysis of geomesa-arrow SimpleFeatureVector [upstream,
+unverified]:
+
+- numeric columns: f64/f32/i64/i32/bool NumPy arrays
+- String/UUID columns: dictionary-encoded int32 codes + host vocab
+- Date/Timestamp: int64 epoch millis
+- geometry: point fast path (x[N], y[N] f64) or CSR for extended geometries
+  (vertex buffer [V,2] f64 + ring offsets + per-feature ring slices + bbox[N,4])
+
+Batches are immutable; `select`/`pad_to` return new batches. Padding carries a
+validity mask so fixed-shape device kernels can AND it into predicate masks
+(static shapes are an XLA requirement; the mask is the price).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.core.wkt import Geometry
+
+
+@dataclasses.dataclass
+class DictColumn:
+    """Dictionary-encoded string column: int32 codes (-1 = null) + vocab."""
+
+    codes: np.ndarray
+    vocab: List[str]
+
+    def __len__(self) -> int:
+        return len(self.codes)
+
+    def take(self, idx) -> "DictColumn":
+        return DictColumn(self.codes[idx], self.vocab)
+
+    def decode(self) -> List[Optional[str]]:
+        return [self.vocab[c] if c >= 0 else None for c in self.codes]
+
+    @classmethod
+    def encode(cls, values: Sequence[Optional[str]]) -> "DictColumn":
+        vocab: List[str] = []
+        lookup: Dict[str, int] = {}
+        codes = np.empty(len(values), dtype=np.int32)
+        for i, v in enumerate(values):
+            if v is None:
+                codes[i] = -1
+            else:
+                code = lookup.get(v)
+                if code is None:
+                    code = len(vocab)
+                    lookup[v] = code
+                    vocab.append(v)
+                codes[i] = code
+        return cls(codes, vocab)
+
+
+@dataclasses.dataclass
+class GeometryColumn:
+    """Columnar geometry.
+
+    Point layout: x[N], y[N] (f64). Extended layout additionally carries the
+    CSR buffers; for points the CSR fields are None.
+
+    CSR layout (kind != Point):
+      vertices:      [V, 2] f64 — all ring vertices, concatenated
+      ring_offsets:  [R+1] i64  — ring r = vertices[ring_offsets[r]:ring_offsets[r+1]]
+      feature_rings: [N+1] i64  — feature i owns rings feature_rings[i]:feature_rings[i+1]
+      feature_parts: list of per-feature part sizes (for Multi* reconstruction)
+      bbox:          [N, 4] f64 — (xmin, ymin, xmax, ymax) per feature
+    x/y for extended geometries hold a representative point (first vertex),
+    used only as a cheap prefilter aid, never for exact predicates.
+    """
+
+    kind: str
+    x: np.ndarray
+    y: np.ndarray
+    vertices: Optional[np.ndarray] = None
+    ring_offsets: Optional[np.ndarray] = None
+    feature_rings: Optional[np.ndarray] = None
+    feature_parts: Optional[List[List[int]]] = None
+    bbox: Optional[np.ndarray] = None
+
+    def __len__(self) -> int:
+        return len(self.x)
+
+    @property
+    def is_point(self) -> bool:
+        return self.vertices is None
+
+    @classmethod
+    def from_points(cls, x, y) -> "GeometryColumn":
+        return cls(
+            "Point",
+            np.asarray(x, dtype=np.float64),
+            np.asarray(y, dtype=np.float64),
+        )
+
+    @classmethod
+    def from_geometries(cls, geoms: Sequence[Geometry]) -> "GeometryColumn":
+        kinds = {g.kind for g in geoms}
+        if kinds <= {"Point"}:
+            xy = np.array([g.point for g in geoms], dtype=np.float64).reshape(-1, 2)
+            return cls.from_points(xy[:, 0], xy[:, 1])
+        kind = kinds.pop() if len(kinds) == 1 else "Geometry"
+        vertices, ring_offsets, feature_rings = [], [0], [0]
+        parts: List[List[int]] = []
+        bbox = np.empty((len(geoms), 4), dtype=np.float64)
+        xs = np.empty(len(geoms), dtype=np.float64)
+        ys = np.empty(len(geoms), dtype=np.float64)
+        for i, g in enumerate(geoms):
+            for r in g.rings:
+                vertices.append(r)
+                ring_offsets.append(ring_offsets[-1] + len(r))
+            feature_rings.append(feature_rings[-1] + len(g.rings))
+            parts.append(list(g.parts))
+            bbox[i] = g.bbox
+            if g.rings:
+                xs[i], ys[i] = g.rings[0][0]
+            else:
+                xs[i] = ys[i] = np.nan
+        v = (
+            np.concatenate(vertices, axis=0)
+            if vertices
+            else np.zeros((0, 2), dtype=np.float64)
+        )
+        return cls(
+            kind,
+            xs,
+            ys,
+            v,
+            np.asarray(ring_offsets, dtype=np.int64),
+            np.asarray(feature_rings, dtype=np.int64),
+            parts,
+            bbox,
+        )
+
+    def geometry(self, i: int) -> Geometry:
+        """Reconstruct the host Geometry for feature i."""
+        if self.is_point:
+            return Geometry(
+                "Point", [np.array([[self.x[i], self.y[i]]], dtype=np.float64)]
+            )
+        r0, r1 = int(self.feature_rings[i]), int(self.feature_rings[i + 1])
+        rings = [
+            self.vertices[self.ring_offsets[r] : self.ring_offsets[r + 1]]
+            for r in range(r0, r1)
+        ]
+        return Geometry(self.kind, rings, list(self.feature_parts[i]))
+
+    def take(self, idx) -> "GeometryColumn":
+        idx = np.asarray(idx)
+        if self.is_point:
+            return GeometryColumn(self.kind, self.x[idx], self.y[idx])
+        # Vectorized CSR gather: per-feature ring slices -> new offset arrays.
+        r0 = self.feature_rings[idx]
+        r1 = self.feature_rings[idx + 1]
+        ring_counts = r1 - r0
+        new_feature_rings = np.concatenate([[0], np.cumsum(ring_counts)])
+        # indices of selected rings, in output order
+        ring_idx = (
+            np.concatenate([np.arange(a, b) for a, b in zip(r0, r1)])
+            if len(idx)
+            else np.zeros(0, dtype=np.int64)
+        )
+        v0 = self.ring_offsets[ring_idx]
+        v1 = self.ring_offsets[ring_idx + 1]
+        vert_counts = v1 - v0
+        new_ring_offsets = np.concatenate([[0], np.cumsum(vert_counts)])
+        vert_idx = (
+            np.concatenate([np.arange(a, b) for a, b in zip(v0, v1)])
+            if len(ring_idx)
+            else np.zeros(0, dtype=np.int64)
+        )
+        return GeometryColumn(
+            self.kind,
+            self.x[idx],
+            self.y[idx],
+            self.vertices[vert_idx],
+            new_ring_offsets.astype(np.int64),
+            new_feature_rings.astype(np.int64),
+            [self.feature_parts[int(i)] for i in idx],
+            self.bbox[idx],
+        )
+
+
+Column = Union[np.ndarray, DictColumn, GeometryColumn]
+
+
+@dataclasses.dataclass
+class FeatureBatch:
+    """An immutable batch of features in columnar layout."""
+
+    sft: SimpleFeatureType
+    columns: Dict[str, Column]
+    fids: Optional[DictColumn] = None
+    valid: Optional[np.ndarray] = None  # bool [N]; None = all valid
+
+    def __post_init__(self):
+        n = len(self)
+        for name, col in self.columns.items():
+            if len(col) != n:
+                raise ValueError(
+                    f"column {name!r} has length {len(col)}, expected {n}"
+                )
+
+    def __len__(self) -> int:
+        if not self.columns:
+            return 0
+        return len(next(iter(self.columns.values())))
+
+    @property
+    def num_valid(self) -> int:
+        return int(self.valid.sum()) if self.valid is not None else len(self)
+
+    @property
+    def geometry(self) -> Optional[GeometryColumn]:
+        g = self.sft.default_geometry
+        return self.columns[g.name] if g is not None else None  # type: ignore[return-value]
+
+    @property
+    def dtg(self) -> Optional[np.ndarray]:
+        d = self.sft.default_dtg
+        return self.columns[d.name] if d is not None else None  # type: ignore[return-value]
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    def select(self, mask_or_idx) -> "FeatureBatch":
+        arr = np.asarray(mask_or_idx)
+        idx = np.nonzero(arr)[0] if arr.dtype == bool else arr
+        cols = {
+            name: (col[idx] if isinstance(col, np.ndarray) else col.take(idx))
+            for name, col in self.columns.items()
+        }
+        fids = self.fids.take(idx) if self.fids is not None else None
+        valid = self.valid[idx] if self.valid is not None else None
+        return FeatureBatch(self.sft, cols, fids, valid)
+
+    def pad_to(self, size: int) -> "FeatureBatch":
+        """Pad all columns to `size`, extending the validity mask with False."""
+        n = len(self)
+        if size < n:
+            raise ValueError("pad_to smaller than batch")
+        if size == n and self.valid is not None:
+            return self
+        pad = size - n
+        cols: Dict[str, Column] = {}
+        for name, col in self.columns.items():
+            if isinstance(col, np.ndarray):
+                fill = np.zeros((pad,) + col.shape[1:], dtype=col.dtype)
+                cols[name] = np.concatenate([col, fill])
+            elif isinstance(col, DictColumn):
+                cols[name] = DictColumn(
+                    np.concatenate([col.codes, np.full(pad, -1, np.int32)]), col.vocab
+                )
+            else:  # GeometryColumn: pad point arrays; CSR padding = empty geoms
+                if col.is_point:
+                    cols[name] = GeometryColumn(
+                        col.kind,
+                        np.concatenate([col.x, np.zeros(pad)]),
+                        np.concatenate([col.y, np.zeros(pad)]),
+                    )
+                else:
+                    geoms = [col.geometry(i) for i in range(n)] + [
+                        Geometry(col.kind, [], parts=[0]) for _ in range(pad)
+                    ]
+                    cols[name] = GeometryColumn.from_geometries(geoms)
+        fids = (
+            DictColumn(
+                np.concatenate([self.fids.codes, np.full(pad, -1, np.int32)]),
+                self.fids.vocab,
+            )
+            if self.fids is not None
+            else None
+        )
+        valid = (
+            self.valid if self.valid is not None else np.ones(n, dtype=bool)
+        )
+        valid = np.concatenate([valid, np.zeros(pad, dtype=bool)])
+        return FeatureBatch(self.sft, cols, fids, valid)
+
+    @staticmethod
+    def concat(batches: Sequence["FeatureBatch"]) -> "FeatureBatch":
+        batches = [b for b in batches if len(b)]
+        if not batches:
+            raise ValueError("nothing to concat")
+        if len(batches) == 1:
+            return batches[0]
+        sft = batches[0].sft
+        cols: Dict[str, Column] = {}
+        for name in batches[0].columns:
+            parts = [b.columns[name] for b in batches]
+            first = parts[0]
+            if isinstance(first, np.ndarray):
+                cols[name] = np.concatenate(parts)
+            elif isinstance(first, DictColumn):
+                cols[name] = DictColumn.encode(
+                    [v for p in parts for v in p.decode()]
+                )
+            else:
+                geoms = [p.geometry(i) for p in parts for i in range(len(p))]
+                cols[name] = GeometryColumn.from_geometries(geoms)
+        fids = None
+        if batches[0].fids is not None:
+            fids = DictColumn.encode(
+                [v for b in batches for v in b.fids.decode()]
+            )
+        valid = None
+        if any(b.valid is not None for b in batches):
+            valid = np.concatenate(
+                [
+                    b.valid if b.valid is not None else np.ones(len(b), dtype=bool)
+                    for b in batches
+                ]
+            )
+        return FeatureBatch(sft, cols, fids, valid)
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_pydict(
+        cls,
+        sft: SimpleFeatureType,
+        data: Dict[str, Sequence],
+        fids: Optional[Sequence[str]] = None,
+    ) -> "FeatureBatch":
+        """Build from plain Python lists/arrays keyed by attribute name.
+
+        Geometry attributes accept: a list of Geometry, a list of WKT strings,
+        or (for Point) a (N,2) array / list of (x, y) tuples.
+        """
+        from geomesa_tpu.core.wkt import parse_wkt
+
+        cols: Dict[str, Column] = {}
+        for attr in sft.attributes:
+            if attr.name not in data:
+                raise KeyError(f"missing column {attr.name!r}")
+            raw = data[attr.name]
+            if attr.is_geometry:
+                if isinstance(raw, np.ndarray) and raw.ndim == 2:
+                    cols[attr.name] = GeometryColumn.from_points(raw[:, 0], raw[:, 1])
+                else:
+                    raw = list(raw)
+                    if raw and isinstance(raw[0], str):
+                        raw = [parse_wkt(w) for w in raw]
+                    if raw and isinstance(raw[0], (tuple, list)):
+                        arr = np.asarray(raw, dtype=np.float64)
+                        cols[attr.name] = GeometryColumn.from_points(arr[:, 0], arr[:, 1])
+                    else:
+                        cols[attr.name] = GeometryColumn.from_geometries(raw)
+            elif attr.type in ("String", "UUID"):
+                cols[attr.name] = DictColumn.encode(list(raw))
+            elif attr.is_temporal:
+                cols[attr.name] = _to_epoch_millis(raw)
+            elif attr.type == "Bytes":
+                cols[attr.name] = np.array(list(raw), dtype=object)
+            elif attr.type.startswith(("List[", "Map[")):
+                raise NotImplementedError(
+                    f"columnar layout for {attr.type!r} not implemented yet"
+                )
+            else:
+                dtype = {
+                    "Integer": np.int32,
+                    "Long": np.int64,
+                    "Double": np.float64,
+                    "Float": np.float32,
+                    "Boolean": np.bool_,
+                }[attr.type]
+                cols[attr.name] = np.asarray(raw, dtype=dtype)
+        fid_col = DictColumn.encode(list(fids)) if fids is not None else None
+        return cls(sft, cols, fid_col)
+
+
+def _to_epoch_millis(values) -> np.ndarray:
+    arr = np.asarray(values)
+    if arr.dtype.kind == "M":
+        return arr.astype("datetime64[ms]").astype(np.int64)
+    if arr.dtype.kind in "iu":
+        return arr.astype(np.int64)
+    if arr.dtype.kind == "f":
+        return arr.astype(np.int64)
+    # strings: ISO 8601
+    return (
+        np.array([np.datetime64(_clean_iso(str(v))) for v in values])
+        .astype("datetime64[ms]")
+        .astype(np.int64)
+    )
+
+
+def _clean_iso(s: str) -> str:
+    s = s.strip()
+    if s.endswith("Z"):
+        s = s[:-1]
+    return s

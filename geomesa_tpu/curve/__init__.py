@@ -1,0 +1,24 @@
+"""Space-filling curves: Z2/Z3 (points) and XZ2/XZ3 (extended geometries).
+
+Semantic parity with the reference's `geomesa-z3` module
+(org.locationtech.geomesa.curve: Z2SFC, Z3SFC, XZ2SFC, XZ3SFC, BinnedTime,
+NormalizedDimension [upstream, unverified]) and the external
+org.locationtech.sfcurve range-decomposition library, re-implemented from
+scratch as vectorized NumPy (host-side: used for partition pruning and index
+parity, not device execution).
+"""
+
+from geomesa_tpu.curve.normalized import NormalizedDimension, NormalizedLon, NormalizedLat
+from geomesa_tpu.curve.zorder import interleave2, interleave3, deinterleave2, deinterleave3
+from geomesa_tpu.curve.z2 import Z2SFC
+from geomesa_tpu.curve.z3 import Z3SFC
+from geomesa_tpu.curve.binned_time import BinnedTime, TimePeriod
+from geomesa_tpu.curve.zranges import zranges, IndexRange
+from geomesa_tpu.curve.xz import XZ2SFC, XZ3SFC
+
+__all__ = [
+    "NormalizedDimension", "NormalizedLon", "NormalizedLat",
+    "interleave2", "interleave3", "deinterleave2", "deinterleave3",
+    "Z2SFC", "Z3SFC", "BinnedTime", "TimePeriod",
+    "zranges", "IndexRange", "XZ2SFC", "XZ3SFC",
+]

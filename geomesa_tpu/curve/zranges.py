@@ -1,0 +1,134 @@
+"""Z-order range decomposition: query box -> covering set of key ranges.
+
+Parity: org.locationtech.sfcurve ZRange/zranges (the external dependency the
+reference's geomesa-z3 uses for BIGMIN-style range splitting) [upstream,
+unverified]. Re-implemented as a budgeted breadth-first quadtree/octree
+refinement over z-prefix cells, which produces the same *covering* guarantee:
+the union of returned ranges is a superset of the query box's cells, and every
+range endpoint pair is a contiguous z interval. False positives inside ranges
+are removed downstream by the residual predicate mask (the TPU analog of the
+reference's Z3Iterator server-side mask check).
+
+The refinement budget (`max_ranges`) mirrors the reference's
+`geomesa.scan.ranges.target` system property semantics: more ranges = tighter
+covering = fewer false positives, at higher planning cost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexRange:
+    """A contiguous inclusive z-value interval [lower, upper]."""
+
+    lower: int
+    upper: int
+    contained: bool = False  # True if every z in range is inside the query box
+
+    def __iter__(self):
+        yield self.lower
+        yield self.upper
+
+
+def _merge(ranges: List[IndexRange]) -> List[IndexRange]:
+    """Sort and coalesce adjacent/overlapping ranges."""
+    if not ranges:
+        return []
+    ranges = sorted(ranges, key=lambda r: r.lower)
+    out = [ranges[0]]
+    for r in ranges[1:]:
+        last = out[-1]
+        if r.lower <= last.upper + 1:
+            out[-1] = IndexRange(
+                last.lower, max(last.upper, r.upper), last.contained and r.contained
+            )
+        else:
+            out.append(r)
+    return out
+
+
+def zranges(
+    mins: Sequence[int],
+    maxs: Sequence[int],
+    bits_per_dim: int,
+    max_ranges: int = 2000,
+) -> List[IndexRange]:
+    """Decompose an axis-aligned box of normalized cells into z-ranges.
+
+    Args:
+      mins/maxs: inclusive per-dimension cell bounds (ints in [0, 2**bits)).
+      bits_per_dim: curve precision per dimension (31 for Z2, 21 for Z3).
+      max_ranges: refinement budget; the result may be coarser (more false
+        positives) but never misses a cell in the box.
+
+    Returns a sorted, merged list of IndexRange.
+    """
+    dims = len(mins)
+    assert dims == len(maxs) and dims in (2, 3)
+    fanout = 1 << dims
+
+    # A cell is (level, prefix) where prefix is the z-value of its first cell.
+    # At `level`, each dimension is refined to `level` bits; the cell spans
+    # z values [prefix, prefix + 2**(dims*(bits_per_dim-level)) - 1] and
+    # per-dim coordinates [dim_prefix << shift, ((dim_prefix+1) << shift) - 1].
+    mins = [int(m) for m in mins]
+    maxs = [int(m) for m in maxs]
+
+    def cell_relation(level: int, dim_prefixes: Sequence[int]) -> int:
+        """2 = cell inside box, 1 = overlaps, 0 = disjoint."""
+        shift = bits_per_dim - level
+        inside = True
+        for d in range(dims):
+            lo = dim_prefixes[d] << shift
+            hi = ((dim_prefixes[d] + 1) << shift) - 1
+            if hi < mins[d] or lo > maxs[d]:
+                return 0
+            if lo < mins[d] or hi > maxs[d]:
+                inside = False
+        return 2 if inside else 1
+
+    def cell_range(level: int, dim_prefixes: Sequence[int], contained: bool) -> IndexRange:
+        shift = bits_per_dim - level
+        if dims == 2:
+            from geomesa_tpu.curve.zorder import interleave2
+
+            z = int(interleave2(dim_prefixes[0], dim_prefixes[1]))
+        else:
+            from geomesa_tpu.curve.zorder import interleave3
+
+            z = int(interleave3(dim_prefixes[0], dim_prefixes[1], dim_prefixes[2]))
+        # z of the prefix at full resolution: shift the interleaved prefix up.
+        lower = z << (dims * shift)
+        upper = lower + (1 << (dims * shift)) - 1
+        return IndexRange(lower, upper, contained)
+
+    # Budgeted BFS: refine partially-overlapping cells while within budget.
+    contained: List[IndexRange] = []
+    frontier = [(0, tuple(0 for _ in range(dims)))]  # root cell
+    level = 0
+    while frontier and level < bits_per_dim:
+        if len(contained) + len(frontier) * fanout > max_ranges:
+            break
+        level += 1
+        next_frontier = []
+        for _, prefixes in frontier:
+            for child in range(fanout):
+                # child bit d selects the upper half of dimension d
+                child_prefixes = tuple(
+                    (prefixes[d] << 1) | ((child >> d) & 1) for d in range(dims)
+                )
+                rel = cell_relation(level, child_prefixes)
+                if rel == 0:
+                    continue
+                if rel == 2:
+                    contained.append(cell_range(level, child_prefixes, True))
+                else:
+                    next_frontier.append((level, child_prefixes))
+        frontier = next_frontier
+
+    # Remaining frontier cells become (overestimating) ranges.
+    ranges = contained + [cell_range(lvl, p, False) for lvl, p in frontier]
+    return _merge(ranges)

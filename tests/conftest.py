@@ -1,0 +1,16 @@
+"""Test environment: force JAX onto 8 virtual CPU devices.
+
+Multi-chip sharding logic is tested without TPU hardware, per the reference's
+"mini-cluster in one JVM" testing idea (SURVEY.md §4): all roles in-process.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,173 @@
+"""Core model tests: SFT spec round-trips, columnar batches, WKT, Arrow IO."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.core.arrow_io import from_arrow, read_ipc, to_arrow, write_ipc
+from geomesa_tpu.core.columnar import DictColumn, FeatureBatch, GeometryColumn
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.core.wkt import box, parse_wkt, point, to_wkt
+
+SPEC = "name:String:index=true,age:Integer,weight:Double,dtg:Date,*geom:Point:srid=4326"
+
+
+class TestSFT:
+    def test_parse(self):
+        sft = SimpleFeatureType.from_spec("test", SPEC)
+        assert sft.attribute_names == ["name", "age", "weight", "dtg", "geom"]
+        assert sft.attribute("name").options == {"index": "true"}
+        assert sft.default_geometry.name == "geom"
+        assert sft.default_dtg.name == "dtg"
+        assert sft.attribute("geom").default_geom
+
+    def test_roundtrip(self):
+        sft = SimpleFeatureType.from_spec("test", SPEC)
+        sft2 = SimpleFeatureType.from_spec("test", sft.to_spec())
+        assert sft2.to_spec() == sft.to_spec()
+
+    def test_user_data(self):
+        sft = SimpleFeatureType.from_spec(
+            "t", "dtg:Date,*geom:Point;geomesa.z3.interval=day,geomesa.index.dtg=dtg"
+        )
+        assert sft.user_data["geomesa.z3.interval"] == "day"
+        assert sft.default_dtg.name == "dtg"
+
+    def test_aliases_and_lists(self):
+        sft = SimpleFeatureType.from_spec("t", "a:int,b:long,c:List[String],*g:Geometry")
+        assert sft.attribute("a").type == "Integer"
+        assert sft.attribute("b").type == "Long"
+        assert sft.attribute("c").type == "List[String]"
+
+    def test_bad_type_raises(self):
+        with pytest.raises(ValueError):
+            SimpleFeatureType.from_spec("t", "a:Blob")
+
+
+class TestWKT:
+    def test_point_roundtrip(self):
+        g = parse_wkt("POINT (10 20)")
+        assert g.point == (10.0, 20.0)
+        assert to_wkt(g) == "POINT (10.0 20.0)"
+
+    def test_polygon_with_hole(self):
+        g = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), (2 2, 4 2, 4 4, 2 4, 2 2))")
+        assert g.kind == "Polygon" and len(g.rings) == 2
+        assert g.bbox == (0.0, 0.0, 10.0, 10.0)
+        g2 = parse_wkt(to_wkt(g))
+        np.testing.assert_array_equal(g2.rings[1], g.rings[1])
+
+    def test_multipolygon(self):
+        g = parse_wkt("MULTIPOLYGON (((0 0, 1 0, 1 1, 0 0)), ((5 5, 6 5, 6 6, 5 5)))")
+        assert g.kind == "MultiPolygon" and g.parts == [1, 1]
+        g2 = parse_wkt(to_wkt(g))
+        assert g2.parts == [1, 1]
+
+    def test_linestring_and_multipoint(self):
+        g = parse_wkt("LINESTRING (0 0, 1 1, 2 0)")
+        assert g.rings[0].shape == (3, 2)
+        g = parse_wkt("MULTIPOINT ((1 2), (3 4))")
+        assert len(g.rings) == 2
+        g = parse_wkt("MULTIPOINT (1 2, 3 4)")
+        assert len(g.rings) == 2
+
+    def test_box_helper(self):
+        b = box(-10, -5, 10, 5)
+        assert b.bbox == (-10.0, -5.0, 10.0, 5.0)
+
+
+def make_batch(n=10):
+    sft = SimpleFeatureType.from_spec("test", SPEC)
+    rng = np.random.default_rng(0)
+    return FeatureBatch.from_pydict(
+        sft,
+        {
+            "name": [f"n{i % 3}" for i in range(n)],
+            "age": np.arange(n),
+            "weight": rng.uniform(0, 100, n),
+            "dtg": np.arange(n) * 3600_000 + 1_600_000_000_000,
+            "geom": rng.uniform(-90, 90, (n, 2)),
+        },
+        fids=[f"fid{i}" for i in range(n)],
+    )
+
+
+class TestFeatureBatch:
+    def test_construct(self):
+        b = make_batch(10)
+        assert len(b) == 10
+        assert isinstance(b.column("name"), DictColumn)
+        assert b.column("name").decode()[:3] == ["n0", "n1", "n2"]
+        assert b.geometry.is_point
+        assert b.dtg.dtype == np.int64
+
+    def test_select(self):
+        b = make_batch(10)
+        sel = b.select(np.array([0, 2, 4]))
+        assert len(sel) == 3
+        assert sel.column("age").tolist() == [0, 2, 4]
+        assert sel.fids.decode() == ["fid0", "fid2", "fid4"]
+        mask = np.zeros(10, dtype=bool)
+        mask[7] = True
+        assert b.select(mask).column("age").tolist() == [7]
+
+    def test_pad(self):
+        b = make_batch(10)
+        p = b.pad_to(16)
+        assert len(p) == 16
+        assert p.num_valid == 10
+        assert not p.valid[10:].any()
+
+    def test_concat(self):
+        b1, b2 = make_batch(5), make_batch(7)
+        c = FeatureBatch.concat([b1, b2])
+        assert len(c) == 12
+        assert c.column("name").decode()[5] == "n0"
+
+    def test_extended_geometry_column(self):
+        polys = [
+            parse_wkt("POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))"),
+            parse_wkt("POLYGON ((10 10, 12 10, 12 12, 10 12, 10 10), (10.5 10.5, 11 10.5, 11 11, 10.5 10.5))"),
+        ]
+        col = GeometryColumn.from_geometries(polys)
+        assert not col.is_point
+        assert col.bbox[0].tolist() == [0, 0, 4, 4]
+        g = col.geometry(1)
+        assert len(g.rings) == 2
+        taken = col.take(np.array([1]))
+        assert len(taken) == 1 and len(taken.geometry(0).rings) == 2
+
+
+class TestArrowIO:
+    def test_roundtrip(self):
+        b = make_batch(10)
+        rb = to_arrow(b)
+        assert rb.num_rows == 10
+        b2 = from_arrow(rb)
+        assert b2.column("name").decode() == b.column("name").decode()
+        np.testing.assert_array_equal(b2.column("age"), b.column("age"))
+        np.testing.assert_allclose(b2.geometry.x, b.geometry.x)
+        assert b2.fids.decode() == b.fids.decode()
+
+    def test_polygon_roundtrip(self):
+        sft = SimpleFeatureType.from_spec("p", "name:String,*geom:Polygon")
+        b = FeatureBatch.from_pydict(
+            sft,
+            {
+                "name": ["a", "b"],
+                "geom": [
+                    "POLYGON ((0 0, 4 0, 4 4, 0 0))",
+                    "POLYGON ((1 1, 2 1, 2 2, 1 1))",
+                ],
+            },
+        )
+        b2 = from_arrow(to_arrow(b))
+        assert b2.geometry.bbox[1].tolist() == [1, 1, 2, 2]
+
+    def test_ipc_file(self, tmp_path):
+        b = make_batch(10)
+        path = str(tmp_path / "features.arrow")
+        write_ipc(path, [b, b])
+        batches = read_ipc(path)
+        assert len(batches) == 2
+        assert len(batches[0]) == 10
+        assert batches[0].sft.name == "test"

@@ -1,0 +1,240 @@
+"""Space-filling curve tests: round-trips, covering guarantees, golden vectors.
+
+Mirrors the reference's pure-unit tier (SURVEY.md §4): Z3SFCTest-style
+round-trip and range-cover correctness, plus known-answer Morton vectors.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve import (
+    XZ2SFC,
+    XZ3SFC,
+    Z2SFC,
+    Z3SFC,
+    BinnedTime,
+    TimePeriod,
+    deinterleave2,
+    deinterleave3,
+    interleave2,
+    interleave3,
+    zranges,
+)
+from geomesa_tpu.curve.binned_time import (
+    bin_to_epoch_millis,
+    bins_for_interval,
+    max_offset_seconds,
+    to_binned_time,
+)
+
+rng = np.random.default_rng(42)
+
+
+class TestMorton:
+    def test_golden_2d(self):
+        # x=5 (101), y=3 (011), x on even bits: y2x2 y1x1 y0x0 = 011011 = 27
+        assert int(interleave2(5, 3)) == 0b011011
+        assert int(interleave2(0, 0)) == 0
+        assert int(interleave2(1, 0)) == 1
+        assert int(interleave2(0, 1)) == 2
+        assert int(interleave2(1, 1)) == 3
+        assert int(interleave2(2**31 - 1, 2**31 - 1)) == 2**62 - 1
+
+    def test_golden_3d(self):
+        assert int(interleave3(1, 0, 0)) == 1
+        assert int(interleave3(0, 1, 0)) == 2
+        assert int(interleave3(0, 0, 1)) == 4
+        assert int(interleave3(1, 1, 1)) == 7
+        assert int(interleave3(2**21 - 1, 2**21 - 1, 2**21 - 1)) == 2**63 - 1
+
+    def test_roundtrip_2d(self):
+        x = rng.integers(0, 2**31, size=1000)
+        y = rng.integers(0, 2**31, size=1000)
+        z = interleave2(x, y)
+        rx, ry = deinterleave2(z)
+        np.testing.assert_array_equal(rx, x)
+        np.testing.assert_array_equal(ry, y)
+
+    def test_roundtrip_3d(self):
+        x = rng.integers(0, 2**21, size=1000)
+        y = rng.integers(0, 2**21, size=1000)
+        t = rng.integers(0, 2**21, size=1000)
+        z = interleave3(x, y, t)
+        rx, ry, rt = deinterleave3(z)
+        np.testing.assert_array_equal(rx, x)
+        np.testing.assert_array_equal(ry, y)
+        np.testing.assert_array_equal(rt, t)
+
+    def test_ordering_locality(self):
+        # z of (x, y) and (x+1, y) in the same quad share high bits
+        assert int(interleave2(4, 4)) // 16 == int(interleave2(5, 5)) // 16
+
+
+class TestZ2:
+    def test_index_invert_roundtrip(self):
+        sfc = Z2SFC()
+        lon = rng.uniform(-180, 180, size=500)
+        lat = rng.uniform(-90, 90, size=500)
+        z = sfc.index(lon, lat)
+        rlon, rlat = sfc.invert(z)
+        # within half a cell
+        assert np.max(np.abs(rlon - lon)) <= 360.0 / 2**31
+        assert np.max(np.abs(rlat - lat)) <= 180.0 / 2**31
+
+    def test_ranges_cover(self):
+        sfc = Z2SFC()
+        box = (-10.0, -10.0, 10.0, 10.0)
+        ranges = sfc.ranges(*box, max_ranges=500)
+        assert ranges
+        # every point in the box must fall in some range (covering guarantee)
+        lon = rng.uniform(box[0], box[2], size=300)
+        lat = rng.uniform(box[1], box[3], size=300)
+        z = sfc.index(lon, lat)
+        for zi in z:
+            assert any(r.lower <= int(zi) <= r.upper for r in ranges)
+
+    def test_ranges_exclude_far_points(self):
+        sfc = Z2SFC()
+        ranges = sfc.ranges(-10, -10, 10, 10, max_ranges=2000)
+        # a far-away point should not be inside (tight covering)
+        z = int(sfc.index(120.0, 70.0))
+        assert not any(r.lower <= z <= r.upper for r in ranges)
+
+    def test_more_ranges_is_tighter(self):
+        sfc = Z2SFC()
+        coarse = sfc.ranges(-10, -10, 10, 10, max_ranges=16)
+        fine = sfc.ranges(-10, -10, 10, 10, max_ranges=2000)
+        size = lambda rs: sum(r.upper - r.lower + 1 for r in rs)
+        assert size(fine) <= size(coarse)
+
+
+class TestBinnedTime:
+    def test_week_bins(self):
+        # 1970-01-01 was Thursday; epoch is in ISO week starting Mon 1969-12-29
+        b, off = to_binned_time(np.int64(0), TimePeriod.WEEK)
+        assert int(b) == 0
+        assert float(off) == 4 * 86400.0  # Thu is 4 days after Mon
+
+    def test_day_bins(self):
+        ms = np.int64(86400_000 * 3 + 3600_000)
+        b, off = to_binned_time(ms, TimePeriod.DAY)
+        assert int(b) == 3 and float(off) == 3600.0
+
+    def test_month_year(self):
+        ms = np.int64(np.datetime64("2020-03-15T12:00:00", "ms").astype(np.int64))
+        b, off = to_binned_time(ms, TimePeriod.MONTH)
+        assert int(b) == (2020 - 1970) * 12 + 2
+        assert float(off) == 14 * 86400.0 + 12 * 3600.0
+        b, off = to_binned_time(ms, TimePeriod.YEAR)
+        assert int(b) == 50
+
+    def test_bin_start_roundtrip(self):
+        for period in TimePeriod:
+            ms = int(np.datetime64("2021-06-05T00:00:00", "ms").astype(np.int64))
+            b, off = to_binned_time(np.int64(ms), period)
+            start = bin_to_epoch_millis(int(b), period)
+            assert start + float(off) * 1000 == ms
+
+    def test_bins_for_interval(self):
+        start = int(np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64))
+        end = int(np.datetime64("2020-01-20T00:00:00", "ms").astype(np.int64))
+        bins = bins_for_interval(start, end, TimePeriod.WEEK)
+        assert len(bins) == 4  # spans 4 ISO weeks
+        assert bins[0][1] > 0  # first bin starts mid-week
+        assert bins[-1][2] < max_offset_seconds(TimePeriod.WEEK)
+
+
+class TestZ3:
+    def test_roundtrip(self):
+        sfc = Z3SFC("week")
+        lon = rng.uniform(-180, 180, size=200)
+        lat = rng.uniform(-90, 90, size=200)
+        t = rng.integers(1_500_000_000_000, 1_600_000_000_000, size=200)
+        bins, z = sfc.index(lon, lat, t)
+        rlon, rlat, roff = sfc.invert(z)
+        assert np.max(np.abs(rlon - lon)) <= 360.0 / 2**21
+        assert np.max(np.abs(rlat - lat)) <= 180.0 / 2**21
+
+    def test_ranges_cover(self):
+        sfc = Z3SFC("week")
+        t0 = int(np.datetime64("2020-06-01T00:00:00", "ms").astype(np.int64))
+        t1 = int(np.datetime64("2020-06-10T00:00:00", "ms").astype(np.int64))
+        per_bin = sfc.ranges(-20, -20, 20, 20, t0, t1, max_ranges=4000)
+        lon = rng.uniform(-20, 20, size=200)
+        lat = rng.uniform(-20, 20, size=200)
+        t = rng.integers(t0, t1, size=200)
+        bins, z = sfc.index(lon, lat, t)
+        for b, zi in zip(bins, z):
+            ranges = per_bin[int(b)]
+            assert any(r.lower <= int(zi) <= r.upper for r in ranges), (b, zi)
+
+
+class TestZRangesGeneric:
+    def test_full_domain(self):
+        rs = zranges((0, 0), (2**31 - 1, 2**31 - 1), 31)
+        assert len(rs) == 1
+        assert rs[0].lower == 0 and rs[0].upper == 2**62 - 1
+
+    def test_single_cell(self):
+        rs = zranges((5, 3), (5, 3), 4, max_ranges=10000)
+        z = int(interleave2(5, 3))
+        assert any(r.lower <= z <= r.upper for r in rs)
+
+    def test_covering_3d(self):
+        mins, maxs = (100, 200, 300), (150, 260, 310)
+        rs = zranges(mins, maxs, 21, max_ranges=300)
+        for _ in range(100):
+            p = [int(rng.integers(mins[d], maxs[d] + 1)) for d in range(3)]
+            z = int(interleave3(*p))
+            assert any(r.lower <= z <= r.upper for r in rs)
+
+
+class TestXZ2:
+    def test_point_like_max_resolution(self):
+        sfc = XZ2SFC(g=12)
+        code = sfc.index(10.0, 10.0, 10.0, 10.0)
+        assert code > 0
+
+    def test_query_finds_indexed_boxes(self):
+        sfc = XZ2SFC(g=12)
+        # boxes inside the query window must be found
+        query = (-20.0, -20.0, 20.0, 20.0)
+        ranges = sfc.ranges(*query, max_ranges=2000)
+        for _ in range(100):
+            x0 = rng.uniform(-19, 18)
+            y0 = rng.uniform(-19, 18)
+            w = rng.uniform(0.001, 1.0)
+            code = sfc.index(x0, y0, x0 + w, y0 + w)
+            assert any(r.lower <= code <= r.upper for r in ranges), (x0, y0, w)
+
+    def test_query_finds_overlapping_boxes(self):
+        sfc = XZ2SFC(g=12)
+        query = (0.0, 0.0, 10.0, 10.0)
+        ranges = sfc.ranges(*query, max_ranges=2000)
+        # a box straddling the query edge must also be found
+        code = sfc.index(-5.0, -5.0, 5.0, 5.0)
+        assert any(r.lower <= code <= r.upper for r in ranges)
+        # a big box containing the whole query must be found
+        code = sfc.index(-50.0, -50.0, 50.0, 50.0)
+        assert any(r.lower <= code <= r.upper for r in ranges)
+
+    def test_disjoint_box_excluded(self):
+        sfc = XZ2SFC(g=12)
+        ranges = sfc.ranges(0.0, 0.0, 10.0, 10.0, max_ranges=2000)
+        code = sfc.index(100.0, 50.0, 101.0, 51.0)
+        assert not any(r.lower <= code <= r.upper for r in ranges)
+
+
+class TestXZ3:
+    def test_query_finds_indexed_boxes(self):
+        sfc = XZ3SFC("week", g=8)
+        t0 = int(np.datetime64("2020-06-01T00:00:00", "ms").astype(np.int64))
+        t1 = int(np.datetime64("2020-06-03T00:00:00", "ms").astype(np.int64))
+        per_bin = sfc.ranges(-20, -20, 20, 20, t0, t1, max_ranges=4000)
+        for _ in range(50):
+            x0 = rng.uniform(-19, 18)
+            y0 = rng.uniform(-19, 18)
+            ts = int(rng.integers(t0, t1 - 3600_000))
+            b, code = sfc.index(x0, y0, x0 + 0.5, y0 + 0.5, ts, ts + 3600_000)
+            assert b in per_bin
+            assert any(r.lower <= code <= r.upper for r in per_bin[b]), (x0, y0, ts)
