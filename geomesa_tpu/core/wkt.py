@@ -37,6 +37,16 @@ class Geometry:
         if not self.parts:
             self.parts = [len(self.rings)]
 
+    def __eq__(self, other):
+        if not isinstance(other, Geometry):
+            return NotImplemented
+        return (
+            self.kind == other.kind
+            and self.parts == other.parts
+            and len(self.rings) == len(other.rings)
+            and all(np.array_equal(a, b) for a, b in zip(self.rings, other.rings))
+        )
+
     @property
     def bbox(self) -> Tuple[float, float, float, float]:
         if not self.rings:

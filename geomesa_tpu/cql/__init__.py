@@ -1,0 +1,29 @@
+"""CQL/ECQL filter engine.
+
+Parity: geomesa-filter (FastFilterFactory, FilterHelper) [upstream,
+unverified]. Three stages, mirroring the reference's split between filter
+*analysis* (planning-time) and filter *evaluation* (scan-time):
+
+- ``parser``  — ECQL text -> typed AST (the predicate set from SURVEY.md C4:
+  BBOX, INTERSECTS, WITHIN, CONTAINS, OVERLAPS, CROSSES, TOUCHES, DISJOINT,
+  DWITHIN, BEYOND, DURING, BEFORE, AFTER, TEQUALS, comparisons, BETWEEN,
+  LIKE/ILIKE, IN, IS NULL, AND/OR/NOT, INCLUDE/EXCLUDE).
+- ``extract`` — geometry-bounds and time-interval extraction from arbitrary
+  filter trees (FilterHelper.extractGeometries/extractIntervals semantics),
+  feeding index-range planning and partition pruning.
+- ``compile`` — AST -> a pure, jit-compatible mask function over device
+  columns: the TPU replacement for FastFilterFactory's optimized evaluators
+  and the server-side residual-filter iterators.
+"""
+
+from geomesa_tpu.cql.parser import parse_cql
+from geomesa_tpu.cql.extract import extract_bbox, extract_intervals
+from geomesa_tpu.cql.compile import compile_filter, CompiledFilter
+
+__all__ = [
+    "parse_cql",
+    "extract_bbox",
+    "extract_intervals",
+    "compile_filter",
+    "CompiledFilter",
+]

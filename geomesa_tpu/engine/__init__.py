@@ -1,0 +1,13 @@
+"""TPU execution engine: device batches and the kernel suite.
+
+This package is the TPU-native replacement for the reference's server-side
+scan path (geomesa-index-api iterators + geomesa-accumulo/-hbase distributed
+runtimes) and the compute cores of geomesa-process [upstream, unverified]:
+residual CQL evaluation = compiled predicate masks; DensityScan = masked
+scatter-add; StatsScan = masked reductions; KNN/TubeSelect = tiled distance
+kernels; cross-device merge = XLA collectives over the "shard" mesh axis.
+"""
+
+from geomesa_tpu.engine.device import DeviceBatch, to_device
+
+__all__ = ["DeviceBatch", "to_device"]

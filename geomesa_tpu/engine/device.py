@@ -1,0 +1,118 @@
+"""Device-resident feature batches.
+
+The host FeatureBatch (NumPy + vocab) maps onto a flat dict of device arrays
+— a pytree that jitted kernels take as an argument. Naming convention:
+
+  <attr>            numeric / dict-code (int32) / temporal (int64 millis)
+  <attr>__x/__y     point coordinates (coord_dtype, default float32)
+  <attr>__bbox      [N,4] per-feature envelopes (extended geometries)
+  <attr>__verts     [V,2] CSR vertex buffer (extended geometries)
+  <attr>__rings     [R+1] ring offsets        <attr>__featr  [N+1] feature->rings
+  __valid__         bool validity mask (padding-aware)
+
+Dtype policy (SURVEY.md §7 design stance): f64 on host; f32 coordinates on
+device by default (adequate for ~1 m predicate resolution; kernels that need
+tighter tolerance, e.g. kNN refinement, upcast selectively). Epoch-millis
+stay int64 — int64 compare/add on TPU lowers to cheap s32 pairs, unlike f64
+matmuls. geomesa_tpu enables jax x64 so int64 survives; all kernel dtypes
+are explicit, so nothing else silently widens.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import jax
+
+if os.environ.get("GEOMESA_TPU_ENABLE_X64", "1") == "1":
+    jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+from geomesa_tpu.core.columnar import DictColumn, FeatureBatch, GeometryColumn
+
+DeviceBatch = Dict[str, jax.Array]
+
+VALID = "__valid__"
+
+
+def to_device(
+    batch: FeatureBatch,
+    coord_dtype=jnp.float32,
+    device=None,
+) -> DeviceBatch:
+    """Transfer a FeatureBatch to device arrays (see module docstring)."""
+    out: Dict[str, jax.Array] = {}
+    put = lambda a: jax.device_put(a, device)
+    for attr in batch.sft.attributes:
+        col = batch.columns[attr.name]
+        if isinstance(col, GeometryColumn):
+            out[f"{attr.name}__x"] = put(jnp.asarray(col.x, coord_dtype))
+            out[f"{attr.name}__y"] = put(jnp.asarray(col.y, coord_dtype))
+            if not col.is_point:
+                out[f"{attr.name}__bbox"] = put(jnp.asarray(col.bbox, coord_dtype))
+                out[f"{attr.name}__verts"] = put(jnp.asarray(col.vertices, coord_dtype))
+                out[f"{attr.name}__rings"] = put(jnp.asarray(col.ring_offsets, jnp.int32))
+                out[f"{attr.name}__featr"] = put(jnp.asarray(col.feature_rings, jnp.int32))
+                vfeat, edges, efeat = _csr_tables(col)
+                out[f"{attr.name}__vfeat"] = put(jnp.asarray(vfeat, jnp.int32))
+                out[f"{attr.name}__ex1"] = put(jnp.asarray(edges[0], coord_dtype))
+                out[f"{attr.name}__ey1"] = put(jnp.asarray(edges[1], coord_dtype))
+                out[f"{attr.name}__ex2"] = put(jnp.asarray(edges[2], coord_dtype))
+                out[f"{attr.name}__ey2"] = put(jnp.asarray(edges[3], coord_dtype))
+                out[f"{attr.name}__efeat"] = put(jnp.asarray(efeat, jnp.int32))
+        elif isinstance(col, DictColumn):
+            out[attr.name] = put(jnp.asarray(col.codes, jnp.int32))
+        elif col.dtype == object:
+            continue  # Bytes columns stay host-side
+        elif attr.is_temporal:
+            out[attr.name] = put(jnp.asarray(col, jnp.int64))
+        else:
+            out[attr.name] = put(jnp.asarray(col))
+    valid = (
+        batch.valid
+        if batch.valid is not None
+        else np.ones(len(batch), dtype=bool)
+    )
+    out[VALID] = put(jnp.asarray(valid))
+    return out
+
+
+def _csr_tables(col: GeometryColumn):
+    """Host-side: per-vertex feature ids and the ring edge table.
+
+    Rings are closed into edges for polygon kinds; line kinds keep open
+    paths. Edge table is (x1, y1, x2, y2) with a parallel feature-id array —
+    the layout the extended-geometry predicate kernels segment-reduce over.
+    """
+    n = len(col)
+    is_poly = "Polygon" in col.kind or col.kind in ("Geometry", "GeometryCollection")
+    vfeat = np.zeros(len(col.vertices), dtype=np.int32)
+    x1s, y1s, x2s, y2s, efeat = [], [], [], [], []
+    for i in range(n):
+        r0, r1 = int(col.feature_rings[i]), int(col.feature_rings[i + 1])
+        for r in range(r0, r1):
+            v0, v1 = int(col.ring_offsets[r]), int(col.ring_offsets[r + 1])
+            vfeat[v0:v1] = i
+            ring = col.vertices[v0:v1]
+            if len(ring) < 2:
+                continue
+            closed = is_poly and not np.array_equal(ring[0], ring[-1])
+            pts = np.concatenate([ring, ring[:1]], axis=0) if closed else ring
+            x1s.append(pts[:-1, 0])
+            y1s.append(pts[:-1, 1])
+            x2s.append(pts[1:, 0])
+            y2s.append(pts[1:, 1])
+            efeat.append(np.full(len(pts) - 1, i, dtype=np.int32))
+    if x1s:
+        edges = tuple(
+            np.concatenate(a) for a in (x1s, y1s, x2s, y2s)
+        )
+        ef = np.concatenate(efeat)
+    else:
+        z = np.zeros(0, np.float64)
+        edges = (z, z, z, z)
+        ef = np.zeros(0, np.int32)
+    return vfeat, edges, ef

@@ -1,0 +1,73 @@
+"""Geodetic distance kernels.
+
+Parity: the geodesic-distance role of GeoTools' GeodeticCalculator in the
+reference's KNN process (treated as haversine per BASELINE.json's config 3)
+[upstream, unverified]. Haversine on the WGS84 mean sphere — vectorized,
+MXU/VPU-friendly (pure elementwise trig; fuses into surrounding kernels).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+EARTH_RADIUS_M = 6_371_008.8  # IUGG mean radius
+
+
+def haversine_m(lon1, lat1, lon2, lat2, dtype=None):
+    """Great-circle distance in meters. Broadcasts over inputs.
+
+    Uses the numerically-stable haversine form; for sub-meter stability at
+    tiny separations compute in f32 with f64 refinement upstream if needed.
+    """
+    if dtype is not None:
+        lon1, lat1, lon2, lat2 = (jnp.asarray(a, dtype) for a in (lon1, lat1, lon2, lat2))
+    rlon1, rlat1, rlon2, rlat2 = (jnp.radians(a) for a in (lon1, lat1, lon2, lat2))
+    dlat = rlat2 - rlat1
+    dlon = rlon2 - rlon1
+    a = (
+        jnp.sin(dlat / 2) ** 2
+        + jnp.cos(rlat1) * jnp.cos(rlat2) * jnp.sin(dlon / 2) ** 2
+    )
+    return 2.0 * EARTH_RADIUS_M * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+
+
+def haversine_m_np(lon1, lat1, lon2, lat2):
+    """NumPy reference implementation (the test oracle's distance)."""
+    rlon1, rlat1, rlon2, rlat2 = (
+        np.radians(np.asarray(a, np.float64)) for a in (lon1, lat1, lon2, lat2)
+    )
+    dlat = rlat2 - rlat1
+    dlon = rlon2 - rlon1
+    a = (
+        np.sin(dlat / 2) ** 2
+        + np.cos(rlat1) * np.cos(rlat2) * np.sin(dlon / 2) ** 2
+    )
+    return 2.0 * EARTH_RADIUS_M * np.arcsin(np.sqrt(np.clip(a, 0.0, 1.0)))
+
+
+def point_to_segments_m(px, py, sx1, sy1, sx2, sy2):
+    """Approximate min distance (meters) from points to a set of segments.
+
+    Equirectangular local projection around each point's latitude: exact
+    enough for DWITHIN-style predicates at sub-percent error for segment
+    spans << Earth radius (documented divergence from the reference's
+    geodesic calculator; the error is conservative-tested in parity suites).
+
+    px, py: [N]; s*: [S]. Returns [N] min over segments.
+    """
+    deg_m_lat = 111_194.9  # pi * R / 180
+    coslat = jnp.cos(jnp.radians(py))[:, None]
+    # project: meters relative to each point
+    ax = (sx1[None, :] - px[:, None]) * deg_m_lat * coslat
+    ay = (sy1[None, :] - py[:, None]) * deg_m_lat
+    bx = (sx2[None, :] - px[:, None]) * deg_m_lat * coslat
+    by = (sy2[None, :] - py[:, None]) * deg_m_lat
+    dx = bx - ax
+    dy = by - ay
+    seg_len2 = dx * dx + dy * dy
+    t = jnp.clip(-(ax * dx + ay * dy) / jnp.maximum(seg_len2, 1e-12), 0.0, 1.0)
+    cx = ax + t * dx
+    cy = ay + t * dy
+    d2 = cx * cx + cy * cy
+    return jnp.sqrt(jnp.min(d2, axis=1))

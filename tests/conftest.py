@@ -2,15 +2,26 @@
 
 Multi-chip sharding logic is tested without TPU hardware, per the reference's
 "mini-cluster in one JVM" testing idea (SURVEY.md §4): all roles in-process.
-Must run before jax is imported anywhere.
+
+Environment note: this image registers an experimental 'axon' TPU PJRT plugin
+via sitecustomize (PYTHONPATH=/root/.axon_site) and pins JAX_PLATFORMS=axon in
+jax.config at register time. Initializing ANY backend then dials the TPU
+tunnel and can hang for minutes, so tests must (1) deregister the axon/tpu
+factories and (2) reset jax_platforms to cpu — env vars alone are not enough
+because register() already overrode the config.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+from jax._src import xla_bridge as _xb
+
+for _name in ("axon", "tpu"):
+    _xb._backend_factories.pop(_name, None)
+jax.config.update("jax_platforms", "cpu")
