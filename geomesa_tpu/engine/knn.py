@@ -1,0 +1,200 @@
+"""k-nearest-neighbor kernels: tiled brute-force haversine + sharded merges.
+
+Parity: geomesa-process KNearestNeighborSearchProcess (knn/) [upstream,
+unverified]. The reference's windowed expand-and-requery search exists to
+avoid scanning the world from a key-value store; on TPU the economics invert —
+a dense tiled all-pairs haversine over the (index-pruned) candidate batch is
+exact by construction, so there is no radius iteration and no recall risk.
+Recall@k parity is therefore structural: every kernel here is brute-force
+over whatever candidates it is given.
+
+Three execution shapes (SURVEY.md §5.7's "ring-topk replaces ring-attention"):
+
+- `knn`          — single device, queries tiled through VMEM via lax.map.
+- `knn_sharded`  — data sharded over the mesh axis; per-shard local top-k,
+                   then all_gather(k·D candidates) + re-top-k. One collective,
+                   exact. The merge is the TPU analog of the reference's
+                   client-side fan-in of per-tablet results (C25).
+- `knn_ring`     — queries AND data sharded; data shards rotate by ppermute
+                   around the ring while each device folds the visiting shard
+                   into its running top-k. O(D) steps, constant memory: the
+                   long-context/feature-set-scaling shape.
+
+Distances are f32 by default (~meter-scale resolution at Earth radius);
+ties at f32 resolution can reorder equidistant neighbors vs an f64 oracle —
+recall tests treat within-tolerance distance ties as equivalent.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from geomesa_tpu.engine.geodesy import haversine_m
+from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+INF = jnp.float32(jnp.inf)
+
+
+def _topk_smallest(d: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """top-k smallest along the last axis -> (dists, indices).
+
+    If fewer than k candidates exist (tiny shard, k > rows), the result is
+    padded with +inf distances so downstream merges stay shape-stable.
+    """
+    kk = min(k, d.shape[-1])
+    neg, idx = jax.lax.top_k(-d, kk)
+    if kk < k:
+        pad = [(0, 0)] * (d.ndim - 1) + [(0, k - kk)]
+        neg = jnp.pad(neg, pad, constant_values=-jnp.inf)
+        idx = jnp.pad(idx, pad)
+    return -neg, idx
+
+
+@functools.partial(jax.jit, static_argnames=("k", "query_tile"))
+def knn(
+    qx: jax.Array,
+    qy: jax.Array,
+    dx: jax.Array,
+    dy: jax.Array,
+    mask: jax.Array,
+    k: int,
+    query_tile: int = 1024,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN: [Q] query points vs [N] masked data points.
+
+    Returns (dists [Q,k] meters, indices [Q,k] into the data arrays).
+    Invalid/masked data points get +inf distance (index still in range).
+    Queries are processed in fixed-size tiles so the QxN distance block
+    streams through memory instead of materializing at once.
+    """
+    q = qx.shape[0]
+    pad = (-q) % query_tile
+    qxp = jnp.pad(qx, (0, pad))
+    qyp = jnp.pad(qy, (0, pad))
+    tiles_x = qxp.reshape(-1, query_tile)
+    tiles_y = qyp.reshape(-1, query_tile)
+
+    def tile(args):
+        tx, ty = args
+        d = haversine_m(tx[:, None], ty[:, None], dx[None, :], dy[None, :])
+        d = jnp.where(mask[None, :], d, INF)
+        return _topk_smallest(d, k)
+
+    dists, idx = jax.lax.map(tile, (tiles_x, tiles_y))
+    return (
+        dists.reshape(-1, k)[:q],
+        idx.reshape(-1, k)[:q],
+    )
+
+
+def knn_sharded(
+    mesh: Mesh,
+    qx: jax.Array,
+    qy: jax.Array,
+    dx: jax.Array,
+    dy: jax.Array,
+    mask: jax.Array,
+    k: int,
+    query_tile: int = 1024,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN with data sharded over the mesh: local top-k + all_gather
+    merge. Returns (dists [Q,k], global indices [Q,k]).
+
+    Exactness: each shard's local top-k is exact over its rows; the true
+    global top-k is a subset of the union of per-shard top-ks, so the merged
+    re-top-k is exact — the same argument as the reference's per-tablet
+    aggregation + client merge, with psum-free O(D·Q·k) gather traffic.
+    """
+    d_count = mesh.devices.size
+    shard_n = dx.shape[0] // d_count
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(), P()),
+        # post-gather re-top-k computes identical values on every device;
+        # JAX's varying-mesh-axes check can't infer that, so assert it
+        check_vma=False,
+    )
+    def run(qx, qy, dx, dy, mask):
+        dists, idx = knn(qx, qy, dx, dy, mask, k=k, query_tile=query_tile)
+        shard = jax.lax.axis_index(SHARD_AXIS)
+        gidx = idx + shard * shard_n
+        # [D, Q, k] candidate pools on every device
+        all_d = jax.lax.all_gather(dists, SHARD_AXIS)
+        all_i = jax.lax.all_gather(gidx, SHARD_AXIS)
+        pool_d = jnp.moveaxis(all_d, 0, 1).reshape(dists.shape[0], -1)
+        pool_i = jnp.moveaxis(all_i, 0, 1).reshape(dists.shape[0], -1)
+        md, mi = _topk_smallest(pool_d, k)
+        return md, jnp.take_along_axis(pool_i, mi, axis=1)
+
+    return run(qx, qy, dx, dy, mask)
+
+
+def knn_ring(
+    mesh: Mesh,
+    qx: jax.Array,
+    qy: jax.Array,
+    dx: jax.Array,
+    dy: jax.Array,
+    mask: jax.Array,
+    k: int,
+    query_tile: int = 1024,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN with BOTH queries and data sharded: ring top-k.
+
+    Each device owns a query shard and a data shard; data shards rotate
+    around the ring (ppermute) for D steps while every device folds the
+    visiting shard into its running top-k. Communication is the data shard
+    itself (the ring-attention access pattern), never the QxN distances.
+    Returns (dists, global indices) sharded like the queries.
+    """
+    d_count = mesh.devices.size
+    shard_n = dx.shape[0] // d_count
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS),
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+        ),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
+    def run(qx, qy, dx, dy, mask):
+        me = jax.lax.axis_index(SHARD_AXIS)
+        perm = [(i, (i + 1) % d_count) for i in range(d_count)]
+
+        def step(i, carry):
+            best_d, best_i, dx, dy, mask = carry
+            owner = (me - i) % d_count  # whose shard is visiting
+            ld, li = knn(qx, qy, dx, dy, mask, k=k, query_tile=query_tile)
+            gi = (li + owner * shard_n).astype(jnp.int32)
+            pool_d = jnp.concatenate([best_d, ld], axis=1)
+            pool_i = jnp.concatenate([best_i, gi], axis=1)
+            nd, sel = _topk_smallest(pool_d, k)
+            ni = jnp.take_along_axis(pool_i, sel, axis=1)
+            dx, dy, mask = (
+                jax.lax.ppermute(a, SHARD_AXIS, perm) for a in (dx, dy, mask)
+            )
+            return nd, ni, dx, dy, mask
+
+        q = qx.shape[0]
+        dist_dtype = jnp.promote_types(jnp.promote_types(qx.dtype, dx.dtype), jnp.float32)
+        # mark the init carry as device-varying (it becomes so after step 1)
+        best_d = jax.lax.pcast(
+            jnp.full((q, k), jnp.inf, dist_dtype), SHARD_AXIS, to="varying"
+        )
+        best_i = jax.lax.pcast(jnp.zeros((q, k), jnp.int32), SHARD_AXIS, to="varying")
+        best_d, best_i, *_ = jax.lax.fori_loop(
+            0, d_count, step, (best_d, best_i, dx, dy, mask)
+        )
+        return best_d, best_i
+
+    return run(qx, qy, dx, dy, mask)

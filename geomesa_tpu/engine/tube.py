@@ -1,0 +1,107 @@
+"""Tube-select kernel: spatio-temporal corridor join.
+
+Parity: geomesa-process TubeSelectProcess (tube/) [upstream, unverified]:
+"find features near this track in space AND time". The reference builds tube
+segments (buffered geometries + time intervals) host-side via TubeBuilder
+variants (NoGapFill / LineGapFill / InterpolatedGapFill) and issues one
+spatial+temporal query per segment. TPU-first shape: the tube is a compact
+array of (lon, lat, time, radius_m, half_window_ms) samples; the kernel is a
+single masked (N data x T tube-samples) haversine + time-window test, tiled
+over T — every data point is matched against the whole corridor in one fused
+pass instead of S sequential store queries.
+
+Gap-filling lives host-side in process/tube.py (same division of labor as the
+reference); this kernel only sees the sampled tube.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from geomesa_tpu.engine.geodesy import haversine_m
+from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+
+@functools.partial(jax.jit, static_argnames=("tube_tile",))
+def tube_select(
+    x: jax.Array,
+    y: jax.Array,
+    t: jax.Array,
+    mask: jax.Array,
+    tube_x: jax.Array,
+    tube_y: jax.Array,
+    tube_t: jax.Array,
+    radius_m: jax.Array,
+    half_window_ms: jax.Array,
+    tube_tile: int = 2048,
+) -> jax.Array:
+    """bool [N]: data point matches if within radius AND time window of ANY
+    tube sample. Tube arrays are [T]; radius/window may be scalar or [T]."""
+    T = tube_x.shape[0]
+    radius_m = jnp.broadcast_to(jnp.asarray(radius_m, jnp.float32), (T,))
+    half_window_ms = jnp.broadcast_to(
+        jnp.asarray(half_window_ms, jnp.int64), (T,)
+    )
+    pad = (-T) % tube_tile
+    tx = jnp.pad(tube_x, (0, pad))
+    ty = jnp.pad(tube_y, (0, pad))
+    tt = jnp.pad(tube_t, (0, pad))
+    tr = jnp.pad(radius_m, (0, pad), constant_values=-1.0)  # pad never matches
+    tw = jnp.pad(half_window_ms, (0, pad))
+
+    def tile(carry, args):
+        txi, tyi, tti, tri, twi = args
+        d = haversine_m(x[:, None], y[:, None], txi[None, :], tyi[None, :])
+        dt = jnp.abs(t[:, None] - tti[None, :])
+        hit = (d <= tri[None, :]) & (dt <= twi[None, :])
+        return carry | jnp.any(hit, axis=1), None
+
+    # zeros_like keeps the carry's varying-mesh-axes type aligned with x
+    # when this kernel runs inside shard_map
+    init = jnp.zeros_like(x, dtype=bool)
+    out, _ = jax.lax.scan(
+        tile,
+        init,
+        (
+            tx.reshape(-1, tube_tile),
+            ty.reshape(-1, tube_tile),
+            tt.reshape(-1, tube_tile),
+            tr.reshape(-1, tube_tile),
+            tw.reshape(-1, tube_tile),
+        ),
+    )
+    return out & mask
+
+
+def tube_select_sharded(
+    mesh: Mesh,
+    x, y, t, mask,
+    tube_x, tube_y, tube_t, radius_m, half_window_ms,
+    tube_tile: int = 2048,
+):
+    """Data sharded over the mesh; the tube (small) is replicated. The result
+    mask stays sharded like the data — no collective needed (pure map)."""
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(), P(), P(), P(), P(),
+        ),
+        out_specs=P(SHARD_AXIS),
+    )
+    def run(x, y, t, m, tx, ty, tt, tr, tw):
+        return tube_select(x, y, t, m, tx, ty, tt, tr, tw, tube_tile=tube_tile)
+
+    return run(
+        x, y, t, mask,
+        tube_x, tube_y, tube_t,
+        jnp.broadcast_to(jnp.asarray(radius_m, jnp.float32), tube_x.shape),
+        jnp.broadcast_to(jnp.asarray(half_window_ms, jnp.int64), tube_x.shape),
+    )

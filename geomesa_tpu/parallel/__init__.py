@@ -1,0 +1,25 @@
+"""Device mesh + sharding utilities.
+
+Parity role: the reference's parallelism inventory (SURVEY.md C24-C27) —
+range-partitioned scan parallelism and server-side compute offload — becomes
+data-parallel sharding of the feature batch axis over a 1-D `jax.sharding.Mesh`
+axis "shard", with XLA collectives (psum / all_gather / ppermute over ICI)
+replacing client-coordinated fan-in merges. There is no NCCL/MPI: ICI/DCN via
+XLA is the whole communication backend (SURVEY.md §5.8).
+"""
+
+from geomesa_tpu.parallel.mesh import (
+    SHARD_AXIS,
+    default_mesh,
+    shard_device_batch,
+    shard_batch_host,
+    replicated,
+)
+
+__all__ = [
+    "SHARD_AXIS",
+    "default_mesh",
+    "shard_device_batch",
+    "shard_batch_host",
+    "replicated",
+]

@@ -1,0 +1,69 @@
+"""Mesh construction and batch sharding.
+
+The feature-batch axis is the one meaningful parallel axis for this workload
+(SURVEY.md C24): every kernel is a masked map/reduction over features, so a
+1-D mesh with axis "shard" covers DP-style scaling; multi-host runs extend the
+same axis over DCN via jax.distributed initialization (no code change in the
+kernels — XLA routes collectives over ICI within a slice and DCN across).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.engine.device import VALID, DeviceBatch, to_device
+
+SHARD_AXIS = "shard"
+
+
+def default_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def replicated(mesh: Mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def shard_device_batch(dev: DeviceBatch, mesh: Mesh) -> DeviceBatch:
+    """Shard feature-axis arrays over the mesh; CSR buffers stay replicated.
+
+    Arrays whose leading dim equals the batch length shard on axis 0; the
+    batch length must divide evenly (pad first — pad_to a multiple of the
+    mesh size; the validity mask keeps padding inert).
+    """
+    n = int(dev[VALID].shape[0])
+    d = mesh.devices.size
+    if n % d != 0:
+        raise ValueError(
+            f"batch length {n} not divisible by mesh size {d}; pad_to first"
+        )
+    row = NamedSharding(mesh, P(SHARD_AXIS))
+    rep = NamedSharding(mesh, P())
+    out = {}
+    for k, v in dev.items():
+        if v.ndim >= 1 and v.shape[0] == n and not k.endswith(
+            ("__verts", "__rings", "__featr", "__vfeat", "__ex1", "__ey1", "__ex2", "__ey2", "__efeat")
+        ):
+            out[k] = jax.device_put(v, row)
+        else:
+            out[k] = jax.device_put(v, rep)
+    return out
+
+
+def shard_batch_host(
+    batch: FeatureBatch, mesh: Mesh, coord_dtype=jnp.float32
+) -> DeviceBatch:
+    """Host FeatureBatch -> padded, sharded device batch."""
+    d = mesh.devices.size
+    n = len(batch)
+    padded = batch.pad_to(((n + d - 1) // d) * d) if n % d else batch
+    if padded.valid is None:
+        padded = padded.pad_to(len(padded))  # force a validity mask
+    return shard_device_batch(to_device(padded, coord_dtype), mesh)

@@ -1,0 +1,28 @@
+"""Mergeable stat sketches + the Stat DSL.
+
+Parity: org.locationtech.geomesa.utils.stats (geomesa-utils) [upstream,
+unverified]: parseable stat expressions ("MinMax(dtg);Frequency(name)") with
+mergeable implementations used for both query-time aggregation (StatsScan)
+and the planner's selectivity estimation (GeoMesaStats / StatsBasedEstimator).
+"""
+
+from geomesa_tpu.stats.sketches import (
+    Cardinality,
+    DescriptiveStats,
+    EnumerationStat,
+    Frequency,
+    GroupBy,
+    Histogram,
+    MinMax,
+    SeqStat,
+    Stat,
+    TopK,
+    Z3HistogramStat,
+)
+from geomesa_tpu.stats.dsl import parse_stats
+
+__all__ = [
+    "Stat", "MinMax", "Cardinality", "Frequency", "TopK", "Histogram",
+    "DescriptiveStats", "EnumerationStat", "GroupBy", "SeqStat",
+    "Z3HistogramStat", "parse_stats",
+]

@@ -1,0 +1,493 @@
+"""Mergeable stat sketch implementations.
+
+Parity: the Stat hierarchy in geomesa-utils o.l.g.utils.stats [upstream,
+unverified]: MinMax, Cardinality (HyperLogLog upstream; HLL here too),
+Frequency (Count-Min), TopK (StreamSummary upstream; exact-counts-over-
+dict-codes here, feasible because columns are dictionary-encoded), Histogram
+(fixed-width bins), DescriptiveStats (count/mean/variance via moments),
+EnumerationStat, GroupBy, SeqStat, Z3Histogram.
+
+Design: sketches are host-side mergeable objects whose `observe_*` methods
+accept batch-level *device reduction results* (from engine.stats) or raw
+NumPy columns — the merge laws (associative, commutative) are what the
+cross-shard psum/gather guarantees ride on. Each sketch serializes to a JSON
+dict (`to_json`/`from_json`) standing in for the reference's binary stat
+serialization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Stat:
+    """Base: observe(values, mask) ; merge(other) ; result() ; to_json().
+
+    Subclasses carry an `attribute` field naming the observed column.
+    (No default here: a class-level default would leak into the dataclass
+    subclasses' field ordering.)
+    """
+
+    kind = "stat"
+
+    def observe(self, values, mask=None):
+        raise NotImplementedError
+
+    def merge(self, other: "Stat") -> "Stat":
+        raise NotImplementedError
+
+    def result(self):
+        raise NotImplementedError
+
+    def to_json(self) -> dict:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_json(d: dict) -> "Stat":
+        cls = _KINDS[d["kind"]]
+        return cls._from_json(d)
+
+
+def _masked(values, mask):
+    values = np.asarray(values)
+    if mask is not None:
+        values = values[np.asarray(mask)]
+    return values
+
+
+@dataclasses.dataclass
+class MinMax(Stat):
+    attribute: str
+    min: Optional[float] = None
+    max: Optional[float] = None
+    kind = "minmax"
+
+    def observe(self, values, mask=None):
+        v = _masked(values, mask)
+        if len(v):
+            lo, hi = float(np.min(v)), float(np.max(v))
+            self.min = lo if self.min is None else min(self.min, lo)
+            self.max = hi if self.max is None else max(self.max, hi)
+
+    def merge(self, other):
+        if other.min is not None:
+            self.observe(np.array([other.min, other.max]))
+        return self
+
+    def result(self):
+        return (self.min, self.max)
+
+    def to_json(self):
+        return {"kind": self.kind, "attribute": self.attribute,
+                "min": self.min, "max": self.max}
+
+    @classmethod
+    def _from_json(cls, d):
+        return cls(d["attribute"], d["min"], d["max"])
+
+
+class Cardinality(Stat):
+    """HyperLogLog distinct-count estimate (upstream: HyperLogLog via
+    stream-lib). Standard HLL with 2^p registers, p=12 (~1.6% error)."""
+
+    kind = "cardinality"
+
+    def __init__(self, attribute: str, p: int = 12, registers=None):
+        self.attribute = attribute
+        self.p = p
+        self.m = 1 << p
+        self.registers = (
+            np.zeros(self.m, np.uint8) if registers is None else np.asarray(registers, np.uint8)
+        )
+
+    def observe(self, values, mask=None):
+        v = _masked(values, mask)
+        for x in v:
+            h = int.from_bytes(
+                hashlib.blake2b(str(x).encode(), digest_size=8).digest(), "big"
+            )
+            idx = h >> (64 - self.p)
+            rest = (h << self.p) & ((1 << 64) - 1)
+            # rank = 1-based position of the first 1-bit in the remaining word
+            rank = (65 - rest.bit_length()) if rest else (64 - self.p + 1)
+            self.registers[idx] = max(self.registers[idx], rank)
+
+    def merge(self, other):
+        self.registers = np.maximum(self.registers, other.registers)
+        return self
+
+    def result(self) -> float:
+        m = self.m
+        alpha = 0.7213 / (1 + 1.079 / m)
+        est = alpha * m * m / np.sum(2.0 ** -self.registers.astype(np.float64))
+        zeros = int(np.sum(self.registers == 0))
+        if est <= 2.5 * m and zeros:
+            est = m * math.log(m / zeros)
+        return float(est)
+
+    def to_json(self):
+        return {"kind": self.kind, "attribute": self.attribute, "p": self.p,
+                "registers": self.registers.tolist()}
+
+    @classmethod
+    def _from_json(cls, d):
+        return cls(d["attribute"], d["p"], d["registers"])
+
+
+class Frequency(Stat):
+    """Count-Min sketch for value frequencies (upstream: Frequency)."""
+
+    kind = "frequency"
+
+    def __init__(self, attribute: str, width: int = 1024, depth: int = 4, table=None):
+        self.attribute = attribute
+        self.width = width
+        self.depth = depth
+        self.table = (
+            np.zeros((depth, width), np.int64) if table is None else np.asarray(table, np.int64)
+        )
+
+    def _rows(self, value) -> List[int]:
+        out = []
+        for d in range(self.depth):
+            h = hashlib.blake2b(
+                str(value).encode(), digest_size=8, salt=d.to_bytes(2, "big") * 8
+            ).digest()
+            out.append(int.from_bytes(h, "big") % self.width)
+        return out
+
+    def observe(self, values, mask=None):
+        v = _masked(np.asarray(values, dtype=object), mask)
+        uniq, counts = np.unique(v.astype(str), return_counts=True)
+        for val, c in zip(uniq, counts):
+            for d, col in enumerate(self._rows(val)):
+                self.table[d, col] += int(c)
+
+    def observe_counts(self, vocab: Sequence[str], counts: np.ndarray):
+        """Feed from engine.stats.masked_value_counts results."""
+        for val, c in zip(vocab, counts):
+            if c:
+                for d, col in enumerate(self._rows(val)):
+                    self.table[d, col] += int(c)
+
+    def count(self, value) -> int:
+        return int(min(self.table[d, col] for d, col in enumerate(self._rows(value))))
+
+    def merge(self, other):
+        self.table += other.table
+        return self
+
+    def result(self):
+        return self
+
+    def to_json(self):
+        return {"kind": self.kind, "attribute": self.attribute,
+                "width": self.width, "depth": self.depth,
+                "table": self.table.tolist()}
+
+    @classmethod
+    def _from_json(cls, d):
+        return cls(d["attribute"], d["width"], d["depth"], d["table"])
+
+
+class TopK(Stat):
+    """Top-k most frequent values. Upstream uses StreamSummary; dictionary
+    encoding makes exact per-code counting cheap, so this is exact."""
+
+    kind = "topk"
+
+    def __init__(self, attribute: str, k: int = 10, counts: Optional[Dict[str, int]] = None):
+        self.attribute = attribute
+        self.k = k
+        self.counts: Dict[str, int] = dict(counts or {})
+
+    def observe(self, values, mask=None):
+        v = _masked(np.asarray(values, dtype=object), mask)
+        for val in v:
+            if val is not None:
+                key = str(val)
+                self.counts[key] = self.counts.get(key, 0) + 1
+
+    def observe_counts(self, vocab: Sequence[str], counts: np.ndarray):
+        for val, c in zip(vocab, counts):
+            if c:
+                self.counts[val] = self.counts.get(val, 0) + int(c)
+
+    def merge(self, other):
+        for k, c in other.counts.items():
+            self.counts[k] = self.counts.get(k, 0) + c
+        return self
+
+    def result(self):
+        return sorted(self.counts.items(), key=lambda kv: (-kv[1], kv[0]))[: self.k]
+
+    def to_json(self):
+        return {"kind": self.kind, "attribute": self.attribute, "k": self.k,
+                "counts": self.counts}
+
+    @classmethod
+    def _from_json(cls, d):
+        return cls(d["attribute"], d["k"], d["counts"])
+
+
+@dataclasses.dataclass
+class Histogram(Stat):
+    attribute: str
+    bins: int
+    lo: float
+    hi: float
+    counts: Optional[np.ndarray] = None
+    kind = "histogram"
+
+    def __post_init__(self):
+        if self.counts is None:
+            self.counts = np.zeros(self.bins, np.int64)
+        else:
+            self.counts = np.asarray(self.counts, np.int64)
+
+    def observe(self, values, mask=None):
+        v = _masked(values, mask).astype(np.float64)
+        idx = np.clip(
+            ((v - self.lo) / ((self.hi - self.lo) / self.bins)).astype(int),
+            0,
+            self.bins - 1,
+        )
+        np.add.at(self.counts, idx, 1)
+
+    def observe_counts(self, counts: np.ndarray):
+        self.counts += np.asarray(counts, np.int64)
+
+    def merge(self, other):
+        self.counts += other.counts
+        return self
+
+    def result(self):
+        return self.counts
+
+    def to_json(self):
+        return {"kind": self.kind, "attribute": self.attribute, "bins": self.bins,
+                "lo": self.lo, "hi": self.hi, "counts": self.counts.tolist()}
+
+    @classmethod
+    def _from_json(cls, d):
+        return cls(d["attribute"], d["bins"], d["lo"], d["hi"], d["counts"])
+
+
+@dataclasses.dataclass
+class DescriptiveStats(Stat):
+    attribute: str
+    count: int = 0
+    sum: float = 0.0
+    sum_sq: float = 0.0
+    kind = "descriptive"
+
+    def observe(self, values, mask=None):
+        v = _masked(values, mask).astype(np.float64)
+        self.count += len(v)
+        self.sum += float(v.sum())
+        self.sum_sq += float((v * v).sum())
+
+    def observe_moments(self, count: int, total: float, total_sq: float):
+        self.count += int(count)
+        self.sum += float(total)
+        self.sum_sq += float(total_sq)
+
+    def merge(self, other):
+        self.observe_moments(other.count, other.sum, other.sum_sq)
+        return self
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else float("nan")
+
+    @property
+    def variance(self) -> float:
+        if self.count < 2:
+            return float("nan")
+        return max(
+            (self.sum_sq - self.sum * self.sum / self.count) / (self.count - 1), 0.0
+        )
+
+    def result(self):
+        return {"count": self.count, "mean": self.mean,
+                "variance": self.variance,
+                "stddev": math.sqrt(self.variance) if self.count >= 2 else float("nan")}
+
+    def to_json(self):
+        return {"kind": self.kind, "attribute": self.attribute,
+                "count": self.count, "sum": self.sum, "sum_sq": self.sum_sq}
+
+    @classmethod
+    def _from_json(cls, d):
+        return cls(d["attribute"], d["count"], d["sum"], d["sum_sq"])
+
+
+class EnumerationStat(Stat):
+    """Exact value -> count map (upstream: EnumerationStat)."""
+
+    kind = "enumeration"
+
+    def __init__(self, attribute: str, counts: Optional[Dict[str, int]] = None):
+        self.attribute = attribute
+        self.counts: Dict[str, int] = dict(counts or {})
+
+    observe = TopK.observe
+    observe_counts = TopK.observe_counts
+    merge = TopK.merge
+
+    def result(self):
+        return dict(self.counts)
+
+    def to_json(self):
+        return {"kind": self.kind, "attribute": self.attribute, "counts": self.counts}
+
+    @classmethod
+    def _from_json(cls, d):
+        return cls(d["attribute"], d["counts"])
+
+
+class Z3HistogramStat(Stat):
+    """Coarse (time-bin, x, y) occupancy counts (upstream: Z3Histogram);
+    feeds planner selectivity for spatio-temporal predicates."""
+
+    kind = "z3histogram"
+
+    def __init__(self, geom: str, dtg: str, period: str = "week",
+                 bins_per_dim: int = 16, counts: Optional[Dict[str, list]] = None):
+        self.attribute = geom
+        self.geom = geom
+        self.dtg = dtg
+        self.period = period
+        self.bins_per_dim = bins_per_dim
+        # per-time-bin [b,b] grids, keyed by str(bin)
+        self.counts: Dict[str, np.ndarray] = {
+            k: np.asarray(v, np.int64) for k, v in (counts or {}).items()
+        }
+
+    def observe_grid(self, time_bin: int, grid: np.ndarray):
+        key = str(int(time_bin))
+        if key in self.counts:
+            self.counts[key] += np.asarray(grid, np.int64)
+        else:
+            self.counts[key] = np.asarray(grid, np.int64).copy()
+
+    def observe(self, values, mask=None):
+        raise TypeError("Z3HistogramStat is fed via observe_grid")
+
+    def merge(self, other):
+        for k, g in other.counts.items():
+            if k in self.counts:
+                self.counts[k] += g
+            else:
+                self.counts[k] = g.copy()
+        return self
+
+    def estimate(self, xmin, ymin, xmax, ymax, bins: Sequence[int]) -> int:
+        """Upper-bound count of features in the box over the given time bins."""
+        b = self.bins_per_dim
+        c0 = max(0, min(b - 1, int((xmin + 180.0) / 360.0 * b)))
+        c1 = max(0, min(b - 1, int((xmax + 180.0) / 360.0 * b)))
+        r0 = max(0, min(b - 1, int((ymin + 90.0) / 180.0 * b)))
+        r1 = max(0, min(b - 1, int((ymax + 90.0) / 180.0 * b)))
+        total = 0
+        for tb in bins:
+            g = self.counts.get(str(int(tb)))
+            if g is not None:
+                total += int(g[r0 : r1 + 1, c0 : c1 + 1].sum())
+        return total
+
+    def result(self):
+        return self.counts
+
+    def to_json(self):
+        return {"kind": self.kind, "geom": self.geom, "dtg": self.dtg,
+                "period": self.period, "bins_per_dim": self.bins_per_dim,
+                "counts": {k: v.tolist() for k, v in self.counts.items()}}
+
+    @classmethod
+    def _from_json(cls, d):
+        return cls(d["geom"], d["dtg"], d["period"], d["bins_per_dim"], d["counts"])
+
+
+class GroupBy(Stat):
+    """Group a sub-stat by the values of an attribute (upstream: GroupBy)."""
+
+    kind = "groupby"
+
+    def __init__(self, attribute: str, substat_factory, groups=None):
+        self.attribute = attribute
+        self.factory = substat_factory
+        self.groups: Dict[str, Stat] = groups or {}
+
+    def observe_grouped(self, key: str, values, mask=None):
+        if key not in self.groups:
+            sub = self.factory() if self.factory else None
+            if sub is None:
+                raise TypeError(
+                    "deserialized GroupBy is read-only for new groups "
+                    "(substat factory not serialized)"
+                )
+            self.groups[key] = sub
+        self.groups[key].observe(values, mask)
+
+    def observe(self, values, mask=None):
+        raise TypeError("GroupBy is fed via observe_grouped")
+
+    def merge(self, other):
+        for k, s in other.groups.items():
+            if k in self.groups:
+                self.groups[k].merge(s)
+            else:
+                self.groups[k] = s
+        return self
+
+    def result(self):
+        return {k: s.result() for k, s in self.groups.items()}
+
+    def to_json(self):
+        return {"kind": self.kind, "attribute": self.attribute,
+                "groups": {k: s.to_json() for k, s in self.groups.items()}}
+
+    @classmethod
+    def _from_json(cls, d):
+        groups = {k: Stat.from_json(s) for k, s in d["groups"].items()}
+        return cls(d["attribute"], lambda: None, groups)
+
+
+class SeqStat(Stat):
+    """A sequence of stats observed together (the ';' in the DSL)."""
+
+    kind = "seq"
+
+    def __init__(self, stats: List[Stat]):
+        self.stats = stats
+
+    def observe(self, values, mask=None):
+        raise TypeError("observe SeqStat members individually")
+
+    def merge(self, other):
+        for a, b in zip(self.stats, other.stats):
+            a.merge(b)
+        return self
+
+    def result(self):
+        return [s.result() for s in self.stats]
+
+    def to_json(self):
+        return {"kind": self.kind, "stats": [s.to_json() for s in self.stats]}
+
+    @classmethod
+    def _from_json(cls, d):
+        return cls([Stat.from_json(s) for s in d["stats"]])
+
+
+_KINDS = {
+    c.kind: c
+    for c in (MinMax, Cardinality, Frequency, TopK, Histogram,
+              DescriptiveStats, EnumerationStat, Z3HistogramStat, GroupBy, SeqStat)
+}

@@ -1,0 +1,27 @@
+"""Storage: filesystem (Parquet) datastore, partition schemes, device cache.
+
+Parity: geomesa-fs (geomesa-fs-storage-api / -common / -parquet /
+-datastore) [upstream, unverified] — the store behind BASELINE config #1.
+"""
+
+from geomesa_tpu.store.partition import (
+    AttributeScheme,
+    CompositeScheme,
+    DateTimeScheme,
+    PartitionScheme,
+    XZ2Scheme,
+    Z2Scheme,
+    scheme_from_config,
+)
+from geomesa_tpu.store.fs import FileSystemStorage
+
+__all__ = [
+    "PartitionScheme",
+    "DateTimeScheme",
+    "Z2Scheme",
+    "XZ2Scheme",
+    "AttributeScheme",
+    "CompositeScheme",
+    "scheme_from_config",
+    "FileSystemStorage",
+]

@@ -1,0 +1,292 @@
+"""Filesystem (Parquet) storage: partitioned writes, pruned + pushed-down reads.
+
+Parity: geomesa-fs-storage-parquet SimpleFeatureParquetWriter + FilterConverter
+(CQL -> Parquet predicate pushdown) and geomesa-fs-datastore's
+query = prune partitions -> read files w/ pushdown -> residual pipeline
+[upstream, unverified].
+
+Layout on disk:
+
+    <root>/metadata.json            sft spec + scheme config + manifest
+    <root>/<partition>/<uuid>.parquet
+
+Parquet schema is the flat columnar mapping of core.arrow_io (point geometry
+as x/y float64 columns named <attr>__x/__y so min/max row-group statistics
+prune on bbox; extended geometries as WKT plus <attr>__bbox_* bound columns).
+Partition pruning consumes the covering sets from store.partition; pruned
+names match partitions by exact name or path-prefix (composite wildcards).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import uuid
+from typing import Dict, Iterator, List, Optional, Sequence, Set
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+
+from geomesa_tpu.core.columnar import DictColumn, FeatureBatch, GeometryColumn
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.core.wkt import parse_wkt, to_wkt
+from geomesa_tpu.cql.extract import BBox, Interval
+from geomesa_tpu.store.partition import PartitionScheme, scheme_from_config
+
+METADATA = "metadata.json"
+FID = "__fid__"
+
+
+def _batch_to_table(batch: FeatureBatch) -> pa.Table:
+    arrays: Dict[str, pa.Array] = {}
+    for a in batch.sft.attributes:
+        col = batch.columns[a.name]
+        if isinstance(col, GeometryColumn):
+            if col.is_point:
+                arrays[f"{a.name}__x"] = pa.array(col.x, pa.float64())
+                arrays[f"{a.name}__y"] = pa.array(col.y, pa.float64())
+            else:
+                arrays[a.name] = pa.array(
+                    [to_wkt(col.geometry(i)) for i in range(len(col))]
+                )
+                bb = col.bbox
+                arrays[f"{a.name}__xmin"] = pa.array(bb[:, 0], pa.float64())
+                arrays[f"{a.name}__ymin"] = pa.array(bb[:, 1], pa.float64())
+                arrays[f"{a.name}__xmax"] = pa.array(bb[:, 2], pa.float64())
+                arrays[f"{a.name}__ymax"] = pa.array(bb[:, 3], pa.float64())
+        elif isinstance(col, DictColumn):
+            codes = np.asarray(col.codes, np.int64)
+            arrays[a.name] = pa.DictionaryArray.from_arrays(
+                pa.array(codes, pa.int32(), mask=codes < 0),
+                pa.array(col.vocab, pa.string()),
+            )
+        elif a.type == "Bytes":
+            arrays[a.name] = pa.array(list(col), pa.binary())
+        elif a.is_temporal:
+            arrays[a.name] = pa.array(np.asarray(col, np.int64), pa.int64())
+        else:
+            arrays[a.name] = pa.array(col)
+    if batch.fids is not None:
+        codes = np.asarray(batch.fids.codes, np.int64)
+        arrays[FID] = pa.DictionaryArray.from_arrays(
+            pa.array(codes, pa.int32(), mask=codes < 0),
+            pa.array(batch.fids.vocab, pa.string()),
+        )
+    return pa.table(arrays)
+
+
+def _table_to_batch(t: pa.Table, sft: SimpleFeatureType) -> FeatureBatch:
+    # projection support: narrow the SFT to the attributes present
+    present = [
+        a
+        for a in sft.attributes
+        if (a.name in t.schema.names)
+        or (a.is_geometry and a.type == "Point" and f"{a.name}__x" in t.schema.names)
+    ]
+    if len(present) != len(sft.attributes):
+        sft = SimpleFeatureType(sft.name, present, sft.user_data)
+    cols: Dict[str, object] = {}
+    for a in sft.attributes:
+        if a.is_geometry:
+            if a.type == "Point":
+                x = t.column(f"{a.name}__x").to_numpy()
+                y = t.column(f"{a.name}__y").to_numpy()
+                cols[a.name] = GeometryColumn.from_points(x, y)
+            else:
+                geoms = [parse_wkt(w) for w in t.column(a.name).to_pylist()]
+                cols[a.name] = GeometryColumn.from_geometries(geoms)
+        elif a.type in ("String", "UUID"):
+            col = t.column(a.name)
+            arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+            if pa.types.is_dictionary(arr.type):
+                codes = arr.indices.to_numpy(zero_copy_only=False)
+                if codes.dtype.kind == "f":
+                    codes = np.where(np.isnan(codes), -1, codes)
+                cols[a.name] = DictColumn(codes.astype(np.int32), arr.dictionary.to_pylist())
+            else:
+                cols[a.name] = DictColumn.encode(arr.to_pylist())
+        elif a.type == "Bytes":
+            cols[a.name] = np.array(t.column(a.name).to_pylist(), dtype=object)
+        else:
+            cols[a.name] = t.column(a.name).to_numpy()
+    fids = None
+    if FID in t.schema.names:
+        col = t.column(FID)
+        arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+        if pa.types.is_dictionary(arr.type):
+            codes = arr.indices.to_numpy(zero_copy_only=False)
+            if codes.dtype.kind == "f":
+                codes = np.where(np.isnan(codes), -1, codes)
+            fids = DictColumn(codes.astype(np.int32), arr.dictionary.to_pylist())
+        else:
+            fids = DictColumn.encode(arr.to_pylist())
+    return FeatureBatch(sft, cols, fids)
+
+
+class FileSystemStorage:
+    """A partitioned Parquet feature store."""
+
+    def __init__(self, root: str, sft: SimpleFeatureType, scheme: PartitionScheme):
+        self.root = root
+        self.sft = sft
+        self.scheme = scheme
+        # manifest: partition -> list of {"file", "count"}
+        self.manifest: Dict[str, List[dict]] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def create(
+        cls, root: str, sft: SimpleFeatureType, scheme: PartitionScheme
+    ) -> "FileSystemStorage":
+        os.makedirs(root, exist_ok=True)
+        if os.path.exists(os.path.join(root, METADATA)):
+            raise FileExistsError(f"storage already exists at {root}")
+        store = cls(root, sft, scheme)
+        store._save_metadata()
+        return store
+
+    @classmethod
+    def load(cls, root: str) -> "FileSystemStorage":
+        with open(os.path.join(root, METADATA)) as f:
+            meta = json.load(f)
+        sft = SimpleFeatureType.from_spec(meta["name"], meta["spec"])
+        store = cls(root, sft, scheme_from_config(meta["scheme"]))
+        store.manifest = meta.get("manifest", {})
+        return store
+
+    def _save_metadata(self):
+        meta = {
+            "version": 1,
+            "name": self.sft.name,
+            "spec": self.sft.to_spec(),
+            "scheme": self.scheme.to_config(),
+            "manifest": self.manifest,
+        }
+        tmp = os.path.join(self.root, METADATA + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1)
+        os.replace(tmp, os.path.join(self.root, METADATA))
+
+    @property
+    def count(self) -> int:
+        return sum(f["count"] for files in self.manifest.values() for f in files)
+
+    # -- write -------------------------------------------------------------
+
+    def write(self, batch: FeatureBatch) -> None:
+        """Partition the batch by the scheme and append one parquet file per
+        touched partition. Writes are idempotent at file granularity (fresh
+        uuids), matching the reference's append model."""
+        if batch.valid is not None and not batch.valid.all():
+            batch = batch.select(batch.valid)
+        names = np.asarray(self.scheme.partitions_for(batch))
+        for name in np.unique(names):
+            sub = batch.select(names == name)
+            pdir = os.path.join(self.root, name)
+            os.makedirs(pdir, exist_ok=True)
+            fname = f"{uuid.uuid4().hex}.parquet"
+            pq.write_table(
+                _batch_to_table(sub),
+                os.path.join(pdir, fname),
+                compression="zstd",
+                row_group_size=64 * 1024,
+            )
+            self.manifest.setdefault(name, []).append(
+                {"file": fname, "count": len(sub)}
+            )
+        self._save_metadata()
+
+    # -- read --------------------------------------------------------------
+
+    def partitions(self) -> List[str]:
+        return sorted(self.manifest)
+
+    def prune_partitions(self, bbox: BBox, interval: Interval) -> List[str]:
+        pruned = self.scheme.prune(bbox, interval)
+        if pruned is None:
+            return self.partitions()
+        out = []
+        for name in self.manifest:
+            for p in pruned:
+                if name == p or name.startswith(p + "/") or p == "":
+                    out.append(name)
+                    break
+        return sorted(out)
+
+    def _pushdown_expr(self, bbox: BBox, interval: Interval):
+        """Build a pyarrow filter expression from the covering bounds —
+        the FilterConverter analog (row-group statistics do the pruning)."""
+        g = self.sft.default_geometry
+        d = self.sft.default_dtg
+        expr = None
+
+        def AND(a, b):
+            return b if a is None else (a if b is None else a & b)
+
+        if g is not None and not bbox.is_whole_world:
+            if g.type == "Point":
+                e = (
+                    (pc.field(f"{g.name}__x") >= bbox.xmin)
+                    & (pc.field(f"{g.name}__x") <= bbox.xmax)
+                    & (pc.field(f"{g.name}__y") >= bbox.ymin)
+                    & (pc.field(f"{g.name}__y") <= bbox.ymax)
+                )
+            else:
+                e = (
+                    (pc.field(f"{g.name}__xmin") <= bbox.xmax)
+                    & (pc.field(f"{g.name}__xmax") >= bbox.xmin)
+                    & (pc.field(f"{g.name}__ymin") <= bbox.ymax)
+                    & (pc.field(f"{g.name}__ymax") >= bbox.ymin)
+                )
+            expr = AND(expr, e)
+        if d is not None and not interval.is_unbounded:
+            if interval.start is not None:
+                expr = AND(expr, pc.field(d.name) >= int(interval.start))
+            if interval.end is not None:
+                expr = AND(expr, pc.field(d.name) <= int(interval.end))
+        return expr
+
+    def scan(
+        self,
+        bbox: Optional[BBox] = None,
+        interval: Optional[Interval] = None,
+        columns: Optional[Sequence[str]] = None,
+    ) -> Iterator[FeatureBatch]:
+        """Yield batches from pruned partitions with parquet pushdown.
+
+        The result is a *covering* superset: exact predicate evaluation is
+        the engine's job (residual mask), same as the reference's split.
+        """
+        bbox = bbox if bbox is not None else BBox(-180.0, -90.0, 180.0, 90.0)
+        interval = interval if interval is not None else Interval(None, None)
+        expr = self._pushdown_expr(bbox, interval)
+        phys_cols = None
+        if columns is not None:
+            phys_cols = []
+            for c in columns:
+                a = self.sft.attribute(c)
+                if a.is_geometry and a.type == "Point":
+                    phys_cols += [f"{c}__x", f"{c}__y"]
+                elif a.is_geometry:
+                    phys_cols += [c, f"{c}__xmin", f"{c}__ymin", f"{c}__xmax", f"{c}__ymax"]
+                else:
+                    phys_cols.append(c)
+        for name in self.prune_partitions(bbox, interval):
+            for entry in self.manifest.get(name, []):
+                path = os.path.join(self.root, name, entry["file"])
+                cols = phys_cols
+                if phys_cols is not None:
+                    # include fids only when the file actually has them
+                    schema_names = pq.read_schema(path).names
+                    cols = phys_cols + ([FID] if FID in schema_names else [])
+                t = pq.read_table(path, filters=expr, columns=cols)
+                if len(t):
+                    yield _table_to_batch(t, self.sft)
+
+    def read_all(self) -> Optional[FeatureBatch]:
+        batches = list(self.scan())
+        return FeatureBatch.concat(batches) if batches else None

@@ -1,0 +1,274 @@
+"""Kernel suite tests: kNN recall parity, density grid equality, stats,
+tube-select — single-device and sharded over the 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from geomesa_tpu.engine.bin import bin_pack, decode_bin, encode_bin
+from geomesa_tpu.engine.density import density_grid, density_sharded, gaussian_blur
+from geomesa_tpu.engine.geodesy import haversine_m, haversine_m_np
+from geomesa_tpu.engine.knn import knn, knn_ring, knn_sharded
+from geomesa_tpu.engine.stats import (
+    masked_count,
+    masked_histogram,
+    masked_minmax,
+    masked_moments,
+    masked_value_counts,
+    stats_sharded,
+    z3_histogram,
+)
+from geomesa_tpu.engine.tube import tube_select, tube_select_sharded
+from geomesa_tpu.parallel import default_mesh
+
+rng = np.random.default_rng(11)
+
+
+def recall_at_k(got_idx, got_d, oracle_d, k, tol=1.0):
+    """Tie-tolerant recall: a returned neighbor counts if its true distance
+    is within `tol` meters of the oracle's k-th distance."""
+    hits = 0
+    for q in range(got_idx.shape[0]):
+        kth = oracle_d[q][k - 1]
+        hits += int(np.sum(got_d[q] <= kth + tol))
+    return hits / (got_idx.shape[0] * k)
+
+
+class TestHaversine:
+    def test_matches_numpy(self):
+        lon1, lat1 = rng.uniform(-180, 180, 100), rng.uniform(-89, 89, 100)
+        lon2, lat2 = rng.uniform(-180, 180, 100), rng.uniform(-89, 89, 100)
+        d_jax = np.asarray(haversine_m(lon1, lat1, lon2, lat2))
+        d_np = haversine_m_np(lon1, lat1, lon2, lat2)
+        np.testing.assert_allclose(d_jax, d_np, rtol=1e-6)
+
+    def test_known_distance(self):
+        # London -> Paris ~ 343 km great circle
+        d = float(haversine_m(-0.1276, 51.5072, 2.3522, 48.8566))
+        assert 330_000 < d < 350_000
+
+
+class TestKNN:
+    def setup_method(self):
+        self.n, self.q, self.k = 5000, 64, 10
+        self.dx = rng.uniform(-10, 10, self.n)
+        self.dy = rng.uniform(40, 60, self.n)
+        self.qx = rng.uniform(-10, 10, self.q)
+        self.qy = rng.uniform(40, 60, self.q)
+        self.mask = np.ones(self.n, bool)
+        # oracle: full f64 distance sort
+        d = haversine_m_np(
+            self.qx[:, None], self.qy[:, None], self.dx[None, :], self.dy[None, :]
+        )
+        self.oracle_d = np.sort(d, axis=1)
+
+    def test_exact_recall_single_device(self):
+        dists, idx = knn(
+            jnp.asarray(self.qx), jnp.asarray(self.qy),
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(self.mask), k=self.k, query_tile=16,
+        )
+        true_d = haversine_m_np(
+            self.qx[:, None], self.qy[:, None],
+            self.dx[np.asarray(idx)], self.dy[np.asarray(idx)],
+        )
+        r = recall_at_k(np.asarray(idx), true_d, self.oracle_d, self.k)
+        assert r == 1.0
+
+    def test_masked_points_excluded(self):
+        mask = self.mask.copy()
+        mask[:2500] = False
+        dists, idx = knn(
+            jnp.asarray(self.qx), jnp.asarray(self.qy),
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(mask), k=self.k,
+        )
+        assert np.asarray(idx).min() >= 2500
+
+    def test_sharded_matches_single(self):
+        mesh = default_mesh()
+        args = (
+            jnp.asarray(self.qx), jnp.asarray(self.qy),
+            jnp.asarray(self.dx[:4096]), jnp.asarray(self.dy[:4096]),
+            jnp.asarray(self.mask[:4096]),
+        )
+        d1, i1 = knn(*args, k=self.k)
+        d2, i2 = knn_sharded(mesh, *args, k=self.k)
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5)
+
+    def test_ring_matches_single(self):
+        mesh = default_mesh()
+        qn = 64  # queries shard over 8 devices
+        args_q = (jnp.asarray(self.qx[:qn]), jnp.asarray(self.qy[:qn]))
+        args_d = (
+            jnp.asarray(self.dx[:4096]), jnp.asarray(self.dy[:4096]),
+            jnp.asarray(self.mask[:4096]),
+        )
+        d1, i1 = knn(*args_q, *args_d, k=self.k)
+        d2, i2 = knn_ring(mesh, *args_q, *args_d, k=self.k, query_tile=8)
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5)
+        # indices agree wherever distances aren't ties
+        agree = np.asarray(i1) == np.asarray(i2)
+        ties = np.isclose(np.asarray(d1), np.roll(np.asarray(d1), 1, axis=1))
+        assert (agree | ties).mean() > 0.99
+
+
+class TestDensity:
+    def test_grid_equals_numpy(self):
+        n = 10_000
+        x = rng.uniform(-74.1, -73.9, n)
+        y = rng.uniform(40.6, 40.9, n)
+        w = rng.uniform(0, 2, n).astype(np.float32)
+        mask = rng.random(n) < 0.7
+        bbox = (-74.1, 40.6, -73.9, 40.9)
+        W = H = 64
+        got = np.asarray(
+            density_grid(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w),
+                         jnp.asarray(mask), bbox, W, H)
+        )
+        # numpy oracle
+        col = np.clip(((x - bbox[0]) / ((bbox[2] - bbox[0]) / W)).astype(int), 0, W - 1)
+        row = np.clip(((y - bbox[1]) / ((bbox[3] - bbox[1]) / H)).astype(int), 0, H - 1)
+        exp = np.zeros((H, W), np.float64)
+        np.add.at(exp, (row[mask], col[mask]), w[mask])
+        np.testing.assert_allclose(got, exp, rtol=1e-5)
+        assert got.sum() == pytest.approx(w[mask].sum(), rel=1e-5)
+
+    def test_outside_points_dropped(self):
+        x = np.array([0.0, 200.0])  # second is out of any lon range
+        y = np.array([0.0, 0.0])
+        g = np.asarray(
+            density_grid(jnp.asarray(x), jnp.asarray(y), jnp.ones(2),
+                         jnp.ones(2, bool), (-1.0, -1.0, 1.0, 1.0), 8, 8)
+        )
+        assert g.sum() == 1.0
+
+    def test_sharded_equals_single(self):
+        mesh = default_mesh()
+        n = 8 * 512
+        x = rng.uniform(-74.1, -73.9, n)
+        y = rng.uniform(40.6, 40.9, n)
+        w = np.ones(n, np.float32)
+        mask = np.ones(n, bool)
+        bbox = (-74.1, 40.6, -73.9, 40.9)
+        g1 = density_grid(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w),
+                          jnp.asarray(mask), bbox, 32, 32)
+        g2 = density_sharded(mesh, jnp.asarray(x), jnp.asarray(y),
+                             jnp.asarray(w), jnp.asarray(mask), bbox, 32, 32)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5)
+
+    def test_blur_preserves_mass(self):
+        g = jnp.zeros((32, 32)).at[16, 16].set(100.0)
+        b = np.asarray(gaussian_blur(g, 4))
+        assert b.sum() == pytest.approx(100.0, rel=1e-3)
+        assert b[16, 16] < 100.0
+
+
+class TestStats:
+    def test_basics(self):
+        v = rng.uniform(-100, 100, 1000)
+        mask = rng.random(1000) < 0.5
+        assert int(masked_count(jnp.asarray(mask))) == mask.sum()
+        mn, mx = masked_minmax(jnp.asarray(v), jnp.asarray(mask))
+        assert float(mn) == pytest.approx(v[mask].min())
+        assert float(mx) == pytest.approx(v[mask].max())
+        c, s, ss = masked_moments(jnp.asarray(v), jnp.asarray(mask))
+        assert float(s) == pytest.approx(v[mask].sum())
+        assert float(ss) == pytest.approx((v[mask] ** 2).sum())
+
+    def test_histogram(self):
+        v = rng.uniform(0, 10, 1000)
+        h = np.asarray(masked_histogram(jnp.asarray(v), jnp.ones(1000, bool), 0.0, 10.0, 20))
+        exp, _ = np.histogram(v, bins=20, range=(0, 10))
+        np.testing.assert_array_equal(h, exp)
+
+    def test_value_counts(self):
+        codes = rng.integers(-1, 5, 1000).astype(np.int32)
+        mask = np.ones(1000, bool)
+        counts = np.asarray(masked_value_counts(jnp.asarray(codes), jnp.asarray(mask), 5))
+        for c in range(5):
+            assert counts[c] == (codes == c).sum()
+        assert counts.sum() == (codes >= 0).sum()
+
+    def test_z3_histogram_total(self):
+        n = 500
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+        tb = rng.integers(0, 4, n).astype(np.int32)
+        h = np.asarray(z3_histogram(jnp.asarray(x), jnp.asarray(y), jnp.asarray(tb),
+                                    jnp.ones(n, bool), 4, bins_per_dim=8))
+        assert h.shape == (4, 8, 8)
+        assert h.sum() == n
+
+    def test_sharded_moments(self):
+        mesh = default_mesh()
+        n = 8 * 256
+        v = rng.uniform(0, 1, n)
+        mask = np.ones(n, bool)
+        c, s, ss = stats_sharded(
+            mesh, lambda v, m: masked_moments(v, m), jnp.asarray(v), jnp.asarray(mask)
+        )
+        assert int(c) == n
+        assert float(s) == pytest.approx(v.sum())
+
+
+class TestTube:
+    def test_matches_numpy(self):
+        n, T = 2000, 37
+        x = rng.uniform(-10, 10, n)
+        y = rng.uniform(50, 60, n)
+        t = rng.integers(0, 1_000_000_000, n)
+        tx = rng.uniform(-10, 10, T)
+        ty = rng.uniform(50, 60, T)
+        tt = rng.integers(0, 1_000_000_000, T)
+        r, w = 50_000.0, 50_000_000
+        got = np.asarray(tube_select(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(t), jnp.ones(n, bool),
+            jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(tt), r, w, tube_tile=16,
+        ))
+        d = haversine_m_np(x[:, None], y[:, None], tx[None, :], ty[None, :])
+        dt = np.abs(t[:, None] - tt[None, :])
+        exp = ((d <= r) & (dt <= w)).any(axis=1)
+        np.testing.assert_array_equal(got, exp)
+
+    def test_sharded_matches_single(self):
+        mesh = default_mesh()
+        n, T = 8 * 256, 5
+        x = rng.uniform(-10, 10, n)
+        y = rng.uniform(50, 60, n)
+        t = rng.integers(0, 10_000, n)
+        tx = rng.uniform(-10, 10, T)
+        ty = rng.uniform(50, 60, T)
+        tt = rng.integers(0, 10_000, T)
+        args = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(t), jnp.ones(n, bool),
+                jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(tt), 100_000.0, 5_000)
+        m1 = np.asarray(tube_select(*args))
+        m2 = np.asarray(tube_select_sharded(mesh, *args))
+        np.testing.assert_array_equal(m1, m2)
+
+
+class TestBin:
+    def test_roundtrip(self):
+        n = 100
+        track = rng.integers(0, 50, n).astype(np.int32)
+        dtg = rng.integers(1_500_000_000_000, 1_600_000_000_000, n)
+        lat = rng.uniform(-90, 90, n).astype(np.float32)
+        lon = rng.uniform(-180, 180, n).astype(np.float32)
+        packed = bin_pack(jnp.asarray(track), jnp.asarray(dtg),
+                          jnp.asarray(lat), jnp.asarray(lon))
+        buf = encode_bin(packed)
+        assert len(buf) == n * 16
+        rec = decode_bin(buf)
+        np.testing.assert_array_equal(rec["track"], track)
+        np.testing.assert_array_equal(rec["dtg_s"], dtg // 1000)
+        np.testing.assert_allclose(rec["lat"], lat)
+        np.testing.assert_allclose(rec["lon"], lon)
+
+    def test_selection(self):
+        packed = bin_pack(jnp.arange(10, dtype=jnp.int32), jnp.zeros(10, jnp.int64),
+                          jnp.zeros(10), jnp.zeros(10))
+        sel = np.array([1, 3, 5])
+        rec = decode_bin(encode_bin(packed, sel))
+        np.testing.assert_array_equal(rec["track"], [1, 3, 5])

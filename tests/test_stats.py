@@ -1,0 +1,145 @@
+"""Stat sketch tests: merge laws, accuracy, DSL parsing, serialization."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.stats import (
+    Cardinality,
+    DescriptiveStats,
+    EnumerationStat,
+    Frequency,
+    Histogram,
+    MinMax,
+    Stat,
+    TopK,
+    Z3HistogramStat,
+    parse_stats,
+)
+
+rng = np.random.default_rng(5)
+
+
+class TestMergeLaws:
+    """merge(a, b) must equal observing the union — the property the
+    cross-shard reduction tree relies on."""
+
+    def test_minmax(self):
+        v = rng.uniform(-100, 100, 1000)
+        a, b, c = MinMax("x"), MinMax("x"), MinMax("x")
+        a.observe(v[:500])
+        b.observe(v[500:])
+        c.observe(v)
+        assert a.merge(b).result() == c.result()
+
+    def test_descriptive(self):
+        v = rng.uniform(-10, 10, 1000)
+        a, b, c = DescriptiveStats("x"), DescriptiveStats("x"), DescriptiveStats("x")
+        a.observe(v[:300])
+        b.observe(v[300:])
+        c.observe(v)
+        got, exp = a.merge(b).result(), c.result()
+        assert got["count"] == exp["count"]
+        assert got["mean"] == pytest.approx(exp["mean"])
+        assert got["variance"] == pytest.approx(exp["variance"])
+        assert exp["mean"] == pytest.approx(v.mean())
+        assert exp["variance"] == pytest.approx(v.var(ddof=1), rel=1e-6)
+
+    def test_histogram(self):
+        v = rng.uniform(0, 100, 2000)
+        a, b, c = (Histogram("x", 10, 0, 100) for _ in range(3))
+        a.observe(v[:1000]); b.observe(v[1000:]); c.observe(v)
+        np.testing.assert_array_equal(a.merge(b).result(), c.result())
+
+    def test_topk_and_enumeration(self):
+        v = rng.choice(["a", "b", "c", "d"], 1000, p=[0.5, 0.3, 0.15, 0.05])
+        a, b, c = TopK("x", 2), TopK("x", 2), TopK("x", 2)
+        a.observe(v[:500]); b.observe(v[500:]); c.observe(v)
+        assert a.merge(b).result() == c.result()
+        assert c.result()[0][0] == "a"
+        e = EnumerationStat("x")
+        e.observe(v)
+        assert sum(e.result().values()) == 1000
+
+    def test_cardinality_merge_and_accuracy(self):
+        vals = np.array([f"v{i}" for i in range(20_000)])
+        a, b = Cardinality("x"), Cardinality("x")
+        a.observe(vals[:10_000]); b.observe(vals[5_000:])  # overlapping
+        est = a.merge(b).result()
+        assert est == pytest.approx(20_000, rel=0.05)
+
+    def test_frequency(self):
+        v = np.array(["x"] * 700 + ["y"] * 200 + ["z"] * 100)
+        a, b = Frequency("a"), Frequency("a")
+        a.observe(v[:500]); b.observe(v[500:])
+        a.merge(b)
+        assert a.count("x") >= 700  # CM sketch overestimates only
+        assert a.count("x") <= 1000
+        assert a.count("zzz") <= 5
+
+    def test_frequency_observe_counts(self):
+        f = Frequency("a")
+        f.observe_counts(["p", "q"], np.array([10, 3]))
+        assert f.count("p") >= 10
+
+
+class TestZ3Histogram:
+    def test_observe_and_estimate(self):
+        z = Z3HistogramStat("geom", "dtg", "week", 16)
+        grid = np.zeros((16, 16), np.int64)
+        grid[8, 8] = 100  # center cell: lon ~ 11.25, lat ~ 5.6
+        z.observe_grid(2600, grid)
+        assert z.estimate(-180, -90, 180, 90, [2600]) == 100
+        assert z.estimate(0, 0, 22, 11, [2600]) == 100
+        assert z.estimate(-90, -45, -60, -30, [2600]) == 0
+        assert z.estimate(0, 0, 22, 11, [2601]) == 0
+
+    def test_merge(self):
+        a, b = Z3HistogramStat("g", "d"), Z3HistogramStat("g", "d")
+        g = np.ones((16, 16), np.int64)
+        a.observe_grid(1, g)
+        b.observe_grid(1, g)
+        b.observe_grid(2, g)
+        a.merge(b)
+        assert a.estimate(-180, -90, 180, 90, [1]) == 512
+        assert a.estimate(-180, -90, 180, 90, [2]) == 256
+
+
+class TestDSL:
+    def test_parse(self):
+        seq = parse_stats(
+            "MinMax(dtg);Frequency(name);TopK(actor,5);"
+            "Histogram(score,20,-10,10);Cardinality(id);DescriptiveStats(score)"
+        )
+        kinds = [s.kind for s in seq.stats]
+        assert kinds == ["minmax", "frequency", "topk", "histogram",
+                         "cardinality", "descriptive"]
+        assert seq.stats[2].k == 5
+
+    def test_parse_errors(self):
+        with pytest.raises(ValueError):
+            parse_stats("Bogus(x)")
+        with pytest.raises(ValueError):
+            parse_stats("Histogram(x)")
+
+
+class TestSerialization:
+    def test_roundtrip(self):
+        v = rng.uniform(0, 10, 100)
+        stats = [
+            MinMax("a"), Histogram("a", 5, 0, 10), DescriptiveStats("a"),
+        ]
+        for s in stats:
+            s.observe(v)
+        t = TopK("s", 3)
+        t.observe(np.array(["x", "y", "x"]))
+        stats.append(t)
+        c = Cardinality("s")
+        c.observe(np.array(["p", "q"]))
+        stats.append(c)
+        for s in stats:
+            s2 = Stat.from_json(s.to_json())
+            r1, r2 = s.result(), s2.result()
+            if isinstance(r1, np.ndarray):
+                np.testing.assert_array_equal(r1, r2)
+            else:
+                assert r1 == r2

@@ -1,0 +1,197 @@
+"""Filesystem storage tests: partition schemes, pruning, parquet round-trips,
+pushdown covering guarantees."""
+
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.cql.extract import BBox, Interval
+from geomesa_tpu.store import (
+    AttributeScheme,
+    CompositeScheme,
+    DateTimeScheme,
+    FileSystemStorage,
+    XZ2Scheme,
+    Z2Scheme,
+    scheme_from_config,
+)
+
+SPEC = "name:String,score:Double,dtg:Date,*geom:Point"
+T0 = int(np.datetime64("2020-06-01T00:00:00", "ms").astype(np.int64))
+DAY = 86400_000
+
+rng = np.random.default_rng(9)
+
+
+def make_batch(n=1000, days=10, seed=0):
+    r = np.random.default_rng(seed)
+    sft = SimpleFeatureType.from_spec("t", SPEC)
+    return FeatureBatch.from_pydict(
+        sft,
+        {
+            "name": r.choice(["a", "b", "c"], n).tolist(),
+            "score": r.uniform(0, 1, n),
+            "dtg": r.integers(T0, T0 + days * DAY, n),
+            "geom": np.stack([r.uniform(-60, 60, n), r.uniform(-45, 45, n)], 1),
+        },
+        fids=[f"f{i}" for i in range(n)],
+    )
+
+
+class TestSchemes:
+    def test_datetime_partitions(self):
+        b = make_batch(100, days=3)
+        s = DateTimeScheme("yyyy/MM/dd")
+        parts = s.partitions_for(b)
+        assert all(p.startswith("2020/06/") for p in parts)
+        assert len(set(parts)) <= 4
+
+    def test_datetime_prune(self):
+        s = DateTimeScheme("yyyy/MM/dd")
+        pruned = s.prune(BBox(-180, -90, 180, 90), Interval(T0, T0 + 2 * DAY))
+        assert pruned == {"2020/06/01", "2020/06/02", "2020/06/03"}
+        assert s.prune(BBox(-180, -90, 180, 90), Interval(None, None)) is None
+
+    def test_z2_prune_covers(self):
+        b = make_batch(200)
+        s = Z2Scheme(bits=3)
+        parts = np.asarray(s.partitions_for(b))
+        bb = BBox(-30, -30, 30, 30)
+        pruned = s.prune(bb, Interval(None, None))
+        inbox = (
+            (b.geometry.x >= -30) & (b.geometry.x <= 30)
+            & (b.geometry.y >= -30) & (b.geometry.y <= 30)
+        )
+        for p in parts[inbox]:
+            assert p in pruned
+
+    def test_xz2_prune_covers(self):
+        sft = SimpleFeatureType.from_spec("p", "name:String,*geom:Polygon")
+        wkts, r = [], np.random.default_rng(2)
+        for _ in range(50):
+            cx, cy = r.uniform(-50, 50, 2)
+            w = r.uniform(0.1, 5)
+            wkts.append(f"POLYGON (({cx-w} {cy-w}, {cx+w} {cy-w}, {cx+w} {cy+w}, {cx-w} {cy+w}, {cx-w} {cy-w}))")
+        b = FeatureBatch.from_pydict(sft, {"name": ["x"] * 50, "geom": wkts})
+        s = XZ2Scheme(g=3)
+        parts = np.asarray(s.partitions_for(b))
+        bb = BBox(-20, -20, 20, 20)
+        pruned = s.prune(bb, Interval(None, None))
+        overlaps = (
+            (b.geometry.bbox[:, 0] <= 20) & (b.geometry.bbox[:, 2] >= -20)
+            & (b.geometry.bbox[:, 1] <= 20) & (b.geometry.bbox[:, 3] >= -20)
+        )
+        for p in parts[overlaps]:
+            assert p in pruned
+
+    def test_composite(self):
+        b = make_batch(100, days=2)
+        s = CompositeScheme([DateTimeScheme("yyyy/MM/dd"), Z2Scheme(bits=2)])
+        parts = s.partitions_for(b)
+        assert all("/z2/" in p for p in parts)
+        pruned = s.prune(BBox(-10, -10, 10, 10), Interval(T0, T0 + DAY))
+        assert pruned and all(p.startswith("2020/06/0") for p in pruned)
+
+    def test_config_roundtrip(self):
+        for s in [
+            DateTimeScheme("yyyy/MM"),
+            Z2Scheme(5, "geom"),
+            XZ2Scheme(3),
+            AttributeScheme("name"),
+            CompositeScheme([DateTimeScheme(), Z2Scheme()]),
+        ]:
+            s2 = scheme_from_config(s.to_config())
+            assert s2.to_config() == s.to_config()
+
+
+class TestFileSystemStorage:
+    def test_write_read_roundtrip(self, tmp_path):
+        b = make_batch(500, days=5)
+        store = FileSystemStorage.create(
+            str(tmp_path / "s"), b.sft, DateTimeScheme("yyyy/MM/dd")
+        )
+        store.write(b)
+        assert store.count == 500
+        back = store.read_all()
+        assert len(back) == 500
+        # round-trip preserves values (order may shuffle across partitions)
+        assert sorted(back.fids.decode()) == sorted(b.fids.decode())
+        got = {f: s for f, s in zip(back.fids.decode(), back.column("score"))}
+        exp = {f: s for f, s in zip(b.fids.decode(), b.column("score"))}
+        for k in exp:
+            assert got[k] == pytest.approx(exp[k])
+
+    def test_load_existing(self, tmp_path):
+        b = make_batch(100)
+        root = str(tmp_path / "s")
+        store = FileSystemStorage.create(root, b.sft, DateTimeScheme())
+        store.write(b)
+        store2 = FileSystemStorage.load(root)
+        assert store2.count == 100
+        assert store2.sft.to_spec() == b.sft.to_spec()
+        assert len(store2.read_all()) == 100
+
+    def test_create_twice_fails(self, tmp_path):
+        b = make_batch(10)
+        root = str(tmp_path / "s")
+        FileSystemStorage.create(root, b.sft, DateTimeScheme())
+        with pytest.raises(FileExistsError):
+            FileSystemStorage.create(root, b.sft, DateTimeScheme())
+
+    def test_scan_covering(self, tmp_path):
+        """Every feature matching bounds must come back (covering), and the
+        scan must not read partitions outside the pruned set."""
+        b = make_batch(2000, days=10)
+        store = FileSystemStorage.create(
+            str(tmp_path / "s"), b.sft,
+            CompositeScheme([DateTimeScheme("yyyy/MM/dd"), Z2Scheme(bits=2)]),
+        )
+        store.write(b)
+        bb = BBox(-20, -20, 20, 20)
+        iv = Interval(T0 + 2 * DAY, T0 + 5 * DAY)
+        got = [f for batch in store.scan(bb, iv) for f in batch.fids.decode()]
+        x, y, t = b.geometry.x, b.geometry.y, np.asarray(b.dtg)
+        match = (
+            (x >= bb.xmin) & (x <= bb.xmax) & (y >= bb.ymin) & (y <= bb.ymax)
+            & (t >= iv.start) & (t <= iv.end)
+        )
+        expected = set(np.asarray(b.fids.decode(), dtype=object)[match])
+        assert expected <= set(got)
+        # pruning actually prunes
+        assert len(store.prune_partitions(bb, iv)) < len(store.partitions())
+
+    def test_scan_projection(self, tmp_path):
+        b = make_batch(100)
+        store = FileSystemStorage.create(str(tmp_path / "s"), b.sft, DateTimeScheme())
+        store.write(b)
+        out = list(store.scan(columns=["name", "geom"]))
+        assert out and set(out[0].columns) == {"name", "geom"}
+
+    def test_append(self, tmp_path):
+        b1, b2 = make_batch(100, seed=1), make_batch(150, seed=2)
+        store = FileSystemStorage.create(str(tmp_path / "s"), b1.sft, DateTimeScheme())
+        store.write(b1)
+        store.write(b2)
+        assert store.count == 250
+        assert len(store.read_all()) == 250
+
+    def test_polygon_store(self, tmp_path):
+        sft = SimpleFeatureType.from_spec("p", "name:String,*geom:Polygon")
+        b = FeatureBatch.from_pydict(
+            sft,
+            {
+                "name": ["a", "b"],
+                "geom": [
+                    "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))",
+                    "POLYGON ((50 50, 54 50, 54 54, 50 54, 50 50))",
+                ],
+            },
+        )
+        store = FileSystemStorage.create(str(tmp_path / "s"), sft, XZ2Scheme(g=2))
+        store.write(b)
+        got = list(store.scan(BBox(-1, -1, 5, 5), Interval(None, None)))
+        names = [n for batch in got for n in batch.column("name").decode()]
+        assert "a" in names and "b" not in names  # pushdown pruned the far one
