@@ -1,0 +1,162 @@
+"""Benchmark: GDELT-style BBOX+time filter + kNN, TPU vs honest CPU baseline.
+
+The north-star shape from BASELINE.json: post-index-scan predicate filtering
+plus kNN analytics, measured as points/sec/chip. The CPU baseline is the
+vectorized NumPy equivalent of the geomesa-fs Parquet scan path's compute
+(config 1-style): full-width f64 mask + argpartition kNN — the strongest
+simple CPU implementation we can field locally (see BASELINE.md build
+obligation: measure, don't assert).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Usage: python bench.py [--smoke] [--n N] [--queries Q]
+  --smoke: small sizes + force CPU (for CI; vs_baseline still computed)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _cpu_baseline(x, y, t, speed, qx, qy, k, bbox, t0, t1, repeats=3):
+    """Vectorized NumPy: mask + argpartition kNN (per query, masked)."""
+    from geomesa_tpu.engine.geodesy import haversine_m_np
+
+    def run():
+        mask = (
+            (x >= bbox[0]) & (x <= bbox[2]) & (y >= bbox[1]) & (y <= bbox[3])
+            & (t > t0) & (t < t1) & (speed > 5.0)
+        )
+        cx, cy = x[mask], y[mask]
+        out = np.empty((len(qx), k))
+        for i in range(len(qx)):
+            d = haversine_m_np(qx[i], qy[i], cx, cy)
+            if len(d) >= k:
+                idx = np.argpartition(d, k - 1)[:k]
+                out[i] = np.sort(d[idx])
+            else:
+                out[i, : len(d)] = np.sort(d)
+                out[i, len(d):] = np.inf
+        return int(mask.sum()), out
+
+    run()  # warm caches
+    best = np.inf
+    for _ in range(repeats):
+        s = time.perf_counter()
+        count, dists = run()
+        best = min(best, time.perf_counter() - s)
+    return best, count, dists
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--n", type=int, default=None)
+    p.add_argument("--queries", type=int, default=None)
+    p.add_argument("--k", type=int, default=10)
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        import os
+
+        os.environ.setdefault("XLA_FLAGS", "")
+        import jax
+        from jax._src import xla_bridge as xb
+
+        for name in ("axon", "tpu"):
+            xb._backend_factories.pop(name, None)
+        jax.config.update("jax_platforms", "cpu")
+
+    n = args.n or (1 << 17 if args.smoke else 1 << 22)
+    q = args.queries or (32 if args.smoke else 256)
+    k = args.k
+
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.engine.knn import knn
+
+    rng = np.random.default_rng(42)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    t = rng.integers(1_590_000_000_000, 1_600_000_000_000, n)
+    speed = rng.uniform(0, 30, n)
+    qx = rng.uniform(-30, 30, q)
+    qy = rng.uniform(30, 60, q)
+    BBOX = (-60.0, 20.0, 60.0, 70.0)
+    T0, T1 = 1_592_000_000_000, 1_598_000_000_000
+
+    # --- device pipeline (one fused jit: mask + kNN) ----------------------
+    @jax.jit
+    def device_step(x, y, t, speed, qx, qy):
+        mask = (
+            (x >= BBOX[0]) & (x <= BBOX[2]) & (y >= BBOX[1]) & (y <= BBOX[3])
+            & (t > T0) & (t < T1) & (speed > 5.0)
+        )
+        dists, idx = knn(qx, qy, x, y, mask, k=k, query_tile=q)
+        return jnp.sum(mask.astype(jnp.int32)), dists
+
+    dx = jnp.asarray(x, jnp.float32)
+    dy = jnp.asarray(y, jnp.float32)
+    dt = jnp.asarray(t, jnp.int64)
+    dspeed = jnp.asarray(speed, jnp.float32)
+    dqx = jnp.asarray(qx, jnp.float32)
+    dqy = jnp.asarray(qy, jnp.float32)
+
+    count, dists = device_step(dx, dy, dt, dspeed, dqx, dqy)
+    count.block_until_ready()  # compile + warm
+    best = np.inf
+    for _ in range(5 if not args.smoke else 2):
+        s = time.perf_counter()
+        count, dists = device_step(dx, dy, dt, dspeed, dqx, dqy)
+        jax.block_until_ready((count, dists))
+        best = min(best, time.perf_counter() - s)
+    tpu_pps = n / best
+
+    # --- CPU baseline ------------------------------------------------------
+    cpu_time, cpu_count, cpu_dists = _cpu_baseline(
+        x, y, t, speed, qx, qy, k, BBOX, T0, T1,
+        repeats=1 if args.smoke else 3,
+    )
+    cpu_pps = n / cpu_time
+
+    # --- recall parity gate ------------------------------------------------
+    got = np.sort(np.asarray(dists), axis=1)
+    exp = np.sort(cpu_dists, axis=1)
+    finite = np.isfinite(exp)
+    recall_ok = bool(
+        np.all(np.abs(got[finite] - exp[finite]) <= np.maximum(1.0, 1e-4 * exp[finite]))
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "gdelt_bbox_time_knn_points_per_sec_per_chip",
+                "value": round(tpu_pps, 1),
+                "unit": "points/sec",
+                "vs_baseline": round(tpu_pps / cpu_pps, 3),
+                "detail": {
+                    "n": n,
+                    "queries": q,
+                    "k": k,
+                    "device": jax.devices()[0].platform,
+                    "device_time_s": round(best, 5),
+                    "cpu_time_s": round(cpu_time, 5),
+                    "cpu_points_per_sec": round(cpu_pps, 1),
+                    "match_count": int(count),
+                    "cpu_match_count": cpu_count,
+                    "recall_parity": recall_ok,
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

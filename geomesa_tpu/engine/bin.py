@@ -26,36 +26,45 @@ def bin_pack(
     dtg_ms: jax.Array,  # int64 epoch millis
     lat: jax.Array,
     lon: jax.Array,
+    label: Optional[jax.Array] = None,  # int lane -> 24-byte labeled records
 ) -> jax.Array:
-    """[N,4] int32: (track, dtg_s, lat bits, lon bits)."""
-    return jnp.stack(
-        [
-            track_code.astype(jnp.int32),
-            (dtg_ms // 1000).astype(jnp.int32),
-            jax.lax.bitcast_convert_type(lat.astype(jnp.float32), jnp.int32),
-            jax.lax.bitcast_convert_type(lon.astype(jnp.float32), jnp.int32),
-        ],
-        axis=1,
-    )
+    """[N,4] int32 (16B records) or [N,6] with a label (24B: label as two
+    little-endian int32 lanes, low word first)."""
+    lanes = [
+        track_code.astype(jnp.int32),
+        (dtg_ms // 1000).astype(jnp.int32),
+        jax.lax.bitcast_convert_type(lat.astype(jnp.float32), jnp.int32),
+        jax.lax.bitcast_convert_type(lon.astype(jnp.float32), jnp.int32),
+    ]
+    if label is not None:
+        l64 = label.astype(jnp.int64)
+        lanes.append((l64 & 0xFFFFFFFF).astype(jnp.int32))
+        lanes.append((l64 >> 32).astype(jnp.int32))
+    return jnp.stack(lanes, axis=1)
 
 
 def encode_bin(packed: jax.Array, select: Optional[np.ndarray] = None) -> bytes:
-    """Host-side: [N,4] int32 -> 16-byte-per-record little-endian buffer."""
+    """Host-side: [N,4|6] int32 -> 16/24-byte-per-record LE buffer."""
     arr = np.asarray(packed, dtype="<i4")
     if select is not None:
         arr = arr[select]
     return arr.tobytes()
 
 
-def decode_bin(buf: bytes) -> np.ndarray:
-    """bytes -> structured array (track:int32, dtg_s:int32, lat:f32, lon:f32)."""
-    raw = np.frombuffer(buf, dtype="<i4").reshape(-1, 4)
-    out = np.empty(
-        len(raw),
-        dtype=[("track", "<i4"), ("dtg_s", "<i4"), ("lat", "<f4"), ("lon", "<f4")],
-    )
+def decode_bin(buf: bytes, labeled: bool = False) -> np.ndarray:
+    """bytes -> structured array (track, dtg_s, lat, lon[, label])."""
+    lanes = 6 if labeled else 4
+    raw = np.frombuffer(buf, dtype="<i4").reshape(-1, lanes)
+    fields = [("track", "<i4"), ("dtg_s", "<i4"), ("lat", "<f4"), ("lon", "<f4")]
+    if labeled:
+        fields.append(("label", "<i8"))
+    out = np.empty(len(raw), dtype=fields)
     out["track"] = raw[:, 0]
     out["dtg_s"] = raw[:, 1]
     out["lat"] = raw[:, 2].view("<f4")
     out["lon"] = raw[:, 3].view("<f4")
+    if labeled:
+        out["label"] = (
+            raw[:, 4].astype(np.int64) & 0xFFFFFFFF
+        ) | (raw[:, 5].astype(np.int64) << 32)
     return out

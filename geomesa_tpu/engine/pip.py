@@ -26,18 +26,20 @@ from geomesa_tpu.core.wkt import Geometry
 
 
 def polygon_edges(geom: Geometry) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Host-side: all ring edges of a polygon/multipolygon as (x1,y1,x2,y2).
+    """Host-side: all ring edges of a geometry as (x1,y1,x2,y2).
 
-    Closing edges are added if rings aren't explicitly closed. Even-odd
-    counting over the concatenated edge table handles holes and multi-parts
-    without any per-ring bookkeeping.
+    Rings of polygon kinds are closed if not explicitly closed; line kinds
+    keep open paths (a closing edge would fabricate a phantom segment).
+    Even-odd counting over the concatenated edge table handles holes and
+    multi-parts without any per-ring bookkeeping.
     """
+    close = "Polygon" in geom.kind or geom.kind in ("Geometry", "GeometryCollection")
     x1s, y1s, x2s, y2s = [], [], [], []
     for ring in geom.rings:
         r = np.asarray(ring, np.float64)
         if len(r) < 2:
             continue
-        if not np.array_equal(r[0], r[-1]):
+        if close and not np.array_equal(r[0], r[-1]):
             r = np.concatenate([r, r[:1]], axis=0)
         x1s.append(r[:-1, 0])
         y1s.append(r[:-1, 1])

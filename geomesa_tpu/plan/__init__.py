@@ -1,0 +1,21 @@
+"""Query planning and execution.
+
+Parity: geomesa-index-api planning (QueryPlanner, QueryRunner, QueryHints,
+Explainer, audit) [upstream, unverified]. The planner keeps the reference's
+architecture — normalize filter, extract primary bounds, prune, push down,
+residual-evaluate, post-process — with the executor swapped from
+iterator-RPC fan-in to device kernels (SURVEY.md §7 "keep the planner,
+replace the executor").
+"""
+
+from geomesa_tpu.plan.hints import QueryHints
+from geomesa_tpu.plan.query import Query
+from geomesa_tpu.plan.planner import QueryPlanner, QueryPlan, QueryResult
+from geomesa_tpu.plan.datastore import DataStore, FeatureSource
+from geomesa_tpu.plan.explain import Explainer
+from geomesa_tpu.plan.audit import AuditWriter, QueryEvent
+
+__all__ = [
+    "Query", "QueryHints", "QueryPlanner", "QueryPlan", "QueryResult",
+    "DataStore", "FeatureSource", "Explainer", "AuditWriter", "QueryEvent",
+]

@@ -1,0 +1,47 @@
+"""Query auditing.
+
+Parity: geomesa-index-api audit (AuditWriter / QueryEvent persisted to a
+*_queries table) [upstream, unverified]: one structured record per query with
+filter, hints, planning/scan timings and hit counts — here a JSONL file (or
+in-memory list) with per-phase wall timings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class QueryEvent:
+    type_name: str
+    filter: str
+    hints: str
+    plan_time_ms: float
+    scan_time_ms: float
+    compute_time_ms: float
+    result_count: int
+    partitions_scanned: int
+    partitions_total: int
+    user: str = ""
+    timestamp: float = 0.0
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class AuditWriter:
+    """Collects QueryEvents; optionally appends JSONL to a path."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.events: List[QueryEvent] = []
+
+    def write(self, event: QueryEvent) -> None:
+        event.timestamp = time.time()
+        self.events.append(event)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(event.to_json()) + "\n")

@@ -1,0 +1,129 @@
+"""DataStore / FeatureSource: the GeoTools-shaped entry API.
+
+Parity: GeoMesaDataStore + the GeoTools DataStore/FeatureSource SPI surface
+(geomesa-index-api GeoMesaDataStore.scala) [upstream, unverified], as a
+Python API with the same call shape (SURVEY.md §7 design stance):
+
+    ds = DataStore(catalog_dir)
+    ds.create_schema(sft, scheme)
+    fs = ds.get_feature_source("gdelt")
+    result = fs.get_features(Query("gdelt", "BBOX(geom,...) AND ..."))
+    fs.write(batch)
+
+A catalog is a directory; each schema is a FileSystemStorage subdirectory.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Dict, List, Optional
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.plan.audit import AuditWriter
+from geomesa_tpu.plan.explain import Explainer
+from geomesa_tpu.plan.planner import QueryPlanner, QueryResult
+from geomesa_tpu.plan.query import Query
+from geomesa_tpu.store.fs import METADATA, FileSystemStorage
+from geomesa_tpu.store.partition import DateTimeScheme, PartitionScheme
+
+
+class FeatureSource:
+    def __init__(self, storage: FileSystemStorage, planner: QueryPlanner):
+        self.storage = storage
+        self.planner = planner
+
+    @property
+    def sft(self) -> SimpleFeatureType:
+        return self.storage.sft
+
+    def get_features(self, query: "Query | str" = "INCLUDE") -> QueryResult:
+        if isinstance(query, str):
+            query = Query(self.sft.name, query)
+        return self.planner.execute(query)
+
+    def get_count(self, query: "Query | str" = "INCLUDE") -> int:
+        if isinstance(query, str):
+            query = Query(self.sft.name, query)
+        return self.planner.count(query)
+
+    def write(self, batch: FeatureBatch) -> None:
+        self.storage.write(batch)
+
+    def explain(self, query: "Query | str") -> str:
+        if isinstance(query, str):
+            query = Query(self.sft.name, query)
+        e = Explainer()
+        self.planner.plan(query, e)
+        return e.render()
+
+
+class DataStore:
+    """A catalog of feature types over a directory."""
+
+    def __init__(
+        self,
+        catalog: str,
+        audit: Optional[AuditWriter] = None,
+        mesh=None,
+    ):
+        self.catalog = catalog
+        self.audit = audit if audit is not None else AuditWriter()
+        self.mesh = mesh
+        os.makedirs(catalog, exist_ok=True)
+        self._sources: Dict[str, FeatureSource] = {}
+
+    def get_type_names(self) -> List[str]:
+        out = []
+        for name in sorted(os.listdir(self.catalog)):
+            if os.path.exists(os.path.join(self.catalog, name, METADATA)):
+                out.append(name)
+        return out
+
+    def create_schema(
+        self,
+        sft: SimpleFeatureType,
+        scheme: Optional[PartitionScheme] = None,
+    ) -> FeatureSource:
+        if scheme is None:
+            scheme = (
+                DateTimeScheme(dtg_attr=sft.default_dtg.name)
+                if sft.default_dtg is not None
+                else _default_spatial_scheme(sft)
+            )
+        storage = FileSystemStorage.create(
+            os.path.join(self.catalog, sft.name), sft, scheme
+        )
+        src = FeatureSource(storage, QueryPlanner(storage, self.audit, self.mesh))
+        self._sources[sft.name] = src
+        return src
+
+    def get_feature_source(self, name: str) -> FeatureSource:
+        if name not in self._sources:
+            storage = FileSystemStorage.load(os.path.join(self.catalog, name))
+            self._sources[name] = FeatureSource(
+                storage, QueryPlanner(storage, self.audit, self.mesh)
+            )
+        return self._sources[name]
+
+    def get_schema(self, name: str) -> SimpleFeatureType:
+        return self.get_feature_source(name).sft
+
+    def remove_schema(self, name: str) -> None:
+        self._sources.pop(name, None)
+        path = os.path.join(self.catalog, name)
+        if not os.path.exists(os.path.join(path, METADATA)):
+            raise FileNotFoundError(f"no schema {name!r} in catalog")
+        shutil.rmtree(path)
+
+
+def _default_spatial_scheme(sft: SimpleFeatureType) -> PartitionScheme:
+    from geomesa_tpu.store.partition import XZ2Scheme, Z2Scheme
+
+    g = sft.default_geometry
+    if g is not None and g.type == "Point":
+        return Z2Scheme(bits=2, geom_attr=g.name)
+    if g is not None:
+        return XZ2Scheme(g=2, geom_attr=g.name)
+    raise ValueError("schema has neither dtg nor geometry; supply a scheme")

@@ -1,0 +1,54 @@
+"""Per-query hints.
+
+Parity: geomesa-index-api QueryHints [upstream, unverified] — the same hint
+vocabulary (DENSITY_*, BIN_*, STATS_STRING, SAMPLING, LOOSE_BBOX,
+EXACT_COUNT, QUERY_INDEX) as a typed dataclass. A hint changes *what the
+scan computes* (aggregation push-down), not *which features match*.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class QueryHints:
+    # density aggregation (DensityScan): result is a weight grid
+    density_bbox: Optional[Tuple[float, float, float, float]] = None
+    density_width: Optional[int] = None
+    density_height: Optional[int] = None
+    density_weight: Optional[str] = None  # numeric attribute name
+
+    # bin aggregation (BinAggregatingScan): compact dot-map records
+    bin_track: Optional[str] = None  # attribute used as track id
+    bin_label: Optional[str] = None
+
+    # stats aggregation (StatsScan): Stat DSL expression
+    stats_string: Optional[str] = None
+
+    # sampling: keep roughly 1-in-n (None = off); optional per-attribute
+    sampling: Optional[int] = None
+    sample_by: Optional[str] = None
+
+    # loose bbox: skip the residual exact predicate, accept the covering
+    # index result (upstream: LOOSE_BBOX / the XZ "non-strict" mode)
+    loose_bbox: bool = False
+
+    # exact count: force full evaluation for counts instead of estimates
+    exact_count: bool = True
+
+    # index override (upstream: QUERY_INDEX)
+    query_index: Optional[str] = None
+
+    @property
+    def is_density(self) -> bool:
+        return self.density_bbox is not None
+
+    @property
+    def is_stats(self) -> bool:
+        return self.stats_string is not None
+
+    @property
+    def is_bin(self) -> bool:
+        return self.bin_track is not None

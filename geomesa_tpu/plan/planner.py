@@ -1,0 +1,461 @@
+"""The query planner and executor.
+
+Parity: geomesa-index-api QueryPlanner / QueryRunner / LocalQueryRunner
+[upstream, unverified], restructured for the TPU executor (SURVEY.md §3.1):
+
+  1. normalize filter (parse), merge hints
+  2. extract primary bounds (bbox + interval) — FilterHelper semantics
+  3. prune partitions (the index-range analog) via the store's scheme
+  4. scan pruned partitions with parquet row-group pushdown (covering)
+  5. device residual evaluation: compiled predicate mask (the Z3Iterator +
+     FilterTransformIterator analog, fused into one XLA program)
+  6. aggregation push-down per hints (density / stats / bin) on device
+  7. local post-processing: sort, max-features, projection (LocalQueryRunner)
+
+Every phase is timed into the audit record; `explain` narrates the plan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from geomesa_tpu.core.columnar import DictColumn, FeatureBatch
+from geomesa_tpu.cql import ast, compile_filter, extract_bbox, extract_intervals
+from geomesa_tpu.cql.compile import CompiledFilter
+from geomesa_tpu.cql.extract import BBox, Interval
+from geomesa_tpu.curve.binned_time import TimePeriod, to_binned_time
+from geomesa_tpu.plan.audit import AuditWriter, QueryEvent
+from geomesa_tpu.plan.explain import Explainer
+from geomesa_tpu.plan.hints import QueryHints
+from geomesa_tpu.plan.query import Query
+from geomesa_tpu.store.fs import FileSystemStorage
+
+
+@dataclasses.dataclass
+class QueryPlan:
+    query: Query
+    filter: ast.Filter
+    bbox: BBox
+    interval: Interval
+    partitions: List[str]
+    total_partitions: int
+    compiled: Optional[CompiledFilter]
+
+
+@dataclasses.dataclass
+class QueryResult:
+    kind: str  # features | density | stats | bin | count
+    features: Optional[FeatureBatch] = None
+    grid: Optional[np.ndarray] = None
+    stats: object = None
+    bin_bytes: Optional[bytes] = None
+    count: int = 0
+
+
+class QueryPlanner:
+    def __init__(
+        self,
+        storage: FileSystemStorage,
+        audit: Optional[AuditWriter] = None,
+        mesh=None,
+        coord_dtype=None,
+    ):
+        self.storage = storage
+        self.audit = audit
+        self.mesh = mesh
+        if coord_dtype is None:
+            import jax.numpy as jnp
+
+            coord_dtype = jnp.float32
+        self.coord_dtype = coord_dtype
+
+    # -- planning ----------------------------------------------------------
+
+    def plan(self, query: Query, explain: Optional[Explainer] = None) -> QueryPlan:
+        e = explain or Explainer()
+        sft = self.storage.sft
+        f = query.filter_ast
+        e.push(f"Planning '{query.type_name}' {ast.to_cql(f)}")
+        g = sft.default_geometry
+        d = sft.default_dtg
+        bbox = extract_bbox(f, g.name) if g else BBox(-180, -90, 180, 90)
+        interval = extract_intervals(f, d.name) if d else Interval(None, None)
+        e(f"Primary bbox: ({bbox.xmin}, {bbox.ymin}, {bbox.xmax}, {bbox.ymax})")
+        e(f"Primary interval: [{interval.start}, {interval.end}]")
+        partitions = self.storage.prune_partitions(bbox, interval)
+        total = len(self.storage.partitions())
+        e(f"Partitions: {len(partitions)} of {total} after pruning")
+        if query.hints.query_index:
+            e(f"Index override requested: {query.hints.query_index!r} "
+              "(single-strategy partition store; recorded only)")
+        residual = f
+        if query.hints.loose_bbox and g is not None:
+            residual = _loosen_bbox(residual, g.name)
+            e("Loose bbox: default-geometry BBOX predicates dropped from residual")
+        compiled = None
+        if not isinstance(residual, ast.Include):
+            compiled = compile_filter(residual, sft)
+            e(f"Residual predicate: compiled mask over "
+              f"{len(compiled.builders)} param table(s)")
+        else:
+            e("Residual predicate: none (INCLUDE)")
+        if query.hints.is_density:
+            e(f"Aggregation: density {query.hints.density_width}x"
+              f"{query.hints.density_height} over {query.hints.density_bbox}")
+        elif query.hints.is_stats:
+            e(f"Aggregation: stats {query.hints.stats_string!r}")
+        elif query.hints.is_bin:
+            e(f"Aggregation: bin track={query.hints.bin_track}")
+        e.pop()
+        return QueryPlan(query, f, bbox, interval, partitions, total, compiled)
+
+    # -- execution ---------------------------------------------------------
+
+    def execute(self, query: Query, explain: Optional[Explainer] = None) -> QueryResult:
+        import jax.numpy as jnp
+
+        from geomesa_tpu.engine.device import to_device
+
+        t0 = time.perf_counter()
+        plan = self.plan(query, explain)
+        t_plan = time.perf_counter()
+
+        batches = list(
+            self.storage.scan(
+                plan.bbox,
+                plan.interval,
+                columns=_needed_columns(query, plan, self.storage.sft),
+            )
+        )
+        t_scan = time.perf_counter()
+
+        hints = query.hints
+        result: QueryResult
+        if not batches:
+            result = self._empty_result(hints)
+            mask_count = 0
+        else:
+            batch = FeatureBatch.concat(batches)
+            # pow2 padding stabilizes jit cache shapes across scans
+            padded = batch.pad_to(_next_pow2(len(batch)))
+            dev = to_device(padded, coord_dtype=self.coord_dtype)
+            if plan.compiled is not None:
+                mask = np.asarray(plan.compiled.mask(dev, padded))
+            else:
+                mask = np.asarray(dev["__valid__"])
+            if hints.sampling:
+                groups = None
+                if hints.sample_by:
+                    col = padded.columns[hints.sample_by]
+                    groups = (
+                        np.asarray(col.codes)
+                        if isinstance(col, DictColumn)
+                        else np.asarray(col)
+                    )
+                mask = _sample_mask(mask, hints.sampling, groups)
+            mask_count = int(mask.sum())
+            result = self._aggregate(padded, dev, mask, query)
+        t_done = time.perf_counter()
+
+        if self.audit is not None:
+            self.audit.write(
+                QueryEvent(
+                    type_name=query.type_name,
+                    filter=ast.to_cql(plan.filter),
+                    hints=str(hints),
+                    plan_time_ms=(t_plan - t0) * 1000,
+                    scan_time_ms=(t_scan - t_plan) * 1000,
+                    compute_time_ms=(t_done - t_scan) * 1000,
+                    result_count=mask_count,
+                    partitions_scanned=len(plan.partitions),
+                    partitions_total=plan.total_partitions,
+                )
+            )
+        return result
+
+    def count(self, query: Query) -> int:
+        """EXACT_COUNT path; with exact_count=False and INCLUDE, serve the
+        manifest count (the stats-estimate analog)."""
+        if (
+            not query.hints.exact_count
+            and isinstance(query.filter_ast, ast.Include)
+        ):
+            return self.storage.count
+        r = self.execute(query)
+        if r.kind == "features":
+            return len(r.features) if r.features is not None else 0
+        return r.count
+
+    # -- internals ---------------------------------------------------------
+
+    def _empty_result(self, hints: QueryHints) -> QueryResult:
+        if hints.is_density:
+            import numpy as np
+
+            return QueryResult(
+                "density",
+                grid=np.zeros((hints.density_height, hints.density_width), np.float32),
+            )
+        if hints.is_stats:
+            from geomesa_tpu.stats import parse_stats
+
+            return QueryResult("stats", stats=parse_stats(hints.stats_string))
+        if hints.is_bin:
+            return QueryResult("bin", bin_bytes=b"")
+        return QueryResult("features", features=None, count=0)
+
+    def _aggregate(self, batch, dev, mask: np.ndarray, query: Query) -> QueryResult:
+        import jax.numpy as jnp
+
+        hints = query.hints
+        sft = self.storage.sft
+        g = sft.default_geometry
+
+        if hints.is_density:
+            from geomesa_tpu.engine.density import density_grid
+
+            w = (
+                dev[hints.density_weight].astype(jnp.float32)
+                if hints.density_weight
+                else jnp.ones(len(batch), jnp.float32)
+            )
+            grid = density_grid(
+                dev[f"{g.name}__x"],
+                dev[f"{g.name}__y"],
+                w,
+                jnp.asarray(mask),
+                tuple(hints.density_bbox),
+                hints.density_width,
+                hints.density_height,
+            )
+            return QueryResult("density", grid=np.asarray(grid), count=int(mask.sum()))
+
+        if hints.is_stats:
+            stats = self._run_stats(batch, dev, mask, hints.stats_string)
+            return QueryResult("stats", stats=stats, count=int(mask.sum()))
+
+        if hints.is_bin:
+            from geomesa_tpu.engine.bin import bin_pack, encode_bin
+
+            def track_codes(name):
+                col = batch.columns[name]
+                return (
+                    jnp.asarray(col.codes)
+                    if isinstance(col, DictColumn)
+                    else jnp.asarray(np.asarray(col), jnp.int32)
+                )
+
+            d = sft.default_dtg
+            dtg = dev[d.name] if d else jnp.zeros(len(batch), jnp.int64)
+            label = track_codes(hints.bin_label) if hints.bin_label else None
+            packed = bin_pack(
+                track_codes(hints.bin_track),
+                dtg,
+                dev[f"{g.name}__y"],
+                dev[f"{g.name}__x"],
+                label=label,
+            )
+            return QueryResult(
+                "bin",
+                bin_bytes=encode_bin(packed, np.nonzero(mask)[0]),
+                count=int(mask.sum()),
+            )
+
+        # plain feature results
+        sel = batch.select(np.nonzero(mask)[0])
+        if query.sort_by:
+            order = _sort_order(sel, query.sort_by)
+            sel = sel.select(order)
+        if query.max_features is not None and len(sel) > query.max_features:
+            sel = sel.select(np.arange(query.max_features))
+        if query.attributes is not None:
+            sel = _project(sel, query.attributes)
+        return QueryResult("features", features=sel, count=len(sel))
+
+    def _run_stats(self, batch, dev, mask: np.ndarray, expression: str):
+        import jax.numpy as jnp
+
+        from geomesa_tpu.engine import stats as est
+        from geomesa_tpu.stats import parse_stats
+        from geomesa_tpu.stats.sketches import (
+            Cardinality,
+            DescriptiveStats,
+            EnumerationStat,
+            Frequency,
+            Histogram,
+            MinMax,
+            TopK,
+            Z3HistogramStat,
+        )
+
+        seq = parse_stats(expression)
+        jmask = jnp.asarray(mask)
+        for s in seq.stats:
+            if isinstance(s, Z3HistogramStat):
+                col = batch.columns[s.dtg]
+                bins, _ = to_binned_time(np.asarray(col), TimePeriod.parse(s.period))
+                ub = np.unique(bins)
+                # one kernel call over contiguous remapped bin indices
+                remap = {int(b): i for i, b in enumerate(ub)}
+                tb = np.vectorize(remap.__getitem__, otypes=[np.int32])(bins)
+                grids = est.z3_histogram(
+                    dev[f"{s.geom}__x"], dev[f"{s.geom}__y"],
+                    jnp.asarray(tb), jmask, len(ub), s.bins_per_dim,
+                )
+                grids = np.asarray(grids)
+                for i, b in enumerate(ub):
+                    s.observe_grid(int(b), grids[i])
+                continue
+            col = batch.columns.get(s.attribute) if s.attribute else None
+            if isinstance(s, (TopK, EnumerationStat, Frequency)) and isinstance(col, DictColumn):
+                counts = np.asarray(
+                    est.masked_value_counts(
+                        jnp.asarray(col.codes), jmask, max(len(col.vocab), 1)
+                    )
+                )
+                s.observe_counts(col.vocab, counts[: len(col.vocab)])
+            elif isinstance(s, MinMax) and col is not None and not isinstance(col, DictColumn):
+                if mask.any():
+                    mn, mx = est.masked_minmax(jnp.asarray(col), jmask)
+                    s.observe(np.array([float(mn), float(mx)]))
+            elif isinstance(s, Histogram) and col is not None:
+                h = est.masked_histogram(jnp.asarray(col), jmask, s.lo, s.hi, s.bins)
+                s.observe_counts(np.asarray(h))
+            elif isinstance(s, DescriptiveStats):
+                if s.attribute and col is not None and not isinstance(col, DictColumn):
+                    c, sm, ssq = est.masked_moments(jnp.asarray(col), jmask)
+                    s.observe_moments(int(c), float(sm), float(ssq))
+                else:  # Count()
+                    s.observe_moments(int(mask.sum()), 0.0, 0.0)
+            elif isinstance(s, Cardinality) and isinstance(col, DictColumn):
+                # distinct codes present under the mask (exact for dict cols)
+                counts = np.asarray(
+                    est.masked_value_counts(
+                        jnp.asarray(col.codes), jmask, max(len(col.vocab), 1)
+                    )
+                )
+                present = [v for v, c in zip(col.vocab, counts) if c > 0]
+                s.observe(np.asarray(present, dtype=object))
+            else:  # host fallback (e.g. MinMax over strings)
+                if isinstance(col, DictColumn):
+                    vals = np.asarray(col.decode(), dtype=object)
+                    sel = vals[mask]
+                    s.observe(sel[sel != None])  # noqa: E711
+                elif col is not None:
+                    s.observe(np.asarray(col), mask)
+        return seq
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _loosen_bbox(f: ast.Filter, geom_name: str) -> ast.Filter:
+    """LOOSE_BBOX semantics: drop default-geometry BBOX predicates from the
+    residual — the covering index/pushdown result is accepted as-is for the
+    spatial primary (attribute/temporal predicates stay exact)."""
+    if isinstance(f, ast.SpatialPredicate) and f.op == "BBOX" and f.prop.name == geom_name:
+        return ast.Include()
+    if isinstance(f, ast.And):
+        kids = tuple(_loosen_bbox(c, geom_name) for c in f.children)
+        kids = tuple(c for c in kids if not isinstance(c, ast.Include))
+        if not kids:
+            return ast.Include()
+        return kids[0] if len(kids) == 1 else ast.And(kids)
+    # do not descend through OR/NOT: dropping a disjunct would change results
+    return f
+
+
+def _needed_columns(query: Query, plan: QueryPlan, sft):
+    """Physical column projection for the scan: filter-referenced attributes
+    + hint attributes + requested projection (None = all, for full feature
+    results)."""
+    hints = query.hints
+    g = sft.default_geometry
+    d = sft.default_dtg
+    needed = set()
+    for node in ast.walk(plan.filter):
+        for field in ("prop", "left", "right"):
+            v = getattr(node, field, None)
+            if isinstance(v, ast.Property):
+                needed.add(v.name)
+    if hints.sample_by:
+        needed.add(hints.sample_by)
+    if hints.is_density:
+        needed.add(g.name)
+        if hints.density_weight:
+            needed.add(hints.density_weight)
+    elif hints.is_bin:
+        needed.add(g.name)
+        needed.add(hints.bin_track)
+        if hints.bin_label:
+            needed.add(hints.bin_label)
+        if d is not None:
+            needed.add(d.name)
+    elif hints.is_stats:
+        from geomesa_tpu.stats import parse_stats
+        from geomesa_tpu.stats.sketches import Z3HistogramStat
+
+        for s in parse_stats(hints.stats_string).stats:
+            if isinstance(s, Z3HistogramStat):
+                needed.add(s.geom)
+                needed.add(s.dtg)
+            elif s.attribute:
+                needed.add(s.attribute)
+    elif query.attributes is None:
+        return None  # full feature results: all columns
+    else:
+        needed.update(query.attributes)
+        for attr, _ in query.sort_by or []:
+            needed.add(attr)
+    return sorted(needed)
+
+
+def _sample_mask(
+    mask: np.ndarray, n: int, groups: Optional[np.ndarray] = None
+) -> np.ndarray:
+    """Keep every n-th matching feature; with `groups`, every n-th within
+    each group (SAMPLE_BY semantics: per-track thinning)."""
+    out = np.zeros_like(mask)
+    if groups is None:
+        idx = np.nonzero(mask)[0]
+        out[idx[::n]] = True
+        return out
+    for gval in np.unique(groups[mask]):
+        idx = np.nonzero(mask & (groups == gval))[0]
+        out[idx[::n]] = True
+    return out
+
+
+def _sort_order(batch: FeatureBatch, sort_by) -> np.ndarray:
+    keys = []
+    for attr, ascending in reversed(list(sort_by)):
+        col = batch.columns[attr]
+        v = (
+            np.asarray(col.codes)
+            if isinstance(col, DictColumn)
+            else np.asarray(col)
+        )
+        if isinstance(col, DictColumn):
+            # order codes by value text for a true lexicographic sort
+            rank = np.argsort(np.argsort(np.asarray(col.vocab, dtype=object)))
+            v = np.where(v >= 0, rank[np.clip(v, 0, None)], -1)
+        keys.append(v if ascending else -v)
+    order = np.lexsort(keys) if keys else np.arange(len(batch))
+    return order
+
+
+def _project(batch: FeatureBatch, attributes) -> FeatureBatch:
+    from geomesa_tpu.core.sft import SimpleFeatureType
+
+    attrs = [batch.sft.attribute(a) for a in attributes]
+    sft = SimpleFeatureType(batch.sft.name, attrs, batch.sft.user_data)
+    cols = {a.name: batch.columns[a.name] for a in attrs}
+    return FeatureBatch(sft, cols, batch.fids, batch.valid)

@@ -1,0 +1,29 @@
+"""The Query object.
+
+Parity: the GeoTools Query as used by GeoMesa (filter + projection + sort +
+max features + hints) [upstream, unverified].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple, Union
+
+from geomesa_tpu.cql import ast, parse_cql
+from geomesa_tpu.plan.hints import QueryHints
+
+
+@dataclasses.dataclass
+class Query:
+    type_name: str
+    filter: Union[str, ast.Filter] = "INCLUDE"
+    attributes: Optional[Sequence[str]] = None  # projection; None = all
+    sort_by: Optional[Sequence[Tuple[str, bool]]] = None  # (attr, ascending)
+    max_features: Optional[int] = None
+    hints: QueryHints = dataclasses.field(default_factory=QueryHints)
+
+    @property
+    def filter_ast(self) -> ast.Filter:
+        if isinstance(self.filter, str):
+            return parse_cql(self.filter)
+        return self.filter

@@ -1,0 +1,50 @@
+"""Analytics process library.
+
+Parity: geomesa-process (geomesa-process-vector) [upstream, unverified] —
+the WPS-exposed VectorProcess implementations, mirrored by name:
+
+  KNearestNeighborSearchProcess, DensityProcess, TubeSelectProcess,
+  ProximitySearchProcess, QueryProcess, SamplingProcess, StatsProcess,
+  UniqueProcess, JoinProcess, Point2PointProcess, DateOffsetProcess,
+  HashAttributeProcess, RouteSearchProcess, ArrowConversionProcess,
+  BinConversionProcess
+
+Each is a thin orchestration over plan/ + engine/ (SURVEY.md §7 step 7); the
+registry maps process names to classes for CLI/WPS-style dispatch.
+"""
+
+from geomesa_tpu.process.knn import KNearestNeighborSearchProcess
+from geomesa_tpu.process.density import DensityProcess
+from geomesa_tpu.process.tube import (
+    TubeSelectProcess,
+    NoGapFill,
+    LineGapFill,
+    InterpolatedGapFill,
+)
+from geomesa_tpu.process.misc import (
+    ArrowConversionProcess,
+    BinConversionProcess,
+    DateOffsetProcess,
+    HashAttributeProcess,
+    JoinProcess,
+    Point2PointProcess,
+    ProximitySearchProcess,
+    QueryProcess,
+    RouteSearchProcess,
+    SamplingProcess,
+    StatsProcess,
+    UniqueProcess,
+)
+
+REGISTRY = {
+    c.__name__: c
+    for c in (
+        KNearestNeighborSearchProcess, DensityProcess, TubeSelectProcess,
+        ProximitySearchProcess, QueryProcess, SamplingProcess, StatsProcess,
+        UniqueProcess, JoinProcess, Point2PointProcess, DateOffsetProcess,
+        HashAttributeProcess, RouteSearchProcess, ArrowConversionProcess,
+        BinConversionProcess,
+    )
+}
+
+__all__ = list(REGISTRY) + ["REGISTRY", "NoGapFill", "LineGapFill", "InterpolatedGapFill"]

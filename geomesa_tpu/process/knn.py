@@ -1,0 +1,122 @@
+"""KNearestNeighborSearchProcess.
+
+Parity: geomesa-process knn/KNearestNeighborSearchProcess [upstream,
+unverified]. Same parameters (inputFeatures, dataFeatures, numDesired,
+estimatedDistance, maxSearchDistance); same guarantee (k nearest by geodesic
+distance within maxSearchDistance).
+
+Mechanism redesigned for TPU (SURVEY.md §3.4): instead of per-query-point
+window queries with geometric radius growth, ONE covering window query for
+all query points at the current radius feeds a dense tiled kNN kernel;
+the radius doubles only if some query's k-th neighbor distance exceeds its
+searched radius (the recall-parity condition at window edges), re-using the
+same kernel on the wider candidate set. Worst case log2(max/estimated)
+store scans; each scan is one fused device pass. A materialized FeatureBatch
+input needs no window iteration at all — the kernel is exact over the batch
+in a single pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.cql.extract import BBox
+from geomesa_tpu.plan.datastore import FeatureSource
+from geomesa_tpu.process.util import candidates_for, filter_batch, window_query
+
+
+@dataclasses.dataclass
+class KnnResult:
+    indices: np.ndarray  # [Q, k] into `features`
+    distances_m: np.ndarray  # [Q, k] (inf where fewer than k within range)
+    features: FeatureBatch  # the candidate set the indices refer to
+
+
+class KNearestNeighborSearchProcess:
+    name = "KNearestNeighborSearchProcess"
+
+    def execute(
+        self,
+        input_features: FeatureBatch,
+        data_features: "FeatureSource | FeatureBatch",
+        num_desired: int = 10,
+        estimated_distance_m: float = 10_000.0,
+        max_search_distance_m: float = 1_000_000.0,
+        cql_filter: str = "INCLUDE",
+        query_tile: int = 1024,
+    ) -> KnnResult:
+        qcol = input_features.geometry
+        qx, qy = np.asarray(qcol.x), np.asarray(qcol.y)
+
+        if isinstance(data_features, FeatureBatch):
+            # materialized input: one exact pass, no window growth possible
+            candidates = filter_batch(data_features, cql_filter)
+            return self._solve(
+                qx, qy, candidates, num_desired, max_search_distance_m, query_tile
+            )
+
+        radius = max(float(estimated_distance_m), 1.0)
+        while True:
+            bbox = BBox(
+                float(qx.min()), float(qy.min()), float(qx.max()), float(qy.max())
+            ).buffer_degrees(radius)
+            candidates = window_query(data_features, bbox, cql_filter)
+            if candidates is None or len(candidates) == 0:
+                if radius >= max_search_distance_m:
+                    return self._solve(
+                        qx, qy,
+                        candidates
+                        if candidates is not None
+                        else input_features.select(np.zeros(0, np.int64)),
+                        num_desired, max_search_distance_m, query_tile,
+                    )
+                radius = min(radius * 2, max_search_distance_m)
+                continue
+            result = self._solve(
+                qx, qy, candidates, num_desired, max_search_distance_m, query_tile
+            )
+            # recall condition: every query's k-th neighbor must lie within
+            # the searched radius, else a closer point may sit outside the
+            # window — widen and retry (reference: expand window, re-query)
+            kth = result.distances_m[:, -1]
+            unsafe = (kth > radius) & np.isfinite(kth)
+            short = ~np.isfinite(kth)
+            if (unsafe.any() or short.any()) and radius < max_search_distance_m:
+                radius = min(radius * 2, max_search_distance_m)
+                continue
+            return result
+
+    def _solve(
+        self, qx, qy, candidates: FeatureBatch, k: int, max_dist: float, query_tile: int
+    ) -> KnnResult:
+        if candidates is None or len(candidates) == 0:
+            return KnnResult(
+                np.zeros((len(qx), k), np.int32),
+                np.full((len(qx), k), np.inf),
+                candidates,
+            )
+        import jax.numpy as jnp
+
+        from geomesa_tpu.engine.device import to_device
+        from geomesa_tpu.engine.knn import knn
+
+        dev = to_device(candidates, coord_dtype=jnp.float64)
+        g = candidates.sft.default_geometry
+        dists, idx = knn(
+            jnp.asarray(qx), jnp.asarray(qy),
+            dev[f"{g.name}__x"], dev[f"{g.name}__y"], dev["__valid__"],
+            k=min(k, len(candidates)),
+            query_tile=min(query_tile, max(len(qx), 1)),
+        )
+        dists = np.asarray(dists)
+        idx = np.asarray(idx)
+        if dists.shape[1] < k:
+            pad = k - dists.shape[1]
+            dists = np.pad(dists, ((0, 0), (0, pad)), constant_values=np.inf)
+            idx = np.pad(idx, ((0, 0), (0, pad)))
+        dists = np.where(dists <= max_dist, dists, np.inf)
+        return KnnResult(idx, dists, candidates)

@@ -1,0 +1,132 @@
+"""TubeSelectProcess and tube builders.
+
+Parity: geomesa-process tube/ (TubeSelectProcess, TubeBuilder: NoGapFill,
+LineGapFill, InterpolatedGapFill) [upstream, unverified]. The builders turn
+an input track (points with times) into tube samples host-side; the match
+against the target layer runs as ONE fused device kernel (engine.tube)
+instead of the reference's per-segment store queries (SURVEY.md C17).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.engine.geodesy import haversine_m_np
+from geomesa_tpu.plan.datastore import FeatureSource
+from geomesa_tpu.cql.extract import BBox
+
+
+@dataclasses.dataclass
+class Tube:
+    x: np.ndarray
+    y: np.ndarray
+    t: np.ndarray  # epoch millis
+    radius_m: float
+    half_window_ms: int
+
+
+class TubeBuilder:
+    def build(
+        self, track: FeatureBatch, radius_m: float, half_window_ms: int
+    ) -> Tube:
+        x, y, t = _track_arrays(track)
+        return Tube(*self._samples(x, y, t), radius_m, half_window_ms)
+
+    def _samples(self, x, y, t):
+        raise NotImplementedError
+
+
+class NoGapFill(TubeBuilder):
+    """Buffer each input point with its own time (no interpolation)."""
+
+    def _samples(self, x, y, t):
+        return x, y, t
+
+
+class LineGapFill(TubeBuilder):
+    """Interpolate positions along lines between consecutive points; time
+    takes the segment midpointwise linear interpolation too (upstream
+    LineGapFill interpolates the geometry; sample spacing here is bounded
+    by `max_sample_m`)."""
+
+    def __init__(self, max_sample_m: float = 10_000.0):
+        self.max_sample_m = max_sample_m
+
+    def _samples(self, x, y, t):
+        xs, ys, ts = [x[:1]], [y[:1]], [t[:1]]
+        for i in range(len(x) - 1):
+            d = float(haversine_m_np(x[i], y[i], x[i + 1], y[i + 1]))
+            n = max(1, int(np.ceil(d / self.max_sample_m)))
+            frac = np.linspace(0.0, 1.0, n + 1)[1:]
+            xs.append(x[i] + frac * (x[i + 1] - x[i]))
+            ys.append(y[i] + frac * (y[i + 1] - y[i]))
+            ts.append((t[i] + frac * (t[i + 1] - t[i])).astype(np.int64))
+        return np.concatenate(xs), np.concatenate(ys), np.concatenate(ts)
+
+
+class InterpolatedGapFill(LineGapFill):
+    """Same sampling; kept as a distinct name for parity with the upstream
+    variant (which additionally smooths headings)."""
+
+
+class TubeSelectProcess:
+    name = "TubeSelectProcess"
+
+    def execute(
+        self,
+        tube_features: FeatureBatch,
+        data: "FeatureSource | FeatureBatch",
+        fill: Optional[TubeBuilder] = None,
+        buffer_m: float = 10_000.0,
+        max_time_window_ms: int = 3_600_000,
+        cql_filter: str = "INCLUDE",
+    ) -> FeatureBatch:
+        import jax.numpy as jnp
+
+        from geomesa_tpu.engine.device import to_device
+        from geomesa_tpu.engine.tube import tube_select
+
+        from geomesa_tpu.process.util import candidates_for
+
+        fill = fill or NoGapFill()
+        tube = fill.build(tube_features, buffer_m, max_time_window_ms)
+        bbox = BBox(
+            float(tube.x.min()), float(tube.y.min()),
+            float(tube.x.max()), float(tube.y.max()),
+        ).buffer_degrees(buffer_m)
+        candidates = candidates_for(data, bbox, cql_filter)
+        if candidates is None or len(candidates) == 0:
+            return tube_features.select(np.zeros(0, np.int64))
+
+        dev = to_device(candidates, coord_dtype=jnp.float64)
+        g = candidates.sft.default_geometry
+        d = candidates.sft.default_dtg
+        mask = tube_select(
+            dev[f"{g.name}__x"],
+            dev[f"{g.name}__y"],
+            dev[d.name],
+            dev["__valid__"],
+            jnp.asarray(tube.x),
+            jnp.asarray(tube.y),
+            jnp.asarray(tube.t),
+            tube.radius_m,
+            tube.half_window_ms,
+        )
+        return candidates.select(np.asarray(mask))
+
+
+def _track_arrays(track: FeatureBatch):
+    g = track.geometry
+    d = track.dtg
+    if d is None:
+        raise ValueError("tube features need a date attribute")
+    order = np.argsort(np.asarray(d))
+    return (
+        np.asarray(g.x)[order],
+        np.asarray(g.y)[order],
+        np.asarray(d)[order],
+    )

@@ -1,0 +1,57 @@
+"""Shared process helpers: window queries and batch-side filtering."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.wkt import box
+from geomesa_tpu.cql import ast, parse_cql
+from geomesa_tpu.cql.extract import BBox
+from geomesa_tpu.plan.query import Query
+
+
+def filter_batch(batch: FeatureBatch, cql_filter: str) -> FeatureBatch:
+    """Apply an ECQL filter to an in-memory batch (device mask + select)."""
+    f = parse_cql(cql_filter)
+    if isinstance(f, ast.Include):
+        return batch
+    import jax.numpy as jnp
+
+    from geomesa_tpu.cql import compile_filter
+    from geomesa_tpu.engine.device import to_device
+
+    compiled = compile_filter(f, batch.sft)
+    dev = to_device(batch, coord_dtype=jnp.float64)
+    return batch.select(np.asarray(compiled.mask(dev, batch)))
+
+
+def window_query(
+    source,  # FeatureSource
+    bbox: BBox,
+    cql_filter: str = "INCLUDE",
+) -> Optional[FeatureBatch]:
+    """BBOX-window query ANDed with an optional ECQL filter."""
+    g = source.sft.default_geometry
+    window = ast.SpatialPredicate(
+        "BBOX", ast.Property(g.name), box(bbox.xmin, bbox.ymin, bbox.xmax, bbox.ymax)
+    )
+    base = parse_cql(cql_filter)
+    combined = window if isinstance(base, ast.Include) else ast.And((window, base))
+    return source.get_features(Query(source.sft.name, combined)).features
+
+
+def candidates_for(
+    data,  # FeatureSource | FeatureBatch
+    bbox: BBox,
+    cql_filter: str = "INCLUDE",
+) -> Optional[FeatureBatch]:
+    """Uniform candidate retrieval: window query for sources, filtered
+    passthrough for materialized batches (the cql_filter applies in BOTH
+    paths; the window does not constrain a materialized batch — the kernels
+    are exact regardless)."""
+    if isinstance(data, FeatureBatch):
+        return filter_batch(data, cql_filter)
+    return window_query(data, bbox, cql_filter)

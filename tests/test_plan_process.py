@@ -1,0 +1,344 @@
+"""End-to-end planner + process tests over a real on-disk catalog."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.engine.bin import decode_bin
+from geomesa_tpu.engine.geodesy import haversine_m_np
+from geomesa_tpu.plan import AuditWriter, DataStore, Query, QueryHints
+from geomesa_tpu.process import (
+    DensityProcess,
+    JoinProcess,
+    KNearestNeighborSearchProcess,
+    LineGapFill,
+    Point2PointProcess,
+    ProximitySearchProcess,
+    QueryProcess,
+    SamplingProcess,
+    StatsProcess,
+    TubeSelectProcess,
+    UniqueProcess,
+)
+from geomesa_tpu.store.partition import CompositeScheme, DateTimeScheme, Z2Scheme
+
+import reference_engine as oracle
+from geomesa_tpu.cql import parse_cql
+
+SPEC = "vessel:String,speed:Double,heading:Double,dtg:Date,*geom:Point"
+T0 = int(np.datetime64("2021-03-01T00:00:00", "ms").astype(np.int64))
+DAY = 86400_000
+
+
+def make_batch(n=3000, seed=1):
+    r = np.random.default_rng(seed)
+    sft = SimpleFeatureType.from_spec("ais", SPEC)
+    return FeatureBatch.from_pydict(
+        sft,
+        {
+            "vessel": r.choice(["v1", "v2", "v3", "v4", "v5"], n).tolist(),
+            "speed": r.uniform(0, 30, n),
+            "heading": r.uniform(0, 360, n),
+            "dtg": r.integers(T0, T0 + 7 * DAY, n),
+            "geom": np.stack([r.uniform(-5, 5, n), r.uniform(50, 60, n)], 1),
+        },
+        fids=[f"a{i}" for i in range(n)],
+    )
+
+
+@pytest.fixture(scope="module")
+def catalog(tmp_path_factory):
+    root = tmp_path_factory.mktemp("catalog")
+    audit = AuditWriter()
+    ds = DataStore(str(root), audit=audit)
+    batch = make_batch()
+    src = ds.create_schema(
+        batch.sft, CompositeScheme([DateTimeScheme("yyyy/MM/dd"), Z2Scheme(bits=2)])
+    )
+    src.write(batch)
+    return ds, batch, audit
+
+
+class TestDataStore:
+    def test_type_names_and_schema(self, catalog):
+        ds, batch, _ = catalog
+        assert ds.get_type_names() == ["ais"]
+        assert ds.get_schema("ais").to_spec() == batch.sft.to_spec()
+
+    def test_query_matches_oracle(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        cql = ("BBOX(geom, -2, 52, 3, 58) AND dtg DURING "
+               "2021-03-02T00:00:00Z/2021-03-05T00:00:00Z AND speed > 10")
+        r = src.get_features(Query("ais", cql))
+        exp = oracle.eval_filter(parse_cql(cql), batch)
+        assert r.kind == "features"
+        assert sorted(r.features.fids.decode()) == sorted(
+            np.asarray(batch.fids.decode(), dtype=object)[exp].tolist()
+        )
+
+    def test_count_and_audit(self, catalog):
+        ds, batch, audit = catalog
+        src = ds.get_feature_source("ais")
+        n0 = len(audit.events)
+        assert src.get_count("speed > 10") == int(
+            (np.asarray(batch.column("speed")) > 10).sum()
+        )
+        assert len(audit.events) > n0
+        ev = audit.events[-1]
+        assert ev.partitions_total >= ev.partitions_scanned > 0
+
+    def test_fast_count_include(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        q = Query("ais", "INCLUDE", hints=QueryHints(exact_count=False))
+        assert src.get_count(q) == len(batch)
+
+    def test_projection_sort_limit(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        q = Query(
+            "ais", "speed > 25",
+            attributes=["vessel", "speed", "geom"],
+            sort_by=[("speed", False)],
+            max_features=5,
+        )
+        r = src.get_features(q)
+        assert len(r.features) <= 5
+        s = r.features.column("speed")
+        assert all(s[i] >= s[i + 1] for i in range(len(s) - 1))
+        assert set(r.features.columns) == {"vessel", "speed", "geom"}
+
+    def test_explain(self, catalog):
+        ds, _, _ = catalog
+        src = ds.get_feature_source("ais")
+        text = src.explain("BBOX(geom, -2, 52, 3, 58) AND speed > 10")
+        assert "Partitions:" in text and "Residual predicate" in text
+
+    def test_density_hint(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        bbox = (-5.0, 50.0, 5.0, 60.0)
+        q = Query(
+            "ais", "speed > 10",
+            hints=QueryHints(density_bbox=bbox, density_width=64, density_height=64),
+        )
+        r = src.get_features(q)
+        assert r.kind == "density" and r.grid.shape == (64, 64)
+        exp = (np.asarray(batch.column("speed")) > 10).sum()
+        assert r.grid.sum() == pytest.approx(exp)
+
+    def test_stats_hint(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        q = Query(
+            "ais", "INCLUDE",
+            hints=QueryHints(stats_string="MinMax(speed);TopK(vessel,2);DescriptiveStats(speed)"),
+        )
+        stats = src.get_features(q).stats
+        mn, mx = stats.stats[0].result()
+        sp = np.asarray(batch.column("speed"))
+        assert mn == pytest.approx(sp.min()) and mx == pytest.approx(sp.max())
+        top = stats.stats[1].result()
+        vc = {}
+        for v in batch.column("vessel").decode():
+            vc[v] = vc.get(v, 0) + 1
+        assert top[0][1] == max(vc.values())
+        desc = stats.stats[2].result()
+        assert desc["mean"] == pytest.approx(sp.mean())
+
+    def test_bin_hint(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        q = Query("ais", "speed > 20", hints=QueryHints(bin_track="vessel"))
+        r = src.get_features(q)
+        rec = decode_bin(r.bin_bytes)
+        exp = (np.asarray(batch.column("speed")) > 20).sum()
+        assert len(rec) == exp
+
+    def test_loose_bbox_hint(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        cql = "BBOX(geom, -2, 52, 3, 58) AND speed > 10"
+        strict = src.get_features(Query("ais", cql)).count
+        loose = src.get_features(
+            Query("ais", cql, hints=QueryHints(loose_bbox=True))
+        ).count
+        # loose accepts the covering pushdown: superset of strict
+        assert loose >= strict
+        text = src.explain(Query("ais", cql, hints=QueryHints(loose_bbox=True)))
+        assert "Loose bbox" in text
+
+    def test_sample_by_hint(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        r = src.get_features(
+            Query("ais", "INCLUDE", hints=QueryHints(sampling=5, sample_by="vessel"))
+        )
+        per = {}
+        for v in r.features.column("vessel").decode():
+            per[v] = per.get(v, 0) + 1
+        assert set(per) == {"v1", "v2", "v3", "v4", "v5"}  # every track kept
+
+    def test_bin_label_hint(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        q = Query(
+            "ais", "speed > 25",
+            hints=QueryHints(bin_track="vessel", bin_label="vessel"),
+        )
+        r = src.get_features(q)
+        rec = decode_bin(r.bin_bytes, labeled=True)
+        exp = (np.asarray(batch.column("speed")) > 25).sum()
+        assert len(rec) == exp
+        np.testing.assert_array_equal(rec["label"], rec["track"])
+
+    def test_sampling_hint(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        full = src.get_features(Query("ais", "speed > 10")).count
+        s = src.get_features(
+            Query("ais", "speed > 10", hints=QueryHints(sampling=10))
+        )
+        assert s.count == pytest.approx(full / 10, abs=2)
+
+    def test_remove_schema(self, tmp_path):
+        ds = DataStore(str(tmp_path / "c"))
+        b = make_batch(50)
+        ds.create_schema(b.sft)
+        assert ds.get_type_names() == ["ais"]
+        ds.remove_schema("ais")
+        assert ds.get_type_names() == []
+
+
+class TestProcesses:
+    def test_knn(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        r = np.random.default_rng(7)
+        qsft = SimpleFeatureType.from_spec("q", "name:String,*geom:Point")
+        qx, qy = r.uniform(-4, 4, 8), r.uniform(51, 59, 8)
+        queries = FeatureBatch.from_pydict(
+            qsft, {"name": [f"q{i}" for i in range(8)],
+                   "geom": np.stack([qx, qy], 1)}
+        )
+        res = KNearestNeighborSearchProcess().execute(
+            queries, src, num_desired=5, estimated_distance_m=20_000
+        )
+        # oracle: exact 5-NN over the full dataset
+        d = haversine_m_np(qx[:, None], qy[:, None],
+                           batch.geometry.x[None, :], batch.geometry.y[None, :])
+        exp = np.sort(d, axis=1)[:, :5]
+        np.testing.assert_allclose(res.distances_m, exp, rtol=1e-6)
+
+    def test_knn_respects_max_distance(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        qsft = SimpleFeatureType.from_spec("q", "name:String,*geom:Point")
+        # a far-away query point: nothing within 50km
+        queries = FeatureBatch.from_pydict(
+            qsft, {"name": ["far"], "geom": np.array([[120.0, -40.0]])}
+        )
+        res = KNearestNeighborSearchProcess().execute(
+            queries, src, num_desired=3, estimated_distance_m=10_000,
+            max_search_distance_m=50_000,
+        )
+        assert np.isinf(res.distances_m).all()
+
+    def test_density_process(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        grid = DensityProcess().execute(src, (-5, 50, 5, 60), 32, 32)
+        assert grid.shape == (32, 32)
+        assert grid.sum() == pytest.approx(len(batch))
+        blurred = DensityProcess().execute(
+            src, (-5, 50, 5, 60), 32, 32, radius_pixels=2
+        )
+        # blur spreads mass; only border spill may be lost
+        assert 0.9 * len(batch) <= blurred.sum() <= len(batch) + 1
+
+    def test_tube_select(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        tsft = SimpleFeatureType.from_spec("t", "name:String,dtg:Date,*geom:Point")
+        track = FeatureBatch.from_pydict(
+            tsft,
+            {
+                "name": ["t"] * 3,
+                "dtg": [T0 + DAY, T0 + 2 * DAY, T0 + 3 * DAY],
+                "geom": np.array([[-2.0, 52.0], [0.0, 55.0], [2.0, 58.0]]),
+            },
+        )
+        hits = TubeSelectProcess().execute(
+            track, src, fill=LineGapFill(50_000), buffer_m=50_000,
+            max_time_window_ms=12 * 3600_000,
+        )
+        # every hit must satisfy the tube condition vs some interpolated sample
+        assert len(hits) > 0
+        from geomesa_tpu.process.tube import Tube
+
+        for i in range(min(len(hits), 20)):
+            x, y = hits.geometry.x[i], hits.geometry.y[i]
+            t = int(np.asarray(hits.dtg)[i])
+            d = haversine_m_np(
+                np.array([x]), np.array([y]),
+                np.array([-2.0, 0.0, 2.0]), np.array([52.0, 55.0, 58.0]),
+            )
+            # within 50km+interp of the coarse track: loose sanity check
+            assert d.min() < 500_000
+
+    def test_proximity(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        qsft = SimpleFeatureType.from_spec("q", "name:String,*geom:Point")
+        probe = FeatureBatch.from_pydict(
+            qsft, {"name": ["p"], "geom": np.array([[0.0, 55.0]])}
+        )
+        hits = ProximitySearchProcess().execute(probe, src, 100_000)
+        d = haversine_m_np(batch.geometry.x, batch.geometry.y, 0.0, 55.0)
+        assert len(hits) == (d <= 100_000).sum()
+
+    def test_query_sampling_stats_unique(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        assert len(QueryProcess().execute(src, "speed > 25")) == (
+            np.asarray(batch.column("speed")) > 25
+        ).sum()
+        thin = SamplingProcess().execute(src, 7)
+        assert len(thin) == pytest.approx(len(batch) / 7, abs=2)
+        stats = StatsProcess().execute(src, "Histogram(speed,10,0,30)")
+        assert stats.stats[0].result().sum() == len(batch)
+        uniq = UniqueProcess().execute(src, "vessel")
+        assert {u[0] for u in uniq} == {"v1", "v2", "v3", "v4", "v5"}
+        assert sum(u[1] for u in uniq) == len(batch)
+
+    def test_join(self, catalog):
+        ds, batch, _ = catalog
+        rsft = SimpleFeatureType.from_spec("meta", "vessel:String,flag:String,*geom:Point")
+        right = FeatureBatch.from_pydict(
+            rsft,
+            {
+                "vessel": ["v1", "v2", "v3"],
+                "flag": ["NL", "DE", "FR"],
+                "geom": np.zeros((3, 2)),
+            },
+        )
+        joined = JoinProcess().execute(batch, right, "vessel", "vessel", ["flag"])
+        assert "flag" in joined.sft.attribute_names
+        vs = joined.column("vessel").decode()
+        fl = joined.column("flag").decode()
+        assert all((v, f) in {("v1", "NL"), ("v2", "DE"), ("v3", "FR")} for v, f in zip(vs, fl))
+
+    def test_point2point(self, catalog):
+        ds, batch, _ = catalog
+        tracks = Point2PointProcess().execute(batch, "vessel")
+        assert len(tracks) == 5
+        assert tracks.sft.attribute("geom").type == "LineString"
+        # each vessel's track has as many vertices as its pings
+        counts = {}
+        for v in batch.column("vessel").decode():
+            counts[v] = counts.get(v, 0) + 1
+        for i in range(len(tracks)):
+            name = tracks.column("track").decode()[i]
+            assert len(tracks.geometry.geometry(i).rings[0]) == counts[name]
