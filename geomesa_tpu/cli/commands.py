@@ -1,22 +1,362 @@
-"""CLI subcommand registry.
+"""CLI subcommands.
 
-Commands land here as their subsystems are built; each mirrors a
-geomesa-tools command (create-schema, describe-schema, ingest, export,
-explain, stats-*) [upstream, unverified].
+Parity: geomesa-tools commands [upstream, unverified]: create-schema,
+describe-schema, get-type-names, remove-schema, ingest, export, explain,
+stats-analyze/bounds/count/histogram/top-k, delete-features (via
+remove-schema), env. All commands take --catalog (the catalog directory,
+standing in for the reference's store connection params).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import sys
 
 
 def register(sub: "argparse._SubParsersAction") -> None:
-    version = sub.add_parser("version", help="print version")
-    version.set_defaults(func=_version)
+    def cmd(name, help_, fn, args):
+        p = sub.add_parser(name, help=help_)
+        for flags, kw in args:
+            p.add_argument(*flags, **kw)
+        p.set_defaults(func=fn)
+        return p
+
+    cat = (["--catalog", "-c"], {"required": True, "help": "catalog directory"})
+    feat = (["--feature-name", "-f"], {"required": True, "help": "feature type name"})
+    cql = (["--cql", "-q"], {"default": "INCLUDE", "help": "ECQL filter"})
+
+    cmd("version", "print version", _version, [])
+    cmd(
+        "create-schema", "create a feature type",
+        _create_schema,
+        [cat, feat,
+         (["--spec", "-s"], {"required": True, "help": "SFT spec string"}),
+         (["--partition-scheme"], {"default": None,
+          "help": "JSON scheme config (default: daily datetime)"})],
+    )
+    cmd("get-type-names", "list feature types", _get_type_names, [cat])
+    cmd("describe-schema", "show a feature type", _describe_schema, [cat, feat])
+    cmd("remove-schema", "drop a feature type and its data", _remove_schema, [cat, feat])
+    cmd(
+        "ingest", "ingest files through a converter",
+        _ingest,
+        [cat, feat,
+         (["--converter", "-C"], {"required": True,
+          "help": "converter config JSON file, or a well-known name "
+                  "(gdelt|ais|nyctaxi)"}),
+         (["files"], {"nargs": "+", "help": "input files"})],
+    )
+    cmd(
+        "export", "export features",
+        _export,
+        [cat, feat, cql,
+         (["--output", "-o"], {"default": "-", "help": "output path (- = stdout)"}),
+         (["--format", "-F"], {"default": "csv",
+          "choices": ["csv", "tsv", "json", "arrow", "bin", "wkt"]}),
+         (["--attributes", "-a"], {"default": None, "help": "comma-sep projection"}),
+         (["--max-features", "-m"], {"type": int, "default": None}),
+         (["--bin-track"], {"default": None, "help": "track attr for bin format"})],
+    )
+    cmd("explain", "print the query plan", _explain, [cat, feat, cql])
+    cmd("stats-analyze", "compute and persist stats", _stats_analyze, [cat, feat])
+    cmd("stats-bounds", "attribute bounds", _stats_bounds,
+        [cat, feat, cql, (["--attributes", "-a"], {"default": None})])
+    cmd("stats-count", "feature count", _stats_count,
+        [cat, feat, cql, (["--no-exact"], {"action": "store_true"})])
+    cmd(
+        "stats-histogram", "attribute histogram", _stats_histogram,
+        [cat, feat, cql,
+         (["--attribute", "-a"], {"required": True}),
+         (["--bins"], {"type": int, "default": 10})],
+    )
+    cmd(
+        "stats-top-k", "most frequent values", _stats_topk,
+        [cat, feat, cql,
+         (["--attribute", "-a"], {"required": True}),
+         (["--k"], {"type": int, "default": 10})],
+    )
+    cmd("env", "show system properties", _env, [])
 
 
 def _version(args) -> int:
     import geomesa_tpu
 
     print(geomesa_tpu.__version__)
+    return 0
+
+
+def _store(args):
+    from geomesa_tpu.plan import DataStore
+
+    return DataStore(args.catalog)
+
+
+def _create_schema(args) -> int:
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.store.partition import scheme_from_config
+
+    sft = SimpleFeatureType.from_spec(args.feature_name, args.spec)
+    scheme = (
+        scheme_from_config(json.loads(args.partition_scheme))
+        if args.partition_scheme
+        else None
+    )
+    _store(args).create_schema(sft, scheme)
+    print(f"created schema {args.feature_name}")
+    return 0
+
+
+def _get_type_names(args) -> int:
+    for n in _store(args).get_type_names():
+        print(n)
+    return 0
+
+
+def _describe_schema(args) -> int:
+    sft = _store(args).get_schema(args.feature_name)
+    print(f"{sft.name}:")
+    for a in sft.attributes:
+        marks = []
+        if a.default_geom:
+            marks.append("*default geometry")
+        if a.options:
+            marks.append(",".join(f"{k}={v}" for k, v in a.options.items()))
+        print(f"  {a.name:<24}{a.type:<16}{' '.join(marks)}")
+    if sft.user_data:
+        print("user data:")
+        for k, v in sft.user_data.items():
+            print(f"  {k}={v}")
+    return 0
+
+
+def _remove_schema(args) -> int:
+    _store(args).remove_schema(args.feature_name)
+    print(f"removed schema {args.feature_name}")
+    return 0
+
+
+def _ingest(args) -> int:
+    from geomesa_tpu.convert import converter_from_config, schemas
+
+    ds = _store(args)
+    if args.converter in schemas.WELL_KNOWN:
+        sft, config = schemas.WELL_KNOWN[args.converter]
+        sft = type(sft)(args.feature_name, sft.attributes, sft.user_data)
+    else:
+        with open(args.converter) as f:
+            config = json.load(f)
+        sft = ds.get_schema(args.feature_name)
+    if args.feature_name in ds.get_type_names():
+        src = ds.get_feature_source(args.feature_name)
+    else:
+        src = ds.create_schema(sft)
+    conv = converter_from_config(src.sft, config)
+    total = failed = 0
+    for path in args.files:
+        batch = conv.convert(path)
+        src.write(batch)
+        total += len(batch)
+        failed += conv.failed
+    print(f"ingested {total} features ({failed} failed) into {args.feature_name}")
+    return 0
+
+
+def _export(args) -> int:
+    from geomesa_tpu.plan import Query, QueryHints
+
+    ds = _store(args)
+    src = ds.get_feature_source(args.feature_name)
+    attrs = args.attributes.split(",") if args.attributes else None
+    hints = QueryHints()
+    binary = args.format in ("arrow", "bin")
+    if args.format == "bin":
+        track = args.bin_track or next(
+            (a.name for a in src.sft.attributes if not a.is_geometry), None
+        )
+        if track is None:
+            raise ValueError("bin export needs --bin-track (no non-geometry attribute)")
+        hints = QueryHints(bin_track=track)
+    q = Query(args.feature_name, args.cql, attributes=attrs,
+              max_features=args.max_features, hints=hints)
+    r = src.get_features(q)
+    if args.output == "-":
+        out = sys.stdout.buffer if binary else sys.stdout
+    else:
+        out = open(args.output, "wb" if binary else "w")
+    try:
+        if args.format == "bin":
+            out.write(r.bin_bytes or b"")
+        elif args.format == "arrow":
+            if r.features is None or len(r.features) == 0:
+                print("no features matched; nothing written", file=sys.stderr)
+            else:
+                import io
+
+                import pyarrow as pa
+
+                from geomesa_tpu.core.arrow_io import to_arrow
+
+                rb = to_arrow(r.features)
+                sink = io.BytesIO()
+                with pa.ipc.new_stream(sink, rb.schema) as w:
+                    w.write_batch(rb)
+                out.write(sink.getvalue())
+        else:
+            _write_text(out, r.features, args.format)
+    finally:
+        if args.output != "-":
+            out.close()
+    return 0
+
+
+def _write_text(out, batch, fmt):
+    import csv
+
+    import numpy as np
+
+    from geomesa_tpu.core.columnar import DictColumn, GeometryColumn
+    from geomesa_tpu.core.wkt import to_wkt
+
+    if batch is None or len(batch) == 0:
+        return
+    names = batch.sft.attribute_names
+    geom_attr = batch.sft.default_geometry
+
+    def geom_wkt(col, i):
+        return (
+            f"POINT ({col.x[i]} {col.y[i]})"
+            if col.is_point
+            else to_wkt(col.geometry(i))
+        )
+
+    if fmt == "wkt":
+        col = batch.columns[geom_attr.name]
+        for i in range(len(batch)):
+            out.write(geom_wkt(col, i) + "\n")
+        return
+    rows = []
+    for i in range(len(batch)):
+        row = {}
+        for name in names:
+            col = batch.columns[name]
+            if isinstance(col, GeometryColumn):
+                row[name] = geom_wkt(col, i)
+            elif isinstance(col, DictColumn):
+                v = col.decode()[i]
+                row[name] = "" if v is None else v
+            else:
+                row[name] = np.asarray(col)[i].item()
+        rows.append(row)
+    if fmt == "json":
+        for r in rows:
+            out.write(json.dumps(r) + "\n")
+    else:
+        writer = csv.writer(out, delimiter="\t" if fmt == "tsv" else ",")
+        writer.writerow(names)
+        for r in rows:
+            writer.writerow([r[n] for n in names])
+
+
+def _explain(args) -> int:
+    src = _store(args).get_feature_source(args.feature_name)
+    print(src.explain(args.cql))
+    return 0
+
+
+def _stats_analyze(args) -> int:
+    from geomesa_tpu.plan.stats_manager import StatsManager
+
+    src = _store(args).get_feature_source(args.feature_name)
+    mgr = StatsManager(src.storage)
+    summary = mgr.analyze()
+    print(json.dumps(summary, indent=1, default=str))
+    return 0
+
+
+def _stats_bounds(args) -> int:
+    from geomesa_tpu.plan import Query, QueryHints
+
+    src = _store(args).get_feature_source(args.feature_name)
+    attrs = (
+        args.attributes.split(",")
+        if args.attributes
+        else [
+            a.name
+            for a in src.sft.attributes
+            if not a.is_geometry and a.type not in ("String", "UUID", "Bytes")
+        ]
+    )
+    expr = ";".join(f"MinMax({a})" for a in attrs)
+    stats = src.get_features(
+        Query(args.feature_name, args.cql, hints=QueryHints(stats_string=expr))
+    ).stats
+    for a, s in zip(attrs, stats.stats):
+        print(f"{a}: {s.result()}")
+    return 0
+
+
+def _stats_count(args) -> int:
+    from geomesa_tpu.plan import Query, QueryHints
+
+    src = _store(args).get_feature_source(args.feature_name)
+    q = Query(args.feature_name, args.cql,
+              hints=QueryHints(exact_count=not args.no_exact))
+    print(src.get_count(q))
+    return 0
+
+
+def _stats_histogram(args) -> int:
+    import numpy as np
+
+    from geomesa_tpu.plan import Query, QueryHints
+
+    src = _store(args).get_feature_source(args.feature_name)
+    attr = src.sft.attribute(args.attribute)
+    if attr.is_geometry or attr.type in ("String", "UUID", "Bytes"):
+        raise ValueError(
+            f"stats-histogram requires a numeric or date attribute; "
+            f"{args.attribute!r} is {attr.type} (use stats-top-k for strings)"
+        )
+    # bounds first, then histogram over them
+    mm = src.get_features(
+        Query(args.feature_name, args.cql,
+              hints=QueryHints(stats_string=f"MinMax({args.attribute})"))
+    ).stats.stats[0].result()
+    lo, hi = mm
+    if lo is None:
+        print("no data")
+        return 0
+    hi = hi if hi > lo else lo + 1
+    stats = src.get_features(
+        Query(args.feature_name, args.cql,
+              hints=QueryHints(
+                  stats_string=f"Histogram({args.attribute},{args.bins},{lo},{hi})"
+              ))
+    ).stats
+    counts = stats.stats[0].result()
+    width = (hi - lo) / args.bins
+    for i, c in enumerate(np.asarray(counts)):
+        print(f"[{lo + i * width:.4g}, {lo + (i + 1) * width:.4g}) {int(c)}")
+    return 0
+
+
+def _stats_topk(args) -> int:
+    from geomesa_tpu.plan import Query, QueryHints
+
+    src = _store(args).get_feature_source(args.feature_name)
+    stats = src.get_features(
+        Query(args.feature_name, args.cql,
+              hints=QueryHints(stats_string=f"TopK({args.attribute},{args.k})"))
+    ).stats
+    for value, count in stats.stats[0].result():
+        print(f"{value}\t{count}")
+    return 0
+
+
+def _env(args) -> int:
+    from geomesa_tpu.utils.config import SystemProperties
+
+    for name, prop in sorted(SystemProperties.all().items()):
+        print(f"{name} = {prop.get()} ({prop.provenance}) — {prop.description}")
     return 0

@@ -29,7 +29,22 @@ def main(argv=None) -> int:
     if not getattr(args, "func", None):
         parser.print_help()
         return 1
-    return args.func(args) or 0
+    import os
+
+    try:
+        return args.func(args) or 0
+    except BrokenPipeError:
+        # output piped into head/less that exited early: not an error
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
+    except (FileNotFoundError, FileExistsError, ValueError, KeyError) as e:
+        if os.environ.get("GEOMESA_TPU_DEBUG"):
+            raise
+        print(f"error: {e}", file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

@@ -89,6 +89,9 @@ class QueryPlanner:
         partitions = self.storage.prune_partitions(bbox, interval)
         total = len(self.storage.partitions())
         e(f"Partitions: {len(partitions)} of {total} after pruning")
+        est = self._stats_estimate(bbox, interval)
+        if est is not None:
+            e(f"Estimated matches (stats sketches): ~{est}")
         if query.hints.query_index:
             e(f"Index override requested: {query.hints.query_index!r} "
               "(single-strategy partition store; recorded only)")
@@ -112,6 +115,17 @@ class QueryPlanner:
             e(f"Aggregation: bin track={query.hints.bin_track}")
         e.pop()
         return QueryPlan(query, f, bbox, interval, partitions, total, compiled)
+
+    def _stats_estimate(self, bbox: BBox, interval: Interval):
+        """Sketch-based selectivity (StatsBasedEstimator analog); None when
+        stats-analyze has never run on this store."""
+        if not hasattr(self, "_stats_mgr"):
+            from geomesa_tpu.plan.stats_manager import StatsManager
+
+            self._stats_mgr = StatsManager(self.storage)
+        if not self._stats_mgr.stats:
+            return None
+        return self._stats_mgr.estimate_count(bbox, interval)
 
     # -- execution ---------------------------------------------------------
 

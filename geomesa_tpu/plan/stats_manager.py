@@ -1,0 +1,148 @@
+"""Stats persistence + estimation.
+
+Parity: GeoMesaStats / StatsBasedEstimator + the stats-analyze command
+(geomesa-index-api stats; SURVEY.md C5) [upstream, unverified]: compute
+mergeable sketches over a store, persist them next to the data
+(<root>/stats.json standing in for the stats metadata table), and serve
+cheap estimates (count, bounds, histogram, top-k, spatio-temporal
+selectivity) to the planner's cost model without scanning.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from geomesa_tpu.core.columnar import DictColumn, GeometryColumn
+from geomesa_tpu.cql.extract import BBox, Interval
+from geomesa_tpu.curve.binned_time import TimePeriod, to_binned_time
+from geomesa_tpu.stats.sketches import (
+    DescriptiveStats,
+    MinMax,
+    Stat,
+    TopK,
+    Z3HistogramStat,
+)
+from geomesa_tpu.store.fs import FileSystemStorage
+
+STATS_FILE = "stats.json"
+
+
+class StatsManager:
+    def __init__(self, storage: FileSystemStorage):
+        self.storage = storage
+        self.stats: Dict[str, Stat] = {}
+        self._load()
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.storage.root, STATS_FILE)
+
+    def _load(self) -> None:
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                raw = json.load(f)
+            self.stats = {k: Stat.from_json(v) for k, v in raw.items()}
+
+    def _save(self) -> None:
+        with open(self.path, "w") as f:
+            json.dump({k: s.to_json() for k, s in self.stats.items()}, f)
+
+    def analyze(self) -> dict:
+        """Full-store sketch computation (the stats-analyze command)."""
+        sft = self.storage.sft
+        g = sft.default_geometry
+        d = sft.default_dtg
+        stats: Dict[str, Stat] = {"count": DescriptiveStats("")}
+        for a in sft.attributes:
+            if a.is_geometry:
+                continue
+            if a.type in ("String", "UUID"):
+                stats[f"topk:{a.name}"] = TopK(a.name, 20)
+            elif a.type not in ("Bytes",) and not a.type.startswith(("List", "Map")):
+                stats[f"minmax:{a.name}"] = MinMax(a.name)
+        if g is not None and g.type == "Point" and d is not None:
+            stats["z3"] = Z3HistogramStat(g.name, d.name, "week", 16)
+
+        for batch in self.storage.scan():
+            n = len(batch)
+            stats["count"].observe_moments(n, 0.0, 0.0)
+            for a in sft.attributes:
+                col = batch.columns.get(a.name)
+                if col is None:
+                    continue
+                key_minmax = f"minmax:{a.name}"
+                key_topk = f"topk:{a.name}"
+                if key_minmax in stats and not isinstance(col, (DictColumn, GeometryColumn)):
+                    stats[key_minmax].observe(np.asarray(col))
+                elif key_topk in stats and isinstance(col, DictColumn):
+                    vals = np.asarray(
+                        [v for v in col.decode() if v is not None], dtype=object
+                    )
+                    stats[key_topk].observe(vals)
+            if "z3" in stats:
+                gc = batch.columns[g.name]
+                bins, _ = to_binned_time(np.asarray(batch.columns[d.name]), TimePeriod.WEEK)
+                z3: Z3HistogramStat = stats["z3"]  # type: ignore[assignment]
+                b16 = z3.bins_per_dim
+                cx = np.clip(((np.asarray(gc.x) + 180.0) / 360.0 * b16).astype(int), 0, b16 - 1)
+                cy = np.clip(((np.asarray(gc.y) + 90.0) / 180.0 * b16).astype(int), 0, b16 - 1)
+                for b in np.unique(bins):
+                    sel = bins == b
+                    grid = np.zeros((b16, b16), np.int64)
+                    np.add.at(grid, (cy[sel], cx[sel]), 1)
+                    z3.observe_grid(int(b), grid)
+
+        self.stats = stats
+        self._save()
+        return self.summary()
+
+    def summary(self) -> dict:
+        out = {}
+        for k, s in self.stats.items():
+            r = s.result()
+            if isinstance(r, dict) and "count" in r:
+                out[k] = r["count"]
+            elif isinstance(r, tuple):
+                out[k] = list(r)
+            elif isinstance(r, list):
+                out[k] = r[:5]
+            elif isinstance(r, dict):
+                out[k] = {kk: int(np.asarray(v).sum()) for kk, v in list(r.items())[:5]}
+            else:
+                out[k] = str(r)
+        return out
+
+    # -- estimation (the planner cost model's inputs) ----------------------
+
+    @property
+    def count(self) -> Optional[int]:
+        s = self.stats.get("count")
+        return int(s.count) if s is not None else None
+
+    def estimate_count(self, bbox: BBox, interval: Interval) -> Optional[int]:
+        """Spatio-temporal selectivity from the Z3 histogram sketch; None if
+        stats were never analyzed (planner falls back to heuristics)."""
+        z3 = self.stats.get("z3")
+        if z3 is None:
+            return self.count
+        if interval.start is not None and interval.end is not None:
+            from geomesa_tpu.curve.binned_time import bins_for_interval
+
+            bins = [b for b, _, _ in bins_for_interval(
+                int(interval.start), int(interval.end), TimePeriod.WEEK
+            )]
+        else:
+            bins = [int(k) for k in z3.counts.keys()]
+        return z3.estimate(bbox.xmin, bbox.ymin, bbox.xmax, bbox.ymax, bins)
+
+    def minmax(self, attr: str):
+        s = self.stats.get(f"minmax:{attr}")
+        return s.result() if s is not None else None
+
+    def topk(self, attr: str):
+        s = self.stats.get(f"topk:{attr}")
+        return s.result() if s is not None else None

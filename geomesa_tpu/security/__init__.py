@@ -1,0 +1,23 @@
+"""Visibility security.
+
+Parity: geomesa-security (AuthorizationsProvider SPI, VisibilityEvaluator
+for Accumulo-style boolean visibility expressions like "admin&(usa|gbr)")
+[upstream, unverified]. TPU design (SURVEY.md C21): visibilities live in a
+dictionary-coded label column; a user's authorizations precompute a per-batch
+allow table over the vocabulary, AND-ed into every predicate mask — cheap
+and exact.
+"""
+
+from geomesa_tpu.security.visibility import (
+    VisibilityEvaluator,
+    AuthorizationsProvider,
+    StaticAuthorizationsProvider,
+    allow_mask,
+)
+
+__all__ = [
+    "VisibilityEvaluator",
+    "AuthorizationsProvider",
+    "StaticAuthorizationsProvider",
+    "allow_mask",
+]

@@ -1,0 +1,6 @@
+"""Cross-cutting utilities: system properties, metrics."""
+
+from geomesa_tpu.utils.config import SystemProperty, SystemProperties
+from geomesa_tpu.utils.metrics import MetricsRegistry, metrics
+
+__all__ = ["SystemProperty", "SystemProperties", "MetricsRegistry", "metrics"]

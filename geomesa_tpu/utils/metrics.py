@@ -1,0 +1,103 @@
+"""Tiny metrics registry: counters, gauges, timers.
+
+Parity: geomesa-metrics (Dropwizard/Micrometer registries + reporters)
+[upstream, unverified], reduced to counters/gauges/timers with JSON and
+Prometheus-text export — used by converters/ingest and the query path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List
+
+
+class Timer:
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def update(self, seconds: float):
+        self.count += 1
+        self.total_s += seconds
+        self.max_s = max(self.max_s, seconds)
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+class _TimerContext:
+    def __init__(self, timer: Timer):
+        self.timer = timer
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.timer.update(time.perf_counter() - self._t0)
+        return False
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.timers: Dict[str, Timer] = {}
+
+    def counter(self, name: str, inc: float = 1.0) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + inc
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def timer(self, name: str) -> _TimerContext:
+        with self._lock:
+            t = self.timers.setdefault(name, Timer())
+        return _TimerContext(t)
+
+    def to_json(self) -> str:
+        with self._lock:
+            return json.dumps(
+                {
+                    "counters": self.counters,
+                    "gauges": self.gauges,
+                    "timers": {
+                        k: {"count": t.count, "total_s": t.total_s,
+                            "mean_s": t.mean_s, "max_s": t.max_s}
+                        for k, t in self.timers.items()
+                    },
+                }
+            )
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format."""
+        out: List[str] = []
+        with self._lock:
+            for k, v in self.counters.items():
+                name = _prom(k)
+                out.append(f"# TYPE {name} counter")
+                out.append(f"{name} {v}")
+            for k, v in self.gauges.items():
+                name = _prom(k)
+                out.append(f"# TYPE {name} gauge")
+                out.append(f"{name} {v}")
+            for k, t in self.timers.items():
+                name = _prom(k)
+                out.append(f"# TYPE {name}_seconds summary")
+                out.append(f"{name}_seconds_count {t.count}")
+                out.append(f"{name}_seconds_sum {t.total_s}")
+        return "\n".join(out) + "\n"
+
+
+def _prom(name: str) -> str:
+    return name.replace(".", "_").replace("-", "_")
+
+
+metrics = MetricsRegistry()

@@ -1,0 +1,312 @@
+"""Tests: converter DSL + framework, visibility security, flags, metrics, CLI."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert import (
+    DelimitedTextConverter,
+    EvalContext,
+    JsonConverter,
+    compile_expression,
+    converter_from_config,
+    schemas,
+)
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.security import (
+    StaticAuthorizationsProvider,
+    VisibilityEvaluator,
+    allow_mask,
+)
+from geomesa_tpu.utils.config import SystemProperties
+from geomesa_tpu.utils.metrics import MetricsRegistry
+
+
+class TestTransforms:
+    def ctx(self, *pos, **named):
+        return EvalContext(list(pos), named, line_no=3)
+
+    def test_refs_and_casts(self):
+        assert compile_expression("$1::int")(self.ctx("x", "42")) == 42
+        assert compile_expression("$2::double")(self.ctx("x", "1", "2.5")) == 2.5
+        assert compile_expression("$name")(self.ctx(named={})) is None
+
+    def test_functions(self):
+        assert compile_expression("concat($1, '-', $2)")(self.ctx("", "a", "b")) == "a-b"
+        assert compile_expression("lowercase(trim($1))")(self.ctx("", "  AB ")) == "ab"
+        assert compile_expression("point($1, $2)")(self.ctx("", "1.5", "2.5")) == (1.5, 2.5)
+        assert compile_expression("toInt($1, 7)")(self.ctx("", "bad")) == 7
+        assert compile_expression("withDefault($1, 'x')")(self.ctx("", "")) == "x"
+        assert compile_expression("lineNo()")(self.ctx("")) == 3
+        assert len(compile_expression("md5($1)")(self.ctx("", "v"))) == 32
+
+    def test_dates(self):
+        ms = compile_expression("dateParse('yyyyMMdd', $1)")(self.ctx("", "20200601"))
+        assert ms == int(np.datetime64("2020-06-01", "ms").astype(np.int64))
+        ms = compile_expression("isoDateTime($1)")(self.ctx("", "2020-06-01T12:00:00Z"))
+        assert ms == int(np.datetime64("2020-06-01T12:00:00", "ms").astype(np.int64))
+        assert compile_expression("secsToDate($1)")(self.ctx("", "100")) == 100_000
+
+    def test_nested(self):
+        e = compile_expression("concat(uppercase($1), toString(toInt($2)))")
+        assert e(self.ctx("", "ab", "9")) == "AB9"
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            compile_expression("nosuchfn($1)")
+        with pytest.raises(ValueError):
+            compile_expression("$1::nosuchtype")
+        with pytest.raises(ValueError):
+            compile_expression("toInt(")
+
+
+CSV = """id,name,lat,lon,when
+1,alpha,51.5,-0.1,2020-06-01T00:00:00Z
+2,beta,48.8,2.35,2020-06-02T00:00:00Z
+3,,48.8,2.35,2020-06-03T00:00:00Z
+bad,gamma,not_a_lat,xx,2020-06-04T00:00:00Z
+"""
+
+
+class TestConverters:
+    def make(self):
+        sft = SimpleFeatureType.from_spec(
+            "t", "name:String,dtg:Date,*geom:Point"
+        )
+        config = {
+            "type": "delimited-text",
+            "format": "CSV",
+            "options": {"skip-lines": 1},
+            "id-field": "$1",
+            "fields": [
+                {"name": "name", "transform": "withDefault($2, 'unknown')"},
+                {"name": "dtg", "transform": "isoDateTime($5)"},
+                {"name": "geom", "transform": "point($4, $3)"},
+            ],
+        }
+        return sft, config
+
+    def test_csv(self):
+        sft, config = self.make()
+        conv = DelimitedTextConverter(sft, config)
+        batch = conv.convert(io.StringIO(CSV))
+        assert len(batch) == 3  # bad record skipped
+        assert conv.failed == 1
+        assert batch.fids.decode() == ["1", "2", "3"]
+        assert batch.column("name").decode() == ["alpha", "beta", "unknown"]
+        np.testing.assert_allclose(batch.geometry.x, [-0.1, 2.35, 2.35])
+
+    def test_raise_mode(self):
+        sft, config = self.make()
+        config["options"]["error-mode"] = "raise-errors"
+        conv = DelimitedTextConverter(sft, config)
+        with pytest.raises(Exception):
+            conv.convert(io.StringIO(CSV))
+
+    def test_json(self):
+        sft = SimpleFeatureType.from_spec("t", "name:String,dtg:Date,*geom:Point")
+        config = {
+            "type": "json",
+            "id-field": "$name",
+            "fields": [
+                {"name": "name", "path": "$.props.name"},
+                {"name": "dtg", "path": "$.when", "transform": "isoDateTime($0)"},
+                {"name": "lon", "path": "$.loc.0"},
+                {"name": "lat", "path": "$.loc.1"},
+                {"name": "geom", "transform": "point($lon, $lat)"},
+            ],
+        }
+        lines = "\n".join(
+            json.dumps(
+                {"props": {"name": f"n{i}"}, "when": "2020-06-01T00:00:00Z",
+                 "loc": [i * 1.0, i * 2.0]}
+            )
+            for i in range(4)
+        )
+        conv = converter_from_config(sft, config)
+        assert isinstance(conv, JsonConverter)
+        batch = conv.convert(io.StringIO(lines))
+        assert len(batch) == 4
+        np.testing.assert_allclose(batch.geometry.x, [0, 1, 2, 3])
+        np.testing.assert_allclose(batch.geometry.y, [0, 2, 4, 6])
+
+    def test_gdelt_schema(self):
+        sft, config = schemas.WELL_KNOWN["gdelt"]
+        cols = [""] * 57
+        cols[0] = "e1"
+        cols[1] = "20200601"
+        cols[6] = "FRANCE"
+        cols[26] = "043"
+        cols[30] = "2.4"
+        cols[31] = "12"
+        cols[39] = "48.85"
+        cols[40] = "2.35"
+        tsv = "\t".join(cols)
+        conv = converter_from_config(sft, config)
+        batch = conv.convert(io.StringIO(tsv))
+        assert len(batch) == 1
+        assert batch.column("Actor1Name").decode() == ["FRANCE"]
+        assert batch.column("GoldsteinScale")[0] == pytest.approx(2.4)
+        assert batch.geometry.x[0] == pytest.approx(2.35)
+
+    def test_ais_schema(self):
+        sft, config = schemas.WELL_KNOWN["ais"]
+        csv_text = (
+            "MMSI,BaseDateTime,LAT,LON,SOG,COG,Heading,VesselName\n"
+            "367000001,2021-03-01T00:00:01,29.9,-90.1,7.5,180.0,181.0,EVER GIVEN\n"
+        )
+        conv = converter_from_config(sft, config)
+        batch = conv.convert(io.StringIO(csv_text))
+        assert len(batch) == 1
+        assert batch.column("VesselName").decode() == ["EVER GIVEN"]
+        assert batch.geometry.y[0] == pytest.approx(29.9)
+
+
+class TestVisibility:
+    def test_parse_eval(self):
+        ev = VisibilityEvaluator()
+        assert ev.can_see("", ["any"])
+        assert ev.can_see(None, [])
+        assert ev.can_see("admin", ["admin"])
+        assert not ev.can_see("admin", ["user"])
+        assert ev.can_see("admin&(usa|gbr)", ["admin", "gbr"])
+        assert not ev.can_see("admin&(usa|gbr)", ["admin"])
+        assert not ev.can_see("admin&(usa|gbr)", ["usa", "gbr"])
+        assert ev.can_see("a|b|c", ["c"])
+        assert ev.can_see('"weird label"&x', ["weird label", "x"])
+
+    def test_mixing_requires_parens(self):
+        ev = VisibilityEvaluator()
+        with pytest.raises(ValueError):
+            ev.can_see("a&b|c", ["a"])
+
+    def test_allow_mask(self):
+        vocab = ["admin", "admin&usa", None, "public|admin"]
+        codes = np.array([0, 1, 2, 3, -1, 1], np.int32)
+        m = allow_mask(vocab, codes, ["admin"])
+        np.testing.assert_array_equal(m, [True, False, True, True, True, False])
+        m2 = allow_mask(vocab, codes, ["admin", "usa"])
+        assert m2.all()
+
+    def test_provider(self):
+        p = StaticAuthorizationsProvider(["a", "b"])
+        assert p.get_authorizations() == ["a", "b"]
+
+
+class TestSystemProperties:
+    def test_default_env_override(self, monkeypatch):
+        prop = SystemProperties.SCAN_RANGES_TARGET
+        assert prop.get() == 2000
+        assert prop.provenance == "default"
+        monkeypatch.setenv("GEOMESA_TPU_SCAN_RANGES_TARGET", "512")
+        assert prop.get() == 512
+        assert prop.provenance.startswith("env:")
+        SystemProperties.set(prop.name, 64)
+        assert prop.get() == 64
+        assert prop.provenance == "override"
+        SystemProperties.clear(prop.name)
+        assert prop.get() == 512
+
+    def test_registry(self):
+        assert "geomesa.scan.ranges.target" in SystemProperties.all()
+
+
+class TestMetrics:
+    def test_counters_timers(self):
+        m = MetricsRegistry()
+        m.counter("ingest.features", 10)
+        m.counter("ingest.features", 5)
+        m.gauge("cache.bytes", 1024)
+        with m.timer("query"):
+            pass
+        data = json.loads(m.to_json())
+        assert data["counters"]["ingest.features"] == 15
+        assert data["gauges"]["cache.bytes"] == 1024
+        assert data["timers"]["query"]["count"] == 1
+        prom = m.to_prometheus()
+        assert "ingest_features 15" in prom
+        assert "query_seconds_count 1" in prom
+
+
+@pytest.fixture()
+def cli_env(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo"
+    env["JAX_PLATFORMS"] = "cpu"
+    # the axon sitecustomize pins jax_platforms; geomesa CLI paths that
+    # touch jax need the conftest-style workaround, applied via sitecustomize
+    site = tmp_path / "site"
+    site.mkdir()
+    (site / "sitecustomize.py").write_text(
+        "import jax\n"
+        "from jax._src import xla_bridge as xb\n"
+        "for k in ('axon','tpu'): xb._backend_factories.pop(k, None)\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+    )
+    env["PYTHONPATH"] = f"{site}:/root/repo"
+    return env
+
+
+def run_cli(args, env):
+    return subprocess.run(
+        [sys.executable, "-m", "geomesa_tpu.cli.main"] + args,
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+
+
+class TestCLI:
+    def test_end_to_end(self, tmp_path, cli_env):
+        cat = str(tmp_path / "catalog")
+        r = run_cli(["create-schema", "-c", cat, "-f", "pois",
+                     "-s", "name:String,dtg:Date,*geom:Point"], cli_env)
+        assert r.returncode == 0, r.stderr
+        r = run_cli(["get-type-names", "-c", cat], cli_env)
+        assert r.stdout.strip() == "pois"
+        r = run_cli(["describe-schema", "-c", cat, "-f", "pois"], cli_env)
+        assert "String" in r.stdout and "*default geometry" in r.stdout
+
+        # ingest via a converter config file
+        conv = tmp_path / "conv.json"
+        conv.write_text(json.dumps({
+            "type": "delimited-text", "format": "CSV",
+            "options": {"skip-lines": 1},
+            "id-field": "$1",
+            "fields": [
+                {"name": "name", "transform": "$2::string"},
+                {"name": "dtg", "transform": "isoDateTime($3)"},
+                {"name": "geom", "transform": "point($4, $5)"},
+            ],
+        }))
+        data = tmp_path / "data.csv"
+        data.write_text(
+            "id,name,when,lon,lat\n"
+            "1,cafe,2020-06-01T00:00:00Z,2.35,48.85\n"
+            "2,pub,2020-06-02T00:00:00Z,-0.1,51.5\n"
+        )
+        r = run_cli(["ingest", "-c", cat, "-f", "pois", "-C", str(conv), str(data)], cli_env)
+        assert "ingested 2 features" in r.stdout, r.stderr
+
+        r = run_cli(["stats-count", "-c", cat, "-f", "pois"], cli_env)
+        assert r.stdout.strip() == "2"
+        r = run_cli(["export", "-c", cat, "-f", "pois", "-q", "name = 'cafe'",
+                     "-F", "csv"], cli_env)
+        assert "cafe" in r.stdout and "pub" not in r.stdout
+        r = run_cli(["explain", "-c", cat, "-f", "pois",
+                     "-q", "BBOX(geom, 0, 40, 5, 50)"], cli_env)
+        assert "Partitions" in r.stdout
+        r = run_cli(["stats-analyze", "-c", cat, "-f", "pois"], cli_env)
+        assert r.returncode == 0, r.stderr
+        r = run_cli(["stats-top-k", "-c", cat, "-f", "pois", "-a", "name"], cli_env)
+        assert "cafe\t1" in r.stdout
+        r = run_cli(["env"], cli_env)
+        assert "geomesa.scan.ranges.target" in r.stdout
+
+    def test_version_and_help(self, cli_env):
+        assert run_cli(["version"], cli_env).returncode == 0
+        r = run_cli([], cli_env)
+        assert r.returncode == 1
