@@ -1,0 +1,7 @@
+"""Allow `python -m geomesa_tpu.cli` (mirrors the geomesa-* launcher scripts)."""
+
+import sys
+
+from geomesa_tpu.cli.main import main
+
+sys.exit(main())

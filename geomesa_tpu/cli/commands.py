@@ -235,18 +235,29 @@ def _write_text(out, batch, fmt):
         for i in range(len(batch)):
             out.write(geom_wkt(col, i) + "\n")
         return
+    # materialize each column once (decode()/asarray are O(N) per call)
+    materialized = {}
+    for name in names:
+        col = batch.columns[name]
+        if isinstance(col, GeometryColumn):
+            materialized[name] = col
+        elif isinstance(col, DictColumn):
+            materialized[name] = col.decode()
+        else:
+            materialized[name] = np.asarray(col)
     rows = []
     for i in range(len(batch)):
         row = {}
         for name in names:
             col = batch.columns[name]
+            m = materialized[name]
             if isinstance(col, GeometryColumn):
-                row[name] = geom_wkt(col, i)
+                row[name] = geom_wkt(m, i)
             elif isinstance(col, DictColumn):
-                v = col.decode()[i]
+                v = m[i]
                 row[name] = "" if v is None else v
             else:
-                row[name] = np.asarray(col)[i].item()
+                row[name] = m[i].item()
         rows.append(row)
     if fmt == "json":
         for r in rows:

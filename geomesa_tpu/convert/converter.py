@@ -86,18 +86,25 @@ class _BaseConverter:
                     row[f.name] = self._field_value(ctx, f)
                     ctx.named[f.name] = row[f.name]
                 fid = str(self.id_field(ctx)) if self.id_field else f"f{ctx.line_no}"
+                # validate the whole row BEFORE any append so a skipped
+                # record never leaves columns misaligned
                 for a in self.sft.attributes:
                     v = row.get(a.name)
                     if a.is_geometry and v is None:
                         raise ValueError(f"no geometry for {a.name}")
                     if a.is_temporal and v is None:
                         raise ValueError(f"no date for {a.name}")
-                    data[a.name].append(v)
+                for a in self.sft.attributes:
+                    data[a.name].append(row.get(a.name))
                 fids.append(fid)
             except Exception:
                 if self.error_mode == "raise-errors":
                     raise
                 self.failed += 1
+        from geomesa_tpu.utils.metrics import metrics
+
+        metrics.counter("convert.success", len(fids))
+        metrics.counter("convert.failure", self.failed)
         return self._to_batch(data, fids)
 
     def _to_batch(self, data, fids) -> FeatureBatch:
@@ -152,15 +159,12 @@ class JsonConverter(_BaseConverter):
                 fh.close()
 
     def _field_value(self, ctx: EvalContext, f: _Field):
-        # transforms run over the extracted path value, exposed as $0
+        # transforms run over the extracted path value, exposed as $0; a
+        # missing path stays None so e.g. withDefault($0, ...) sees null
+        # rather than the whole record
         v = ctx.named.get(f.name)
         if f.transform is not None:
-            sub = EvalContext(
-                [v if v is not None else ctx.positional[0]],
-                ctx.named,
-                ctx.line_no,
-                ctx.raw,
-            )
+            sub = EvalContext([v], ctx.named, ctx.line_no, ctx.raw)
             v = f.transform(sub)
         return v
 

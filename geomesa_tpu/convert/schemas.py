@@ -24,8 +24,10 @@ GDELT_SPEC = (
 GDELT_SFT = SimpleFeatureType.from_spec("gdelt", GDELT_SPEC)
 
 # GDELT 1.0: $1=GlobalEventID $2=Day(yyyyMMdd) $7=Actor1Name $17=Actor2Name
-# $31=GoldsteinScale $32=NumMentions $40=ActionGeo_Lat $41=ActionGeo_Long
-# $27=EventCode  (1-based positions into the TSV)
+# $27=EventCode $31=GoldsteinScale $32=NumMentions
+# $54=ActionGeo_Lat $55=ActionGeo_Long  (1-based positions into the TSV;
+# $40/$41 are Actor1Geo_Lat/Long — the event's *actor* location, not the
+# action location the schema promises)
 GDELT_CONVERTER = {
     "type": "delimited-text",
     "format": "TSV",
@@ -38,7 +40,7 @@ GDELT_CONVERTER = {
         {"name": "GoldsteinScale", "transform": "toDouble($31, 0.0)"},
         {"name": "NumMentions", "transform": "toInt($32, 0)"},
         {"name": "dtg", "transform": "dateParse('yyyyMMdd', $2)"},
-        {"name": "geom", "transform": "point($41, $40)"},
+        {"name": "geom", "transform": "point($55, $54)"},
     ],
 }
 

@@ -70,7 +70,13 @@ class QueryPlanner:
         if coord_dtype is None:
             import jax.numpy as jnp
 
-            coord_dtype = jnp.float32
+            from geomesa_tpu.utils.config import SystemProperties
+
+            coord_dtype = (
+                jnp.float64
+                if SystemProperties.COORD_DTYPE.get() == "float64"
+                else jnp.float32
+            )
         self.coord_dtype = coord_dtype
 
     # -- planning ----------------------------------------------------------
@@ -123,6 +129,7 @@ class QueryPlanner:
             from geomesa_tpu.plan.stats_manager import StatsManager
 
             self._stats_mgr = StatsManager(self.storage)
+        self._stats_mgr.refresh()
         if not self._stats_mgr.stats:
             return None
         return self._stats_mgr.estimate_count(bbox, interval)
@@ -133,10 +140,22 @@ class QueryPlanner:
         import jax.numpy as jnp
 
         from geomesa_tpu.engine.device import to_device
+        from geomesa_tpu.utils.config import SystemProperties
+        from geomesa_tpu.utils.metrics import metrics
 
+        timeout_ms = int(SystemProperties.QUERY_TIMEOUT_MS.get())
         t0 = time.perf_counter()
+
+        def check_timeout(phase: str) -> None:
+            if timeout_ms and (time.perf_counter() - t0) * 1000 > timeout_ms:
+                raise TimeoutError(
+                    f"query exceeded geomesa.query.timeout={timeout_ms}ms "
+                    f"during {phase}"
+                )
+
         plan = self.plan(query, explain)
         t_plan = time.perf_counter()
+        check_timeout("planning")
 
         batches = list(
             self.storage.scan(
@@ -146,6 +165,7 @@ class QueryPlanner:
             )
         )
         t_scan = time.perf_counter()
+        check_timeout("scan")
 
         hints = query.hints
         result: QueryResult
@@ -175,6 +195,12 @@ class QueryPlanner:
             result = self._aggregate(padded, dev, mask, query)
         t_done = time.perf_counter()
 
+        metrics.counter("query.count")
+        metrics.counter("query.features.matched", mask_count)
+        metrics.timer("query.plan").timer.update(t_plan - t0)
+        metrics.timer("query.scan").timer.update(t_scan - t_plan)
+        metrics.timer("query.compute").timer.update(t_done - t_scan)
+
         if self.audit is not None:
             self.audit.write(
                 QueryEvent(
@@ -193,9 +219,13 @@ class QueryPlanner:
 
     def count(self, query: Query) -> int:
         """EXACT_COUNT path; with exact_count=False and INCLUDE, serve the
-        manifest count (the stats-estimate analog)."""
+        manifest count (the stats-estimate analog). geomesa.force.count
+        makes every count exact regardless of hints."""
+        from geomesa_tpu.utils.config import SystemProperties
+
         if (
             not query.hints.exact_count
+            and not SystemProperties.FORCE_COUNT.get()
             and isinstance(query.filter_ast, ast.Include)
         ):
             return self.storage.count

@@ -35,6 +35,7 @@ class StatsManager:
     def __init__(self, storage: FileSystemStorage):
         self.storage = storage
         self.stats: Dict[str, Stat] = {}
+        self._loaded_mtime: float = -1.0
         self._load()
 
     @property
@@ -43,13 +44,26 @@ class StatsManager:
 
     def _load(self) -> None:
         if os.path.exists(self.path):
+            self._loaded_mtime = os.path.getmtime(self.path)
             with open(self.path) as f:
                 raw = json.load(f)
             self.stats = {k: Stat.from_json(v) for k, v in raw.items()}
 
+    def refresh(self) -> None:
+        """Reload stats.json if it changed on disk since the last load, so a
+        long-lived planner sees stats analyzed after it was constructed
+        (parity: GeoMesa's expiring metadata cache)."""
+        try:
+            mtime = os.path.getmtime(self.path)
+        except OSError:
+            return
+        if mtime != self._loaded_mtime:
+            self._load()
+
     def _save(self) -> None:
         with open(self.path, "w") as f:
             json.dump({k: s.to_json() for k, s in self.stats.items()}, f)
+        self._loaded_mtime = os.path.getmtime(self.path)
 
     def analyze(self) -> dict:
         """Full-store sketch computation (the stats-analyze command)."""

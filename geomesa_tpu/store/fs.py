@@ -284,8 +284,18 @@ class FileSystemStorage:
                     schema_names = pq.read_schema(path).names
                     cols = phys_cols + ([FID] if FID in schema_names else [])
                 t = pq.read_table(path, filters=expr, columns=cols)
-                if len(t):
+                if not len(t):
+                    continue
+                # geomesa.scan.batch.size bounds per-yield rows so one huge
+                # file cannot force an oversized host allocation
+                from geomesa_tpu.utils.config import SystemProperties
+
+                target = int(SystemProperties.SCAN_BATCH_SIZE.get())
+                if len(t) <= target:
                     yield _table_to_batch(t, self.sft)
+                else:
+                    for off in range(0, len(t), target):
+                        yield _table_to_batch(t.slice(off, target), self.sft)
 
     def read_all(self) -> Optional[FeatureBatch]:
         batches = list(self.scan())

@@ -157,8 +157,11 @@ class XZ2Scheme(PartitionScheme):
         if bbox.is_whole_world:
             return None
         out: Set[str] = set()
+        from geomesa_tpu.utils.config import SystemProperties
+
+        budget = int(SystemProperties.SCAN_RANGES_TARGET.get())
         for r in self._sfc.ranges(bbox.xmin, bbox.ymin, bbox.xmax, bbox.ymax,
-                                  max_ranges=2000):
+                                  max_ranges=budget):
             for c in range(r.lower, r.upper + 1):
                 out.add(f"xz2/{c}")
         return out

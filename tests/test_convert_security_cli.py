@@ -101,6 +101,41 @@ class TestConverters:
         assert batch.column("name").decode() == ["alpha", "beta", "unknown"]
         np.testing.assert_allclose(batch.geometry.x, [-0.1, 2.35, 2.35])
 
+    def test_skip_keeps_columns_aligned(self):
+        # a record failing geometry validation must not leave earlier
+        # columns partially appended (silent row misalignment)
+        sft = SimpleFeatureType.from_spec("t", "name:String,*geom:Point")
+        config = {
+            "type": "delimited-text",
+            "fields": [
+                {"name": "name", "transform": "$1"},
+                {"name": "geom", "transform": "point($2, $3)"},
+            ],
+        }
+        conv = DelimitedTextConverter(sft, config)
+        batch = conv.convert(io.StringIO("a,1,2\nbad,,\nc,5,6\n"))
+        assert conv.failed == 1
+        assert batch.column("name").decode() == ["a", "c"]
+        np.testing.assert_allclose(batch.geometry.x, [1.0, 5.0])
+
+    def test_json_missing_path_stays_null(self):
+        # $0 must be the extracted path value (None when missing), never the
+        # whole record object
+        sft = SimpleFeatureType.from_spec("t", "name:String,*geom:Point")
+        config = {
+            "type": "json",
+            "fields": [
+                {"name": "name", "path": "$.props.name",
+                 "transform": "withDefault($0, 'UNKNOWN')"},
+                {"name": "lon", "path": "$.loc.0"},
+                {"name": "lat", "path": "$.loc.1"},
+                {"name": "geom", "transform": "point($lon, $lat)"},
+            ],
+        }
+        conv = converter_from_config(sft, config)
+        batch = conv.convert(io.StringIO(json.dumps({"loc": [1.0, 2.0]})))
+        assert batch.column("name").decode() == ["UNKNOWN"]
+
     def test_raise_mode(self):
         sft, config = self.make()
         config["options"]["error-mode"] = "raise-errors"
@@ -144,8 +179,8 @@ class TestConverters:
         cols[26] = "043"
         cols[30] = "2.4"
         cols[31] = "12"
-        cols[39] = "48.85"
-        cols[40] = "2.35"
+        cols[53] = "48.85"  # ActionGeo_Lat ($54)
+        cols[54] = "2.35"   # ActionGeo_Long ($55)
         tsv = "\t".join(cols)
         conv = converter_from_config(sft, config)
         batch = conv.convert(io.StringIO(tsv))
