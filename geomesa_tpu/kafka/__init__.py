@@ -1,0 +1,27 @@
+"""Near-real-time live feature layer (the geomesa-kafka analog).
+
+Parity: geomesa-kafka KafkaDataStore / GeoMessage / KafkaFeatureCache
+[upstream, unverified]. Streaming upsert is host-side by design; TPU parity
+is periodic double-buffered snapshot refresh of device-resident arrays, not
+per-message device updates (SURVEY.md C12 TPU note).
+"""
+
+from geomesa_tpu.kafka.cache import FeatureEvent, KafkaFeatureCache
+from geomesa_tpu.kafka.messages import (
+    Change,
+    Clear,
+    Delete,
+    GeoMessageSerializer,
+)
+from geomesa_tpu.kafka.store import InProcessBroker, KafkaDataStore
+
+__all__ = [
+    "Change",
+    "Clear",
+    "Delete",
+    "FeatureEvent",
+    "GeoMessageSerializer",
+    "InProcessBroker",
+    "KafkaDataStore",
+    "KafkaFeatureCache",
+]

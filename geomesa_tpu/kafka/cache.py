@@ -1,0 +1,165 @@
+"""KafkaFeatureCache: latest-feature-per-id in-memory state + spatial index.
+
+Parity: geomesa-kafka KafkaFeatureCache + KafkaFeatureEventSource [upstream,
+unverified]: consumers fold GeoMessages into a map fid -> latest feature,
+maintain a gridded spatial index for bbox queries, push feature events to
+registered listeners, and expire features by age.
+
+TPU integration (SURVEY.md C12): `snapshot()` materializes the live state as
+an immutable columnar FeatureBatch — the double-buffered device refresh
+boundary. Queries can run host-side from the index (low latency, small
+results) or device-side on the latest snapshot (analytics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.core.wkt import Geometry
+from geomesa_tpu.kafka.messages import Change, Clear, Delete, GeoMessage
+from geomesa_tpu.utils.spatial_index import BucketIndex
+
+
+@dataclasses.dataclass
+class FeatureEvent:
+    kind: str  # changed | removed | cleared
+    fid: Optional[str] = None
+    attributes: Optional[Dict[str, object]] = None
+
+
+Listener = Callable[[FeatureEvent], None]
+
+
+class KafkaFeatureCache:
+    def __init__(
+        self,
+        sft: SimpleFeatureType,
+        expiry_ms: Optional[int] = None,
+        xbuckets: int = 360,
+        ybuckets: int = 180,
+    ):
+        self.sft = sft
+        self.expiry_ms = expiry_ms
+        self._geom = sft.default_geometry.name if sft.default_geometry else None
+        self._rows: Dict[str, Dict[str, object]] = {}
+        self._stamps: Dict[str, float] = {}
+        self._index: BucketIndex[str] = BucketIndex(xbuckets, ybuckets)
+        self._listeners: List[Listener] = []
+        self._lock = threading.Lock()
+        self._snapshot: Optional[FeatureBatch] = None
+        self._snapshot_dirty = True
+
+    # -- message application ----------------------------------------------
+
+    def apply(self, msg: GeoMessage) -> None:
+        if isinstance(msg, Change):
+            self._upsert(msg.fid, msg.attributes)
+        elif isinstance(msg, Delete):
+            self._delete(msg.fid)
+        elif isinstance(msg, Clear):
+            self.clear()
+        else:
+            raise TypeError(f"not a GeoMessage: {msg!r}")
+
+    def _upsert(self, fid: str, attrs: Dict[str, object]) -> None:
+        with self._lock:
+            self._rows[fid] = attrs
+            self._stamps[fid] = time.time()
+            if self._geom is not None:
+                g = attrs.get(self._geom)
+                if isinstance(g, Geometry):
+                    cx, cy = g.point if g.is_point else (
+                        (g.bbox[0] + g.bbox[2]) / 2.0,
+                        (g.bbox[1] + g.bbox[3]) / 2.0,
+                    )
+                    self._index.insert(fid, cx, cy, fid)
+            self._snapshot_dirty = True
+        self._emit(FeatureEvent("changed", fid, attrs))
+
+    def _delete(self, fid: str) -> None:
+        with self._lock:
+            existed = self._rows.pop(fid, None) is not None
+            self._stamps.pop(fid, None)
+            self._index.remove(fid)
+            if existed:
+                self._snapshot_dirty = True
+        if existed:
+            self._emit(FeatureEvent("removed", fid))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rows.clear()
+            self._stamps.clear()
+            self._index.clear()
+            self._snapshot_dirty = True
+        self._emit(FeatureEvent("cleared"))
+
+    # -- expiry ------------------------------------------------------------
+
+    def expire(self, now: Optional[float] = None) -> int:
+        """Drop features older than expiry_ms; returns the evicted count.
+        Called by the store's maintenance tick (upstream: Caffeine expiry)."""
+        if self.expiry_ms is None:
+            return 0
+        now = now if now is not None else time.time()
+        cutoff = now - self.expiry_ms / 1000.0
+        with self._lock:
+            stale = [fid for fid, ts in self._stamps.items() if ts < cutoff]
+        for fid in stale:
+            self._delete(fid)
+        return len(stale)
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, fid: str) -> Optional[Dict[str, object]]:
+        with self._lock:
+            return self._rows.get(fid)
+
+    def query_bbox(
+        self, bbox: Tuple[float, float, float, float]
+    ) -> List[Tuple[str, Dict[str, object]]]:
+        """Host-side bbox query straight off the gridded index."""
+        with self._lock:
+            fids = [fid for fid, _ in self._index.query(bbox)]
+            return [(fid, self._rows[fid]) for fid in fids if fid in self._rows]
+
+    def snapshot(self) -> Optional[FeatureBatch]:
+        """Immutable columnar view of current state (device refresh boundary).
+        Rebuilt only when dirty — repeated calls between updates are free."""
+        with self._lock:
+            if not self._snapshot_dirty:
+                return self._snapshot
+            if not self._rows:
+                self._snapshot = None
+                self._snapshot_dirty = False
+                return None
+            fids = list(self._rows.keys())
+            data: Dict[str, list] = {a.name: [] for a in self.sft.attributes}
+            for fid in fids:
+                row = self._rows[fid]
+                for a in self.sft.attributes:
+                    data[a.name].append(row.get(a.name))
+            self._snapshot = FeatureBatch.from_pydict(self.sft, data, fids=fids)
+            self._snapshot_dirty = False
+            return self._snapshot
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    # -- events ------------------------------------------------------------
+
+    def add_listener(self, fn: Listener) -> None:
+        self._listeners.append(fn)
+
+    def remove_listener(self, fn: Listener) -> None:
+        self._listeners.remove(fn)
+
+    def _emit(self, event: FeatureEvent) -> None:
+        for fn in list(self._listeners):
+            fn(event)

@@ -1,0 +1,125 @@
+"""Lambda store: transient live tier + persistent tier, merged on read.
+
+Parity: geomesa-lambda LambdaDataStore [upstream, unverified]: recent writes
+live in Kafka + an in-memory cache (transient tier) and are asynchronously
+persisted after an age threshold to a backing persistent store; queries
+merge both tiers with the transient feature winning on feature-id collision.
+
+Here: transient = KafkaDataStore (in-process broker), persistent = the
+partitioned Parquet DataStore. `persist()` is the explicit tick the
+reference runs on a scheduled executor (upstream: OffsetManager-coordinated
+expiry); call it from a host timer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.kafka.store import InProcessBroker, KafkaDataStore
+from geomesa_tpu.plan.datastore import DataStore
+from geomesa_tpu.plan.query import Query
+from geomesa_tpu.plan.planner import QueryResult
+
+
+class LambdaDataStore:
+    def __init__(
+        self,
+        catalog: str,
+        persist_after_ms: int = 60_000,
+        broker: Optional[InProcessBroker] = None,
+        mesh=None,
+    ):
+        self.persistent = DataStore(catalog, mesh=mesh)
+        self.transient = KafkaDataStore(broker=broker, mesh=mesh)
+        self.persist_after_ms = persist_after_ms
+        self._created: Set[str] = set()
+
+    # -- schema ------------------------------------------------------------
+
+    def create_schema(self, sft: SimpleFeatureType, scheme=None) -> None:
+        self.persistent.create_schema(sft, scheme)
+        self.transient.create_schema(sft)
+        self._created.add(sft.name)
+
+    def get_type_names(self) -> List[str]:
+        return sorted(set(self.persistent.get_type_names()) | set(self._created))
+
+    def get_schema(self, name: str) -> SimpleFeatureType:
+        return self.persistent.get_schema(name)
+
+    # -- writes (transient tier) ------------------------------------------
+
+    def write(self, name: str, batch: FeatureBatch) -> None:
+        self.transient.write(name, batch)
+
+    def delete(self, name: str, fid: str) -> None:
+        self.transient.delete(name, fid)
+
+    # -- persistence tick --------------------------------------------------
+
+    def persist(self, name: str, now: Optional[float] = None) -> int:
+        """Move features older than persist_after_ms into the persistent
+        store; returns how many were persisted."""
+        self.transient.poll(name)
+        cache = self.transient.cache(name)
+        now = now if now is not None else time.time()
+        cutoff = now - self.persist_after_ms / 1000.0
+        snap = cache.snapshot()
+        if snap is None:
+            return 0
+        with cache._lock:
+            old = [fid for fid, ts in cache._stamps.items() if ts < cutoff]
+        if not old:
+            return 0
+        fids = snap.fids.decode() if snap.fids is not None else []
+        idx = [i for i, f in enumerate(fids) if f in set(old)]
+        if not idx:
+            return 0
+        moving = snap.select(np.asarray(idx))
+        self.persistent.get_feature_source(name).write(moving)
+        for fid in old:
+            self.transient.delete(name, fid)
+        self.transient.poll(name)
+        return len(idx)
+
+    # -- merged reads ------------------------------------------------------
+
+    def get_features(self, query: "Query | str") -> QueryResult:
+        """Query both tiers; merge feature results with transient-wins
+        dedupe by fid. Aggregations (density/stats) run per tier and are
+        NOT merged here — run them post-persist or on one tier."""
+        if isinstance(query, str):
+            name = self.get_type_names()[0] if "(" not in query else None
+            raise TypeError("pass a Query(type_name, cql) to LambdaDataStore")
+        p = self.persistent.get_feature_source(query.type_name).get_features(query)
+        t = self.transient.get_feature_source(query.type_name).get_features(query)
+        if p.kind != "features":
+            raise NotImplementedError(
+                "aggregation hints over the merged lambda view are not "
+                "supported; query a single tier"
+            )
+        return _merge_features(t, p)
+
+    def get_count(self, query: "Query | str") -> int:
+        r = self.get_features(query)
+        return len(r.features) if r.features is not None else 0
+
+
+def _merge_features(transient: QueryResult, persistent: QueryResult) -> QueryResult:
+    tb = transient.features
+    pb = persistent.features
+    if tb is None or len(tb) == 0:
+        return persistent
+    if pb is None or len(pb) == 0:
+        return transient
+    tfids = set(tb.fids.decode()) if tb.fids is not None else set()
+    if pb.fids is not None and tfids:
+        keep = np.asarray([f not in tfids for f in pb.fids.decode()])
+        pb = pb.select(np.nonzero(keep)[0])
+    merged = FeatureBatch.concat([tb, pb]) if len(pb) else tb
+    return QueryResult("features", features=merged, count=len(merged))

@@ -1,0 +1,287 @@
+"""Live layer: spatial indices, geohash, GeoMessage wire, Kafka cache/store,
+Lambda two-tier merge.
+
+Parity targets: geomesa-utils SpatialIndex/GeoHash, geomesa-kafka
+KafkaDataStore/GeoMessage, geomesa-lambda LambdaDataStore [upstream,
+unverified] — semantics tested against brute-force/NumPy oracles, per the
+reference's TestGeoMesaDataStore idea (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.core.wkt import parse_wkt, point
+from geomesa_tpu.kafka import (
+    Change,
+    Clear,
+    Delete,
+    GeoMessageSerializer,
+    InProcessBroker,
+    KafkaDataStore,
+    KafkaFeatureCache,
+)
+from geomesa_tpu.plan.query import Query
+from geomesa_tpu.utils import geohash
+from geomesa_tpu.utils.spatial_index import BucketIndex, SizeSeparatedBucketIndex
+
+SFT = SimpleFeatureType.from_spec(
+    "live", "name:String,score:Double,dtg:Date,*geom:Point"
+)
+
+
+def _batch(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return FeatureBatch.from_pydict(
+        SFT,
+        {
+            "name": rng.choice(["a", "b", "c"], n).tolist(),
+            "score": rng.uniform(-5, 5, n),
+            "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], 1
+            ),
+        },
+        fids=[f"f{i}" for i in range(n)],
+    )
+
+
+class TestBucketIndex:
+    def test_insert_query_remove(self):
+        rng = np.random.default_rng(0)
+        xs, ys = rng.uniform(-180, 180, 500), rng.uniform(-90, 90, 500)
+        idx = BucketIndex()
+        for i, (x, y) in enumerate(zip(xs, ys)):
+            idx.insert(f"k{i}", x, y, i)
+        assert len(idx) == 500
+        bbox = (-30.0, -20.0, 40.0, 50.0)
+        got = sorted(v for _, v in idx.query(bbox))
+        want = sorted(
+            int(i)
+            for i in np.nonzero(
+                (xs >= bbox[0]) & (xs <= bbox[2]) & (ys >= bbox[1]) & (ys <= bbox[3])
+            )[0]
+        )
+        assert got == want
+        # upsert moves the entry
+        idx.insert("k0", 0.0, 0.0, 999)
+        assert idx.get("k0") == 999
+        assert len(idx) == 500
+        assert idx.remove("k0") == 999
+        assert idx.get("k0") is None
+        assert len(idx) == 499
+
+    def test_query_all_and_clear(self):
+        idx = BucketIndex()
+        idx.insert("a", 0, 0, 1)
+        idx.insert("b", 10, 10, 2)
+        assert sorted(v for _, v in idx.query(None)) == [1, 2]
+        idx.clear()
+        assert len(idx) == 0
+
+
+class TestSizeSeparated:
+    def test_extended_geometries_found(self):
+        idx = SizeSeparatedBucketIndex()
+        # a large polygon whose center is far from the query box but which
+        # overlaps it — plain center-binned BucketIndex would miss this
+        idx.insert("big", (-50.0, -50.0, 50.0, 50.0), "big")
+        idx.insert("small", (0.0, 0.0, 0.5, 0.5), "small")
+        idx.insert("far", (100.0, 60.0, 101.0, 61.0), "far")
+        got = sorted(v for _, v in idx.query((40.0, 40.0, 45.0, 45.0)))
+        assert got == ["big"]
+        got = sorted(v for _, v in idx.query((-1.0, -1.0, 1.0, 1.0)))
+        assert got == ["big", "small"]
+        assert idx.remove("big") == "big"
+        assert sorted(v for _, v in idx.query((40.0, 40.0, 45.0, 45.0))) == []
+
+
+class TestGeoHash:
+    def test_known_values(self):
+        # public reference vectors
+        assert geohash.encode_one(-5.6, 42.6, 5) == "ezs42"
+        assert geohash.encode_one(-0.1257, 51.5074, 7) == "gcpvj0s"
+
+    def test_round_trip(self):
+        rng = np.random.default_rng(3)
+        lon = rng.uniform(-180, 180, 50)
+        lat = rng.uniform(-90, 90, 50)
+        for g, x, y in zip(geohash.encode(lon, lat, 9), lon, lat):
+            bx = geohash.decode_bbox(str(g))
+            assert bx[0] <= x <= bx[2] and bx[1] <= y <= bx[3]
+
+    def test_neighbors_share_edge(self):
+        for n in geohash.neighbors("ezs42"):
+            a, b = geohash.decode_bbox("ezs42"), geohash.decode_bbox(n)
+            # neighbor cells touch the cell's bbox
+            assert a[0] <= b[2] + 1e-9 and a[2] >= b[0] - 1e-9
+            assert a[1] <= b[3] + 1e-9 and a[3] >= b[1] - 1e-9
+
+    def test_bboxes_cover(self):
+        cells = geohash.bboxes_for((-10, -10, 10, 10), 2)
+        rng = np.random.default_rng(4)
+        for x, y in zip(rng.uniform(-10, 10, 30), rng.uniform(-10, 10, 30)):
+            assert geohash.encode_one(x, y, 2) in cells
+
+
+class TestGeoMessage:
+    def test_change_round_trip(self):
+        ser = GeoMessageSerializer(SFT)
+        msg = Change(
+            "id-1",
+            {"name": "alpha", "score": 2.5, "dtg": 1_595_000_000_000,
+             "geom": point(2.35, 48.85)},
+        )
+        out = ser.deserialize(ser.serialize(msg))
+        assert isinstance(out, Change)
+        assert out.fid == "id-1"
+        assert out.attributes["name"] == "alpha"
+        assert out.attributes["score"] == 2.5
+        assert out.attributes["geom"].point == (2.35, 48.85)
+
+    def test_nulls_and_polygon(self):
+        sft = SimpleFeatureType.from_spec("p", "name:String,*geom:Polygon")
+        ser = GeoMessageSerializer(sft)
+        poly = parse_wkt("POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))")
+        out = ser.deserialize(ser.serialize(Change("a", {"name": None, "geom": poly})))
+        assert out.attributes["name"] is None
+        assert out.attributes["geom"] == poly
+
+    def test_delete_clear(self):
+        ser = GeoMessageSerializer(SFT)
+        assert ser.deserialize(ser.serialize(Delete("x"))).fid == "x"
+        assert isinstance(ser.deserialize(ser.serialize(Clear())), Clear)
+
+
+class TestKafkaCache:
+    def test_upsert_latest_wins(self):
+        cache = KafkaFeatureCache(SFT)
+        cache.apply(Change("f1", {"name": "a", "score": 1.0,
+                                  "dtg": 1_595_000_000_000, "geom": point(0, 0)}))
+        cache.apply(Change("f1", {"name": "b", "score": 2.0,
+                                  "dtg": 1_595_000_000_000, "geom": point(10, 10)}))
+        assert len(cache) == 1
+        assert cache.get("f1")["name"] == "b"
+        assert [f for f, _ in cache.query_bbox((5, 5, 15, 15))] == ["f1"]
+        assert cache.query_bbox((-5, -5, 5, 5)) == []
+
+    def test_events_and_clear(self):
+        cache = KafkaFeatureCache(SFT)
+        events = []
+        cache.add_listener(events.append)
+        cache.apply(Change("f1", {"name": "a", "score": 1.0,
+                                  "dtg": 0, "geom": point(0, 0)}))
+        cache.apply(Delete("f1"))
+        cache.apply(Clear())
+        assert [e.kind for e in events] == ["changed", "removed", "cleared"]
+
+    def test_expiry(self):
+        cache = KafkaFeatureCache(SFT, expiry_ms=1)
+        cache.apply(Change("f1", {"name": "a", "score": 1.0,
+                                  "dtg": 0, "geom": point(0, 0)}))
+        import time
+
+        assert cache.expire(now=time.time() + 1.0) == 1
+        assert len(cache) == 0
+
+    def test_snapshot_caching(self):
+        cache = KafkaFeatureCache(SFT)
+        assert cache.snapshot() is None
+        cache.apply(Change("f1", {"name": "a", "score": 1.0,
+                                  "dtg": 0, "geom": point(1, 2)}))
+        s1 = cache.snapshot()
+        assert s1 is cache.snapshot()  # clean -> same object
+        cache.apply(Change("f2", {"name": "b", "score": 2.0,
+                                  "dtg": 0, "geom": point(3, 4)}))
+        s2 = cache.snapshot()
+        assert s2 is not s1 and len(s2) == 2
+
+
+class TestKafkaDataStore:
+    def test_write_query_live(self):
+        ds = KafkaDataStore()
+        src = ds.create_schema(SFT)
+        batch = _batch(300)
+        src.write(batch)
+        res = src.get_features(Query("live", "BBOX(geom, -90, -45, 90, 45) AND score > 0"))
+        gc = batch.geometry
+        s = np.asarray(batch.column("score"))
+        want = int(np.sum((gc.x >= -90) & (gc.x <= 90) & (gc.y >= -45)
+                          & (gc.y <= 45) & (s > 0)))
+        assert len(res.features) == want
+        assert src.get_count("INCLUDE") == 300
+
+    def test_upsert_and_delete_via_topic(self):
+        ds = KafkaDataStore()
+        src = ds.create_schema(SFT)
+        src.write(_batch(10))
+        ds.delete("live", "f0")
+        assert src.get_count("INCLUDE") == 9
+        ds.clear("live")
+        assert src.get_count("INCLUDE") == 0
+
+    def test_two_consumers_one_broker(self):
+        broker = InProcessBroker()
+        writer = KafkaDataStore(broker=broker)
+        reader = KafkaDataStore(broker=broker)
+        writer.create_schema(SFT)
+        rsrc = reader.create_schema(SFT)
+        writer.write("live", _batch(25))
+        assert rsrc.get_count("INCLUDE") == 25
+
+    def test_density_hint_over_live(self):
+        from geomesa_tpu.plan.hints import QueryHints
+
+        ds = KafkaDataStore()
+        src = ds.create_schema(SFT)
+        src.write(_batch(100))
+        q = Query("live", "INCLUDE",
+                  hints=QueryHints(density_bbox=(-180, -90, 180, 90),
+                                   density_width=16, density_height=16))
+        res = src.get_features(q)
+        assert res.kind == "density"
+        assert res.grid.sum() == pytest.approx(100.0)
+
+
+class TestLambdaStore:
+    def test_two_tier_merge_and_persist(self, tmp_path):
+        from geomesa_tpu.lambda_store import LambdaDataStore
+
+        lds = LambdaDataStore(str(tmp_path / "cat"), persist_after_ms=60_000)
+        lds.create_schema(SFT)
+        lds.write("live", _batch(50, seed=1))
+        q = Query("live", "INCLUDE")
+        assert lds.get_count(q) == 50
+        # nothing old enough yet
+        assert lds.persist("live") == 0
+        # force-persist everything by pretending time passed
+        import time
+
+        n = lds.persist("live", now=time.time() + 120.0)
+        assert n == 50
+        assert lds.transient.cache("live").snapshot() is None
+        assert lds.get_count(q) == 50  # now served by the persistent tier
+
+    def test_transient_wins_on_fid(self, tmp_path):
+        from geomesa_tpu.lambda_store import LambdaDataStore
+
+        lds = LambdaDataStore(str(tmp_path / "cat"), persist_after_ms=0)
+        lds.create_schema(SFT)
+        b = _batch(5, seed=2)
+        lds.write("live", b)
+        import time
+
+        lds.persist("live", now=time.time() + 1.0)
+        # re-write f0 with a new score into the transient tier
+        upd = FeatureBatch.from_pydict(
+            SFT,
+            {"name": ["zz"], "score": [99.0], "dtg": [0], "geom": np.array([[1.0, 2.0]])},
+            fids=["f0"],
+        )
+        lds.write("live", upd)
+        res = lds.get_features(Query("live", "INCLUDE"))
+        assert len(res.features) == 5
+        fids = res.features.fids.decode()
+        scores = np.asarray(res.features.column("score"))
+        assert scores[fids.index("f0")] == pytest.approx(99.0)
