@@ -33,7 +33,9 @@ def register(sub: "argparse._SubParsersAction") -> None:
         [cat, feat,
          (["--spec", "-s"], {"required": True, "help": "SFT spec string"}),
          (["--partition-scheme"], {"default": None,
-          "help": "JSON scheme config (default: daily datetime)"})],
+          "help": "JSON scheme config (default: daily datetime)"}),
+         (["--encoding"], {"default": "parquet",
+          "choices": ["parquet", "orc"], "help": "file encoding"})],
     )
     cmd("get-type-names", "list feature types", _get_type_names, [cat])
     cmd("describe-schema", "show a feature type", _describe_schema, [cat, feat])
@@ -53,7 +55,8 @@ def register(sub: "argparse._SubParsersAction") -> None:
         [cat, feat, cql,
          (["--output", "-o"], {"default": "-", "help": "output path (- = stdout)"}),
          (["--format", "-F"], {"default": "csv",
-          "choices": ["csv", "tsv", "json", "arrow", "bin", "wkt"]}),
+          "choices": ["csv", "tsv", "json", "arrow", "bin", "wkt", "shp",
+                      "leaflet"]}),
          (["--attributes", "-a"], {"default": None, "help": "comma-sep projection"}),
          (["--max-features", "-m"], {"type": int, "default": None}),
          (["--bin-track"], {"default": None, "help": "track attr for bin format"})],
@@ -76,6 +79,11 @@ def register(sub: "argparse._SubParsersAction") -> None:
          (["--attribute", "-a"], {"required": True}),
          (["--k"], {"type": int, "default": 10})],
     )
+    cmd("manage-partitions", "list partitions and their files",
+        _manage_partitions, [cat, feat])
+    cmd("compact", "merge each partition's files into one", _compact,
+        [cat, feat,
+         (["--partition"], {"default": None, "help": "limit to one partition"})])
     cmd("env", "show system properties", _env, [])
 
 
@@ -102,7 +110,7 @@ def _create_schema(args) -> int:
         if args.partition_scheme
         else None
     )
-    _store(args).create_schema(sft, scheme)
+    _store(args).create_schema(sft, scheme, encoding=args.encoding)
     print(f"created schema {args.feature_name}")
     return 0
 
@@ -180,6 +188,24 @@ def _export(args) -> int:
     q = Query(args.feature_name, args.cql, attributes=attrs,
               max_features=args.max_features, hints=hints)
     r = src.get_features(q)
+    if args.format == "shp":
+        if args.output == "-":
+            raise ValueError("shp export needs --output (writes .shp/.shx/.dbf)")
+        from geomesa_tpu.convert.formats import write_shapefile
+
+        if r.features is None or len(r.features) == 0:
+            print("no features matched; nothing written", file=sys.stderr)
+            return 0
+        write_shapefile(args.output, r.features)
+        return 0
+    if args.format == "leaflet":
+        html = _leaflet_html(r.features, args.feature_name)
+        if args.output == "-":
+            sys.stdout.write(html)
+        else:
+            with open(args.output, "w") as f:
+                f.write(html)
+        return 0
     if args.output == "-":
         out = sys.stdout.buffer if binary else sys.stdout
     else:
@@ -267,6 +293,70 @@ def _write_text(out, batch, fmt):
         writer.writerow(names)
         for r in rows:
             writer.writerow([r[n] for n in names])
+
+
+def _leaflet_html(batch, title: str) -> str:
+    """Self-contained Leaflet HTML preview (geomesa-tools export -F leaflet
+    analog): embedded GeoJSON over CDN Leaflet assets."""
+    import numpy as np
+
+    from geomesa_tpu.core.columnar import GeometryColumn
+
+    features = []
+    if batch is not None and len(batch):
+        geom = batch.geometry
+        fids = batch.fids.decode() if batch.fids is not None else None
+        for i in range(len(batch)):
+            if isinstance(geom, GeometryColumn) and geom.is_point:
+                coords = [float(geom.x[i]), float(geom.y[i])]
+                gj = {"type": "Point", "coordinates": coords}
+            else:
+                g = geom.geometry(i)
+                gj = {
+                    "type": "Polygon" if "Polygon" in g.kind else "LineString",
+                    "coordinates": (
+                        [np.asarray(r).tolist() for r in g.rings]
+                        if "Polygon" in g.kind
+                        else np.asarray(g.rings[0]).tolist()
+                    ),
+                }
+            features.append({
+                "type": "Feature",
+                "id": fids[i] if fids else str(i),
+                "geometry": gj,
+                "properties": {},
+            })
+    collection = json.dumps({"type": "FeatureCollection", "features": features})
+    return f"""<!DOCTYPE html>
+<html><head><title>{title}</title>
+<link rel="stylesheet" href="https://unpkg.com/leaflet@1.9.4/dist/leaflet.css"/>
+<script src="https://unpkg.com/leaflet@1.9.4/dist/leaflet.js"></script>
+<style>#map {{ height: 100vh; }}</style></head>
+<body><div id="map"></div><script>
+var map = L.map('map').setView([0, 0], 2);
+L.tileLayer('https://tile.openstreetmap.org/{{z}}/{{x}}/{{y}}.png',
+            {{maxZoom: 19}}).addTo(map);
+var data = {collection};
+var layer = L.geoJSON(data).addTo(map);
+if (data.features.length) map.fitBounds(layer.getBounds());
+</script></body></html>
+"""
+
+
+def _manage_partitions(args) -> int:
+    storage = _store(args).get_feature_source(args.feature_name).storage
+    for name in storage.partitions():
+        files = storage.manifest.get(name, [])
+        count = sum(f["count"] for f in files)
+        print(f"{name}\t{len(files)} file(s)\t{count} feature(s)")
+    return 0
+
+
+def _compact(args) -> int:
+    storage = _store(args).get_feature_source(args.feature_name).storage
+    removed = storage.compact(args.partition)
+    print(f"compacted: {removed} file(s) merged")
+    return 0
 
 
 def _explain(args) -> int:

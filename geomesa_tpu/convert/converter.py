@@ -207,4 +207,14 @@ def converter_from_config(sft: SimpleFeatureType, config: dict):
         return DelimitedTextConverter(sft, config)
     if kind == "json":
         return JsonConverter(sft, config)
+    if kind in ("fixed-width", "xml", "shp", "avro"):
+        from geomesa_tpu.convert import formats
+
+        cls = {
+            "fixed-width": formats.FixedWidthConverter,
+            "xml": formats.XmlConverter,
+            "shp": formats.ShapefileConverter,
+            "avro": formats.AvroConverter,
+        }[kind]
+        return cls(sft, config)
     raise ValueError(f"unknown converter type {kind!r}")

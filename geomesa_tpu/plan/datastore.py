@@ -85,6 +85,7 @@ class DataStore:
         self,
         sft: SimpleFeatureType,
         scheme: Optional[PartitionScheme] = None,
+        encoding: str = "parquet",
     ) -> FeatureSource:
         if scheme is None:
             scheme = (
@@ -93,7 +94,7 @@ class DataStore:
                 else _default_spatial_scheme(sft)
             )
         storage = FileSystemStorage.create(
-            os.path.join(self.catalog, sft.name), sft, scheme
+            os.path.join(self.catalog, sft.name), sft, scheme, encoding
         )
         src = FeatureSource(storage, QueryPlanner(storage, self.audit, self.mesh))
         self._sources[sft.name] = src

@@ -1,0 +1,539 @@
+"""st_* spatial functions.
+
+Parity: geomesa-spark-jts o.l.g.spark.jts {constructors, accessors,
+predicates, processors} [upstream, unverified]. Semantics notes:
+
+- Predicates over point *columns* (NumPy arrays of x/y) are vectorized and
+  return boolean arrays — the columnar analog of a Spark UDF over a
+  geometry column. Geometry×Geometry forms take Geometry objects.
+- Planar predicates use lon/lat degrees as a flat plane, exactly like JTS
+  defaults upstream; spherical measures are the *Sphere variants.
+- Polygon×polygon intersects = bbox gate + (vertex containment either way
+  or any edge pair crossing): exact for simple polygons incl. holes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from geomesa_tpu.core.wkt import Geometry, parse_wkt, point as _mk_point, to_wkt
+from geomesa_tpu.engine.geodesy import EARTH_RADIUS_M, haversine_m_np
+from geomesa_tpu.engine.pip import points_in_polygon_np, polygon_edges
+
+ArrayLike = Union[np.ndarray, Sequence[float]]
+
+__all__ = [
+    "FUNCTIONS",
+    "register",
+    "st_area",
+    "st_asText",
+    "st_bbox",
+    "st_castToGeometry",
+    "st_centroid",
+    "st_contains",
+    "st_convexHull",
+    "st_crosses",
+    "st_disjoint",
+    "st_distance",
+    "st_distanceSphere",
+    "st_dwithin",
+    "st_envelope",
+    "st_equals",
+    "st_exteriorRing",
+    "st_geomFromText",
+    "st_geomFromWKT",
+    "st_geometryType",
+    "st_intersects",
+    "st_length",
+    "st_lengthSphere",
+    "st_makeBBOX",
+    "st_makeBox2D",
+    "st_makeLine",
+    "st_makePoint",
+    "st_makePolygon",
+    "st_numPoints",
+    "st_overlaps",
+    "st_point",
+    "st_pointN",
+    "st_touches",
+    "st_translate",
+    "st_within",
+    "st_x",
+    "st_y",
+]
+
+
+# ---------------------------------------------------------------------------
+# constructors
+
+
+def st_point(x: float, y: float) -> Geometry:
+    return _mk_point(float(x), float(y))
+
+
+st_makePoint = st_point
+
+
+def st_geomFromWKT(wkt: str) -> Geometry:
+    return parse_wkt(wkt)
+
+
+st_geomFromText = st_geomFromWKT
+
+
+def st_makeBBOX(xmin: float, ymin: float, xmax: float, ymax: float) -> Geometry:
+    ring = np.array(
+        [[xmin, ymin], [xmax, ymin], [xmax, ymax], [xmin, ymax], [xmin, ymin]],
+        np.float64,
+    )
+    return Geometry("Polygon", [ring])
+
+
+st_makeBox2D = st_makeBBOX
+
+
+def st_makeLine(points: Iterable[Geometry]) -> Geometry:
+    pts = np.array([p.point for p in points], np.float64)
+    return Geometry("LineString", [pts])
+
+
+def st_makePolygon(line: Geometry) -> Geometry:
+    ring = np.asarray(line.rings[0], np.float64)
+    if not np.array_equal(ring[0], ring[-1]):
+        ring = np.concatenate([ring, ring[:1]], axis=0)
+    return Geometry("Polygon", [ring])
+
+
+def st_castToGeometry(g: Geometry) -> Geometry:
+    return g
+
+
+# ---------------------------------------------------------------------------
+# accessors
+
+
+def st_x(g: Union[Geometry, ArrayLike]):
+    if isinstance(g, Geometry):
+        return g.point[0]
+    return np.asarray(g, np.float64)
+
+
+def st_y(g: Union[Geometry, ArrayLike]):
+    if isinstance(g, Geometry):
+        return g.point[1]
+    return np.asarray(g, np.float64)
+
+
+def st_envelope(g: Geometry) -> Geometry:
+    return st_makeBBOX(*g.bbox)
+
+
+def st_bbox(g: Geometry) -> Tuple[float, float, float, float]:
+    return g.bbox
+
+
+def st_exteriorRing(g: Geometry) -> Geometry:
+    if "Polygon" not in g.kind:
+        raise ValueError("st_exteriorRing expects a polygon")
+    ring = np.asarray(g.rings[0], np.float64)
+    return Geometry("LineString", [ring])
+
+
+def st_numPoints(g: Geometry) -> int:
+    return int(sum(len(r) for r in g.rings)) if g.rings else 1
+
+
+def st_pointN(g: Geometry, n: int) -> Geometry:
+    """1-based vertex of a line (negative counts from the end), per JTS."""
+    pts = np.asarray(g.rings[0], np.float64)
+    idx = n - 1 if n > 0 else len(pts) + n
+    return _mk_point(float(pts[idx, 0]), float(pts[idx, 1]))
+
+
+def st_geometryType(g: Geometry) -> str:
+    return g.kind
+
+
+def st_asText(g: Geometry) -> str:
+    return to_wkt(g)
+
+
+# ---------------------------------------------------------------------------
+# measures
+
+
+def st_area(g: Geometry) -> float:
+    """Planar (degree²) shoelace area; holes subtract (signed by ring
+    orientation normalization: exterior CCW positive, holes by |area| of
+    first ring minus the rest for simple polygons)."""
+    if "Polygon" not in g.kind and g.kind != "Geometry":
+        return 0.0
+    total = 0.0
+    for i, ring in enumerate(g.rings):
+        r = np.asarray(ring, np.float64)
+        if len(r) < 3:
+            continue
+        if not np.array_equal(r[0], r[-1]):
+            r = np.concatenate([r, r[:1]], axis=0)
+        a = 0.5 * abs(
+            float(np.sum(r[:-1, 0] * r[1:, 1] - r[1:, 0] * r[:-1, 1]))
+        )
+        # convention: first ring of each part is the shell; JTS areas treat
+        # subsequent rings as holes. Without per-part metadata, treat ring 0
+        # as shell and the rest as holes (single-polygon common case).
+        total += a if i == 0 else -a
+    return max(total, 0.0)
+
+
+def st_length(g: Geometry) -> float:
+    """Planar (degree) path length of line kinds; 0 for points/polygons
+    (JTS semantics: polygon length is the perimeter — matched for polygons)."""
+    if g.is_point:
+        return 0.0
+    close = "Polygon" in g.kind
+    total = 0.0
+    for ring in g.rings:
+        r = np.asarray(ring, np.float64)
+        if close and not np.array_equal(r[0], r[-1]):
+            r = np.concatenate([r, r[:1]], axis=0)
+        d = np.diff(r, axis=0)
+        total += float(np.sum(np.hypot(d[:, 0], d[:, 1])))
+    return total
+
+
+def st_lengthSphere(g: Geometry) -> float:
+    """Great-circle (meters) path length of a line."""
+    if g.is_point:
+        return 0.0
+    total = 0.0
+    for ring in g.rings:
+        r = np.asarray(ring, np.float64)
+        if len(r) < 2:
+            continue
+        total += float(
+            np.sum(haversine_m_np(r[:-1, 0], r[:-1, 1], r[1:, 0], r[1:, 1]))
+        )
+    return total
+
+
+def st_centroid(g: Geometry) -> Geometry:
+    if g.is_point:
+        return g
+    if "Polygon" in g.kind:
+        # area-weighted centroid of the shell (ring 0)
+        r = np.asarray(g.rings[0], np.float64)
+        if not np.array_equal(r[0], r[-1]):
+            r = np.concatenate([r, r[:1]], axis=0)
+        cross = r[:-1, 0] * r[1:, 1] - r[1:, 0] * r[:-1, 1]
+        a = float(np.sum(cross)) / 2.0
+        if abs(a) < 1e-300:
+            return _mk_point(float(r[:-1, 0].mean()), float(r[:-1, 1].mean()))
+        cx = float(np.sum((r[:-1, 0] + r[1:, 0]) * cross)) / (6.0 * a)
+        cy = float(np.sum((r[:-1, 1] + r[1:, 1]) * cross)) / (6.0 * a)
+        return _mk_point(cx, cy)
+    pts = np.concatenate([np.asarray(r, np.float64) for r in g.rings], axis=0)
+    return _mk_point(float(pts[:, 0].mean()), float(pts[:, 1].mean()))
+
+
+def st_distance(a: Geometry, b: Geometry) -> float:
+    """Planar (degree) min distance between two geometries."""
+    if a.is_point and b.is_point:
+        ax, ay = a.point
+        bx, by = b.point
+        return math.hypot(ax - bx, ay - by)
+    if st_intersects(a, b):
+        return 0.0
+    return min(
+        _min_vertex_to_edges(a, b),
+        _min_vertex_to_edges(b, a),
+    )
+
+
+def st_distanceSphere(a: Geometry, b: Geometry) -> float:
+    """Great-circle (meters); exact for point×point, vertex-sampled
+    otherwise (documented approximation)."""
+    if a.is_point and b.is_point:
+        ax, ay = a.point
+        bx, by = b.point
+        return float(haversine_m_np(ax, ay, bx, by))
+    if st_intersects(a, b):
+        return 0.0
+    av = _vertices(a)
+    bv = _vertices(b)
+    d = haversine_m_np(
+        av[:, None, 0], av[:, None, 1], bv[None, :, 0], bv[None, :, 1]
+    )
+    return float(np.min(d))
+
+
+# ---------------------------------------------------------------------------
+# predicates
+
+
+def st_contains(a: Geometry, b: Union[Geometry, ArrayLike], y: Optional[ArrayLike] = None):
+    """contains(a, b) — b strictly inside a.
+
+    Columnar form: st_contains(poly, x_array, y_array) -> bool[N]."""
+    if y is not None:
+        return points_in_polygon_np(np.asarray(b, np.float64), np.asarray(y, np.float64), a)
+    assert isinstance(b, Geometry)
+    if b.is_point:
+        x, yy = b.point
+        return bool(points_in_polygon_np([x], [yy], a)[0])
+    # every vertex of b inside a, and no boundary crossing
+    bv = _vertices(b)
+    if not bool(np.all(points_in_polygon_np(bv[:, 0], bv[:, 1], a))):
+        return False
+    return not _edges_cross(a, b)
+
+
+def st_within(a: Union[Geometry, ArrayLike], b: Geometry, y: Optional[ArrayLike] = None):
+    """within(a, b) — a inside b. Columnar: st_within(x, y_arrays..., poly)
+    is spelled st_within(x_array, poly, y_array) for symmetry with
+    st_contains; prefer the Geometry×Geometry form in user code."""
+    if y is not None:
+        return points_in_polygon_np(np.asarray(a, np.float64), np.asarray(y, np.float64), b)
+    assert isinstance(a, Geometry)
+    return st_contains(b, a)
+
+
+def st_intersects(a: Geometry, b: Union[Geometry, ArrayLike], y: Optional[ArrayLike] = None):
+    if y is not None:
+        return points_in_polygon_np(np.asarray(b, np.float64), np.asarray(y, np.float64), a)
+    assert isinstance(b, Geometry)
+    abox, bbox_ = a.bbox, b.bbox
+    if abox[0] > bbox_[2] or abox[2] < bbox_[0] or abox[1] > bbox_[3] or abox[3] < bbox_[1]:
+        return False
+    if a.is_point:
+        return st_contains(b, a) if not b.is_point else a.point == b.point
+    if b.is_point:
+        return st_contains(a, b)
+    av = _vertices(a)
+    bv = _vertices(b)
+    if "Polygon" in b.kind or b.kind == "Geometry":
+        if bool(np.any(points_in_polygon_np(av[:, 0], av[:, 1], b))):
+            return True
+    if "Polygon" in a.kind or a.kind == "Geometry":
+        if bool(np.any(points_in_polygon_np(bv[:, 0], bv[:, 1], a))):
+            return True
+    return _edges_cross(a, b)
+
+
+def st_disjoint(a: Geometry, b: Geometry) -> bool:
+    return not st_intersects(a, b)
+
+
+def st_equals(a: Geometry, b: Geometry) -> bool:
+    if a.is_point and b.is_point:
+        return a.point == b.point
+    return a == b
+
+
+def st_crosses(a: Geometry, b: Geometry) -> bool:
+    """Line×polygon / line×line crossing (boundary interiors intersect)."""
+    return _edges_cross(a, b)
+
+
+def st_touches(a: Geometry, b: Geometry) -> bool:
+    """Boundaries meet but interiors do not (approximated as: intersects,
+    no vertex of either strictly inside the other)."""
+    if not st_intersects(a, b):
+        return False
+    # interior evidence: vertices AND edge midpoints (a vertex can land
+    # exactly on the other's boundary while an edge runs through its
+    # interior — midpoints catch that)
+    av = _sample_points(a)
+    bv = _sample_points(b)
+    inside_a = (
+        np.any(_strictly_inside(bv, a)) if ("Polygon" in a.kind) else False
+    )
+    inside_b = (
+        np.any(_strictly_inside(av, b)) if ("Polygon" in b.kind) else False
+    )
+    return not (bool(inside_a) or bool(inside_b))
+
+
+def st_overlaps(a: Geometry, b: Geometry) -> bool:
+    """Interiors overlap but neither contains the other (polygon×polygon)."""
+    if not st_intersects(a, b):
+        return False
+    return not st_contains(a, b) and not st_contains(b, a) and not st_touches(a, b)
+
+
+def st_dwithin(
+    a: Geometry,
+    b: Union[Geometry, ArrayLike],
+    dist_or_y=None,
+    dist: Optional[float] = None,
+    meters: bool = False,
+):
+    """dwithin(a, b, d) planar degrees by default; meters=True -> haversine.
+
+    Columnar: st_dwithin(point_geom, x_array, y_array, dist=d, meters=...)."""
+    if dist is not None and not isinstance(b, Geometry):
+        x = np.asarray(b, np.float64)
+        yy = np.asarray(dist_or_y, np.float64)
+        ax, ay = a.point
+        if meters:
+            return haversine_m_np(x, yy, ax, ay) <= dist
+        return np.hypot(x - ax, yy - ay) <= dist
+    d = float(dist_or_y)
+    if meters:
+        return st_distanceSphere(a, b) <= d
+    return st_distance(a, b) <= d
+
+
+# ---------------------------------------------------------------------------
+# processors
+
+
+def st_translate(g: Geometry, dx: float, dy: float) -> Geometry:
+    if g.is_point:
+        x, y = g.point
+        return _mk_point(x + dx, y + dy)
+    rings = [np.asarray(r, np.float64) + np.array([dx, dy]) for r in g.rings]
+    return Geometry(g.kind, rings)
+
+
+def st_convexHull(g: Geometry) -> Geometry:
+    """Monotone-chain convex hull of all vertices."""
+    pts = _vertices(g)
+    pts = np.unique(pts, axis=0)
+    if len(pts) <= 2:
+        return Geometry("LineString", [pts]) if len(pts) == 2 else _mk_point(
+            float(pts[0, 0]), float(pts[0, 1])
+        )
+    order = np.lexsort((pts[:, 1], pts[:, 0]))
+    p = pts[order]
+
+    def half(points):
+        out: List[np.ndarray] = []
+        for pt in points:
+            while len(out) >= 2:
+                u = out[-1] - out[-2]
+                v = pt - out[-2]
+                if u[0] * v[1] - u[1] * v[0] <= 0:  # 2D cross product
+                    out.pop()
+                else:
+                    break
+            out.append(pt)
+        return out
+
+    lower = half(p)
+    upper = half(p[::-1])
+    hull = np.asarray(lower[:-1] + upper[:-1] + [lower[0]], np.float64)
+    return Geometry("Polygon", [hull])
+
+
+# ---------------------------------------------------------------------------
+# internals
+
+
+def _vertices(g: Geometry) -> np.ndarray:
+    if g.is_point:
+        return np.asarray([g.point], np.float64)
+    return np.concatenate([np.asarray(r, np.float64) for r in g.rings], axis=0)
+
+
+def _edges(g: Geometry):
+    return polygon_edges(g)
+
+
+def _edges_cross(a: Geometry, b: Geometry) -> bool:
+    ax1, ay1, ax2, ay2 = _edges(a)
+    bx1, by1, bx2, by2 = _edges(b)
+    if len(ax1) == 0 or len(bx1) == 0:
+        return False
+    # orientation-based proper/improper segment intersection, all pairs
+    def orient(ox, oy, px, py, qx, qy):
+        return (px - ox) * (qy - oy) - (py - oy) * (qx - ox)
+
+    o1 = orient(ax1[:, None], ay1[:, None], ax2[:, None], ay2[:, None], bx1[None, :], by1[None, :])
+    o2 = orient(ax1[:, None], ay1[:, None], ax2[:, None], ay2[:, None], bx2[None, :], by2[None, :])
+    o3 = orient(bx1[None, :], by1[None, :], bx2[None, :], by2[None, :], ax1[:, None], ay1[:, None])
+    o4 = orient(bx1[None, :], by1[None, :], bx2[None, :], by2[None, :], ax2[:, None], ay2[:, None])
+    proper = (np.sign(o1) * np.sign(o2) < 0) & (np.sign(o3) * np.sign(o4) < 0)
+    if bool(np.any(proper)):
+        return True
+    # collinear touching endpoints
+    def on_seg(ox, oy, px, py, qx, qy, o):
+        return (
+            (o == 0)
+            & (np.minimum(ox, px) - 1e-12 <= qx)
+            & (qx <= np.maximum(ox, px) + 1e-12)
+            & (np.minimum(oy, py) - 1e-12 <= qy)
+            & (qy <= np.maximum(oy, py) + 1e-12)
+        )
+
+    t = (
+        on_seg(ax1[:, None], ay1[:, None], ax2[:, None], ay2[:, None], bx1[None, :], by1[None, :], o1)
+        | on_seg(ax1[:, None], ay1[:, None], ax2[:, None], ay2[:, None], bx2[None, :], by2[None, :], o2)
+        | on_seg(bx1[None, :], by1[None, :], bx2[None, :], by2[None, :], ax1[:, None], ay1[:, None], o3)
+        | on_seg(bx1[None, :], by1[None, :], bx2[None, :], by2[None, :], ax2[:, None], ay2[:, None], o4)
+    )
+    return bool(np.any(t))
+
+
+def _sample_points(g: Geometry) -> np.ndarray:
+    """Vertices plus edge midpoints (boundary sample for interior tests)."""
+    v = _vertices(g)
+    x1, y1, x2, y2 = _edges(g)
+    if len(x1) == 0:
+        return v
+    mid = np.stack([(x1 + x2) / 2.0, (y1 + y2) / 2.0], axis=1)
+    return np.concatenate([v, mid], axis=0)
+
+
+def _strictly_inside(pts: np.ndarray, g: Geometry, eps: float = 1e-12) -> np.ndarray:
+    """Interior test excluding the boundary: crossing-number AND min
+    distance to any edge > eps (the half-open crossing rule alone counts
+    some on-boundary points as inside)."""
+    inside = points_in_polygon_np(pts[:, 0], pts[:, 1], g)
+    if not np.any(inside):
+        return inside
+    x1, y1, x2, y2 = _edges(g)
+    px = pts[:, None, 0]
+    py = pts[:, None, 1]
+    ex = (x2 - x1)[None, :]
+    ey = (y2 - y1)[None, :]
+    denom = np.where(ex * ex + ey * ey == 0, 1.0, ex * ex + ey * ey)
+    t = np.clip(((px - x1[None, :]) * ex + (py - y1[None, :]) * ey) / denom, 0.0, 1.0)
+    d = np.min(np.hypot(px - (x1[None, :] + t * ex), py - (y1[None, :] + t * ey)), axis=1)
+    return inside & (d > eps)
+
+
+def _min_vertex_to_edges(a: Geometry, b: Geometry) -> float:
+    """Min planar distance from a's vertices to b's edges (or vertices)."""
+    av = _vertices(a)
+    bx1, by1, bx2, by2 = _edges(b)
+    if len(bx1) == 0:
+        bv = _vertices(b)
+        d = np.hypot(av[:, None, 0] - bv[None, :, 0], av[:, None, 1] - bv[None, :, 1])
+        return float(np.min(d))
+    px = av[:, None, 0]
+    py = av[:, None, 1]
+    ex = (bx2 - bx1)[None, :]
+    ey = (by2 - by1)[None, :]
+    denom = np.where(ex * ex + ey * ey == 0, 1.0, ex * ex + ey * ey)
+    t = np.clip(((px - bx1[None, :]) * ex + (py - by1[None, :]) * ey) / denom, 0.0, 1.0)
+    cx = bx1[None, :] + t * ex
+    cy = by1[None, :] + t * ey
+    return float(np.min(np.hypot(px - cx, py - cy)))
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+FUNCTIONS = {
+    name: obj
+    for name, obj in list(globals().items())
+    if name.startswith("st_") and callable(obj)
+}
+
+
+def register() -> dict:
+    """name -> callable table (the UDF-registration analog)."""
+    return dict(FUNCTIONS)

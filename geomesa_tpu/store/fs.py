@@ -127,12 +127,21 @@ def _table_to_batch(t: pa.Table, sft: SimpleFeatureType) -> FeatureBatch:
 
 
 class FileSystemStorage:
-    """A partitioned Parquet feature store."""
+    """A partitioned Parquet (or ORC) feature store."""
 
-    def __init__(self, root: str, sft: SimpleFeatureType, scheme: PartitionScheme):
+    def __init__(
+        self,
+        root: str,
+        sft: SimpleFeatureType,
+        scheme: PartitionScheme,
+        encoding: str = "parquet",
+    ):
+        if encoding not in ("parquet", "orc"):
+            raise ValueError(f"unknown encoding {encoding!r}")
         self.root = root
         self.sft = sft
         self.scheme = scheme
+        self.encoding = encoding
         # manifest: partition -> list of {"file", "count"}
         self.manifest: Dict[str, List[dict]] = {}
 
@@ -140,12 +149,16 @@ class FileSystemStorage:
 
     @classmethod
     def create(
-        cls, root: str, sft: SimpleFeatureType, scheme: PartitionScheme
+        cls,
+        root: str,
+        sft: SimpleFeatureType,
+        scheme: PartitionScheme,
+        encoding: str = "parquet",
     ) -> "FileSystemStorage":
         os.makedirs(root, exist_ok=True)
         if os.path.exists(os.path.join(root, METADATA)):
             raise FileExistsError(f"storage already exists at {root}")
-        store = cls(root, sft, scheme)
+        store = cls(root, sft, scheme, encoding)
         store._save_metadata()
         return store
 
@@ -154,7 +167,12 @@ class FileSystemStorage:
         with open(os.path.join(root, METADATA)) as f:
             meta = json.load(f)
         sft = SimpleFeatureType.from_spec(meta["name"], meta["spec"])
-        store = cls(root, sft, scheme_from_config(meta["scheme"]))
+        store = cls(
+            root,
+            sft,
+            scheme_from_config(meta["scheme"]),
+            meta.get("encoding", "parquet"),
+        )
         store.manifest = meta.get("manifest", {})
         return store
 
@@ -164,6 +182,7 @@ class FileSystemStorage:
             "name": self.sft.name,
             "spec": self.sft.to_spec(),
             "scheme": self.scheme.to_config(),
+            "encoding": self.encoding,
             "manifest": self.manifest,
         }
         tmp = os.path.join(self.root, METADATA + ".tmp")
@@ -188,17 +207,58 @@ class FileSystemStorage:
             sub = batch.select(names == name)
             pdir = os.path.join(self.root, name)
             os.makedirs(pdir, exist_ok=True)
-            fname = f"{uuid.uuid4().hex}.parquet"
-            pq.write_table(
-                _batch_to_table(sub),
-                os.path.join(pdir, fname),
-                compression="zstd",
-                row_group_size=64 * 1024,
-            )
+            fname = f"{uuid.uuid4().hex}.{self.encoding}"
+            if self.encoding == "orc":
+                from pyarrow import orc
+
+                orc.write_table(
+                    self._decode_dictionaries(_batch_to_table(sub)),
+                    os.path.join(pdir, fname),
+                    compression="zstd",
+                )
+            else:
+                pq.write_table(
+                    _batch_to_table(sub),
+                    os.path.join(pdir, fname),
+                    compression="zstd",
+                    row_group_size=64 * 1024,
+                )
             self.manifest.setdefault(name, []).append(
                 {"file": fname, "count": len(sub)}
             )
         self._save_metadata()
+
+    def compact(self, partition: Optional[str] = None) -> int:
+        """Merge each touched partition's files into one (the FS store's
+        compact command). Returns how many files were removed."""
+        targets = [partition] if partition is not None else list(self.manifest)
+        removed = 0
+        for name in targets:
+            entries = self.manifest.get(name, [])
+            if len(entries) <= 1:
+                continue
+            tables = []
+            for entry in entries:
+                path = os.path.join(self.root, name, entry["file"])
+                tables.append(self._read_file(path, None, None))
+            merged = pa.concat_tables(tables, promote_options="permissive")
+            count = sum(e["count"] for e in entries)
+            fname = f"{uuid.uuid4().hex}.{self.encoding}"
+            out = os.path.join(self.root, name, fname)
+            if self.encoding == "orc":
+                from pyarrow import orc
+
+                orc.write_table(self._decode_dictionaries(merged), out,
+                               compression="zstd")
+            else:
+                pq.write_table(merged, out, compression="zstd",
+                               row_group_size=64 * 1024)
+            for entry in entries:
+                os.remove(os.path.join(self.root, name, entry["file"]))
+                removed += 1
+            self.manifest[name] = [{"file": fname, "count": count}]
+        self._save_metadata()
+        return removed
 
     # -- read --------------------------------------------------------------
 
@@ -281,9 +341,9 @@ class FileSystemStorage:
                 cols = phys_cols
                 if phys_cols is not None:
                     # include fids only when the file actually has them
-                    schema_names = pq.read_schema(path).names
+                    schema_names = self._file_schema_names(path)
                     cols = phys_cols + ([FID] if FID in schema_names else [])
-                t = pq.read_table(path, filters=expr, columns=cols)
+                t = self._read_file(path, expr, cols)
                 if not len(t):
                     continue
                 # geomesa.scan.batch.size bounds per-yield rows so one huge
@@ -296,6 +356,39 @@ class FileSystemStorage:
                 else:
                     for off in range(0, len(t), target):
                         yield _table_to_batch(t.slice(off, target), self.sft)
+
+    @staticmethod
+    def _decode_dictionaries(table: pa.Table) -> pa.Table:
+        """ORC has no dictionary type: cast dict columns to their value
+        type (the read path re-encodes into DictColumn)."""
+        fields = []
+        arrays = []
+        for field in table.schema:
+            col = table.column(field.name)
+            if pa.types.is_dictionary(field.type):
+                col = col.cast(field.type.value_type)
+                field = pa.field(field.name, field.type.value_type)
+            fields.append(field)
+            arrays.append(col)
+        return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+    def _file_schema_names(self, path: str) -> List[str]:
+        if self.encoding == "orc":
+            from pyarrow import orc
+
+            return orc.ORCFile(path).schema.names
+        return pq.read_schema(path).names
+
+    def _read_file(self, path: str, expr, cols):
+        """Read one data file with predicate + column pushdown. Parquet uses
+        row-group statistics natively; ORC goes through pyarrow.dataset for
+        stripe-level filtering (the geomesa-fs-storage-orc analog)."""
+        if self.encoding == "orc":
+            import pyarrow.dataset as pads
+
+            dataset = pads.dataset(path, format="orc")
+            return dataset.to_table(filter=expr, columns=cols)
+        return pq.read_table(path, filters=expr, columns=cols)
 
     def read_all(self) -> Optional[FeatureBatch]:
         batches = list(self.scan())

@@ -1,0 +1,137 @@
+"""Converter formats: fixed-width, XML, shapefile round-trip, Avro gate."""
+
+import io
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert.converter import converter_from_config
+from geomesa_tpu.convert.formats import (
+    ShapefileConverter,
+    read_shapefile,
+    write_shapefile,
+)
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+
+
+class TestFixedWidth:
+    def test_basic(self):
+        sft = SimpleFeatureType.from_spec("fw", "name:String,*geom:Point")
+        config = {
+            "type": "fixed-width",
+            "fields": [
+                {"name": "name", "start": 0, "width": 5},
+                {"name": "lat", "start": 5, "width": 6, "transform": "$0::double"},
+                {"name": "lon", "start": 11, "width": 7, "transform": "$0::double"},
+                {"name": "geom", "transform": "point($lon, $lat)"},
+            ],
+        }
+        text = "alpha 48.85   2.35\nbeta  29.90 -90.10\n"
+        conv = converter_from_config(sft, config)
+        batch = conv.convert(io.StringIO(text))
+        assert len(batch) == 2
+        assert batch.column("name").decode() == ["alpha", "beta"]
+        np.testing.assert_allclose(batch.geometry.y, [48.85, 29.9])
+        np.testing.assert_allclose(batch.geometry.x, [2.35, -90.1])
+
+    def test_skip_lines(self):
+        sft = SimpleFeatureType.from_spec("fw", "*geom:Point")
+        config = {
+            "type": "fixed-width",
+            "options": {"skip-lines": 1},
+            "fields": [
+                {"name": "x", "start": 0, "width": 4, "transform": "$0::double"},
+                {"name": "y", "start": 4, "width": 4, "transform": "$0::double"},
+                {"name": "geom", "transform": "point($x, $y)"},
+            ],
+        }
+        batch = converter_from_config(sft, config).convert(
+            io.StringIO("XXYY\n1.0 2.0\n")
+        )
+        assert len(batch) == 1
+
+
+class TestXml:
+    XML = """<doc>
+      <row id="a"><props><name>alpha</name></props><lon>2.35</lon><lat>48.85</lat></row>
+      <row id="b"><props><name>beta</name></props><lon>-90.1</lon><lat>29.9</lat></row>
+    </doc>"""
+
+    def test_paths_and_attrs(self):
+        sft = SimpleFeatureType.from_spec("x", "rid:String,name:String,*geom:Point")
+        config = {
+            "type": "xml",
+            "feature-path": "doc/row",
+            "fields": [
+                {"name": "rid", "path": "@id"},
+                {"name": "name", "path": "props/name"},
+                {"name": "lon", "path": "lon", "transform": "$0::double"},
+                {"name": "lat", "path": "lat", "transform": "$0::double"},
+                {"name": "geom", "transform": "point($lon, $lat)"},
+            ],
+            "id-field": "$rid",
+        }
+        batch = converter_from_config(sft, config).convert(io.StringIO(self.XML))
+        assert len(batch) == 2
+        assert batch.fids.decode() == ["a", "b"]
+        assert batch.column("name").decode() == ["alpha", "beta"]
+        np.testing.assert_allclose(batch.geometry.x, [2.35, -90.1])
+
+    def test_missing_path_is_null(self):
+        sft = SimpleFeatureType.from_spec("x", "name:String,*geom:Point")
+        config = {
+            "type": "xml",
+            "feature-path": "doc/row",
+            "fields": [
+                {"name": "name", "path": "props/nope",
+                 "transform": "withDefault($0, 'UNK')"},
+                {"name": "lon", "path": "lon", "transform": "$0::double"},
+                {"name": "lat", "path": "lat", "transform": "$0::double"},
+                {"name": "geom", "transform": "point($lon, $lat)"},
+            ],
+        }
+        batch = converter_from_config(sft, config).convert(io.StringIO(self.XML))
+        assert batch.column("name").decode() == ["UNK", "UNK"]
+
+
+class TestShapefile:
+    def test_point_round_trip(self, tmp_path):
+        sft = SimpleFeatureType.from_spec("s", "name:String,score:Double,*geom:Point")
+        batch = FeatureBatch.from_pydict(
+            sft,
+            {
+                "name": ["alpha", "beta", "gamma"],
+                "score": [1.5, -2.25, 0.0],
+                "geom": np.array([[2.35, 48.85], [-90.1, 29.9], [0.0, 0.0]]),
+            },
+        )
+        path = str(tmp_path / "pts.shp")
+        write_shapefile(path, batch)
+        recs = list(read_shapefile(path))
+        assert len(recs) == 3
+        assert recs[0].geometry.point == (2.35, 48.85)
+        assert recs[0].attributes["name"] == "alpha"
+        assert recs[1].attributes["score"] == pytest.approx(-2.25)
+
+    def test_converter_facade(self, tmp_path):
+        sft = SimpleFeatureType.from_spec("s", "name:String,score:Double,*geom:Point")
+        batch = FeatureBatch.from_pydict(
+            sft,
+            {"name": ["a", "b"], "score": [1.0, 2.0],
+             "geom": np.array([[1.0, 2.0], [3.0, 4.0]])},
+        )
+        path = str(tmp_path / "pts.shp")
+        write_shapefile(path, batch)
+        conv = ShapefileConverter(sft, {"type": "shp"})
+        out = conv.convert(path)
+        assert len(out) == 2
+        assert out.column("name").decode() == ["a", "b"]
+        np.testing.assert_allclose(out.geometry.x, [1.0, 3.0])
+
+
+class TestAvroGate:
+    def test_raises_clearly(self):
+        sft = SimpleFeatureType.from_spec("a", "*geom:Point")
+        with pytest.raises(ImportError, match="[Aa]vro"):
+            converter_from_config(sft, {"type": "avro"})

@@ -1,0 +1,109 @@
+"""ORC encoding, compaction, and the partition-management CLI surface."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.plan.datastore import DataStore
+from geomesa_tpu.plan.query import Query
+from geomesa_tpu.store.fs import FileSystemStorage
+from geomesa_tpu.store.partition import DateTimeScheme
+
+SFT = SimpleFeatureType.from_spec(
+    "t", "name:String,score:Double,dtg:Date,*geom:Point"
+)
+
+
+def _batch(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    return FeatureBatch.from_pydict(
+        SFT,
+        {
+            "name": rng.choice(["a", "b"], n).tolist(),
+            "score": rng.uniform(-5, 5, n),
+            "dtg": rng.integers(1_590_000_000_000, 1_590_400_000_000, n),
+            "geom": np.stack([rng.uniform(-60, 60, n), rng.uniform(-45, 45, n)], 1),
+        },
+        fids=[f"f{i}" for i in range(n)],
+    )
+
+
+class TestOrc:
+    def test_round_trip_and_query(self, tmp_path):
+        ds = DataStore(str(tmp_path / "cat"))
+        src = ds.create_schema(SFT, encoding="orc")
+        batch = _batch(200)
+        src.write(batch)
+        # reload from disk: encoding persists in metadata
+        ds2 = DataStore(str(tmp_path / "cat"))
+        src2 = ds2.get_feature_source("t")
+        assert src2.storage.encoding == "orc"
+        res = src2.get_features(Query("t", "BBOX(geom, -30, -20, 30, 20) AND score > 0"))
+        gc = batch.geometry
+        s = np.asarray(batch.column("score"))
+        want = int(np.sum((gc.x >= -30) & (gc.x <= 30) & (gc.y >= -20)
+                          & (gc.y <= 20) & (s > 0)))
+        assert len(res.features) == want
+
+    def test_bad_encoding_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="encoding"):
+            FileSystemStorage.create(
+                str(tmp_path / "x"), SFT, DateTimeScheme(dtg_attr="dtg"), "feather"
+            )
+
+
+class TestCompact:
+    @pytest.mark.parametrize("encoding", ["parquet", "orc"])
+    def test_compact_preserves_data(self, tmp_path, encoding):
+        ds = DataStore(str(tmp_path / "cat"))
+        src = ds.create_schema(SFT, encoding=encoding)
+        for seed in range(3):  # three writes -> three files per partition
+            src.write(_batch(50, seed=seed))
+        storage = src.storage
+        multi = [p for p in storage.partitions()
+                 if len(storage.manifest[p]) > 1]
+        assert multi, "expected multi-file partitions"
+        before = src.get_count("INCLUDE")
+        removed = storage.compact()
+        assert removed > 0
+        assert all(len(v) == 1 for v in storage.manifest.values())
+        assert src.get_count("INCLUDE") == before
+        # reload sees the compacted manifest
+        ds2 = DataStore(str(tmp_path / "cat"))
+        assert ds2.get_feature_source("t").get_count("INCLUDE") == before
+
+
+class TestCli:
+    def test_manage_partitions_and_compact(self, tmp_path, capsys):
+        from geomesa_tpu.cli.main import main
+
+        cat = str(tmp_path / "cat")
+        ds = DataStore(cat)
+        src = ds.create_schema(SFT)
+        src.write(_batch(40, seed=0))
+        src.write(_batch(40, seed=1))
+        assert main(["manage-partitions", "-c", cat, "-f", "t"]) == 0
+        out = capsys.readouterr().out
+        assert "file(s)" in out
+        assert main(["compact", "-c", cat, "-f", "t"]) == 0
+        out = capsys.readouterr().out
+        assert "compacted" in out
+
+    def test_export_shp_and_leaflet(self, tmp_path):
+        from geomesa_tpu.cli.main import main
+        from geomesa_tpu.convert.formats import read_shapefile
+
+        cat = str(tmp_path / "cat")
+        ds = DataStore(cat)
+        src = ds.create_schema(SFT)
+        src.write(_batch(20))
+        shp = str(tmp_path / "out.shp")
+        assert main(["export", "-c", cat, "-f", "t", "-F", "shp",
+                     "-o", shp]) == 0
+        assert len(list(read_shapefile(shp))) == 20
+        html = str(tmp_path / "out.html")
+        assert main(["export", "-c", cat, "-f", "t", "-F", "leaflet",
+                     "-o", html, "-m", "5"]) == 0
+        text = open(html).read()
+        assert "leaflet" in text and "FeatureCollection" in text

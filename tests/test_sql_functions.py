@@ -1,0 +1,150 @@
+"""st_* spatial SQL function tests (geomesa-spark-jts parity surface)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import sql
+from geomesa_tpu.core.wkt import parse_wkt
+
+
+SQUARE = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")
+HOLED = parse_wkt(
+    "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), (4 4, 6 4, 6 6, 4 6, 4 4))"
+)
+LINE = parse_wkt("LINESTRING (0 0, 3 4)")
+
+
+class TestConstructorsAccessors:
+    def test_point(self):
+        p = sql.st_point(2.0, 3.0)
+        assert (sql.st_x(p), sql.st_y(p)) == (2.0, 3.0)
+        assert sql.st_geometryType(p) == "Point"
+
+    def test_bbox_and_envelope(self):
+        b = sql.st_makeBBOX(0, 0, 4, 2)
+        assert sql.st_bbox(b) == (0, 0, 4, 2)
+        assert sql.st_bbox(sql.st_envelope(SQUARE)) == (0, 0, 10, 10)
+
+    def test_wkt_round_trip(self):
+        g = sql.st_geomFromWKT("POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))")
+        assert sql.st_geomFromText(sql.st_asText(g)) == g
+
+    def test_line_builders(self):
+        pts = [sql.st_point(0, 0), sql.st_point(1, 0), sql.st_point(1, 1)]
+        line = sql.st_makeLine(pts)
+        assert sql.st_numPoints(line) == 3
+        assert sql.st_pointN(line, 2).point == (1.0, 0.0)
+        assert sql.st_pointN(line, -1).point == (1.0, 1.0)
+        poly = sql.st_makePolygon(line)
+        assert "Polygon" in sql.st_geometryType(poly)
+
+
+class TestMeasures:
+    def test_area(self):
+        assert sql.st_area(SQUARE) == pytest.approx(100.0)
+        assert sql.st_area(HOLED) == pytest.approx(96.0)
+        assert sql.st_area(LINE) == 0.0
+
+    def test_length(self):
+        assert sql.st_length(LINE) == pytest.approx(5.0)
+        assert sql.st_length(SQUARE) == pytest.approx(40.0)  # perimeter
+
+    def test_length_sphere(self):
+        # 1 degree of longitude at the equator ~ 111.19 km
+        l = parse_wkt("LINESTRING (0 0, 1 0)")
+        assert sql.st_lengthSphere(l) == pytest.approx(111_195, rel=1e-3)
+
+    def test_centroid(self):
+        c = sql.st_centroid(SQUARE)
+        assert c.point == (pytest.approx(5.0), pytest.approx(5.0))
+
+    def test_distance(self):
+        a = sql.st_point(0, 0)
+        b = sql.st_point(3, 4)
+        assert sql.st_distance(a, b) == pytest.approx(5.0)
+        # point to polygon edge
+        p = sql.st_point(15, 5)
+        assert sql.st_distance(p, SQUARE) == pytest.approx(5.0)
+        assert sql.st_distance(sql.st_point(5, 5), SQUARE) == 0.0
+
+    def test_distance_sphere(self):
+        paris = sql.st_point(2.35, 48.85)
+        london = sql.st_point(-0.1257, 51.5074)
+        assert sql.st_distanceSphere(paris, london) == pytest.approx(
+            343_000, rel=0.02
+        )
+
+
+class TestPredicates:
+    def test_contains_point(self):
+        assert sql.st_contains(SQUARE, sql.st_point(5, 5))
+        assert not sql.st_contains(SQUARE, sql.st_point(15, 5))
+        assert not sql.st_contains(HOLED, sql.st_point(5, 5))  # in the hole
+
+    def test_contains_columnar(self):
+        xs = np.array([5.0, 15.0, 5.0])
+        ys = np.array([5.0, 5.0, 5.0])
+        m = sql.st_contains(SQUARE, xs, ys)
+        assert m.tolist() == [True, False, True]
+        mh = sql.st_contains(HOLED, xs, ys)
+        assert mh.tolist() == [False, False, False]
+
+    def test_within(self):
+        inner = parse_wkt("POLYGON ((2 2, 4 2, 4 4, 2 4, 2 2))")
+        assert sql.st_within(inner, SQUARE)
+        assert not sql.st_within(SQUARE, inner)
+
+    def test_intersects_disjoint(self):
+        other = parse_wkt("POLYGON ((5 5, 15 5, 15 15, 5 15, 5 5))")
+        far = parse_wkt("POLYGON ((20 20, 30 20, 30 30, 20 30, 20 20))")
+        assert sql.st_intersects(SQUARE, other)
+        assert sql.st_disjoint(SQUARE, far)
+        assert sql.st_intersects(SQUARE, LINE)
+
+    def test_crossing_polygons_without_contained_vertices(self):
+        # a tall thin rect crossing a wide flat rect: no vertex of either
+        # inside the other — only the edge test catches this
+        tall = parse_wkt("POLYGON ((4 -5, 6 -5, 6 15, 4 15, 4 -5))")
+        assert sql.st_intersects(SQUARE, tall)
+        assert sql.st_crosses(SQUARE, tall)
+
+    def test_touches_overlaps(self):
+        adjacent = parse_wkt("POLYGON ((10 0, 20 0, 20 10, 10 10, 10 0))")
+        overlapping = parse_wkt("POLYGON ((5 0, 15 0, 15 10, 5 10, 5 0))")
+        assert sql.st_touches(SQUARE, adjacent)
+        assert not sql.st_overlaps(SQUARE, adjacent)
+        assert sql.st_overlaps(SQUARE, overlapping)
+
+    def test_dwithin(self):
+        a = sql.st_point(0, 0)
+        assert sql.st_dwithin(a, sql.st_point(3, 4), 5.01)
+        assert not sql.st_dwithin(a, sql.st_point(3, 4), 4.99)
+        xs = np.array([0.0, 1.0])
+        ys = np.array([0.0, 1.0])
+        m = sql.st_dwithin(a, xs, ys, dist=1.0)
+        assert m.tolist() == [True, False]
+        mm = sql.st_dwithin(a, xs, ys, dist=200_000.0, meters=True)
+        assert mm.tolist() == [True, True]
+
+    def test_equals(self):
+        assert sql.st_equals(SQUARE, parse_wkt(sql.st_asText(SQUARE)))
+        assert not sql.st_equals(SQUARE, HOLED)
+
+
+class TestProcessors:
+    def test_translate(self):
+        t = sql.st_translate(sql.st_point(1, 2), 2, 3)
+        assert t.point == (3.0, 5.0)
+        ts = sql.st_translate(SQUARE, 1, 1)
+        assert sql.st_bbox(ts) == (1, 1, 11, 11)
+
+    def test_convex_hull(self):
+        cloud = parse_wkt("MULTIPOINT ((0 0), (4 0), (4 4), (0 4), (2 2), (1 1))")
+        hull = sql.st_convexHull(cloud)
+        assert sql.st_area(hull) == pytest.approx(16.0)
+        assert sql.st_contains(hull, sql.st_point(2, 2))
+
+    def test_registry(self):
+        fns = sql.register()
+        assert "st_contains" in fns and fns["st_point"](1, 2).point == (1.0, 2.0)
+        assert len(fns) >= 30
