@@ -298,8 +298,6 @@ def _write_text(out, batch, fmt):
 def _leaflet_html(batch, title: str) -> str:
     """Self-contained Leaflet HTML preview (geomesa-tools export -F leaflet
     analog): embedded GeoJSON over CDN Leaflet assets."""
-    import numpy as np
-
     from geomesa_tpu.core.columnar import GeometryColumn
 
     features = []
@@ -311,15 +309,9 @@ def _leaflet_html(batch, title: str) -> str:
                 coords = [float(geom.x[i]), float(geom.y[i])]
                 gj = {"type": "Point", "coordinates": coords}
             else:
-                g = geom.geometry(i)
-                gj = {
-                    "type": "Polygon" if "Polygon" in g.kind else "LineString",
-                    "coordinates": (
-                        [np.asarray(r).tolist() for r in g.rings]
-                        if "Polygon" in g.kind
-                        else np.asarray(g.rings[0]).tolist()
-                    ),
-                }
+                from geomesa_tpu.core.wkt import to_geojson
+
+                gj = to_geojson(geom.geometry(i))
             features.append({
                 "type": "Feature",
                 "id": fids[i] if fids else str(i),

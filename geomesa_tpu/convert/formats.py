@@ -163,7 +163,11 @@ def read_shapefile(path: str) -> Iterator[ShapefileRecord]:
             break
         (shape_type,) = struct.unpack("<i", content[0:4])
         geom = _parse_shape(shape_type, content)
-        attrs = dbf_rows[i] if dbf_rows is not None and i < len(dbf_rows) else {}
+        attrs = (
+            dbf_rows[i]
+            if dbf_rows is not None and i < len(dbf_rows) and dbf_rows[i] is not None
+            else {}
+        )
         if geom is not None:
             yield ShapefileRecord(geom, attrs)
         i += 1
@@ -214,7 +218,8 @@ def _read_dbf(path: str) -> List[Dict[str, object]]:
             break
         rec = data[off : off + record_len]
         off += record_len
-        if rec[0:1] == b"*":  # deleted
+        if rec[0:1] == b"*":  # deleted: keep a placeholder so .shp record
+            rows.append(None)  # ordinals stay aligned with dbf ordinals
             continue
         row: Dict[str, object] = {}
         pos = 1
@@ -342,12 +347,23 @@ def _write_dbf(path: str, batch: FeatureBatch) -> None:
             cols.append((a.name[:10], "C", width, 0, vals))
         else:
             arr = np.asarray(col)
-            vals = [str(v) for v in arr.tolist()]
-            width = max(1, min(32, max((len(v) for v in vals), default=1)))
             dec = 6 if arr.dtype.kind == "f" else 0
             if dec:
-                vals = [f"{float(v):.6f}"[:width].rjust(width) for v in arr.tolist()]
-                width = max(width, max(len(v) for v in vals))
+                # render first, size the field after: fixed-point when it
+                # fits the 32-char N cap AND preserves the value; else
+                # %.10g (≤17 chars, always fits; dbf readers parse either)
+                def fmt(x: float) -> str:
+                    s = f"{x:.6f}"
+                    if len(s) > 32 or (
+                        x != 0.0 and abs(float(s) - x) > 1e-9 * abs(x)
+                    ):
+                        s = f"{x:.10g}"
+                    return s
+
+                vals = [fmt(float(v)) for v in arr.tolist()]
+            else:
+                vals = [str(v) for v in arr.tolist()]
+            width = max(1, min(32, max((len(v) for v in vals), default=1)))
             cols.append((a.name[:10], "N", width, dec, vals))
     n = len(batch)
     record_len = 1 + sum(w for _, _, w, _, _ in cols)

@@ -234,3 +234,31 @@ def to_wkt(g: Geometry) -> str:
             i += n
         return "MULTIPOLYGON (" + ", ".join(out) + ")"
     raise ValueError(f"cannot encode {g.kind}")
+
+
+def to_geojson(g: Geometry) -> dict:
+    """GeoJSON geometry object; Geometry.parts groups MultiPolygon rings."""
+
+    def ring(r) -> list:
+        return np.asarray(r, np.float64).tolist()
+
+    if g.kind == "Point":
+        x, y = g.point
+        return {"type": "Point", "coordinates": [float(x), float(y)]}
+    if g.kind == "MultiPoint":
+        pts = np.concatenate([np.asarray(r, np.float64) for r in g.rings], axis=0)
+        return {"type": "MultiPoint", "coordinates": pts.tolist()}
+    if g.kind == "LineString":
+        return {"type": "LineString", "coordinates": ring(g.rings[0])}
+    if g.kind == "MultiLineString" or (g.kind == "LineString" and len(g.rings) > 1):
+        return {"type": "MultiLineString", "coordinates": [ring(r) for r in g.rings]}
+    if g.kind == "Polygon":
+        return {"type": "Polygon", "coordinates": [ring(r) for r in g.rings]}
+    if g.kind == "MultiPolygon":
+        polys, i = [], 0
+        for n in g.parts:
+            polys.append([ring(r) for r in g.rings[i : i + n]])
+            i += n
+        return {"type": "MultiPolygon", "coordinates": polys}
+    # GeometryCollection-ish fallback: emit each part as a polygon ring list
+    return {"type": "MultiLineString", "coordinates": [ring(r) for r in g.rings]}

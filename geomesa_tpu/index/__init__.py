@@ -1,0 +1,40 @@
+"""Key-value index layer: keyspaces, adapter SPI, and the in-memory backend.
+
+Parity: geomesa-index-api's index catalog + IndexAdapter SPI + the
+TestGeoMesaDataStore in-memory reference backend (SURVEY.md C7, C9-C11, §4)
+[upstream, unverified]. This is the row-key architecture the reference runs
+on Accumulo/HBase/Cassandra/Redis; here one sorted-KV adapter contract backs
+all index types, and the in-memory implementation doubles as the test oracle
+backend exactly as upstream's TestGeoMesaDataStore does.
+"""
+
+from geomesa_tpu.index.adapter import IndexAdapter, MemoryIndexAdapter
+from geomesa_tpu.index.keyspace import (
+    AttributeIndex,
+    IdIndex,
+    IndexKeySpace,
+    XZ2Index,
+    XZ3Index,
+    Z2Index,
+    Z3Index,
+    default_indices,
+)
+from geomesa_tpu.index.kvstore import KVDataStore, KVFeatureSource
+from geomesa_tpu.index.splitter import FilterSplitter, StrategyDecider
+
+__all__ = [
+    "IndexAdapter",
+    "MemoryIndexAdapter",
+    "IndexKeySpace",
+    "Z3Index",
+    "Z2Index",
+    "XZ2Index",
+    "XZ3Index",
+    "IdIndex",
+    "AttributeIndex",
+    "default_indices",
+    "FilterSplitter",
+    "StrategyDecider",
+    "KVDataStore",
+    "KVFeatureSource",
+]

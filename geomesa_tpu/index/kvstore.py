@@ -1,0 +1,271 @@
+"""KVDataStore: the index-architecture datastore over an IndexAdapter.
+
+Parity: GeoMesaDataStore over a KV backend — the Accumulo/HBase-shaped
+path (SURVEY.md §3.1/§3.2): writes fan out to every enabled index's key
+schema; reads run FilterSplitter -> StrategyDecider -> range scan ->
+residual compiled-mask evaluation on device -> local runner. With the
+MemoryIndexAdapter this is also the TestGeoMesaDataStore analog (§4): the
+full planner/index/aggregation stack with no cluster.
+
+Differences from the FS store (plan/datastore.py): the FS store prunes
+*partitions* (file layout); this store scans *key ranges* (row layout) —
+the two index disciplines of the reference, both ending in the same device
+residual + aggregation pipeline (plan/runner.py).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from geomesa_tpu.core.columnar import DictColumn, FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.cql import ast, compile_filter
+from geomesa_tpu.index.adapter import IndexAdapter, MemoryIndexAdapter
+from geomesa_tpu.index.keyspace import IndexKeySpace, default_indices
+from geomesa_tpu.index.splitter import FilterSplitter, StrategyDecider
+from geomesa_tpu.plan.explain import Explainer
+from geomesa_tpu.plan.query import Query
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class KVFeatureSource:
+    def __init__(
+        self,
+        sft: SimpleFeatureType,
+        adapter: IndexAdapter,
+        indices: Sequence[IndexKeySpace],
+        coord_dtype=None,
+    ):
+        self.sft = sft
+        self.adapter = adapter
+        self.indices = list(indices)
+        self.splitter = FilterSplitter(self.indices)
+        self.decider = StrategyDecider(adapter)
+        self.coord_dtype = coord_dtype
+        for idx in self.indices:
+            adapter.create_index(getattr(idx, "full_name", idx.name))
+        # row storage: append-only batches with cumulative offsets
+        self._batches: List[FeatureBatch] = []
+        self._fids: List[List[str]] = []
+        self._offsets: List[int] = [0]
+        self._fid_row: Dict[str, int] = {}
+        self._dead: set = set()
+        self._seq = 0
+
+    # -- writes ------------------------------------------------------------
+
+    def write(self, batch: FeatureBatch, fids: Optional[Sequence[str]] = None) -> List[str]:
+        """Index + store a batch; same-fid writes replace (upstream:
+        idempotent same-key overwrite, §5.3). Returns the feature ids."""
+        n = len(batch)
+        if fids is None:
+            fids = batch.fids.decode() if batch.fids is not None else None
+        if fids is None:
+            fids = [f"{self.sft.name}-{self._seq + i}" for i in range(n)]
+        fids = [str(f) for f in fids]
+        self._seq += n
+
+        # replace-by-id: tombstone + de-index any previous row per fid
+        stale = [self._fid_row[f] for f in fids if f in self._fid_row]
+        if stale:
+            self._delete_rows(stale)
+
+        base = self._offsets[-1]
+        rows = list(range(base, base + n))
+        self._batches.append(batch)
+        self._fids.append(list(fids))
+        self._offsets.append(base + n)
+        for i, f in enumerate(fids):
+            self._fid_row[f] = base + i
+        for idx in self.indices:
+            name = getattr(idx, "full_name", idx.name)
+            self.adapter.write(name, idx.write_keys(batch, fids, rows))
+        return list(fids)
+
+    def _locate(self, row: int):
+        b = bisect.bisect_right(self._offsets, row) - 1
+        return b, row - self._offsets[b]
+
+    def _delete_rows(self, rows: Sequence[int]) -> None:
+        by_batch: Dict[int, List[int]] = {}
+        for r in rows:
+            if r in self._dead:
+                continue
+            b, i = self._locate(r)
+            by_batch.setdefault(b, []).append(i)
+            self._dead.add(r)
+        for b, local in by_batch.items():
+            sel = self._batches[b].select(np.asarray(sorted(local)))
+            fids = [self._fids[b][i] for i in sorted(local)]
+            rows_abs = [self._offsets[b] + i for i in sorted(local)]
+            for idx in self.indices:
+                name = getattr(idx, "full_name", idx.name)
+                keys = [wk.key for wk in idx.write_keys(sel, fids, rows_abs)]
+                self.adapter.delete(name, keys)
+            for f in fids:
+                if self._fid_row.get(f) in rows_abs:
+                    del self._fid_row[f]
+
+    def delete_features(self, query: "Query | str") -> int:
+        """Delete everything matching the filter (upstream delete-features)."""
+        r = self.get_features(query if not isinstance(query, str)
+                              else Query(self.sft.name, query))
+        if r.features is None or len(r.features) == 0:
+            return 0
+        fids = r.features.fids.decode() if r.features.fids is not None else []
+        rows = [self._fid_row[f] for f in fids if f in self._fid_row]
+        self._delete_rows(rows)
+        return len(rows)
+
+    # -- reads -------------------------------------------------------------
+
+    @property
+    def live_count(self) -> int:
+        return self._offsets[-1] - len(self._dead)
+
+    def _all_rows(self) -> List[int]:
+        return [r for r in range(self._offsets[-1]) if r not in self._dead]
+
+    def _gather(self, rows: Sequence[int]) -> FeatureBatch:
+        by_batch: Dict[int, List[int]] = {}
+        for r in sorted(rows):
+            b, i = self._locate(r)
+            by_batch.setdefault(b, []).append(i)
+        parts = []
+        for b in sorted(by_batch):
+            idx = np.asarray(by_batch[b])
+            sel = self._batches[b].select(idx)
+            sel = FeatureBatch(
+                sel.sft, sel.columns,
+                DictColumn.encode([self._fids[b][i] for i in by_batch[b]]),
+                sel.valid,
+            )
+            parts.append(sel)
+        return FeatureBatch.concat(parts)
+
+    def plan(self, query: "Query | str", explain: Optional[Explainer] = None):
+        if isinstance(query, str):
+            query = Query(self.sft.name, query)
+        e = explain if explain is not None else Explainer()
+        f = query.filter_ast
+        e(f"Planning KV query: {ast.to_cql(f)}")
+        options = self.splitter.options(f)
+        e(f"Index options: {[o.name for o in options] or 'none (full scan)'}")
+        chosen = self.decider.decide(options, query.hints.query_index, e)
+        if chosen is not None:
+            e(f"Chosen index: {chosen.name} with {len(chosen.ranges)} ranges "
+              f"(~{chosen.cost} keys)")
+        return query, f, chosen
+
+    def explain(self, query: "Query | str") -> str:
+        e = Explainer()
+        self.plan(query, e)
+        return e.render()
+
+    def get_features(self, query: "Query | str" = "INCLUDE"):
+        from geomesa_tpu.engine.device import to_device
+        from geomesa_tpu.plan.planner import QueryResult
+        from geomesa_tpu.plan.runner import aggregate, sample_mask
+
+        query, f, chosen = self.plan(query)
+        if chosen is not None:
+            name = chosen.name
+            rows = [
+                r for r in self.adapter.scan(name, chosen.ranges)
+                if r not in self._dead
+            ]
+        else:
+            rows = self._all_rows()
+        if not rows:
+            return QueryResult("features", features=None, count=0)
+
+        batch = self._gather(rows)
+        padded = batch.pad_to(_next_pow2(len(batch)))
+        dev = to_device(padded, **(
+            {"coord_dtype": self.coord_dtype} if self.coord_dtype else {}
+        ))
+        if isinstance(f, ast.Include):
+            mask = np.asarray(dev["__valid__"])
+        else:
+            residual = f
+            if query.hints.loose_bbox:
+                from geomesa_tpu.plan.planner import _loosen_bbox
+
+                g = self.sft.default_geometry
+                if g is not None:
+                    residual = _loosen_bbox(f, g.name)
+            compiled = compile_filter(residual, self.sft)
+            mask = np.asarray(compiled.mask(dev, padded))
+        if query.hints.sampling:
+            groups = None
+            if query.hints.sample_by:
+                from geomesa_tpu.core.columnar import DictColumn
+
+                col = padded.columns[query.hints.sample_by]
+                groups = (
+                    np.asarray(col.codes)
+                    if isinstance(col, DictColumn)
+                    else np.asarray(col)
+                )
+            mask = sample_mask(mask, query.hints.sampling, groups)
+        return aggregate(self.sft, padded, dev, mask, query)
+
+    def get_count(self, query: "Query | str" = "INCLUDE") -> int:
+        if isinstance(query, str):
+            query = Query(self.sft.name, query)
+        if not query.hints.exact_count and isinstance(query.filter_ast, ast.Include):
+            return self.live_count
+        r = self.get_features(query)
+        if r.kind == "features":
+            return len(r.features) if r.features is not None else 0
+        return r.count
+
+    def get_features_by_id(self, fids: Sequence[str]) -> FeatureBatch:
+        rows = [self._fid_row[f] for f in fids if f in self._fid_row]
+        return self._gather(rows) if rows else FeatureBatch(
+            self.sft, {a.name: np.zeros(0) for a in self.sft.attributes}, [], None
+        )
+
+
+class KVDataStore:
+    """A catalog of KV-indexed feature types (in-memory by default)."""
+
+    def __init__(self, adapter_factory=MemoryIndexAdapter, shards: int = 4):
+        self._adapter_factory = adapter_factory
+        self._shards = shards
+        self._sources: Dict[str, KVFeatureSource] = {}
+
+    def create_schema(
+        self,
+        sft: SimpleFeatureType,
+        indices: Optional[Sequence[IndexKeySpace]] = None,
+    ) -> KVFeatureSource:
+        if sft.name in self._sources:
+            raise ValueError(f"schema {sft.name!r} already exists")
+        adapter = self._adapter_factory()
+        if indices is None:
+            indices = default_indices(sft, self._shards)
+        src = KVFeatureSource(sft, adapter, indices)
+        self._sources[sft.name] = src
+        return src
+
+    def get_feature_source(self, name: str) -> KVFeatureSource:
+        return self._sources[name]
+
+    def get_schema(self, name: str) -> SimpleFeatureType:
+        return self._sources[name].sft
+
+    def get_type_names(self) -> List[str]:
+        return sorted(self._sources)
+
+    def remove_schema(self, name: str) -> None:
+        del self._sources[name]

@@ -1,0 +1,209 @@
+"""Local query runner: aggregation push-down + feature post-processing.
+
+Parity: geomesa-index-api LocalQueryRunner + the shared aggregating scans'
+reduce steps (SURVEY.md C6/C8) [upstream, unverified]. Shared by every
+store: the FS/Parquet planner and the KV-index datastore both end a scan
+here — batch + device arrays + residual mask in, QueryResult out. This is
+the exact separability the reference proves with its "local fallback"
+architecture (C11 lesson).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from geomesa_tpu.core.columnar import DictColumn, FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.curve.binned_time import TimePeriod, to_binned_time
+
+if TYPE_CHECKING:
+    from geomesa_tpu.plan.query import Query
+
+
+def aggregate(sft: SimpleFeatureType, batch, dev, mask: np.ndarray, query: "Query"):
+    """Dispatch on hints: density / stats / bin aggregation, else features."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.plan.planner import QueryResult
+
+    hints = query.hints
+    g = sft.default_geometry
+
+    if hints.is_density:
+        from geomesa_tpu.engine.density import density_grid
+
+        w = (
+            dev[hints.density_weight].astype(jnp.float32)
+            if hints.density_weight
+            else jnp.ones(len(batch), jnp.float32)
+        )
+        grid = density_grid(
+            dev[f"{g.name}__x"],
+            dev[f"{g.name}__y"],
+            w,
+            jnp.asarray(mask),
+            tuple(hints.density_bbox),
+            hints.density_width,
+            hints.density_height,
+        )
+        return QueryResult("density", grid=np.asarray(grid), count=int(mask.sum()))
+
+    if hints.is_stats:
+        stats = run_stats(batch, dev, mask, hints.stats_string)
+        return QueryResult("stats", stats=stats, count=int(mask.sum()))
+
+    if hints.is_bin:
+        from geomesa_tpu.engine.bin import bin_pack, encode_bin
+
+        def track_codes(name):
+            col = batch.columns[name]
+            return (
+                jnp.asarray(col.codes)
+                if isinstance(col, DictColumn)
+                else jnp.asarray(np.asarray(col), jnp.int32)
+            )
+
+        d = sft.default_dtg
+        dtg = dev[d.name] if d else jnp.zeros(len(batch), jnp.int64)
+        label = track_codes(hints.bin_label) if hints.bin_label else None
+        packed = bin_pack(
+            track_codes(hints.bin_track),
+            dtg,
+            dev[f"{g.name}__y"],
+            dev[f"{g.name}__x"],
+            label=label,
+        )
+        return QueryResult(
+            "bin",
+            bin_bytes=encode_bin(packed, np.nonzero(mask)[0]),
+            count=int(mask.sum()),
+        )
+
+    # plain feature results
+    sel = batch.select(np.nonzero(mask)[0])
+    if query.sort_by:
+        order = sort_order(sel, query.sort_by)
+        sel = sel.select(order)
+    if query.max_features is not None and len(sel) > query.max_features:
+        sel = sel.select(np.arange(query.max_features))
+    if query.attributes is not None:
+        sel = project(sel, query.attributes)
+    return QueryResult("features", features=sel, count=len(sel))
+
+
+def run_stats(batch, dev, mask: np.ndarray, expression: str):
+    """Evaluate a Stat DSL expression over the masked batch on device."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.engine import stats as est
+    from geomesa_tpu.stats import parse_stats
+    from geomesa_tpu.stats.sketches import (
+        Cardinality,
+        DescriptiveStats,
+        EnumerationStat,
+        Frequency,
+        Histogram,
+        MinMax,
+        TopK,
+        Z3HistogramStat,
+    )
+
+    seq = parse_stats(expression)
+    jmask = jnp.asarray(mask)
+    for s in seq.stats:
+        if isinstance(s, Z3HistogramStat):
+            col = batch.columns[s.dtg]
+            bins, _ = to_binned_time(np.asarray(col), TimePeriod.parse(s.period))
+            ub = np.unique(bins)
+            # one kernel call over contiguous remapped bin indices
+            remap = {int(b): i for i, b in enumerate(ub)}
+            tb = np.vectorize(remap.__getitem__, otypes=[np.int32])(bins)
+            grids = est.z3_histogram(
+                dev[f"{s.geom}__x"], dev[f"{s.geom}__y"],
+                jnp.asarray(tb), jmask, len(ub), s.bins_per_dim,
+            )
+            grids = np.asarray(grids)
+            for i, b in enumerate(ub):
+                s.observe_grid(int(b), grids[i])
+            continue
+        col = batch.columns.get(s.attribute) if s.attribute else None
+        if isinstance(s, (TopK, EnumerationStat, Frequency)) and isinstance(col, DictColumn):
+            counts = np.asarray(
+                est.masked_value_counts(
+                    jnp.asarray(col.codes), jmask, max(len(col.vocab), 1)
+                )
+            )
+            s.observe_counts(col.vocab, counts[: len(col.vocab)])
+        elif isinstance(s, MinMax) and col is not None and not isinstance(col, DictColumn):
+            if mask.any():
+                mn, mx = est.masked_minmax(jnp.asarray(col), jmask)
+                s.observe(np.array([float(mn), float(mx)]))
+        elif isinstance(s, Histogram) and col is not None:
+            h = est.masked_histogram(jnp.asarray(col), jmask, s.lo, s.hi, s.bins)
+            s.observe_counts(np.asarray(h))
+        elif isinstance(s, DescriptiveStats):
+            if s.attribute and col is not None and not isinstance(col, DictColumn):
+                c, sm, ssq = est.masked_moments(jnp.asarray(col), jmask)
+                s.observe_moments(int(c), float(sm), float(ssq))
+            else:  # Count()
+                s.observe_moments(int(mask.sum()), 0.0, 0.0)
+        elif isinstance(s, Cardinality) and isinstance(col, DictColumn):
+            # distinct codes present under the mask (exact for dict cols)
+            counts = np.asarray(
+                est.masked_value_counts(
+                    jnp.asarray(col.codes), jmask, max(len(col.vocab), 1)
+                )
+            )
+            present = [v for v, c in zip(col.vocab, counts) if c > 0]
+            s.observe(np.asarray(present, dtype=object))
+        else:  # host fallback (e.g. MinMax over strings)
+            if isinstance(col, DictColumn):
+                vals = np.asarray(col.decode(), dtype=object)
+                sel = vals[mask]
+                s.observe(sel[sel != None])  # noqa: E711
+            elif col is not None:
+                s.observe(np.asarray(col), mask)
+    return seq
+
+
+def sort_order(batch: FeatureBatch, sort_by) -> np.ndarray:
+    keys = []
+    for attr, ascending in reversed(list(sort_by)):
+        col = batch.columns[attr]
+        v = (
+            np.asarray(col.codes)
+            if isinstance(col, DictColumn)
+            else np.asarray(col)
+        )
+        if isinstance(col, DictColumn):
+            # order codes by value text for a true lexicographic sort
+            rank = np.argsort(np.argsort(np.asarray(col.vocab, dtype=object)))
+            v = np.where(v >= 0, rank[np.clip(v, 0, None)], -1)
+        keys.append(v if ascending else -v)
+    order = np.lexsort(keys) if keys else np.arange(len(batch))
+    return order
+
+
+def project(batch: FeatureBatch, attributes) -> FeatureBatch:
+    attrs = [batch.sft.attribute(a) for a in attributes]
+    sft = SimpleFeatureType(batch.sft.name, attrs, batch.sft.user_data)
+    cols = {a.name: batch.columns[a.name] for a in attrs}
+    return FeatureBatch(sft, cols, batch.fids, batch.valid)
+
+
+def sample_mask(
+    mask: np.ndarray, n: int, groups=None
+) -> np.ndarray:
+    """Keep every n-th matching feature; with `groups`, every n-th within
+    each group (SAMPLE_BY semantics: per-track thinning)."""
+    out = np.zeros_like(mask)
+    if groups is None:
+        idx = np.nonzero(mask)[0]
+        out[idx[::n]] = True
+        return out
+    for gval in np.unique(groups[mask]):
+        idx = np.nonzero(mask & (groups == gval))[0]
+        out[idx[::n]] = True
+    return out

@@ -165,26 +165,29 @@ def st_asText(g: Geometry) -> str:
 # measures
 
 
+def _ring_shoelace(ring) -> float:
+    """|shoelace area| of one closed-or-open ring (0 if degenerate)."""
+    r = np.asarray(ring, np.float64)
+    if len(r) < 3:
+        return 0.0
+    if not np.array_equal(r[0], r[-1]):
+        r = np.concatenate([r, r[:1]], axis=0)
+    return 0.5 * abs(float(np.sum(r[:-1, 0] * r[1:, 1] - r[1:, 0] * r[:-1, 1])))
+
+
 def st_area(g: Geometry) -> float:
-    """Planar (degree²) shoelace area; holes subtract (signed by ring
-    orientation normalization: exterior CCW positive, holes by |area| of
-    first ring minus the rest for simple polygons)."""
+    """Planar (degree²) shoelace area. Geometry.parts gives the ring count
+    per part; within each part, ring 0 is the shell (adds) and the rest
+    are holes (subtract) — JTS area semantics for (Multi)Polygons."""
     if "Polygon" not in g.kind and g.kind != "Geometry":
         return 0.0
     total = 0.0
-    for i, ring in enumerate(g.rings):
-        r = np.asarray(ring, np.float64)
-        if len(r) < 3:
-            continue
-        if not np.array_equal(r[0], r[-1]):
-            r = np.concatenate([r, r[:1]], axis=0)
-        a = 0.5 * abs(
-            float(np.sum(r[:-1, 0] * r[1:, 1] - r[1:, 0] * r[:-1, 1]))
-        )
-        # convention: first ring of each part is the shell; JTS areas treat
-        # subsequent rings as holes. Without per-part metadata, treat ring 0
-        # as shell and the rest as holes (single-polygon common case).
-        total += a if i == 0 else -a
+    ri = 0
+    for nrings in g.parts:
+        for j in range(nrings):
+            a = _ring_shoelace(g.rings[ri])
+            ri += 1
+            total += a if j == 0 else -a
     return max(total, 0.0)
 
 
@@ -223,17 +226,34 @@ def st_centroid(g: Geometry) -> Geometry:
     if g.is_point:
         return g
     if "Polygon" in g.kind:
-        # area-weighted centroid of the shell (ring 0)
-        r = np.asarray(g.rings[0], np.float64)
-        if not np.array_equal(r[0], r[-1]):
-            r = np.concatenate([r, r[:1]], axis=0)
-        cross = r[:-1, 0] * r[1:, 1] - r[1:, 0] * r[:-1, 1]
-        a = float(np.sum(cross)) / 2.0
-        if abs(a) < 1e-300:
-            return _mk_point(float(r[:-1, 0].mean()), float(r[:-1, 1].mean()))
-        cx = float(np.sum((r[:-1, 0] + r[1:, 0]) * cross)) / (6.0 * a)
-        cy = float(np.sum((r[:-1, 1] + r[1:, 1]) * cross)) / (6.0 * a)
-        return _mk_point(cx, cy)
+        # area-weighted centroid over all parts; holes carry negative weight
+        wsum = cxsum = cysum = 0.0
+        ri = 0
+        for nrings in g.parts:
+            for j in range(nrings):
+                r = np.asarray(g.rings[ri], np.float64)
+                ri += 1
+                if len(r) < 3:
+                    continue
+                if not np.array_equal(r[0], r[-1]):
+                    r = np.concatenate([r, r[:1]], axis=0)
+                cross = r[:-1, 0] * r[1:, 1] - r[1:, 0] * r[:-1, 1]
+                a = abs(float(np.sum(cross)) / 2.0)
+                if a < 1e-300:
+                    continue
+                sgn = float(np.sign(np.sum(cross))) or 1.0
+                cx = float(np.sum((r[:-1, 0] + r[1:, 0]) * cross)) / (6.0 * (a * sgn))
+                cy = float(np.sum((r[:-1, 1] + r[1:, 1]) * cross)) / (6.0 * (a * sgn))
+                w = a if j == 0 else -a
+                wsum += w
+                cxsum += w * cx
+                cysum += w * cy
+        if abs(wsum) < 1e-300:
+            pts = np.concatenate(
+                [np.asarray(r, np.float64) for r in g.rings], axis=0
+            )
+            return _mk_point(float(pts[:, 0].mean()), float(pts[:, 1].mean()))
+        return _mk_point(cxsum / wsum, cysum / wsum)
     pts = np.concatenate([np.asarray(r, np.float64) for r in g.rings], axis=0)
     return _mk_point(float(pts[:, 0].mean()), float(pts[:, 1].mean()))
 
@@ -339,7 +359,8 @@ def st_crosses(a: Geometry, b: Geometry) -> bool:
 
 def st_touches(a: Geometry, b: Geometry) -> bool:
     """Boundaries meet but interiors do not (approximated as: intersects,
-    no vertex of either strictly inside the other)."""
+    no vertex of either strictly inside the other, and — for line pairs —
+    no proper edge crossing or collinear overlap)."""
     if not st_intersects(a, b):
         return False
     # interior evidence: vertices AND edge midpoints (a vertex can land
@@ -353,7 +374,14 @@ def st_touches(a: Geometry, b: Geometry) -> bool:
     inside_b = (
         np.any(_strictly_inside(av, b)) if ("Polygon" in b.kind) else False
     )
-    return not (bool(inside_a) or bool(inside_b))
+    if bool(inside_a) or bool(inside_b):
+        return False
+    if "Polygon" not in a.kind and "Polygon" not in b.kind:
+        # line×line: interiors intersect when edges properly cross or
+        # overlap collinearly — either refutes "touches"
+        if _edges_properly_cross(a, b):
+            return False
+    return True
 
 
 def st_overlaps(a: Geometry, b: Geometry) -> bool:
@@ -442,12 +470,17 @@ def _edges(g: Geometry):
     return polygon_edges(g)
 
 
-def _edges_cross(a: Geometry, b: Geometry) -> bool:
+def _edge_orientations(a: Geometry, b: Geometry):
+    """All-pairs segment orientation tests between a's and b's edges.
+
+    Returns None when either has no edges; else (o1, o2, o3, o4, coords)
+    where coords = (ax1, ay1, ax2, ay2, bx1, by1, bx2, by2) broadcastable
+    [A, B] orientation signs."""
     ax1, ay1, ax2, ay2 = _edges(a)
     bx1, by1, bx2, by2 = _edges(b)
     if len(ax1) == 0 or len(bx1) == 0:
-        return False
-    # orientation-based proper/improper segment intersection, all pairs
+        return None
+
     def orient(ox, oy, px, py, qx, qy):
         return (px - ox) * (qy - oy) - (py - oy) * (qx - ox)
 
@@ -455,6 +488,39 @@ def _edges_cross(a: Geometry, b: Geometry) -> bool:
     o2 = orient(ax1[:, None], ay1[:, None], ax2[:, None], ay2[:, None], bx2[None, :], by2[None, :])
     o3 = orient(bx1[None, :], by1[None, :], bx2[None, :], by2[None, :], ax1[:, None], ay1[:, None])
     o4 = orient(bx1[None, :], by1[None, :], bx2[None, :], by2[None, :], ax2[:, None], ay2[:, None])
+    return o1, o2, o3, o4, (ax1, ay1, ax2, ay2, bx1, by1, bx2, by2)
+
+
+def _edges_properly_cross(a: Geometry, b: Geometry) -> bool:
+    """True when segment *interiors* intersect: a strict crossing, or a
+    collinear pair overlapping over positive length."""
+    os_ = _edge_orientations(a, b)
+    if os_ is None:
+        return False
+    o1, o2, o3, o4, (ax1, ay1, ax2, ay2, bx1, by1, bx2, by2) = os_
+    proper = (np.sign(o1) * np.sign(o2) < 0) & (np.sign(o3) * np.sign(o4) < 0)
+    if bool(np.any(proper)):
+        return True
+    # collinear overlap: all four orientations zero and the 1-D projections
+    # share more than a point
+    col = (o1 == 0) & (o2 == 0) & (o3 == 0) & (o4 == 0)
+    if not bool(np.any(col)):
+        return False
+    # project on the dominant axis of each a-edge
+    use_x = np.abs(ax2 - ax1)[:, None] >= np.abs(ay2 - ay1)[:, None]
+    alo = np.where(use_x, np.minimum(ax1, ax2)[:, None], np.minimum(ay1, ay2)[:, None])
+    ahi = np.where(use_x, np.maximum(ax1, ax2)[:, None], np.maximum(ay1, ay2)[:, None])
+    blo = np.where(use_x, np.minimum(bx1, bx2)[None, :], np.minimum(by1, by2)[None, :])
+    bhi = np.where(use_x, np.maximum(bx1, bx2)[None, :], np.maximum(by1, by2)[None, :])
+    overlap = np.minimum(ahi, bhi) - np.maximum(alo, blo)
+    return bool(np.any(col & (overlap > 1e-12)))
+
+
+def _edges_cross(a: Geometry, b: Geometry) -> bool:
+    os_ = _edge_orientations(a, b)
+    if os_ is None:
+        return False
+    o1, o2, o3, o4, (ax1, ay1, ax2, ay2, bx1, by1, bx2, by2) = os_
     proper = (np.sign(o1) * np.sign(o2) < 0) & (np.sign(o3) * np.sign(o4) < 0)
     if bool(np.any(proper)):
         return True

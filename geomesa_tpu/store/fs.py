@@ -253,11 +253,15 @@ class FileSystemStorage:
             else:
                 pq.write_table(merged, out, compression="zstd",
                                row_group_size=64 * 1024)
+            # crash-safety ordering: write merged file, point the manifest
+            # at it, persist — only then delete the old files. A crash
+            # leaves either the old manifest (old files intact) or the new
+            # one (merged file intact); never a manifest of missing files.
+            self.manifest[name] = [{"file": fname, "count": count}]
+            self._save_metadata()
             for entry in entries:
                 os.remove(os.path.join(self.root, name, entry["file"]))
                 removed += 1
-            self.manifest[name] = [{"file": fname, "count": count}]
-        self._save_metadata()
         return removed
 
     # -- read --------------------------------------------------------------

@@ -135,3 +135,19 @@ class TestAvroGate:
         sft = SimpleFeatureType.from_spec("a", "*geom:Point")
         with pytest.raises(ImportError, match="[Aa]vro"):
             converter_from_config(sft, {"type": "avro"})
+
+
+def test_dbf_large_float_roundtrip(tmp_path):
+    """Floats whose repr is scientific notation must survive dbf export."""
+    sft = SimpleFeatureType.from_spec("t", "v:Double,*geom:Point")
+    batch = FeatureBatch.from_pydict(
+        sft, {"v": [1e20, 0.5, 1e-7], "geom": np.zeros((3, 2))}
+    )
+    from geomesa_tpu.convert.formats import _read_dbf, _write_dbf
+
+    path = str(tmp_path / "t.dbf")
+    _write_dbf(path, batch)
+    rows = _read_dbf(path)
+    assert rows[0]["v"] == pytest.approx(1e20)
+    assert rows[1]["v"] == pytest.approx(0.5)
+    assert rows[2]["v"] == pytest.approx(1e-7, abs=1e-9)

@@ -1,0 +1,257 @@
+"""Index layer tests: lexicoders, keyspaces, KV datastore parity.
+
+Strategy (SURVEY.md §4): the KVDataStore's full stack — FilterSplitter,
+StrategyDecider, range scans, residual mask — is validated for exact result
+parity against the brute-force NumPy reference engine, for every index type.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.cql import parse_cql
+from geomesa_tpu.index import (
+    AttributeIndex,
+    KVDataStore,
+    MemoryIndexAdapter,
+    Z3Index,
+    default_indices,
+)
+from geomesa_tpu.index import lexicoders as lx
+from geomesa_tpu.plan.query import Query
+from geomesa_tpu.plan.hints import QueryHints
+
+from tests.reference_engine import eval_filter
+
+
+# -- lexicoders ------------------------------------------------------------
+
+
+def test_int_lexicoder_order_preserving():
+    vals = [-(2**62), -1000, -1, 0, 1, 7, 2**40, 2**62]
+    encs = [lx.encode_int(v) for v in vals]
+    assert encs == sorted(encs)
+    assert [lx.decode_int(e) for e in encs] == vals
+
+
+def test_float_lexicoder_order_preserving():
+    vals = [-1e300, -2.5, -1e-9, 0.0, 1e-9, 1.0, 3.14, 1e300]
+    encs = [lx.encode_float(v) for v in vals]
+    assert encs == sorted(encs)
+    back = [lx.decode_float(e) for e in encs]
+    assert np.allclose(back, vals)
+
+
+def test_string_lexicoder_roundtrip_and_order():
+    vals = ["", "a", "ab", "b", "ba", "z\x00q", "z\x01q", "zz"]
+    encs = [lx.encode_string(v) for v in vals]
+    assert [lx.decode_string(e) for e in encs] == vals
+
+
+def test_successor_is_prefix_upper_bound():
+    for b in [b"abc", b"a\xff", b"\xff\xff", b"x"]:
+        s = lx.successor(b)
+        assert s > b
+        assert s > b + b"zzzz"
+        assert s > b + b"\xfe\xfe"
+
+
+# -- fixtures --------------------------------------------------------------
+
+
+SPEC = "actor:String:index=true,score:Double,count:Integer,dtg:Date,*geom:Point"
+
+
+def make_point_batch(n=400, seed=7):
+    rng = np.random.default_rng(seed)
+    sft = SimpleFeatureType.from_spec("gdelt", SPEC)
+    return sft, FeatureBatch.from_pydict(
+        sft,
+        {
+            "actor": rng.choice(["USA", "FRA", "CHN", "GBR", None], n).tolist(),
+            "score": rng.uniform(-10, 10, n),
+            "count": rng.integers(0, 100, n),
+            "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack(
+                [rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)], 1
+            ),
+        },
+    )
+
+
+POINT_FILTERS = [
+    "BBOX(geom, -50, -40, 50, 40) AND dtg DURING 2020-06-01T00:00:00Z/2020-08-01T00:00:00Z",
+    "BBOX(geom, 0, 0, 90, 60)",
+    "actor = 'USA'",
+    "actor IN ('FRA', 'CHN') AND score > 0",
+    "count BETWEEN 10 AND 30",
+    "score < -5.0",
+    "actor LIKE 'U%'",
+    "BBOX(geom, -50, -40, 50, 40) AND actor = 'GBR'",
+    "dtg AFTER 2020-08-10T00:00:00Z",
+]
+
+
+@pytest.fixture(scope="module")
+def kv_source():
+    sft, batch = make_point_batch()
+    ds = KVDataStore()
+    src = ds.create_schema(sft)
+    src.write(batch)
+    return sft, batch, src
+
+
+# -- parity ----------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cql", POINT_FILTERS)
+def test_kv_query_parity(kv_source, cql):
+    sft, batch, src = kv_source
+    f = parse_cql(cql)
+    expected = set(
+        np.asarray(range(len(batch)))[eval_filter(f, batch)].tolist()
+    )
+    r = src.get_features(cql)
+    got = set() if r.features is None else {
+        int(fid.split("-")[-1]) for fid in r.features.fids.decode()
+    }
+    assert got == expected, cql
+
+
+def test_kv_strategy_choice(kv_source):
+    sft, batch, src = kv_source
+    # equality on an indexed attribute should choose the attribute index
+    ex = src.explain("actor = 'USA'")
+    assert "attr:actor" in ex and "chose attr:actor" in ex
+    # bbox+time should pick a z index (z3 beats z2 on selectivity here)
+    ex = src.explain(POINT_FILTERS[0])
+    assert "chose z" in ex
+
+
+def test_kv_index_override(kv_source):
+    sft, batch, src = kv_source
+    q = Query("gdelt", POINT_FILTERS[0], hints=QueryHints(query_index="z2"))
+    _, _, chosen = src.plan(q)
+    assert chosen is not None and chosen.index.name == "z2"
+    # result parity still holds under the override
+    f = parse_cql(POINT_FILTERS[0])
+    expected = int(eval_filter(f, batch).sum())
+    assert src.get_count(q) == expected
+
+
+def test_kv_overwrite_same_fid():
+    sft, batch = make_point_batch(50)
+    ds = KVDataStore()
+    src = ds.create_schema(sft)
+    fids = src.write(batch)
+    assert src.live_count == 50
+    # rewrite the same fids: replaces, not duplicates
+    src.write(batch, fids=fids)
+    assert src.live_count == 50
+    r = src.get_features("INCLUDE")
+    assert len(r.features) == 50
+
+
+def test_kv_delete_features():
+    sft, batch = make_point_batch(80)
+    ds = KVDataStore()
+    src = ds.create_schema(sft)
+    src.write(batch)
+    f = parse_cql("actor = 'USA'")
+    n_usa = int(eval_filter(f, batch).sum())
+    deleted = src.delete_features("actor = 'USA'")
+    assert deleted == n_usa
+    assert src.get_count("actor = 'USA'") == 0
+    assert src.live_count == 80 - n_usa
+    # deleted rows are gone from every index, not just attr
+    r = src.get_features("BBOX(geom, -180, -90, 180, 90)")
+    got = 0 if r.features is None else len(r.features)
+    assert got == 80 - n_usa
+
+
+def test_kv_id_queries():
+    sft, batch = make_point_batch(30)
+    ds = KVDataStore()
+    src = ds.create_schema(sft)
+    fids = src.write(batch)
+    some = [fids[3], fids[17], fids[29]]
+    got = src.get_features_by_id(some)
+    assert sorted(got.fids.decode()) == sorted(some)
+    # __fid__ pseudo-attribute rides the ID index
+    q = f"__fid__ IN ('{some[0]}', '{some[1]}')"
+    _, _, chosen = src.plan(q)
+    assert chosen is not None and chosen.index.name == "id"
+
+
+def test_kv_aggregation_hints(kv_source):
+    sft, batch, src = kv_source
+    cql = "BBOX(geom, -50, -40, 50, 40)"
+    f = parse_cql(cql)
+    expected_count = int(eval_filter(f, batch).sum())
+    # density over the matched set
+    q = Query(
+        "gdelt", cql,
+        hints=QueryHints(density_bbox=(-50, -40, 50, 40),
+                         density_width=16, density_height=16),
+    )
+    r = src.get_features(q)
+    assert r.kind == "density"
+    assert int(round(float(r.grid.sum()))) == expected_count
+    # stats
+    q = Query("gdelt", cql, hints=QueryHints(stats_string="MinMax(score)"))
+    r = src.get_features(q)
+    assert r.kind == "stats"
+
+
+def test_kv_extended_geometries_xz2():
+    rng = np.random.default_rng(3)
+    sft = SimpleFeatureType.from_spec("polys", "name:String,*geom:Polygon")
+    n = 60
+    geoms = []
+    for i in range(n):
+        cx, cy = rng.uniform(-150, 150), rng.uniform(-70, 70)
+        w, h = rng.uniform(0.5, 8, 2)
+        geoms.append(
+            f"POLYGON (({cx-w} {cy-h}, {cx+w} {cy-h}, {cx+w} {cy+h}, "
+            f"{cx-w} {cy+h}, {cx-w} {cy-h}))"
+        )
+    batch = FeatureBatch.from_pydict(
+        sft, {"name": [f"p{i}" for i in range(n)], "geom": geoms}
+    )
+    ds = KVDataStore()
+    src = ds.create_schema(sft)
+    src.write(batch)
+    # default index set for extended geoms: xz2 (+id)
+    assert any(i.name == "xz2" for i in src.indices)
+    for cql in ["BBOX(geom, -60, -40, -10, 10)", "BBOX(geom, 100, 20, 160, 70)"]:
+        f = parse_cql(cql)
+        expected = int(eval_filter(f, batch).sum())
+        assert src.get_count(cql) == expected, cql
+
+
+def test_default_indices_selection():
+    sft, _ = make_point_batch(1)
+    names = [getattr(i, "full_name", i.name) for i in default_indices(sft)]
+    assert "z3" in names and "z2" in names and "id" in names
+    assert "attr:actor" in names
+    sft2 = SimpleFeatureType.from_spec("lines", "n:Integer,*geom:LineString")
+    names2 = [i.name for i in default_indices(sft2)]
+    assert "xz2" in names2 and "z3" not in names2
+
+
+def test_attribute_index_range_scan_counts():
+    """The attribute index must return a covering set for range predicates."""
+    sft, batch = make_point_batch(200, seed=11)
+    adapter = MemoryIndexAdapter()
+    idx = AttributeIndex(sft, "count")
+    adapter.create_index(idx.full_name)
+    fids = [f"f-{i}" for i in range(len(batch))]
+    adapter.write(idx.full_name, idx.write_keys(batch, fids, list(range(len(batch)))))
+    f = parse_cql("count BETWEEN 20 AND 40")
+    rows = adapter.scan(idx.full_name, idx.ranges(f))
+    vals = np.asarray(batch.columns["count"])
+    expected = set(np.nonzero((vals >= 20) & (vals <= 40))[0].tolist())
+    assert expected.issubset(set(rows))
+    # and tight: nothing outside [20, 40] at the key level for ints
+    assert set(rows) == expected

@@ -148,3 +148,28 @@ class TestProcessors:
         fns = sql.register()
         assert "st_contains" in fns and fns["st_point"](1, 2).point == (1.0, 2.0)
         assert len(fns) >= 30
+
+
+def test_st_area_multipolygon_parts():
+    g = parse_wkt(
+        "MULTIPOLYGON (((0 0, 1 0, 1 1, 0 1, 0 0)), ((5 5, 6 5, 6 6, 5 6, 5 5)))"
+    )
+    assert sql.st_area(g) == pytest.approx(2.0)
+    c = sql.st_centroid(g)
+    assert c.point == pytest.approx((3.0, 3.0))
+
+
+def test_st_area_polygon_with_hole():
+    g = parse_wkt(
+        "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 2 1, 2 2, 1 2, 1 1))"
+    )
+    assert sql.st_area(g) == pytest.approx(15.0)
+
+
+def test_st_touches_line_line():
+    cross = (parse_wkt("LINESTRING (0 0, 2 2)"), parse_wkt("LINESTRING (0 2, 2 0)"))
+    endpoint = (parse_wkt("LINESTRING (0 0, 1 1)"), parse_wkt("LINESTRING (1 1, 2 0)"))
+    overlap = (parse_wkt("LINESTRING (0 0, 2 0)"), parse_wkt("LINESTRING (1 0, 3 0)"))
+    assert not sql.st_touches(*cross)  # interiors cross
+    assert sql.st_touches(*endpoint)  # endpoint only
+    assert not sql.st_touches(*overlap)  # collinear interior overlap
