@@ -248,9 +248,9 @@ def to_geojson(g: Geometry) -> dict:
     if g.kind == "MultiPoint":
         pts = np.concatenate([np.asarray(r, np.float64) for r in g.rings], axis=0)
         return {"type": "MultiPoint", "coordinates": pts.tolist()}
-    if g.kind == "LineString":
+    if g.kind == "LineString" and len(g.rings) == 1:
         return {"type": "LineString", "coordinates": ring(g.rings[0])}
-    if g.kind == "MultiLineString" or (g.kind == "LineString" and len(g.rings) > 1):
+    if g.kind in ("MultiLineString", "LineString"):
         return {"type": "MultiLineString", "coordinates": [ring(r) for r in g.rings]}
     if g.kind == "Polygon":
         return {"type": "Polygon", "coordinates": [ring(r) for r in g.rings]}

@@ -55,7 +55,7 @@ def _topk_smallest(d: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
     return -neg, idx
 
 
-@functools.partial(jax.jit, static_argnames=("k", "query_tile"))
+@functools.partial(jax.jit, static_argnames=("k", "query_tile", "data_tile"))
 def knn(
     qx: jax.Array,
     qy: jax.Array,
@@ -64,26 +64,68 @@ def knn(
     mask: jax.Array,
     k: int,
     query_tile: int = 1024,
+    data_tile: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN: [Q] query points vs [N] masked data points.
 
     Returns (dists [Q,k] meters, indices [Q,k] into the data arrays).
     Invalid/masked data points get +inf distance (index still in range).
-    Queries are processed in fixed-size tiles so the QxN distance block
-    streams through memory instead of materializing at once.
+
+    Both axes are tiled: queries via lax.map, data via a lax.scan that folds
+    each [query_tile, data_tile] distance block into a running top-k — peak
+    memory is O(query_tile · data_tile), never O(Q · N), so GDELT-scale N
+    streams through HBM instead of materializing a multi-GB block. Folding
+    per-tile top-ks is exact (the global top-k is a subset of the union of
+    tile top-ks — the same argument as the cross-shard merge below).
     """
     q = qx.shape[0]
+    n = dx.shape[0]
+    if data_tile is None:
+        # cap the distance block at ~64M lanes (256MB f32)
+        data_tile = max(k, min(n, (1 << 26) // max(query_tile, 1)))
     pad = (-q) % query_tile
     qxp = jnp.pad(qx, (0, pad))
     qyp = jnp.pad(qy, (0, pad))
     tiles_x = qxp.reshape(-1, query_tile)
     tiles_y = qyp.reshape(-1, query_tile)
 
+    dpad = (-n) % data_tile
+    dxp = jnp.pad(dx, (0, dpad)).reshape(-1, data_tile)
+    dyp = jnp.pad(dy, (0, dpad)).reshape(-1, data_tile)
+    mp = jnp.pad(mask, (0, dpad)).reshape(-1, data_tile)
+    n_dtiles = dxp.shape[0]
+    dist_dtype = jnp.promote_types(jnp.promote_types(qx.dtype, dx.dtype), jnp.float32)
+
     def tile(args):
         tx, ty = args
-        d = haversine_m(tx[:, None], ty[:, None], dx[None, :], dy[None, :])
-        d = jnp.where(mask[None, :], d, INF)
-        return _topk_smallest(d, k)
+
+        def fold(carry, xs):
+            bd, bi = carry
+            dxt, dyt, mt, base = xs
+            d = haversine_m(tx[:, None], ty[:, None], dxt[None, :], dyt[None, :])
+            d = jnp.where(mt[None, :], d, INF)
+            ld, li = _topk_smallest(d, k)
+            # clamp padded-lane indices into range — their distances are
+            # +inf so they never displace real neighbors, but the contract
+            # is "index still in range" even for unfilled slots
+            gi = jnp.minimum((li + base).astype(jnp.int32), n - 1)
+            pool_d = jnp.concatenate([bd, ld], axis=1)
+            pool_i = jnp.concatenate([bi, gi], axis=1)
+            nd, sel = _topk_smallest(pool_d, k)
+            ni = jnp.take_along_axis(pool_i, sel, axis=1)
+            return (nd, ni), None
+
+        # derive the init from the inputs so it inherits their varying-
+        # mesh-axes tag — a plain constant init breaks lax.scan's carry
+        # typing when knn runs inside a shard_map (ring/sharded callers)
+        vzero = jnp.sum(dx[:1] * 0).astype(dist_dtype) + jnp.sum(tx[:1] * 0).astype(dist_dtype)
+        init = (
+            jnp.full((query_tile, k), jnp.inf, dist_dtype) + vzero,
+            jnp.zeros((query_tile, k), jnp.int32) + vzero.astype(jnp.int32),
+        )
+        bases = (jnp.arange(n_dtiles) * data_tile).astype(jnp.int32)
+        (bd, bi), _ = jax.lax.scan(fold, init, (dxp, dyp, mp, bases))
+        return bd, bi
 
     dists, idx = jax.lax.map(tile, (tiles_x, tiles_y))
     return (

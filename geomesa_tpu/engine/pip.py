@@ -62,7 +62,17 @@ def points_in_polygon(px, py, x1, y1, x2, y2):
     Edge rule: an edge crosses the upward ray from p iff exactly one endpoint
     is strictly above p's y (half-open: y1 <= py < y2 or y2 <= py < y1), and
     the edge's x at py is strictly right of px. Even crossings = outside.
+
+    On TPU with enough work, dispatches to the Pallas streamed-tile kernel
+    (engine.pip_pallas) — O(N+E) HBM traffic vs this dense path's O(N·E).
     """
+    from geomesa_tpu.engine.pip_pallas import (
+        points_in_polygon_pallas,
+        use_pallas_pip,
+    )
+
+    if use_pallas_pip(px.shape[0], x1.shape[0]):
+        return points_in_polygon_pallas(px, py, x1, y1, x2, y2)
     px = px[:, None]
     py = py[:, None]
     cond = (y1[None, :] <= py) != (y2[None, :] <= py)

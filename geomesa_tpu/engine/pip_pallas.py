@@ -1,0 +1,113 @@
+"""Pallas TPU kernel: tiled crossing-number point-in-polygon.
+
+Parity role: same predicate as engine.pip.points_in_polygon (the JTS
+prepared-geometry intersects analog — SURVEY.md C4/§7 "hardest kernel",
+baseline config 2). TPU-first design: the dense lax implementation
+materializes the [N, E] crossing matrix in HBM; this kernel streams fixed
+[POINT_TILE, EDGE_TILE] blocks through VMEM with a revisited int32
+accumulator block, so HBM traffic is O(N + E) instead of O(N·E) and the
+VPU stays saturated on elementwise compare/FMA work.
+
+Grid: (point_tiles, edge_tiles), edge axis minor — each point block's
+accumulator is initialized at edge step 0 and folded until the last step
+(standard Pallas revisited-output accumulation; the sequential TPU grid
+guarantees ordering). Padding edges are degenerate (all zeros) and can
+never satisfy the half-open crossing rule; padded points are sliced off.
+
+f32 note: edge-crossing comparisons at f32 resolution can flip for points
+within ~1e-7 deg of a boundary (documented divergence from the f64 oracle,
+same caveat as the lax path)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+POINT_TILE = 512
+EDGE_TILE = 1024
+
+
+def _pip_kernel(px_ref, py_ref, x1_ref, y1_ref, x2_ref, y2_ref, out_ref):
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    px = px_ref[...].reshape(-1, 1)  # [P, 1]
+    py = py_ref[...].reshape(-1, 1)
+    x1 = x1_ref[...].reshape(1, -1)  # [1, E]
+    y1 = y1_ref[...].reshape(1, -1)
+    x2 = x2_ref[...].reshape(1, -1)
+    y2 = y2_ref[...].reshape(1, -1)
+
+    # half-open rule: exactly one endpoint strictly above py
+    cond = (y1 <= py) != (y2 <= py)
+    t = (py - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
+    xc = x1 + t * (x2 - x1)
+    partial = jnp.sum((cond & (xc > px)).astype(jnp.int32), axis=1)
+    out_ref[...] += partial.reshape(out_ref.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def points_in_polygon_pallas(px, py, x1, y1, x2, y2, interpret: bool = False):
+    """Crossing-number test [N] points vs [E] edges -> bool [N] (Pallas)."""
+    import jax.experimental.pallas as pl
+
+    n = px.shape[0]
+    e = x1.shape[0]
+    if e == 0:
+        return jnp.zeros((n,), bool)
+    npad = (-n) % POINT_TILE
+    epad = (-e) % EDGE_TILE
+    dt = jnp.promote_types(px.dtype, jnp.float32)
+    pxp = jnp.pad(px.astype(dt), (0, npad)).reshape(-1, POINT_TILE)
+    pyp = jnp.pad(py.astype(dt), (0, npad)).reshape(-1, POINT_TILE)
+    # degenerate zero edges never cross (y1 == y2 fails the half-open rule)
+    e1 = jnp.pad(x1.astype(dt), (0, epad)).reshape(-1, EDGE_TILE)
+    f1 = jnp.pad(y1.astype(dt), (0, epad)).reshape(-1, EDGE_TILE)
+    e2 = jnp.pad(x2.astype(dt), (0, epad)).reshape(-1, EDGE_TILE)
+    f2 = jnp.pad(y2.astype(dt), (0, epad)).reshape(-1, EDGE_TILE)
+
+    gp, ge = pxp.shape[0], e1.shape[0]
+    point_block = pl.BlockSpec((1, POINT_TILE), lambda i, j: (i, 0))
+    edge_block = pl.BlockSpec((1, EDGE_TILE), lambda i, j: (j, 0))
+
+    counts = pl.pallas_call(
+        _pip_kernel,
+        grid=(gp, ge),
+        in_specs=[point_block, point_block,
+                  edge_block, edge_block, edge_block, edge_block],
+        out_specs=pl.BlockSpec((1, POINT_TILE), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((gp, POINT_TILE), jnp.int32),
+        interpret=interpret,
+    )(pxp, pyp, e1, f1, e2, f2)
+    return (counts.reshape(-1)[:n] % 2) == 1
+
+
+# threshold below which the dense lax path wins (kernel launch + padding
+# overhead dominates when the [N, E] block fits comfortably anyway)
+_MIN_WORK = 1 << 22
+
+
+def use_pallas_pip(n: int, e: int) -> bool:
+    return jax.default_backend() == "tpu" and n * max(e, 1) >= _MIN_WORK
+
+
+def points_in_polygon_np_edges(px, py, x1, y1, x2, y2) -> np.ndarray:
+    """NumPy f64 oracle over an explicit edge table (same edge rule)."""
+    px = np.asarray(px, np.float64)[:, None]
+    py = np.asarray(py, np.float64)[:, None]
+    x1 = np.asarray(x1, np.float64)[None, :]
+    y1 = np.asarray(y1, np.float64)[None, :]
+    x2 = np.asarray(x2, np.float64)[None, :]
+    y2 = np.asarray(y2, np.float64)[None, :]
+    cond = (y1 <= py) != (y2 <= py)
+    t = (py - y1) / np.where(y2 == y1, 1.0, y2 - y1)
+    xc = x1 + t * (x2 - x1)
+    return (np.sum(cond & (xc > px), axis=1) % 2) == 1

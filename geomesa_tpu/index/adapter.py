@@ -55,15 +55,43 @@ class MemoryIndexAdapter(IndexAdapter):
         self._rows.setdefault(index_name, [])
 
     def write(self, index_name: str, keys: Iterable[WriteKey]) -> None:
-        ks, rs = self._keys[index_name], self._rows[index_name]
-        for wk in keys:
-            i = bisect.bisect_left(ks, wk.key)
-            # idempotent same-key overwrite (upstream: same row key replaces)
-            if i < len(ks) and ks[i] == wk.key:
-                rs[i] = wk.row
+        """Bulk merge: sort incoming pairs, one O(N+M) merge with the
+        existing sorted arrays (per-key list.insert would make a batch
+        load O(N^2)). Same-key writes replace (idempotent overwrite)."""
+        incoming = sorted(((wk.key, wk.row) for wk in keys), key=lambda p: p[0])
+        if not incoming:
+            return
+        # same key twice in one batch: last one wins
+        dedup = []
+        for key, row in incoming:
+            if dedup and dedup[-1][0] == key:
+                dedup[-1] = (key, row)
             else:
-                ks.insert(i, wk.key)
-                rs.insert(i, wk.row)
+                dedup.append((key, row))
+        ks, rs = self._keys[index_name], self._rows[index_name]
+        out_k: List[bytes] = []
+        out_r: List[int] = []
+        i = j = 0
+        while i < len(ks) and j < len(dedup):
+            if ks[i] < dedup[j][0]:
+                out_k.append(ks[i])
+                out_r.append(rs[i])
+                i += 1
+            elif ks[i] == dedup[j][0]:
+                out_k.append(dedup[j][0])
+                out_r.append(dedup[j][1])
+                i += 1
+                j += 1
+            else:
+                out_k.append(dedup[j][0])
+                out_r.append(dedup[j][1])
+                j += 1
+        out_k.extend(ks[i:])
+        out_r.extend(rs[i:])
+        out_k.extend(p[0] for p in dedup[j:])
+        out_r.extend(p[1] for p in dedup[j:])
+        self._keys[index_name] = out_k
+        self._rows[index_name] = out_r
 
     def delete(self, index_name: str, keys: Iterable[bytes]) -> None:
         ks, rs = self._keys[index_name], self._rows[index_name]

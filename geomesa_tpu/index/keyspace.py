@@ -445,10 +445,18 @@ class AttributeIndex(IndexKeySpace):
             return [(lo, hi, True, True)]
         if isinstance(f, ast.Like) and f.prop.name == self.attr \
                 and not f.negate and not f.case_insensitive:
-            # prefix LIKE 'abc%' -> range scan on the literal prefix
+            # prefix LIKE 'abc%' -> range scan on the literal prefix. Only
+            # when the prefix is wildcard-free: '_' (single-char) and '\'
+            # (escape) are LIKE metacharacters, and encoding them as literal
+            # bytes would produce a non-covering range that drops matches.
             pat = f.pattern
-            if "%" in pat and not pat.rstrip("%").count("%") and not pat.startswith("%"):
-                prefix = lx.encode_string(pat.rstrip("%"))
+            head = pat.rstrip("%")
+            if (
+                pat.endswith("%")
+                and head
+                and not any(c in head for c in ("%", "_", "\\"))
+            ):
+                prefix = lx.encode_string(head)
                 return [(prefix, lx.successor(prefix), True, False)]
             return None
         if isinstance(f, ast.Comparison) and isinstance(f.left, ast.Property) \

@@ -231,8 +231,11 @@ class KVFeatureSource:
 
     def get_features_by_id(self, fids: Sequence[str]) -> FeatureBatch:
         rows = [self._fid_row[f] for f in fids if f in self._fid_row]
-        return self._gather(rows) if rows else FeatureBatch(
-            self.sft, {a.name: np.zeros(0) for a in self.sft.attributes}, [], None
+        if rows:
+            return self._gather(rows)
+        # well-formed empty batch (proper empty GeometryColumn/DictColumn)
+        return FeatureBatch.from_pydict(
+            self.sft, {a.name: [] for a in self.sft.attributes}
         )
 
 

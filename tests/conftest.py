@@ -20,6 +20,10 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax
+import jax.experimental.pallas  # noqa: F401  (register TPU lowering rules
+# while the tpu platform is still a known backend — popping the factories
+# first makes pallas_call's registration fail with "unknown platform tpu",
+# even in interpret mode)
 from jax._src import xla_bridge as _xb
 
 for _name in ("axon", "tpu"):

@@ -272,3 +272,20 @@ class TestBin:
         sel = np.array([1, 3, 5])
         rec = decode_bin(encode_bin(packed, sel))
         np.testing.assert_array_equal(rec["track"], [1, 3, 5])
+
+
+class TestKNNSmallN:
+    def test_indices_in_range_when_k_exceeds_n(self):
+        """Padded top-k slots must keep indices < N (documented contract)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from geomesa_tpu.engine.knn import knn
+
+        dx = jnp.asarray(np.array([0.0, 1.0, 2.0], np.float32))
+        dy = jnp.asarray(np.zeros(3, np.float32))
+        mask = jnp.asarray(np.array([True, True, False]))
+        d, i = knn(jnp.zeros(2, jnp.float32), jnp.zeros(2, jnp.float32),
+                   dx, dy, mask, k=5, query_tile=2)
+        assert int(jnp.max(i)) < 3
+        assert bool(jnp.all(jnp.isinf(d[:, 2:])))  # only 2 valid candidates

@@ -255,3 +255,32 @@ def test_attribute_index_range_scan_counts():
     assert expected.issubset(set(rows))
     # and tight: nothing outside [20, 40] at the key level for ints
     assert set(rows) == expected
+
+
+def test_kv_like_underscore_not_prefix_scanned():
+    """'_' is a LIKE wildcard; the attr index must not treat it as a literal
+    prefix byte (that would silently drop matches)."""
+    sft, batch = make_point_batch(100, seed=13)
+    ds = KVDataStore()
+    src = ds.create_schema(sft)
+    src.write(batch)
+    f = parse_cql("actor LIKE 'U_A%'")
+    expected = int(eval_filter(f, batch).sum())
+    assert expected > 0  # USA matches U_A
+    assert src.get_count("actor LIKE 'U_A%'") == expected
+
+
+def test_kv_bulk_write_scales():
+    """Bulk writes use one sorted merge, not per-key insertion."""
+    import time
+
+    sft, batch = make_point_batch(5000, seed=17)
+    ds = KVDataStore()
+    src = ds.create_schema(sft)
+    t0 = time.perf_counter()
+    src.write(batch)
+    assert time.perf_counter() - t0 < 10.0
+    assert src.live_count == 5000
+    assert src.get_count("actor = 'USA'") == int(
+        eval_filter(parse_cql("actor = 'USA'"), batch).sum()
+    )

@@ -1,0 +1,121 @@
+"""Pallas kernel parity tests (interpret mode on CPU; same code path the
+TPU compiles). Oracle: f64 NumPy with the identical half-open edge rule."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.core.wkt import parse_wkt
+from geomesa_tpu.engine.pip import points_in_polygon, polygon_edges
+from geomesa_tpu.engine.pip_pallas import (
+    points_in_polygon_np_edges,
+    points_in_polygon_pallas,
+)
+
+
+def _random_polygon(rng, nv=12, cx=0.0, cy=0.0, r=10.0):
+    """A random star-convex polygon (no self-intersections)."""
+    angles = np.sort(rng.uniform(0, 2 * np.pi, nv))
+    radii = rng.uniform(0.3 * r, r, nv)
+    xs = cx + radii * np.cos(angles)
+    ys = cy + radii * np.sin(angles)
+    pts = np.stack([xs, ys], 1)
+    return np.concatenate([pts, pts[:1]], 0)
+
+
+def _edges_from_rings(rings):
+    x1 = np.concatenate([r[:-1, 0] for r in rings])
+    y1 = np.concatenate([r[:-1, 1] for r in rings])
+    x2 = np.concatenate([r[1:, 0] for r in rings])
+    y2 = np.concatenate([r[1:, 1] for r in rings])
+    return x1, y1, x2, y2
+
+
+@pytest.mark.parametrize("n,nv", [(100, 8), (777, 40), (2048, 3)])
+def test_pallas_pip_parity_random(n, nv):
+    rng = np.random.default_rng(nv * 1000 + n)
+    ring = _random_polygon(rng, nv)
+    x1, y1, x2, y2 = _edges_from_rings([ring])
+    px = rng.uniform(-15, 15, n)
+    py = rng.uniform(-15, 15, n)
+    exp = points_in_polygon_np_edges(px, py, x1, y1, x2, y2)
+    got = np.asarray(
+        points_in_polygon_pallas(
+            px.astype(np.float32), py.astype(np.float32),
+            x1.astype(np.float32), y1.astype(np.float32),
+            x2.astype(np.float32), y2.astype(np.float32),
+            interpret=True,
+        )
+    )
+    # f32 tolerance: only points within ~1e-5 deg of an edge may flip
+    disagree = np.nonzero(got != exp)[0]
+    for i in disagree:
+        d = _min_edge_dist(px[i], py[i], x1, y1, x2, y2)
+        assert d < 1e-4, f"point {i} disagrees at distance {d} from boundary"
+    assert len(disagree) <= max(1, n // 100)
+
+
+def _min_edge_dist(px, py, x1, y1, x2, y2):
+    ex, ey = x2 - x1, y2 - y1
+    L2 = ex * ex + ey * ey
+    t = np.clip(((px - x1) * ex + (py - y1) * ey) / np.where(L2 == 0, 1, L2), 0, 1)
+    qx, qy = x1 + t * ex, y1 + t * ey
+    return float(np.min(np.hypot(px - qx, py - qy)))
+
+
+def test_pallas_pip_holes_multipart():
+    g = parse_wkt(
+        "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), (3 3, 7 3, 7 7, 3 7, 3 3))"
+    )
+    x1, y1, x2, y2 = polygon_edges(g)
+    px = np.array([5.0, 1.0, 5.0, -1.0, 8.0])
+    py = np.array([5.0, 1.0, 3.5, 5.0, 8.0])
+    exp = np.array([False, True, False, False, True])  # hole center excluded
+    got = np.asarray(
+        points_in_polygon_pallas(px, py, x1, y1, x2, y2, interpret=True)
+    )
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_pallas_pip_large_edge_table_streams():
+    """Edge count beyond one tile exercises the accumulation grid axis."""
+    rng = np.random.default_rng(0)
+    # many small squares: 5 vertices each -> E >> EDGE_TILE
+    rings = []
+    for i in range(400):
+        cx, cy = rng.uniform(-100, 100, 2)
+        s = 0.5
+        rings.append(
+            np.array(
+                [[cx - s, cy - s], [cx + s, cy - s], [cx + s, cy + s],
+                 [cx - s, cy + s], [cx - s, cy - s]]
+            )
+        )
+    x1, y1, x2, y2 = _edges_from_rings(rings)
+    assert len(x1) > 1024  # spans multiple edge tiles
+    px = rng.uniform(-100, 100, 300)
+    py = rng.uniform(-100, 100, 300)
+    exp = points_in_polygon_np_edges(px, py, x1, y1, x2, y2)
+    got = np.asarray(
+        points_in_polygon_pallas(px, py, x1, y1, x2, y2, interpret=True)
+    )
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_pip_dense_and_pallas_agree_exact_f64():
+    """At f64 the two implementations are bit-identical on the same rule."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    ring = _random_polygon(rng, 20)
+    x1, y1, x2, y2 = _edges_from_rings([ring])
+    px, py = rng.uniform(-12, 12, 500), rng.uniform(-12, 12, 500)
+    dense = np.asarray(
+        points_in_polygon(
+            jnp.asarray(px), jnp.asarray(py),
+            jnp.asarray(x1), jnp.asarray(y1), jnp.asarray(x2), jnp.asarray(y2),
+        )
+    )
+    pallas = np.asarray(
+        points_in_polygon_pallas(px, py, x1, y1, x2, y2, interpret=True)
+    )
+    np.testing.assert_array_equal(dense, pallas)
