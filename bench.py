@@ -53,12 +53,240 @@ def _cpu_baseline(x, y, t, speed, qx, qy, k, bbox, t0, t1, repeats=3):
     return best, count, dists
 
 
+def _timeit(fn, repeats=3, warm=True):
+    if warm:
+        fn()
+    best = float("inf")
+    for _ in range(repeats):
+        s = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - s)
+    return best
+
+
+def bench_pip(n, repeats):
+    """Config 2: Within() point-in-polygon (OSM-admin-style polygon)."""
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.engine.pip import points_in_polygon
+    from geomesa_tpu.engine.pip_pallas import points_in_polygon_np_edges
+
+    rng = np.random.default_rng(7)
+    th = np.sort(rng.uniform(0, 2 * np.pi, 4096))
+    radii = rng.uniform(20, 60, th.shape[0])
+    ring = np.stack([radii * np.cos(th), radii * np.sin(th)], 1)
+    ring = np.concatenate([ring, ring[:1]], 0)
+    x1, y1 = ring[:-1, 0], ring[:-1, 1]
+    x2, y2 = ring[1:, 0], ring[1:, 1]
+    px = rng.uniform(-80, 80, n)
+    py = rng.uniform(-80, 80, n)
+
+    dev = [jnp.asarray(a, jnp.float32) for a in (px, py, x1, y1, x2, y2)]
+    run = jax.jit(lambda *a: points_in_polygon(*a))
+    dev_t = _timeit(lambda: run(*dev).block_until_ready(), repeats)
+
+    # CPU baseline: chunked NumPy f64 crossing number. Chunk size keeps the
+    # [chunk, E] intermediates ~128MB so the baseline is compute-bound, not
+    # swap-bound (an artificially thrashing baseline would inflate speedups)
+    chunk = max(1024, (1 << 24) // max(len(x1), 1))
+
+    def cpu():
+        out = np.zeros(n, bool)
+        for off in range(0, n, chunk):
+            sl = slice(off, min(off + chunk, n))
+            out[sl] = points_in_polygon_np_edges(px[sl], py[sl], x1, y1, x2, y2)
+        return out
+
+    cpu_t = _timeit(cpu, max(1, repeats - 1))
+    exp = cpu()
+    got = np.asarray(run(*dev))
+    mismatch = int((got != exp).sum())
+    return {
+        "metric": "within_pip_points_per_sec_per_chip",
+        "value": round(n / dev_t, 1),
+        "unit": "points/sec",
+        "vs_baseline": round((n / dev_t) / (n / cpu_t), 3),
+        "detail": {
+            "n": n, "edges": len(x1), "device_time_s": round(dev_t, 5),
+            "cpu_time_s": round(cpu_t, 5), "mismatch": mismatch,
+            "parity": mismatch <= max(2, n // 10000),
+        },
+    }
+
+
+def bench_density(n, repeats):
+    """Config 4: DensityProcess 512x512 (NYC-TLC-style grid)."""
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.engine.density import density_grid
+
+    rng = np.random.default_rng(11)
+    x = rng.uniform(-74.3, -73.7, n)
+    y = rng.uniform(40.5, 41.0, n)
+    w = rng.uniform(0, 5, n).astype(np.float32)
+    bbox = (-74.3, 40.5, -73.7, 41.0)
+    W = H = 512
+
+    dx = jnp.asarray(x, jnp.float32)
+    dy = jnp.asarray(y, jnp.float32)
+    dw = jnp.asarray(w)
+    m = jnp.ones(n, bool)
+    run = jax.jit(lambda a, b, c, d: density_grid(a, b, c, d, bbox, W, H))
+    dev_t = _timeit(lambda: run(dx, dy, dw, m).block_until_ready(), repeats)
+
+    def cpu():
+        g, _, _ = np.histogram2d(
+            y, x, bins=(H, W),
+            range=((bbox[1], bbox[3]), (bbox[0], bbox[2])), weights=w,
+        )
+        return g
+
+    cpu_t = _timeit(cpu, max(1, repeats - 1))
+    grid_dev = np.asarray(run(dx, dy, dw, m))
+    grid_cpu = cpu()
+    # histogram2d puts top-edge values in the last bin; compare total mass
+    mass_ok = abs(grid_dev.sum() - grid_cpu.sum()) / max(grid_cpu.sum(), 1) < 1e-3
+    return {
+        "metric": "density_512_points_per_sec_per_chip",
+        "value": round(n / dev_t, 1),
+        "unit": "points/sec",
+        "vs_baseline": round((n / dev_t) / (n / cpu_t), 3),
+        "detail": {
+            "n": n, "grid": f"{W}x{H}", "device_time_s": round(dev_t, 5),
+            "cpu_time_s": round(cpu_t, 5), "grid_mass_parity": bool(mass_ok),
+        },
+    }
+
+
+def bench_tube(n, repeats):
+    """Config 5: TubeSelect trajectory join (AIS-convoy-style)."""
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.engine.geodesy import haversine_m_np
+    from geomesa_tpu.engine.tube import tube_select
+
+    rng = np.random.default_rng(13)
+    x = rng.uniform(-10, 10, n)
+    y = rng.uniform(50, 60, n)
+    t = rng.integers(0, 86_400_000, n)
+    T = 256  # tube samples along the track
+    tx = np.linspace(-8, 8, T)
+    ty = np.linspace(51, 59, T) + rng.normal(0, 0.05, T)
+    tt = np.linspace(0, 86_400_000, T).astype(np.int64)
+    radius = 20_000.0  # 20 km corridor
+    half_win = 3_600_000  # 1 h
+
+    m = jnp.ones(n, bool)
+    dev = (
+        jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32),
+        jnp.asarray(t, jnp.int64), m,
+        jnp.asarray(tx, jnp.float32), jnp.asarray(ty, jnp.float32),
+        jnp.asarray(tt, jnp.int64),
+        jnp.asarray(radius, jnp.float32), jnp.asarray(half_win, jnp.int64),
+    )
+    run = jax.jit(lambda *a: tube_select(*a))
+    dev_t = _timeit(lambda: run(*dev).block_until_ready(), repeats)
+
+    def cpu():
+        hit = np.zeros(n, bool)
+        for i in range(T):
+            d = haversine_m_np(tx[i], ty[i], x, y)
+            hit |= (d <= radius) & (np.abs(t - tt[i]) <= half_win)
+        return hit
+
+    cpu_t = _timeit(cpu, max(1, repeats - 1))
+    got = np.asarray(run(*dev))
+    exp = cpu()
+    return {
+        "metric": "tube_select_points_per_sec_per_chip",
+        "value": round(n / dev_t, 1),
+        "unit": "points/sec",
+        "vs_baseline": round((n / dev_t) / (n / cpu_t), 3),
+        "detail": {
+            "n": n, "tube_samples": T, "device_time_s": round(dev_t, 5),
+            "cpu_time_s": round(cpu_t, 5),
+            "parity": bool((got == exp).mean() > 0.9999),
+            "matched": int(exp.sum()),
+        },
+    }
+
+
+def bench_fs_query(n, repeats, tmpdir=None):
+    """Config 1: BBOX+time CQL through the full FS Parquet DataStore stack
+    (plan -> prune -> parquet pushdown -> device residual mask), CPU
+    baseline = the same filter in flat NumPy over the raw arrays."""
+    import shutil
+    import tempfile
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.plan.datastore import DataStore
+
+    rng = np.random.default_rng(17)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    t = rng.integers(1_590_000_000_000, 1_600_000_000_000, n)
+    score = rng.uniform(-10, 10, n)
+    root = tmpdir or tempfile.mkdtemp(prefix="gmtpu_bench_")
+    try:
+        sft = SimpleFeatureType.from_spec(
+            "gdelt", "score:Double,dtg:Date,*geom:Point"
+        )
+        ds = DataStore(root, use_device_cache=True)
+        src = ds.create_schema(sft)
+        src.write(FeatureBatch.from_pydict(
+            sft, {"score": score, "dtg": t, "geom": np.stack([x, y], 1)}
+        ))
+        cql = ("BBOX(geom, -60, 20, 60, 70) AND score > 0 AND "
+               "dtg DURING 2020-06-13T00:00:00Z/2020-08-21T00:00:00Z")
+        q_t = _timeit(lambda: src.get_count(cql), repeats)
+        count = src.get_count(cql)
+
+        import datetime as _dt
+
+        def _ms(s):
+            return int(_dt.datetime.fromisoformat(s).timestamp() * 1000)
+
+        lo, hi = _ms("2020-06-13T00:00:00+00:00"), _ms("2020-08-21T00:00:00+00:00")
+
+        def cpu():
+            m = ((x >= -60) & (x <= 60) & (y >= 20) & (y <= 70)
+                 & (score > 0) & (t > lo) & (t < hi))
+            return int(m.sum())
+
+        cpu_t = _timeit(cpu, max(1, repeats - 1))
+        parity = cpu() == count
+        return {
+            "metric": "fs_bbox_time_query_points_per_sec_per_chip",
+            "value": round(n / q_t, 1),
+            "unit": "points/sec",
+            "vs_baseline": round((n / q_t) / (n / cpu_t), 3),
+            "detail": {
+                "n": n, "matched": count, "device_time_s": round(q_t, 5),
+                "cpu_time_s": round(cpu_t, 5), "parity": bool(parity),
+                "note": "end-to-end DataStore query incl. planning vs raw "
+                        "NumPy mask (the CPU side has no stack overhead)",
+            },
+        }
+    finally:
+        if tmpdir is None:
+            shutil.rmtree(root, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--n", type=int, default=None)
     p.add_argument("--queries", type=int, default=None)
     p.add_argument("--k", type=int, default=10)
+    p.add_argument(
+        "--config", type=int, default=None, choices=[1, 2, 3, 4, 5],
+        help="BASELINE.json config to run (default: 3, the headline "
+             "BBOX+time+kNN metric; 1=fs-query 2=pip 4=density 5=tube)",
+    )
     args = p.parse_args(argv)
 
     if args.smoke:
@@ -75,6 +303,12 @@ def main(argv=None) -> int:
     n = args.n or (1 << 17 if args.smoke else 1 << 22)
     q = args.queries or (32 if args.smoke else 256)
     k = args.k
+    repeats = 2 if args.smoke else 3
+
+    if args.config in (1, 2, 4, 5):
+        fn = {1: bench_fs_query, 2: bench_pip, 4: bench_density, 5: bench_tube}
+        print(json.dumps(fn[args.config](n, repeats)))
+        return 0
 
     import jax
     import jax.numpy as jnp

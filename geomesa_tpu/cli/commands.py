@@ -47,6 +47,10 @@ def register(sub: "argparse._SubParsersAction") -> None:
          (["--converter", "-C"], {"required": True,
           "help": "converter config JSON file, or a well-known name "
                   "(gdelt|ais|nyctaxi)"}),
+         (["--workers"], {"type": int, "default": 1,
+          "help": "parallel converter threads (distributed-ingest analog)"}),
+         (["--no-resume"], {"action": "store_true",
+          "help": "ignore the per-file ingest checkpoint"}),
          (["files"], {"nargs": "+", "help": "input files"})],
     )
     cmd(
@@ -159,6 +163,25 @@ def _ingest(args) -> int:
         src = ds.get_feature_source(args.feature_name)
     else:
         src = ds.create_schema(sft)
+    if getattr(args, "workers", 1) > 1:
+        from geomesa_tpu.jobs import ingest_files
+
+        rep = ingest_files(
+            src,
+            lambda: converter_from_config(src.sft, config),
+            args.files,
+            workers=args.workers,
+            resume=not getattr(args, "no_resume", False),
+        )
+        print(
+            f"ingested {rep.features} features into {args.feature_name} "
+            f"({len(rep.files_ok)} files ok, {len(rep.files_failed)} failed, "
+            f"{rep.records_failed} records failed, "
+            f"{len(rep.skipped)} skipped by checkpoint)"
+        )
+        for line in rep.files_failed:
+            print(f"  FAILED {line}", file=sys.stderr)
+        return 1 if rep.files_failed else 0
     conv = converter_from_config(src.sft, config)
     total = failed = 0
     for path in args.files:

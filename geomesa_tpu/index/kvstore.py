@@ -28,13 +28,7 @@ from geomesa_tpu.index.keyspace import IndexKeySpace, default_indices
 from geomesa_tpu.index.splitter import FilterSplitter, StrategyDecider
 from geomesa_tpu.plan.explain import Explainer
 from geomesa_tpu.plan.query import Query
-
-
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p <<= 1
-    return p
+from geomesa_tpu.utils.padding import next_pow2 as _next_pow2
 
 
 class KVFeatureSource:

@@ -67,12 +67,26 @@ class DataStore:
         catalog: str,
         audit: Optional[AuditWriter] = None,
         mesh=None,
+        use_device_cache: bool = False,
     ):
         self.catalog = catalog
         self.audit = audit if audit is not None else AuditWriter()
         self.mesh = mesh
+        self.use_device_cache = use_device_cache
         os.makedirs(catalog, exist_ok=True)
         self._sources: Dict[str, FeatureSource] = {}
+
+    def _planner(self, storage) -> QueryPlanner:
+        planner = QueryPlanner(storage, self.audit, self.mesh)
+        if self.use_device_cache:
+            from geomesa_tpu.store.cache import DeviceCacheManager
+
+            # same coord dtype as the scan path, else cached/scan results
+            # diverge for points near predicate boundaries
+            planner.cache = DeviceCacheManager(
+                storage, coord_dtype=planner.coord_dtype
+            )
+        return planner
 
     def get_type_names(self) -> List[str]:
         out = []
@@ -96,16 +110,14 @@ class DataStore:
         storage = FileSystemStorage.create(
             os.path.join(self.catalog, sft.name), sft, scheme, encoding
         )
-        src = FeatureSource(storage, QueryPlanner(storage, self.audit, self.mesh))
+        src = FeatureSource(storage, self._planner(storage))
         self._sources[sft.name] = src
         return src
 
     def get_feature_source(self, name: str) -> FeatureSource:
         if name not in self._sources:
             storage = FileSystemStorage.load(os.path.join(self.catalog, name))
-            self._sources[name] = FeatureSource(
-                storage, QueryPlanner(storage, self.audit, self.mesh)
-            )
+            self._sources[name] = FeatureSource(storage, self._planner(storage))
         return self._sources[name]
 
     def get_schema(self, name: str) -> SimpleFeatureType:

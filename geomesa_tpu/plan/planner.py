@@ -31,9 +31,8 @@ from geomesa_tpu.plan.audit import AuditWriter, QueryEvent
 from geomesa_tpu.plan.explain import Explainer
 from geomesa_tpu.plan.hints import QueryHints
 from geomesa_tpu.plan.query import Query
-from geomesa_tpu.plan.runner import project as _project
 from geomesa_tpu.plan.runner import sample_mask as _sample_mask
-from geomesa_tpu.plan.runner import sort_order as _sort_order
+from geomesa_tpu.utils.padding import next_pow2 as _next_pow2
 from geomesa_tpu.store.fs import FileSystemStorage
 
 
@@ -65,10 +64,12 @@ class QueryPlanner:
         audit: Optional[AuditWriter] = None,
         mesh=None,
         coord_dtype=None,
+        cache=None,  # Optional[store.cache.DeviceCacheManager]
     ):
         self.storage = storage
         self.audit = audit
         self.mesh = mesh
+        self.cache = cache
         if coord_dtype is None:
             import jax.numpy as jnp
 
@@ -159,6 +160,20 @@ class QueryPlanner:
         t_plan = time.perf_counter()
         check_timeout("planning")
 
+        hints = query.hints
+        # HBM-resident path: per-partition cached device batches skip the
+        # parquet scan entirely (sampling falls back: every-nth is defined
+        # over the global match order, not per partition)
+        # loose_bbox also falls back: the scan path re-applies the bbox
+        # row-exactly via parquet pushdown, which cached whole partitions
+        # cannot reproduce once the residual drops the BBOX predicate
+        if self.cache is not None and not hints.sampling and not hints.loose_bbox:
+            result, mask_count, t_scan = self._execute_cached(plan, query)
+            t_done = time.perf_counter()
+            self._record(query, plan, hints, mask_count,
+                         t0, t_plan, t_scan, t_done)
+            return result
+
         batches = list(
             self.storage.scan(
                 plan.bbox,
@@ -169,7 +184,6 @@ class QueryPlanner:
         t_scan = time.perf_counter()
         check_timeout("scan")
 
-        hints = query.hints
         result: QueryResult
         if not batches:
             result = self._empty_result(hints)
@@ -196,6 +210,11 @@ class QueryPlanner:
             mask_count = int(mask.sum())
             result = self._aggregate(padded, dev, mask, query)
         t_done = time.perf_counter()
+        self._record(query, plan, hints, mask_count, t0, t_plan, t_scan, t_done)
+        return result
+
+    def _record(self, query, plan, hints, mask_count, t0, t_plan, t_scan, t_done):
+        from geomesa_tpu.utils.metrics import metrics
 
         metrics.counter("query.count")
         metrics.counter("query.features.matched", mask_count)
@@ -217,7 +236,65 @@ class QueryPlanner:
                     partitions_total=plan.total_partitions,
                 )
             )
-        return result
+
+    def _execute_cached(self, plan: QueryPlan, query: Query):
+        """Per-partition HBM-resident execution: cached padded device
+        batches -> residual mask -> per-partition aggregation -> merge.
+        Returns (result, mask_count, t_scan); "scan time" here is the
+        cache-ensure (load of any non-resident partition)."""
+        hints = query.hints
+        self.cache.ensure(plan.partitions)
+        t_scan = time.perf_counter()
+
+        grids = []
+        seq = None
+        bins = []
+        feats = []
+        total = 0
+        for name in plan.partitions:
+            entry = self.cache.get(name)
+            if entry is None:
+                continue
+            if plan.compiled is not None:
+                mask = np.asarray(plan.compiled.mask(entry.dev, entry.batch))
+            else:
+                mask = np.asarray(entry.dev["__valid__"])
+            count = int(mask.sum())
+            if count == 0:
+                continue
+            total += count
+            if hints.is_density or hints.is_stats or hints.is_bin:
+                part = self._aggregate(entry.batch, entry.dev, mask, query)
+                if hints.is_density:
+                    grids.append(part.grid)
+                elif hints.is_stats:
+                    seq = part.stats if seq is None else seq.merge(part.stats)
+                else:
+                    bins.append(part.bin_bytes)
+            else:
+                feats.append(entry.batch.select(np.nonzero(mask)[0]))
+
+        if hints.is_density:
+            if not grids:
+                return self._empty_result(hints), 0, t_scan
+            grid = np.sum(np.stack(grids), axis=0)
+            return QueryResult("density", grid=grid, count=total), total, t_scan
+        if hints.is_stats:
+            if seq is None:
+                return self._empty_result(hints), 0, t_scan
+            return QueryResult("stats", stats=seq, count=total), total, t_scan
+        if hints.is_bin:
+            return (
+                QueryResult("bin", bin_bytes=b"".join(bins), count=total),
+                total,
+                t_scan,
+            )
+        if not feats:
+            return QueryResult("features", features=None, count=0), 0, t_scan
+        from geomesa_tpu.plan.runner import finish_features
+
+        sel = finish_features(FeatureBatch.concat(feats), query)
+        return QueryResult("features", features=sel, count=len(sel)), total, t_scan
 
     def count(self, query: Query) -> int:
         """EXACT_COUNT path; with exact_count=False and INCLUDE, serve the
@@ -263,13 +340,6 @@ class QueryPlanner:
         from geomesa_tpu.plan.runner import run_stats
 
         return run_stats(batch, dev, mask, expression)
-
-
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p <<= 1
-    return p
 
 
 def _loosen_bbox(f: ast.Filter, geom_name: str) -> ast.Filter:

@@ -82,15 +82,20 @@ def aggregate(sft: SimpleFeatureType, batch, dev, mask: np.ndarray, query: "Quer
         )
 
     # plain feature results
-    sel = batch.select(np.nonzero(mask)[0])
+    sel = finish_features(batch.select(np.nonzero(mask)[0]), query)
+    return QueryResult("features", features=sel, count=len(sel))
+
+
+def finish_features(sel: FeatureBatch, query: "Query") -> FeatureBatch:
+    """The LocalQueryRunner tail: sort, max-features, projection — shared
+    by the scan path and the cached per-partition path."""
     if query.sort_by:
-        order = sort_order(sel, query.sort_by)
-        sel = sel.select(order)
+        sel = sel.select(sort_order(sel, query.sort_by))
     if query.max_features is not None and len(sel) > query.max_features:
         sel = sel.select(np.arange(query.max_features))
     if query.attributes is not None:
         sel = project(sel, query.attributes)
-    return QueryResult("features", features=sel, count=len(sel))
+    return sel
 
 
 def run_stats(batch, dev, mask: np.ndarray, expression: str):

@@ -1,0 +1,358 @@
+"""SQL over feature stores with spatial-predicate pushdown.
+
+Parity: geomesa-spark-sql's GeoMesaRelation + Catalyst rules (SURVEY.md C16)
+[upstream, unverified] — SQL spatial predicates are *translated into the
+store's CQL filter* so they ride the index/pruning machinery instead of
+post-filtering, which is exactly the reference's pushdown contract. Spark
+itself is not rebuilt (non-goal per §7); the distributed execution fabric is
+the mesh/pjit layer, and this module supplies the SQL surface:
+
+    ctx = SqlContext(datastore)
+    ctx.sql("SELECT actor, score FROM gdelt "
+            "WHERE st_intersects(geom, st_geomFromWKT('POLYGON(...)')) "
+            "AND score > 0 ORDER BY score DESC LIMIT 10")
+
+Supported: SELECT cols|*|COUNT(*), WHERE with AND/OR/NOT over st_intersects/
+st_within/st_contains/st_dwithin/st_bbox + comparisons/BETWEEN/IN/LIKE
+(datetime-typed comparisons are translated to temporal predicates), ORDER
+BY, LIMIT. Predicates that cannot be pushed (e.g. computed st_area(geom) in
+WHERE) raise with a clear message rather than silently full-scanning.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.core.wkt import Geometry, box, parse_wkt
+from geomesa_tpu.cql import ast
+from geomesa_tpu.cql.parser import parse_cql  # for datetime literal reuse
+from geomesa_tpu.plan.query import Query
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<number>-?\d+\.\d*(?:[eE][+-]?\d+)?|-?\.\d+|-?\d+(?:[eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<op><>|<=|>=|!=|=|<|>)
+  | (?P<punct>[(),*])
+  | (?P<word>[A-Za-z_][A-Za-z0-9_.]*)
+""",
+    re.VERBOSE,
+)
+
+_ISO = re.compile(
+    r"^\d{4}-\d{2}-\d{2}([T ]\d{2}:\d{2}:\d{2}(\.\d+)?)?(Z|[+-]\d{2}:?\d{2})?$"
+)
+
+
+class SqlError(ValueError):
+    pass
+
+
+class _Tokens:
+    def __init__(self, text: str):
+        self.toks: List[Tuple[str, str]] = []
+        pos = 0
+        while pos < len(text):
+            m = _TOKEN_RE.match(text, pos)
+            if not m:
+                raise SqlError(f"bad SQL near {text[pos:pos+20]!r}")
+            pos = m.end()
+            kind = m.lastgroup
+            if kind != "ws":
+                self.toks.append((kind, m.group()))
+        self.i = 0
+
+    def peek(self, ahead: int = 0) -> Optional[Tuple[str, str]]:
+        j = self.i + ahead
+        return self.toks[j] if j < len(self.toks) else None
+
+    def next(self) -> Tuple[str, str]:
+        if self.i >= len(self.toks):
+            raise SqlError("unexpected end of SQL")
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept_word(self, *words: str) -> Optional[str]:
+        t = self.peek()
+        if t and t[0] == "word" and t[1].upper() in words:
+            self.i += 1
+            return t[1].upper()
+        return None
+
+    def expect_word(self, word: str) -> None:
+        if not self.accept_word(word):
+            raise SqlError(f"expected {word} at {self.peek()}")
+
+    def expect_punct(self, p: str) -> None:
+        t = self.next()
+        if t != ("punct", p) and not (t[0] == "punct" and t[1] == p):
+            raise SqlError(f"expected {p!r}, got {t}")
+
+
+_SPATIAL_FNS = {
+    # fn -> CQL op when the column is the FIRST arg; the geometry-literal
+    # arg supplies the filter geometry. Containment flips with arg order.
+    "ST_INTERSECTS": ("INTERSECTS", "INTERSECTS"),
+    "ST_WITHIN": ("WITHIN", "CONTAINS"),
+    "ST_CONTAINS": ("CONTAINS", "WITHIN"),
+    "ST_OVERLAPS": ("OVERLAPS", "OVERLAPS"),
+    "ST_CROSSES": ("CROSSES", "CROSSES"),
+    "ST_TOUCHES": ("TOUCHES", "TOUCHES"),
+    "ST_DISJOINT": ("DISJOINT", "DISJOINT"),
+    "ST_EQUALS": ("EQUALS", "EQUALS"),
+}
+
+
+class SqlContext:
+    """Execute SQL SELECTs against a DataStore-shaped catalog."""
+
+    def __init__(self, datastore):
+        self.ds = datastore
+
+    # -- public ------------------------------------------------------------
+
+    def sql(self, text: str):
+        """Run a SELECT; returns QueryResult (features/count)."""
+        toks = _Tokens(text.strip().rstrip(";"))
+        toks.expect_word("SELECT")
+        cols, is_count = self._select_list(toks)
+        toks.expect_word("FROM")
+        table = toks.next()[1]
+        sft = self.ds.get_schema(table)
+
+        where: ast.Filter = ast.Include()
+        if toks.accept_word("WHERE"):
+            where = self._expr(toks, sft)
+        sort_by = None
+        if toks.accept_word("ORDER"):
+            toks.expect_word("BY")
+            sort_by = self._order_list(toks)
+        limit = None
+        if toks.accept_word("LIMIT"):
+            limit = int(toks.next()[1])
+        if toks.peek() is not None:
+            raise SqlError(f"trailing tokens at {toks.peek()}")
+
+        src = self.ds.get_feature_source(table)
+        q = Query(
+            table,
+            where,
+            attributes=cols,
+            sort_by=sort_by,
+            max_features=limit,
+        )
+        if is_count:
+            from geomesa_tpu.plan.planner import QueryResult
+
+            return QueryResult("count", count=src.get_count(q))
+        return src.get_features(q)
+
+    # -- parsing -----------------------------------------------------------
+
+    def _select_list(self, toks: _Tokens):
+        t = toks.peek()
+        if t and t[0] == "word" and t[1].upper() == "COUNT":
+            toks.next()
+            toks.expect_punct("(")
+            toks.expect_punct("*")
+            toks.expect_punct(")")
+            return None, True
+        if t and t[0] == "punct" and t[1] == "*":
+            toks.next()
+            return None, False
+        cols = [toks.next()[1]]
+        while toks.peek() == ("punct", ","):
+            toks.next()
+            cols.append(toks.next()[1])
+        return cols, False
+
+    def _order_list(self, toks: _Tokens):
+        out = []
+        while True:
+            col = toks.next()[1]
+            asc = True
+            if toks.accept_word("ASC"):
+                asc = True
+            elif toks.accept_word("DESC"):
+                asc = False
+            out.append((col, asc))
+            if toks.peek() == ("punct", ","):
+                toks.next()
+                continue
+            return out
+
+    def _expr(self, toks: _Tokens, sft) -> ast.Filter:
+        left = self._and_expr(toks, sft)
+        while toks.accept_word("OR"):
+            right = self._and_expr(toks, sft)
+            left = ast.Or((left, right))
+        return left
+
+    def _and_expr(self, toks: _Tokens, sft) -> ast.Filter:
+        left = self._not_expr(toks, sft)
+        while toks.accept_word("AND"):
+            right = self._not_expr(toks, sft)
+            left = ast.And((left, right))
+        return left
+
+    def _not_expr(self, toks: _Tokens, sft) -> ast.Filter:
+        if toks.accept_word("NOT"):
+            return ast.Not(self._not_expr(toks, sft))
+        if toks.peek() == ("punct", "("):
+            save = toks.i
+            toks.next()
+            try:
+                inner = self._expr(toks, sft)
+                toks.expect_punct(")")
+                return inner
+            except SqlError:
+                toks.i = save  # not a parenthesized boolean; re-parse
+        return self._predicate(toks, sft)
+
+    def _predicate(self, toks: _Tokens, sft) -> ast.Filter:
+        t = toks.peek()
+        if t is None:
+            raise SqlError("expected predicate")
+        if t[0] == "word" and t[1].upper() in _SPATIAL_FNS:
+            return self._spatial(toks, sft)
+        if t[0] == "word" and t[1].upper() == "ST_DWITHIN":
+            return self._dwithin(toks, sft)
+        if t[0] == "word" and t[1].upper().startswith("ST_"):
+            raise SqlError(
+                f"{t[1]} is not pushable in WHERE — only spatial relation "
+                "predicates (st_intersects/st_within/st_contains/st_dwithin/"
+                "...) can ride the index; compute expressions belong in "
+                "client code via geomesa_tpu.sql functions"
+            )
+        # column predicate
+        col = toks.next()[1]
+        if col not in sft:
+            raise SqlError(f"unknown column {col!r}")
+        is_temporal = sft.attribute(col).is_temporal
+        if toks.accept_word("BETWEEN"):
+            lo = self._literal(toks, is_temporal)
+            toks.expect_word("AND")
+            hi = self._literal(toks, is_temporal)
+            if is_temporal:
+                return ast.And((
+                    ast.Comparison(">=", ast.Property(col), lo),
+                    ast.Comparison("<=", ast.Property(col), hi),
+                ))
+            return ast.Between(ast.Property(col), lo, hi)
+        if toks.accept_word("IN"):
+            toks.expect_punct("(")
+            vals = [self._literal(toks, is_temporal).value]
+            while toks.peek() == ("punct", ","):
+                toks.next()
+                vals.append(self._literal(toks, is_temporal).value)
+            toks.expect_punct(")")
+            return ast.In(ast.Property(col), tuple(vals))
+        if toks.accept_word("LIKE"):
+            s = toks.next()
+            if s[0] != "string":
+                raise SqlError("LIKE needs a string pattern")
+            return ast.Like(ast.Property(col), s[1][1:-1].replace("''", "'"))
+        if toks.accept_word("IS"):
+            negate = bool(toks.accept_word("NOT"))
+            toks.expect_word("NULL")
+            return ast.IsNull(ast.Property(col), negate=negate)
+        op_t = toks.next()
+        if op_t[0] != "op":
+            raise SqlError(f"expected operator after {col}, got {op_t}")
+        op = "<>" if op_t[1] == "!=" else op_t[1]
+        lit = self._literal(toks, is_temporal)
+        return ast.Comparison(op, ast.Property(col), lit)
+
+    def _literal(self, toks: _Tokens, temporal: bool) -> ast.Literal:
+        t = toks.next()
+        if t[0] == "number":
+            v = float(t[1])
+            return ast.Literal(int(v) if v.is_integer() else v)
+        if t[0] == "string":
+            s = t[1][1:-1].replace("''", "'")
+            if temporal and _ISO.match(s):
+                f = parse_cql(f"x TEQUALS {s}")
+                return ast.Literal(f.start, kind="datetime")
+            return ast.Literal(s)
+        if t[0] == "word" and t[1].upper() in ("TRUE", "FALSE"):
+            return ast.Literal(t[1].upper() == "TRUE")
+        if t[0] == "word" and t[1].upper() == "TIMESTAMP":
+            s = toks.next()
+            if s[0] != "string":
+                raise SqlError("TIMESTAMP needs a quoted ISO string")
+            f = parse_cql(f"x TEQUALS {s[1][1:-1]}")
+            return ast.Literal(f.start, kind="datetime")
+        raise SqlError(f"expected literal, got {t}")
+
+    # -- spatial translation ----------------------------------------------
+
+    def _geom_arg(self, toks: _Tokens, sft):
+        """One argument of a spatial fn: a geometry column name or a
+        geometry literal expression. Returns ('col', name) | ('geom', g)."""
+        t = toks.next()
+        up = t[1].upper() if t[0] == "word" else ""
+        if up == "ST_GEOMFROMWKT" or up == "ST_GEOMFROMTEXT":
+            toks.expect_punct("(")
+            s = toks.next()
+            if s[0] != "string":
+                raise SqlError("st_geomFromWKT needs a quoted WKT string")
+            toks.expect_punct(")")
+            return "geom", parse_wkt(s[1][1:-1].replace("''", "'"))
+        if up == "ST_POINT":
+            toks.expect_punct("(")
+            x = float(toks.next()[1])
+            toks.expect_punct(",")
+            y = float(toks.next()[1])
+            toks.expect_punct(")")
+            return "geom", Geometry("Point", [np.array([[x, y]], np.float64)])
+        if up == "ST_MAKEBBOX":
+            toks.expect_punct("(")
+            vals = [float(toks.next()[1])]
+            for _ in range(3):
+                toks.expect_punct(",")
+                vals.append(float(toks.next()[1]))
+            toks.expect_punct(")")
+            return "geom", box(*vals)
+        if t[0] == "word" and t[1] in sft:
+            return "col", t[1]
+        raise SqlError(f"expected geometry column or literal, got {t}")
+
+    def _spatial(self, toks: _Tokens, sft) -> ast.Filter:
+        fn = toks.next()[1].upper()
+        col_first_op, col_second_op = _SPATIAL_FNS[fn]
+        toks.expect_punct("(")
+        a = self._geom_arg(toks, sft)
+        toks.expect_punct(",")
+        b = self._geom_arg(toks, sft)
+        toks.expect_punct(")")
+        if a[0] == "col" and b[0] == "geom":
+            return ast.SpatialPredicate(col_first_op, ast.Property(a[1]), b[1])
+        if a[0] == "geom" and b[0] == "col":
+            return ast.SpatialPredicate(col_second_op, ast.Property(b[1]), a[1])
+        raise SqlError(
+            f"{fn} needs exactly one geometry column and one literal "
+            "(column-column joins go through process.JoinProcess)"
+        )
+
+    def _dwithin(self, toks: _Tokens, sft) -> ast.Filter:
+        toks.next()  # fn name
+        toks.expect_punct("(")
+        a = self._geom_arg(toks, sft)
+        toks.expect_punct(",")
+        b = self._geom_arg(toks, sft)
+        toks.expect_punct(",")
+        dist = float(toks.next()[1])
+        toks.expect_punct(")")
+        if a[0] == "col" and b[0] == "geom":
+            prop, geom = a[1], b[1]
+        elif a[0] == "geom" and b[0] == "col":
+            prop, geom = b[1], a[1]
+        else:
+            raise SqlError("st_dwithin needs one column and one literal")
+        # distance in meters (GeoMesa's geomesa-spark st_dwithin contract)
+        return ast.DistancePredicate("DWITHIN", ast.Property(prop), geom, dist)

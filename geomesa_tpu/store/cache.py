@@ -1,0 +1,178 @@
+"""Device cache manager: HBM residency with a persistent manifest.
+
+Parity: SURVEY.md §5.4's checkpoint/resume obligation — the reference's
+"checkpointing" is FS partition->file manifests + Kafka offsets; the TPU
+analog is a manifest of *device residency*: which partition files are
+resident in HBM, under which layout version, so a restarted server rebuilds
+identical device state deterministically. Also covers the Kafka-layer
+snapshot-refresh design (SURVEY.md C12 TPU note): `refresh()` is the
+double-buffered snapshot swap — a new padded batch is built while the old
+one keeps serving, then the reference flips.
+
+Layout notes:
+- partitions are cached independently (pruning stays effective: a query
+  touching 3 of 300 partitions pulls 3 cache entries);
+- each entry is padded to the next pow2 so jit cache keys stabilize across
+  refreshes (same policy as the planner's scan path);
+- LAYOUT_VERSION participates in the manifest: a layout change invalidates
+  stale residency on load instead of serving mis-shaped arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.store.fs import FileSystemStorage
+from geomesa_tpu.utils.padding import next_pow2 as _next_pow2
+
+LAYOUT_VERSION = 1
+MANIFEST = ".device_cache.json"
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    """One partition resident on device."""
+
+    files: List[str]  # source files (residency provenance)
+    count: int  # valid rows
+    padded: int  # padded device length (pow2)
+    batch: FeatureBatch  # host copy (padded)
+    dev: dict  # DeviceBatch
+
+
+class DeviceCacheManager:
+    """Keeps partitions of a FileSystemStorage resident on device."""
+
+    def __init__(self, storage: FileSystemStorage, coord_dtype=None):
+        self.storage = storage
+        self.coord_dtype = coord_dtype
+        self._entries: Dict[str, CacheEntry] = {}
+
+    # -- residency ---------------------------------------------------------
+
+    def _partition_files(self, name: str) -> List[str]:
+        return sorted(e["file"] for e in self.storage.manifest.get(name, []))
+
+    def _load_partition(self, name: str) -> Optional[CacheEntry]:
+        from geomesa_tpu.engine.device import to_device
+
+        batches = list(self.storage.scan_partitions([name]))
+        if not batches:
+            return None
+        batch = FeatureBatch.concat(batches)
+        n = len(batch)
+        padded = batch.pad_to(_next_pow2(n))
+        kw = {"coord_dtype": self.coord_dtype} if self.coord_dtype else {}
+        dev = to_device(padded, **kw)
+        return CacheEntry(
+            files=self._partition_files(name),
+            count=n,
+            padded=len(padded),
+            batch=padded,
+            dev=dev,
+        )
+
+    def ensure(self, partitions: Optional[List[str]] = None) -> List[str]:
+        """Make the named partitions (default: all) resident; returns the
+        list actually (re)loaded. Already-resident, unchanged partitions are
+        untouched — the double-buffer: a changed partition's new entry is
+        fully built before the old one is dropped."""
+        names = partitions if partitions is not None else self.storage.partitions()
+        loaded = []
+        for name in names:
+            files = self._partition_files(name)
+            cur = self._entries.get(name)
+            if cur is not None and cur.files == files:
+                continue
+            entry = self._load_partition(name)
+            if entry is None:
+                self._entries.pop(name, None)
+            else:
+                self._entries[name] = entry  # atomic reference flip
+            loaded.append(name)
+        return loaded
+
+    def refresh(self) -> List[str]:
+        """Re-sync with the storage manifest: load new/changed partitions,
+        drop removed ones. Returns changed partition names."""
+        current = set(self.storage.partitions())
+        dropped = [n for n in self._entries if n not in current]
+        for n in dropped:
+            del self._entries[n]
+        return self.ensure() + dropped
+
+    def invalidate(self, partition: Optional[str] = None) -> None:
+        if partition is None:
+            self._entries.clear()
+        else:
+            self._entries.pop(partition, None)
+
+    def get(self, partition: str) -> Optional[CacheEntry]:
+        return self._entries.get(partition)
+
+    def resident(self) -> List[str]:
+        return sorted(self._entries)
+
+    def stats(self) -> dict:
+        return {
+            "partitions": len(self._entries),
+            "rows": sum(e.count for e in self._entries.values()),
+            "padded_rows": sum(e.padded for e in self._entries.values()),
+            "layout_version": LAYOUT_VERSION,
+        }
+
+    # -- manifest persistence (restart determinism) ------------------------
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.storage.root, MANIFEST)
+
+    def save_manifest(self) -> None:
+        doc = {
+            "layout_version": LAYOUT_VERSION,
+            "coord_dtype": str(np.dtype(self.coord_dtype).name)
+            if self.coord_dtype
+            else None,
+            "partitions": {
+                name: {"files": e.files, "count": e.count, "padded": e.padded}
+                for name, e in self._entries.items()
+            },
+        }
+        tmp = self.manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, self.manifest_path)
+
+    def resume(self) -> Tuple[List[str], List[str]]:
+        """Rebuild device state from the saved manifest: reload every
+        partition it names whose files still match; report (restored,
+        stale). Stale = layout drift or file-list drift — reloaded fresh
+        via ensure() by the caller if wanted."""
+        if not os.path.exists(self.manifest_path):
+            return [], []
+        with open(self.manifest_path) as f:
+            doc = json.load(f)
+        restored, stale = [], []
+        if doc.get("layout_version") != LAYOUT_VERSION:
+            return [], sorted(doc.get("partitions", {}))
+        for name, meta in sorted(doc.get("partitions", {}).items()):
+            if self._partition_files(name) != meta["files"]:
+                stale.append(name)
+                continue
+            entry = self._load_partition(name)
+            if entry is None:
+                stale.append(name)
+                continue
+            assert entry.padded == meta["padded"], (
+                f"non-deterministic rebuild for {name}: "
+                f"{entry.padded} != {meta['padded']}"
+            )
+            self._entries[name] = entry
+            restored.append(name)
+        return restored, stale

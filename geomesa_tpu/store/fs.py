@@ -361,6 +361,17 @@ class FileSystemStorage:
                     for off in range(0, len(t), target):
                         yield _table_to_batch(t.slice(off, target), self.sft)
 
+    def scan_partitions(self, names: Sequence[str]) -> Iterator[FeatureBatch]:
+        """Yield every row (all columns) of the named partitions, no
+        pushdown — the device-cache residency read (store.cache and the
+        export jobs load whole partitions)."""
+        for name in names:
+            for entry in self.manifest.get(name, []):
+                path = os.path.join(self.root, name, entry["file"])
+                t = self._read_file(path, None, None)
+                if len(t):
+                    yield _table_to_batch(t, self.sft)
+
     @staticmethod
     def _decode_dictionaries(table: pa.Table) -> pa.Table:
         """ORC has no dictionary type: cast dict columns to their value

@@ -1,0 +1,9 @@
+"""Shared padding policy: pow2 batch buckets stabilize jit cache keys
+(SURVEY.md §7 hard part 5 — padding/occupancy economics)."""
+
+
+def next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p

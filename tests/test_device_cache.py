@@ -1,0 +1,152 @@
+"""Device cache manager: residency, refresh, restart determinism, and
+cached-vs-scan query parity (SURVEY.md §5.4 checkpoint/resume analog)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.plan.datastore import DataStore
+from geomesa_tpu.plan.hints import QueryHints
+from geomesa_tpu.plan.query import Query
+from geomesa_tpu.store.cache import DeviceCacheManager
+
+
+def make_batch(n=300, seed=2):
+    rng = np.random.default_rng(seed)
+    sft = SimpleFeatureType.from_spec(
+        "gdelt", "actor:String,score:Double,dtg:Date,*geom:Point"
+    )
+    return sft, FeatureBatch.from_pydict(
+        sft,
+        {
+            "actor": rng.choice(["USA", "FRA", "CHN"], n).tolist(),
+            "score": rng.uniform(-10, 10, n),
+            "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack(
+                [rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)], 1
+            ),
+        },
+    )
+
+
+CQL = (
+    "BBOX(geom, -120, -60, 120, 60) AND score > 0 AND "
+    "dtg DURING 2020-06-01T00:00:00Z/2020-09-01T00:00:00Z"
+)
+
+
+@pytest.fixture()
+def stores(tmp_path):
+    sft, batch = make_batch()
+    plain = DataStore(str(tmp_path / "plain"))
+    cached = DataStore(str(tmp_path / "cached"), use_device_cache=True)
+    plain.create_schema(sft).write(batch)
+    cached.create_schema(sft).write(batch)
+    return sft, batch, plain, cached
+
+
+def test_cached_query_parity_features(stores):
+    sft, batch, plain, cached = stores
+    a = plain.get_feature_source("gdelt").get_features(CQL)
+    b = cached.get_feature_source("gdelt").get_features(CQL)
+    assert a.count == b.count
+    sa = np.sort(np.asarray(a.features.columns["score"])) if a.features else []
+    sb = np.sort(np.asarray(b.features.columns["score"])) if b.features else []
+    np.testing.assert_allclose(sa, sb)
+
+
+def test_cached_query_parity_density_stats(stores):
+    sft, batch, plain, cached = stores
+    q = Query(
+        "gdelt", CQL,
+        hints=QueryHints(density_bbox=(-120, -60, 120, 60),
+                         density_width=16, density_height=16),
+    )
+    ga = plain.get_feature_source("gdelt").get_features(q)
+    gb = cached.get_feature_source("gdelt").get_features(q)
+    np.testing.assert_allclose(ga.grid, gb.grid, atol=1e-4)
+    q2 = Query("gdelt", CQL, hints=QueryHints(stats_string="MinMax(score);Count()"))
+    sa = plain.get_feature_source("gdelt").get_features(q2)
+    sb = cached.get_feature_source("gdelt").get_features(q2)
+    assert sa.stats.stats[0].result() == sb.stats.stats[0].result()
+
+
+def test_cache_refresh_after_write(stores):
+    sft, batch, plain, cached = stores
+    src = cached.get_feature_source("gdelt")
+    before = src.get_count(CQL)
+    _, more = make_batch(150, seed=9)
+    src.write(more)
+    plain.get_feature_source("gdelt").write(more)
+    after = src.get_count(CQL)
+    expected = plain.get_feature_source("gdelt").get_count(CQL)
+    assert after == expected
+    assert after >= before
+
+
+def test_manifest_resume_deterministic(tmp_path):
+    sft, batch = make_batch()
+    ds = DataStore(str(tmp_path / "c"))
+    src = ds.create_schema(sft)
+    src.write(batch)
+    m1 = DeviceCacheManager(src.storage)
+    m1.ensure()
+    assert m1.resident()
+    m1.save_manifest()
+    stats1 = m1.stats()
+
+    # fresh manager on the same storage rebuilds identical residency
+    m2 = DeviceCacheManager(src.storage)
+    restored, stale = m2.resume()
+    assert restored == m1.resident()
+    assert stale == []
+    assert m2.stats() == stats1
+
+
+def test_manifest_resume_detects_drift(tmp_path):
+    sft, batch = make_batch()
+    ds = DataStore(str(tmp_path / "c"))
+    src = ds.create_schema(sft)
+    src.write(batch)
+    m1 = DeviceCacheManager(src.storage)
+    m1.ensure()
+    m1.save_manifest()
+    # write more data -> file lists drift -> stale on resume
+    _, more = make_batch(50, seed=4)
+    src.write(more)
+    m2 = DeviceCacheManager(src.storage)
+    restored, stale = m2.resume()
+    assert stale  # at least one partition changed
+    # ensure() then brings everything fresh
+    m2.ensure()
+    assert set(m2.resident()) == set(src.storage.partitions())
+
+
+def test_cache_invalidate_and_stats(tmp_path):
+    sft, batch = make_batch()
+    ds = DataStore(str(tmp_path / "c"))
+    src = ds.create_schema(sft)
+    src.write(batch)
+    m = DeviceCacheManager(src.storage)
+    m.ensure()
+    s = m.stats()
+    assert s["rows"] == len(batch)
+    assert s["padded_rows"] >= s["rows"]
+    p = m.resident()[0]
+    m.invalidate(p)
+    assert p not in m.resident()
+    m.invalidate()
+    assert m.resident() == []
+
+
+def test_cached_loose_bbox_falls_back_exact(stores):
+    """loose_bbox on the cached store must not return out-of-bbox rows:
+    the cached path falls back to the scan path (parquet pushdown
+    re-applies the bbox row-exactly)."""
+    sft, batch, plain, cached = stores
+    q = Query("gdelt", "BBOX(geom, -20, -10, 20, 10)",
+              hints=QueryHints(loose_bbox=True))
+    a = plain.get_feature_source("gdelt").get_features(q)
+    b = cached.get_feature_source("gdelt").get_features(q)
+    assert a.count == b.count

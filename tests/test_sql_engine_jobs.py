@@ -1,0 +1,209 @@
+"""SQL pushdown engine + parallel jobs tests."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.jobs import export_partitions, ingest_files
+from geomesa_tpu.plan.datastore import DataStore
+from geomesa_tpu.sql.engine import SqlContext, SqlError
+
+from tests.reference_engine import eval_filter
+from geomesa_tpu.cql import parse_cql
+
+
+def make_store(tmp_path, n=400, seed=21):
+    rng = np.random.default_rng(seed)
+    sft = SimpleFeatureType.from_spec(
+        "gdelt", "actor:String,score:Double,dtg:Date,*geom:Point"
+    )
+    batch = FeatureBatch.from_pydict(
+        sft,
+        {
+            "actor": rng.choice(["USA", "FRA", "CHN"], n).tolist(),
+            "score": rng.uniform(-10, 10, n),
+            "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack(
+                [rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)], 1
+            ),
+        },
+    )
+    ds = DataStore(str(tmp_path / "cat"))
+    ds.create_schema(sft).write(batch)
+    return sft, batch, ds
+
+
+class TestSqlEngine:
+    def test_select_where_pushdown_parity(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT actor, score FROM gdelt WHERE "
+            "st_intersects(geom, st_makeBBOX(-60, -30, 60, 30)) "
+            "AND score > 2.5"
+        )
+        f = parse_cql("BBOX(geom, -60, -30, 60, 30) AND score > 2.5")
+        assert r.count == int(eval_filter(f, batch).sum())
+        assert list(r.features.sft.attribute_names) == ["actor", "score"]
+
+    def test_count_star(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql("SELECT COUNT(*) FROM gdelt WHERE actor = 'USA'")
+        f = parse_cql("actor = 'USA'")
+        assert r.kind == "count"
+        assert r.count == int(eval_filter(f, batch).sum())
+
+    def test_order_limit(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT score FROM gdelt WHERE score > 0 "
+            "ORDER BY score DESC LIMIT 5"
+        )
+        got = np.asarray(r.features.columns["score"])
+        allv = np.asarray(batch.columns["score"])
+        exp = np.sort(allv[allv > 0])[::-1][:5]
+        np.testing.assert_allclose(got, exp)
+
+    def test_contains_argument_flip(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        wkt = "POLYGON ((-60 -30, 60 -30, 60 30, -60 30, -60 -30))"
+        a = ctx.sql(
+            f"SELECT COUNT(*) FROM gdelt WHERE st_contains(st_geomFromWKT('{wkt}'), geom)"
+        )
+        b = ctx.sql(
+            f"SELECT COUNT(*) FROM gdelt WHERE st_within(geom, st_geomFromWKT('{wkt}'))"
+        )
+        assert a.count == b.count > 0
+
+    def test_temporal_between(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT COUNT(*) FROM gdelt WHERE dtg BETWEEN "
+            "'2020-06-01T00:00:00Z' AND '2020-08-01T00:00:00Z'"
+        )
+        t = np.asarray(batch.columns["dtg"])
+        f = parse_cql(
+            "dtg >= 2020-06-01T00:00:00Z AND dtg <= 2020-08-01T00:00:00Z"
+        )
+        assert r.count == int(eval_filter(f, batch).sum())
+
+    def test_dwithin_meters(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT COUNT(*) FROM gdelt WHERE "
+            "st_dwithin(geom, st_point(0, 0), 2000000)"
+        )
+        f = parse_cql("DWITHIN(geom, POINT (0 0), 2000000, meters)")
+        assert r.count == int(eval_filter(f, batch).sum())
+
+    def test_unsupported_compute_predicate_raises(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        with pytest.raises(SqlError, match="not pushable"):
+            ctx.sql("SELECT * FROM gdelt WHERE st_area(geom) > 2")
+
+    def test_in_like_null(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT COUNT(*) FROM gdelt WHERE actor IN ('USA', 'FRA')"
+        )
+        f = parse_cql("actor IN ('USA', 'FRA')")
+        assert r.count == int(eval_filter(f, batch).sum())
+        r2 = ctx.sql("SELECT COUNT(*) FROM gdelt WHERE actor LIKE 'U%'")
+        assert r2.count == int(
+            eval_filter(parse_cql("actor LIKE 'U%'"), batch).sum()
+        )
+
+
+class TestJobs:
+    def _csv_files(self, tmp_path, n_files=4, rows=30):
+        paths = []
+        rng = np.random.default_rng(0)
+        for i in range(n_files):
+            p = tmp_path / f"in_{i}.csv"
+            lines = []
+            for j in range(rows):
+                lines.append(
+                    f"a{i}_{j},{rng.uniform(-10, 10):.3f},"
+                    f"2020-06-0{1 + (j % 9)}T00:00:00Z,"
+                    f"{rng.uniform(-170, 170):.4f},{rng.uniform(-80, 80):.4f}"
+                )
+            p.write_text("\n".join(lines) + "\n")
+            paths.append(str(p))
+        return paths
+
+    def _converter_cfg(self):
+        return {
+            "type": "delimited-text",
+            "format": "CSV",
+            "id-field": "$1",
+            "fields": [
+                {"name": "actor", "transform": "$1::string"},
+                {"name": "score", "transform": "$2::double"},
+                {"name": "dtg", "transform": "isoDateTime($3)"},
+                {"name": "geom", "transform": "point($4::double, $5::double)"},
+            ],
+        }
+
+    def test_parallel_ingest_and_resume(self, tmp_path):
+        from geomesa_tpu.convert import converter_from_config
+
+        sft = SimpleFeatureType.from_spec(
+            "t", "actor:String,score:Double,dtg:Date,*geom:Point"
+        )
+        ds = DataStore(str(tmp_path / "cat"))
+        src = ds.create_schema(sft)
+        files = self._csv_files(tmp_path)
+        cfg = self._converter_cfg()
+        factory = lambda: converter_from_config(sft, cfg)
+        rep = ingest_files(src, factory, files, workers=3)
+        assert not rep.files_failed
+        assert rep.features == 4 * 30
+        assert src.get_count("INCLUDE") == 120
+        # re-run: everything skipped, nothing double-written
+        rep2 = ingest_files(src, factory, files, workers=3)
+        assert sorted(rep2.skipped) == sorted(files)
+        assert rep2.features == 0
+        assert src.get_count("INCLUDE") == 120
+
+    def test_ingest_failure_isolation(self, tmp_path):
+        from geomesa_tpu.convert import converter_from_config
+
+        sft = SimpleFeatureType.from_spec(
+            "t", "actor:String,score:Double,dtg:Date,*geom:Point"
+        )
+        ds = DataStore(str(tmp_path / "cat"))
+        src = ds.create_schema(sft)
+        files = self._csv_files(tmp_path, n_files=2)
+        missing = str(tmp_path / "nope.csv")
+        cfg = self._converter_cfg()
+        rep = ingest_files(
+            src, lambda: converter_from_config(sft, cfg), files + [missing],
+            workers=2,
+        )
+        assert len(rep.files_ok) == 2
+        assert len(rep.files_failed) == 1 and missing in rep.files_failed[0]
+        assert src.get_count("INCLUDE") == 60
+
+    def test_export_partitions(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        src = ds.get_feature_source("gdelt")
+        out = {}
+
+        def writer(name, b):
+            out[name] = len(b)
+
+        names = export_partitions(src, writer, cql="score > 0", workers=3)
+        assert names
+        f = parse_cql("score > 0")
+        assert sum(out.values()) == int(eval_filter(f, batch).sum())
