@@ -166,3 +166,36 @@ def read_ipc(path: str) -> List[FeatureBatch]:
                 meta[b"geomesa.sft.spec"].decode(),
             )
         return [from_arrow(rb, sft) for rb in reader]
+
+
+def merge_record_batches(batches: "List[pa.RecordBatch]") -> pa.RecordBatch:
+    """Merge per-shard Arrow result batches into one, unifying dictionary
+    columns whose vocabularies differ across shards.
+
+    Parity: the client-side delta/dictionary merge of the reference's
+    distributed ArrowScan (SimpleFeatureArrowFileWriter delta batches,
+    SURVEY.md C13) [upstream, unverified] — each tablet/shard emits batches
+    with its own dictionary; the reducer re-keys codes into one shared
+    vocabulary. Raises on schema-shape mismatch (same guarantee as the
+    reference: all deltas come from one query's transform schema).
+    """
+    if not batches:
+        raise ValueError("no batches to merge")
+    if len(batches) == 1:
+        return batches[0]
+    names = batches[0].schema.names
+    for rb in batches[1:]:
+        if rb.schema.names != names:
+            raise ValueError(
+                f"schema mismatch: {rb.schema.names} vs {names}"
+            )
+    # pa.unify_schemas + concat_tables(promote) handles dictionary
+    # re-keying; cast back to one record batch
+    table = pa.concat_tables(
+        [pa.Table.from_batches([rb]) for rb in batches],
+        promote_options="permissive",
+    ).combine_chunks()
+    out = table.to_batches()
+    if len(out) != 1:  # combine_chunks guarantees one chunk per column
+        out = [pa.concat_batches(out)] if hasattr(pa, "concat_batches") else out
+    return out[0]

@@ -109,6 +109,27 @@ class KVFeatureSource:
                 if self._fid_row.get(f) in rows_abs:
                     del self._fid_row[f]
 
+    def age_off(self, ttl_ms: int, now_ms: Optional[int] = None) -> int:
+        """Delete features older than ttl (upstream: DtgAgeOffIterator /
+        AgeOffIterator TTL enforcement, run as a maintenance sweep rather
+        than scan-time filtering). Returns the number removed."""
+        import time as _time
+
+        d = self.sft.default_dtg
+        if d is None:
+            raise ValueError("age_off needs a default dtg attribute")
+        now = now_ms if now_ms is not None else int(_time.time() * 1000)
+        cutoff = now - int(ttl_ms)
+        rows = []
+        for b, batch in enumerate(self._batches):
+            dtg = np.asarray(batch.columns[d.name], np.int64)
+            for i in np.nonzero(dtg < cutoff)[0]:
+                r = self._offsets[b] + int(i)
+                if r not in self._dead:
+                    rows.append(r)
+        self._delete_rows(rows)
+        return len(rows)
+
     def delete_features(self, query: "Query | str") -> int:
         """Delete everything matching the filter (upstream delete-features)."""
         r = self.get_features(query if not isinstance(query, str)

@@ -23,6 +23,7 @@ import numpy as np
 from geomesa_tpu.core.columnar import DictColumn, FeatureBatch, GeometryColumn
 from geomesa_tpu.core.sft import SimpleFeatureType
 from geomesa_tpu.core.wkt import Geometry, point
+from geomesa_tpu.cql import ast, parse_cql
 from geomesa_tpu.cql.extract import BBox, Interval
 from geomesa_tpu.kafka.cache import KafkaFeatureCache
 from geomesa_tpu.kafka.messages import (
@@ -35,6 +36,7 @@ from geomesa_tpu.kafka.messages import (
 from geomesa_tpu.plan.audit import AuditWriter
 from geomesa_tpu.plan.datastore import FeatureSource
 from geomesa_tpu.plan.planner import QueryPlanner
+from geomesa_tpu.plan.query import Query
 
 
 class InProcessBroker:
@@ -118,6 +120,45 @@ class KafkaFeatureSource(FeatureSource):
         return super().get_count(query)
 
 
+class KafkaLayerView(KafkaFeatureSource):
+    """Filtered/projected derived view over a live layer (read-only)."""
+
+    def __init__(self, store, base_name, view_name, cql, attributes):
+        super().__init__(store, base_name)
+        self.view_name = view_name
+        self.view_filter = parse_cql(cql) if isinstance(cql, str) else cql
+        self.view_attributes = list(attributes) if attributes else None
+
+    def _narrow(self, query):
+        if isinstance(query, str):
+            query = Query(self._name, query)
+        f = query.filter_ast
+        merged = (
+            self.view_filter
+            if isinstance(f, ast.Include)
+            else ast.And((self.view_filter, f))
+        )
+        attrs = query.attributes
+        if self.view_attributes is not None:
+            attrs = (
+                self.view_attributes
+                if attrs is None
+                else [a for a in attrs if a in self.view_attributes]
+            )
+        import dataclasses as _dc
+
+        return _dc.replace(query, filter=merged, attributes=attrs)
+
+    def write(self, batch) -> None:
+        raise TypeError(f"layer view {self.view_name!r} is read-only")
+
+    def get_features(self, query="INCLUDE"):
+        return super().get_features(self._narrow(query))
+
+    def get_count(self, query="INCLUDE") -> int:
+        return super().get_count(self._narrow(query))
+
+
 class KafkaDataStore:
     def __init__(
         self,
@@ -158,6 +199,28 @@ class KafkaDataStore:
 
     def cache(self, name: str) -> KafkaFeatureCache:
         return self._state[name]["cache"]
+
+    # -- layer views -------------------------------------------------------
+
+    def create_layer_view(
+        self,
+        view_name: str,
+        base_name: str,
+        cql: str = "INCLUDE",
+        attributes: Optional[List[str]] = None,
+    ) -> "KafkaLayerView":
+        """A derived read-only view of a live layer: the base layer's
+        stream with a standing filter and/or projection (upstream: Kafka
+        layer views, SURVEY.md C12). Views share the base cache — no data
+        is duplicated; the view filter ANDs into every query."""
+        if base_name not in self._state:
+            raise KeyError(f"no live schema {base_name!r}")
+        view = KafkaLayerView(self, base_name, view_name, cql, attributes)
+        self._state[base_name].setdefault("views", {})[view_name] = view
+        return view
+
+    def get_layer_view(self, base_name: str, view_name: str) -> "KafkaLayerView":
+        return self._state[base_name]["views"][view_name]
 
     # -- producer side -----------------------------------------------------
 

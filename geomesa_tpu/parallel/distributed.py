@@ -1,0 +1,75 @@
+"""Multi-host distributed initialization (DCN) for the shard mesh.
+
+Parity: the reference's distributed runtime is storage RPC + Zookeeper
+coordination (SURVEY.md C27/§5.8); the TPU-native equivalent is
+`jax.distributed` over DCN with one global mesh on the same "shard" axis
+the single-host kernels already use. Because every kernel in engine/ is
+written against the mesh axis name (not a device count), scaling to
+multi-host is configuration, not code: collectives ride ICI within a slice
+and DCN across slices, routed by XLA.
+
+Usage on each host (same program, standard JAX multi-host SPMD):
+
+    from geomesa_tpu.parallel.distributed import initialize, global_mesh
+    initialize(coordinator="host0:1234", num_processes=4, process_id=ID)
+    mesh = global_mesh()           # one "shard" axis over ALL devices
+    dev = shard_batch_host(local_batch, mesh)   # per-host arrays
+    grid = density_sharded(mesh, ...)           # psum crosses hosts
+
+Host-level data feeding follows the reference's storage division: each host
+reads its own partitions (FS store over a shared filesystem), mirroring
+per-tablet data locality; result merging is the collectives' job.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+
+def initialize(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """jax.distributed.initialize with env-var fallback
+    (GEOMESA_TPU_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID; on Cloud TPU
+    pods all three are auto-detected and may be omitted)."""
+    import jax
+
+    coordinator = coordinator or os.environ.get("GEOMESA_TPU_COORDINATOR")
+    if num_processes is None and "GEOMESA_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["GEOMESA_TPU_NUM_PROCESSES"])
+    if process_id is None and "GEOMESA_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["GEOMESA_TPU_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh():
+    """One 1-D mesh with the shard axis over every device of every host.
+
+    jax.devices() is globally consistent across processes after
+    initialize(), so each host constructs the identical mesh."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (SHARD_AXIS,))
+
+
+def process_partitions(partitions, process_id=None, num_processes=None):
+    """Deterministic partition->host assignment for host-local feeding:
+    host i reads partitions[i::P] (the per-tablet locality analog). Same
+    list on every host => disjoint, exhaustive coverage."""
+    import jax
+
+    pid = process_id if process_id is not None else jax.process_index()
+    n = num_processes if num_processes is not None else jax.process_count()
+    return sorted(partitions)[pid::n]

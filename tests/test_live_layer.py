@@ -285,3 +285,114 @@ class TestLambdaStore:
         fids = res.features.fids.decode()
         scores = np.asarray(res.features.column("score"))
         assert scores[fids.index("f0")] == pytest.approx(99.0)
+
+
+class TestLayerViews:
+    def _store(self):
+        import numpy as np
+
+        from geomesa_tpu.core.columnar import FeatureBatch
+        from geomesa_tpu.core.sft import SimpleFeatureType
+        from geomesa_tpu.kafka.store import KafkaDataStore
+
+        rng = np.random.default_rng(8)
+        n = 120
+        sft = SimpleFeatureType.from_spec(
+            "live", "actor:String,score:Double,dtg:Date,*geom:Point"
+        )
+        batch = FeatureBatch.from_pydict(
+            sft,
+            {
+                "actor": rng.choice(["USA", "FRA"], n).tolist(),
+                "score": rng.uniform(-10, 10, n),
+                "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+                "geom": np.stack(
+                    [rng.uniform(-60, 60, n), rng.uniform(-40, 40, n)], 1
+                ),
+            },
+        )
+        ds = KafkaDataStore()
+        src = ds.create_schema(sft)
+        src.write(batch)
+        return ds, src, batch
+
+    def test_view_filters_and_projects(self):
+        import numpy as np
+
+        ds, src, batch = self._store()
+        view = ds.create_layer_view(
+            "usa_only", "live", "actor = 'USA'", attributes=["actor", "score"]
+        )
+        actors = np.array(batch.columns["actor"].decode())
+        assert view.get_count("INCLUDE") == int((actors == "USA").sum())
+        r = view.get_features("score > 0")
+        scores = np.asarray(batch.columns["score"])
+        assert r.count == int(((actors == "USA") & (scores > 0)).sum())
+        assert list(r.features.sft.attribute_names) == ["actor", "score"]
+
+    def test_view_read_only_and_live(self):
+        import numpy as np
+        import pytest as _pytest
+
+        from geomesa_tpu.core.columnar import FeatureBatch
+
+        ds, src, batch = self._store()
+        view = ds.create_layer_view("v", "live", "actor = 'FRA'")
+        before = view.get_count()
+        with _pytest.raises(TypeError):
+            view.write(batch)
+        # new writes to the base flow into the view
+        sub = batch.select(np.arange(5))
+        fra = FeatureBatch(
+            sub.sft,
+            {**sub.columns, "actor": type(sub.columns["actor"]).encode(["FRA"] * 5)},
+            type(sub.columns["actor"]).encode([f"new-{i}" for i in range(5)]),
+            sub.valid,
+        )
+        src.write(fra)
+        assert view.get_count() == before + 5
+
+
+class TestAgeOff:
+    def test_kv_age_off(self):
+        import numpy as np
+
+        from geomesa_tpu.core.columnar import FeatureBatch
+        from geomesa_tpu.core.sft import SimpleFeatureType
+        from geomesa_tpu.index import KVDataStore
+
+        sft = SimpleFeatureType.from_spec("t", "v:Integer,dtg:Date,*geom:Point")
+        now = 1_600_000_000_000
+        dtg = np.array([now - 10_000, now - 5_000, now - 500, now - 100])
+        batch = FeatureBatch.from_pydict(
+            sft, {"v": [1, 2, 3, 4], "dtg": dtg, "geom": np.zeros((4, 2))}
+        )
+        ds = KVDataStore()
+        src = ds.create_schema(sft)
+        src.write(batch)
+        removed = src.age_off(ttl_ms=1_000, now_ms=now)
+        assert removed == 2
+        assert src.live_count == 2
+        r = src.get_features("v > 0")
+        assert sorted(np.asarray(r.features.columns["v"]).tolist()) == [3, 4]
+
+
+class TestArrowMerge:
+    def test_dictionary_unification(self):
+        import numpy as np
+
+        from geomesa_tpu.core.arrow_io import from_arrow, merge_record_batches, to_arrow
+        from geomesa_tpu.core.columnar import FeatureBatch
+        from geomesa_tpu.core.sft import SimpleFeatureType
+
+        sft = SimpleFeatureType.from_spec("t", "name:String,*geom:Point")
+        b1 = FeatureBatch.from_pydict(
+            sft, {"name": ["a", "b", "a"], "geom": np.zeros((3, 2))}
+        )
+        b2 = FeatureBatch.from_pydict(
+            sft, {"name": ["c", "b"], "geom": np.ones((2, 2))}
+        )
+        merged = merge_record_batches([to_arrow(b1), to_arrow(b2)])
+        out = from_arrow(merged)
+        assert len(out) == 5
+        assert out.columns["name"].decode() == ["a", "b", "a", "c", "b"]
