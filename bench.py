@@ -287,6 +287,11 @@ def main(argv=None) -> int:
         help="BASELINE.json config to run (default: 3, the headline "
              "BBOX+time+kNN metric; 1=fs-query 2=pip 4=density 5=tube)",
     )
+    p.add_argument(
+        "--impl", choices=["mxu", "haversine"], default="mxu",
+        help="config-3 kNN kernel: mxu = dot-product matmul + exact refine "
+             "(systolic-array path), haversine = elementwise VPU",
+    )
     args = p.parse_args(argv)
 
     if args.smoke:
@@ -313,7 +318,7 @@ def main(argv=None) -> int:
     import jax
     import jax.numpy as jnp
 
-    from geomesa_tpu.engine.knn import knn
+    from geomesa_tpu.engine.knn import knn, knn_mxu
 
     rng = np.random.default_rng(42)
     x = rng.uniform(-180, 180, n)
@@ -326,13 +331,18 @@ def main(argv=None) -> int:
     T0, T1 = 1_592_000_000_000, 1_598_000_000_000
 
     # --- device pipeline (one fused jit: mask + kNN) ----------------------
+    knn_fn = knn_mxu if args.impl == "mxu" else knn
+
     @jax.jit
     def device_step(x, y, t, speed, qx, qy):
         mask = (
             (x >= BBOX[0]) & (x <= BBOX[2]) & (y >= BBOX[1]) & (y <= BBOX[3])
             & (t > T0) & (t < T1) & (speed > 5.0)
         )
-        dists, idx = knn(qx, qy, x, y, mask, k=k, query_tile=q)
+        if args.impl == "mxu":
+            dists, idx = knn_fn(qx, qy, x, y, mask, k=k)  # sorts + tiles itself
+        else:
+            dists, idx = knn_fn(qx, qy, x, y, mask, k=k, query_tile=q)
         return jnp.sum(mask.astype(jnp.int32)), dists
 
     dx = jnp.asarray(x, jnp.float32)

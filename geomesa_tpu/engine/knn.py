@@ -134,6 +134,203 @@ def knn(
     )
 
 
+def _unit3(lon: jax.Array, lat: jax.Array) -> jax.Array:
+    """[N] lon/lat degrees -> [N,3] unit vectors on the sphere (f32)."""
+    rlon = jnp.radians(lon.astype(jnp.float32))
+    rlat = jnp.radians(lat.astype(jnp.float32))
+    cl = jnp.cos(rlat)
+    return jnp.stack([cl * jnp.cos(rlon), cl * jnp.sin(rlon), jnp.sin(rlat)], -1)
+
+
+def _morton16(lon: jax.Array, lat: jax.Array) -> jax.Array:
+    """Z-order key from 16-bit-quantized lon/lat (device-side, jit-safe)."""
+    qx = jnp.clip(((lon + 180.0) / 360.0 * 65535.0), 0, 65535).astype(jnp.uint32)
+    qy = jnp.clip(((lat + 90.0) / 180.0 * 65535.0), 0, 65535).astype(jnp.uint32)
+
+    def spread(v):
+        v = (v | (v << 8)) & jnp.uint32(0x00FF00FF)
+        v = (v | (v << 4)) & jnp.uint32(0x0F0F0F0F)
+        v = (v | (v << 2)) & jnp.uint32(0x33333333)
+        v = (v | (v << 1)) & jnp.uint32(0x55555555)
+        return v
+
+    return spread(qx) | (spread(qy) << 1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k", "query_tile", "data_tile", "margin", "with_flags", "presorted"
+    ),
+)
+def knn_mxu(
+    qx: jax.Array,
+    qy: jax.Array,
+    dx: jax.Array,
+    dy: jax.Array,
+    mask: jax.Array,
+    k: int,
+    query_tile: int = 64,
+    data_tile: Optional[int] = None,
+    margin: Optional[int] = None,
+    with_flags: bool = False,
+    presorted: bool = False,
+):
+    """kNN via the MXU: centered chord-distance matmul + exact refine.
+
+    Same contract as `knn`. The great-circle distance is monotonic in the
+    3D chord distance, so top-k by smallest chord^2 equals top-k by
+    smallest haversine. With points as unit vectors, chord^2 = 2 - 2 q.d
+    cancels catastrophically in f32 for nearby points (every dot rounds to
+    1.0 inside a ~3 km cluster). Instead both sides are translated by the
+    query tile's centroid c and
+
+        chord^2 = |q-c|^2 + |d-c|^2 - 2 (q-c).(d-c)
+
+    — translation-invariant and exact in infinite precision, while every
+    operand now scales with distance-from-centroid, so f32 resolution is
+    relative to the local spread rather than to 1.0. The cross term is a
+    [Q,3]x[3,N] matmul on the MXU (~3 MACs/pair at systolic-array rate vs
+    ~20 VPU transcendental ops/pair for direct haversine); the norms are
+    cheap elementwise VPU work.
+
+    Accuracy model (documented, tested): the f32 rounding noise in chord^2
+    is ~6e-8 * r^2 for r = the query TILE's radius in radians. Queries are
+    therefore Z-order-sorted internally so each tile of `query_tile`
+    (default 64) consecutive queries is as spatially compact as the query
+    distribution allows, the candidate pool keeps a top-M margin
+    (M = max(4k, 64)) per query, and the final k come from EXACT haversine
+    over those M gathered candidates. A true neighbor can only be lost when
+    MORE than M-k data points sit inside the noise band around the k-th
+    distance — i.e. a meters-dense data cluster queried from a tile whose
+    other queries are 100s of km away (the sorted-order tile that straddles
+    a cluster boundary). For guaranteed exactness, `with_flags=True` also
+    returns a per-query bool that is True whenever the noise bound CANNOT
+    prove the result exact: the refined pool's chord^2 span is compared
+    against 2B for B = a conservative multiple of eps*r_tile^2. Callers
+    (the KNN process does this) re-run flagged queries on the exact
+    haversine path — typically none, or only the handful in boundary tiles.
+
+    Small query sets (Q < 128) fall back to the exact haversine path: with
+    so few MXU rows the kernel is HBM-bandwidth-bound either way, so the
+    matmul buys nothing and tile compactness cannot be established.
+    """
+    q = qx.shape[0]
+    n = dx.shape[0]
+    if q < 128:
+        fd, fi = knn(qx, qy, dx, dy, mask, k=k,
+                     query_tile=min(query_tile, max(q, 1)), data_tile=data_tile)
+        return (fd, fi, jnp.zeros(q, bool)) if with_flags else (fd, fi)
+    m = margin if margin is not None else max(4 * k, 64)
+    m = min(m, n) if n else m
+    if data_tile is None:
+        data_tile = max(m, min(n, (1 << 26) // max(query_tile, 1)))
+
+    # compact tiles: process queries in Z-order, un-permute at the end.
+    # presorted=True lets loop callers (knn_ring) sort once outside.
+    if presorted:
+        inv = None
+    else:
+        order = jnp.argsort(_morton16(qx, qy))
+        inv = jnp.argsort(order)
+        qx = jnp.take(qx, order)
+        qy = jnp.take(qy, order)
+
+    pad = (-q) % query_tile
+    # edge-pad so padded lanes don't drag the tile centroid off-cluster
+    qxp = jnp.pad(qx, (0, pad), mode="edge") if q else jnp.pad(qx, (0, pad))
+    qyp = jnp.pad(qy, (0, pad), mode="edge") if q else jnp.pad(qy, (0, pad))
+    qu = _unit3(qxp, qyp)
+    tiles_q = qu.reshape(-1, query_tile, 3)
+
+    dpad = (-n) % data_tile
+    du = _unit3(jnp.pad(dx, (0, dpad)), jnp.pad(dy, (0, dpad)))
+    dut = du.reshape(-1, data_tile, 3)
+    mp = jnp.pad(mask, (0, dpad)).reshape(-1, data_tile)
+    n_dtiles = dut.shape[0]
+    BIG = jnp.float32(8.0)  # > max chord^2 (4.0)
+
+    def tile(tq):
+        c = tq.mean(axis=0)
+        tqc = tq - c
+        nq = jnp.sum(tqc * tqc, axis=-1)  # [query_tile]
+        r2_tile = jnp.max(nq)  # squared tile radius, for the noise bound
+
+        def fold(carry, xs):
+            bs, bi = carry
+            dt, mt, base = xs
+            dtc = dt - c
+            nd = jnp.sum(dtc * dtc, axis=-1)  # [data_tile]
+            # [query_tile, data_tile] cross term on the MXU
+            s = jax.lax.dot_general(
+                tqc, dtc, (((1,), (1,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            chord2 = nq[:, None] + nd[None, :] - 2.0 * s
+            chord2 = jnp.where(mt[None, :], chord2, BIG)
+            ls, li = _topk_smallest(chord2, min(m, data_tile))
+            gi = jnp.minimum((li + base).astype(jnp.int32), n - 1)
+            pool_s = jnp.concatenate([bs, ls], axis=1)
+            pool_i = jnp.concatenate([bi, gi], axis=1)
+            ns, sel = _topk_smallest(pool_s, m)
+            ni = jnp.take_along_axis(pool_i, sel, axis=1)
+            return (ns, ni), None
+
+        vzero = jnp.sum(tq[:1, :1] * 0) + jnp.sum(dut[:1, :1, :1] * 0)
+        init = (
+            jnp.full((query_tile, m), BIG) + vzero,
+            jnp.zeros((query_tile, m), jnp.int32) + vzero.astype(jnp.int32),
+        )
+        bases = (jnp.arange(n_dtiles) * data_tile).astype(jnp.int32)
+        (bs, bi), _ = jax.lax.scan(fold, init, (dut, mp, bases))
+        return bs, bi, jnp.broadcast_to(r2_tile, (tq.shape[0],))
+
+    chord2, cidx, r2 = jax.lax.map(tile, tiles_q)
+    chord2 = chord2.reshape(-1, m)[:q]
+    cidx = cidx.reshape(-1, m)[:q]
+    r2 = r2.reshape(-1)[:q]
+
+    # exact refine: haversine over the gathered M candidates per query
+    cx = jnp.take(dx, cidx)
+    cy = jnp.take(dy, cidx)
+    dist_dtype = jnp.promote_types(jnp.promote_types(qx.dtype, dx.dtype), jnp.float32)
+    d = haversine_m(
+        qx[:, None].astype(dist_dtype), qy[:, None].astype(dist_dtype),
+        cx.astype(dist_dtype), cy.astype(dist_dtype),
+    )
+    d = jnp.where(chord2 >= BIG / 2, INF, d)  # masked / unfilled slots
+    fd, sel = _topk_smallest(d, k)
+    fi = jnp.take_along_axis(cidx, sel, axis=1)
+    fd_out = fd if inv is None else jnp.take(fd, inv, axis=0)
+    fi_out = fi if inv is None else jnp.take(fi, inv, axis=0)
+    if not with_flags:
+        return fd_out, fi_out
+
+    # exactness certificate: an excluded point's true chord^2 exceeds the
+    # pool's selection threshold minus the rounding-noise bound B; if the
+    # exact k-th..M-th chord^2 span is wider than 2B, no excluded point can
+    # beat the k-th neighbor and the result is provably exact.
+    from geomesa_tpu.engine.geodesy import EARTH_RADIUS_M
+
+    EPS = jnp.float32(6e-8)  # f32 ulp at ~1 (matmul/norm rounding)
+    KAPPA = jnp.float32(8.0)  # roundings of magnitude <= eps * r^2 each
+    ETA = jnp.float32(1.3e-7)  # unit-vector f32 quantization (per point)
+    finite = jnp.isfinite(d)
+    has_unfilled = jnp.any(~finite, axis=1)  # pool held every candidate
+    d_M = jnp.max(jnp.where(finite, d, -jnp.inf), axis=1)
+    chord_k = 2.0 * jnp.sin(fd[:, -1] / (2.0 * EARTH_RADIUS_M))
+    chord_M = 2.0 * jnp.sin(jnp.where(jnp.isfinite(d_M), d_M, 0.0)
+                            / (2.0 * EARTH_RADIUS_M))
+    B = KAPPA * EPS * r2 + 8.0 * ETA * chord_k
+    uncertain = (
+        ~has_unfilled
+        & (chord_M * chord_M - chord_k * chord_k < 2.0 * B)
+    )
+    if inv is not None:
+        uncertain = jnp.take(uncertain, inv, axis=0)
+    return fd_out, fi_out, uncertain
+
+
 def knn_sharded(
     mesh: Mesh,
     qx: jax.Array,
@@ -143,6 +340,7 @@ def knn_sharded(
     mask: jax.Array,
     k: int,
     query_tile: int = 1024,
+    impl: str = "haversine",
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN with data sharded over the mesh: local top-k + all_gather
     merge. Returns (dists [Q,k], global indices [Q,k]).
@@ -151,7 +349,19 @@ def knn_sharded(
     global top-k is a subset of the union of per-shard top-ks, so the merged
     re-top-k is exact — the same argument as the reference's per-tablet
     aggregation + client merge, with psum-free O(D·Q·k) gather traffic.
+
+    impl: "haversine" (VPU, bit-exact — the merge argument above holds
+    unconditionally) or "mxu" (`knn_mxu` without its exactness certificate:
+    the local top-k inherits knn_mxu's f32 noise model, so cluster-boundary
+    query tiles can mis-rank meters-scale near-ties; use the KNN process or
+    impl="haversine" where guaranteed exactness is required).
     """
+    if impl == "mxu":
+        def local(*a, **kw):
+            kw["query_tile"] = min(kw.pop("query_tile", 64), 64)
+            return knn_mxu(*a, **kw)
+    else:
+        local = knn
     d_count = mesh.devices.size
     shard_n = dx.shape[0] // d_count
 
@@ -165,7 +375,7 @@ def knn_sharded(
         check_vma=False,
     )
     def run(qx, qy, dx, dy, mask):
-        dists, idx = knn(qx, qy, dx, dy, mask, k=k, query_tile=query_tile)
+        dists, idx = local(qx, qy, dx, dy, mask, k=k, query_tile=query_tile)
         shard = jax.lax.axis_index(SHARD_AXIS)
         gidx = idx + shard * shard_n
         # [D, Q, k] candidate pools on every device
@@ -188,6 +398,7 @@ def knn_ring(
     mask: jax.Array,
     k: int,
     query_tile: int = 1024,
+    impl: str = "haversine",
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN with BOTH queries and data sharded: ring top-k.
 
@@ -196,7 +407,12 @@ def knn_ring(
     visiting shard into its running top-k. Communication is the data shard
     itself (the ring-attention access pattern), never the QxN distances.
     Returns (dists, global indices) sharded like the queries.
+
+    impl: "haversine" (bit-exact) or "mxu" (knn_mxu's f32 noise model, no
+    certificate — see knn_sharded). For mxu the Z-order query sort is
+    hoisted out of the ring loop (queries never change between steps).
     """
+    use_mxu = impl == "mxu"
     d_count = mesh.devices.size
     shard_n = dx.shape[0] // d_count
 
@@ -213,10 +429,22 @@ def knn_ring(
         me = jax.lax.axis_index(SHARD_AXIS)
         perm = [(i, (i + 1) % d_count) for i in range(d_count)]
 
+        if use_mxu:
+            order = jnp.argsort(_morton16(qx, qy))
+            inv = jnp.argsort(order)
+            qx = jnp.take(qx, order)
+            qy = jnp.take(qy, order)
+
+            def local(qx, qy, dx, dy, mask, k, query_tile):
+                return knn_mxu(qx, qy, dx, dy, mask, k=k,
+                               query_tile=min(query_tile, 64), presorted=True)
+        else:
+            local = knn
+
         def step(i, carry):
             best_d, best_i, dx, dy, mask = carry
             owner = (me - i) % d_count  # whose shard is visiting
-            ld, li = knn(qx, qy, dx, dy, mask, k=k, query_tile=query_tile)
+            ld, li = local(qx, qy, dx, dy, mask, k=k, query_tile=query_tile)
             gi = (li + owner * shard_n).astype(jnp.int32)
             pool_d = jnp.concatenate([best_d, ld], axis=1)
             pool_i = jnp.concatenate([best_i, gi], axis=1)
@@ -237,6 +465,9 @@ def knn_ring(
         best_d, best_i, *_ = jax.lax.fori_loop(
             0, d_count, step, (best_d, best_i, dx, dy, mask)
         )
+        if use_mxu:
+            best_d = jnp.take(best_d, inv, axis=0)
+            best_i = jnp.take(best_i, inv, axis=0)
         return best_d, best_i
 
     return run(qx, qy, dx, dy, mask)

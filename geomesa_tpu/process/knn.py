@@ -48,7 +48,12 @@ class KNearestNeighborSearchProcess:
         max_search_distance_m: float = 1_000_000.0,
         cql_filter: str = "INCLUDE",
         query_tile: int = 1024,
+        impl: str = "haversine",
     ) -> KnnResult:
+        """impl: "haversine" (f64 coords, bit-exact) or "mxu" (f32 coords,
+        centered chord-distance matmul on the systolic array with exact
+        haversine refine; certificate-flagged queries are re-solved on the
+        exact path — see engine.knn.knn_mxu for the accuracy model)."""
         qcol = input_features.geometry
         qx, qy = np.asarray(qcol.x), np.asarray(qcol.y)
 
@@ -56,7 +61,8 @@ class KNearestNeighborSearchProcess:
             # materialized input: one exact pass, no window growth possible
             candidates = filter_batch(data_features, cql_filter)
             return self._solve(
-                qx, qy, candidates, num_desired, max_search_distance_m, query_tile
+                qx, qy, candidates, num_desired, max_search_distance_m,
+                query_tile, impl,
             )
 
         radius = max(float(estimated_distance_m), 1.0)
@@ -72,12 +78,13 @@ class KNearestNeighborSearchProcess:
                         candidates
                         if candidates is not None
                         else input_features.select(np.zeros(0, np.int64)),
-                        num_desired, max_search_distance_m, query_tile,
+                        num_desired, max_search_distance_m, query_tile, impl,
                     )
                 radius = min(radius * 2, max_search_distance_m)
                 continue
             result = self._solve(
-                qx, qy, candidates, num_desired, max_search_distance_m, query_tile
+                qx, qy, candidates, num_desired, max_search_distance_m,
+                query_tile, impl,
             )
             # recall condition: every query's k-th neighbor must lie within
             # the searched radius, else a closer point may sit outside the
@@ -91,7 +98,8 @@ class KNearestNeighborSearchProcess:
             return result
 
     def _solve(
-        self, qx, qy, candidates: FeatureBatch, k: int, max_dist: float, query_tile: int
+        self, qx, qy, candidates: FeatureBatch, k: int, max_dist: float,
+        query_tile: int, impl: str = "haversine",
     ) -> KnnResult:
         if candidates is None or len(candidates) == 0:
             return KnnResult(
@@ -102,18 +110,38 @@ class KNearestNeighborSearchProcess:
         import jax.numpy as jnp
 
         from geomesa_tpu.engine.device import to_device
-        from geomesa_tpu.engine.knn import knn
+        from geomesa_tpu.engine.knn import knn, knn_mxu
 
-        dev = to_device(candidates, coord_dtype=jnp.float64)
-        g = candidates.sft.default_geometry
-        dists, idx = knn(
-            jnp.asarray(qx), jnp.asarray(qy),
-            dev[f"{g.name}__x"], dev[f"{g.name}__y"], dev["__valid__"],
-            k=min(k, len(candidates)),
-            query_tile=min(query_tile, max(len(qx), 1)),
+        use_mxu = impl == "mxu"
+        dev = to_device(
+            candidates, coord_dtype=jnp.float32 if use_mxu else jnp.float64
         )
-        dists = np.asarray(dists)
-        idx = np.asarray(idx)
+        g = candidates.sft.default_geometry
+        cx, cy, valid = dev[f"{g.name}__x"], dev[f"{g.name}__y"], dev["__valid__"]
+        kk = min(k, len(candidates))
+        if use_mxu:
+            dists, idx, flags = knn_mxu(
+                jnp.asarray(qx), jnp.asarray(qy), cx, cy, valid,
+                k=kk, with_flags=True,
+            )
+            dists, idx = np.array(dists), np.array(idx)
+            flags = np.asarray(flags)
+            if flags.any():
+                # certificate failed for these queries (cluster-boundary
+                # tiles): re-solve just them on the exact haversine path
+                fqx, fqy = qx[flags], qy[flags]
+                ed, ei = knn(
+                    jnp.asarray(fqx), jnp.asarray(fqy), cx, cy, valid,
+                    k=kk, query_tile=min(query_tile, max(len(fqx), 1)),
+                )
+                dists[flags] = np.asarray(ed)
+                idx[flags] = np.asarray(ei)
+        else:
+            dists, idx = knn(
+                jnp.asarray(qx), jnp.asarray(qy), cx, cy, valid,
+                k=kk, query_tile=min(query_tile, max(len(qx), 1)),
+            )
+            dists, idx = np.asarray(dists), np.asarray(idx)
         if dists.shape[1] < k:
             pad = k - dists.shape[1]
             dists = np.pad(dists, ((0, 0), (0, pad)), constant_values=np.inf)

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 from geomesa_tpu.engine.bin import bin_pack, decode_bin, encode_bin
 from geomesa_tpu.engine.density import density_grid, density_sharded, gaussian_blur
 from geomesa_tpu.engine.geodesy import haversine_m, haversine_m_np
-from geomesa_tpu.engine.knn import knn, knn_ring, knn_sharded
+from geomesa_tpu.engine.knn import knn, knn_mxu, knn_ring, knn_sharded
 from geomesa_tpu.engine.stats import (
     masked_count,
     masked_histogram,
@@ -76,6 +76,173 @@ class TestKNN:
         )
         r = recall_at_k(np.asarray(idx), true_d, self.oracle_d, self.k)
         assert r == 1.0
+
+    def _mxu_queries(self, q=160):
+        # >= 128 queries so knn_mxu takes the matmul path, not the small-Q
+        # exact fallback (q < 128 falls back to `knn` by design)
+        mqx = rng.uniform(-10, 10, q)
+        mqy = rng.uniform(40, 60, q)
+        d = haversine_m_np(
+            mqx[:, None], mqy[:, None], self.dx[None, :], self.dy[None, :]
+        )
+        return mqx, mqy, np.sort(d, axis=1)
+
+    def test_mxu_recall_parity(self):
+        # the matmul-similarity path must hit full tie-tolerant recall
+        mqx, mqy, oracle = self._mxu_queries()
+        dists, idx = knn_mxu(
+            jnp.asarray(mqx), jnp.asarray(mqy),
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(self.mask), k=self.k, query_tile=32,
+        )
+        true_d = haversine_m_np(
+            mqx[:, None], mqy[:, None],
+            self.dx[np.asarray(idx)], self.dy[np.asarray(idx)],
+        )
+        r = recall_at_k(np.asarray(idx), true_d, oracle, self.k)
+        assert r == 1.0
+        # refined distances match the oracle to sub-meter
+        np.testing.assert_allclose(
+            np.sort(np.asarray(dists), 1), oracle[:, : self.k], atol=1.0
+        )
+
+    def test_mxu_clustered_near_ties(self):
+        # dense cluster: many near-equal distances stress the f32 margin
+        n, q, k = 20_000, 160, 8
+        cdx = rng.normal(2.0, 0.01, n)  # ~1km cluster
+        cdy = rng.normal(48.0, 0.01, n)
+        cqx = rng.normal(2.0, 0.01, q)
+        cqy = rng.normal(48.0, 0.01, q)
+        mask = np.ones(n, bool)
+        d_or = np.sort(
+            haversine_m_np(cqx[:, None], cqy[:, None], cdx[None, :], cdy[None, :]), 1
+        )
+        dists, idx = knn_mxu(
+            jnp.asarray(cqx), jnp.asarray(cqy), jnp.asarray(cdx),
+            jnp.asarray(cdy), jnp.asarray(mask), k=k, query_tile=32,
+        )
+        true_d = haversine_m_np(cqx[:, None], cqy[:, None],
+                                cdx[np.asarray(idx)], cdy[np.asarray(idx)])
+        assert recall_at_k(np.asarray(idx), true_d, d_or, k, tol=1.0) == 1.0
+
+    def test_mxu_masked_and_small_n(self):
+        mqx, mqy, _ = self._mxu_queries()
+        mask = self.mask.copy()
+        mask[:2500] = False
+        dists, idx = knn_mxu(
+            jnp.asarray(mqx), jnp.asarray(mqy),
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(mask), k=self.k,
+        )
+        assert np.asarray(idx).min() >= 2500
+        # n < k (and q < 128: the exact-fallback path): pads with inf
+        d, i = knn_mxu(
+            jnp.asarray(self.qx[:4]), jnp.asarray(self.qy[:4]),
+            jnp.asarray(self.dx[:3]), jnp.asarray(self.dy[:3]),
+            jnp.ones(3, bool), k=self.k,
+        )
+        assert np.isinf(np.asarray(d)[:, 3:]).all()
+
+    def test_mxu_small_q_falls_back_exact(self):
+        # q < 128 must route to the bit-exact haversine kernel
+        d1, i1 = knn(
+            jnp.asarray(self.qx), jnp.asarray(self.qy),
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(self.mask), k=self.k, query_tile=64,
+        )
+        d2, i2 = knn_mxu(
+            jnp.asarray(self.qx), jnp.asarray(self.qy),
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(self.mask), k=self.k,
+        )
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+    def test_mxu_certificate_flags_boundary_tiles(self):
+        # mixed workload: meters-dense port cluster + spread sea queries.
+        # The sorted-order tile straddling the cluster boundary loses f32
+        # precision; the exactness certificate must flag every query whose
+        # error exceeds the refine tolerance, and flag far fewer than all.
+        r = np.random.default_rng(5)
+        n, q, k = 30_000, 256, 8
+        pts = np.concatenate([
+            np.stack([r.normal(4.0, 0.005, n // 2), r.normal(51.9, 0.005, n // 2)], 1),
+            np.stack([r.uniform(-10, 10, n - n // 2), r.uniform(48, 58, n - n // 2)], 1),
+        ])
+        qpts = np.concatenate([
+            np.stack([r.normal(4.0, 0.005, q // 2), r.normal(51.9, 0.005, q // 2)], 1),
+            np.stack([r.uniform(-10, 10, q - q // 2), r.uniform(48, 58, q - q // 2)], 1),
+        ])
+        dists, idx, flags = knn_mxu(
+            jnp.asarray(qpts[:, 0], jnp.float32), jnp.asarray(qpts[:, 1], jnp.float32),
+            jnp.asarray(pts[:, 0], jnp.float32), jnp.asarray(pts[:, 1], jnp.float32),
+            jnp.ones(n, bool), k=k, with_flags=True,
+        )
+        oracle = np.sort(haversine_m_np(
+            qpts[:, 0:1], qpts[:, 1:2], pts[None, :, 0], pts[None, :, 1]
+        ), axis=1)[:, :k]
+        err = np.abs(np.sort(np.asarray(dists), 1) - oracle).max(1)
+        flags = np.asarray(flags)
+        assert np.all(flags[err > 1.0]), "unflagged query with >1m error"
+        assert flags.sum() < q // 2, "certificate flags too much to be useful"
+
+    def test_process_mxu_exact_via_fallback(self):
+        # process layer must deliver oracle-exact results for impl=mxu by
+        # re-solving flagged queries on the exact path
+        from geomesa_tpu.core.sft import SimpleFeatureType
+        from geomesa_tpu.core.columnar import FeatureBatch
+        from geomesa_tpu.process.knn import KNearestNeighborSearchProcess
+
+        r = np.random.default_rng(6)
+        n, q, k = 20_000, 256, 6
+        pts = np.concatenate([
+            np.stack([r.normal(4.0, 0.004, n // 2), r.normal(51.9, 0.004, n // 2)], 1),
+            np.stack([r.uniform(-10, 10, n - n // 2), r.uniform(48, 58, n - n // 2)], 1),
+        ])
+        qpts = np.concatenate([
+            np.stack([r.normal(4.0, 0.004, q // 2), r.normal(51.9, 0.004, q // 2)], 1),
+            np.stack([r.uniform(-10, 10, q - q // 2), r.uniform(48, 58, q - q // 2)], 1),
+        ])
+        sft = SimpleFeatureType.from_spec("t", "*geom:Point")
+        data = FeatureBatch.from_pydict(sft, {"geom": pts})
+        queries = FeatureBatch.from_pydict(sft, {"geom": qpts})
+        res = KNearestNeighborSearchProcess().execute(
+            queries, data, num_desired=k, impl="mxu"
+        )
+        oracle = np.sort(haversine_m_np(
+            qpts[:, 0:1], qpts[:, 1:2], pts[None, :, 0], pts[None, :, 1]
+        ), axis=1)[:, :k]
+        np.testing.assert_allclose(
+            np.sort(res.distances_m, 1), oracle, atol=1.0
+        )
+
+    def test_sharded_mxu_impl(self):
+        mesh = default_mesh()
+        mqx, mqy, _ = self._mxu_queries()
+        args = (
+            jnp.asarray(mqx), jnp.asarray(mqy),
+            jnp.asarray(self.dx[:4096]), jnp.asarray(self.dy[:4096]),
+            jnp.asarray(self.mask[:4096]),
+        )
+        d1, _ = knn(*args, k=self.k)
+        d2, _ = knn_sharded(mesh, *args, k=self.k, impl="mxu")
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), atol=1.0)
+
+    def test_ring_mxu_impl(self):
+        mesh = default_mesh()
+        mqx, mqy, _ = self._mxu_queries(q=256)  # shards to 32/device: mxu
+        # pad queries... ring shards queries: 256/8 = 32 per device < 128
+        # so per-device calls fall back exact; still exercises the hoisted
+        # sort + presorted plumbing end to end
+        args_d = (
+            jnp.asarray(self.dx[:4096]), jnp.asarray(self.dy[:4096]),
+            jnp.asarray(self.mask[:4096]),
+        )
+        d1, _ = knn(jnp.asarray(mqx), jnp.asarray(mqy), *args_d, k=self.k)
+        d2, _ = knn_ring(
+            mesh, jnp.asarray(mqx), jnp.asarray(mqy), *args_d,
+            k=self.k, query_tile=32, impl="mxu",
+        )
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), atol=1.0)
 
     def test_masked_points_excluded(self):
         mask = self.mask.copy()
