@@ -306,7 +306,9 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     n = args.n or (1 << 17 if args.smoke else 1 << 22)
-    q = args.queries or (32 if args.smoke else 256)
+    # smoke still needs >= 128 queries: below that knn_mxu falls back to the
+    # haversine path and --impl mxu would never exercise the matmul kernel
+    q = args.queries or (128 if args.smoke else 256)
     k = args.k
     repeats = 2 if args.smoke else 3
 
@@ -331,8 +333,6 @@ def main(argv=None) -> int:
     T0, T1 = 1_592_000_000_000, 1_598_000_000_000
 
     # --- device pipeline (one fused jit: mask + kNN) ----------------------
-    knn_fn = knn_mxu if args.impl == "mxu" else knn
-
     @jax.jit
     def device_step(x, y, t, speed, qx, qy):
         mask = (
@@ -340,9 +340,9 @@ def main(argv=None) -> int:
             & (t > T0) & (t < T1) & (speed > 5.0)
         )
         if args.impl == "mxu":
-            dists, idx = knn_fn(qx, qy, x, y, mask, k=k)  # sorts + tiles itself
+            dists, idx = knn_mxu(qx, qy, x, y, mask, k=k)  # sorts+tiles itself
         else:
-            dists, idx = knn_fn(qx, qy, x, y, mask, k=k, query_tile=q)
+            dists, idx = knn(qx, qy, x, y, mask, k=k, query_tile=q)
         return jnp.sum(mask.astype(jnp.int32)), dists
 
     dx = jnp.asarray(x, jnp.float32)

@@ -125,6 +125,25 @@ class TestKNN:
                                 cdx[np.asarray(idx)], cdy[np.asarray(idx)])
         assert recall_at_k(np.asarray(idx), true_d, d_or, k, tol=1.0) == 1.0
 
+    def test_mxu_antipodal_neighbors_stay_finite(self):
+        # a legitimate neighbor at a query's antipode has chord^2 == 4.0,
+        # the maximum possible — the refine cut must not confuse it with a
+        # masked slot (chord2 == BIG) and report +inf (regression)
+        n, q, k = 4_096, 160, 3
+        dx = np.full(n, 180.0) - rng.uniform(0, 1e-4, n)
+        dy = rng.uniform(-1e-4, 1e-4, n)
+        qx = np.zeros(q) + rng.uniform(0, 1e-4, q)
+        qy = rng.uniform(-1e-4, 1e-4, q)
+        dists, idx = knn_mxu(
+            jnp.asarray(qx, jnp.float32), jnp.asarray(qy, jnp.float32),
+            jnp.asarray(dx, jnp.float32), jnp.asarray(dy, jnp.float32),
+            jnp.asarray(np.ones(n, bool)), k=k, query_tile=32,
+        )
+        got = np.asarray(dists)
+        assert np.all(np.isfinite(got))
+        # half the meridian circumference, to within f32 slack
+        np.testing.assert_allclose(got, 2.00151e7, rtol=1e-3)
+
     def test_mxu_masked_and_small_n(self):
         mqx, mqy, _ = self._mxu_queries()
         mask = self.mask.copy()
