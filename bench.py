@@ -53,6 +53,18 @@ def _cpu_baseline(x, y, t, speed, qx, qy, k, bbox, t0, t1, repeats=3):
     return best, count, dists
 
 
+def _sync(out):
+    """Force device completion. Under the remote-tunnel TPU platform
+    `block_until_ready()` returns before execution finishes, so timings must
+    instead fetch one scalar to host — that transfer cannot complete until
+    the producing computation has."""
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf[(0,) * leaf.ndim])
+    return out
+
+
 def _timeit(fn, repeats=3, warm=True):
     if warm:
         fn()
@@ -84,33 +96,37 @@ def bench_pip(n, repeats):
 
     dev = [jnp.asarray(a, jnp.float32) for a in (px, py, x1, y1, x2, y2)]
     run = jax.jit(lambda *a: points_in_polygon(*a))
-    dev_t = _timeit(lambda: run(*dev).block_until_ready(), repeats)
+    dev_t = _timeit(lambda: _sync(run(*dev)), repeats)
 
-    # CPU baseline: chunked NumPy f64 crossing number. Chunk size keeps the
-    # [chunk, E] intermediates ~128MB so the baseline is compute-bound, not
-    # swap-bound (an artificially thrashing baseline would inflate speedups)
+    # CPU baseline: chunked NumPy f64 crossing number, measured on a point
+    # subsample (the per-point cost is constant in n — O(E) each) and
+    # reported as points/sec. Chunk size keeps the [chunk, E] intermediates
+    # ~128MB so the baseline is compute-bound, not swap-bound.
+    ncpu = min(n, 1 << 18)
     chunk = max(1024, (1 << 24) // max(len(x1), 1))
 
     def cpu():
-        out = np.zeros(n, bool)
-        for off in range(0, n, chunk):
-            sl = slice(off, min(off + chunk, n))
+        out = np.zeros(ncpu, bool)
+        for off in range(0, ncpu, chunk):
+            sl = slice(off, min(off + chunk, ncpu))
             out[sl] = points_in_polygon_np_edges(px[sl], py[sl], x1, y1, x2, y2)
         return out
 
     cpu_t = _timeit(cpu, max(1, repeats - 1))
     exp = cpu()
-    got = np.asarray(run(*dev))
+    got = np.asarray(run(*dev))[:ncpu]
     mismatch = int((got != exp).sum())
+    cpu_pps = ncpu / cpu_t
     return {
         "metric": "within_pip_points_per_sec_per_chip",
         "value": round(n / dev_t, 1),
         "unit": "points/sec",
-        "vs_baseline": round((n / dev_t) / (n / cpu_t), 3),
+        "vs_baseline": round((n / dev_t) / cpu_pps, 3),
         "detail": {
             "n": n, "edges": len(x1), "device_time_s": round(dev_t, 5),
-            "cpu_time_s": round(cpu_t, 5), "mismatch": mismatch,
-            "parity": mismatch <= max(2, n // 10000),
+            "cpu_points": ncpu, "cpu_time_s": round(cpu_t, 5),
+            "mismatch": mismatch,
+            "parity": mismatch <= max(2, ncpu // 10000),
         },
     }
 
@@ -134,7 +150,7 @@ def bench_density(n, repeats):
     dw = jnp.asarray(w)
     m = jnp.ones(n, bool)
     run = jax.jit(lambda a, b, c, d: density_grid(a, b, c, d, bbox, W, H))
-    dev_t = _timeit(lambda: run(dx, dy, dw, m).block_until_ready(), repeats)
+    dev_t = _timeit(lambda: _sync(run(dx, dy, dw, m)), repeats)
 
     def cpu():
         g, _, _ = np.histogram2d(
@@ -188,7 +204,7 @@ def bench_tube(n, repeats):
         jnp.asarray(radius, jnp.float32), jnp.asarray(half_win, jnp.int64),
     )
     run = jax.jit(lambda *a: tube_select(*a))
-    dev_t = _timeit(lambda: run(*dev).block_until_ready(), repeats)
+    dev_t = _timeit(lambda: _sync(run(*dev)), repeats)
 
     def cpu():
         hit = np.zeros(n, bool)
@@ -358,7 +374,7 @@ def main(argv=None) -> int:
     for _ in range(5 if not args.smoke else 2):
         s = time.perf_counter()
         count, dists = device_step(dx, dy, dt, dspeed, dqx, dqy)
-        jax.block_until_ready((count, dists))
+        _sync(dists)
         best = min(best, time.perf_counter() - s)
     tpu_pps = n / best
 

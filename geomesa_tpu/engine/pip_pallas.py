@@ -14,6 +14,13 @@ accumulator is initialized at edge step 0 and folded until the last step
 guarantees ordering). Padding edges are degenerate (all zeros) and can
 never satisfy the half-open crossing rule; padded points are sliced off.
 
+Layout (Mosaic tiling): points ride the LANE axis as [1, POINT_TILE]
+blocks and edges ride the SUBLANE axis as [EDGE_TILE, 1] blocks, so the
+[EDGE_TILE, POINT_TILE] crossing matrix is a native VPU broadcast
+(no relayout) and the per-point count is a sublane-axis reduction. Block
+shapes obey the TPU lowering rule (last two dims divisible by (8, 128) or
+equal to the array dims: the 1-sized dims equal the array's).
+
 f32 note: edge-crossing comparisons at f32 resolution can flip for points
 within ~1e-7 deg of a boundary (documented divergence from the f64 oracle,
 same caveat as the lax path)."""
@@ -27,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 POINT_TILE = 512
-EDGE_TILE = 1024
+EDGE_TILE = 512
 
 
 def _pip_kernel(px_ref, py_ref, x1_ref, y1_ref, x2_ref, y2_ref, out_ref):
@@ -39,18 +46,18 @@ def _pip_kernel(px_ref, py_ref, x1_ref, y1_ref, x2_ref, y2_ref, out_ref):
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    px = px_ref[...].reshape(-1, 1)  # [P, 1]
-    py = py_ref[...].reshape(-1, 1)
-    x1 = x1_ref[...].reshape(1, -1)  # [1, E]
-    y1 = y1_ref[...].reshape(1, -1)
-    x2 = x2_ref[...].reshape(1, -1)
-    y2 = y2_ref[...].reshape(1, -1)
+    px = px_ref[0]  # [1, P] — points in lanes
+    py = py_ref[0]
+    x1 = x1_ref[0]  # [E, 1] — edges in sublanes
+    y1 = y1_ref[0]
+    x2 = x2_ref[0]
+    y2 = y2_ref[0]
 
     # half-open rule: exactly one endpoint strictly above py
-    cond = (y1 <= py) != (y2 <= py)
+    cond = (y1 <= py) != (y2 <= py)          # [E, P] native broadcast
     t = (py - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
     xc = x1 + t * (x2 - x1)
-    partial = jnp.sum((cond & (xc > px)).astype(jnp.int32), axis=1)
+    partial = jnp.sum((cond & (xc > px)).astype(jnp.int32), axis=0)  # [P]
     out_ref[...] += partial.reshape(out_ref.shape)
 
 
@@ -65,28 +72,35 @@ def points_in_polygon_pallas(px, py, x1, y1, x2, y2, interpret: bool = False):
         return jnp.zeros((n,), bool)
     npad = (-n) % POINT_TILE
     epad = (-e) % EDGE_TILE
-    dt = jnp.promote_types(px.dtype, jnp.float32)
-    pxp = jnp.pad(px.astype(dt), (0, npad)).reshape(-1, POINT_TILE)
-    pyp = jnp.pad(py.astype(dt), (0, npad)).reshape(-1, POINT_TILE)
+    # kernel is f32-only (Mosaic rejects 64-bit operands); f64 callers accept
+    # the documented boundary-resolution caveat above
+    dt = jnp.float32
+    # points: [gp, 1, POINT_TILE] (lane axis); edges: [ge, EDGE_TILE, 1]
+    # (sublane axis)
+    pxp = jnp.pad(px.astype(dt), (0, npad)).reshape(-1, 1, POINT_TILE)
+    pyp = jnp.pad(py.astype(dt), (0, npad)).reshape(-1, 1, POINT_TILE)
     # degenerate zero edges never cross (y1 == y2 fails the half-open rule)
-    e1 = jnp.pad(x1.astype(dt), (0, epad)).reshape(-1, EDGE_TILE)
-    f1 = jnp.pad(y1.astype(dt), (0, epad)).reshape(-1, EDGE_TILE)
-    e2 = jnp.pad(x2.astype(dt), (0, epad)).reshape(-1, EDGE_TILE)
-    f2 = jnp.pad(y2.astype(dt), (0, epad)).reshape(-1, EDGE_TILE)
+    e1 = jnp.pad(x1.astype(dt), (0, epad)).reshape(-1, EDGE_TILE, 1)
+    f1 = jnp.pad(y1.astype(dt), (0, epad)).reshape(-1, EDGE_TILE, 1)
+    e2 = jnp.pad(x2.astype(dt), (0, epad)).reshape(-1, EDGE_TILE, 1)
+    f2 = jnp.pad(y2.astype(dt), (0, epad)).reshape(-1, EDGE_TILE, 1)
 
     gp, ge = pxp.shape[0], e1.shape[0]
-    point_block = pl.BlockSpec((1, POINT_TILE), lambda i, j: (i, 0))
-    edge_block = pl.BlockSpec((1, EDGE_TILE), lambda i, j: (j, 0))
+    point_block = pl.BlockSpec((1, 1, POINT_TILE), lambda i, j: (i, 0, 0))
+    edge_block = pl.BlockSpec((1, EDGE_TILE, 1), lambda i, j: (j, 0, 0))
 
-    counts = pl.pallas_call(
-        _pip_kernel,
-        grid=(gp, ge),
-        in_specs=[point_block, point_block,
-                  edge_block, edge_block, edge_block, edge_block],
-        out_specs=pl.BlockSpec((1, POINT_TILE), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((gp, POINT_TILE), jnp.int32),
-        interpret=interpret,
-    )(pxp, pyp, e1, f1, e2, f2)
+    # Mosaic rejects 64-bit types; trace the kernel with x64 off so index-map
+    # and in-kernel literals stay i32/f32 even when the host runs x64 mode.
+    with jax.enable_x64(False):
+        counts = pl.pallas_call(
+            _pip_kernel,
+            grid=(gp, ge),
+            in_specs=[point_block, point_block,
+                      edge_block, edge_block, edge_block, edge_block],
+            out_specs=pl.BlockSpec((1, 1, POINT_TILE), lambda i, j: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((gp, 1, POINT_TILE), jnp.int32),
+            interpret=interpret,
+        )(pxp, pyp, e1, f1, e2, f2)
     return (counts.reshape(-1)[:n] % 2) == 1
 
 

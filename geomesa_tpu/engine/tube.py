@@ -27,7 +27,7 @@ from geomesa_tpu.engine.geodesy import haversine_m
 from geomesa_tpu.parallel.mesh import SHARD_AXIS
 
 
-@functools.partial(jax.jit, static_argnames=("tube_tile",))
+@functools.partial(jax.jit, static_argnames=("tube_tile", "data_tile"))
 def tube_select(
     x: jax.Array,
     y: jax.Array,
@@ -39,43 +39,62 @@ def tube_select(
     radius_m: jax.Array,
     half_window_ms: jax.Array,
     tube_tile: int = 2048,
+    data_tile: int = 8192,
 ) -> jax.Array:
     """bool [N]: data point matches if within radius AND time window of ANY
-    tube sample. Tube arrays are [T]; radius/window may be scalar or [T]."""
+    tube sample. Tube arrays are [T]; radius/window may be scalar or [T].
+
+    Tiled over BOTH axes: the [data_tile, tube_tile] hit block is the only
+    pairwise intermediate, so HBM stays O(N + T) regardless of problem size
+    (a flat [N, T] broadcast at N=4M, T=2k would materialize ~32 GB).
+    """
     T = tube_x.shape[0]
+    n = x.shape[0]
+    if T == 0:
+        return jnp.zeros((n,), bool)
     radius_m = jnp.broadcast_to(jnp.asarray(radius_m, jnp.float32), (T,))
     half_window_ms = jnp.broadcast_to(
         jnp.asarray(half_window_ms, jnp.int64), (T,)
     )
-    pad = (-T) % tube_tile
-    tx = jnp.pad(tube_x, (0, pad))
-    ty = jnp.pad(tube_y, (0, pad))
-    tt = jnp.pad(tube_t, (0, pad))
-    tr = jnp.pad(radius_m, (0, pad), constant_values=-1.0)  # pad never matches
-    tw = jnp.pad(half_window_ms, (0, pad))
-
-    def tile(carry, args):
-        txi, tyi, tti, tri, twi = args
-        d = haversine_m(x[:, None], y[:, None], txi[None, :], tyi[None, :])
-        dt = jnp.abs(t[:, None] - tti[None, :])
-        hit = (d <= tri[None, :]) & (dt <= twi[None, :])
-        return carry | jnp.any(hit, axis=1), None
-
-    # zeros_like keeps the carry's varying-mesh-axes type aligned with x
-    # when this kernel runs inside shard_map
-    init = jnp.zeros_like(x, dtype=bool)
-    out, _ = jax.lax.scan(
-        tile,
-        init,
-        (
-            tx.reshape(-1, tube_tile),
-            ty.reshape(-1, tube_tile),
-            tt.reshape(-1, tube_tile),
-            tr.reshape(-1, tube_tile),
-            tw.reshape(-1, tube_tile),
-        ),
+    # pad the tube axis only to the lane quantum (128), not a full tile —
+    # short tubes (the common case) shouldn't pay 8x padding waste
+    tube_tile = min(tube_tile, (T + 127) // 128 * 128)
+    tpad = (-T) % tube_tile
+    tx = jnp.pad(tube_x, (0, tpad))
+    ty = jnp.pad(tube_y, (0, tpad))
+    tt = jnp.pad(tube_t, (0, tpad))
+    tr = jnp.pad(radius_m, (0, tpad), constant_values=-1.0)  # pad never matches
+    tw = jnp.pad(half_window_ms, (0, tpad))
+    tube = (
+        tx.reshape(-1, tube_tile),
+        ty.reshape(-1, tube_tile),
+        tt.reshape(-1, tube_tile),
+        tr.reshape(-1, tube_tile),
+        tw.reshape(-1, tube_tile),
     )
-    return out & mask
+
+    data_tile = min(data_tile, max(n, 1))
+    npad = (-n) % data_tile
+    xd = jnp.pad(x, (0, npad)).reshape(-1, data_tile)
+    yd = jnp.pad(y, (0, npad)).reshape(-1, data_tile)
+    td = jnp.pad(t, (0, npad)).reshape(-1, data_tile)
+
+    def data_block(_, args):
+        xi, yi, ti = args
+
+        def tube_block(carry, targs):
+            txi, tyi, tti, tri, twi = targs
+            d = haversine_m(xi[:, None], yi[:, None], txi[None, :], tyi[None, :])
+            dt = jnp.abs(ti[:, None] - tti[None, :])
+            hit = (d <= tri[None, :]) & (dt <= twi[None, :])
+            return carry | jnp.any(hit, axis=1), None
+
+        init = jnp.zeros_like(xi, dtype=bool)
+        out, _ = jax.lax.scan(tube_block, init, tube)
+        return None, out
+
+    _, hits = jax.lax.scan(data_block, None, (xd, yd, td))
+    return hits.reshape(-1)[:n] & mask
 
 
 def tube_select_sharded(
