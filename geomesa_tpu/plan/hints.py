@@ -41,6 +41,12 @@ class QueryHints:
     # index override (upstream: QUERY_INDEX)
     query_index: Optional[str] = None
 
+    # internal: the caller only needs a match count, so execution may keep
+    # every mask on device and fetch a single reduced scalar (set by
+    # QueryPlanner.count; the analog of the reference's count-optimized
+    # stats/EXACT_COUNT path)
+    count_only: bool = False
+
     @property
     def is_density(self) -> bool:
         return self.density_bbox is not None

@@ -95,6 +95,15 @@ class TestDataStore:
         q = Query("ais", "INCLUDE", hints=QueryHints(exact_count=False))
         assert src.get_count(q) == len(batch)
 
+    def test_count_honors_max_features(self, catalog):
+        # GeoTools getCount semantics: the query limit caps the count (the
+        # count_only device fast path must match the features path)
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        q = Query("ais", "speed >= 0", max_features=5)
+        assert len(src.get_features(q).features) == 5
+        assert src.get_count(q) == 5
+
     def test_projection_sort_limit(self, catalog):
         ds, batch, _ = catalog
         src = ds.get_feature_source("ais")
