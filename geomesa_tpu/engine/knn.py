@@ -258,6 +258,8 @@ def knn_mxu(
     m = min(m, n) if n else m
     if data_tile is None:
         data_tile = max(m, min(n, (1 << 27) // max(query_tile, 1)))
+    # block-minima layout needs whole 128-lane blocks per data tile
+    data_tile = -(-data_tile // 128) * 128
 
     # compact tiles: process queries in Z-order, un-permute at the end.
     # presorted=True lets loop callers (knn_ring) sort once outside.
@@ -283,39 +285,69 @@ def knn_mxu(
     n_dtiles = dut.shape[0]
     BIG = jnp.float32(8.0)  # > max chord^2 (4.0)
 
+    # deferred block selection: the scan emits only per-128-lane block
+    # minima (which XLA fuses into the matmul epilogue — the [Q, N] chord^2
+    # matrix never reaches HBM), the m winning blocks per query are picked
+    # ONCE over the accumulated minima, and chord^2 is recomputed for just
+    # those m·128 lanes. This replaces a per-scan-step top-k + pool merge
+    # that cost ~3.5x the fused pass at GDELT scale. Exactness is the
+    # two-level argument: if a true top-m element's block were unpicked, m
+    # picked blocks each hold an element <= it, so its rank exceeds m.
+    BLK = 128
+    nb_tile = data_tile // BLK
+    du_flat = du  # [n_padded, 3]
+    mp_flat = jnp.pad(mask, (0, dpad))
+
     def tile(tq):
         c = tq.mean(axis=0)
         tqc = tq - c
         nq = jnp.sum(tqc * tqc, axis=-1)  # [query_tile]
         r2_tile = jnp.max(nq)  # squared tile radius, for the noise bound
+        # augmented queries [tqc | 1]: one matmul emits the entire per-pair
+        # ranking key nd - 2 q.d (chord^2 minus the per-query constant nq,
+        # which cannot change ranks within a query row), so the VPU's only
+        # [Q, N] work is the block-min compare
+        aug_q = jnp.concatenate(
+            [tqc, jnp.ones((query_tile, 1), tqc.dtype)], axis=1
+        )
 
-        def fold(carry, xs):
-            bs, bi = carry
-            dt, mt, base = xs
+        def fold(_, xs):
+            dt, mt = xs
             dtc = dt - c
             nd = jnp.sum(dtc * dtc, axis=-1)  # [data_tile]
-            # [query_tile, data_tile] cross term on the MXU
-            s = jax.lax.dot_general(
-                tqc, dtc, (((1,), (1,)), ((), ())),
+            # masked rows carry a huge additive term instead of a [Q, N]
+            # where(): 1e9 dwarfs any real key (|nd - 2 q.d| <= 12)
+            ndm = jnp.where(mt, nd, jnp.float32(1e9))
+            aug_d = jnp.concatenate([-2.0 * dtc, ndm[:, None]], axis=1)
+            key = jax.lax.dot_general(
+                aug_q, aug_d, (((1,), (1,)), ((), ())),
                 precision=jax.lax.Precision.HIGHEST,
-            )
-            chord2 = nq[:, None] + nd[None, :] - 2.0 * s
-            chord2 = jnp.where(mt[None, :], chord2, BIG)
-            ls, li = _twolevel_smallest(chord2, min(m, data_tile))
-            gi = jnp.minimum((li + base).astype(jnp.int32), n - 1)
-            pool_s = jnp.concatenate([bs, ls], axis=1)
-            pool_i = jnp.concatenate([bi, gi], axis=1)
-            ns, sel = _topk_smallest(pool_s, m)
-            ni = jnp.take_along_axis(pool_i, sel, axis=1)
-            return (ns, ni), None
+            )  # [query_tile, data_tile] = nd - 2 q.d (+1e9 where masked)
+            bmin = key.reshape(query_tile, nb_tile, BLK).min(axis=-1)
+            return None, bmin
 
-        vzero = jnp.sum(tq[:1, :1] * 0) + jnp.sum(dut[:1, :1, :1] * 0)
-        init = (
-            jnp.full((query_tile, m), BIG) + vzero,
-            jnp.zeros((query_tile, m), jnp.int32) + vzero.astype(jnp.int32),
+        _, minima = jax.lax.scan(fold, None, (dut, mp))
+        # [n_dtiles, query_tile, nb_tile] -> [query_tile, total_blocks]
+        minima = minima.transpose(1, 0, 2).reshape(query_tile, -1)
+        mb = min(m, minima.shape[-1])
+        _, blk_ids = _twolevel_smallest(minima, mb)  # [query_tile, mb]
+
+        # recompute chord^2 for the winning blocks only (same centered
+        # arithmetic, so the noise model and certificate are unchanged)
+        lane = (blk_ids[:, :, None] * BLK
+                + jnp.arange(BLK, dtype=jnp.int32)).reshape(query_tile, -1)
+        gd = jnp.take(du_flat, lane, axis=0)  # [query_tile, mb*BLK, 3]
+        gm = jnp.take(mp_flat, lane)
+        gdc = gd - c
+        nd_g = jnp.sum(gdc * gdc, axis=-1)
+        s_g = jnp.einsum("qd,qjd->qj", tqc, gdc,
+                         precision=jax.lax.Precision.HIGHEST)
+        chord2_g = nq[:, None] + nd_g - 2.0 * s_g
+        chord2_g = jnp.where(gm, chord2_g, BIG)
+        bs, within = _topk_smallest(chord2_g, m)
+        bi = jnp.minimum(
+            jnp.take_along_axis(lane, within, axis=1).astype(jnp.int32), n - 1
         )
-        bases = (jnp.arange(n_dtiles) * data_tile).astype(jnp.int32)
-        (bs, bi), _ = jax.lax.scan(fold, init, (dut, mp, bases))
         return bs, bi, jnp.broadcast_to(r2_tile, (tq.shape[0],))
 
     chord2, cidx, r2 = jax.lax.map(tile, tiles_q)
@@ -365,6 +397,55 @@ def knn_mxu(
     if inv is not None:
         uncertain = jnp.take(uncertain, inv, axis=0)
     return fd_out, fi_out, uncertain
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "capacity", "impl", "query_tile")
+)
+def knn_compact(
+    qx: jax.Array,
+    qy: jax.Array,
+    dx: jax.Array,
+    dy: jax.Array,
+    mask: jax.Array,
+    k: int,
+    capacity: int,
+    impl: str = "mxu",
+    query_tile: int = 64,
+) -> Tuple[jax.Array, jax.Array]:
+    """kNN over the mask's matches only: device-side candidate compaction.
+
+    At GDELT-scale selectivity (a few % of the scanned batch matches the
+    predicate) the dominant cost of `knn`/`knn_mxu` is streaming [Q, N]
+    distance blocks through HBM for rows the mask rejects anyway. This
+    gathers the matching rows into a dense [capacity] candidate array first
+    (one `nonzero` pass — the columnar analog of the reference emitting
+    index-scan hits before running KNN on them), then runs the kNN kernel on
+    the compacted set: distance traffic drops from O(Q·N) to O(Q·count).
+
+    `capacity` must be a static bound >= the match count (callers bucket it
+    to the next power of two to stabilize jit cache keys); validity of each
+    compacted slot is derived on device from a sentinel, so no count needs
+    to cross from the host. Returned indices refer to the ORIGINAL arrays.
+    """
+    # top_k-based stream compaction: jnp.nonzero(size=...) lowers ~26x
+    # slower on TPU (measured 6.3s vs 0.26s at 67M); top_k over
+    # where(mask, iota, -1) yields the matched indices (descending order —
+    # irrelevant for kNN) at sort-free selection cost
+    n = dx.shape[0]
+    capacity = min(capacity, n)  # lax.top_k requires k <= lane count
+    picked = jax.lax.top_k(
+        jnp.where(mask, jnp.arange(n, dtype=jnp.int32), -1), capacity
+    )[0]
+    idx = jnp.maximum(picked, 0)
+    valid = picked >= 0
+    cx = jnp.take(dx, idx)
+    cy = jnp.take(dy, idx)
+    if impl == "mxu":
+        fd, fi = knn_mxu(qx, qy, cx, cy, valid, k=k, query_tile=query_tile)
+    else:
+        fd, fi = knn(qx, qy, cx, cy, valid, k=k)
+    return fd, jnp.take(idx, fi)
 
 
 def knn_sharded(

@@ -302,22 +302,13 @@ class QueryPlanner:
 
         if hints.is_density:
             # per-partition grids accumulate on device; one grid transfer
-            from geomesa_tpu.engine.density import density_grid
+            from geomesa_tpu.plan.runner import density_device_grid
 
-            g = self.storage.sft.default_geometry
+            sft = self.storage.sft
             total_grid = None
             counts = []
             for e, m in zip(entries, dev_masks):
-                w = (
-                    e.dev[hints.density_weight].astype(jnp.float32)
-                    if hints.density_weight
-                    else jnp.ones(len(e.batch), jnp.float32)
-                )
-                grid = density_grid(
-                    e.dev[f"{g.name}__x"], e.dev[f"{g.name}__y"], w, m,
-                    tuple(hints.density_bbox),
-                    hints.density_width, hints.density_height,
-                )
+                grid = density_device_grid(sft, e.batch, e.dev, m, hints)
                 total_grid = grid if total_grid is None else total_grid + grid
                 counts.append(jnp.sum(m, dtype=jnp.int32))
             total = int(np.asarray(jnp.stack(counts)).sum())

@@ -22,6 +22,31 @@ if TYPE_CHECKING:
     from geomesa_tpu.plan.query import Query
 
 
+def density_device_grid(sft: SimpleFeatureType, batch, dev, dev_mask, hints):
+    """Device density grid for one batch (weight column or ones). Shared by
+    the scan-path aggregate() and the planner's cached per-partition path so
+    weighting semantics cannot diverge between them."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.engine.density import density_grid
+
+    g = sft.default_geometry
+    w = (
+        dev[hints.density_weight].astype(jnp.float32)
+        if hints.density_weight
+        else jnp.ones(len(batch), jnp.float32)
+    )
+    return density_grid(
+        dev[f"{g.name}__x"],
+        dev[f"{g.name}__y"],
+        w,
+        dev_mask,
+        tuple(hints.density_bbox),
+        hints.density_width,
+        hints.density_height,
+    )
+
+
 def aggregate(sft: SimpleFeatureType, batch, dev, mask: np.ndarray, query: "Query"):
     """Dispatch on hints: density / stats / bin aggregation, else features."""
     import jax.numpy as jnp
@@ -32,22 +57,7 @@ def aggregate(sft: SimpleFeatureType, batch, dev, mask: np.ndarray, query: "Quer
     g = sft.default_geometry
 
     if hints.is_density:
-        from geomesa_tpu.engine.density import density_grid
-
-        w = (
-            dev[hints.density_weight].astype(jnp.float32)
-            if hints.density_weight
-            else jnp.ones(len(batch), jnp.float32)
-        )
-        grid = density_grid(
-            dev[f"{g.name}__x"],
-            dev[f"{g.name}__y"],
-            w,
-            jnp.asarray(mask),
-            tuple(hints.density_bbox),
-            hints.density_width,
-            hints.density_height,
-        )
+        grid = density_device_grid(sft, batch, dev, jnp.asarray(mask), hints)
         return QueryResult("density", grid=np.asarray(grid), count=int(mask.sum()))
 
     if hints.is_stats:

@@ -106,6 +106,52 @@ class TestKNN:
             np.sort(np.asarray(dists), 1), oracle[:, : self.k], atol=1.0
         )
 
+    def test_compact_recall_and_index_mapping(self):
+        # knn_compact: masked selectivity + capacity > count; the returned
+        # indices must point at unmasked ORIGINAL rows and reproduce the
+        # reported distances
+        from geomesa_tpu.engine.knn import knn_compact
+
+        mask = rng.random(self.n) < 0.4
+        mqx, mqy, _ = self._mxu_queries()
+        d = haversine_m_np(
+            mqx[:, None], mqy[:, None],
+            self.dx[None, mask], self.dy[None, mask],
+        )
+        oracle = np.sort(d, axis=1)
+        cap = 1 << int(mask.sum() - 1).bit_length()
+        dists, idx = knn_compact(
+            jnp.asarray(mqx), jnp.asarray(mqy),
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(mask), k=self.k, capacity=cap,
+        )
+        idx = np.asarray(idx)
+        assert mask[idx].all(), "index into a masked-out row"
+        true_d = haversine_m_np(
+            mqx[:, None], mqy[:, None], self.dx[idx], self.dy[idx]
+        )
+        np.testing.assert_allclose(
+            np.sort(true_d, 1), np.sort(np.asarray(dists), 1), atol=1.0
+        )
+        np.testing.assert_allclose(
+            np.sort(np.asarray(dists), 1), oracle[:, : self.k], atol=1.0
+        )
+
+    def test_compact_capacity_exceeds_n(self):
+        # capacity above the data length must clamp, not crash (lax.top_k
+        # requires k <= lane count)
+        from geomesa_tpu.engine.knn import knn_compact
+
+        mqx, mqy, oracle = self._mxu_queries()
+        dists, _ = knn_compact(
+            jnp.asarray(mqx), jnp.asarray(mqy),
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(self.mask), k=self.k, capacity=4 * self.n,
+        )
+        np.testing.assert_allclose(
+            np.sort(np.asarray(dists), 1), oracle[:, : self.k], atol=1.0
+        )
+
     def test_mxu_clustered_near_ties(self):
         # dense cluster: many near-equal distances stress the f32 margin
         n, q, k = 20_000, 160, 8
