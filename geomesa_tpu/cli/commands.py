@@ -89,6 +89,15 @@ def register(sub: "argparse._SubParsersAction") -> None:
         [cat, feat,
          (["--partition"], {"default": None, "help": "limit to one partition"})])
     cmd("env", "show system properties", _env, [])
+    cmd(
+        "bench", "run a BASELINE benchmark config",
+        _bench,
+        [(["--config"], {"type": int, "default": 3, "choices": [1, 2, 3, 4, 5],
+          "help": "BASELINE.json config (3 = headline BBOX+time+kNN)"}),
+         (["--smoke"], {"action": "store_true",
+          "help": "small sizes, force CPU"}),
+         (["--n"], {"type": int, "default": None, "help": "points"})],
+    )
 
 
 def _version(args) -> int:
@@ -468,6 +477,33 @@ def _stats_topk(args) -> int:
     for value, count in stats.stats[0].result():
         print(f"{value}\t{count}")
     return 0
+
+
+def _bench(args) -> int:
+    """Run bench.py's configs through the CLI (upstream: the tools'
+    stats/benchmark-ish commands; here the BASELINE harness itself)."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "bench.py"
+    )
+    if not os.path.exists(path):
+        # bench.py lives at the repo root, next to the package — only a
+        # source checkout has it
+        raise FileNotFoundError(
+            "bench.py not found (the bench command needs a source checkout; "
+            f"looked at {path})"
+        )
+    spec = importlib.util.spec_from_file_location("geomesa_tpu_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    argv = ["--config", str(args.config)]
+    if args.smoke:
+        argv.append("--smoke")
+    if args.n:
+        argv += ["--n", str(args.n)]
+    return mod.main(argv)
 
 
 def _env(args) -> int:

@@ -518,6 +518,11 @@ def default_indices(
         if d is not None:
             out.append(XZ3Index(sft, shards))
     for a in sft.attributes:
+        # "full" vs "join" (upstream: join indices store reduced columns and
+        # join back to the record table) collapse to one behavior here: index
+        # entries are (key, row-pointer) pairs and feature values live only
+        # in the columnar record store, so every attribute index already has
+        # join semantics with zero value duplication
         if a.options.get("index", "").lower() in ("true", "full", "join"):
             out.append(AttributeIndex(sft, a.name))
     return out

@@ -45,6 +45,11 @@ class KVFeatureSource:
         self.splitter = FilterSplitter(self.indices)
         self.decider = StrategyDecider(adapter)
         self.coord_dtype = coord_dtype
+        # QueryInterceptor SPI (plan/interceptor.py), per feature type as
+        # in the reference; SFT-configured interceptors load here too
+        from geomesa_tpu.plan.interceptor import load_interceptors
+
+        self.interceptors: List = load_interceptors(sft)
         for idx in self.indices:
             adapter.create_index(getattr(idx, "full_name", idx.name))
         # row storage: append-only batches with cumulative offsets
@@ -168,9 +173,12 @@ class KVFeatureSource:
         return FeatureBatch.concat(parts)
 
     def plan(self, query: "Query | str", explain: Optional[Explainer] = None):
+        from geomesa_tpu.plan.interceptor import run_interceptors
+
         if isinstance(query, str):
             query = Query(self.sft.name, query)
         e = explain if explain is not None else Explainer()
+        query = run_interceptors(query, self.interceptors, e)
         f = query.filter_ast
         e(f"Planning KV query: {ast.to_cql(f)}")
         options = self.splitter.options(f)

@@ -77,7 +77,10 @@ class DataStore:
         self._sources: Dict[str, FeatureSource] = {}
 
     def _planner(self, storage) -> QueryPlanner:
+        from geomesa_tpu.plan.interceptor import load_interceptors
+
         planner = QueryPlanner(storage, self.audit, self.mesh)
+        planner.interceptors.extend(load_interceptors(storage.sft))
         if self.use_device_cache:
             from geomesa_tpu.store.cache import DeviceCacheManager
 

@@ -70,6 +70,9 @@ class QueryPlanner:
         self.audit = audit
         self.mesh = mesh
         self.cache = cache
+        # QueryInterceptor SPI: callables Query -> Query run before
+        # planning; see plan/interceptor.py
+        self.interceptors: List = []
         if coord_dtype is None:
             import jax.numpy as jnp
 
@@ -85,7 +88,10 @@ class QueryPlanner:
     # -- planning ----------------------------------------------------------
 
     def plan(self, query: Query, explain: Optional[Explainer] = None) -> QueryPlan:
+        from geomesa_tpu.plan.interceptor import run_interceptors
+
         e = explain or Explainer()
+        query = run_interceptors(query, self.interceptors, e)
         sft = self.storage.sft
         f = query.filter_ast
         e.push(f"Planning '{query.type_name}' {ast.to_cql(f)}")

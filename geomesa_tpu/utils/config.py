@@ -69,6 +69,11 @@ class SystemProperties:
         "geomesa.coord.dtype", "float32", str,
         "device coordinate dtype (float32|float64)",
     )
+    SCAN_BLOCK_FULL_TABLE = SystemProperty(
+        "geomesa.scan.block.full.table", False,
+        lambda s: s.lower() in ("1", "true"),
+        "reject queries whose filter constrains nothing (full-table scans)",
+    )
 
     _all = None
 

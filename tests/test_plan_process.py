@@ -95,6 +95,46 @@ class TestDataStore:
         q = Query("ais", "INCLUDE", hints=QueryHints(exact_count=False))
         assert src.get_count(q) == len(batch)
 
+    def test_query_interceptors_and_guard(self, catalog):
+        import pytest as _pytest
+
+        from geomesa_tpu.plan.interceptor import (
+            FullTableScanGuard, QueryGuardException)
+        from geomesa_tpu.utils.config import SystemProperties
+
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        planner = src.planner if hasattr(src, "planner") else None
+        assert planner is not None
+        # rewrite interceptor: force a speed predicate into every query
+        def clamp(q):
+            import dataclasses as _dc
+
+            from geomesa_tpu.cql import parse_cql
+            from geomesa_tpu.cql import ast as _ast
+
+            f = _ast.And((q.filter_ast, parse_cql("speed > 10")))
+            return _dc.replace(q, filter=f)
+
+        planner.interceptors.append(clamp)
+        try:
+            got = src.get_count("speed >= 0")
+            exp = int((np.asarray(batch.column("speed")) > 10).sum())
+            assert got == exp
+        finally:
+            planner.interceptors.clear()
+
+        # guard: unconstrained scans rejected when the property is set
+        planner.interceptors.append(FullTableScanGuard())
+        try:
+            with _pytest.raises(QueryGuardException):
+                src.get_count("INCLUDE")
+            # sampled previews pass the guard
+            q = Query("ais", "INCLUDE", hints=QueryHints(sampling=2))
+            assert src.get_features(q).features is not None
+        finally:
+            planner.interceptors.clear()
+
     def test_count_honors_max_features(self, catalog):
         # GeoTools getCount semantics: the query limit caps the count (the
         # count_only device fast path must match the features path)
