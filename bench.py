@@ -136,7 +136,7 @@ def bench_density(n, repeats):
     import jax
     import jax.numpy as jnp
 
-    from geomesa_tpu.engine.density import density_grid
+    from geomesa_tpu.engine.density import density_grid_auto as density_grid
 
     rng = np.random.default_rng(11)
     x = rng.uniform(-74.3, -73.7, n)
@@ -357,8 +357,13 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     # 1<<26 amortizes the remote-tunnel dispatch floor (~105ms/round trip)
-    # over a GDELT-realistic batch; both sides scan the same n
-    n = args.n or (1 << 17 if args.smoke else 1 << 26)
+    # over a GDELT-realistic batch; both sides scan the same n. Configs
+    # whose CPU baseline is superlinear-or-heavy in n keep a smaller default
+    # so a full 5-config sweep stays within a bench budget.
+    per_config = {1: 1 << 22, 2: 1 << 22, 3: 1 << 26, 4: 1 << 26, 5: 1 << 22}
+    n = args.n or (
+        1 << 17 if args.smoke else per_config.get(args.config or 3, 1 << 26)
+    )
     # smoke still needs >= 128 queries: below that knn_mxu falls back to the
     # haversine path and --impl mxu would never exercise the matmul kernel
     q = args.queries or (128 if args.smoke else 256)

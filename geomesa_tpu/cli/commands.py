@@ -501,7 +501,7 @@ def _bench(args) -> int:
     argv = ["--config", str(args.config)]
     if args.smoke:
         argv.append("--smoke")
-    if args.n:
+    if args.n is not None:
         argv += ["--n", str(args.n)]
     return mod.main(argv)
 

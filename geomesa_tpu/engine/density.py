@@ -58,6 +58,108 @@ def density_grid(
     return flat.reshape(height, width)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("width", "height", "bbox", "point_tile")
+)
+def density_grid_mxu(
+    x: jax.Array,
+    y: jax.Array,
+    weights: jax.Array,
+    mask: jax.Array,
+    bbox: BBox,
+    width: int,
+    height: int,
+    point_tile: int = 8192,
+) -> jax.Array:
+    """Density via the MXU: per-tile one-hot matmuls instead of scatter.
+
+    XLA's scatter-add serializes on TPU (~106ms for 4M points at 512x512,
+    HBM bound is ~2ms). Reformulated: for a tile of T points,
+
+        grid += onehot_rows[T, H]^T  @  (onehot_cols[T, W] * w[:, None])
+
+    — an outer-product accumulation the systolic array does at matmul rate.
+    One-hot entries are exactly representable in bf16; weights are split
+    into bf16 hi + lo parts folded into the COLUMN one-hots of a doubled
+    tile, so each product is an exact bf16 multiply and the f32 MXU
+    accumulator sees w_hi + w_lo ≈ f32(w) per point. The two-term split
+    recovers ~16 of f32's 24 mantissa bits (~2^-16 relative error per
+    weight); unweighted counts are exact. Callers needing full f32 weight
+    fidelity use the scatter path.
+
+    Out-of-envelope or masked points get row index -1: their one-hot row is
+    all zero, so they contribute nothing (same exclusion rule as
+    `density_grid`).
+    """
+    xmin, ymin, xmax, ymax = bbox
+    dx = (xmax - xmin) / width
+    dy = (ymax - ymin) / height
+    n = x.shape[0]
+    pad = (-n) % point_tile
+    xp = jnp.pad(x, (0, pad))
+    yp = jnp.pad(y, (0, pad))
+    wp = jnp.pad(weights.astype(jnp.float32), (0, pad))
+    mp = jnp.pad(mask, (0, pad))
+
+    col = jnp.floor((xp - xmin) / dx).astype(jnp.int32)
+    row = jnp.floor((yp - ymin) / dy).astype(jnp.int32)
+    inb = (col >= 0) & (col < width) & (row >= 0) & (row < height) & mp
+    row = jnp.where(inb, row, -1)  # -1 -> all-zero one-hot row
+    col = jnp.where(inb, col, 0)
+
+    w_hi = wp.astype(jnp.bfloat16)
+    w_lo = (wp - w_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+
+    iota_h = jnp.arange(height, dtype=jnp.int32)
+    iota_w = jnp.arange(width, dtype=jnp.int32)
+
+    def tile(grid, args):
+        r, c, hi, lo = args
+        rows = (r[:, None] == iota_h[None, :]).astype(jnp.bfloat16)
+        cols = (c[:, None] == iota_w[None, :]).astype(jnp.bfloat16)
+        # doubled tile: [2T, H] rows against hi- and lo-weighted cols
+        rows2 = jnp.concatenate([rows, rows], axis=0)
+        cols2 = jnp.concatenate(
+            [cols * hi[:, None], cols * lo[:, None]], axis=0
+        )
+        grid = grid + jax.lax.dot_general(
+            rows2, cols2, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return grid, None
+
+    init = jnp.zeros((height, width), jnp.float32)
+    grid, _ = jax.lax.scan(
+        tile,
+        init,
+        (
+            row.reshape(-1, point_tile),
+            col.reshape(-1, point_tile),
+            w_hi.reshape(-1, point_tile),
+            w_lo.reshape(-1, point_tile),
+        ),
+    )
+    return grid
+
+
+# one-hot tiles get memory-heavy past this grid edge ([T, 4096] bf16 = 64MB)
+_MXU_MAX_EDGE = 4096
+_MXU_MIN_POINTS = 1 << 17
+
+
+def density_grid_auto(x, y, weights, mask, bbox, width, height) -> jax.Array:
+    """Backend dispatch: the matmul formulation on TPU at scale, the
+    scatter path elsewhere (CPU scatter is fine, and small batches don't
+    amortize the one-hot construction)."""
+    if (
+        jax.default_backend() == "tpu"
+        and x.shape[0] >= _MXU_MIN_POINTS
+        and max(width, height) <= _MXU_MAX_EDGE
+    ):
+        return density_grid_mxu(x, y, weights, mask, bbox, width, height)
+    return density_grid(x, y, weights, mask, bbox, width, height)
+
+
 def density_sharded(
     mesh: Mesh,
     x: jax.Array,

@@ -243,8 +243,13 @@ class KVFeatureSource:
         return aggregate(self.sft, padded, dev, mask, query)
 
     def get_count(self, query: "Query | str" = "INCLUDE") -> int:
+        from geomesa_tpu.plan.interceptor import run_interceptors
+
         if isinstance(query, str):
             query = Query(self.sft.name, query)
+        # the shortcut must see the post-interceptor query (idempotent
+        # chain: get_features -> plan re-applies it)
+        query = run_interceptors(query, self.interceptors)
         if not query.hints.exact_count and isinstance(query.filter_ast, ast.Include):
             return self.live_count
         r = self.get_features(query)

@@ -93,14 +93,23 @@ def run_interceptors(
     query: "Query", interceptors: List[Interceptor], explain=None
 ) -> "Query":
     """Apply interceptors in registration order; each sees the previous
-    one's output (upstream: interceptors chain per feature type)."""
+    one's output (upstream: interceptors chain per feature type).
+
+    Interceptors MUST be idempotent (applying one twice must not change the
+    result set): count shortcuts apply the chain before delegating to the
+    full execute path, which applies it again.
+
+    The property-driven guard runs AFTER the chain, so a configured rewrite
+    interceptor gets the chance to constrain an INCLUDE query before the
+    guard judges it (upstream guards evaluate the post-interceptor query).
+    """
     from geomesa_tpu.utils.config import SystemProperties
 
-    if SystemProperties.SCAN_BLOCK_FULL_TABLE.get():
-        query = FullTableScanGuard()(query)
     for ic in interceptors:
         before = query
         query = ic(query)
         if explain is not None and query is not before:
             explain(f"Interceptor {type(ic).__name__} rewrote the query")
+    if SystemProperties.SCAN_BLOCK_FULL_TABLE.get():
+        query = FullTableScanGuard()(query)
     return query

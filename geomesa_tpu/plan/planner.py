@@ -178,6 +178,9 @@ class QueryPlanner:
                 )
 
         plan = self.plan(query, explain)
+        # interceptors may have rewritten hints/projection/limits, not just
+        # the filter — the rewritten query is authoritative from here on
+        query = plan.query
         t_plan = time.perf_counter()
         check_timeout("planning")
 
@@ -373,6 +376,13 @@ class QueryPlanner:
         makes every count exact regardless of hints."""
         from geomesa_tpu.utils.config import SystemProperties
 
+        from geomesa_tpu.plan.interceptor import run_interceptors
+
+        # the estimate shortcut must see the POST-interceptor query, or a
+        # rewrite/guard configured on the type is bypassed for counts.
+        # Interceptors are documented idempotent, so the second application
+        # inside execute() -> plan() is safe.
+        query = run_interceptors(query, self.interceptors)
         if (
             not query.hints.exact_count
             and not SystemProperties.FORCE_COUNT.get()

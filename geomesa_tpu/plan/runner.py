@@ -28,7 +28,7 @@ def density_device_grid(sft: SimpleFeatureType, batch, dev, dev_mask, hints):
     weighting semantics cannot diverge between them."""
     import jax.numpy as jnp
 
-    from geomesa_tpu.engine.density import density_grid
+    from geomesa_tpu.engine.density import density_grid_auto as density_grid
 
     g = sft.default_geometry
     w = (

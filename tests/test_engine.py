@@ -368,6 +368,41 @@ class TestDensity:
         np.testing.assert_allclose(got, exp, rtol=1e-5)
         assert got.sum() == pytest.approx(w[mask].sum(), rel=1e-5)
 
+    def test_mxu_matches_scatter(self):
+        # the one-hot matmul formulation must reproduce the scatter grid
+        # cell-for-cell (bf16 hi/lo weight split keeps f32-level exactness)
+        from geomesa_tpu.engine.density import density_grid_mxu
+
+        n = 20_000
+        x = rng.uniform(-74.1, -73.9, n)
+        y = rng.uniform(40.6, 40.9, n)
+        w = rng.uniform(0, 2, n).astype(np.float32)
+        mask = rng.random(n) < 0.7
+        bbox = (-74.1, 40.6, -73.9, 40.9)
+        W, H = 96, 64
+        ref = np.asarray(
+            density_grid(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w),
+                         jnp.asarray(mask), bbox, W, H)
+        )
+        got = np.asarray(
+            density_grid_mxu(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w),
+                             jnp.asarray(mask), bbox, W, H,
+                             point_tile=4096)
+        )
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-4)
+        # unweighted counts must be bit-exact (0/1 one-hots, f32 accum)
+        ones = jnp.ones(n, jnp.float32)
+        ref_c = np.asarray(
+            density_grid(jnp.asarray(x), jnp.asarray(y), ones,
+                         jnp.asarray(mask), bbox, W, H)
+        )
+        got_c = np.asarray(
+            density_grid_mxu(jnp.asarray(x), jnp.asarray(y), ones,
+                             jnp.asarray(mask), bbox, W, H,
+                             point_tile=4096)
+        )
+        np.testing.assert_array_equal(got_c, ref_c)
+
     def test_outside_points_dropped(self):
         x = np.array([0.0, 200.0])  # second is out of any lon range
         y = np.array([0.0, 0.0])

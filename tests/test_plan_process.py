@@ -135,6 +135,27 @@ class TestDataStore:
         finally:
             planner.interceptors.clear()
 
+        # hint rewrites must take effect in execution, not just planning
+        def limit_two(q):
+            import dataclasses as _dc
+
+            return _dc.replace(q, max_features=2)
+
+        planner.interceptors.append(limit_two)
+        try:
+            assert len(src.get_features("speed >= 0").features) == 2
+        finally:
+            planner.interceptors.clear()
+
+        # the estimated-count shortcut must see the post-interceptor query
+        planner.interceptors.append(clamp)
+        try:
+            q = Query("ais", "INCLUDE", hints=QueryHints(exact_count=False))
+            exp = int((np.asarray(batch.column("speed")) > 10).sum())
+            assert src.get_count(q) == exp
+        finally:
+            planner.interceptors.clear()
+
     def test_count_honors_max_features(self, catalog):
         # GeoTools getCount semantics: the query limit caps the count (the
         # count_only device fast path must match the features path)
