@@ -337,11 +337,14 @@ def main(argv=None) -> int:
              "BBOX+time+kNN metric; 1=fs-query 2=pip 4=density 5=tube)",
     )
     p.add_argument(
-        "--impl", choices=["mxu", "compact", "haversine"], default="mxu",
+        "--impl", choices=["mxu", "grid", "compact", "haversine"],
+        default="mxu",
         help="config-3 kNN kernel: mxu = augmented-matmul ranking keys + "
              "deferred block selection over the full batch (default), "
-             "compact = device candidate compaction + MXU kNN over matches "
-             "only (wins at low selectivity), haversine = elementwise VPU",
+             "grid = device-built spatial index + certified neighborhood "
+             "search (amortizes over many queries), compact = device "
+             "candidate compaction + MXU kNN over matches only, haversine "
+             "= elementwise VPU",
     )
     args = p.parse_args(argv)
 
@@ -422,6 +425,22 @@ def main(argv=None) -> int:
         dists, idx = knn_compact(qx, qy, x, y, mask, k=k, capacity=cap)
         return count, dists
 
+    def grid_step(x, y, t, speed, qx, qy):
+        # the index-scan shape: build the batch-resident grid index (one
+        # device sort, amortized over every query round against the batch),
+        # then certified neighborhood search + exact fallback. Grid sized
+        # to the match count (one host fetch, like the compact impl).
+        from geomesa_tpu.engine.grid_index import (
+            auto_grid_params, knn_indexed)
+
+        mask, count = mask_count(x, y, t, speed)
+        g_edge, slots = auto_grid_params(int(np.asarray(count)))
+        dists, idx = knn_indexed(
+            qx, qy, x, y, mask, k=k, g=g_edge, ring_radius=2,
+            cell_slots=slots,
+        )
+        return count, dists
+
     dx = jnp.asarray(x, jnp.float32)
     dy = jnp.asarray(y, jnp.float32)
     dt = jnp.asarray(t, jnp.int64)
@@ -429,7 +448,9 @@ def main(argv=None) -> int:
     dqx = jnp.asarray(qx, jnp.float32)
     dqy = jnp.asarray(qy, jnp.float32)
 
-    step = compact_step if args.impl == "compact" else device_step
+    step = {"compact": compact_step, "grid": grid_step}.get(
+        args.impl, device_step
+    )
     count, dists = step(dx, dy, dt, dspeed, dqx, dqy)
     _sync(dists)  # compile + warm
     best = np.inf

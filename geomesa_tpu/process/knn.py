@@ -50,10 +50,13 @@ class KNearestNeighborSearchProcess:
         query_tile: int = 1024,
         impl: str = "haversine",
     ) -> KnnResult:
-        """impl: "haversine" (f64 coords, bit-exact) or "mxu" (f32 coords,
+        """impl: "haversine" (f64 coords, bit-exact), "mxu" (f32 coords,
         centered chord-distance matmul on the systolic array with exact
         haversine refine; certificate-flagged queries are re-solved on the
-        exact path — see engine.knn.knn_mxu for the accuracy model)."""
+        exact path — see engine.knn.knn_mxu for the accuracy model),
+        "grid" (device-built spatial index, certificate + exact fallback —
+        engine.grid_index), or "auto" (grid when many queries hit a large
+        batch, else haversine)."""
         qcol = input_features.geometry
         qx, qy = np.asarray(qcol.x), np.asarray(qcol.y)
 
@@ -113,13 +116,32 @@ class KNearestNeighborSearchProcess:
         from geomesa_tpu.engine.knn import knn, knn_mxu
 
         use_mxu = impl == "mxu"
+        use_grid = impl == "grid" or (
+            impl == "auto"
+            and len(qx) >= 512
+            and len(candidates) >= (1 << 20)
+        )
         dev = to_device(
-            candidates, coord_dtype=jnp.float32 if use_mxu else jnp.float64
+            candidates,
+            coord_dtype=jnp.float32 if (use_mxu or use_grid) else jnp.float64,
         )
         g = candidates.sft.default_geometry
         cx, cy, valid = dev[f"{g.name}__x"], dev[f"{g.name}__y"], dev["__valid__"]
         kk = min(k, len(candidates))
-        if use_mxu:
+        if use_grid:
+            # many queries against a large batch: the device-built grid
+            # index amortizes one sort over all queries (engine.grid_index;
+            # certificate-failed queries fall back to the exact scan inside)
+            from geomesa_tpu.engine.grid_index import (
+                auto_grid_params, knn_indexed)
+
+            g_edge, slots = auto_grid_params(len(candidates))
+            dists, idx = knn_indexed(
+                jnp.asarray(qx), jnp.asarray(qy), cx, cy, valid,
+                k=kk, g=g_edge, ring_radius=2, cell_slots=slots,
+            )
+            dists, idx = np.asarray(dists), np.asarray(idx)
+        elif use_mxu:
             dists, idx, flags = knn_mxu(
                 jnp.asarray(qx), jnp.asarray(qy), cx, cy, valid,
                 k=kk, with_flags=True,

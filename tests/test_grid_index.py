@@ -1,0 +1,152 @@
+"""Grid-index kNN: exact recall vs the f64 oracle, certificate behavior.
+
+The certificate must never falsely claim exactness; over-flagging is only a
+performance issue (fallback runs), under-flagging is a correctness bug — so
+these tests check final results AFTER the fallback, plus that the
+no-fallback path is already exact when nothing is flagged.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from geomesa_tpu.engine.geodesy import haversine_m_np
+from geomesa_tpu.engine.grid_index import (
+    build_grid_index, knn_grid, knn_indexed)
+
+rng = np.random.default_rng(77)
+
+
+def oracle(qx, qy, dx, dy, mask, k):
+    d = haversine_m_np(
+        qx[:, None].astype(np.float64), qy[:, None].astype(np.float64),
+        dx[None, mask].astype(np.float64), dy[None, mask].astype(np.float64),
+    )
+    return np.sort(d, axis=1)[:, :k]
+
+
+def assert_recall(dists, exp, tol=1.5):
+    got = np.sort(np.asarray(dists), axis=1)
+    assert np.all(np.abs(got - exp) <= np.maximum(tol, 1e-4 * exp)), (
+        np.abs(got - exp).max()
+    )
+
+
+class TestGridIndex:
+    def setup_method(self):
+        self.n, self.q, self.k = 60_000, 200, 10
+        self.dx = rng.uniform(-20, 20, self.n).astype(np.float32)
+        self.dy = rng.uniform(35, 65, self.n).astype(np.float32)
+        self.mask = rng.random(self.n) < 0.5
+        self.qx = rng.uniform(-15, 15, self.q).astype(np.float32)
+        self.qy = rng.uniform(40, 60, self.q).astype(np.float32)
+
+    def _args(self):
+        return (
+            jnp.asarray(self.qx), jnp.asarray(self.qy),
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(self.mask),
+        )
+
+    def test_build_partitions_all_matches(self):
+        idx = build_grid_index(
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(self.mask), g=64,
+        )
+        assert int(np.asarray(idx.counts).sum()) == int(self.mask.sum())
+        # every sorted prefix row is a real match, in its claimed cell
+        sidx = np.asarray(idx.sidx)[: int(self.mask.sum())]
+        assert self.mask[sidx].all()
+        starts = np.asarray(idx.starts)
+        sx, sy = np.asarray(idx.sx), np.asarray(idx.sy)
+        for cell in rng.choice(64 * 64, 50, replace=False):
+            a, b = starts[cell], starts[cell + 1]
+            if a == b:
+                continue
+            cx = np.clip(((sx[a:b] + 180) / 360 * 64).astype(int), 0, 63)
+            cy = np.clip(((sy[a:b] + 90) / 180 * 64).astype(int), 0, 63)
+            assert (cy * 64 + cx == cell).all()
+
+    def test_exact_after_fallback(self):
+        exp = oracle(self.qx, self.qy, self.dx, self.dy, self.mask, self.k)
+        kd, ki = knn_indexed(*self._args(), k=self.k, g=64,
+                             ring_radius=2, cell_slots=128)
+        assert_recall(kd, exp)
+        ki = np.asarray(ki)
+        assert self.mask[ki].all(), "returned a masked-out candidate"
+
+    def test_certified_queries_already_exact(self):
+        # whatever the certificate marks certain must match the oracle
+        # WITHOUT any fallback help
+        # g sized to the density: ~30k matches over ~7x11 deg-scale cells at
+        # g=64 overflows every cell; g=256 keeps ~25 per cell
+        idx = build_grid_index(
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(self.mask), g=256,
+        )
+        kd, ki, unc = knn_grid(
+            jnp.asarray(self.qx), jnp.asarray(self.qy), idx,
+            k=self.k, ring_radius=2, cell_slots=128,
+        )
+        unc = np.asarray(unc)
+        assert (~unc).sum() > 0, "test needs some certified queries"
+        exp = oracle(self.qx, self.qy, self.dx, self.dy, self.mask, self.k)
+        assert_recall(np.asarray(kd)[~unc], exp[~unc])
+
+    def test_sparse_region_flags_not_crashes(self):
+        # queries far from all data: fewer than k in the neighborhood ->
+        # flagged -> fallback produces the exact answer
+        qx = np.full(8, 170.0, np.float32)
+        qy = np.full(8, -80.0, np.float32)
+        exp = oracle(qx, qy, self.dx, self.dy, self.mask, self.k)
+        kd, _ = knn_indexed(
+            jnp.asarray(qx), jnp.asarray(qy),
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(self.mask), k=self.k, g=64,
+            ring_radius=1, cell_slots=64,
+        )
+        assert_recall(kd, exp)
+
+    def test_dense_cell_overflow_fallback(self):
+        # one cell holds far more points than cell_slots: overflow flag
+        # must force the fallback, keeping exactness
+        n = 20_000
+        dx = rng.normal(2.0, 0.005, n).astype(np.float32)  # single-cell cluster
+        dy = rng.normal(48.0, 0.005, n).astype(np.float32)
+        mask = np.ones(n, bool)
+        qx = rng.normal(2.0, 0.01, 16).astype(np.float32)
+        qy = rng.normal(48.0, 0.01, 16).astype(np.float32)
+        exp = oracle(qx, qy, dx, dy, mask, 5)
+        kd, _ = knn_indexed(
+            jnp.asarray(qx), jnp.asarray(qy), jnp.asarray(dx),
+            jnp.asarray(dy), jnp.asarray(mask), k=5, g=64, cell_slots=64,
+        )
+        assert_recall(kd, exp)
+
+    def test_antimeridian_queries_flagged(self):
+        # data on both sides of the seam; queries at the lon edge must not
+        # be falsely certified (their square clips the grid edge)
+        n = 5000
+        dx = np.concatenate([
+            rng.uniform(178, 180, n // 2), rng.uniform(-180, -178, n // 2)
+        ]).astype(np.float32)
+        dy = rng.uniform(-5, 5, n).astype(np.float32)
+        mask = np.ones(n, bool)
+        qx = np.asarray([179.9, -179.9, 179.5], np.float32)
+        qy = np.asarray([0.0, 1.0, -1.0], np.float32)
+        exp = oracle(qx, qy, dx, dy, mask, 5)
+        kd, _ = knn_indexed(
+            jnp.asarray(qx), jnp.asarray(qy), jnp.asarray(dx),
+            jnp.asarray(dy), jnp.asarray(mask), k=5, g=64,
+        )
+        assert_recall(kd, exp)
+
+    def test_reused_index_matches_fresh(self):
+        idx = build_grid_index(
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(self.mask), g=64,
+        )
+        kd1, ki1 = knn_indexed(*self._args(), k=self.k, g=64, index=idx)
+        kd2, ki2 = knn_indexed(*self._args(), k=self.k, g=64)
+        np.testing.assert_allclose(np.asarray(kd1), np.asarray(kd2), atol=1.0)

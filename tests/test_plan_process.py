@@ -302,6 +302,28 @@ class TestProcesses:
         exp = np.sort(d, axis=1)[:, :5]
         np.testing.assert_allclose(res.distances_m, exp, rtol=1e-6)
 
+    def test_knn_grid_impl_matches_oracle(self, catalog):
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        r = np.random.default_rng(8)
+        qsft = SimpleFeatureType.from_spec("q", "name:String,*geom:Point")
+        nq = 32
+        qx, qy = r.uniform(-4, 4, nq), r.uniform(51, 59, nq)
+        queries = FeatureBatch.from_pydict(
+            qsft, {"name": [f"q{i}" for i in range(nq)],
+                   "geom": np.stack([qx, qy], 1)}
+        )
+        res = KNearestNeighborSearchProcess().execute(
+            queries, src, num_desired=5, estimated_distance_m=20_000,
+            impl="grid",
+        )
+        d = haversine_m_np(qx[:, None], qy[:, None],
+                           batch.geometry.x[None, :], batch.geometry.y[None, :])
+        exp = np.sort(d, axis=1)[:, :5]
+        np.testing.assert_allclose(
+            res.distances_m, exp, rtol=1e-4, atol=1.0
+        )
+
     def test_knn_respects_max_distance(self, catalog):
         ds, batch, _ = catalog
         src = ds.get_feature_source("ais")
