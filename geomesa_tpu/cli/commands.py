@@ -59,8 +59,8 @@ def register(sub: "argparse._SubParsersAction") -> None:
         [cat, feat, cql,
          (["--output", "-o"], {"default": "-", "help": "output path (- = stdout)"}),
          (["--format", "-F"], {"default": "csv",
-          "choices": ["csv", "tsv", "json", "arrow", "bin", "wkt", "shp",
-                      "leaflet"]}),
+          "choices": ["csv", "tsv", "json", "gml", "arrow", "bin", "wkt",
+                      "shp", "parquet", "orc", "leaflet"]}),
          (["--attributes", "-a"], {"default": None, "help": "comma-sep projection"}),
          (["--max-features", "-m"], {"type": int, "default": None}),
          (["--bin-track"], {"default": None, "help": "track attr for bin format"})],
@@ -209,7 +209,7 @@ def _export(args) -> int:
     src = ds.get_feature_source(args.feature_name)
     attrs = args.attributes.split(",") if args.attributes else None
     hints = QueryHints()
-    binary = args.format in ("arrow", "bin")
+    binary = args.format in ("arrow", "bin", "parquet", "orc")
     if args.format == "bin":
         track = args.bin_track or next(
             (a.name for a in src.sft.attributes if not a.is_geometry), None
@@ -245,27 +245,104 @@ def _export(args) -> int:
     try:
         if args.format == "bin":
             out.write(r.bin_bytes or b"")
-        elif args.format == "arrow":
-            if r.features is None or len(r.features) == 0:
-                print("no features matched; nothing written", file=sys.stderr)
-            else:
-                import io
-
-                import pyarrow as pa
-
-                from geomesa_tpu.core.arrow_io import to_arrow
-
-                rb = to_arrow(r.features)
-                sink = io.BytesIO()
-                with pa.ipc.new_stream(sink, rb.schema) as w:
-                    w.write_batch(rb)
-                out.write(sink.getvalue())
+        elif args.format in ("arrow", "parquet", "orc"):
+            out.write(_arrow_bytes(r.features, src.sft, args.format))
+        elif args.format == "gml":
+            _write_gml(out, r.features, args.feature_name)
         else:
             _write_text(out, r.features, args.format)
     finally:
         if args.output != "-":
             out.close()
     return 0
+
+
+def _arrow_bytes(batch, sft, fmt: str) -> bytes:
+    """Encode features as Arrow IPC / Parquet / ORC bytes. Zero matches
+    still yields a VALID schema-only file (a 0-byte parquet/orc is corrupt
+    to every reader), built from an empty batch of the feature type."""
+    import io
+
+    import pyarrow as pa
+
+    from geomesa_tpu.core.arrow_io import to_arrow
+    from geomesa_tpu.core.columnar import FeatureBatch
+
+    if batch is None or len(batch) == 0:
+        print("no features matched; writing schema-only output",
+              file=sys.stderr)
+        batch = FeatureBatch.from_pydict(
+            sft, {a.name: [] for a in sft.attributes}
+        )
+    rb = to_arrow(batch)
+    sink = io.BytesIO()
+    if fmt == "arrow":
+        with pa.ipc.new_stream(sink, rb.schema) as w:
+            w.write_batch(rb)
+    else:
+        table = pa.Table.from_batches([rb])
+        if fmt == "parquet":
+            import pyarrow.parquet as papq
+
+            papq.write_table(table, sink)
+        else:
+            import pyarrow.orc as paorc
+
+            # ORC has no dictionary type: decode dict columns
+            cols = [
+                c.cast(c.type.value_type)
+                if pa.types.is_dictionary(c.type) else c
+                for c in (table.column(i).combine_chunks()
+                          for i in range(table.num_columns))
+            ]
+            paorc.write_table(pa.table(cols, names=table.column_names), sink)
+    return sink.getvalue()
+
+
+def _write_gml(out, batch, type_name):
+    """GML 3.1 FeatureCollection (the reference's GML export format). Point
+    members use gml:pos lat-order per the GML spec's EPSG:4326 axis order."""
+    from xml.sax.saxutils import escape, quoteattr
+
+    from geomesa_tpu.core.columnar import DictColumn, GeometryColumn
+    from geomesa_tpu.core.wkt import to_wkt
+
+    out.write(
+        '<?xml version="1.0" encoding="UTF-8"?>\n'
+        '<gml:FeatureCollection xmlns:gml="http://www.opengis.net/gml" '
+        'xmlns:geomesa="http://geomesa.org">\n'
+    )
+    if batch is not None and len(batch):
+        names = batch.sft.attribute_names
+        fids = batch.fids.decode() if batch.fids is not None else range(len(batch))
+        # decode()/materialize once per column — per-row decode is O(N^2)
+        cols = {}
+        for n in names:
+            col = batch.columns[n]
+            if isinstance(col, GeometryColumn):
+                cols[n] = col
+            elif isinstance(col, DictColumn):
+                cols[n] = col.decode()
+            else:
+                cols[n] = col
+        for i in range(len(batch)):
+            out.write(f'  <gml:featureMember>\n    <geomesa:{type_name} '
+                      f"gml:id={quoteattr(str(fids[i]))}>\n")
+            for n in names:
+                col = cols[n]
+                if isinstance(col, GeometryColumn):
+                    if col.is_point:
+                        gml = (f'<gml:Point srsName="EPSG:4326"><gml:pos>'
+                               f"{col.y[i]} {col.x[i]}</gml:pos></gml:Point>")
+                    else:
+                        gml = escape(to_wkt(col.geometry(i)))
+                    out.write(f"      <geomesa:{n}>{gml}</geomesa:{n}>\n")
+                else:
+                    out.write(
+                        f"      <geomesa:{n}>{escape(str(col[i]))}</geomesa:{n}>\n"
+                    )
+            out.write(f"    </geomesa:{type_name}>\n  </gml:featureMember>\n")
+    out.write("</gml:FeatureCollection>\n")
 
 
 def _write_text(out, batch, fmt):

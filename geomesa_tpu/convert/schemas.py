@@ -93,8 +93,59 @@ NYC_TAXI_CONVERTER = {
     ],
 }
 
+OSM_SPEC = (
+    "osm_id:String,user:String,version:Integer,tags:String,"
+    "dtg:Date,*geom:Point:srid=4326"
+)
+
+OSM_SFT = SimpleFeatureType.from_spec("osm", OSM_SPEC)
+
+# OSM nodes flattened to CSV (osmconvert --csv layout):
+# $1=id $2=lon $3=lat $4=user $5=version $6=timestamp(ISO) $7=tags
+OSM_CONVERTER = {
+    "type": "delimited-text",
+    "format": "CSV",
+    "id-field": "$1",
+    "fields": [
+        {"name": "osm_id", "transform": "$1::string"},
+        {"name": "user", "transform": "withDefault($4, '')"},
+        {"name": "version", "transform": "toInt($5, 1)"},
+        {"name": "tags", "transform": "withDefault($7, '')"},
+        {"name": "dtg", "transform": "isoDateTime($6)"},
+        {"name": "geom", "transform": "point($2, $3)"},
+    ],
+}
+
+TWITTER_SPEC = (
+    "tweet_id:String,user_name:String,text:String,"
+    "dtg:Date,*geom:Point:srid=4326"
+)
+
+TWITTER_SFT = SimpleFeatureType.from_spec("twitter", TWITTER_SPEC)
+
+# Twitter API v1.1 statuses (one JSON object per line); geo-tagged tweets
+# carry GeoJSON [lon, lat] in coordinates.coordinates
+TWITTER_CONVERTER = {
+    "type": "json",
+    "id-field": "$tweet_id",
+    "fields": [
+        {"name": "tweet_id", "path": "$.id_str"},
+        {"name": "user_name", "path": "$.user.screen_name",
+         "transform": "withDefault($0, '')"},
+        {"name": "text", "path": "$.text",
+         "transform": "withDefault($0, '')"},
+        {"name": "dtg", "path": "$.created_at",
+         "transform": "dateParse('EEE MMM dd HH:mm:ss Z yyyy', $0)"},
+        {"name": "lon", "path": "$.coordinates.coordinates.0"},
+        {"name": "lat", "path": "$.coordinates.coordinates.1"},
+        {"name": "geom", "transform": "point($lon, $lat)"},
+    ],
+}
+
 WELL_KNOWN = {
     "gdelt": (GDELT_SFT, GDELT_CONVERTER),
     "ais": (AIS_SFT, AIS_CONVERTER),
     "nyctaxi": (NYC_TAXI_SFT, NYC_TAXI_CONVERTER),
+    "osm": (OSM_SFT, OSM_CONVERTER),
+    "twitter": (TWITTER_SFT, TWITTER_CONVERTER),
 }

@@ -69,10 +69,15 @@ def _tokenize(s: str):
     return out
 
 
+# longer tokens first (MMM before MM, EEE before any E handling) so prefix
+# tokens can't corrupt them; quoted literals go through placeholders so a
+# later token rule can never rewrite their contents
 _JAVA_TO_STRPTIME = [
-    ("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
-    ("mm", "%M"), ("ss", "%S"), ("SSS", "%f"), ("DDD", "%j"), ("'T'", "T"),
-    ("'Z'", "Z"),
+    ("'T'", "\x01"), ("'Z'", "\x02"),
+    ("yyyy", "%Y"), ("EEE", "%a"), ("MMM", "%b"), ("MM", "%m"),
+    ("dd", "%d"), ("HH", "%H"), ("mm", "%M"), ("ss", "%S"),
+    ("SSS", "%f"), ("DDD", "%j"), ("Z", "%z"),
+    ("\x01", "T"), ("\x02", "Z"),
 ]
 
 
@@ -82,11 +87,33 @@ def _java_pattern(p: str) -> str:
     return p
 
 
+_EN_MONTHS = {
+    "Jan": "01", "Feb": "02", "Mar": "03", "Apr": "04", "May": "05",
+    "Jun": "06", "Jul": "07", "Aug": "08", "Sep": "09", "Oct": "10",
+    "Nov": "11", "Dec": "12",
+}
+_EN_DAYS = ("Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun")
+
+
 def _parse_date(pattern: str, v: str) -> int:
     import datetime as dt
+    import re as _re
 
     fmt = _java_pattern(pattern)
-    d = dt.datetime.strptime(str(v).strip(), fmt)
+    s = str(v).strip()
+    # EEE/MMM name tokens are defined as ENGLISH in the Java patterns these
+    # configs come from, but strptime's %a/%b follow LC_TIME — normalize to
+    # numerics/removal so parsing is locale-independent
+    if "%b" in fmt:
+        for name, num in _EN_MONTHS.items():
+            if name in s:
+                s = s.replace(name, num, 1)
+                break
+        fmt = fmt.replace("%b", "%m")
+    if "%a" in fmt:
+        s = _re.sub(r"(?:%s)\s*" % "|".join(_EN_DAYS), "", s, count=1)
+        fmt = _re.sub(r"%a\s*", "", fmt, count=1)
+    d = dt.datetime.strptime(s, fmt)
     if d.tzinfo is None:
         d = d.replace(tzinfo=dt.timezone.utc)
     return int(d.timestamp() * 1000)

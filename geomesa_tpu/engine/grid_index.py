@@ -195,6 +195,69 @@ def knn_grid(
     return jax.vmap(one_query)(qcx, qcy, qx, qy)
 
 
+def knn_indexed_sharded(
+    mesh,
+    qx: jax.Array,
+    qy: jax.Array,
+    dx: jax.Array,
+    dy: jax.Array,
+    mask: jax.Array,
+    k: int,
+    g: int = 128,
+    ring_radius: int = 2,
+    cell_slots: int = 256,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Grid-index kNN with data sharded over the mesh axis.
+
+    Each device sorts ITS shard into a local grid index (the sort
+    parallelizes perfectly — no cross-device data movement), runs the
+    certified neighborhood search for the replicated queries, and the
+    per-shard top-ks merge by all_gather + re-top-k (C25's reduction-tree
+    shape, same argument as knn_sharded: the global top-k is a subset of
+    the union of exact per-shard top-ks).
+
+    A query is globally uncertain if ANY shard's certificate failed for it
+    (an or-reduce over the gathered flags); callers re-run flagged queries
+    on an exact sharded scan (`knn_sharded`). Returns
+    (dists [Q,k], global indices [Q,k], uncertain [Q]) replicated.
+    """
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from geomesa_tpu.engine.knn import _topk_smallest
+    from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+    d_count = mesh.devices.size
+    shard_n = dx.shape[0] // d_count
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(), P(), P()),
+        # post-gather merge computes identical values on every device
+        check_vma=False,
+    )
+    def run(qx, qy, dxs, dys, ms):
+        index = build_grid_index(dxs, dys, ms, g=g)
+        kd, ki, unc = knn_grid(
+            qx, qy, index, k=k, ring_radius=ring_radius,
+            cell_slots=cell_slots,
+        )
+        shard = jax.lax.axis_index(SHARD_AXIS)
+        gi = ki + shard * shard_n
+        all_d = jax.lax.all_gather(kd, SHARD_AXIS)   # [D, Q, k]
+        all_i = jax.lax.all_gather(gi, SHARD_AXIS)
+        all_u = jax.lax.all_gather(unc, SHARD_AXIS)  # [D, Q]
+        pool_d = jnp.moveaxis(all_d, 0, 1).reshape(kd.shape[0], -1)
+        pool_i = jnp.moveaxis(all_i, 0, 1).reshape(kd.shape[0], -1)
+        md, sel = _topk_smallest(pool_d, k)
+        return md, jnp.take_along_axis(pool_i, sel, axis=1), jnp.any(all_u, 0)
+
+    return run(qx, qy, dx, dy, mask)
+
+
 def knn_indexed(
     qx, qy, dx, dy, mask, k: int,
     g: int = 128, ring_radius: int = 2, cell_slots: int = 256,

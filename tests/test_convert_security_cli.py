@@ -201,6 +201,33 @@ class TestConverters:
         assert batch.column("VesselName").decode() == ["EVER GIVEN"]
         assert batch.geometry.y[0] == pytest.approx(29.9)
 
+    def test_osm_schema(self):
+        sft, config = schemas.WELL_KNOWN["osm"]
+        csv_text = "42,2.35,48.85,mapper,3,2021-05-01T12:00:00,amenity=cafe\n"
+        conv = converter_from_config(sft, config)
+        batch = conv.convert(io.StringIO(csv_text))
+        assert len(batch) == 1
+        assert batch.column("osm_id").decode() == ["42"]
+        assert batch.column("version")[0] == 3
+        assert batch.geometry.x[0] == pytest.approx(2.35)
+
+    def test_twitter_schema(self):
+        import json as _json
+
+        sft, config = schemas.WELL_KNOWN["twitter"]
+        tweet = {
+            "id_str": "123", "text": "hello",
+            "user": {"screen_name": "alice"},
+            "created_at": "Wed Aug 27 13:08:45 +0000 2008",
+            "coordinates": {"type": "Point", "coordinates": [-74.0, 40.7]},
+        }
+        conv = converter_from_config(sft, config)
+        batch = conv.convert(io.StringIO(_json.dumps(tweet)))
+        assert len(batch) == 1
+        assert batch.column("user_name").decode() == ["alice"]
+        assert batch.geometry.y[0] == pytest.approx(40.7)
+        assert batch.column("dtg")[0] == 1219842525000
+
 
 class TestVisibility:
     def test_parse_eval(self):
@@ -331,6 +358,19 @@ class TestCLI:
         r = run_cli(["export", "-c", cat, "-f", "pois", "-q", "name = 'cafe'",
                      "-F", "csv"], cli_env)
         assert "cafe" in r.stdout and "pub" not in r.stdout
+        r = run_cli(["export", "-c", cat, "-f", "pois", "-F", "gml"], cli_env)
+        assert r.returncode == 0, r.stderr
+        assert "<gml:FeatureCollection" in r.stdout and "gml:pos" in r.stdout
+        for fmt in ("parquet", "orc"):
+            out = str(tmp_path / f"out.{fmt}")
+            r = run_cli(["export", "-c", cat, "-f", "pois", "-F", fmt,
+                         "-o", out], cli_env)
+            assert r.returncode == 0, r.stderr
+            import pyarrow.orc as paorc
+            import pyarrow.parquet as papq
+
+            t = (papq if fmt == "parquet" else paorc).read_table(out)
+            assert t.num_rows == 2
         r = run_cli(["explain", "-c", cat, "-f", "pois",
                      "-q", "BBOX(geom, 0, 40, 5, 50)"], cli_env)
         assert "Partitions" in r.stdout

@@ -142,6 +142,34 @@ class TestGridIndex:
         )
         assert_recall(kd, exp)
 
+    def test_sharded_matches_oracle(self):
+        from geomesa_tpu.engine.grid_index import knn_indexed_sharded
+        from geomesa_tpu.engine.knn import knn_sharded
+        from geomesa_tpu.parallel.mesh import default_mesh
+
+        mesh = default_mesh()
+        n = self.n - (self.n % 8)
+        dx, dy, mask = self.dx[:n], self.dy[:n], self.mask[:n]
+        exp = oracle(self.qx, self.qy, dx, dy, mask, self.k)
+        # per-shard density is 1/8th: size the grid to the shard
+        kd, ki, unc = knn_indexed_sharded(
+            mesh, jnp.asarray(self.qx), jnp.asarray(self.qy),
+            jnp.asarray(dx), jnp.asarray(dy), jnp.asarray(mask),
+            k=self.k, g=128, ring_radius=2, cell_slots=256,
+        )
+        kd, ki, unc = np.asarray(kd), np.asarray(ki), np.asarray(unc)
+        if unc.any():
+            fd, fi = knn_sharded(
+                mesh, jnp.asarray(self.qx[unc]), jnp.asarray(self.qy[unc]),
+                jnp.asarray(dx), jnp.asarray(dy), jnp.asarray(mask),
+                k=self.k,
+            )
+            kd[unc] = np.asarray(fd)
+            ki[unc] = np.asarray(fi)
+        assert_recall(kd, exp)
+        finite = np.isfinite(kd)
+        assert mask[ki[finite]].all()
+
     def test_reused_index_matches_fresh(self):
         idx = build_grid_index(
             jnp.asarray(self.dx), jnp.asarray(self.dy),
