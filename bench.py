@@ -363,7 +363,7 @@ def main(argv=None) -> int:
     # over a GDELT-realistic batch; both sides scan the same n. Configs
     # whose CPU baseline is superlinear-or-heavy in n keep a smaller default
     # so a full 5-config sweep stays within a bench budget.
-    per_config = {1: 1 << 22, 2: 1 << 22, 3: 1 << 26, 4: 1 << 26, 5: 1 << 22}
+    per_config = {1: 1 << 24, 2: 1 << 22, 3: 1 << 26, 4: 1 << 26, 5: 1 << 22}
     n = args.n or (
         1 << 17 if args.smoke else per_config.get(args.config or 3, 1 << 26)
     )

@@ -299,13 +299,60 @@ def _arrow_bytes(batch, sft, fmt: str) -> bytes:
     return sink.getvalue()
 
 
+def _gml_geometry(g) -> str:
+    """GML 3.1 markup for a host Geometry (gml:pos/posList are lat lon
+    order per the spec's EPSG:4326 axis order)."""
+
+    def pos(ring):
+        return " ".join(f"{p[1]} {p[0]}" for p in ring)
+
+    def polygon(rings):
+        s = (f"<gml:Polygon><gml:exterior><gml:LinearRing><gml:posList>"
+             f"{pos(rings[0])}</gml:posList></gml:LinearRing></gml:exterior>")
+        for hole in rings[1:]:
+            s += (f"<gml:interior><gml:LinearRing><gml:posList>{pos(hole)}"
+                  f"</gml:posList></gml:LinearRing></gml:interior>")
+        return s + "</gml:Polygon>"
+
+    k = g.kind
+    if k == "Point":
+        return (f'<gml:Point srsName="EPSG:4326"><gml:pos>{pos(g.rings[0])}'
+                f"</gml:pos></gml:Point>")
+    if k == "LineString":
+        return (f"<gml:LineString><gml:posList>{pos(g.rings[0])}"
+                f"</gml:posList></gml:LineString>")
+    if k == "Polygon":
+        return polygon(g.rings)
+    # Multi*/collections: one member per part (parts = ring count per part)
+    members = []
+    at = 0
+    for count in (g.parts or [1] * len(g.rings)):
+        rings = g.rings[at:at + count]
+        at += count
+        if k == "MultiPoint":
+            members.append(
+                f"<gml:pointMember><gml:Point><gml:pos>{pos(rings[0])}"
+                f"</gml:pos></gml:Point></gml:pointMember>")
+        elif k == "MultiLineString":
+            members.append(
+                f"<gml:lineStringMember><gml:LineString><gml:posList>"
+                f"{pos(rings[0])}</gml:posList></gml:LineString>"
+                f"</gml:lineStringMember>")
+        else:
+            members.append(
+                f"<gml:polygonMember>{polygon(rings)}</gml:polygonMember>")
+    tag = {"MultiPoint": "MultiPoint", "MultiLineString": "MultiLineString"}.get(
+        k, "MultiPolygon"
+    )
+    return f"<gml:{tag}>{''.join(members)}</gml:{tag}>"
+
+
 def _write_gml(out, batch, type_name):
     """GML 3.1 FeatureCollection (the reference's GML export format). Point
     members use gml:pos lat-order per the GML spec's EPSG:4326 axis order."""
     from xml.sax.saxutils import escape, quoteattr
 
     from geomesa_tpu.core.columnar import DictColumn, GeometryColumn
-    from geomesa_tpu.core.wkt import to_wkt
 
     out.write(
         '<?xml version="1.0" encoding="UTF-8"?>\n'
@@ -335,7 +382,7 @@ def _write_gml(out, batch, type_name):
                         gml = (f'<gml:Point srsName="EPSG:4326"><gml:pos>'
                                f"{col.y[i]} {col.x[i]}</gml:pos></gml:Point>")
                     else:
-                        gml = escape(to_wkt(col.geometry(i)))
+                        gml = _gml_geometry(col.geometry(i))
                     out.write(f"      <geomesa:{n}>{gml}</geomesa:{n}>\n")
                 else:
                     out.write(

@@ -61,6 +61,27 @@ class DictColumn:
                 codes[i] = code
         return cls(codes, vocab)
 
+    @classmethod
+    def concat(cls, parts: Sequence["DictColumn"]) -> "DictColumn":
+        """Vocab-merge concat: O(sum vocab) dict work + vectorized code
+        remaps — decode()+encode() over every ROW costs a Python loop per
+        element and dominated superbatch rebuilds at millions of rows."""
+        vocab: List[str] = []
+        lookup: Dict[str, int] = {}
+        out = []
+        for p in parts:
+            remap = np.empty(len(p.vocab) + 1, dtype=np.int32)
+            remap[-1] = -1  # null code -1 indexes the sentinel slot
+            for j, v in enumerate(p.vocab):
+                code = lookup.get(v)
+                if code is None:
+                    code = len(vocab)
+                    lookup[v] = code
+                    vocab.append(v)
+                remap[j] = code
+            out.append(remap[p.codes])
+        return cls(np.concatenate(out) if out else np.empty(0, np.int32), vocab)
+
 
 @dataclasses.dataclass
 class GeometryColumn:
@@ -104,8 +125,14 @@ class GeometryColumn:
         )
 
     @classmethod
-    def from_geometries(cls, geoms: Sequence[Geometry]) -> "GeometryColumn":
-        kinds = {g.kind for g in geoms}
+    def from_geometries(
+        cls, geoms: Sequence[Geometry], kind: Optional[str] = None
+    ) -> "GeometryColumn":
+        """`kind` pins the column's geometry type when `geoms` cannot speak
+        for itself — an EMPTY list otherwise defaults to Point, which makes
+        a zero-row batch's arrow schema (struct x,y) disagree with the
+        feature type's declared non-Point layout (utf8/CSR)."""
+        kinds = {g.kind for g in geoms} or ({kind} if kind else set())
         if kinds <= {"Point"}:
             xy = np.array([g.point for g in geoms], dtype=np.float64).reshape(-1, 2)
             return cls.from_points(xy[:, 0], xy[:, 1])
@@ -302,17 +329,18 @@ class FeatureBatch:
             if isinstance(first, np.ndarray):
                 cols[name] = np.concatenate(parts)
             elif isinstance(first, DictColumn):
-                cols[name] = DictColumn.encode(
-                    [v for p in parts for v in p.decode()]
+                cols[name] = DictColumn.concat(parts)
+            elif all(p.is_point for p in parts):
+                cols[name] = GeometryColumn.from_points(
+                    np.concatenate([p.x for p in parts]),
+                    np.concatenate([p.y for p in parts]),
                 )
             else:
                 geoms = [p.geometry(i) for p in parts for i in range(len(p))]
                 cols[name] = GeometryColumn.from_geometries(geoms)
         fids = None
         if batches[0].fids is not None:
-            fids = DictColumn.encode(
-                [v for b in batches for v in b.fids.decode()]
-            )
+            fids = DictColumn.concat([b.fids for b in batches])
         valid = None
         if any(b.valid is not None for b in batches):
             valid = np.concatenate(
@@ -355,7 +383,9 @@ class FeatureBatch:
                         arr = np.asarray(raw, dtype=np.float64)
                         cols[attr.name] = GeometryColumn.from_points(arr[:, 0], arr[:, 1])
                     else:
-                        cols[attr.name] = GeometryColumn.from_geometries(raw)
+                        cols[attr.name] = GeometryColumn.from_geometries(
+                            raw, kind=attr.type
+                        )
             elif attr.type in ("String", "UUID"):
                 cols[attr.name] = DictColumn.encode(list(raw))
             elif attr.is_temporal:

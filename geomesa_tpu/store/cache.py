@@ -37,13 +37,29 @@ MANIFEST = ".device_cache.json"
 
 @dataclasses.dataclass
 class CacheEntry:
-    """One partition resident on device."""
+    """One resident partition (host columnar copy; device residency lives
+    in the concatenated superbatch — see `superbatch()`)."""
 
     files: List[str]  # source files (residency provenance)
     count: int  # valid rows
     padded: int  # padded device length (pow2)
     batch: FeatureBatch  # host copy (padded)
-    dev: dict  # DeviceBatch
+
+
+@dataclasses.dataclass
+class SuperBatch:
+    """All resident partitions as ONE device batch + a partition-id row
+    column. Execution masks pruned-out partitions by lane (allowed[pid])
+    instead of dispatching per-partition kernels: at ~100ms per device
+    round trip on remote-tunnel platforms and ~1ms per kernel launch, one
+    dense pass over every resident row beats dozens of tiny dispatches —
+    partition pruning still governs what gets LOADED into HBM."""
+
+    batch: FeatureBatch          # host concat (padded segments)
+    dev: dict                    # DeviceBatch of the concat
+    pids: object                 # device i32 [N] partition id per row
+    ids: Dict[str, int]          # partition name -> id
+    version: int
 
 
 class DeviceCacheManager:
@@ -53,6 +69,8 @@ class DeviceCacheManager:
         self.storage = storage
         self.coord_dtype = coord_dtype
         self._entries: Dict[str, CacheEntry] = {}
+        self._super: Optional[SuperBatch] = None
+        self._version = 0
 
     # -- residency ---------------------------------------------------------
 
@@ -60,22 +78,17 @@ class DeviceCacheManager:
         return sorted(e["file"] for e in self.storage.manifest.get(name, []))
 
     def _load_partition(self, name: str) -> Optional[CacheEntry]:
-        from geomesa_tpu.engine.device import to_device
-
         batches = list(self.storage.scan_partitions([name]))
         if not batches:
             return None
         batch = FeatureBatch.concat(batches)
         n = len(batch)
         padded = batch.pad_to(_next_pow2(n))
-        kw = {"coord_dtype": self.coord_dtype} if self.coord_dtype else {}
-        dev = to_device(padded, **kw)
         return CacheEntry(
             files=self._partition_files(name),
             count=n,
             padded=len(padded),
             batch=padded,
-            dev=dev,
         )
 
     def ensure(self, partitions: Optional[List[str]] = None) -> List[str]:
@@ -96,6 +109,9 @@ class DeviceCacheManager:
             else:
                 self._entries[name] = entry  # atomic reference flip
             loaded.append(name)
+        if loaded:
+            self._super = None  # residency changed: superbatch stale
+            self._version += 1
         return loaded
 
     def refresh(self) -> List[str]:
@@ -105,6 +121,9 @@ class DeviceCacheManager:
         dropped = [n for n in self._entries if n not in current]
         for n in dropped:
             del self._entries[n]
+        if dropped:
+            self._super = None
+            self._version += 1
         return self.ensure() + dropped
 
     def invalidate(self, partition: Optional[str] = None) -> None:
@@ -112,9 +131,41 @@ class DeviceCacheManager:
             self._entries.clear()
         else:
             self._entries.pop(partition, None)
+        self._super = None
+        self._version += 1
 
     def get(self, partition: str) -> Optional[CacheEntry]:
         return self._entries.get(partition)
+
+    def superbatch(self) -> Optional[SuperBatch]:
+        """The concatenated device view of every resident partition (None
+        when nothing is resident). Built lazily and re-uploaded only when
+        residency changes — the double-buffered snapshot idea at store
+        granularity."""
+        if self._super is not None:
+            return self._super
+        if not self._entries:
+            return None
+        import jax.numpy as jnp
+        import numpy as np
+
+        from geomesa_tpu.engine.device import to_device
+
+        names = sorted(self._entries)
+        entries = [self._entries[n] for n in names]
+        batch = FeatureBatch.concat([e.batch for e in entries])
+        pids_host = np.concatenate([
+            np.full(e.padded, i, np.int32) for i, e in enumerate(entries)
+        ])
+        kw = {"coord_dtype": self.coord_dtype} if self.coord_dtype else {}
+        self._super = SuperBatch(
+            batch=batch,
+            dev=to_device(batch, **kw),
+            pids=jnp.asarray(pids_host),
+            ids={n: i for i, n in enumerate(names)},
+            version=self._version,
+        )
+        return self._super
 
     def resident(self) -> List[str]:
         return sorted(self._entries)
@@ -175,4 +226,7 @@ class DeviceCacheManager:
             )
             self._entries[name] = entry
             restored.append(name)
+        if restored:
+            self._super = None  # residency changed: superbatch stale
+            self._version += 1
         return restored, stale
