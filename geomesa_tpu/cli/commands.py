@@ -322,13 +322,21 @@ def _gml_geometry(g) -> str:
         return (f"<gml:LineString><gml:posList>{pos(g.rings[0])}"
                 f"</gml:posList></gml:LineString>")
     if k == "Polygon":
-        return polygon(g.rings)
-    # Multi*/collections: one member per part (parts = ring count per part)
+        return polygon(g.rings) if g.rings else "<gml:Polygon/>"
+    if k not in ("MultiPoint", "MultiLineString", "MultiPolygon"):
+        # GeometryCollection / mixed columns have no single GML container
+        # here — fail loudly rather than mislabel parts (the WKT export
+        # formats handle them)
+        raise ValueError(f"cannot encode {k} as GML")
+    # Multi*: one member per part (parts = ring count per part); empty
+    # parts (e.g. MULTIPOLYGON EMPTY) contribute no members
     members = []
     at = 0
     for count in (g.parts or [1] * len(g.rings)):
         rings = g.rings[at:at + count]
         at += count
+        if not rings:
+            continue
         if k == "MultiPoint":
             members.append(
                 f"<gml:pointMember><gml:Point><gml:pos>{pos(rings[0])}"
@@ -341,10 +349,7 @@ def _gml_geometry(g) -> str:
         else:
             members.append(
                 f"<gml:polygonMember>{polygon(rings)}</gml:polygonMember>")
-    tag = {"MultiPoint": "MultiPoint", "MultiLineString": "MultiLineString"}.get(
-        k, "MultiPolygon"
-    )
-    return f"<gml:{tag}>{''.join(members)}</gml:{tag}>"
+    return f"<gml:{k}>{''.join(members)}</gml:{k}>"
 
 
 def _write_gml(out, batch, type_name):

@@ -177,6 +177,8 @@ class QueryPlanner:
                     f"during {phase}"
                 )
 
+        from geomesa_tpu.utils.profiling import device_trace
+
         plan = self.plan(query, explain)
         # interceptors may have rewritten hints/projection/limits, not just
         # the filter — the rewritten query is authoritative from here on
@@ -192,7 +194,8 @@ class QueryPlanner:
         # row-exactly via parquet pushdown, which cached whole partitions
         # cannot reproduce once the residual drops the BBOX predicate
         if self.cache is not None and not hints.sampling and not hints.loose_bbox:
-            result, mask_count, t_scan = self._execute_cached(plan, query)
+            with device_trace("query"):
+                result, mask_count, t_scan = self._execute_cached(plan, query)
             t_done = time.perf_counter()
             self._record(query, plan, hints, mask_count,
                          t0, t_plan, t_scan, t_done)

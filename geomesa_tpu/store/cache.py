@@ -61,6 +61,13 @@ class SuperBatch:
     ids: Dict[str, int]          # partition name -> id
     version: int
 
+    # Known cost trade (deliberate for round 1): the host concat doubles
+    # host RAM for the resident set (per-partition batches are kept for
+    # double-buffered single-partition reloads), and any residency change
+    # rebuilds + re-uploads the whole superbatch. Incremental segment
+    # replacement (device-side concat of per-partition buffers) is the
+    # planned refinement if write-heavy workloads need it.
+
 
 class DeviceCacheManager:
     """Keeps partitions of a FileSystemStorage resident on device."""
@@ -104,11 +111,16 @@ class DeviceCacheManager:
             if cur is not None and cur.files == files:
                 continue
             entry = self._load_partition(name)
+            changed = True
             if entry is None:
-                self._entries.pop(name, None)
+                # only a real removal changes residency — a partition that
+                # can never load must not invalidate the superbatch on
+                # every query
+                changed = self._entries.pop(name, None) is not None
             else:
                 self._entries[name] = entry  # atomic reference flip
-            loaded.append(name)
+            if changed:
+                loaded.append(name)
         if loaded:
             self._super = None  # residency changed: superbatch stale
             self._version += 1

@@ -74,6 +74,10 @@ class SystemProperties:
         lambda s: s.lower() in ("1", "true"),
         "reject queries whose filter constrains nothing (full-table scans)",
     )
+    PROFILE_DIR = SystemProperty(
+        "geomesa.profile.dir", "", str,
+        "emit a jax profiler trace per query execution into this directory",
+    )
 
     _all = None
 

@@ -1,0 +1,45 @@
+"""Deep-dive device profiling hooks.
+
+Parity: SURVEY.md §5.1 — the reference's observability is explain logging
+plus per-query audit records; for kernel-level "why is this query slow"
+questions the TPU-native answer is the XLA profiler. This wraps
+`jax.profiler.trace` behind the `geomesa.profile.dir` system property so a
+single env var (`GEOMESA_TPU_PROFILE_DIR=/tmp/traces`) makes every planner
+execution emit a TensorBoard-loadable trace, with zero overhead when unset.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+def profile_dir() -> str | None:
+    """The configured trace directory, or None when profiling is off."""
+    from geomesa_tpu.utils.config import SystemProperties
+
+    v = SystemProperties.PROFILE_DIR.get()
+    return v or None
+
+
+@contextlib.contextmanager
+def device_trace(label: str = "query"):
+    """Wrap a block in a jax profiler trace when profiling is enabled.
+
+    Traces land under `<dir>/<label>-<seq>/` (TensorBoard's profile plugin
+    or `xprof` reads them). No-op context manager when unset.
+    """
+    d = profile_dir()
+    if not d:
+        yield
+        return
+    import jax
+
+    seq = next(_COUNTER)
+    path = os.path.join(d, f"{label}-{seq}")
+    os.makedirs(path, exist_ok=True)
+    with jax.profiler.trace(path):
+        yield
+
+
+_COUNTER = __import__("itertools").count()
