@@ -319,6 +319,8 @@ def _gml_geometry(g) -> str:
         return (f'<gml:Point srsName="EPSG:4326"><gml:pos>{pos(g.rings[0])}'
                 f"</gml:pos></gml:Point>")
     if k == "LineString":
+        if not g.rings:
+            return "<gml:LineString/>"
         return (f"<gml:LineString><gml:posList>{pos(g.rings[0])}"
                 f"</gml:posList></gml:LineString>")
     if k == "Polygon":
@@ -366,6 +368,14 @@ def _write_gml(out, batch, type_name):
     )
     if batch is not None and len(batch):
         names = batch.sft.attribute_names
+        # fail BEFORE writing anything: an unsupported geometry column kind
+        # raising mid-stream would leave a truncated invalid document
+        for n in names:
+            col = batch.columns[n]
+            if (isinstance(col, GeometryColumn) and not col.is_point
+                    and col.kind not in ("LineString", "Polygon", "MultiPoint",
+                                         "MultiLineString", "MultiPolygon")):
+                raise ValueError(f"cannot encode {col.kind} as GML")
         fids = batch.fids.decode() if batch.fids is not None else range(len(batch))
         # decode()/materialize once per column — per-row decode is O(N^2)
         cols = {}

@@ -161,11 +161,7 @@ class QueryPlanner:
     # -- execution ---------------------------------------------------------
 
     def execute(self, query: Query, explain: Optional[Explainer] = None) -> QueryResult:
-        import jax.numpy as jnp
-
-        from geomesa_tpu.engine.device import to_device
         from geomesa_tpu.utils.config import SystemProperties
-        from geomesa_tpu.utils.metrics import metrics
 
         timeout_ms = int(SystemProperties.QUERY_TIMEOUT_MS.get())
         t0 = time.perf_counter()
@@ -200,6 +196,16 @@ class QueryPlanner:
             self._record(query, plan, hints, mask_count,
                          t0, t_plan, t_scan, t_done)
             return result
+
+        with device_trace("query"):
+            return self._execute_scan(
+                query, plan, hints, t0, t_plan, check_timeout
+            )
+
+    def _execute_scan(self, query, plan, hints, t0, t_plan, check_timeout):
+        import jax.numpy as jnp
+
+        from geomesa_tpu.engine.device import to_device
 
         batches = list(
             self.storage.scan(

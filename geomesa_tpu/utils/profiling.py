@@ -11,6 +11,7 @@ execution emit a TensorBoard-loadable trace, with zero overhead when unset.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import os
 
 
@@ -42,4 +43,4 @@ def device_trace(label: str = "query"):
         yield
 
 
-_COUNTER = __import__("itertools").count()
+_COUNTER = itertools.count()

@@ -123,6 +123,28 @@ class TestFeatureBatch:
         assert len(c) == 12
         assert c.column("name").decode()[5] == "n0"
 
+    def test_dict_concat_vocab_merge(self):
+        # vectorized vocab-merge concat: shared values collapse to one
+        # code, nulls survive, decode round-trips
+        from geomesa_tpu.core.columnar import DictColumn
+
+        a = DictColumn.encode(["x", None, "y", "x"])
+        b = DictColumn.encode(["y", "z", None])
+        c = DictColumn.concat([a, b])
+        assert c.decode() == ["x", None, "y", "x", "y", "z", None]
+        assert len(c.vocab) == 3
+
+    def test_empty_geometry_column_keeps_declared_kind(self):
+        # a zero-row batch of a non-Point type must not degrade to a Point
+        # column (its arrow schema would disagree with the feature type)
+        sft = SimpleFeatureType.from_spec("t", "name:String,*geom:Polygon")
+        b = FeatureBatch.from_pydict(sft, {"name": [], "geom": []})
+        assert not b.geometry.is_point
+        from geomesa_tpu.core.arrow_io import to_arrow
+
+        rb = to_arrow(b)  # must build a consistent zero-row record batch
+        assert rb.num_rows == 0
+
     def test_extended_geometry_column(self):
         polys = [
             parse_wkt("POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))"),

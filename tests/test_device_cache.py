@@ -140,6 +140,24 @@ def test_cache_invalidate_and_stats(tmp_path):
     assert m.resident() == []
 
 
+def test_superbatch_stable_when_residency_unchanged(tmp_path):
+    # repeat ensure() calls with unchanged residency must serve the SAME
+    # superbatch object (a rebuild re-uploads every resident row)
+    sft, batch = make_batch()
+    ds = DataStore(str(tmp_path / "c"))
+    src = ds.create_schema(sft)
+    src.write(batch)
+    m = DeviceCacheManager(src.storage)
+    m.ensure()
+    sb1 = m.superbatch()
+    m.ensure()
+    assert m.superbatch() is sb1
+    # a genuine residency change invalidates
+    src.write(batch)
+    m.ensure()
+    assert m.superbatch() is not sb1
+
+
 def test_cached_loose_bbox_falls_back_exact(stores):
     """loose_bbox on the cached store must not return out-of-bbox rows:
     the cached path falls back to the scan path (parquet pushdown
