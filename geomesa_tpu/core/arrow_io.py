@@ -144,6 +144,18 @@ def _dict_from_arrow(arr: pa.Array) -> DictColumn:
     return DictColumn.encode(arr.to_pylist())
 
 
+def to_ipc_bytes(batch: FeatureBatch) -> bytes:
+    """One FeatureBatch as Arrow IPC stream bytes (the ArrowScan result
+    encoding; shard/partition results merge via merge_record_batches)."""
+    import io
+
+    rb = to_arrow(batch)
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as writer:
+        writer.write_batch(rb)
+    return sink.getvalue()
+
+
 def write_ipc(path: str, batches: Iterable[FeatureBatch]) -> None:
     batches = list(batches)
     if not batches:

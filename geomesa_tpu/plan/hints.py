@@ -27,6 +27,14 @@ class QueryHints:
     # stats aggregation (StatsScan): Stat DSL expression
     stats_string: Optional[str] = None
 
+    # arrow aggregation (ArrowScan): results as Arrow IPC stream bytes with
+    # dictionary-encoded strings (upstream: ARROW_ENCODE + ARROW_* hints).
+    # include_fid pins the schema deterministically (synthesized row fids
+    # when the store persisted none; stripped when False) so empty and
+    # non-empty shard results always merge
+    arrow_encode: bool = False
+    arrow_include_fid: bool = True
+
     # sampling: keep roughly 1-in-n (None = off); optional per-attribute
     sampling: Optional[int] = None
     sample_by: Optional[str] = None
@@ -58,3 +66,7 @@ class QueryHints:
     @property
     def is_bin(self) -> bool:
         return self.bin_track is not None
+
+    @property
+    def is_arrow(self) -> bool:
+        return self.arrow_encode

@@ -49,11 +49,12 @@ class QueryPlan:
 
 @dataclasses.dataclass
 class QueryResult:
-    kind: str  # features | density | stats | bin | count
+    kind: str  # features | density | stats | bin | arrow | count
     features: Optional[FeatureBatch] = None
     grid: Optional[np.ndarray] = None
     stats: object = None
     bin_bytes: Optional[bytes] = None
+    arrow_bytes: Optional[bytes] = None
     count: int = 0
 
 
@@ -219,7 +220,7 @@ class QueryPlanner:
 
         result: QueryResult
         if not batches:
-            result = self._empty_result(hints)
+            result = self._empty_result(hints, query)
             mask_count = 0
         else:
             batch = FeatureBatch.concat(batches)
@@ -300,14 +301,14 @@ class QueryPlanner:
 
         sb = self.cache.superbatch()
         if sb is None:
-            return self._empty_result(hints), 0, t_scan
+            return self._empty_result(hints, query), 0, t_scan
         allowed = np.zeros(max(len(sb.ids), 1), bool)
         for name in plan.partitions:
             i = sb.ids.get(name)
             if i is not None:
                 allowed[i] = True
         if not allowed.any():
-            return self._empty_result(hints), 0, t_scan
+            return self._empty_result(hints, query), 0, t_scan
 
         dev_mask = (
             plan.compiled.mask(sb.dev, sb.batch)
@@ -328,7 +329,7 @@ class QueryPlanner:
             )
             total = int(np.asarray(jnp.sum(dev_mask, dtype=jnp.int32)))
             if total == 0:
-                return self._empty_result(hints), 0, t_scan
+                return self._empty_result(hints, query), 0, t_scan
             return (
                 QueryResult("density", grid=np.asarray(grid), count=total),
                 total,
@@ -340,7 +341,7 @@ class QueryPlanner:
         mask = np.asarray(dev_mask)
         total = int(mask.sum())
         if total == 0:
-            return self._empty_result(hints), 0, t_scan
+            return self._empty_result(hints, query), 0, t_scan
         result = self._aggregate(sb.batch, sb.dev, mask, query)
         return result, total, t_scan
 
@@ -379,7 +380,9 @@ class QueryPlanner:
 
     # -- internals ---------------------------------------------------------
 
-    def _empty_result(self, hints: QueryHints) -> QueryResult:
+    def _empty_result(
+        self, hints: QueryHints, query: Optional[Query] = None
+    ) -> QueryResult:
         if hints.is_density:
             import numpy as np
 
@@ -391,6 +394,23 @@ class QueryPlanner:
             from geomesa_tpu.stats import parse_stats
 
             return QueryResult("stats", stats=parse_stats(hints.stats_string))
+        # same hint precedence as runner.aggregate (arrow before bin): the
+        # result KIND of a query must not depend on whether it matched rows
+        if hints.is_arrow:
+            from geomesa_tpu.core.arrow_io import to_ipc_bytes
+            from geomesa_tpu.plan.runner import apply_fid_policy, finish_features
+
+            sft = self.storage.sft
+            # the fid policy + projection make the empty stream's schema
+            # identical to non-empty results (client-side shard merges
+            # reject mismatched schemas)
+            empty = FeatureBatch.from_pydict(
+                sft, {a.name: [] for a in sft.attributes}
+            )
+            if query is not None:
+                empty = finish_features(empty, query)
+            empty = apply_fid_policy(empty, hints.arrow_include_fid)
+            return QueryResult("arrow", arrow_bytes=to_ipc_bytes(empty))
         if hints.is_bin:
             return QueryResult("bin", bin_bytes=b"")
         return QueryResult("features", features=None, count=0)

@@ -47,6 +47,22 @@ def density_device_grid(sft: SimpleFeatureType, batch, dev, dev_mask, hints):
     )
 
 
+def apply_fid_policy(batch: FeatureBatch, include_fid: bool) -> FeatureBatch:
+    """Deterministic __fid__ presence for wire formats: synthesize
+    row-index fids when requested but absent (the store may not have
+    persisted any), strip them when not — so a result's schema never
+    depends on the data that happened to match."""
+    import dataclasses
+
+    if include_fid and batch.fids is None:
+        return dataclasses.replace(
+            batch, fids=DictColumn.encode([str(i) for i in range(len(batch))])
+        )
+    if not include_fid and batch.fids is not None:
+        return dataclasses.replace(batch, fids=None)
+    return batch
+
+
 def aggregate(sft: SimpleFeatureType, batch, dev, mask: np.ndarray, query: "Query"):
     """Dispatch on hints: density / stats / bin aggregation, else features."""
     import jax.numpy as jnp
@@ -63,6 +79,18 @@ def aggregate(sft: SimpleFeatureType, batch, dev, mask: np.ndarray, query: "Quer
     if hints.is_stats:
         stats = run_stats(batch, dev, mask, hints.stats_string)
         return QueryResult("stats", stats=stats, count=int(mask.sum()))
+
+    if hints.is_arrow:
+        # ArrowScan analog: matched (projected) features as one Arrow IPC
+        # stream with dictionary-encoded strings; batches from different
+        # shards/partitions concatenate at the IPC level client-side
+        from geomesa_tpu.core.arrow_io import to_ipc_bytes
+
+        sel = finish_features(batch.select(np.nonzero(mask)[0]), query)
+        sel = apply_fid_policy(sel, hints.arrow_include_fid)
+        return QueryResult(
+            "arrow", arrow_bytes=to_ipc_bytes(sel), count=len(sel)
+        )
 
     if hints.is_bin:
         from geomesa_tpu.engine.bin import bin_pack, encode_bin

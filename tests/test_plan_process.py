@@ -95,6 +95,28 @@ class TestDataStore:
         q = Query("ais", "INCLUDE", hints=QueryHints(exact_count=False))
         assert src.get_count(q) == len(batch)
 
+    def test_arrow_encode_hint(self, catalog):
+        # ARROW_ENCODE analog: results arrive as a readable Arrow IPC
+        # stream whose rows match the plain feature query
+        import io as _io
+
+        import pyarrow as _pa
+
+        ds, batch, _ = catalog
+        src = ds.get_feature_source("ais")
+        cql = "speed > 10"
+        q = Query("ais", cql, hints=QueryHints(arrow_encode=True))
+        r = src.get_features(q)
+        assert r.kind == "arrow" and r.arrow_bytes
+        table = _pa.ipc.open_stream(_io.BytesIO(r.arrow_bytes)).read_all()
+        exp = int((np.asarray(batch.column("speed")) > 10).sum())
+        assert table.num_rows == exp == r.count
+        # empty result still yields a valid schema-only stream
+        q0 = Query("ais", "speed > 1e9", hints=QueryHints(arrow_encode=True))
+        r0 = src.get_features(q0)
+        t0 = _pa.ipc.open_stream(_io.BytesIO(r0.arrow_bytes)).read_all()
+        assert t0.num_rows == 0
+
     def test_query_interceptors_and_guard(self, catalog):
         import pytest as _pytest
 
