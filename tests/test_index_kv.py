@@ -202,6 +202,17 @@ def test_kv_aggregation_hints(kv_source):
     q = Query("gdelt", cql, hints=QueryHints(stats_string="MinMax(score)"))
     r = src.get_features(q)
     assert r.kind == "stats"
+    # arrow (ArrowScan analog) rides the same shared aggregation
+    import io
+
+    import pyarrow as pa
+
+    q = Query("gdelt", cql, hints=QueryHints(arrow_encode=True))
+    r = src.get_features(q)
+    assert r.kind == "arrow"
+    t = pa.ipc.open_stream(io.BytesIO(r.arrow_bytes)).read_all()
+    assert t.num_rows == expected_count
+    assert "__fid__" in t.schema.names
 
 
 def test_kv_extended_geometries_xz2():
