@@ -20,6 +20,7 @@ validity mask so fixed-shape device kernels can AND it into predicate masks
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -84,6 +85,27 @@ class DictColumn:
 
 
 @dataclasses.dataclass
+class EdgeTable:
+    """Flat edge table over a GeometryColumn's CSR buffers.
+
+    The device layout the extended-geometry kernels reduce over: edges as
+    parallel (x1, y1, x2, y2) arrays with per-edge feature ids. For polygon
+    kinds, rings are closed and ORIENTED (outer shells CCW, holes CW) so
+    winding-number accumulation over the flat table is well-defined — the
+    density rasterizer (engine.raster) relies on this; parity-based
+    predicates (crossing number) are orientation-independent, so the
+    normalization is safe for every consumer.
+    """
+
+    vfeat: np.ndarray  # [V] i32 feature id per vertex
+    x1: np.ndarray
+    y1: np.ndarray
+    x2: np.ndarray
+    y2: np.ndarray
+    efeat: np.ndarray  # [E] i32 feature id per edge
+
+
+@dataclasses.dataclass
 class GeometryColumn:
     """Columnar geometry.
 
@@ -108,9 +130,86 @@ class GeometryColumn:
     feature_rings: Optional[np.ndarray] = None
     feature_parts: Optional[List[List[int]]] = None
     bbox: Optional[np.ndarray] = None
+    _edges: Optional[EdgeTable] = dataclasses.field(
+        default=None, init=False, repr=False, compare=False
+    )
 
     def __len__(self) -> int:
         return len(self.x)
+
+    @property
+    def is_polygonal(self) -> bool:
+        return "Polygon" in self.kind or self.kind in (
+            "Geometry",
+            "GeometryCollection",
+        )
+
+    def edge_table(self) -> EdgeTable:
+        """Vectorized (memoized) edge-table build — see EdgeTable.
+
+        O(V) NumPy instead of a per-feature Python loop: at the 1M-polygon
+        scale the loop version took tens of seconds per upload.
+        """
+        if self._edges is not None:
+            return self._edges
+        if self.is_point:
+            raise ValueError("point columns have no edge table")
+        vx = self.vertices[:, 0]
+        vy = self.vertices[:, 1]
+        nv = len(vx)
+        ring_len = np.diff(self.ring_offsets)
+        nring = len(ring_len)
+        ring_id = np.repeat(np.arange(nring, dtype=np.int64), ring_len)
+        feat_of_ring = np.repeat(
+            np.arange(len(self), dtype=np.int32), np.diff(self.feature_rings)
+        )
+        vfeat = (
+            feat_of_ring[ring_id] if nv else np.zeros(0, np.int32)
+        ).astype(np.int32)
+        # open edges: consecutive vertex pairs within the same ring
+        if nv > 1:
+            i0 = np.nonzero(ring_id[:-1] == ring_id[1:])[0]
+        else:
+            i0 = np.zeros(0, np.int64)
+        x1, y1 = vx[i0], vy[i0]
+        x2, y2 = vx[i0 + 1], vy[i0 + 1]
+        ering = ring_id[i0] if nv else np.zeros(0, np.int64)
+        if self.is_polygonal:
+            # closure edges for rings not already closed
+            first = self.ring_offsets[:-1]
+            last = self.ring_offsets[1:] - 1
+            ci = np.nonzero(ring_len >= 2)[0]
+            ci = ci[
+                (vx[first[ci]] != vx[last[ci]])
+                | (vy[first[ci]] != vy[last[ci]])
+            ]
+            x1 = np.concatenate([x1, vx[last[ci]]])
+            y1 = np.concatenate([y1, vy[last[ci]]])
+            x2 = np.concatenate([x2, vx[first[ci]]])
+            y2 = np.concatenate([y2, vy[first[ci]]])
+            ering = np.concatenate([ering, ci])
+            # ring orientation: shells CCW (signed area > 0), holes CW.
+            # ring r of each part with local index 0 is the shell (WKT rule).
+            area2 = np.bincount(
+                ering, weights=x1 * y2 - x2 * y1, minlength=nring
+            )
+            part_sizes = np.fromiter(
+                (p for plist in self.feature_parts for p in plist),
+                dtype=np.int64,
+            )
+            shell = np.zeros(nring, dtype=bool)
+            if len(part_sizes):
+                starts = np.concatenate([[0], np.cumsum(part_sizes)[:-1]])
+                shell[starts[starts < nring]] = True
+            flip_ring = np.where(shell, area2 < 0, area2 > 0) & (area2 != 0)
+            fm = flip_ring[ering]
+            x1, x2 = np.where(fm, x2, x1), np.where(fm, x1, x2)
+            y1, y2 = np.where(fm, y2, y1), np.where(fm, y1, y2)
+        efeat = (
+            feat_of_ring[ering] if len(ering) else np.zeros(0, np.int32)
+        ).astype(np.int32)
+        self._edges = EdgeTable(vfeat, x1, y1, x2, y2, efeat)
+        return self._edges
 
     @property
     def is_point(self) -> bool:
@@ -136,7 +235,7 @@ class GeometryColumn:
         if kinds <= {"Point"}:
             xy = np.array([g.point for g in geoms], dtype=np.float64).reshape(-1, 2)
             return cls.from_points(xy[:, 0], xy[:, 1])
-        kind = kinds.pop() if len(kinds) == 1 else "Geometry"
+        kind = _unify_kind(kinds)
         vertices, ring_offsets, feature_rings = [], [0], [0]
         parts: List[List[int]] = []
         bbox = np.empty((len(geoms), 4), dtype=np.float64)
@@ -218,6 +317,18 @@ class GeometryColumn:
         )
 
 
+def _unify_kind(kinds) -> str:
+    """Smallest kind covering a mix: LineString+MultiLineString stays a
+    line kind (NOT "Geometry", which edge_table/raster would treat as
+    polygonal and close into phantom rings)."""
+    if len(kinds) == 1:
+        return next(iter(kinds))
+    for base in ("Point", "LineString", "Polygon"):
+        if kinds <= {base, f"Multi{base}"}:
+            return f"Multi{base}"
+    return "Geometry"
+
+
 Column = Union[np.ndarray, DictColumn, GeometryColumn]
 
 
@@ -296,10 +407,28 @@ class FeatureBatch:
                         np.concatenate([col.y, np.zeros(pad)]),
                     )
                 else:
-                    geoms = [col.geometry(i) for i in range(n)] + [
-                        Geometry(col.kind, [], parts=[0]) for _ in range(pad)
-                    ]
-                    cols[name] = GeometryColumn.from_geometries(geoms)
+                    # vectorized: padded features own zero rings (same as
+                    # appending empty geometries, without the per-feature
+                    # object round-trip)
+                    cols[name] = GeometryColumn(
+                        col.kind,
+                        np.concatenate([col.x, np.full(pad, np.nan)]),
+                        np.concatenate([col.y, np.full(pad, np.nan)]),
+                        col.vertices,
+                        col.ring_offsets,
+                        np.concatenate(
+                            [
+                                col.feature_rings,
+                                np.full(
+                                    pad, col.feature_rings[-1], dtype=np.int64
+                                ),
+                            ]
+                        ),
+                        col.feature_parts + [[0]] * pad,
+                        np.concatenate(
+                            [col.bbox, np.full((pad, 4), np.nan)]
+                        ),
+                    )
         fids = (
             DictColumn(
                 np.concatenate([self.fids.codes, np.full(pad, -1, np.int32)]),
@@ -334,6 +463,32 @@ class FeatureBatch:
                 cols[name] = GeometryColumn.from_points(
                     np.concatenate([p.x for p in parts]),
                     np.concatenate([p.y for p in parts]),
+                )
+            elif all(not p.is_point for p in parts):
+                # vectorized CSR concat: shift offset arrays
+                voff = np.cumsum([0] + [len(p.vertices) for p in parts])
+                roff = np.cumsum(
+                    [0] + [len(p.ring_offsets) - 1 for p in parts]
+                )
+                cols[name] = GeometryColumn(
+                    _unify_kind({p.kind for p in parts}),
+                    np.concatenate([p.x for p in parts]),
+                    np.concatenate([p.y for p in parts]),
+                    np.concatenate([p.vertices for p in parts]),
+                    np.concatenate(
+                        [[0]]
+                        + [p.ring_offsets[1:] + v for p, v in zip(parts, voff)]
+                    ).astype(np.int64),
+                    np.concatenate(
+                        [[0]]
+                        + [p.feature_rings[1:] + r for p, r in zip(parts, roff)]
+                    ).astype(np.int64),
+                    list(
+                        itertools.chain.from_iterable(
+                            p.feature_parts for p in parts
+                        )
+                    ),
+                    np.concatenate([p.bbox for p in parts]),
                 )
             else:
                 geoms = [p.geometry(i) for p in parts for i in range(len(p))]

@@ -56,13 +56,13 @@ def to_device(
                 out[f"{attr.name}__verts"] = put(jnp.asarray(col.vertices, coord_dtype))
                 out[f"{attr.name}__rings"] = put(jnp.asarray(col.ring_offsets, jnp.int32))
                 out[f"{attr.name}__featr"] = put(jnp.asarray(col.feature_rings, jnp.int32))
-                vfeat, edges, efeat = _csr_tables(col)
-                out[f"{attr.name}__vfeat"] = put(jnp.asarray(vfeat, jnp.int32))
-                out[f"{attr.name}__ex1"] = put(jnp.asarray(edges[0], coord_dtype))
-                out[f"{attr.name}__ey1"] = put(jnp.asarray(edges[1], coord_dtype))
-                out[f"{attr.name}__ex2"] = put(jnp.asarray(edges[2], coord_dtype))
-                out[f"{attr.name}__ey2"] = put(jnp.asarray(edges[3], coord_dtype))
-                out[f"{attr.name}__efeat"] = put(jnp.asarray(efeat, jnp.int32))
+                et = col.edge_table()
+                out[f"{attr.name}__vfeat"] = put(jnp.asarray(et.vfeat, jnp.int32))
+                out[f"{attr.name}__ex1"] = put(jnp.asarray(et.x1, coord_dtype))
+                out[f"{attr.name}__ey1"] = put(jnp.asarray(et.y1, coord_dtype))
+                out[f"{attr.name}__ex2"] = put(jnp.asarray(et.x2, coord_dtype))
+                out[f"{attr.name}__ey2"] = put(jnp.asarray(et.y2, coord_dtype))
+                out[f"{attr.name}__efeat"] = put(jnp.asarray(et.efeat, jnp.int32))
         elif isinstance(col, DictColumn):
             out[attr.name] = put(jnp.asarray(col.codes, jnp.int32))
         elif col.dtype == object:
@@ -80,39 +80,6 @@ def to_device(
     return out
 
 
-def _csr_tables(col: GeometryColumn):
-    """Host-side: per-vertex feature ids and the ring edge table.
-
-    Rings are closed into edges for polygon kinds; line kinds keep open
-    paths. Edge table is (x1, y1, x2, y2) with a parallel feature-id array —
-    the layout the extended-geometry predicate kernels segment-reduce over.
-    """
-    n = len(col)
-    is_poly = "Polygon" in col.kind or col.kind in ("Geometry", "GeometryCollection")
-    vfeat = np.zeros(len(col.vertices), dtype=np.int32)
-    x1s, y1s, x2s, y2s, efeat = [], [], [], [], []
-    for i in range(n):
-        r0, r1 = int(col.feature_rings[i]), int(col.feature_rings[i + 1])
-        for r in range(r0, r1):
-            v0, v1 = int(col.ring_offsets[r]), int(col.ring_offsets[r + 1])
-            vfeat[v0:v1] = i
-            ring = col.vertices[v0:v1]
-            if len(ring) < 2:
-                continue
-            closed = is_poly and not np.array_equal(ring[0], ring[-1])
-            pts = np.concatenate([ring, ring[:1]], axis=0) if closed else ring
-            x1s.append(pts[:-1, 0])
-            y1s.append(pts[:-1, 1])
-            x2s.append(pts[1:, 0])
-            y2s.append(pts[1:, 1])
-            efeat.append(np.full(len(pts) - 1, i, dtype=np.int32))
-    if x1s:
-        edges = tuple(
-            np.concatenate(a) for a in (x1s, y1s, x2s, y2s)
-        )
-        ef = np.concatenate(efeat)
-    else:
-        z = np.zeros(0, np.float64)
-        edges = (z, z, z, z)
-        ef = np.zeros(0, np.int32)
-    return vfeat, edges, ef
+# edge tables are built by GeometryColumn.edge_table() (vectorized,
+# memoized, ring-orientation-normalized for polygon kinds) — see
+# core.columnar.EdgeTable.

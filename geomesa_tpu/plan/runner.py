@@ -25,7 +25,11 @@ if TYPE_CHECKING:
 def density_device_grid(sft: SimpleFeatureType, batch, dev, dev_mask, hints):
     """Device density grid for one batch (weight column or ones). Shared by
     the scan-path aggregate() and the planner's cached per-partition path so
-    weighting semantics cannot diverge between them."""
+    weighting semantics cannot diverge between them.
+
+    Point layers scatter per feature; extended geometries rasterize
+    (DensityScan parity, SURVEY.md:258-259): lines by exact in-cell length
+    apportioning, polygons by cell-center coverage — see engine.raster."""
     import jax.numpy as jnp
 
     from geomesa_tpu.engine.density import density_grid_auto as density_grid
@@ -36,6 +40,20 @@ def density_device_grid(sft: SimpleFeatureType, batch, dev, dev_mask, hints):
         if hints.density_weight
         else jnp.ones(len(batch), jnp.float32)
     )
+    geom_col = batch.columns[g.name]
+    if not geom_col.is_point:
+        from geomesa_tpu.engine.raster import density_grid_geometry
+
+        return density_grid_geometry(
+            geom_col,
+            dev,
+            g.name,
+            w,
+            dev_mask,
+            tuple(hints.density_bbox),
+            hints.density_width,
+            hints.density_height,
+        )
     return density_grid(
         dev[f"{g.name}__x"],
         dev[f"{g.name}__y"],

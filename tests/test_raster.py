@@ -1,0 +1,457 @@
+"""Extended-geometry density rasterization vs independent NumPy oracles.
+
+Oracles use deliberately different algorithms from the kernels:
+- lines: Amanatides-Woo cell walking per segment (vs the kernel's sorted
+  crossing-parameter formulation)
+- polygons: per-feature even-odd crossing-number test of cell centers
+  (vs the kernel's winding scatter + reversed row cumsum)
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from geomesa_tpu.core.columnar import FeatureBatch, GeometryColumn
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.core.wkt import Geometry, parse_wkt
+from geomesa_tpu.engine.device import to_device
+from geomesa_tpu.engine.raster import (
+    density_grid_geometry,
+    line_density,
+    polygon_density,
+)
+
+
+# ---------------------------------------------------------------- oracles
+
+
+def _clip_liang_barsky(x1, y1, x2, y2, bbox):
+    xmin, ymin, xmax, ymax = bbox
+    ddx, ddy = x2 - x1, y2 - y1
+    t0, t1 = 0.0, 1.0
+    for p, q in (
+        (-ddx, x1 - xmin),
+        (ddx, xmax - x1),
+        (-ddy, y1 - ymin),
+        (ddy, ymax - y1),
+    ):
+        if p == 0:
+            if q < 0:
+                return None
+        elif p < 0:
+            t0 = max(t0, q / p)
+        else:
+            t1 = min(t1, q / p)
+    if t0 > t1:
+        return None
+    return t0, t1
+
+
+def line_oracle(features, weights, bbox, width, height):
+    """Amanatides-Woo traversal, f64. `features` = list of list of
+    (M, 2) paths (one entry per feature; each path is a polyline)."""
+    xmin, ymin, xmax, ymax = bbox
+    dx = (xmax - xmin) / width
+    dy = (ymax - ymin) / height
+    grid = np.zeros((height, width))
+    for paths, w in zip(features, weights):
+        total = sum(
+            float(np.sum(np.hypot(np.diff(p[:, 0]), np.diff(p[:, 1]))))
+            for p in paths
+        )
+        if total == 0:
+            continue
+        for p in paths:
+            for (x1, y1), (x2, y2) in zip(p[:-1], p[1:]):
+                seg_len = float(np.hypot(x2 - x1, y2 - y1))
+                if seg_len == 0:
+                    continue
+                clip = _clip_liang_barsky(x1, y1, x2, y2, bbox)
+                if clip is None:
+                    continue
+                t0, t1 = clip
+                ddx, ddy = x2 - x1, y2 - y1
+                t = t0
+                # current cell from a nudged start point
+                eps = 1e-12
+                while t < t1 - eps:
+                    xm = x1 + (t + eps) * ddx
+                    ym = y1 + (t + eps) * ddy
+                    c = int(np.floor((xm - xmin) / dx))
+                    r = int(np.floor((ym - ymin) / dy))
+                    # next crossing out of this cell
+                    tnx = np.inf
+                    if ddx > 0:
+                        tnx = ((c + 1) * dx + xmin - x1) / ddx
+                    elif ddx < 0:
+                        tnx = (c * dx + xmin - x1) / ddx
+                    tny = np.inf
+                    if ddy > 0:
+                        tny = ((r + 1) * dy + ymin - y1) / ddy
+                    elif ddy < 0:
+                        tny = (r * dy + ymin - y1) / ddy
+                    tn = min(tnx, tny, t1)
+                    if tn <= t + eps:
+                        tn = t + eps * 10
+                    if 0 <= c < width and 0 <= r < height:
+                        grid[r, c] += w * (tn - t) * seg_len / total
+                    t = tn
+    return grid
+
+
+def polygon_oracle(features, weights, bbox, width, height):
+    """Even-odd cell-center coverage, f64. `features` = list of list of
+    rings per feature (holes included, any orientation)."""
+    xmin, ymin, xmax, ymax = bbox
+    dx = (xmax - xmin) / width
+    dy = (ymax - ymin) / height
+    cx = xmin + (np.arange(width) + 0.5) * dx
+    cy = ymin + (np.arange(height) + 0.5) * dy
+    grid = np.zeros((height, width))
+    for rings, w in zip(features, weights):
+        if not rings:
+            continue
+        allv = np.concatenate(rings)
+        gxmin, gymin = allv.min(0)
+        gxmax, gymax = allv.max(0)
+        c0 = max(0, int(np.floor((gxmin - xmin) / dx)))
+        c1 = min(width, int(np.ceil((gxmax - xmin) / dx)) + 1)
+        r0 = max(0, int(np.floor((gymin - ymin) / dy)))
+        r1 = min(height, int(np.ceil((gymax - ymin) / dy)) + 1)
+        if c1 <= c0 or r1 <= r0:
+            continue
+        px = cx[c0:c1][None, :, None]  # [1, C, 1]
+        py = cy[r0:r1][:, None, None]  # [R, 1, 1]
+        count = np.zeros((r1 - r0, c1 - c0), dtype=np.int64)
+        for ring in rings:
+            if len(ring) < 3:
+                continue
+            closed = (
+                ring
+                if np.array_equal(ring[0], ring[-1])
+                else np.concatenate([ring, ring[:1]])
+            )
+            x1 = closed[:-1, 0][None, None, :]
+            y1 = closed[:-1, 1][None, None, :]
+            x2 = closed[1:, 0][None, None, :]
+            y2 = closed[1:, 1][None, None, :]
+            cond = (y1 <= py) != (y2 <= py)
+            tt = (py - y1) / np.where(y2 == y1, 1.0, y2 - y1)
+            xc = x1 + tt * (x2 - x1)
+            count += np.sum(cond & (xc > px), axis=2)
+        grid[r0:r1, c0:c1] += w * (count % 2)
+    return grid
+
+
+# ------------------------------------------------------------- generators
+
+
+def random_lines(rng, n, nseg=4, extent=(-10, -10, 10, 10)):
+    xmin, ymin, xmax, ymax = extent
+    feats = []
+    for _ in range(n):
+        x = rng.uniform(xmin, xmax, nseg + 1)
+        y = rng.uniform(ymin, ymax, nseg + 1)
+        feats.append([np.stack([x, y], 1)])
+    return feats
+
+
+def random_polys(rng, n, extent=(-10, -10, 10, 10), rmax=2.0):
+    xmin, ymin, xmax, ymax = extent
+    feats = []
+    for _ in range(n):
+        cx = rng.uniform(xmin, xmax)
+        cy = rng.uniform(ymin, ymax)
+        k = rng.integers(3, 9)
+        ang = np.sort(rng.uniform(0, 2 * np.pi, k))
+        rad = rng.uniform(0.3, rmax, k)
+        ring = np.stack(
+            [cx + rad * np.cos(ang), cy + rad * np.sin(ang)], 1
+        )
+        ring = np.concatenate([ring, ring[:1]])
+        feats.append([ring])
+    return feats
+
+
+def _line_geoms(feats):
+    return [Geometry("LineString", [p for p in paths]) for paths in feats]
+
+
+def _poly_geoms(feats):
+    return [Geometry("Polygon", rings) for rings in feats]
+
+
+def _run_geometry(geoms, kind, weights, bbox, width, height, mask=None):
+    col = GeometryColumn.from_geometries(geoms, kind=kind)
+    sft = SimpleFeatureType.from_spec("t", f"*geom:{kind}")
+    batch = FeatureBatch(sft, {"geom": col})
+    dev = to_device(batch)
+    n = len(col)
+    m = (
+        jnp.asarray(mask)
+        if mask is not None
+        else jnp.ones(n, dtype=bool)
+    )
+    return np.asarray(
+        density_grid_geometry(
+            col, dev, "geom", jnp.asarray(weights, jnp.float32), m,
+            bbox, width, height,
+        )
+    )
+
+
+BBOX = (-8.0, -8.0, 8.0, 8.0)
+
+
+class TestLineDensity:
+    def test_matches_oracle(self):
+        rng = np.random.default_rng(42)
+        feats = random_lines(rng, 60)
+        w = rng.uniform(0.5, 3.0, len(feats))
+        got = _run_geometry(_line_geoms(feats), "LineString", w, BBOX, 32, 24)
+        want = line_oracle(feats, w, BBOX, 32, 24)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_total_weight_is_inside_fraction(self):
+        # one segment fully inside one cell: all weight lands there
+        seg = np.array([[0.1, 0.1], [0.4, 0.3]])
+        got = _run_geometry(
+            [Geometry("LineString", [seg])], "LineString",
+            np.array([2.0]), BBOX, 16, 16,
+        )
+        assert got.sum() == pytest.approx(2.0, rel=1e-5)
+        assert (got > 0).sum() == 1
+
+    def test_outside_portion_drops(self):
+        # half the length is outside the envelope -> half the weight
+        seg = np.array([[0.0, 0.0], [16.0, 0.0]])  # envelope ends at x=8
+        got = _run_geometry(
+            [Geometry("LineString", [seg])], "LineString",
+            np.array([1.0]), BBOX, 16, 16,
+        )
+        assert got.sum() == pytest.approx(0.5, rel=1e-5)
+
+    def test_mask_excludes_features(self):
+        rng = np.random.default_rng(3)
+        feats = random_lines(rng, 10)
+        w = np.ones(10)
+        mask = np.zeros(10, bool)
+        mask[::2] = True
+        got = _run_geometry(
+            _line_geoms(feats), "LineString", w, BBOX, 16, 16, mask=mask
+        )
+        want = line_oracle(
+            [f for f, m in zip(feats, mask) if m],
+            w[mask], BBOX, 16, 16,
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_mixed_line_kinds_stay_linear(self):
+        # concat of LineString + MultiLineString batches must unify to a
+        # line kind (not "Geometry", which would close phantom rings and
+        # dispatch to the polygon rasterizer)
+        a = GeometryColumn.from_geometries(
+            [parse_wkt("LINESTRING(0 0, 2 1)")]
+        )
+        b = GeometryColumn.from_geometries(
+            [parse_wkt("MULTILINESTRING((0 0, 1 1), (2 2, 3 3))")]
+        )
+        sft = SimpleFeatureType.from_spec("t", "*geom:MultiLineString")
+        merged = FeatureBatch.concat(
+            [FeatureBatch(sft, {"geom": a}), FeatureBatch(sft, {"geom": b})]
+        )
+        assert merged.columns["geom"].kind == "MultiLineString"
+        feats = [
+            [np.array([[0.0, 0.0], [2.0, 1.0]])],
+            [
+                np.array([[0.0, 0.0], [1.0, 1.0]]),
+                np.array([[2.0, 2.0], [3.0, 3.0]]),
+            ],
+        ]
+        dev = to_device(merged)
+        got = np.asarray(
+            density_grid_geometry(
+                merged.columns["geom"], dev, "geom",
+                jnp.ones(2, jnp.float32), jnp.ones(2, dtype=bool),
+                BBOX, 16, 16,
+            )
+        )
+        want = line_oracle(feats, [1.0, 1.0], BBOX, 16, 16)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_multilinestring(self):
+        g = parse_wkt(
+            "MULTILINESTRING((0 0, 2 0.5, 3 2), (-4 -4, -2 -3.5))"
+        )
+        paths = [r for r in g.rings]
+        got = _run_geometry([g], "MultiLineString", np.array([1.5]), BBOX, 20, 20)
+        want = line_oracle([paths], [1.5], BBOX, 20, 20)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+class TestPolygonDensity:
+    def test_matches_oracle(self):
+        rng = np.random.default_rng(7)
+        feats = random_polys(rng, 80)
+        w = rng.uniform(0.5, 3.0, len(feats))
+        got = _run_geometry(_poly_geoms(feats), "Polygon", w, BBOX, 40, 32)
+        want = polygon_oracle(feats, w, BBOX, 40, 32)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_hole_excluded(self):
+        outer = np.array(
+            [[-4.0, -4.0], [4.0, -4.0], [4.0, 4.0], [-4.0, 4.0], [-4.0, -4.0]]
+        )
+        hole = np.array(
+            [[-1.0, -1.0], [1.0, -1.0], [1.0, 1.0], [-1.0, 1.0], [-1.0, -1.0]]
+        )
+        g = Geometry("Polygon", [outer, hole])
+        got = _run_geometry([g], "Polygon", np.array([1.0]), BBOX, 32, 32)
+        want = polygon_oracle([[outer, hole]], [1.0], BBOX, 32, 32)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        # center cell is inside the hole -> zero
+        assert got[16, 16] == 0.0
+        # cell (10, 10) center = (-2.75, -2.75): inside shell, outside hole
+        assert got[10, 10] == 1.0
+
+    def test_orientation_invariance(self):
+        # same polygon, shell given CW and CCW: identical grids (the edge
+        # table normalizes orientation)
+        ring = np.array(
+            [[-3.0, -3.0], [3.0, -3.0], [3.0, 3.0], [-3.0, 3.0], [-3.0, -3.0]]
+        )
+        g_ccw = Geometry("Polygon", [ring])
+        g_cw = Geometry("Polygon", [ring[::-1].copy()])
+        a = _run_geometry([g_ccw], "Polygon", np.array([1.0]), BBOX, 16, 16)
+        b = _run_geometry([g_cw], "Polygon", np.array([1.0]), BBOX, 16, 16)
+        np.testing.assert_array_equal(a, b)
+
+    def test_multipolygon_with_weight(self):
+        g = parse_wkt(
+            "MULTIPOLYGON(((0 0, 3 0, 3 3, 0 3, 0 0)),"
+            "((-5 -5, -4 -5, -4 -4, -5 -4, -5 -5)))"
+        )
+        rings = [r for r in g.rings]
+        got = _run_geometry([g], "MultiPolygon", np.array([2.5]), BBOX, 32, 32)
+        want = polygon_oracle([rings], [2.5], BBOX, 32, 32)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_mask_and_padding(self):
+        rng = np.random.default_rng(11)
+        feats = random_polys(rng, 9)
+        w = rng.uniform(1, 2, 9)
+        mask = np.array([True, False] * 4 + [True])
+        col = GeometryColumn.from_geometries(_poly_geoms(feats), kind="Polygon")
+        sft = SimpleFeatureType.from_spec("t", "*geom:Polygon")
+        batch = FeatureBatch(
+            sft, {"geom": col}
+        ).pad_to(16)  # padded rows must contribute nothing
+        dev = to_device(batch)
+        m = jnp.asarray(np.concatenate([mask, np.zeros(7, bool)]))
+        wp = jnp.asarray(
+            np.concatenate([w, np.zeros(7)]), jnp.float32
+        )
+        got = np.asarray(
+            density_grid_geometry(
+                batch.columns["geom"], dev, "geom", wp, m, BBOX, 24, 24
+            )
+        )
+        want = polygon_oracle(
+            [f for f, mm in zip(feats, mask) if mm], w[mask], BBOX, 24, 24
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestMultiPointDensity:
+    def test_each_vertex_counts(self):
+        g = parse_wkt("MULTIPOINT((0.1 0.1), (0.15 0.12), (5 5))")
+        got = _run_geometry([g], "MultiPoint", np.array([1.0]), BBOX, 16, 16)
+        assert got.sum() == pytest.approx(3.0)
+
+
+class TestEndToEndPolygonLayer:
+    """XZ2-partitioned polygon store -> planner -> device rasterization."""
+
+    def test_density_query(self, tmp_path):
+        from geomesa_tpu.plan import DataStore, Query, QueryHints
+        from geomesa_tpu.store.partition import XZ2Scheme
+
+        rng = np.random.default_rng(5)
+        feats = random_polys(rng, 200, extent=(-60, -30, 60, 30), rmax=3.0)
+        geoms = _poly_geoms(feats)
+        sft = SimpleFeatureType.from_spec(
+            "polys", "name:String,score:Double,*geom:Polygon"
+        )
+        batch = FeatureBatch.from_pydict(
+            sft,
+            {
+                "name": [f"p{i}" for i in range(len(geoms))],
+                "score": rng.uniform(0, 10, len(geoms)),
+                "geom": geoms,
+            },
+        )
+        ds = DataStore(str(tmp_path))
+        src = ds.create_schema(sft, XZ2Scheme(g=2))
+        src.write(batch)
+
+        bbox = (-30.0, -20.0, 30.0, 20.0)
+        res = src.get_features(
+            Query(
+                "polys",
+                f"BBOX(geom, {bbox[0]}, {bbox[1]}, {bbox[2]}, {bbox[3]})",
+                hints=QueryHints(
+                    density_bbox=bbox, density_width=48, density_height=32
+                ),
+            )
+        )
+        # oracle: features whose bbox intersects the query bbox (loose
+        # BBOX() semantics on extended geometries = envelope intersects),
+        # rasterized by cell-center coverage
+        keep = [
+            i
+            for i, g in enumerate(geoms)
+            if not (
+                g.bbox[2] < bbox[0]
+                or g.bbox[0] > bbox[2]
+                or g.bbox[3] < bbox[1]
+                or g.bbox[1] > bbox[3]
+            )
+        ]
+        want = polygon_oracle(
+            [feats[i] for i in keep], np.ones(len(keep)), bbox, 48, 32
+        )
+        np.testing.assert_allclose(res.grid, want, rtol=1e-5, atol=1e-5)
+
+    def test_weighted_line_layer(self, tmp_path):
+        from geomesa_tpu.plan import DataStore, Query, QueryHints
+        from geomesa_tpu.store.partition import XZ2Scheme
+
+        rng = np.random.default_rng(6)
+        feats = random_lines(rng, 50, extent=(-5, -5, 5, 5))
+        geoms = _line_geoms(feats)
+        sft = SimpleFeatureType.from_spec(
+            "tracks", "w:Double,*geom:LineString"
+        )
+        w = rng.uniform(1, 4, len(geoms))
+        batch = FeatureBatch.from_pydict(
+            sft, {"w": w, "geom": geoms}
+        )
+        ds = DataStore(str(tmp_path))
+        src = ds.create_schema(sft, XZ2Scheme(g=2))
+        src.write(batch)
+        bbox = (-6.0, -6.0, 6.0, 6.0)
+        res = src.get_features(
+            Query(
+                "tracks",
+                "INCLUDE",
+                hints=QueryHints(
+                    density_bbox=bbox,
+                    density_width=24,
+                    density_height=24,
+                    density_weight="w",
+                ),
+            )
+        )
+        want = line_oracle(feats, w, bbox, 24, 24)
+        np.testing.assert_allclose(res.grid, want, rtol=2e-4, atol=2e-4)
