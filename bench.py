@@ -422,7 +422,7 @@ def main(argv=None) -> int:
         mask, count = mask_count(x, y, t, speed)
         c = int(np.asarray(count))  # host round trip: capacity bucket
         cap = max(next_pow2(max(c, 1)), 1024)
-        dists, idx = knn_compact(qx, qy, x, y, mask, k=k, capacity=cap)
+        dists, idx, _overflow = knn_compact(qx, qy, x, y, mask, k=k, capacity=cap)
         return count, dists
 
     def grid_step(x, y, t, speed, qx, qy):

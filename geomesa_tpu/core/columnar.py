@@ -130,6 +130,13 @@ class GeometryColumn:
     feature_rings: Optional[np.ndarray] = None
     feature_parts: Optional[List[List[int]]] = None
     bbox: Optional[np.ndarray] = None
+    # per-feature base-kind codes (0=point, 1=line, 2=polygon), populated
+    # only for mixed "Geometry"/"GeometryCollection" columns where the
+    # column kind cannot speak for each feature — kernels that dispatch on
+    # geometry kind (density rasterization) split on these instead of
+    # treating every feature as polygonal (which cancels line/point
+    # contributions to zero via edge-closure winding)
+    feature_kinds: Optional[np.ndarray] = None
     _edges: Optional[EdgeTable] = dataclasses.field(
         default=None, init=False, repr=False, compare=False
     )
@@ -257,6 +264,11 @@ class GeometryColumn:
             if vertices
             else np.zeros((0, 2), dtype=np.float64)
         )
+        fkinds = (
+            np.array([_kind_code(g.kind) for g in geoms], dtype=np.int8)
+            if kind in ("Geometry", "GeometryCollection")
+            else None
+        )
         return cls(
             kind,
             xs,
@@ -266,6 +278,7 @@ class GeometryColumn:
             np.asarray(feature_rings, dtype=np.int64),
             parts,
             bbox,
+            fkinds,
         )
 
     def geometry(self, i: int) -> Geometry:
@@ -279,7 +292,18 @@ class GeometryColumn:
             self.vertices[self.ring_offsets[r] : self.ring_offsets[r + 1]]
             for r in range(r0, r1)
         ]
-        return Geometry(self.kind, rings, list(self.feature_parts[i]))
+        kind = self.kind
+        if self.feature_kinds is not None:
+            # mixed column: recover the feature's exact kind (Multi-ness
+            # included) so density dispatch and WKT/schema round-trips
+            # never change a feature's declared type
+            code = int(self.feature_kinds[i])
+            if code == 6:
+                kind = "GeometryCollection"
+            else:
+                base = ("Point", "LineString", "Polygon")[code % 3]
+                kind = base if code < 3 else f"Multi{base}"
+        return Geometry(kind, rings, list(self.feature_parts[i]))
 
     def take(self, idx) -> "GeometryColumn":
         idx = np.asarray(idx)
@@ -314,6 +338,7 @@ class GeometryColumn:
             new_feature_rings.astype(np.int64),
             [self.feature_parts[int(i)] for i in idx],
             self.bbox[idx],
+            self.feature_kinds[idx] if self.feature_kinds is not None else None,
         )
 
 
@@ -327,6 +352,23 @@ def _unify_kind(kinds) -> str:
         if kinds <= {base, f"Multi{base}"}:
             return f"Multi{base}"
     return "Geometry"
+
+
+_KIND_CODES = {
+    "Point": 0,
+    "LineString": 1,
+    "Polygon": 2,
+    "MultiPoint": 3,
+    "MultiLineString": 4,
+    "MultiPolygon": 5,
+}
+
+
+def _kind_code(kind: str) -> int:
+    """feature_kinds codes: 0-2 base kinds, 3-5 their Multi variants
+    (code % 3 recovers the base for kernel dispatch), 6 =
+    GeometryCollection (heterogeneous parts — no single base kind)."""
+    return _KIND_CODES.get(kind, 6)
 
 
 Column = Union[np.ndarray, DictColumn, GeometryColumn]
@@ -428,6 +470,13 @@ class FeatureBatch:
                         np.concatenate(
                             [col.bbox, np.full((pad, 4), np.nan)]
                         ),
+                        (
+                            np.concatenate(
+                                [col.feature_kinds, np.full(pad, 2, np.int8)]
+                            )
+                            if col.feature_kinds is not None
+                            else None
+                        ),
                     )
         fids = (
             DictColumn(
@@ -470,8 +519,33 @@ class FeatureBatch:
                 roff = np.cumsum(
                     [0] + [len(p.ring_offsets) - 1 for p in parts]
                 )
+                ukind = _unify_kind({p.kind for p in parts})
+                fkinds = None
+                if ukind in ("Geometry", "GeometryCollection"):
+                    # preserve per-feature kinds across the merge; a part
+                    # with a concrete kind contributes uniform codes. A
+                    # mixed-kind part LACKING feature_kinds (pre-round-2
+                    # cached column) cannot be coded per feature — stamping
+                    # code 6 would relabel its features as collections —
+                    # so the merged column degrades to None (the
+                    # representative-point density fallback) instead
+                    if all(
+                        p.feature_kinds is not None
+                        or _kind_code(p.kind) != 6
+                        for p in parts
+                    ):
+                        fkinds = np.concatenate(
+                            [
+                                p.feature_kinds
+                                if p.feature_kinds is not None
+                                else np.full(
+                                    len(p), _kind_code(p.kind), np.int8
+                                )
+                                for p in parts
+                            ]
+                        )
                 cols[name] = GeometryColumn(
-                    _unify_kind({p.kind for p in parts}),
+                    ukind,
                     np.concatenate([p.x for p in parts]),
                     np.concatenate([p.y for p in parts]),
                     np.concatenate([p.vertices for p in parts]),
@@ -489,6 +563,7 @@ class FeatureBatch:
                         )
                     ),
                     np.concatenate([p.bbox for p in parts]),
+                    fkinds,
                 )
             else:
                 geoms = [p.geometry(i) for p in parts for i in range(len(p))]

@@ -147,12 +147,17 @@ _MXU_MAX_EDGE = 4096
 _MXU_MIN_POINTS = 1 << 17
 
 
-def density_grid_auto(x, y, weights, mask, bbox, width, height) -> jax.Array:
+def density_grid_auto(
+    x, y, weights, mask, bbox, width, height, exact_weights: bool = False
+) -> jax.Array:
     """Backend dispatch: the matmul formulation on TPU at scale, the
     scatter path elsewhere (CPU scatter is fine, and small batches don't
-    amortize the one-hot construction)."""
+    amortize the one-hot construction). `exact_weights` pins the f32
+    scatter path (the MXU bf16 hi/lo split carries ~2^-16 relative weight
+    error); surfaced as the `density_exact_weights` query hint."""
     if (
-        jax.default_backend() == "tpu"
+        not exact_weights
+        and jax.default_backend() == "tpu"
         and x.shape[0] >= _MXU_MIN_POINTS
         and max(width, height) <= _MXU_MAX_EDGE
     ):

@@ -427,13 +427,24 @@ def knn_compact(
     to the next power of two to stabilize jit cache keys); validity of each
     compacted slot is derived on device from a sentinel, so no count needs
     to cross from the host. Returned indices refer to the ORIGINAL arrays.
+
+    Returns (dists [Q,k], indices [Q,k], overflow scalar bool): `overflow`
+    is True iff the match count exceeded `capacity`, in which case the
+    result silently dropped the lowest-index matches — callers MUST check
+    it and fall back to the full-scan kernel (the round-1 advisor flagged
+    the unchecked contract).
     """
     # top_k-based stream compaction: jnp.nonzero(size=...) lowers ~26x
     # slower on TPU (measured 6.3s vs 0.26s at 67M); top_k over
     # where(mask, iota, -1) yields the matched indices (descending order —
     # irrelevant for kNN) at sort-free selection cost
     n = dx.shape[0]
+    if n >= (1 << 31):
+        # the int32 index iota below wraps past 2^31 rows; callers shard /
+        # tile batches far below this (trace-time check, n is static)
+        raise ValueError("knn_compact supports n < 2^31 rows per batch")
     capacity = min(capacity, n)  # lax.top_k requires k <= lane count
+    overflow = jnp.sum(mask, dtype=jnp.int32) > capacity
     picked = jax.lax.top_k(
         jnp.where(mask, jnp.arange(n, dtype=jnp.int32), -1), capacity
     )[0]
@@ -445,7 +456,7 @@ def knn_compact(
         fd, fi = knn_mxu(qx, qy, cx, cy, valid, k=k, query_tile=query_tile)
     else:
         fd, fi = knn(qx, qy, cx, cy, valid, k=k)
-    return fd, jnp.take(idx, fi)
+    return fd, jnp.take(idx, fi), overflow
 
 
 def knn_sharded(
@@ -458,6 +469,7 @@ def knn_sharded(
     k: int,
     query_tile: int = 1024,
     impl: str = "haversine",
+    debug_check: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN with data sharded over the mesh: local top-k + all_gather
     merge. Returns (dists [Q,k], global indices [Q,k]).
@@ -472,6 +484,13 @@ def knn_sharded(
     the local top-k inherits knn_mxu's f32 noise model, so cluster-boundary
     query tiles can mis-rank meters-scale near-ties; use the KNN process or
     impl="haversine" where guaranteed exactness is required).
+
+    debug_check: the out_specs below declare the post-gather re-top-k
+    replicated (check_vma=False silences JAX's varying-mesh-axes check,
+    which cannot infer it). With debug_check=True the kernel additionally
+    all_gathers the FINAL result and asserts on host that every device
+    computed bitwise-identical values — pinning the unchecked invariant
+    (round-1 review) at the cost of one extra [D, Q, k] gather.
     """
     if impl == "mxu":
         def local(*a, **kw):
@@ -486,9 +505,10 @@ def knn_sharded(
         jax.shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
-        out_specs=(P(), P()),
+        out_specs=(P(), P(), P()) if debug_check else (P(), P()),
         # post-gather re-top-k computes identical values on every device;
         # JAX's varying-mesh-axes check can't infer that, so assert it
+        # (debug_check=True verifies the claim at run time)
         check_vma=False,
     )
     def run(qx, qy, dx, dy, mask):
@@ -501,8 +521,29 @@ def knn_sharded(
         pool_d = jnp.moveaxis(all_d, 0, 1).reshape(dists.shape[0], -1)
         pool_i = jnp.moveaxis(all_i, 0, 1).reshape(dists.shape[0], -1)
         md, mi = _topk_smallest(pool_d, k)
-        return md, jnp.take_along_axis(pool_i, mi, axis=1)
+        gi = jnp.take_along_axis(pool_i, mi, axis=1)
+        if debug_check:
+            # gather every device's final answer and count positions that
+            # differ from device 0's — must be 0 when the replication
+            # claim holds. Equality (not subtraction): results are
+            # +inf-padded when valid matches < k, and inf - inf = NaN
+            # would flag agreement as divergence
+            gd = jax.lax.all_gather(md, SHARD_AXIS)
+            gg = jax.lax.all_gather(gi, SHARD_AXIS)
+            div = jnp.sum((gd != gd[0:1]).astype(jnp.int32)) + jnp.sum(
+                (gg != gg[0:1]).astype(jnp.int32)
+            )
+            return md, gi, div
+        return md, gi
 
+    if debug_check:
+        md, gi, div = run(qx, qy, dx, dy, mask)
+        if float(div) != 0.0:
+            raise AssertionError(
+                "knn_sharded replication invariant violated: devices "
+                f"disagree on the merged top-k (divergence {float(div)})"
+            )
+        return md, gi
     return run(qx, qy, dx, dy, mask)
 
 

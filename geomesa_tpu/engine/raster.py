@@ -329,8 +329,16 @@ def density_grid_geometry(
     device batch carrying the matching CSR/edge arrays, `weights`/`mask`
     per-FEATURE device arrays. Static k budgets are rounded to pow2 so jit
     caches stay warm across small data changes.
+
+    Mixed "Geometry" columns split per base kind (feature_kinds codes) and
+    sum the three sub-grids — running everything through the polygon kernel
+    would cancel line/point winding contributions to zero.
     """
     kind = geom_col.kind
+    if kind in ("Geometry", "GeometryCollection"):
+        return _density_mixed(
+            geom_col, name, weights, mask, bbox, width, height
+        )
     efeat = dev[f"{name}__efeat"]
     ex1, ey1 = dev[f"{name}__ex1"], dev[f"{name}__ey1"]
     ex2, ey2 = dev[f"{name}__ex2"], dev[f"{name}__ey2"]
@@ -375,3 +383,75 @@ def density_grid_geometry(
         ex1, ey1, ex2, ey2, weights[efeat], mask[efeat],
         bbox, width, height, k, seg_tile=_seg_tile(k),
     )
+
+
+def _density_mixed(
+    geom_col, name: str, weights, mask, bbox: BBox, width: int, height: int
+):
+    """Mixed-kind density: split the host column per base kind (codes
+    0-5 -> code % 3), upload each subset's CSR/edge arrays ad hoc, and sum
+    the sub-grids. GeometryCollection features (code 6) have no single
+    base kind and degrade to representative-point binning — a documented
+    approximation, never a silent zero. Mixed layers are rare and small
+    relative to the bench paths, so the per-subset host round trip is
+    acceptable; homogeneous columns never come through here.
+    """
+    import dataclasses
+
+    codes = geom_col.feature_kinds
+    from geomesa_tpu.engine.density import density_grid
+
+    if codes is None:
+        # no per-feature info (e.g. a column built before round 2 and
+        # deserialized from a cache): every feature degrades to its
+        # representative point rather than silently cancelling to zero
+        return density_grid(
+            jnp.asarray(geom_col.x, jnp.float32),
+            jnp.asarray(geom_col.y, jnp.float32),
+            weights,
+            mask,
+            bbox,
+            width,
+            height,
+        )
+    grid = jnp.zeros((height, width), jnp.float32)
+    coll = np.nonzero(codes == 6)[0]
+    if len(coll):
+        jc = jnp.asarray(coll)
+        grid = grid + density_grid(
+            jnp.asarray(geom_col.x[coll], jnp.float32),
+            jnp.asarray(geom_col.y[coll], jnp.float32),
+            jnp.take(weights, jc),
+            jnp.take(mask, jc),
+            bbox,
+            width,
+            height,
+        )
+    base = codes % 3
+    for code, sub_kind in ((0, "MultiPoint"), (1, "MultiLineString"), (2, "MultiPolygon")):
+        idx = np.nonzero((base == code) & (codes != 6))[0]
+        if not len(idx):
+            continue
+        sub = dataclasses.replace(geom_col.take(idx), kind=sub_kind, feature_kinds=None)
+        et = sub.edge_table()
+        sub_dev = {
+            f"{name}__efeat": jnp.asarray(et.efeat, jnp.int32),
+            f"{name}__ex1": jnp.asarray(et.x1, jnp.float32),
+            f"{name}__ey1": jnp.asarray(et.y1, jnp.float32),
+            f"{name}__ex2": jnp.asarray(et.x2, jnp.float32),
+            f"{name}__ey2": jnp.asarray(et.y2, jnp.float32),
+            f"{name}__vfeat": jnp.asarray(et.vfeat, jnp.int32),
+            f"{name}__verts": jnp.asarray(sub.vertices, jnp.float32),
+        }
+        jidx = jnp.asarray(idx)
+        grid = grid + density_grid_geometry(
+            sub,
+            sub_dev,
+            name,
+            jnp.take(weights, jidx),
+            jnp.take(mask, jidx),
+            bbox,
+            width,
+            height,
+        )
+    return grid

@@ -247,8 +247,9 @@ class KVFeatureSource:
 
         if isinstance(query, str):
             query = Query(self.sft.name, query)
-        # the shortcut must see the post-interceptor query (idempotent
-        # chain: get_features -> plan re-applies it)
+        # the shortcut must see the post-interceptor query; the intercepted
+        # marker makes the nested get_features -> plan pass a no-op, so the
+        # chain applies exactly once (no idempotence requirement)
         query = run_interceptors(query, self.interceptors)
         if not query.hints.exact_count and isinstance(query.filter_ast, ast.Include):
             return self.live_count

@@ -19,6 +19,12 @@ class QueryHints:
     density_width: Optional[int] = None
     density_height: Optional[int] = None
     density_weight: Optional[str] = None  # numeric attribute name
+    # force the f32 scatter path for weighted density: the MXU one-hot
+    # formulation carries ~2^-16 relative weight error from its bf16 hi/lo
+    # split, and auto-dispatch would otherwise pick it on TPU at >=2^17
+    # points (round-1 advisor finding: fidelity needs an opt-out that does
+    # not bypass the DataStore API)
+    density_exact_weights: bool = False
 
     # bin aggregation (BinAggregatingScan): compact dot-map records
     bin_track: Optional[str] = None  # attribute used as track id

@@ -354,9 +354,9 @@ class QueryPlanner:
         from geomesa_tpu.plan.interceptor import run_interceptors
 
         # the estimate shortcut must see the POST-interceptor query, or a
-        # rewrite/guard configured on the type is bypassed for counts.
-        # Interceptors are documented idempotent, so the second application
-        # inside execute() -> plan() is safe.
+        # rewrite/guard configured on the type is bypassed for counts; the
+        # intercepted marker makes the nested execute() -> plan() pass a
+        # no-op, so non-idempotent interceptors apply exactly once
         query = run_interceptors(query, self.interceptors)
         if (
             not query.hints.exact_count

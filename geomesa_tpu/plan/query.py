@@ -21,6 +21,10 @@ class Query:
     sort_by: Optional[Sequence[Tuple[str, bool]]] = None  # (attr, ascending)
     max_features: Optional[int] = None
     hints: QueryHints = dataclasses.field(default_factory=QueryHints)
+    # set by run_interceptors on its output so re-entrant paths (count ->
+    # execute -> plan) apply the chain exactly once; upstream's
+    # QueryInterceptor SPI does not promise idempotence
+    intercepted: bool = dataclasses.field(default=False, compare=False)
 
     @property
     def filter_ast(self) -> ast.Filter:

@@ -10,6 +10,7 @@ architecture (C11 lesson).
 
 from __future__ import annotations
 
+import itertools
 from typing import TYPE_CHECKING
 
 import numpy as np
@@ -62,19 +63,31 @@ def density_device_grid(sft: SimpleFeatureType, batch, dev, dev_mask, hints):
         tuple(hints.density_bbox),
         hints.density_width,
         hints.density_height,
+        exact_weights=hints.density_exact_weights,
     )
 
 
+_FID_BATCH_SEQ = itertools.count()
+
+
 def apply_fid_policy(batch: FeatureBatch, include_fid: bool) -> FeatureBatch:
-    """Deterministic __fid__ presence for wire formats: synthesize
-    row-index fids when requested but absent (the store may not have
-    persisted any), strip them when not — so a result's schema never
-    depends on the data that happened to match."""
+    """Deterministic __fid__ presence for wire formats: synthesize fids
+    when requested but absent (the store may not have persisted any), strip
+    them when not — so a result's schema never depends on the data that
+    happened to match. Synthesized fids carry a process-unique batch
+    discriminator (`b<seq>.<row>`) because results from different shards /
+    partitions merge client-side at the IPC level and bare row indices
+    would collide there (round-1 advisor finding; upstream ArrowScan fids
+    are real feature ids usable for dedup)."""
     import dataclasses
 
     if include_fid and batch.fids is None:
+        tag = f"b{next(_FID_BATCH_SEQ)}"
         return dataclasses.replace(
-            batch, fids=DictColumn.encode([str(i) for i in range(len(batch))])
+            batch,
+            fids=DictColumn.encode(
+                [f"{tag}.{i}" for i in range(len(batch))]
+            ),
         )
     if not include_fid and batch.fids is not None:
         return dataclasses.replace(batch, fids=None)

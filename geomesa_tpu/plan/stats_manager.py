@@ -93,10 +93,11 @@ class StatsManager:
                 if key_minmax in stats and not isinstance(col, (DictColumn, GeometryColumn)):
                     stats[key_minmax].observe(np.asarray(col))
                 elif key_topk in stats and isinstance(col, DictColumn):
-                    vals = np.asarray(
-                        [v for v in col.decode() if v is not None], dtype=object
-                    )
-                    stats[key_topk].observe(vals)
+                    # dict-coded: bincount the int32 codes and feed
+                    # (vocab, counts) — never materialize row strings
+                    valid = col.codes[col.codes >= 0]
+                    counts = np.bincount(valid, minlength=len(col.vocab))
+                    stats[key_topk].observe_counts(col.vocab, counts)
             if "z3" in stats:
                 gc = batch.columns[g.name]
                 bins, _ = to_binned_time(np.asarray(batch.columns[d.name]), TimePeriod.WEEK)
@@ -104,11 +105,16 @@ class StatsManager:
                 b16 = z3.bins_per_dim
                 cx = np.clip(((np.asarray(gc.x) + 180.0) / 360.0 * b16).astype(int), 0, b16 - 1)
                 cy = np.clip(((np.asarray(gc.y) + 90.0) / 180.0 * b16).astype(int), 0, b16 - 1)
-                for b in np.unique(bins):
-                    sel = bins == b
-                    grid = np.zeros((b16, b16), np.int64)
-                    np.add.at(grid, (cy[sel], cx[sel]), 1)
-                    z3.observe_grid(int(b), grid)
+                # one bincount over (time-bin, cell) composite keys instead
+                # of a per-bin np.add.at pass (ufunc.at is unbuffered and
+                # ~100x slower at bench scale)
+                ubins, binv = np.unique(bins, return_inverse=True)
+                cells = b16 * b16
+                flat = np.bincount(
+                    binv * cells + cy * b16 + cx, minlength=len(ubins) * cells
+                ).reshape(len(ubins), b16, b16)
+                for i, b in enumerate(ubins):
+                    z3.observe_grid(int(b), flat[i])
 
         self.stats = stats
         self._save()

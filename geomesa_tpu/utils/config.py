@@ -78,6 +78,14 @@ class SystemProperties:
         "geomesa.profile.dir", "", str,
         "emit a jax profiler trace per query execution into this directory",
     )
+    LOAD_INTERCEPTORS = SystemProperty(
+        "geomesa.query.interceptors.load", False,
+        lambda s: s.lower() in ("1", "true"),
+        "allow dotted-path interceptor classes from SFT user_data to be "
+        "imported and instantiated (schema metadata round-trips through "
+        "converter configs and store manifests, so arbitrary-import is "
+        "opt-in; the built-in 'full-table-scan-guard' always loads)",
+    )
 
     _all = None
 

@@ -120,11 +120,12 @@ class TestKNN:
         )
         oracle = np.sort(d, axis=1)
         cap = 1 << int(mask.sum() - 1).bit_length()
-        dists, idx = knn_compact(
+        dists, idx, overflow = knn_compact(
             jnp.asarray(mqx), jnp.asarray(mqy),
             jnp.asarray(self.dx), jnp.asarray(self.dy),
             jnp.asarray(mask), k=self.k, capacity=cap,
         )
+        assert not bool(overflow)
         idx = np.asarray(idx)
         assert mask[idx].all(), "index into a masked-out row"
         true_d = haversine_m_np(
@@ -143,14 +144,29 @@ class TestKNN:
         from geomesa_tpu.engine.knn import knn_compact
 
         mqx, mqy, oracle = self._mxu_queries()
-        dists, _ = knn_compact(
+        dists, _, overflow = knn_compact(
             jnp.asarray(mqx), jnp.asarray(mqy),
             jnp.asarray(self.dx), jnp.asarray(self.dy),
             jnp.asarray(self.mask), k=self.k, capacity=4 * self.n,
         )
+        assert not bool(overflow)
         np.testing.assert_allclose(
             np.sort(np.asarray(dists), 1), oracle[:, : self.k], atol=1.0
         )
+
+    def test_compact_overflow_flag(self):
+        # capacity below the true match count must raise the overflow flag
+        # (the silent-wrong-results contract the round-1 advisor flagged)
+        from geomesa_tpu.engine.knn import knn_compact
+
+        mqx, mqy, _ = self._mxu_queries()
+        cap = int(self.mask.sum()) // 2
+        _, _, overflow = knn_compact(
+            jnp.asarray(mqx), jnp.asarray(mqy),
+            jnp.asarray(self.dx), jnp.asarray(self.dy),
+            jnp.asarray(self.mask), k=self.k, capacity=cap,
+        )
+        assert bool(overflow)
 
     def test_mxu_clustered_near_ties(self):
         # dense cluster: many near-equal distances stress the f32 margin
@@ -329,6 +345,34 @@ class TestKNN:
         d1, i1 = knn(*args, k=self.k)
         d2, i2 = knn_sharded(mesh, *args, k=self.k)
         np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5)
+
+    def test_sharded_debug_check_replication(self):
+        # debug mode verifies the check_vma=False replication claim on
+        # device: every device must hold bitwise-identical merged top-ks
+        mesh = default_mesh()
+        args = (
+            jnp.asarray(self.qx), jnp.asarray(self.qy),
+            jnp.asarray(self.dx[:4096]), jnp.asarray(self.dy[:4096]),
+            jnp.asarray(self.mask[:4096]),
+        )
+        d1, _ = knn(*args, k=self.k)
+        d2, _ = knn_sharded(mesh, *args, k=self.k, debug_check=True)
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5)
+
+    def test_sharded_debug_check_inf_padding(self):
+        # fewer valid matches than k: results are +inf-padded; the debug
+        # equality check must not read inf agreement as divergence
+        # (inf - inf = NaN regression from the round-2 review)
+        mesh = default_mesh()
+        mask = np.zeros(4096, bool)
+        mask[:3] = True
+        args = (
+            jnp.asarray(self.qx), jnp.asarray(self.qy),
+            jnp.asarray(self.dx[:4096]), jnp.asarray(self.dy[:4096]),
+            jnp.asarray(mask),
+        )
+        d2, _ = knn_sharded(mesh, *args, k=self.k, debug_check=True)
+        assert np.isinf(np.asarray(d2)[:, 3:]).all()
 
     def test_ring_matches_single(self):
         mesh = default_mesh()

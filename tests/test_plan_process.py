@@ -178,6 +178,50 @@ class TestDataStore:
         finally:
             planner.interceptors.clear()
 
+        # NON-idempotent interceptors apply exactly once, even on the
+        # count -> execute -> plan re-entrant path (round-1 advisor: the
+        # upstream SPI makes no idempotence promise)
+        calls = []
+
+        def counting_clamp(q):
+            calls.append(1)
+            return clamp(q)
+
+        planner.interceptors.append(counting_clamp)
+        try:
+            got = src.get_count("speed >= 0")
+            exp = int((np.asarray(batch.column("speed")) > 10).sum())
+            assert got == exp
+            assert len(calls) == 1, "interceptor chain ran more than once"
+        finally:
+            planner.interceptors.clear()
+
+    def test_interceptor_loading_gated(self, catalog):
+        # dotted-path interceptors from SFT user_data execute arbitrary
+        # importable callables -> load only under the opt-in property; the
+        # built-in guard name always loads
+        from geomesa_tpu.plan.interceptor import (
+            FullTableScanGuard, load_interceptors)
+        from geomesa_tpu.utils.config import SystemProperties
+
+        ds, batch, _ = catalog
+        sft = ds.get_feature_source("ais").planner.storage.sft
+        ud = dict(sft.user_data or {})
+        ud["geomesa.query.interceptors"] = (
+            "full-table-scan-guard, os.getcwd"
+        )
+        import dataclasses as _dc
+
+        sft2 = _dc.replace(sft, user_data=ud)
+        loaded = load_interceptors(sft2)
+        assert len(loaded) == 1 and isinstance(loaded[0], FullTableScanGuard)
+        SystemProperties.set("geomesa.query.interceptors.load", True)
+        try:
+            loaded = load_interceptors(sft2)
+            assert len(loaded) == 2
+        finally:
+            SystemProperties.clear("geomesa.query.interceptors.load")
+
     def test_count_honors_max_features(self, catalog):
         # GeoTools getCount semantics: the query limit caps the count (the
         # count_only device fast path must match the features path)

@@ -370,6 +370,96 @@ class TestMultiPointDensity:
         assert got.sum() == pytest.approx(3.0)
 
 
+class TestMixedGeometryDensity:
+    def test_mixed_kinds_split_not_cancelled(self):
+        # a mixed "Geometry" column must rasterize each feature by its own
+        # base kind — running lines/points through the polygon winding
+        # kernel cancels their contributions to zero (round-2 review bug)
+        line = parse_wkt("LINESTRING(0 0, 4 3)")
+        poly = parse_wkt("POLYGON((-6 -6, -2 -6, -2 -2, -6 -2, -6 -6))")
+        pt = parse_wkt("POINT(5.5 5.5)")
+        w = np.array([1.0, 2.0, 3.0])
+        got = _run_geometry([line, poly, pt], "Geometry", w, BBOX, 16, 16)
+        want = line_oracle([[line.rings[0]]], [1.0], BBOX, 16, 16)
+        want = want + polygon_oracle([poly.rings], [2.0], BBOX, 16, 16)
+        # point cell: bbox (-8..8) / 16 -> cell edge 1.0; (5.5, 5.5) -> col
+        # 13, row 13
+        want[13, 13] += 3.0
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+        assert got.sum() == pytest.approx(want.sum())
+
+    def test_mixed_mask_and_weights_align(self):
+        # masking a feature inside a mixed column removes exactly its
+        # contribution (per-subset weight/mask gathers must stay aligned)
+        line = parse_wkt("LINESTRING(0 0, 4 0)")
+        poly = parse_wkt("POLYGON((-6 -6, -2 -6, -2 -2, -6 -2, -6 -6))")
+        w = np.array([2.0, 1.5])
+        full = _run_geometry([line, poly], "Geometry", w, BBOX, 16, 16)
+        masked = _run_geometry(
+            [line, poly], "Geometry", w, BBOX, 16, 16,
+            mask=np.array([True, False]),
+        )
+        only_line = line_oracle([[line.rings[0]]], [2.0], BBOX, 16, 16)
+        np.testing.assert_allclose(masked, only_line, rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            full - masked,
+            polygon_oracle([poly.rings], [1.5], BBOX, 16, 16),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    def test_mixed_multi_kinds_round_trip_exact(self):
+        # single-part MULTIPOINT must stay MultiPoint through a mixed
+        # column (round-2 review: kind collapse changes declared types)
+        mp = parse_wkt("MULTIPOINT((1 1))")
+        ln = parse_wkt("LINESTRING(0 0, 2 2)")
+        col = GeometryColumn.from_geometries([mp, ln], kind=None)
+        assert col.kind == "Geometry"
+        assert col.geometry(0).kind == "MultiPoint"
+        assert col.geometry(1).kind == "LineString"
+
+    def test_geometry_collection_not_cancelled(self):
+        # collection features have no single base kind: they degrade to
+        # representative-point binning, never to a silent zero via the
+        # polygon winding kernel
+        gc = parse_wkt("GEOMETRYCOLLECTION(LINESTRING(0 0, 4 3), POINT(1 1))")
+        poly = parse_wkt("POLYGON((-6 -6, -2 -6, -2 -2, -6 -2, -6 -6))")
+        col = GeometryColumn.from_geometries([gc, poly], kind=None)
+        assert col.geometry(0).kind == "GeometryCollection"
+        got = _run_geometry(
+            [gc, poly], "Geometry", np.array([1.0, 1.0]), BBOX, 16, 16
+        )
+        want = polygon_oracle([poly.rings], [1.0], BBOX, 16, 16)
+        # the collection's representative point (first vertex, (0,0)) bins
+        # its full weight at col 8, row 8
+        want[8, 8] += 1.0
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_mixed_concat_preserves_feature_kinds(self):
+        from geomesa_tpu.core.columnar import GeometryColumn
+
+        lines = GeometryColumn.from_geometries(
+            [parse_wkt("LINESTRING(0 0, 1 1)")], kind="LineString"
+        )
+        polys = GeometryColumn.from_geometries(
+            [parse_wkt("POLYGON((0 0, 1 0, 1 1, 0 0))")], kind="Polygon"
+        )
+        sft_l = SimpleFeatureType.from_spec("t", "*geom:LineString")
+        sft_p = SimpleFeatureType.from_spec("t", "*geom:Polygon")
+        merged = FeatureBatch.concat(
+            [
+                FeatureBatch(sft_l, {"geom": lines}),
+                FeatureBatch(sft_p, {"geom": polys}),
+            ]
+        )
+        col = merged.columns["geom"]
+        assert col.kind == "Geometry"
+        assert col.feature_kinds is not None
+        assert col.feature_kinds.tolist() == [1, 2]
+        # reconstruction keeps base kinds
+        assert col.geometry(0).kind == "LineString"
+        assert col.geometry(1).kind == "Polygon"
+
+
 class TestEndToEndPolygonLayer:
     """XZ2-partitioned polygon store -> planner -> device rasterization."""
 
