@@ -1,14 +1,17 @@
-"""Key-value index layer: keyspaces, adapter SPI, and the in-memory backend.
+"""Key-value index layer: keyspaces, adapter SPI, and its two backends.
 
 Parity: geomesa-index-api's index catalog + IndexAdapter SPI + the
 TestGeoMesaDataStore in-memory reference backend (SURVEY.md C7, C9-C11, §4)
 [upstream, unverified]. This is the row-key architecture the reference runs
 on Accumulo/HBase/Cassandra/Redis; here one sorted-KV adapter contract backs
-all index types, and the in-memory implementation doubles as the test oracle
-backend exactly as upstream's TestGeoMesaDataStore does.
+all index types, with two implementations proving the SPI the way the
+reference's backend plurality does: the in-memory adapter (the
+TestGeoMesaDataStore analog) and the durable SQLite adapter + row store
+(index/durable.py), whose data survives process restarts.
 """
 
 from geomesa_tpu.index.adapter import IndexAdapter, MemoryIndexAdapter
+from geomesa_tpu.index.durable import DurableKVDataStore, SqliteIndexAdapter
 from geomesa_tpu.index.keyspace import (
     AttributeIndex,
     IdIndex,
@@ -25,6 +28,8 @@ from geomesa_tpu.index.splitter import FilterSplitter, StrategyDecider
 __all__ = [
     "IndexAdapter",
     "MemoryIndexAdapter",
+    "SqliteIndexAdapter",
+    "DurableKVDataStore",
     "IndexKeySpace",
     "Z3Index",
     "Z2Index",

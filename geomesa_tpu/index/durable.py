@@ -1,0 +1,293 @@
+"""Durable sorted-KV backend: SQLite-backed IndexAdapter + row store.
+
+Parity: the reference's core promise is ONE index/scan contract over many
+stores (SURVEY.md:95, C9-C11 — Accumulo/HBase/Cassandra/Redis all implement
+the same IndexAdapter SPI); round 1 shipped exactly one in-memory adapter,
+which proved nothing about the abstraction and survived no restart. This
+module is the second, durable implementation: every index keyspace and the
+whole KVFeatureSource stack run on it unmodified, and a reopened store
+serves identical results.
+
+Design: one SQLite file per feature type.
+- `idx(name, key BLOB, row)` with PRIMARY KEY (name, key): SQLite compares
+  BLOBs by memcmp, so B-tree range scans over `key >= lo AND key < hi` are
+  exactly the lexicographic ByteRange contract the keyspaces encode for
+  (lexicoders produce order-preserving bytes precisely so a dumb byte-sorted
+  store can serve them — same reason the reference's rowkeys work on any
+  ordered KV store).
+- `batches(id, ipc BLOB, fids TEXT)`: the row store — each written
+  FeatureBatch as Arrow IPC stream bytes (the framework's one serialization
+  substrate; no second row codec, per the C3 columnar-replaces-Kryo design
+  decision) plus its fid list.
+- `dead(row)`: tombstones. `meta(k, v)`: sft spec, shard count, fid seq.
+
+Every logical write (tombstones + row batch + index keys + fid seq) commits
+as ONE SQLite transaction via `transaction()`, so a crash leaves either the
+complete write or nothing — no index keys without rows, no replaced
+features lost between tombstone and re-store, no stale fid sequence
+(§5.3 failure-detection stance: idempotent writes, fail-fast recovery).
+
+This is deliberately NOT the performance path — the FS/Parquet store and
+the HBM-resident cache are (SURVEY.md C14). It is the durability +
+SPI-plurality path, sized for catalog/live-layer workloads.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sqlite3
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from geomesa_tpu.index.adapter import IndexAdapter
+from geomesa_tpu.index.keyspace import ByteRange, WriteKey
+
+
+class SqliteIndexAdapter(IndexAdapter):
+    """IndexAdapter over a SQLite file; also the durable row/meta store
+    the KVFeatureSource persistence hooks use (store_batch/load_batches/
+    mark_dead/load_dead/meta_get/meta_set)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._db = sqlite3.connect(path)
+        self._txn_depth = 0
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        with self._db:
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS idx ("
+                "name TEXT NOT NULL, key BLOB NOT NULL, row INTEGER NOT NULL,"
+                "PRIMARY KEY (name, key))"
+            )
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS batches ("
+                "id INTEGER PRIMARY KEY AUTOINCREMENT, ipc BLOB NOT NULL,"
+                "fids TEXT NOT NULL)"
+            )
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS dead (row INTEGER PRIMARY KEY)"
+            )
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS meta (k TEXT PRIMARY KEY, v TEXT)"
+            )
+
+    def close(self) -> None:
+        self._db.close()
+
+    # -- transactions ------------------------------------------------------
+
+    def _commit(self) -> None:
+        if self._txn_depth == 0:
+            self._db.commit()
+
+    @contextlib.contextmanager
+    def transaction(self):
+        """Group mutations into one atomic commit (reentrant). The
+        KVFeatureSource write/delete paths wrap their whole multi-table
+        sequence (tombstones + row batch + index keys + seq) in this, so a
+        crash leaves either the complete logical write or none of it —
+        never index keys without rows, dead rows without replacements, or
+        a stale fid sequence (round-2 review crash-consistency findings)."""
+        self._txn_depth += 1
+        try:
+            yield
+        except BaseException:
+            self._txn_depth -= 1
+            if self._txn_depth == 0:
+                self._db.rollback()
+            raise
+        else:
+            self._txn_depth -= 1
+            if self._txn_depth == 0:
+                self._db.commit()
+
+    # -- IndexAdapter SPI --------------------------------------------------
+
+    def create_index(self, index_name: str) -> None:
+        # single-table layout: nothing to create per index; record the
+        # name so size() on a never-written index returns 0, not a miss
+        self._db.execute(
+            "INSERT OR IGNORE INTO meta (k, v) VALUES (?, '')",
+            (f"index:{index_name}",),
+        )
+        self._commit()
+
+    def write(self, index_name: str, keys: Iterable[WriteKey]) -> None:
+        self._db.executemany(
+            "INSERT OR REPLACE INTO idx (name, key, row) VALUES (?, ?, ?)",
+            ((index_name, wk.key, wk.row) for wk in keys),
+        )
+        self._commit()
+
+    def delete(self, index_name: str, keys: Iterable[bytes]) -> None:
+        self._db.executemany(
+            "DELETE FROM idx WHERE name = ? AND key = ?",
+            ((index_name, k) for k in keys),
+        )
+        self._commit()
+
+    def scan(self, index_name: str, ranges: Sequence[ByteRange]) -> List[int]:
+        seen = set()
+        out: List[int] = []
+        cur = self._db.cursor()
+        for lo, hi in ranges:
+            for (row,) in cur.execute(
+                "SELECT row FROM idx WHERE name = ? AND key >= ? AND key < ?"
+                " ORDER BY key",
+                (index_name, lo, hi),
+            ):
+                if row not in seen:
+                    seen.add(row)
+                    out.append(row)
+        return out
+
+    def scan_count(self, index_name: str, ranges: Sequence[ByteRange]) -> int:
+        cur = self._db.cursor()
+        total = 0
+        for lo, hi in ranges:
+            total += cur.execute(
+                "SELECT COUNT(*) FROM idx WHERE name = ? AND key >= ?"
+                " AND key < ?",
+                (index_name, lo, hi),
+            ).fetchone()[0]
+        return total
+
+    def size(self, index_name: str) -> int:
+        return self._db.execute(
+            "SELECT COUNT(*) FROM idx WHERE name = ?", (index_name,)
+        ).fetchone()[0]
+
+    # -- durable row store (KVFeatureSource persistence hooks) -------------
+
+    def store_batch(self, ipc: bytes, fids: Sequence[str]) -> None:
+        self._db.execute(
+            "INSERT INTO batches (ipc, fids) VALUES (?, ?)",
+            (ipc, json.dumps(list(fids))),
+        )
+        self._commit()
+
+    def load_batches(self) -> List[Tuple[bytes, List[str]]]:
+        return [
+            (ipc, json.loads(fids))
+            for ipc, fids in self._db.execute(
+                "SELECT ipc, fids FROM batches ORDER BY id"
+            )
+        ]
+
+    def mark_dead(self, rows: Iterable[int]) -> None:
+        self._db.executemany(
+            "INSERT OR IGNORE INTO dead (row) VALUES (?)",
+            ((int(r),) for r in rows),
+        )
+        self._commit()
+
+    def load_dead(self) -> set:
+        return {r for (r,) in self._db.execute("SELECT row FROM dead")}
+
+    def meta_set(self, key: str, value: str) -> None:
+        self._db.execute(
+            "INSERT OR REPLACE INTO meta (k, v) VALUES (?, ?)",
+            (key, str(value)),
+        )
+        self._commit()
+
+    def meta_get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        row = self._db.execute(
+            "SELECT v FROM meta WHERE k = ?", (key,)
+        ).fetchone()
+        return row[0] if row is not None else default
+
+
+def batch_to_ipc(batch) -> bytes:
+    from geomesa_tpu.core.arrow_io import to_ipc_bytes
+
+    return to_ipc_bytes(batch)
+
+
+def ipc_to_batch(ipc: bytes, sft):
+    import pyarrow as pa
+
+    from geomesa_tpu.core.arrow_io import from_arrow
+
+    reader = pa.ipc.open_stream(io.BytesIO(ipc))
+    batches = [from_arrow(rb, sft) for rb in reader]
+    if len(batches) != 1:
+        from geomesa_tpu.core.columnar import FeatureBatch
+
+        return FeatureBatch.concat(batches)
+    return batches[0]
+
+
+class DurableKVDataStore:
+    """A KVDataStore whose schemas and features survive process restarts:
+    one SQLite file per feature type under `root`, reopened on
+    construction (upstream analog: any GeoMesaDataStore pointed at an
+    existing catalog table finds its schemas and data)."""
+
+    def __init__(self, root: str, shards: int = 4):
+        from geomesa_tpu.core.sft import SimpleFeatureType
+        from geomesa_tpu.index.keyspace import default_indices
+        from geomesa_tpu.index.kvstore import KVFeatureSource
+
+        self.root = root
+        self._shards = shards
+        self._sources: Dict[str, "KVFeatureSource"] = {}
+        os.makedirs(root, exist_ok=True)
+        for fn in sorted(os.listdir(root)):
+            if not fn.endswith(".db"):
+                continue
+            adapter = SqliteIndexAdapter(os.path.join(root, fn))
+            name = adapter.meta_get("sft_name")
+            spec = adapter.meta_get("sft_spec")
+            if not name or spec is None:
+                adapter.close()
+                continue  # half-created file: unreadable schema, skip
+            sft = SimpleFeatureType.from_spec(name, spec)
+            sh = int(adapter.meta_get("shards", str(shards)))
+            src = KVFeatureSource(sft, adapter, default_indices(sft, sh))
+            self._sources[name] = src
+
+    def create_schema(self, sft, indices=None) -> "KVFeatureSource":
+        from geomesa_tpu.index.keyspace import default_indices
+        from geomesa_tpu.index.kvstore import KVFeatureSource
+
+        if sft.name in self._sources:
+            raise ValueError(f"schema {sft.name!r} already exists")
+        if indices is not None:
+            raise ValueError(
+                "DurableKVDataStore reopens schemas with default_indices; "
+                "custom index sets are not persisted"
+            )
+        adapter = SqliteIndexAdapter(
+            os.path.join(self.root, f"{sft.name}.db")
+        )
+        adapter.meta_set("sft_name", sft.name)
+        adapter.meta_set("sft_spec", sft.to_spec())
+        adapter.meta_set("shards", str(self._shards))
+        src = KVFeatureSource(
+            sft, adapter, default_indices(sft, self._shards)
+        )
+        self._sources[sft.name] = src
+        return src
+
+    def get_feature_source(self, name: str):
+        return self._sources[name]
+
+    def get_schema(self, name: str):
+        return self._sources[name].sft
+
+    def get_type_names(self) -> List[str]:
+        return sorted(self._sources)
+
+    def remove_schema(self, name: str) -> None:
+        src = self._sources.pop(name)
+        src.adapter.close()
+        os.remove(os.path.join(self.root, f"{name}.db"))
+
+    def close(self) -> None:
+        for src in self._sources.values():
+            src.adapter.close()

@@ -59,12 +59,45 @@ class KVFeatureSource:
         self._fid_row: Dict[str, int] = {}
         self._dead: set = set()
         self._seq = 0
+        # a durable adapter (index/durable.py) also persists the row store;
+        # restore batches / tombstones / fid map from it on (re)open
+        self._durable = hasattr(adapter, "load_batches")
+        if self._durable:
+            from geomesa_tpu.index.durable import ipc_to_batch
+
+            for ipc, fids in adapter.load_batches():
+                batch = ipc_to_batch(ipc, self.sft)
+                base = self._offsets[-1]
+                self._batches.append(batch)
+                self._fids.append(list(fids))
+                self._offsets.append(base + len(batch))
+            self._dead = adapter.load_dead()
+            for b, fids in enumerate(self._fids):
+                for i, f in enumerate(fids):
+                    r = self._offsets[b] + i
+                    if r not in self._dead:
+                        self._fid_row[f] = r
+            self._seq = int(adapter.meta_get("seq", "0"))
 
     # -- writes ------------------------------------------------------------
 
     def write(self, batch: FeatureBatch, fids: Optional[Sequence[str]] = None) -> List[str]:
         """Index + store a batch; same-fid writes replace (upstream:
-        idempotent same-key overwrite, §5.3). Returns the feature ids."""
+        idempotent same-key overwrite, §5.3). Returns the feature ids.
+
+        Padding rows (valid=False) are compacted away first: they are a
+        device-shape artifact, and storing them would also desync the
+        durable row store (Arrow IPC persists valid rows only).
+
+        Failure contract (§5.3 fail-fast): on a durable adapter the disk
+        transaction rolls back atomically, but in-memory bookkeeping may
+        have advanced — discard this source and reopen the store after a
+        write exception; the reopened state is the pre-write state."""
+        if batch.valid is not None and not bool(batch.valid.all()):
+            keep = np.nonzero(batch.valid)[0]
+            if fids is not None:
+                fids = [fids[int(i)] for i in keep]
+            batch = batch.select(keep)
         n = len(batch)
         if fids is None:
             fids = batch.fids.decode() if batch.fids is not None else None
@@ -73,21 +106,38 @@ class KVFeatureSource:
         fids = [str(f) for f in fids]
         self._seq += n
 
-        # replace-by-id: tombstone + de-index any previous row per fid
-        stale = [self._fid_row[f] for f in fids if f in self._fid_row]
-        if stale:
-            self._delete_rows(stale)
+        # the whole logical write — tombstoning replaced fids, the row
+        # batch, every index's keys, and the fid sequence — commits as one
+        # transaction on durable adapters: a crash leaves all or nothing
+        import contextlib
 
-        base = self._offsets[-1]
-        rows = list(range(base, base + n))
-        self._batches.append(batch)
-        self._fids.append(list(fids))
-        self._offsets.append(base + n)
-        for i, f in enumerate(fids):
-            self._fid_row[f] = base + i
-        for idx in self.indices:
-            name = getattr(idx, "full_name", idx.name)
-            self.adapter.write(name, idx.write_keys(batch, fids, rows))
+        txn = (
+            self.adapter.transaction()
+            if self._durable
+            else contextlib.nullcontext()
+        )
+        with txn:
+            # replace-by-id: tombstone + de-index any previous row per fid
+            stale = [self._fid_row[f] for f in fids if f in self._fid_row]
+            if stale:
+                self._delete_rows(stale)
+
+            base = self._offsets[-1]
+            rows = list(range(base, base + n))
+            self._batches.append(batch)
+            self._fids.append(list(fids))
+            self._offsets.append(base + n)
+            for i, f in enumerate(fids):
+                self._fid_row[f] = base + i
+            if self._durable:
+                from geomesa_tpu.index.durable import batch_to_ipc
+
+                self.adapter.store_batch(batch_to_ipc(batch), fids)
+            for idx in self.indices:
+                name = getattr(idx, "full_name", idx.name)
+                self.adapter.write(name, idx.write_keys(batch, fids, rows))
+            if self._durable:
+                self.adapter.meta_set("seq", str(self._seq))
         return list(fids)
 
     def _locate(self, row: int):
@@ -95,24 +145,38 @@ class KVFeatureSource:
         return b, row - self._offsets[b]
 
     def _delete_rows(self, rows: Sequence[int]) -> None:
-        by_batch: Dict[int, List[int]] = {}
-        for r in rows:
-            if r in self._dead:
-                continue
-            b, i = self._locate(r)
-            by_batch.setdefault(b, []).append(i)
-            self._dead.add(r)
-        for b, local in by_batch.items():
-            sel = self._batches[b].select(np.asarray(sorted(local)))
-            fids = [self._fids[b][i] for i in sorted(local)]
-            rows_abs = [self._offsets[b] + i for i in sorted(local)]
-            for idx in self.indices:
-                name = getattr(idx, "full_name", idx.name)
-                keys = [wk.key for wk in idx.write_keys(sel, fids, rows_abs)]
-                self.adapter.delete(name, keys)
-            for f in fids:
-                if self._fid_row.get(f) in rows_abs:
-                    del self._fid_row[f]
+        import contextlib
+
+        # atomic on durable adapters (reentrant: write() already holds the
+        # transaction on the replace-by-id path)
+        txn = (
+            self.adapter.transaction()
+            if self._durable
+            else contextlib.nullcontext()
+        )
+        with txn:
+            by_batch: Dict[int, List[int]] = {}
+            newly_dead: List[int] = []
+            for r in rows:
+                if r in self._dead:
+                    continue
+                b, i = self._locate(r)
+                by_batch.setdefault(b, []).append(i)
+                self._dead.add(r)
+                newly_dead.append(r)
+            if self._durable and newly_dead:
+                self.adapter.mark_dead(newly_dead)
+            for b, local in by_batch.items():
+                sel = self._batches[b].select(np.asarray(sorted(local)))
+                fids = [self._fids[b][i] for i in sorted(local)]
+                rows_abs = [self._offsets[b] + i for i in sorted(local)]
+                for idx in self.indices:
+                    name = getattr(idx, "full_name", idx.name)
+                    keys = [wk.key for wk in idx.write_keys(sel, fids, rows_abs)]
+                    self.adapter.delete(name, keys)
+                for f in fids:
+                    if self._fid_row.get(f) in rows_abs:
+                        del self._fid_row[f]
 
     def age_off(self, ttl_ms: int, now_ms: Optional[int] = None) -> int:
         """Delete features older than ttl (upstream: DtgAgeOffIterator /

@@ -47,7 +47,19 @@ class StatsManager:
             self._loaded_mtime = os.path.getmtime(self.path)
             with open(self.path) as f:
                 raw = json.load(f)
-            self.stats = {k: Stat.from_json(v) for k, v in raw.items()}
+            self.stats = {}
+            for k, v in raw.items():
+                try:
+                    self.stats[k] = Stat.from_json(v)
+                except ValueError as e:
+                    # e.g. a sketch persisted under an older hash family:
+                    # stale derived data — drop it (planner falls back to
+                    # heuristics) rather than serving corrupt estimates
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "dropping persisted stat %r: %s", k, e
+                    )
 
     def refresh(self) -> None:
         """Reload stats.json if it changed on disk since the last load, so a

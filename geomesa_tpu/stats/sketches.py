@@ -23,12 +23,18 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-_FNV_OFFSET = np.uint64(0xCBF29CE484222325)
+_FNV_OFFSET = 0xCBF29CE484222325  # Python ints: seed mixing wraps manually
+_SEED_MIX = 0x9E3779B97F4A7C15
 _FNV_PRIME = np.uint64(0x100000001B3)
-_SEED_MIX = np.uint64(0x9E3779B97F4A7C15)
 _M1 = np.uint64(0xFF51AFD7ED558CCD)
 _M2 = np.uint64(0xC4CEB9FE1A85EC53)
 _U33 = np.uint64(33)
+
+# stamped into hash-dependent sketch JSON; loading a sketch built with a
+# different hash family would silently corrupt CMS counts / HLL registers,
+# so deserialization rejects mismatches (StatsManager drops + warns, and
+# stats-analyze regenerates — sketches are derived data)
+HASH_VERSION = "fnv1a-fmix64-v1"
 
 
 def _hash64(values, seed: int = 0) -> np.ndarray:
@@ -44,7 +50,7 @@ def _hash64(values, seed: int = 0) -> np.ndarray:
     review flagged).
     """
     u = np.asarray(values)
-    init = np.uint64((0xCBF29CE484222325 ^ (seed * 0x9E3779B97F4A7C15)) & 0xFFFFFFFFFFFFFFFF)
+    init = np.uint64((_FNV_OFFSET ^ (seed * _SEED_MIX)) & 0xFFFFFFFFFFFFFFFF)
     if u.dtype.kind in "iub" and u.dtype.itemsize <= 8:
         # numeric fast path: hash the 64-bit pattern directly (no string
         # materialization). Same-value-same-hash holds because a column
@@ -214,10 +220,16 @@ class Cardinality(Stat):
 
     def to_json(self):
         return {"kind": self.kind, "attribute": self.attribute, "p": self.p,
-                "registers": self.registers.tolist()}
+                "hash": HASH_VERSION, "registers": self.registers.tolist()}
 
     @classmethod
     def _from_json(cls, d):
+        if d.get("hash") != HASH_VERSION:
+            raise ValueError(
+                f"cardinality sketch was built with hash "
+                f"{d.get('hash', 'blake2b-v0')!r}, this build uses "
+                f"{HASH_VERSION!r}; rerun stats-analyze"
+            )
         return cls(d["attribute"], d["p"], d["registers"])
 
 
@@ -276,10 +288,16 @@ class Frequency(Stat):
     def to_json(self):
         return {"kind": self.kind, "attribute": self.attribute,
                 "width": self.width, "depth": self.depth,
-                "table": self.table.tolist()}
+                "hash": HASH_VERSION, "table": self.table.tolist()}
 
     @classmethod
     def _from_json(cls, d):
+        if d.get("hash") != HASH_VERSION:
+            raise ValueError(
+                f"frequency sketch was built with hash "
+                f"{d.get('hash', 'blake2b-v0')!r}, this build uses "
+                f"{HASH_VERSION!r}; rerun stats-analyze"
+            )
         return cls(d["attribute"], d["width"], d["depth"], d["table"])
 
 

@@ -13,8 +13,10 @@ from geomesa_tpu.core.sft import SimpleFeatureType
 from geomesa_tpu.cql import parse_cql
 from geomesa_tpu.index import (
     AttributeIndex,
+    DurableKVDataStore,
     KVDataStore,
     MemoryIndexAdapter,
+    SqliteIndexAdapter,
     Z3Index,
     default_indices,
 )
@@ -93,13 +95,35 @@ POINT_FILTERS = [
 ]
 
 
-@pytest.fixture(scope="module")
-def kv_source():
+# every KV test runs over BOTH adapters: the in-memory reference backend
+# and the durable SQLite backend — the SPI-plurality the reference proves
+# with its four storage backends (SURVEY.md C9-C11)
+ADAPTERS = ["memory", "sqlite"]
+
+
+@pytest.fixture(scope="module", params=ADAPTERS)
+def kv_source(request, tmp_path_factory):
     sft, batch = make_point_batch()
-    ds = KVDataStore()
+    if request.param == "memory":
+        ds = KVDataStore()
+    else:
+        ds = DurableKVDataStore(str(tmp_path_factory.mktemp("kvdur")))
     src = ds.create_schema(sft)
     src.write(batch)
     return sft, batch, src
+
+
+@pytest.fixture(params=ADAPTERS)
+def make_ds(request, tmp_path):
+    seq = [0]
+
+    def _make():
+        if request.param == "memory":
+            return KVDataStore()
+        seq[0] += 1
+        return DurableKVDataStore(str(tmp_path / f"kv{seq[0]}"))
+
+    return _make
 
 
 # -- parity ----------------------------------------------------------------
@@ -140,9 +164,9 @@ def test_kv_index_override(kv_source):
     assert src.get_count(q) == expected
 
 
-def test_kv_overwrite_same_fid():
+def test_kv_overwrite_same_fid(make_ds):
     sft, batch = make_point_batch(50)
-    ds = KVDataStore()
+    ds = make_ds()
     src = ds.create_schema(sft)
     fids = src.write(batch)
     assert src.live_count == 50
@@ -153,9 +177,9 @@ def test_kv_overwrite_same_fid():
     assert len(r.features) == 50
 
 
-def test_kv_delete_features():
+def test_kv_delete_features(make_ds):
     sft, batch = make_point_batch(80)
-    ds = KVDataStore()
+    ds = make_ds()
     src = ds.create_schema(sft)
     src.write(batch)
     f = parse_cql("actor = 'USA'")
@@ -170,9 +194,9 @@ def test_kv_delete_features():
     assert got == 80 - n_usa
 
 
-def test_kv_id_queries():
+def test_kv_id_queries(make_ds):
     sft, batch = make_point_batch(30)
-    ds = KVDataStore()
+    ds = make_ds()
     src = ds.create_schema(sft)
     fids = src.write(batch)
     some = [fids[3], fids[17], fids[29]]
@@ -215,7 +239,7 @@ def test_kv_aggregation_hints(kv_source):
     assert "__fid__" in t.schema.names
 
 
-def test_kv_extended_geometries_xz2():
+def test_kv_extended_geometries_xz2(make_ds):
     rng = np.random.default_rng(3)
     sft = SimpleFeatureType.from_spec("polys", "name:String,*geom:Polygon")
     n = 60
@@ -230,7 +254,7 @@ def test_kv_extended_geometries_xz2():
     batch = FeatureBatch.from_pydict(
         sft, {"name": [f"p{i}" for i in range(n)], "geom": geoms}
     )
-    ds = KVDataStore()
+    ds = make_ds()
     src = ds.create_schema(sft)
     src.write(batch)
     # default index set for extended geoms: xz2 (+id)
@@ -268,11 +292,11 @@ def test_attribute_index_range_scan_counts():
     assert set(rows) == expected
 
 
-def test_kv_like_underscore_not_prefix_scanned():
+def test_kv_like_underscore_not_prefix_scanned(make_ds):
     """'_' is a LIKE wildcard; the attr index must not treat it as a literal
     prefix byte (that would silently drop matches)."""
     sft, batch = make_point_batch(100, seed=13)
-    ds = KVDataStore()
+    ds = make_ds()
     src = ds.create_schema(sft)
     src.write(batch)
     f = parse_cql("actor LIKE 'U_A%'")
@@ -281,12 +305,12 @@ def test_kv_like_underscore_not_prefix_scanned():
     assert src.get_count("actor LIKE 'U_A%'") == expected
 
 
-def test_kv_bulk_write_scales():
+def test_kv_bulk_write_scales(make_ds):
     """Bulk writes use one sorted merge, not per-key insertion."""
     import time
 
     sft, batch = make_point_batch(5000, seed=17)
-    ds = KVDataStore()
+    ds = make_ds()
     src = ds.create_schema(sft)
     t0 = time.perf_counter()
     src.write(batch)
@@ -295,3 +319,138 @@ def test_kv_bulk_write_scales():
     assert src.get_count("actor = 'USA'") == int(
         eval_filter(parse_cql("actor = 'USA'"), batch).sum()
     )
+
+
+# -- durability ------------------------------------------------------------
+
+
+def test_durable_survives_restart(tmp_path):
+    """The whole point of the second adapter: a reopened store serves
+    identical results — schema, features, tombstones, fid map."""
+    root = str(tmp_path / "kv")
+    sft, batch = make_point_batch(120, seed=23)
+    ds = DurableKVDataStore(root)
+    src = ds.create_schema(sft)
+    fids = src.write(batch)
+    n_usa = int(eval_filter(parse_cql("actor = 'USA'"), batch).sum())
+    src.delete_features("actor = 'USA'")
+    expected_live = 120 - n_usa
+    expected = {
+        cql: src.get_count(cql) for cql in POINT_FILTERS
+    }
+    ds.close()
+
+    ds2 = DurableKVDataStore(root)
+    assert ds2.get_type_names() == ["gdelt"]
+    src2 = ds2.get_feature_source("gdelt")
+    assert src2.sft.to_spec() == sft.to_spec()
+    assert src2.live_count == expected_live
+    for cql, want in expected.items():
+        assert src2.get_count(cql) == want, cql
+    # fid map restored: id lookups still work, overwrite still replaces
+    live = [f for f in fids if f in src2._fid_row]
+    got = src2.get_features_by_id(live[:5])
+    assert sorted(got.fids.decode()) == sorted(live[:5])
+    src2.write(src2.get_features_by_id(live[:5]), fids=live[:5])
+    assert src2.live_count == expected_live
+    ds2.close()
+
+
+def test_durable_age_off_survives_restart(tmp_path):
+    root = str(tmp_path / "kv")
+    sft, batch = make_point_batch(100, seed=29)
+    ds = DurableKVDataStore(root)
+    src = ds.create_schema(sft)
+    src.write(batch)
+    dtg = np.asarray(batch.columns["dtg"], np.int64)
+    now = 1_600_000_000_000
+    ttl = 5_000_000_000
+    expected_removed = int((dtg < now - ttl).sum())
+    removed = src.age_off(ttl, now_ms=now)
+    assert removed == expected_removed
+    ds.close()
+
+    ds2 = DurableKVDataStore(root)
+    src2 = ds2.get_feature_source("gdelt")
+    assert src2.live_count == 100 - expected_removed
+    # aged-off rows stay gone from every index after reopen
+    r = src2.get_features("BBOX(geom, -180, -90, 180, 90)")
+    got = 0 if r.features is None else len(r.features)
+    assert got == 100 - expected_removed
+    ds2.close()
+
+
+def test_sqlite_adapter_spi_direct(tmp_path):
+    """The SPI contract directly: byte-ordered range scans, idempotent
+    overwrite, delete, counts."""
+    from geomesa_tpu.index.keyspace import WriteKey
+
+    a = SqliteIndexAdapter(str(tmp_path / "x.db"))
+    a.create_index("t")
+    assert a.size("t") == 0
+    a.write("t", [WriteKey(b"\x00\x05", 5), WriteKey(b"\x00\x01", 1),
+                  WriteKey(b"\x01\x00", 256)])
+    a.write("t", [WriteKey(b"\x00\x05", 50)])  # overwrite same key
+    assert a.size("t") == 3
+    assert a.scan("t", [(b"\x00", b"\x01")]) == [1, 50]
+    assert a.scan_count("t", [(b"\x00", b"\x02")]) == 3
+    a.delete("t", [b"\x00\x01"])
+    assert a.scan("t", [(b"\x00", b"\x01")]) == [50]
+    a.close()
+
+
+def test_durable_write_is_atomic(tmp_path):
+    """A failure mid-write (after tombstones + row store, before all index
+    keys) must roll back the WHOLE logical write on disk."""
+    root = str(tmp_path / "kv")
+    sft, batch = make_point_batch(40, seed=31)
+    ds = DurableKVDataStore(root)
+    src = ds.create_schema(sft)
+    fids = src.write(batch)
+    baseline = src.get_count("INCLUDE")
+
+    # sabotage: the LAST index write raises, after rows + earlier indexes
+    real_write = src.adapter.write
+    calls = []
+
+    def flaky(name, keys):
+        calls.append(name)
+        if len(calls) == len(src.indices):
+            raise RuntimeError("simulated crash")
+        real_write(name, keys)
+
+    src.adapter.write = flaky
+    with pytest.raises(RuntimeError):
+        src.write(batch, fids=fids)  # replace-by-id: tombstones first
+    src.adapter.write = real_write
+    ds.close()
+
+    # the failed write must be invisible: no tombstoned originals, no
+    # duplicate batch, same counts
+    ds2 = DurableKVDataStore(root)
+    src2 = ds2.get_feature_source("gdelt")
+    assert src2.live_count == baseline
+    assert src2.get_count("INCLUDE") == baseline
+    for cql in POINT_FILTERS[:3]:
+        assert src2.get_count(cql) == int(
+            eval_filter(parse_cql(cql), batch).sum()
+        ), cql
+    ds2.close()
+
+
+def test_stale_hash_sketches_dropped(tmp_path):
+    """stats.json persisted under an older hash family must be dropped on
+    load (regenerable derived data), not served corrupt."""
+    import json as _json
+
+    from geomesa_tpu.stats.sketches import Cardinality, Stat
+
+    c = Cardinality("x")
+    c.observe(np.arange(100))
+    d = c.to_json()
+    # round trip works at the current version
+    assert Stat.from_json(d).result() == pytest.approx(c.result())
+    d_old = dict(d)
+    d_old.pop("hash")  # as written by the round-1 blake2b code
+    with pytest.raises(ValueError, match="rerun stats-analyze"):
+        Stat.from_json(d_old)
