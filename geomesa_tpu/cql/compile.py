@@ -44,19 +44,64 @@ ParamBuilder = Callable[[FeatureBatch], np.ndarray]
 
 
 class CompiledFilter:
-    """A compiled filter: `mask(dev, batch)` -> bool [N] device array."""
+    """A compiled filter: `mask(dev, batch)` -> bool [N] device array.
 
-    def __init__(self, fn, builders: Dict[str, ParamBuilder], cql: str):
+    When the filter contains polygon predicates, `band(dev, batch)` flags
+    rows inside the f32 boundary-ambiguity band and `mask_refined`
+    re-evaluates exactly those rows in f64 on host (cql.hosteval) and
+    patches the mask — the SURVEY.md:824-827 robustness plan: device bulk
+    throughput, oracle-exact answers at the boundary."""
+
+    def __init__(
+        self, fn, builders: Dict[str, ParamBuilder], cql: str,
+        filter_ast=None, band_fn=None,
+    ):
         self._fn = fn
         self._jit = jax.jit(fn)
         self.builders = builders
         self.cql = cql
+        self.filter_ast = filter_ast
+        self._band_jit = jax.jit(band_fn) if band_fn is not None else None
 
     def params(self, batch: FeatureBatch) -> Dict[str, np.ndarray]:
         return {k: b(batch) for k, b in self.builders.items()}
 
     def mask(self, dev: DeviceBatch, batch: FeatureBatch) -> jax.Array:
         return self._jit(self.params(batch), dev)
+
+    @property
+    def has_band(self) -> bool:
+        return self._band_jit is not None
+
+    def band(self, dev: DeviceBatch, batch: FeatureBatch) -> jax.Array:
+        """Boundary-ambiguity flags [N] (False everywhere when the filter
+        has no polygon predicate)."""
+        if self._band_jit is None:
+            raise ValueError("filter has no boundary band")
+        return self._band_jit(self.params(batch), dev)
+
+    def refine(
+        self, mask: np.ndarray, dev: DeviceBatch, batch: FeatureBatch
+    ) -> np.ndarray:
+        """Patch an already-fetched host mask: borderline rows (f32
+        boundary band of any polygon predicate) are re-evaluated in f64.
+        No-op when the filter has no polygon predicate."""
+        if self._band_jit is None or self.filter_ast is None:
+            return mask
+        flags = np.asarray(self.band(dev, batch))
+        idx = np.nonzero(flags)[0]
+        if not len(idx):
+            return mask
+        from geomesa_tpu.cql.hosteval import eval_filter_host
+
+        sub = batch.select(idx)
+        mask = mask.copy()
+        mask[idx] = eval_filter_host(self.filter_ast, sub)
+        return mask
+
+    def mask_refined(self, dev: DeviceBatch, batch: FeatureBatch) -> np.ndarray:
+        """Host mask with borderline rows re-evaluated exactly in f64."""
+        return self.refine(np.asarray(self.mask(dev, batch)), dev, batch)
 
     def mask_fn(self):
         """The raw pure function (params, dev) -> mask, for fusion into
@@ -70,12 +115,21 @@ class CompiledFilter:
 def compile_filter(f: ast.Filter, sft: SimpleFeatureType) -> CompiledFilter:
     builders: Dict[str, ParamBuilder] = {}
     counter = [0]
-    fn = _compile(f, sft, builders, counter)
+    bands: List = []
+    fn = _compile(f, sft, builders, counter, bands)
 
     def top(params, dev):
         return fn(params, dev) & dev[VALID]
 
-    return CompiledFilter(top, builders, ast.to_cql(f))
+    band_fn = None
+    if bands:
+        def band_fn(params, dev, _bands=tuple(bands)):
+            m = _bands[0](params, dev)
+            for g in _bands[1:]:
+                m = m | g(params, dev)
+            return m & dev[VALID]
+
+    return CompiledFilter(top, builders, ast.to_cql(f), f, band_fn)
 
 
 # -- helpers ---------------------------------------------------------------
@@ -154,13 +208,13 @@ _STR_OPS = {
 # -- node compilation ------------------------------------------------------
 
 
-def _compile(f: ast.Filter, sft, builders, counter):
+def _compile(f: ast.Filter, sft, builders, counter, bands=None):
     if isinstance(f, ast.Include):
         return lambda params, dev: jnp.ones_like(dev[VALID])
     if isinstance(f, ast.Exclude):
         return lambda params, dev: jnp.zeros_like(dev[VALID])
     if isinstance(f, ast.And):
-        fns = [_compile(c, sft, builders, counter) for c in f.children]
+        fns = [_compile(c, sft, builders, counter, bands) for c in f.children]
         def and_(params, dev):
             m = fns[0](params, dev)
             for g in fns[1:]:
@@ -168,7 +222,7 @@ def _compile(f: ast.Filter, sft, builders, counter):
             return m
         return and_
     if isinstance(f, ast.Or):
-        fns = [_compile(c, sft, builders, counter) for c in f.children]
+        fns = [_compile(c, sft, builders, counter, bands) for c in f.children]
         def or_(params, dev):
             m = fns[0](params, dev)
             for g in fns[1:]:
@@ -176,7 +230,7 @@ def _compile(f: ast.Filter, sft, builders, counter):
             return m
         return or_
     if isinstance(f, ast.Not):
-        g = _compile(f.child, sft, builders, counter)
+        g = _compile(f.child, sft, builders, counter, bands)
         return lambda params, dev: ~g(params, dev)
     if isinstance(f, ast.Comparison):
         return _compile_comparison(f, sft, builders, counter)
@@ -255,7 +309,7 @@ def _compile(f: ast.Filter, sft, builders, counter):
             return lambda params, dev: dev[n] > v
         return lambda params, dev: dev[n] == v  # TEQUALS
     if isinstance(f, ast.SpatialPredicate):
-        return _compile_spatial(f, sft, builders, counter)
+        return _compile_spatial(f, sft, builders, counter, bands)
     if isinstance(f, ast.DistancePredicate):
         return _compile_distance(f, sft, builders, counter)
     raise NotImplementedError(f"cannot compile {type(f).__name__}")
@@ -303,7 +357,7 @@ def _compile_comparison(f: ast.Comparison, sft, builders, counter):
 # -- spatial ---------------------------------------------------------------
 
 
-def _compile_spatial(f: ast.SpatialPredicate, sft, builders, counter):
+def _compile_spatial(f: ast.SpatialPredicate, sft, builders, counter, bands=None):
     a = _attr(sft, f.prop.name)
     if not a.is_geometry:
         raise ValueError(f"spatial predicate on non-geometry {a.name!r}")
@@ -327,7 +381,7 @@ def _compile_spatial(f: ast.SpatialPredicate, sft, builders, counter):
         return bbox
 
     if op in ("INTERSECTS", "WITHIN", "DISJOINT"):
-        base = _point_intersects(n, g)
+        base = _point_intersects(n, g, bands)
         if op == "DISJOINT":
             return lambda params, dev: ~base(params, dev)
         return base
@@ -363,7 +417,7 @@ def _compile_spatial(f: ast.SpatialPredicate, sft, builders, counter):
     raise NotImplementedError(f"spatial op {op}")
 
 
-def _point_intersects(n: str, g: Geometry):
+def _point_intersects(n: str, g: Geometry, bands=None):
     """intersects/within for point data against a geometry literal."""
     if g.kind in ("Point", "MultiPoint"):
         pts = np.concatenate(g.rings, axis=0) if g.rings else np.zeros((0, 2))
@@ -385,6 +439,18 @@ def _point_intersects(n: str, g: Geometry):
     edges = tuple(jnp.asarray(s) for s in (x1e, y1e, x2e, y2e))
     def pip(params, dev):
         return points_in_polygon(dev[f"{n}__x"], dev[f"{n}__y"], *edges)
+    if bands is not None:
+        # f32 boundary ambiguity band for exact refinement: rows flagged
+        # here get re-evaluated in f64 on host (SURVEY.md:824-827 plan;
+        # see CompiledFilter.mask_refined)
+        from geomesa_tpu.engine.pip import points_in_polygon_band
+
+        def band(params, dev):
+            return points_in_polygon_band(
+                dev[f"{n}__x"], dev[f"{n}__y"], *edges
+            )
+
+        bands.append(band)
     return pip
 
 

@@ -85,6 +85,45 @@ def points_in_polygon(px, py, x1, y1, x2, y2):
     return (crossings % 2) == 1
 
 
+# f32 boundary ambiguity band, degrees. Must dominate (a) the f64->f32
+# coordinate cast error (ulp(180) ~ 2.1e-5) and (b) the crossing-x
+# arithmetic error, which the band test scales per edge by its slope
+# (nearly-horizontal edges amplify t = (py-y1)/(y2-y1)); edges flatter
+# than the band are caught by the endpoint-proximity term instead.
+BAND_EPS = 1e-4
+
+
+def points_in_polygon_band(px, py, x1, y1, x2, y2, eps: float = BAND_EPS):
+    """Boundary-ambiguity flags: True where the f32 crossing test may
+    disagree with f64 (SURVEY.md:824-827 robustness plan). Flag rule per
+    edge: endpoint-y proximity (the span condition itself can flip), or a
+    crossing whose x lands within the slope-amplified error of px. Callers
+    re-evaluate flagged rows on host in f64 (cql.hosteval) — see
+    CompiledFilter.mask_refined."""
+    from geomesa_tpu.engine.pip_pallas import (
+        points_in_polygon_band_pallas,
+        use_pallas_pip,
+    )
+
+    if use_pallas_pip(px.shape[0], x1.shape[0]):
+        return points_in_polygon_band_pallas(px, py, x1, y1, x2, y2, eps=eps)
+    px = px[:, None]
+    py = py[:, None]
+    near_end = (jnp.abs(py - y1[None, :]) <= eps) | (
+        jnp.abs(py - y2[None, :]) <= eps
+    )
+    cond = (y1[None, :] <= py) != (y2[None, :] <= py)
+    dy = jnp.where(y2 == y1, 1.0, y2 - y1)[None, :]
+    t = (py - y1[None, :]) / dy
+    xc = x1[None, :] + t * (x2[None, :] - x1[None, :])
+    err = eps * (
+        1.0
+        + jnp.abs(x2 - x1)[None, :] / jnp.maximum(jnp.abs(y2 - y1), eps)[None, :]
+    )
+    near_cross = cond & (jnp.abs(xc - px) <= err)
+    return jnp.any(near_end | near_cross, axis=1)
+
+
 def points_in_polygon_np(px, py, geom: Geometry) -> np.ndarray:
     """NumPy f64 oracle with the identical edge rule."""
     x1, y1, x2, y2 = polygon_edges(geom)

@@ -104,6 +104,81 @@ def points_in_polygon_pallas(px, py, x1, y1, x2, y2, interpret: bool = False):
     return (counts.reshape(-1)[:n] % 2) == 1
 
 
+def _pip_band_kernel(
+    px_ref, py_ref, x1_ref, y1_ref, x2_ref, y2_ref, out_ref, *, eps: float
+):
+    """Boundary-ambiguity flags, same streaming-tile shape as _pip_kernel
+    (see engine.pip.points_in_polygon_band for the flag rule)."""
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    px = px_ref[0]
+    py = py_ref[0]
+    x1 = x1_ref[0]
+    y1 = y1_ref[0]
+    x2 = x2_ref[0]
+    y2 = y2_ref[0]
+
+    near_end = (jnp.abs(py - y1) <= eps) | (jnp.abs(py - y2) <= eps)
+    cond = (y1 <= py) != (y2 <= py)
+    t = (py - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
+    xc = x1 + t * (x2 - x1)
+    err = eps * (1.0 + jnp.abs(x2 - x1) / jnp.maximum(jnp.abs(y2 - y1), eps))
+    flag = jnp.sum((near_end | (cond & (jnp.abs(xc - px) <= err))).astype(jnp.int32), axis=0)
+    out_ref[...] += flag.reshape(out_ref.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def points_in_polygon_band_pallas(
+    px, py, x1, y1, x2, y2, eps: float = 1e-4, interpret: bool = False
+):
+    """Streaming-tile boundary-band flags -> bool [N] (Pallas)."""
+    import jax.experimental.pallas as pl
+
+    n = px.shape[0]
+    e = x1.shape[0]
+    if e == 0:
+        return jnp.zeros((n,), bool)
+    npad = (-n) % POINT_TILE
+    epad = (-e) % EDGE_TILE
+    dt = jnp.float32
+    pxp = jnp.pad(px.astype(dt), (0, npad)).reshape(-1, 1, POINT_TILE)
+    pyp = jnp.pad(py.astype(dt), (0, npad), constant_values=1e9).reshape(
+        -1, 1, POINT_TILE
+    )
+    # padding edges sit at y=1e9 so they are never near a real point's y
+    # (zero-padded edges would flag every point with |py| <= eps)
+    e1 = jnp.pad(x1.astype(dt), (0, epad)).reshape(-1, EDGE_TILE, 1)
+    f1 = jnp.pad(y1.astype(dt), (0, epad), constant_values=1e9).reshape(
+        -1, EDGE_TILE, 1
+    )
+    e2 = jnp.pad(x2.astype(dt), (0, epad)).reshape(-1, EDGE_TILE, 1)
+    f2 = jnp.pad(y2.astype(dt), (0, epad), constant_values=1e9).reshape(
+        -1, EDGE_TILE, 1
+    )
+
+    gp, ge = pxp.shape[0], e1.shape[0]
+    point_block = pl.BlockSpec((1, 1, POINT_TILE), lambda i, j: (i, 0, 0))
+    edge_block = pl.BlockSpec((1, EDGE_TILE, 1), lambda i, j: (j, 0, 0))
+
+    with jax.enable_x64(False):
+        counts = pl.pallas_call(
+            functools.partial(_pip_band_kernel, eps=float(eps)),
+            grid=(gp, ge),
+            in_specs=[point_block, point_block,
+                      edge_block, edge_block, edge_block, edge_block],
+            out_specs=pl.BlockSpec((1, 1, POINT_TILE), lambda i, j: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((gp, 1, POINT_TILE), jnp.int32),
+            interpret=interpret,
+        )(pxp, pyp, e1, f1, e2, f2)
+    return counts.reshape(-1)[:n] > 0
+
+
 # threshold below which the dense lax path wins (kernel launch + padding
 # overhead dominates when the [N, E] block fits comfortably anyway)
 _MIN_WORK = 1 << 22

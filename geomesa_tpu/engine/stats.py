@@ -70,6 +70,44 @@ def masked_value_counts(codes: jax.Array, mask: jax.Array, vocab_size: int) -> j
     return jnp.zeros(max(vocab_size, 1), jnp.int32).at[idx].add(w)
 
 
+# -- grouped (segment) reductions: the device side of SQL GROUP BY ----------
+# Parity: upstream runs GROUP BY aggregation in Spark after the relation
+# scan (SURVEY.md:381-383 GeoMesaRelation); here the grouped reduction IS a
+# device kernel — one masked segment reduction per aggregate, mergeable
+# across shards by the same add/min/max laws the sketches use.
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def grouped_count(gids: jax.Array, mask: jax.Array, num_groups: int) -> jax.Array:
+    return jax.ops.segment_sum(
+        mask.astype(jnp.int64), gids, num_segments=num_groups
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def grouped_sum(
+    v: jax.Array, gids: jax.Array, mask: jax.Array, num_groups: int
+) -> jax.Array:
+    vf = jnp.where(mask, v.astype(jnp.float64), 0.0)
+    return jax.ops.segment_sum(vf, gids, num_segments=num_groups)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def grouped_min(
+    v: jax.Array, gids: jax.Array, mask: jax.Array, num_groups: int
+) -> jax.Array:
+    vf = jnp.where(mask, v.astype(jnp.float64), jnp.inf)
+    return jax.ops.segment_min(vf, gids, num_segments=num_groups)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def grouped_max(
+    v: jax.Array, gids: jax.Array, mask: jax.Array, num_groups: int
+) -> jax.Array:
+    vf = jnp.where(mask, v.astype(jnp.float64), -jnp.inf)
+    return jax.ops.segment_max(vf, gids, num_segments=num_groups)
+
+
 @functools.partial(jax.jit, static_argnames=("n_time_bins", "bins_per_dim"))
 def z3_histogram(
     x: jax.Array,

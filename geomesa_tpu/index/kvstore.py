@@ -291,7 +291,9 @@ class KVFeatureSource:
                 if g is not None:
                     residual = _loosen_bbox(f, g.name)
             compiled = compile_filter(residual, self.sft)
-            mask = np.asarray(compiled.mask(dev, padded))
+            # mask_refined: f64 re-check of rows inside the f32 polygon
+            # boundary band (no-op for band-free filters)
+            mask = compiled.mask_refined(dev, padded)
         if query.hints.sampling:
             groups = None
             if query.hints.sample_by:

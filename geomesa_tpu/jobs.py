@@ -140,7 +140,9 @@ def export_partitions(
         batch = FeatureBatch.concat(batches)
         if compiled is not None:
             dev = to_device(batch)
-            mask = np.asarray(compiled.mask(dev, batch))
+            # f64 borderline refinement for polygon predicates (no-op
+            # otherwise) keeps distributed exports oracle-exact
+            mask = compiled.mask_refined(dev, batch)
             batch = batch.select(np.nonzero(mask)[0])
         if not len(batch):
             return None

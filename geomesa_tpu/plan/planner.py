@@ -232,14 +232,28 @@ class QueryPlanner:
                 if plan.compiled is not None
                 else dev["__valid__"]
             )
-            if hints.count_only and not hints.sampling:
+            has_band = plan.compiled is not None and plan.compiled.has_band
+            if hints.count_only and not hints.sampling and not has_band:
                 # device reduction: fetch one scalar instead of the mask
+                # (polygon filters skip this: exact counts need the f64
+                # borderline refinement below)
                 mask_count = int(np.asarray(jnp.sum(dev_mask, dtype=jnp.int32)))
                 t_done = time.perf_counter()
                 self._record(query, plan, hints, mask_count,
                              t0, t_plan, t_scan, t_done)
                 return QueryResult("count", count=mask_count)
             mask = np.asarray(dev_mask)
+            if has_band:
+                # f64 re-check of rows inside the f32 boundary band
+                # (SURVEY.md:824-827); density paths keep the device mask —
+                # grid quantization dwarfs the ~1e-7 deg band
+                mask = plan.compiled.refine(mask, dev, padded)
+            if hints.count_only and not hints.sampling:
+                mask_count = int(mask.sum())
+                t_done = time.perf_counter()
+                self._record(query, plan, hints, mask_count,
+                             t0, t_plan, t_scan, t_done)
+                return QueryResult("count", count=mask_count)
             if hints.sampling:
                 groups = None
                 if hints.sample_by:
@@ -316,8 +330,9 @@ class QueryPlanner:
             else sb.dev["__valid__"]
         )
         dev_mask = dev_mask & jnp.asarray(allowed)[sb.pids]
+        has_band = plan.compiled is not None and plan.compiled.has_band
 
-        if hints.count_only and not hints.sampling:
+        if hints.count_only and not hints.sampling and not has_band:
             total = int(np.asarray(jnp.sum(dev_mask, dtype=jnp.int32)))
             return QueryResult("count", count=total), total, t_scan
 
@@ -339,6 +354,14 @@ class QueryPlanner:
         # host-mask paths (stats/bin/features): one transfer, then the same
         # single-batch aggregation the scan path uses
         mask = np.asarray(dev_mask)
+        if has_band:
+            # refine patches band rows with the pure-filter f64 value, so
+            # re-AND the partition-allowed component it cannot know about
+            mask = plan.compiled.refine(mask, sb.dev, sb.batch)
+            mask &= allowed[np.asarray(sb.pids)]
+        if hints.count_only and not hints.sampling:
+            total = int(mask.sum())
+            return QueryResult("count", count=total), total, t_scan
         total = int(mask.sum())
         if total == 0:
             return self._empty_result(hints, query), 0, t_scan

@@ -12,17 +12,29 @@ the mesh/pjit layer, and this module supplies the SQL surface:
             "WHERE st_intersects(geom, st_geomFromWKT('POLYGON(...)')) "
             "AND score > 0 ORDER BY score DESC LIMIT 10")
 
-Supported: SELECT cols|*|COUNT(*), WHERE with AND/OR/NOT over st_intersects/
-st_within/st_contains/st_dwithin/st_bbox + comparisons/BETWEEN/IN/LIKE
-(datetime-typed comparisons are translated to temporal predicates), ORDER
-BY, LIMIT. Predicates that cannot be pushed (e.g. computed st_area(geom) in
-WHERE) raise with a clear message rather than silently full-scanning.
+Supported: SELECT cols|*|aggregates (COUNT(*)/COUNT(col)/SUM/MIN/MAX/AVG,
+with AS aliases), WHERE with AND/OR/NOT over st_intersects/st_within/
+st_contains/st_dwithin/st_bbox + comparisons/BETWEEN/IN/LIKE (datetime-typed
+comparisons are translated to temporal predicates), GROUP BY, ORDER BY,
+LIMIT.
+
+Non-pushable scalar predicates (e.g. `st_area(geom) > 2` in WHERE) follow
+the reference's LocalQueryRunner contract (SURVEY.md:219): push what the
+index can answer, evaluate the rest as a local post-filter over the fetched
+rows — restricted to top-level AND conjuncts (under OR/NOT the index part
+would be unsound, so those still raise).
+
+GROUP BY aggregation runs on DEVICE: group ids are factorized host-side,
+then each aggregate is one masked segment reduction (engine.stats
+grouped_*) — the TPU formulation of the reference's Spark-side aggregation
+(SURVEY.md:381-383).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import re
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -107,6 +119,25 @@ _SPATIAL_FNS = {
     "ST_EQUALS": ("EQUALS", "EQUALS"),
 }
 
+_AGG_FNS = ("COUNT", "SUM", "MIN", "MAX", "AVG")
+
+
+@dataclasses.dataclass
+class _SelectItem:
+    kind: str  # "col" | "count" | "count_col" | "sum" | "min" | "max" | "avg"
+    col: Optional[str]  # None for COUNT(*)
+    alias: str
+
+
+@dataclasses.dataclass
+class _Where:
+    """A parsed WHERE: the index-pushable CQL part + host-evaluated
+    residual conjuncts (LocalQueryRunner split, SURVEY.md:219)."""
+
+    cql: ast.Filter
+    host: List[Callable]  # each: FeatureBatch -> bool [N]
+    host_desc: List[str]
+
 
 class SqlContext:
     """Execute SQL SELECTs against a DataStore-shaped catalog."""
@@ -120,14 +151,24 @@ class SqlContext:
         """Run a SELECT; returns QueryResult (features/count)."""
         toks = _Tokens(text.strip().rstrip(";"))
         toks.expect_word("SELECT")
-        cols, is_count = self._select_list(toks)
+        items = self._select_list(toks)
         toks.expect_word("FROM")
         table = toks.next()[1]
         sft = self.ds.get_schema(table)
 
-        where: ast.Filter = ast.Include()
+        where = _Where(ast.Include(), [], [])
         if toks.accept_word("WHERE"):
             where = self._expr(toks, sft)
+        group_by: Optional[List[str]] = None
+        if toks.accept_word("GROUP"):
+            toks.expect_word("BY")
+            group_by = [toks.next()[1]]
+            while toks.peek() == ("punct", ","):
+                toks.next()
+                group_by.append(toks.next()[1])
+            for c in group_by:
+                if c not in sft:
+                    raise SqlError(f"unknown GROUP BY column {c!r}")
         sort_by = None
         if toks.accept_word("ORDER"):
             toks.expect_word("BY")
@@ -139,37 +180,120 @@ class SqlContext:
             raise SqlError(f"trailing tokens at {toks.peek()}")
 
         src = self.ds.get_feature_source(table)
-        q = Query(
-            table,
-            where,
-            attributes=cols,
-            sort_by=sort_by,
-            max_features=limit,
+        has_aggs = items is not None and any(
+            it.kind != "col" for it in items
         )
-        if is_count:
-            from geomesa_tpu.plan.planner import QueryResult
+        if group_by is not None and not has_aggs:
+            raise SqlError("GROUP BY requires aggregate select items")
+        if has_aggs:
+            for it in items:
+                if it.kind == "col" and (
+                    group_by is None or it.col not in group_by
+                ):
+                    raise SqlError(
+                        f"column {it.col!r} must appear in GROUP BY"
+                    )
 
+        from geomesa_tpu.plan.planner import QueryResult
+
+        # fast path: bare COUNT(*) with fully-pushable WHERE rides the
+        # store's count machinery (estimate shortcuts included)
+        if (
+            has_aggs
+            and group_by is None
+            and len(items) == 1
+            and items[0].kind == "count"
+            and not where.host
+        ):
+            q = Query(table, where.cql, max_features=limit)
             return QueryResult("count", count=src.get_count(q))
-        return src.get_features(q)
+
+        if has_aggs:
+            needed = None
+            if not where.host:
+                # fetch only the columns the aggregation reads (host
+                # predicates would need arbitrary columns, so only the
+                # fully-pushed case projects)
+                names = list(group_by or [])
+                names += [it.col for it in items if it.col is not None]
+                needed = sorted(set(names)) or None
+            q = Query(table, where.cql, attributes=needed)
+            r = src.get_features(q)
+            batch = r.features
+            if batch is not None and where.host:
+                batch = self._apply_host(batch, where)
+            result = self._aggregate(sft, batch, items, group_by)
+            result = _sort_limit_batch(result, sort_by, limit)
+            return QueryResult(
+                "features", features=result, count=len(result)
+            )
+
+        cols = [it.col for it in items] if items is not None else None
+        if not where.host:
+            q = Query(
+                table, where.cql, attributes=cols,
+                sort_by=sort_by, max_features=limit,
+            )
+            return src.get_features(q)
+        # local post-filter path: fetch unlimited (the limit applies to
+        # post-filter survivors), all attributes (the host predicates may
+        # read columns the projection would drop), project afterwards
+        q = Query(table, where.cql, sort_by=sort_by)
+        r = src.get_features(q)
+        batch = r.features
+        if batch is None or not len(batch):
+            return r
+        batch = self._apply_host(batch, where)
+        if limit is not None and len(batch) > limit:
+            batch = batch.select(np.arange(limit))
+        if cols:
+            batch = _project(batch, cols)
+        return QueryResult("features", features=batch, count=len(batch))
+
+    def _apply_host(self, batch, where: _Where):
+        m = np.ones(len(batch), bool)
+        for hp in where.host:
+            m &= np.asarray(hp(batch), bool)
+        return batch.select(np.nonzero(m)[0])
 
     # -- parsing -----------------------------------------------------------
 
-    def _select_list(self, toks: _Tokens):
+    def _select_list(self, toks: _Tokens) -> Optional[List[_SelectItem]]:
         t = toks.peek()
-        if t and t[0] == "word" and t[1].upper() == "COUNT":
-            toks.next()
-            toks.expect_punct("(")
-            toks.expect_punct("*")
-            toks.expect_punct(")")
-            return None, True
         if t and t[0] == "punct" and t[1] == "*":
             toks.next()
-            return None, False
-        cols = [toks.next()[1]]
-        while toks.peek() == ("punct", ","):
+            return None
+        items: List[_SelectItem] = []
+        while True:
+            items.append(self._select_item(toks))
+            if toks.peek() == ("punct", ","):
+                toks.next()
+                continue
+            return items
+
+    def _select_item(self, toks: _Tokens) -> _SelectItem:
+        t = toks.next()
+        if t[0] != "word":
+            raise SqlError(f"expected select item, got {t}")
+        up = t[1].upper()
+        if up in _AGG_FNS and toks.peek() == ("punct", "("):
             toks.next()
-            cols.append(toks.next()[1])
-        return cols, False
+            if toks.peek() == ("punct", "*"):
+                toks.next()
+                toks.expect_punct(")")
+                if up != "COUNT":
+                    raise SqlError(f"{up}(*) is not valid SQL")
+                item = _SelectItem("count", None, "count")
+            else:
+                col = toks.next()[1]
+                toks.expect_punct(")")
+                kind = "count_col" if up == "COUNT" else up.lower()
+                item = _SelectItem(kind, col, f"{up.lower()}_{col}")
+        else:
+            item = _SelectItem("col", t[1], t[1])
+        if toks.accept_word("AS"):
+            item.alias = toks.next()[1]
+        return item
 
     def _order_list(self, toks: _Tokens):
         out = []
@@ -186,23 +310,40 @@ class SqlContext:
                 continue
             return out
 
-    def _expr(self, toks: _Tokens, sft) -> ast.Filter:
+    def _expr(self, toks: _Tokens, sft) -> _Where:
         left = self._and_expr(toks, sft)
         while toks.accept_word("OR"):
             right = self._and_expr(toks, sft)
-            left = ast.Or((left, right))
+            if left.host or right.host:
+                raise SqlError(
+                    "OR over a non-pushable predicate "
+                    f"({(left.host_desc + right.host_desc)[0]}) cannot ride "
+                    "the index; restructure as top-level AND conjuncts"
+                )
+            left = _Where(ast.Or((left.cql, right.cql)), [], [])
         return left
 
-    def _and_expr(self, toks: _Tokens, sft) -> ast.Filter:
+    def _and_expr(self, toks: _Tokens, sft) -> _Where:
         left = self._not_expr(toks, sft)
         while toks.accept_word("AND"):
             right = self._not_expr(toks, sft)
-            left = ast.And((left, right))
+            left = _Where(
+                ast.And((left.cql, right.cql)),
+                left.host + right.host,
+                left.host_desc + right.host_desc,
+            )
         return left
 
-    def _not_expr(self, toks: _Tokens, sft) -> ast.Filter:
+    def _not_expr(self, toks: _Tokens, sft) -> _Where:
         if toks.accept_word("NOT"):
-            return ast.Not(self._not_expr(toks, sft))
+            inner = self._not_expr(toks, sft)
+            if inner.host:
+                raise SqlError(
+                    "NOT over a non-pushable predicate "
+                    f"({inner.host_desc[0]}) cannot ride the index; "
+                    "restructure as top-level AND conjuncts"
+                )
+            return _Where(ast.Not(inner.cql), [], [])
         if toks.peek() == ("punct", "("):
             save = toks.i
             toks.next()
@@ -214,21 +355,18 @@ class SqlContext:
                 toks.i = save  # not a parenthesized boolean; re-parse
         return self._predicate(toks, sft)
 
-    def _predicate(self, toks: _Tokens, sft) -> ast.Filter:
+    def _predicate(self, toks: _Tokens, sft) -> _Where:
         t = toks.peek()
         if t is None:
             raise SqlError("expected predicate")
         if t[0] == "word" and t[1].upper() in _SPATIAL_FNS:
-            return self._spatial(toks, sft)
+            return _Where(self._spatial(toks, sft), [], [])
         if t[0] == "word" and t[1].upper() == "ST_DWITHIN":
-            return self._dwithin(toks, sft)
+            return _Where(self._dwithin(toks, sft), [], [])
         if t[0] == "word" and t[1].upper().startswith("ST_"):
-            raise SqlError(
-                f"{t[1]} is not pushable in WHERE — only spatial relation "
-                "predicates (st_intersects/st_within/st_contains/st_dwithin/"
-                "...) can ride the index; compute expressions belong in "
-                "client code via geomesa_tpu.sql functions"
-            )
+            # scalar st_* expression: evaluate as a LOCAL post-filter
+            # (push-what-you-can contract; SURVEY.md:219 LocalQueryRunner)
+            return self._host_predicate(toks, sft)
         # column predicate
         col = toks.next()[1]
         if col not in sft:
@@ -239,11 +377,11 @@ class SqlContext:
             toks.expect_word("AND")
             hi = self._literal(toks, is_temporal)
             if is_temporal:
-                return ast.And((
+                return _Where(ast.And((
                     ast.Comparison(">=", ast.Property(col), lo),
                     ast.Comparison("<=", ast.Property(col), hi),
-                ))
-            return ast.Between(ast.Property(col), lo, hi)
+                )), [], [])
+            return _Where(ast.Between(ast.Property(col), lo, hi), [], [])
         if toks.accept_word("IN"):
             toks.expect_punct("(")
             vals = [self._literal(toks, is_temporal).value]
@@ -251,22 +389,25 @@ class SqlContext:
                 toks.next()
                 vals.append(self._literal(toks, is_temporal).value)
             toks.expect_punct(")")
-            return ast.In(ast.Property(col), tuple(vals))
+            return _Where(ast.In(ast.Property(col), tuple(vals)), [], [])
         if toks.accept_word("LIKE"):
             s = toks.next()
             if s[0] != "string":
                 raise SqlError("LIKE needs a string pattern")
-            return ast.Like(ast.Property(col), s[1][1:-1].replace("''", "'"))
+            return _Where(
+                ast.Like(ast.Property(col), s[1][1:-1].replace("''", "'")),
+                [], [],
+            )
         if toks.accept_word("IS"):
             negate = bool(toks.accept_word("NOT"))
             toks.expect_word("NULL")
-            return ast.IsNull(ast.Property(col), negate=negate)
+            return _Where(ast.IsNull(ast.Property(col), negate=negate), [], [])
         op_t = toks.next()
         if op_t[0] != "op":
             raise SqlError(f"expected operator after {col}, got {op_t}")
         op = "<>" if op_t[1] == "!=" else op_t[1]
         lit = self._literal(toks, is_temporal)
-        return ast.Comparison(op, ast.Property(col), lit)
+        return _Where(ast.Comparison(op, ast.Property(col), lit), [], [])
 
     def _literal(self, toks: _Tokens, temporal: bool) -> ast.Literal:
         t = toks.next()
@@ -356,3 +497,278 @@ class SqlContext:
             raise SqlError("st_dwithin needs one column and one literal")
         # distance in meters (GeoMesa's geomesa-spark st_dwithin contract)
         return ast.DistancePredicate("DWITHIN", ast.Property(prop), geom, dist)
+
+    # -- host (non-pushable) scalar predicates ------------------------------
+
+    def _host_predicate(self, toks: _Tokens, sft) -> _Where:
+        """`st_fn(args) op literal` evaluated per row on host (the local
+        post-filter leg of the LocalQueryRunner split)."""
+        start = toks.i
+        expr = self._host_expr(toks, sft)
+        op_t = toks.next()
+        if op_t[0] != "op":
+            raise SqlError(
+                f"expected comparison after scalar st_* expression, got {op_t}"
+            )
+        op = "<>" if op_t[1] == "!=" else op_t[1]
+        lit_t = toks.next()
+        if lit_t[0] == "number":
+            lit = float(lit_t[1])
+        elif lit_t[0] == "string":
+            lit = lit_t[1][1:-1].replace("''", "'")
+        else:
+            raise SqlError(f"expected literal, got {lit_t}")
+        desc = " ".join(t[1] for t in toks.toks[start:toks.i])
+        ops = {
+            "=": lambda a, b: a == b, "<>": lambda a, b: a != b,
+            "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+            ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+        }
+
+        def pred(batch):
+            vals = np.array([expr(batch, i) for i in range(len(batch))])
+            return ops[op](vals, lit)
+
+        return _Where(ast.Include(), [pred], [desc])
+
+    def _host_expr(self, toks: _Tokens, sft):
+        """Parse one scalar/geometry expression into a callable
+        (batch, row) -> value. Supports st_* function calls (from
+        sql.functions), geometry/numeric column refs, and literals."""
+        from geomesa_tpu.sql.functions import FUNCTIONS
+
+        by_upper = {k.upper(): v for k, v in FUNCTIONS.items()}
+        t = toks.next()
+        if t[0] == "number":
+            v = float(t[1])
+            return lambda batch, i, v=v: v
+        if t[0] == "string":
+            s = t[1][1:-1].replace("''", "'")
+            return lambda batch, i, s=s: s
+        if t[0] != "word":
+            raise SqlError(f"expected expression, got {t}")
+        up = t[1].upper()
+        if up in by_upper and toks.peek() == ("punct", "("):
+            fn = by_upper[up]
+            toks.next()
+            args = []
+            if toks.peek() != ("punct", ")"):
+                args.append(self._host_expr(toks, sft))
+                while toks.peek() == ("punct", ","):
+                    toks.next()
+                    args.append(self._host_expr(toks, sft))
+            toks.expect_punct(")")
+
+            def call(batch, i, fn=fn, args=tuple(args)):
+                return fn(*(a(batch, i) for a in args))
+
+            return call
+        if t[1] in sft:
+            name = t[1]
+            attr = sft.attribute(name)
+            if attr.is_geometry:
+                def geom_ref(batch, i, n=name):
+                    return batch.columns[n].geometry(i)
+                return geom_ref
+
+            def col_ref(batch, i, n=name):
+                from geomesa_tpu.core.columnar import DictColumn
+
+                col = batch.columns[n]
+                if isinstance(col, DictColumn):
+                    c = col.codes[i]
+                    return col.vocab[c] if c >= 0 else None
+                return col[i]
+
+            return col_ref
+        raise SqlError(f"unknown function or column {t[1]!r}")
+
+    # -- aggregation (device segment reductions) ----------------------------
+
+    def _aggregate(self, sft, batch, items, group_by):
+        """GROUP BY execution: factorize group keys host-side, run each
+        aggregate as one masked device segment reduction, assemble a
+        result FeatureBatch whose schema mirrors the select list."""
+        import jax.numpy as jnp
+
+        from geomesa_tpu.core.columnar import DictColumn, FeatureBatch
+        from geomesa_tpu.core.sft import SimpleFeatureType
+        from geomesa_tpu.engine.stats import (
+            grouped_count, grouped_max, grouped_min, grouped_sum)
+
+        n = len(batch) if batch is not None else 0
+        group_by = group_by or []
+
+        # factorize each key column, then combine into one group id
+        key_codes: List[np.ndarray] = []
+        key_decode: List = []  # per key: array of group-representative values
+        if n:
+            for col_name in group_by:
+                col = batch.columns[col_name]
+                if isinstance(col, DictColumn):
+                    uniq, inv = np.unique(col.codes, return_inverse=True)
+                    vals = np.array(
+                        [col.vocab[c] if c >= 0 else None for c in uniq],
+                        dtype=object,
+                    )
+                else:
+                    uniq, inv = np.unique(
+                        np.asarray(col), return_inverse=True
+                    )
+                    vals = uniq
+                key_codes.append(inv)
+                key_decode.append(vals)
+            if key_codes:
+                combined = key_codes[0].astype(np.int64)
+                sizes = [len(v) for v in key_decode]
+                for c, sz in zip(key_codes[1:], sizes[1:]):
+                    combined = combined * sz + c
+                gkeys, gids = np.unique(combined, return_inverse=True)
+                ngroups = len(gkeys)
+                # per-key value index for each group
+                key_of_group: List[np.ndarray] = []
+                rem = gkeys.copy()
+                for sz, vals in zip(reversed(sizes), reversed(key_decode)):
+                    key_of_group.append(vals[rem % sz])
+                    rem //= sz
+                key_of_group.reverse()
+            else:
+                gids = np.zeros(n, np.int64)
+                ngroups = 1
+                key_of_group = []
+        else:
+            gids = np.zeros(0, np.int64)
+            ngroups = 0 if group_by else 1
+            key_of_group = [np.array([], dtype=object) for _ in group_by]
+
+        # pow2-pad rows AND groups so the jitted segment kernels keep a
+        # bounded shape-cache across queries (same policy as the planner's
+        # scan path); padded rows carry gid 0 with a False mask
+        from geomesa_tpu.utils.padding import next_pow2
+
+        np_pad = next_pow2(max(n, 1)) - n
+        G = next_pow2(max(ngroups, 1))
+        jg = jnp.asarray(
+            np.concatenate([gids, np.zeros(np_pad, np.int64)]), jnp.int32
+        )
+        row_valid = jnp.asarray(
+            np.concatenate([np.ones(n, bool), np.zeros(np_pad, bool)])
+        )
+
+        def numeric(col_name):
+            col = batch.columns[col_name]
+            if isinstance(col, DictColumn):
+                raise SqlError(
+                    f"cannot aggregate string column {col_name!r}"
+                )
+            arr = np.asarray(col)
+            return jnp.asarray(
+                np.concatenate(
+                    [arr, np.zeros(np_pad, arr.dtype)]
+                )
+            )
+
+        def nonnull_mask(col_name):
+            """SQL aggregates skip NULLs (NaN doubles / -1 dict codes)."""
+            col = batch.columns[col_name]
+            if isinstance(col, DictColumn):
+                m = col.codes >= 0
+            else:
+                arr = np.asarray(col)
+                m = ~np.isnan(arr) if arr.dtype.kind == "f" else np.ones(n, bool)
+            return jnp.asarray(np.concatenate([m, np.zeros(np_pad, bool)]))
+
+        out_cols: dict = {}
+        spec_parts: List[str] = []
+        for it in items:
+            if it.kind == "col":
+                vals = key_of_group[group_by.index(it.col)]
+                a = sft.attribute(it.col)
+                spec_parts.append(f"{it.alias}:{a.type}")
+                out_cols[it.alias] = (
+                    vals.tolist() if vals.dtype == object else vals
+                )
+                continue
+            if n == 0:
+                # empty set: COUNT = 0, every other aggregate is NULL (NaN)
+                res = (
+                    np.zeros(ngroups, np.float64)
+                    if it.kind in ("count", "count_col")
+                    else np.full(ngroups, np.nan)
+                )
+            elif it.kind == "count":
+                res = np.asarray(grouped_count(jg, row_valid, G))[:ngroups]
+            elif it.kind == "count_col":
+                res = np.asarray(
+                    grouped_count(jg, nonnull_mask(it.col), G)
+                )[:ngroups]
+            elif it.kind in ("sum", "min", "max", "avg"):
+                nn = nonnull_mask(it.col)
+                v = numeric(it.col)
+                c = np.asarray(grouped_count(jg, nn, G))[:ngroups]
+                if it.kind == "sum":
+                    res = np.asarray(grouped_sum(v, jg, nn, G))[:ngroups]
+                elif it.kind == "min":
+                    res = np.asarray(grouped_min(v, jg, nn, G))[:ngroups]
+                elif it.kind == "max":
+                    res = np.asarray(grouped_max(v, jg, nn, G))[:ngroups]
+                else:
+                    s = np.asarray(grouped_sum(v, jg, nn, G))[:ngroups]
+                    res = np.where(c > 0, s / np.maximum(c, 1), np.nan)
+                # all-NULL group: SUM/MIN/MAX of an empty set is NULL, not
+                # 0 / +-inf
+                res = np.where(c > 0, res, np.nan)
+            else:  # pragma: no cover
+                raise SqlError(f"unknown aggregate {it.kind}")
+            if it.kind in ("count", "count_col"):
+                spec_parts.append(f"{it.alias}:Long")
+                res = res.astype(np.int64)
+            else:
+                spec_parts.append(f"{it.alias}:Double")
+                res = res.astype(np.float64)
+            out_cols[it.alias] = res
+
+        rsft = SimpleFeatureType.from_spec("result", ",".join(spec_parts))
+        return FeatureBatch.from_pydict(rsft, out_cols)
+
+
+def _project(batch, cols: List[str]):
+    """Column projection of a FeatureBatch (schema + columns subset)."""
+    from geomesa_tpu.core.sft import SimpleFeatureType
+
+    attrs = [batch.sft.attribute(c) for c in cols]
+    sub = SimpleFeatureType(batch.sft.name, list(attrs), batch.sft.user_data)
+    from geomesa_tpu.core.columnar import FeatureBatch
+
+    return FeatureBatch(
+        sub, {c: batch.columns[c] for c in cols}, batch.fids, batch.valid
+    )
+
+
+def _sort_limit_batch(batch, sort_by, limit):
+    """ORDER BY / LIMIT over a small host-side result batch (aggregate
+    outputs; the feature path sorts inside the store instead). Stable
+    multi-key: apply keys least-significant first; descending keys sort
+    by negated dense rank so stability is preserved."""
+    from geomesa_tpu.core.columnar import DictColumn
+
+    if sort_by and len(batch):
+        order = np.arange(len(batch))
+        for col, asc in reversed(sort_by):
+            c = batch.columns[col]
+            arr = (
+                np.array(["" if v is None else str(v) for v in c.decode()])
+                if isinstance(c, DictColumn)
+                else np.asarray(c)
+            )
+            sub = arr[order]
+            if asc:
+                idx = np.argsort(sub, kind="stable")
+            else:
+                ranks = np.unique(sub, return_inverse=True)[1]
+                idx = np.argsort(-ranks, kind="stable")
+            order = order[idx]
+        batch = batch.select(order)
+    if limit is not None and len(batch) > limit:
+        batch = batch.select(np.arange(limit))
+    return batch

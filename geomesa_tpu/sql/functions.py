@@ -31,6 +31,8 @@ __all__ = [
     "st_area",
     "st_asText",
     "st_bbox",
+    "st_buffer",
+    "st_bufferPoint",
     "st_castToGeometry",
     "st_centroid",
     "st_contains",
@@ -424,6 +426,241 @@ def st_translate(g: Geometry, dx: float, dy: float) -> Geometry:
         return _mk_point(x + dx, y + dy)
     rings = [np.asarray(r, np.float64) + np.array([dx, dy]) for r in g.rings]
     return Geometry(g.kind, rings)
+
+
+def st_bufferPoint(g: Geometry, distance_m: float, segments: int = 64) -> Geometry:
+    """Geodesic buffer around a point, in meters (upstream: spark-jts
+    st_bufferPoint — SURVEY.md:378). Vertices via the spherical
+    destination-point formula, so the ring is correct at any latitude
+    (a naive lon/lat circle degenerates toward the poles)."""
+    x, y = g.point
+    lat1 = math.radians(y)
+    lon1 = math.radians(x)
+    ang = distance_m / EARTH_RADIUS_M
+    th = np.linspace(0.0, 2.0 * math.pi, segments, endpoint=False)
+    lat2 = np.arcsin(
+        math.sin(lat1) * math.cos(ang)
+        + math.cos(lat1) * math.sin(ang) * np.cos(th)
+    )
+    lon2 = lon1 + np.arctan2(
+        np.sin(th) * math.sin(ang) * math.cos(lat1),
+        math.cos(ang) - math.sin(lat1) * np.sin(lat2),
+    )
+    ring = np.stack([np.degrees(lon2), np.degrees(lat2)], 1)
+    ring = np.concatenate([ring, ring[:1]], 0)
+    return Geometry("Polygon", [ring])
+
+
+def st_buffer(g: Geometry, d: float, resolution: int = 96) -> Geometry:
+    """Buffer in planar degrees (JTS st_buffer parity — SURVEY.md:378).
+
+    TPU-era formulation: instead of JTS's offset-curve + union machinery,
+    the buffer is the d-level contour of the geometry's signed distance
+    field, extracted by marching squares with linear interpolation. One
+    algorithm covers every kind (multi-parts and overlapping circles union
+    naturally), negative d shrinks polygons, and degenerate inputs can
+    only yield empty output — never a crash or a self-intersecting mess.
+    Accuracy: ~extent/resolution per coordinate (resolution is the
+    quadrantSegments-style knob)."""
+    if not g.rings:
+        return Geometry("Polygon", [])
+    verts = _vertices(g)
+    if len(verts) == 0:
+        return Geometry("Polygon", [])
+    if d <= 0 and g.kind not in ("Polygon", "MultiPolygon"):
+        return Geometry("Polygon", [])  # only areas can shrink
+    if g.is_point and len(verts) == 1:
+        # exact K-gon circle fast path
+        th = np.linspace(0.0, 2.0 * math.pi, 64, endpoint=False)
+        ring = np.stack(
+            [verts[0, 0] + d * np.cos(th), verts[0, 1] + d * np.sin(th)], 1
+        )
+        ring = np.concatenate([ring, ring[:1]], 0)
+        return Geometry("Polygon", [ring])
+
+    x0, y0, x1, y1 = g.bbox
+    pad = abs(d) * 1.05 + 1e-9
+    ex = max(x1 - x0, 1e-9) + 2 * pad
+    ey = max(y1 - y0, 1e-9) + 2 * pad
+    cell = max(ex, ey) / resolution
+    xs = np.arange(x0 - pad, x1 + pad + cell, cell)
+    ys = np.arange(y0 - pad, y1 + pad + cell, cell)
+    gx, gy = np.meshgrid(xs, ys)
+    px, py = gx.ravel(), gy.ravel()
+    field = _planar_distance(px, py, g).reshape(gy.shape)
+    if g.kind in ("Polygon", "MultiPolygon"):
+        inside = points_in_polygon_np(px, py, g).reshape(gy.shape)
+        field = np.where(inside, -field, field)
+    rings = _marching_squares(field - d, xs, ys)
+    if not rings:
+        return Geometry("Polygon", [])
+    # shells vs holes by containment depth; orient shells CCW, holes CW
+    out: List[np.ndarray] = []
+    parts: List[int] = []
+    depths = []
+    for i, r in enumerate(rings):
+        # containment probe: a VERTEX of the ring (contours are disjoint,
+        # so any vertex represents the whole ring; the centroid would lie
+        # in the hole of an annular ring and misclassify it)
+        c = r[0]
+        depth = 0
+        for j, other in enumerate(rings):
+            if i != j and _point_in_ring(c, other):
+                depth += 1
+        depths.append(depth)
+    def oriented(i):
+        r = rings[i]
+        signed = 0.5 * float(
+            np.sum(r[:-1, 0] * r[1:, 1] - r[1:, 0] * r[:-1, 1])
+        )
+        want_ccw = depths[i] % 2 == 0
+        return r if (signed > 0) == want_ccw else r[::-1]
+
+    shells = [i for i, dp in enumerate(depths) if dp % 2 == 0]
+    holes = [i for i, dp in enumerate(depths) if dp % 2 == 1]
+    for s in shells:
+        out.append(oriented(s))
+        # a hole belongs to shell s iff s contains it one level up
+        mine = [
+            h
+            for h in holes
+            if depths[h] == depths[s] + 1
+            and _point_in_ring(rings[h][0], rings[s])
+        ]
+        for h in mine:
+            out.append(oriented(h))
+        parts.append(1 + len(mine))
+    kind = "MultiPolygon" if len(parts) > 1 else "Polygon"
+    return Geometry(kind, out, parts)
+
+
+def _planar_distance(px: np.ndarray, py: np.ndarray, g: Geometry) -> np.ndarray:
+    """Unsigned planar (degree) distance from points to the geometry's
+    edges/vertices, chunked so the [N, E] block stays bounded."""
+    x1, y1, x2, y2 = polygon_edges(g)
+    if len(x1) == 0:  # point cloud: distance to vertices
+        v = _vertices(g)
+        x1 = x2 = v[:, 0]
+        y1 = y2 = v[:, 1]
+    out = np.empty(len(px), np.float64)
+    step = max(1, (1 << 22) // max(len(x1), 1))
+    ex, ey = x2 - x1, y2 - y1
+    L2 = np.maximum(ex * ex + ey * ey, 1e-30)
+    for s in range(0, len(px), step):
+        qx = px[s : s + step, None]
+        qy = py[s : s + step, None]
+        t = np.clip(((qx - x1) * ex + (qy - y1) * ey) / L2, 0.0, 1.0)
+        cx = x1 + t * ex
+        cy = y1 + t * ey
+        out[s : s + step] = np.sqrt(
+            np.min((qx - cx) ** 2 + (qy - cy) ** 2, axis=1)
+        )
+    return out
+
+
+def _point_in_ring(pt, ring) -> bool:
+    x, y = pt
+    rx, ry = ring[:, 0], ring[:, 1]
+    c = (ry[:-1] <= y) != (ry[1:] <= y)
+    dy = np.where(ry[1:] == ry[:-1], 1.0, ry[1:] - ry[:-1])
+    t = (y - ry[:-1]) / dy
+    xc = rx[:-1] + t * (rx[1:] - rx[:-1])
+    return bool(np.sum(c & (xc > x)) % 2)
+
+
+# marching-squares case table: corner bits (1=SW, 2=SE, 4=NE, 8=NW) ->
+# crossed-edge pairs (undirected; ring orientation is fixed afterwards by
+# shoelace + containment depth). Edges: B(ottom)/R(ight)/T(op)/L(eft).
+_MS_CASES = {
+    1: [("L", "B")], 2: [("B", "R")], 3: [("L", "R")], 4: [("R", "T")],
+    6: [("B", "T")], 7: [("L", "T")], 8: [("T", "L")], 9: [("B", "T")],
+    11: [("R", "T")], 12: [("L", "R")], 13: [("B", "R")], 14: [("L", "B")],
+}
+
+
+def _marching_squares(field: np.ndarray, xs: np.ndarray, ys: np.ndarray):
+    """Closed level-0 contours of `field` (negative = inside) sampled at
+    (ys[i], xs[j]). The caller pads the domain so no contour touches the
+    boundary; rings come back closed (first == last), unoriented."""
+    inside = field < 0
+    H, W = field.shape
+    segs: List[Tuple[tuple, tuple]] = []
+    # cells with a sign change only
+    cellmask = (
+        inside[:-1, :-1] | inside[:-1, 1:] | inside[1:, :-1] | inside[1:, 1:]
+    ) & ~(
+        inside[:-1, :-1] & inside[:-1, 1:] & inside[1:, :-1] & inside[1:, 1:]
+    )
+    for i, j in zip(*np.nonzero(cellmask)):
+        code = (
+            (1 if inside[i, j] else 0)
+            | (2 if inside[i, j + 1] else 0)
+            | (4 if inside[i + 1, j + 1] else 0)
+            | (8 if inside[i + 1, j] else 0)
+        )
+        if code in (5, 10):
+            # saddle: split by center sign
+            center = (
+                field[i, j] + field[i, j + 1] + field[i + 1, j] + field[i + 1, j + 1]
+            ) / 4.0
+            if code == 5:
+                pairs = (
+                    [("L", "T"), ("B", "R")]
+                    if center >= 0
+                    else [("L", "B"), ("R", "T")]
+                )
+            else:
+                pairs = (
+                    [("B", "L"), ("T", "R")]
+                    if center >= 0
+                    else [("B", "R"), ("T", "L")]
+                )
+        else:
+            pairs = _MS_CASES[code]
+        eid = {
+            "B": ("h", i, j),
+            "T": ("h", i + 1, j),
+            "L": ("v", i, j),
+            "R": ("v", i, j + 1),
+        }
+        for a, b in pairs:
+            segs.append((eid[a], eid[b]))
+
+    adj: dict = {}
+    for a, b in segs:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, []).append(a)
+
+    def vertex(e):
+        kind, i, j = e
+        if kind == "h":
+            a, b = field[i, j], field[i, j + 1]
+            t = a / (a - b) if a != b else 0.5
+            return (xs[j] + t * (xs[j + 1] - xs[j]), ys[i])
+        a, b = field[i, j], field[i + 1, j]
+        t = a / (a - b) if a != b else 0.5
+        return (xs[j], ys[i] + t * (ys[i + 1] - ys[i]))
+
+    rings = []
+    visited = set()
+    for start in adj:
+        if start in visited or len(adj[start]) != 2:
+            continue
+        loop = [start]
+        visited.add(start)
+        prev, cur = start, adj[start][0]
+        while cur != start:
+            loop.append(cur)
+            visited.add(cur)
+            nxts = [e for e in adj.get(cur, []) if e != prev]
+            if not nxts:
+                break  # open chain (boundary-clipped): drop it
+            prev, cur = cur, nxts[0]
+        else:
+            pts = np.array([vertex(e) for e in loop] + [vertex(start)])
+            if len(pts) >= 4:
+                rings.append(pts)
+    return rings
 
 
 def st_convexHull(g: Geometry) -> Geometry:

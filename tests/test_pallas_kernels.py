@@ -119,3 +119,97 @@ def test_pip_dense_and_pallas_agree_exact_f64():
         points_in_polygon_pallas(px, py, x1, y1, x2, y2, interpret=True)
     )
     np.testing.assert_array_equal(dense, pallas)
+
+
+# -- borderline band + f64 refinement (SURVEY.md:824-827) -------------------
+
+
+def _near_edge_points(rng, x1, y1, x2, y2, n, offset):
+    """Points within `offset` deg of random edge positions (both sides)."""
+    e = rng.integers(0, len(x1), n)
+    t = rng.uniform(0, 1, n)
+    ex, ey = x2[e] - x1[e], y2[e] - y1[e]
+    L = np.hypot(ex, ey)
+    nx, ny = -ey / L, ex / L  # unit normal
+    side = rng.choice([-1.0, 1.0], n)
+    d = rng.uniform(0, offset, n)
+    px = x1[e] + t * ex + side * d * nx
+    py = y1[e] + t * ey + side * d * ny
+    return px, py
+
+
+@pytest.mark.parametrize("offset", [1e-8, 1e-6])
+def test_band_flags_near_edge_points(offset):
+    """Every point close enough to flip at f32 must be flagged."""
+    from geomesa_tpu.engine.pip import points_in_polygon_band
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    ring = _random_polygon(rng, 24)
+    x1, y1, x2, y2 = _edges_from_rings([ring])
+    px, py = _near_edge_points(rng, x1, y1, x2, y2, 500, offset)
+    flags = np.asarray(
+        points_in_polygon_band(
+            jnp.asarray(px, jnp.float32), jnp.asarray(py, jnp.float32),
+            jnp.asarray(x1), jnp.asarray(y1),
+            jnp.asarray(x2), jnp.asarray(y2),
+        )
+    )
+    assert flags.all(), f"{(~flags).sum()} near-edge points unflagged"
+
+
+def test_band_pallas_matches_lax():
+    from geomesa_tpu.engine.pip import points_in_polygon_band
+    from geomesa_tpu.engine.pip_pallas import points_in_polygon_band_pallas
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(13)
+    ring = _random_polygon(rng, 16)
+    x1, y1, x2, y2 = _edges_from_rings([ring])
+    px = rng.uniform(-12, 12, 700)
+    py = rng.uniform(-12, 12, 700)
+    a = np.asarray(points_in_polygon_band(
+        jnp.asarray(px, jnp.float32), jnp.asarray(py, jnp.float32),
+        jnp.asarray(x1, jnp.float32), jnp.asarray(y1, jnp.float32),
+        jnp.asarray(x2, jnp.float32), jnp.asarray(y2, jnp.float32)))
+    b = np.asarray(points_in_polygon_band_pallas(
+        px, py, x1, y1, x2, y2, interpret=True))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_refined_mask_matches_f64_oracle_adversarial():
+    """The full compiled-filter path with refinement: adversarial points
+    within 1e-8 deg of edges must match the f64 oracle EXACTLY."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.core.wkt import Geometry
+    from geomesa_tpu.cql import compile_filter, parse_cql
+    from geomesa_tpu.cql.hosteval import eval_filter_host
+    from geomesa_tpu.engine.device import to_device
+
+    rng = np.random.default_rng(17)
+    ring = _random_polygon(rng, 24, cx=2.0, cy=45.0, r=3.0)
+    x1, y1, x2, y2 = _edges_from_rings([ring])
+    px, py = _near_edge_points(rng, x1, y1, x2, y2, 400, 1e-8)
+    # plus some clearly in/out points
+    px = np.concatenate([px, rng.uniform(-5, 9, 200)])
+    py = np.concatenate([py, rng.uniform(38, 52, 200)])
+
+    sft = SimpleFeatureType.from_spec("t", "*geom:Point")
+    batch = FeatureBatch.from_pydict(sft, {"geom": np.stack([px, py], 1)})
+    wkt_ring = ", ".join(f"{a:.17g} {b:.17g}" for a, b in ring)
+    f = parse_cql(f"WITHIN(geom, POLYGON(({wkt_ring})))")
+    compiled = compile_filter(f, sft)
+    assert compiled.has_band
+    dev = to_device(batch)  # default f32 coords: the adversarial regime
+    refined = compiled.mask_refined(dev, batch)
+    oracle = eval_filter_host(f, batch)
+    np.testing.assert_array_equal(refined, oracle)
+    # and without refinement the f32 path alone would NOT be exact (guards
+    # against the test silently weakening if dtypes change)
+    raw = np.asarray(compiled.mask(dev, batch))
+    assert (raw != oracle).any()

@@ -105,11 +105,26 @@ class TestSqlEngine:
         f = parse_cql("DWITHIN(geom, POINT (0 0), 2000000, meters)")
         assert r.count == int(eval_filter(f, batch).sum())
 
-    def test_unsupported_compute_predicate_raises(self, tmp_path):
+    def test_compute_predicate_local_fallback(self, tmp_path):
+        # non-pushable scalar st_* predicates post-filter locally
+        # (LocalQueryRunner contract) instead of raising (round-1 weak #7)
         sft, batch, ds = make_store(tmp_path)
         ctx = SqlContext(ds)
-        with pytest.raises(SqlError, match="not pushable"):
-            ctx.sql("SELECT * FROM gdelt WHERE st_area(geom) > 2")
+        r = ctx.sql("SELECT * FROM gdelt WHERE st_area(geom) > 2")
+        assert r.features is None or len(r.features) == 0  # points: area 0
+        r = ctx.sql(
+            "SELECT * FROM gdelt WHERE st_x(geom) > 0 AND score > 0"
+        )
+        exp = int(
+            ((np.asarray(batch.columns["geom"].x) > 0)
+             & (np.asarray(batch.column("score")) > 0)).sum()
+        )
+        assert (0 if r.features is None else len(r.features)) == exp
+        # under OR the index part would be unsound -> still raises clearly
+        with pytest.raises(SqlError, match="OR over a non-pushable"):
+            ctx.sql(
+                "SELECT * FROM gdelt WHERE st_x(geom) > 0 OR score > 0"
+            )
 
     def test_in_like_null(self, tmp_path):
         sft, batch, ds = make_store(tmp_path)
@@ -207,3 +222,162 @@ class TestJobs:
         assert names
         f = parse_cql("score > 0")
         assert sum(out.values()) == int(eval_filter(f, batch).sum())
+
+
+class TestSqlAggregation:
+    """GROUP BY / aggregates via device segment reductions (round-1
+    missing #3; SURVEY.md:381-383)."""
+
+    def _oracle_groups(self, batch, mask=None):
+        actors = np.array(
+            ["" if a is None else a for a in batch.columns["actor"].decode()]
+        )
+        scores = np.asarray(batch.column("score"))
+        if mask is not None:
+            actors, scores = actors[mask], scores[mask]
+        out = {}
+        for a in np.unique(actors):
+            s = scores[actors == a]
+            out[a] = (len(s), s.sum(), s.min(), s.max(), s.mean())
+        return out
+
+    def test_group_by_aggregates_parity(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT actor, COUNT(*), SUM(score), MIN(score), MAX(score), "
+            "AVG(score) AS mean_score FROM gdelt GROUP BY actor "
+            "ORDER BY actor"
+        )
+        t = r.features
+        exp = self._oracle_groups(batch)
+        assert len(t) == len(exp)
+        actors = t.columns["actor"].decode()
+        assert actors == sorted(exp)
+        for i, a in enumerate(actors):
+            cnt, s, lo, hi, mean = exp[a]
+            assert int(np.asarray(t.column("count"))[i]) == cnt
+            np.testing.assert_allclose(
+                np.asarray(t.column("sum_score"))[i], s, rtol=1e-9)
+            np.testing.assert_allclose(
+                np.asarray(t.column("min_score"))[i], lo, rtol=1e-9)
+            np.testing.assert_allclose(
+                np.asarray(t.column("max_score"))[i], hi, rtol=1e-9)
+            np.testing.assert_allclose(
+                np.asarray(t.column("mean_score"))[i], mean, rtol=1e-9)
+
+    def test_group_by_with_where_and_order_limit(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT actor, COUNT(*) AS n FROM gdelt WHERE score > 0 "
+            "GROUP BY actor ORDER BY n DESC LIMIT 2"
+        )
+        t = r.features
+        mask = np.asarray(batch.column("score")) > 0
+        exp = self._oracle_groups(batch, mask)
+        counts = sorted((c for c, *_ in exp.values()), reverse=True)[:2]
+        assert np.asarray(t.column("n")).tolist() == counts
+
+    def test_global_aggregates_single_row(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT COUNT(*) AS n, AVG(score) AS m FROM gdelt"
+        )
+        t = r.features
+        assert len(t) == 1
+        assert int(np.asarray(t.column("n"))[0]) == len(batch)
+        np.testing.assert_allclose(
+            np.asarray(t.column("m"))[0],
+            np.asarray(batch.column("score")).mean(),
+            rtol=1e-9,
+        )
+
+    def test_group_by_multi_key(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path, n=300, seed=5)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT actor, COUNT(*) AS n FROM gdelt "
+            "WHERE st_intersects(geom, st_makeBBOX(-100, -60, 100, 60)) "
+            "GROUP BY actor ORDER BY actor"
+        )
+        t = r.features
+        f = parse_cql("BBOX(geom, -100, -60, 100, 60)")
+        mask = eval_filter(f, batch)
+        exp = self._oracle_groups(batch, mask)
+        got = dict(zip(t.columns["actor"].decode(),
+                       np.asarray(t.column("n")).tolist()))
+        assert got == {a: c for a, (c, *_) in exp.items()}
+
+    def test_bare_column_outside_group_by_rejected(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        with pytest.raises(SqlError, match="must appear in GROUP BY"):
+            ctx.sql("SELECT score, COUNT(*) FROM gdelt GROUP BY actor")
+
+    def test_sum_of_string_rejected(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        with pytest.raises(SqlError, match="cannot aggregate string"):
+            ctx.sql("SELECT SUM(actor) FROM gdelt")
+
+
+class TestStBuffer:
+    def test_buffer_in_where_via_pushdown(self, tmp_path):
+        # st_buffer literal feeds a pushable spatial predicate
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        from geomesa_tpu.sql.functions import st_buffer, st_point, st_asText
+
+        poly = st_buffer(st_point(0.0, 0.0), 40.0)
+        r = ctx.sql(
+            "SELECT COUNT(*) FROM gdelt WHERE "
+            f"st_within(geom, st_geomFromWKT('{st_asText(poly)}'))"
+        )
+        from geomesa_tpu.engine.pip import points_in_polygon_np
+
+        g = batch.columns["geom"]
+        exp = int(points_in_polygon_np(g.x, g.y, poly).sum())
+        assert abs(r.count - exp) <= max(2, exp // 200)
+
+    def test_null_skipping_and_empty_set_semantics(self, tmp_path):
+        # SQL NULL semantics: NaN doubles are skipped by SUM/MIN/MAX/AVG,
+        # COUNT(col) counts non-null only; empty sets yield NULL (NaN) for
+        # MIN/MAX/AVG and 0 for COUNT (round-2 review findings)
+        rng = np.random.default_rng(9)
+        sft = SimpleFeatureType.from_spec(
+            "t", "actor:String,score:Double,*geom:Point"
+        )
+        scores = np.array([1.0, np.nan, 3.0, np.nan, 5.0])
+        batch = FeatureBatch.from_pydict(
+            sft,
+            {
+                "actor": ["a", "a", "a", "b", "b"],
+                "score": scores,
+                "geom": rng.uniform(-10, 10, (5, 2)),
+            },
+        )
+        ds = DataStore(str(tmp_path / "cat"))
+        ds.create_schema(sft).write(batch)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT actor, COUNT(*) AS n, COUNT(score) AS nn, "
+            "SUM(score) AS s, MIN(score) AS lo, AVG(score) AS m "
+            "FROM t GROUP BY actor ORDER BY actor"
+        )
+        t = r.features
+        assert np.asarray(t.column("n")).tolist() == [3, 2]
+        assert np.asarray(t.column("nn")).tolist() == [2, 1]
+        np.testing.assert_allclose(np.asarray(t.column("s")), [4.0, 5.0])
+        np.testing.assert_allclose(np.asarray(t.column("lo")), [1.0, 5.0])
+        np.testing.assert_allclose(np.asarray(t.column("m")), [2.0, 5.0])
+        # empty set
+        r = ctx.sql(
+            "SELECT COUNT(*) AS n, MIN(score) AS lo, AVG(score) AS m "
+            "FROM t WHERE score > 1000000000"
+        )
+        t = r.features
+        assert int(np.asarray(t.column("n"))[0]) == 0
+        assert np.isnan(np.asarray(t.column("lo"))[0])
+        assert np.isnan(np.asarray(t.column("m"))[0])

@@ -173,3 +173,89 @@ def test_st_touches_line_line():
     assert not sql.st_touches(*cross)  # interiors cross
     assert sql.st_touches(*endpoint)  # endpoint only
     assert not sql.st_touches(*overlap)  # collinear interior overlap
+
+
+class TestStBuffer:
+    """st_buffer = d-level contour of the signed distance field
+    (SURVEY.md:378 processor parity)."""
+
+    def test_point_buffer_area(self):
+        from geomesa_tpu.sql.functions import st_area, st_buffer, st_point
+
+        b = st_buffer(st_point(10.0, 45.0), 2.0)
+        assert b.kind == "Polygon"
+        np.testing.assert_allclose(st_area(b), np.pi * 4, rtol=5e-3)
+
+    def test_line_buffer_capsule(self):
+        from geomesa_tpu.core.wkt import parse_wkt
+        from geomesa_tpu.sql.functions import st_area, st_buffer
+
+        b = st_buffer(parse_wkt("LINESTRING(0 0, 10 0)"), 1.0, resolution=128)
+        np.testing.assert_allclose(st_area(b), 20 + np.pi, rtol=2e-2)
+
+    def test_polygon_grow_shrink(self):
+        from geomesa_tpu.core.wkt import parse_wkt
+        from geomesa_tpu.sql.functions import st_area, st_buffer
+
+        sq = parse_wkt("POLYGON((0 0, 10 0, 10 10, 0 10, 0 0))")
+        np.testing.assert_allclose(
+            st_area(st_buffer(sq, 1.0, resolution=128)),
+            100 + 40 + np.pi, rtol=2e-2,
+        )
+        np.testing.assert_allclose(
+            st_area(st_buffer(sq, -1.0, resolution=128)), 64.0, rtol=2e-2
+        )
+
+    def test_hole_preserved_and_shrunk(self):
+        from geomesa_tpu.core.wkt import parse_wkt
+        from geomesa_tpu.engine.pip import points_in_polygon_np
+        from geomesa_tpu.sql.functions import st_area, st_buffer
+
+        hp = parse_wkt(
+            "POLYGON((0 0, 20 0, 20 20, 0 20, 0 0),"
+            " (8 8, 12 8, 12 12, 8 12, 8 8))"
+        )
+        b = st_buffer(hp, 1.0, resolution=160)
+        exp = 22 * 22 - 4 + np.pi - 4  # grown shell - shrunk 2x2 hole
+        np.testing.assert_allclose(st_area(b), exp, rtol=2e-2)
+        assert not points_in_polygon_np([10.0], [10.0], b)[0]
+        assert points_in_polygon_np([5.0], [-0.5], b)[0]
+
+    def test_multipoint_union_and_disjoint(self):
+        from geomesa_tpu.core.wkt import parse_wkt
+        from geomesa_tpu.sql.functions import st_area, st_buffer
+
+        near = st_buffer(
+            parse_wkt("MULTIPOINT((0 0), (1.5 0))"), 1.0, resolution=128
+        )
+        assert near.kind == "Polygon"  # overlapping circles union
+        th = np.arccos(0.75)
+        lens_area = 2 * (th - 0.75 * np.sin(th))
+        np.testing.assert_allclose(
+            st_area(near), 2 * np.pi - lens_area, rtol=2e-2
+        )
+        far = st_buffer(
+            parse_wkt("MULTIPOINT((0 0), (10 0))"), 1.0, resolution=128
+        )
+        assert far.kind == "MultiPolygon"
+        np.testing.assert_allclose(st_area(far), 2 * np.pi, rtol=2e-2)
+
+    def test_degenerate_inputs_never_crash(self):
+        from geomesa_tpu.core.wkt import Geometry, parse_wkt
+        from geomesa_tpu.sql.functions import st_area, st_buffer
+
+        assert st_area(st_buffer(parse_wkt("LINESTRING(0 0, 1 1)"), -0.5)) == 0
+        assert st_area(st_buffer(Geometry("Polygon", []), 1.0)) == 0
+        # shrink past extinction: empty, not garbage
+        sq = parse_wkt("POLYGON((0 0, 1 0, 1 1, 0 1, 0 0))")
+        assert st_area(st_buffer(sq, -5.0, resolution=64)) == 0
+
+    def test_buffer_point_geodesic_high_latitude(self):
+        from geomesa_tpu.core.wkt import parse_wkt
+        from geomesa_tpu.engine.geodesy import haversine_m_np
+        from geomesa_tpu.sql.functions import st_bufferPoint
+
+        b = st_bufferPoint(parse_wkt("POINT(10 80)"), 10_000)
+        v = b.rings[0][:-1]
+        d = haversine_m_np(v[:, 0], v[:, 1], 10.0, 80.0)
+        np.testing.assert_allclose(d, 10_000, rtol=1e-3)
