@@ -156,6 +156,103 @@ def to_ipc_bytes(batch: FeatureBatch) -> bytes:
     return sink.getvalue()
 
 
+SORT_FIELD_META = b"geomesa.sort.field"
+SORT_REVERSE_META = b"geomesa.sort.reverse"
+
+
+def _sort_key_np(batch: FeatureBatch, field: str) -> np.ndarray:
+    col = batch.columns[field]
+    if isinstance(col, DictColumn):
+        return np.array(["" if v is None else v for v in col.decode()])
+    if isinstance(col, GeometryColumn):
+        raise ValueError("cannot sort arrow deltas by a geometry column")
+    return np.asarray(col)
+
+
+def to_sorted_ipc_bytes(
+    batch: FeatureBatch, sort_field: str, reverse: bool = False
+) -> bytes:
+    """One shard's ArrowScan DELTA batch: rows pre-sorted by `sort_field`,
+    sort recorded in the schema metadata so the client merge can verify
+    and exploit it (upstream: ArrowScan's pre-sorted delta batches merged
+    by DeltaWriter — SURVEY.md:260-262)."""
+    import io
+
+    key = _sort_key_np(batch, sort_field)
+    order = np.argsort(key, kind="stable")
+    if reverse:
+        order = order[::-1]
+    rb = to_arrow(batch.select(order))
+    meta = dict(rb.schema.metadata or {})
+    meta[SORT_FIELD_META] = sort_field.encode()
+    meta[SORT_REVERSE_META] = b"1" if reverse else b"0"
+    schema = rb.schema.with_metadata(meta)
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, schema) as writer:
+        writer.write_batch(rb)
+    return sink.getvalue()
+
+
+def merge_sorted_ipc(streams: List[bytes]) -> bytes:
+    """Client-side DeltaWriter merge: combine per-shard sorted delta
+    streams into ONE globally sorted IPC stream. Dictionaries are re-keyed
+    into a shared vocabulary first (merge_record_batches); the final order
+    comes from a stable mergesort over the concatenated key column, which
+    runs near-linear on the pre-sorted runs the shards provide — the
+    k-way-merge economics of the reference without custom heap code."""
+    import io
+
+    rbs: List[pa.RecordBatch] = []
+    field: Optional[str] = None
+    reverse = False
+    for s in streams:
+        reader = pa.ipc.open_stream(io.BytesIO(s))
+        meta = reader.schema.metadata or {}
+        f = meta.get(SORT_FIELD_META)
+        if f is None:
+            raise ValueError("stream is not a sorted delta (no sort metadata)")
+        f = f.decode()
+        r = meta.get(SORT_REVERSE_META, b"0") == b"1"
+        if field is None:
+            field, reverse = f, r
+        elif (field, reverse) != (f, r):
+            raise ValueError(
+                f"delta sort mismatch: {field!r}/{reverse} vs {f!r}/{r}"
+            )
+        rbs.extend(reader)
+    if field is None:
+        raise ValueError("no delta streams to merge")
+    rbs = [rb for rb in rbs if rb.num_rows]
+    sink = io.BytesIO()
+    if not rbs:
+        # schema-only stream (all shards empty)
+        reader = pa.ipc.open_stream(io.BytesIO(streams[0]))
+        with pa.ipc.new_stream(sink, reader.schema):
+            pass
+        return sink.getvalue()
+    merged = merge_record_batches(rbs)
+    col = merged.column(field)
+    if pa.types.is_dictionary(col.type):
+        key = np.array(
+            ["" if v is None else v for v in col.to_pylist()]
+        )
+    else:
+        key = col.to_numpy(zero_copy_only=False)
+    order = np.argsort(key, kind="stable")  # timsort: merges sorted runs
+    if reverse:
+        order = order[::-1]
+    merged = merged.take(pa.array(order))
+    meta = dict(merged.schema.metadata or {})
+    meta[SORT_FIELD_META] = field.encode()
+    meta[SORT_REVERSE_META] = b"1" if reverse else b"0"
+    schema = merged.schema.with_metadata(meta)
+    with pa.ipc.new_stream(sink, schema) as writer:
+        writer.write_batch(
+            pa.record_batch(merged.columns, schema=schema)
+        )
+    return sink.getvalue()
+
+
 def write_ipc(path: str, batches: Iterable[FeatureBatch]) -> None:
     batches = list(batches)
     if not batches:

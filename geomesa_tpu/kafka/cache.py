@@ -42,6 +42,7 @@ class KafkaFeatureCache:
         expiry_ms: Optional[int] = None,
         xbuckets: int = 360,
         ybuckets: int = 180,
+        index_attrs: Optional[List[str]] = None,
     ):
         self.sft = sft
         self.expiry_ms = expiry_ms
@@ -49,6 +50,19 @@ class KafkaFeatureCache:
         self._rows: Dict[str, Dict[str, object]] = {}
         self._stamps: Dict[str, float] = {}
         self._index: BucketIndex[str] = BucketIndex(xbuckets, ybuckets)
+        # CQEngine-analog attribute hash indexes (SURVEY.md:323-324): for
+        # each indexed attribute, value -> set of fids, so live-layer
+        # equality queries avoid the full snapshot scan
+        if index_attrs is None:
+            index_attrs = [
+                a.name
+                for a in sft.attributes
+                if a.options.get("index", "").lower() in ("true", "full", "join")
+            ]
+        self._attr_index: Dict[str, Dict[object, set]] = {
+            a: {} for a in index_attrs
+        }
+        self.attr_index_hits = 0  # counter: fast-path queries served
         self._listeners: List[Listener] = []
         self._lock = threading.Lock()
         self._snapshot: Optional[FeatureBatch] = None
@@ -66,8 +80,24 @@ class KafkaFeatureCache:
         else:
             raise TypeError(f"not a GeoMessage: {msg!r}")
 
+    def _unindex_attrs(self, fid: str) -> None:
+        """Caller holds the lock. Remove fid's old values from the
+        attribute indexes."""
+        old = self._rows.get(fid)
+        if old is None:
+            return
+        for name, idx in self._attr_index.items():
+            fids = idx.get(old.get(name))
+            if fids is not None:
+                fids.discard(fid)
+                if not fids:
+                    del idx[old.get(name)]
+
     def _upsert(self, fid: str, attrs: Dict[str, object]) -> None:
         with self._lock:
+            self._unindex_attrs(fid)
+            for name, idx in self._attr_index.items():
+                idx.setdefault(attrs.get(name), set()).add(fid)
             self._rows[fid] = attrs
             self._stamps[fid] = time.time()
             if self._geom is not None:
@@ -83,6 +113,7 @@ class KafkaFeatureCache:
 
     def _delete(self, fid: str) -> None:
         with self._lock:
+            self._unindex_attrs(fid)
             existed = self._rows.pop(fid, None) is not None
             self._stamps.pop(fid, None)
             self._index.remove(fid)
@@ -96,6 +127,8 @@ class KafkaFeatureCache:
             self._rows.clear()
             self._stamps.clear()
             self._index.clear()
+            for idx in self._attr_index.values():
+                idx.clear()
             self._snapshot_dirty = True
         self._emit(FeatureEvent("cleared"))
 
@@ -127,6 +160,27 @@ class KafkaFeatureCache:
         with self._lock:
             fids = [fid for fid, _ in self._index.query(bbox)]
             return [(fid, self._rows[fid]) for fid in fids if fid in self._rows]
+
+    @property
+    def indexed_attributes(self) -> List[str]:
+        return sorted(self._attr_index)
+
+    def query_attribute(
+        self, name: str, values
+    ) -> List[Tuple[str, Dict[str, object]]]:
+        """Equality/IN lookup off the attribute hash index — O(matches),
+        no snapshot scan. Raises KeyError for unindexed attributes."""
+        with self._lock:
+            idx = self._attr_index[name]
+            self.attr_index_hits += 1
+            fids: set = set()
+            for v in values:
+                fids |= idx.get(v, set())
+            return [
+                (fid, self._rows[fid])
+                for fid in sorted(fids)
+                if fid in self._rows
+            ]
 
     def snapshot(self) -> Optional[FeatureBatch]:
         """Immutable columnar view of current state (device refresh boundary).

@@ -111,8 +111,63 @@ class KafkaFeatureSource(FeatureSource):
     def write(self, batch: FeatureBatch) -> None:
         self._store.write(self._name, batch)
 
+    def _attr_fast_path(self, query: Query):
+        """Serve `attr = 'v'` / `attr IN (...)` on an INDEXED attribute
+        straight from the cache's hash index (the CQEngine analog,
+        SURVEY.md:323-324) — no snapshot build, no device round trip.
+        Only plain feature fetches qualify; every hint/sort/aggregation
+        falls through to the full planner path."""
+        h = query.hints
+        if (
+            h != type(h)()  # any non-default hint
+            or query.sort_by
+            or query.attributes is not None
+            or self.planner.interceptors  # must not bypass the chain
+            # feature-level visibility rides the planner mask; the index
+            # has no auth awareness, so it must not serve those types
+            or (self.sft.user_data or {}).get("geomesa.vis.attr")
+        ):
+            return None
+        f = query.filter_ast
+        if isinstance(f, ast.Comparison) and f.op == "=":
+            prop, lit = f.left, f.right
+            if isinstance(prop, ast.Literal):
+                prop, lit = lit, prop
+            if not isinstance(prop, ast.Property) or not isinstance(lit, ast.Literal):
+                return None
+            name, values = prop.name, [lit.value]
+        elif isinstance(f, ast.In) and not f.negate:
+            name, values = f.prop.name, list(f.values)
+        else:
+            return None
+        cache = self._store.cache(self._name)
+        if name not in cache._attr_index:
+            return None
+        rows = cache.query_attribute(name, values)
+        from geomesa_tpu.plan.planner import QueryResult
+
+        if not rows:
+            return QueryResult("features", features=None, count=0)
+        sft = self.sft
+        data = {
+            a.name: [row.get(a.name) for _, row in rows]
+            for a in sft.attributes
+        }
+        batch = FeatureBatch.from_pydict(
+            sft, data, fids=[fid for fid, _ in rows]
+        )
+        from geomesa_tpu.plan.runner import finish_features
+
+        batch = finish_features(batch, query)
+        return QueryResult("features", features=batch, count=len(batch))
+
     def get_features(self, query="INCLUDE"):
         self._store.poll(self._name)
+        if isinstance(query, str):
+            query = Query(self.sft.name, query)
+        fast = self._attr_fast_path(query)
+        if fast is not None:
+            return fast
         return super().get_features(query)
 
     def get_count(self, query="INCLUDE") -> int:

@@ -40,6 +40,11 @@ class QueryHints:
     # non-empty shard results always merge
     arrow_encode: bool = False
     arrow_include_fid: bool = True
+    # ArrowScan sorted-delta protocol (upstream ARROW_SORT hints): each
+    # shard emits its batch pre-sorted by this field with the sort stamped
+    # in schema metadata; client-side merge_sorted_ipc verifies + merges
+    arrow_sort_field: Optional[str] = None
+    arrow_sort_reverse: bool = False
 
     # sampling: keep roughly 1-in-n (None = off); optional per-attribute
     sampling: Optional[int] = None
@@ -54,6 +59,14 @@ class QueryHints:
 
     # index override (upstream: QUERY_INDEX)
     query_index: Optional[str] = None
+
+    # security context: the querying user's authorizations (upstream: the
+    # AuthorizationsProvider SPI resolved per request). With a visibility
+    # column configured (sft user_data `geomesa.vis.attr`), features whose
+    # expression these auths do not satisfy are masked out of EVERY result
+    # kind; attributes carrying a `visibility` option are redacted to null
+    # in feature/arrow results (per-attribute visibility, SURVEY.md:464)
+    auths: Tuple[str, ...] = ()
 
     # internal: the caller only needs a match count, so execution may keep
     # every mask on device and fetch a single reduced scalar (set by

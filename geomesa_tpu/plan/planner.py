@@ -232,11 +232,17 @@ class QueryPlanner:
                 if plan.compiled is not None
                 else dev["__valid__"]
             )
+            from geomesa_tpu.plan.runner import visibility_mask
+
             has_band = plan.compiled is not None and plan.compiled.has_band
-            if hints.count_only and not hints.sampling and not has_band:
+            vm = visibility_mask(self.storage.sft, padded, hints)
+            if (
+                hints.count_only and not hints.sampling
+                and not has_band and vm is None
+            ):
                 # device reduction: fetch one scalar instead of the mask
-                # (polygon filters skip this: exact counts need the f64
-                # borderline refinement below)
+                # (polygon filters and visibility skip this: exact counts
+                # need the f64 refinement / auth mask folded below)
                 mask_count = int(np.asarray(jnp.sum(dev_mask, dtype=jnp.int32)))
                 t_done = time.perf_counter()
                 self._record(query, plan, hints, mask_count,
@@ -248,6 +254,10 @@ class QueryPlanner:
                 # (SURVEY.md:824-827); density paths keep the device mask —
                 # grid quantization dwarfs the ~1e-7 deg band
                 mask = plan.compiled.refine(mask, dev, padded)
+            if vm is not None:
+                # feature-level visibility: rows the auths cannot see are
+                # invisible to counts and every aggregation
+                mask = mask & vm
             if hints.count_only and not hints.sampling:
                 mask_count = int(mask.sum())
                 t_done = time.perf_counter()
@@ -331,6 +341,11 @@ class QueryPlanner:
         )
         dev_mask = dev_mask & jnp.asarray(allowed)[sb.pids]
         has_band = plan.compiled is not None and plan.compiled.has_band
+        from geomesa_tpu.plan.runner import visibility_mask
+
+        vm = visibility_mask(self.storage.sft, sb.batch, hints)
+        if vm is not None:
+            dev_mask = dev_mask & jnp.asarray(vm)
 
         if hints.count_only and not hints.sampling and not has_band:
             total = int(np.asarray(jnp.sum(dev_mask, dtype=jnp.int32)))
@@ -356,9 +371,12 @@ class QueryPlanner:
         mask = np.asarray(dev_mask)
         if has_band:
             # refine patches band rows with the pure-filter f64 value, so
-            # re-AND the partition-allowed component it cannot know about
+            # re-AND the partition-allowed + visibility components it
+            # cannot know about
             mask = plan.compiled.refine(mask, sb.dev, sb.batch)
             mask &= allowed[np.asarray(sb.pids)]
+            if vm is not None:
+                mask &= vm
         if hints.count_only and not hints.sampling:
             total = int(mask.sum())
             return QueryResult("count", count=total), total, t_scan
@@ -420,20 +438,27 @@ class QueryPlanner:
         # same hint precedence as runner.aggregate (arrow before bin): the
         # result KIND of a query must not depend on whether it matched rows
         if hints.is_arrow:
-            from geomesa_tpu.core.arrow_io import to_ipc_bytes
+            from geomesa_tpu.core.arrow_io import to_ipc_bytes, to_sorted_ipc_bytes
             from geomesa_tpu.plan.runner import apply_fid_policy, finish_features
 
             sft = self.storage.sft
             # the fid policy + projection make the empty stream's schema
             # identical to non-empty results (client-side shard merges
-            # reject mismatched schemas)
+            # reject mismatched schemas) — sort metadata included, so an
+            # all-empty shard still participates in a delta merge
             empty = FeatureBatch.from_pydict(
                 sft, {a.name: [] for a in sft.attributes}
             )
             if query is not None:
                 empty = finish_features(empty, query)
             empty = apply_fid_policy(empty, hints.arrow_include_fid)
-            return QueryResult("arrow", arrow_bytes=to_ipc_bytes(empty))
+            if hints.arrow_sort_field:
+                payload = to_sorted_ipc_bytes(
+                    empty, hints.arrow_sort_field, hints.arrow_sort_reverse
+                )
+            else:
+                payload = to_ipc_bytes(empty)
+            return QueryResult("arrow", arrow_bytes=payload)
         if hints.is_bin:
             return QueryResult("bin", bin_bytes=b"")
         return QueryResult("features", features=None, count=0)
@@ -441,7 +466,10 @@ class QueryPlanner:
     def _aggregate(self, batch, dev, mask: np.ndarray, query: Query) -> QueryResult:
         from geomesa_tpu.plan.runner import aggregate
 
-        return aggregate(self.storage.sft, batch, dev, mask, query)
+        # the execute paths fold the visibility mask before calling here
+        return aggregate(
+            self.storage.sft, batch, dev, mask, query, fold_visibility=False
+        )
 
     def _run_stats(self, batch, dev, mask: np.ndarray, expression: str):
         from geomesa_tpu.plan.runner import run_stats
@@ -473,6 +501,11 @@ def _needed_columns(query: Query, plan: QueryPlan, sft):
     g = sft.default_geometry
     d = sft.default_dtg
     needed = set()
+    # the visibility column must ALWAYS ride the scan when configured —
+    # dropping it would silently disable the feature-level auth mask
+    vis_attr = (sft.user_data or {}).get("geomesa.vis.attr")
+    if vis_attr:
+        needed.add(vis_attr)
     for node in ast.walk(plan.filter):
         for field in ("prop", "left", "right"):
             v = getattr(node, field, None)
@@ -480,6 +513,8 @@ def _needed_columns(query: Query, plan: QueryPlan, sft):
                 needed.add(v.name)
     if hints.sample_by:
         needed.add(hints.sample_by)
+    if hints.arrow_sort_field:
+        needed.add(hints.arrow_sort_field)
     if hints.is_density:
         needed.add(g.name)
         if hints.density_weight:

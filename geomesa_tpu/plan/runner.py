@@ -94,13 +94,146 @@ def apply_fid_policy(batch: FeatureBatch, include_fid: bool) -> FeatureBatch:
     return batch
 
 
-def aggregate(sft: SimpleFeatureType, batch, dev, mask: np.ndarray, query: "Query"):
-    """Dispatch on hints: density / stats / bin aggregation, else features."""
+VIS_ATTR_KEY = "geomesa.vis.attr"
+
+
+def visibility_mask(sft: SimpleFeatureType, batch, hints) -> "np.ndarray | None":
+    """Feature-level visibility (SURVEY.md C21): when the type configures a
+    visibility column (user_data `geomesa.vis.attr`), compute the per-batch
+    allow bitmask for the query's auths. None when not configured. The
+    allow table costs |vocab| expression evaluations, not |rows|."""
+    vis_attr = (sft.user_data or {}).get(VIS_ATTR_KEY)
+    if not vis_attr or vis_attr not in batch.columns:
+        return None
+    from geomesa_tpu.security.visibility import allow_mask
+
+    col = batch.columns[vis_attr]
+    if not isinstance(col, DictColumn):
+        raise ValueError(
+            f"visibility column {vis_attr!r} must be a String attribute"
+        )
+    return allow_mask(col.vocab, col.codes, hints.auths)
+
+
+def redact_attributes(sel: FeatureBatch, hints) -> FeatureBatch:
+    """Per-attribute visibility (SURVEY.md:464): null out columns whose
+    `visibility` option the query's auths do not satisfy — folded into the
+    result projection, so every feature/arrow export redacts identically."""
+    vis_attrs = [
+        a for a in sel.sft.attributes if a.options.get("visibility")
+    ]
+    if not vis_attrs:
+        return sel
+    import dataclasses
+
+    from geomesa_tpu.core.columnar import GeometryColumn
+    from geomesa_tpu.security.visibility import VisibilityEvaluator
+
+    ev = VisibilityEvaluator()
+    cols = dict(sel.columns)
+    changed = False
+    n = len(sel)
+    for a in vis_attrs:
+        if ev.can_see(a.options["visibility"], hints.auths):
+            continue
+        changed = True
+        col = cols[a.name]
+        if isinstance(col, DictColumn):
+            cols[a.name] = DictColumn(np.full(n, -1, np.int32), [])
+        elif isinstance(col, GeometryColumn):
+            # a redacted geometry keeps its layout kind (arrow schemas
+            # depend on it) but carries no coordinates: NaN points, or
+            # zero-ring CSR features for extended kinds
+            if col.is_point:
+                cols[a.name] = GeometryColumn(
+                    col.kind, np.full(n, np.nan), np.full(n, np.nan)
+                )
+            else:
+                cols[a.name] = GeometryColumn(
+                    col.kind,
+                    np.full(n, np.nan),
+                    np.full(n, np.nan),
+                    np.zeros((0, 2), np.float64),
+                    np.zeros(1, np.int64),
+                    np.zeros(n + 1, np.int64),
+                    [[0]] * n,
+                    np.full((n, 4), np.nan),
+                )
+        else:
+            arr = np.asarray(col)
+            if arr.dtype.kind == "f":
+                cols[a.name] = np.full(n, np.nan)
+            else:
+                # int/temporal columns have no null representation — a
+                # zero would fabricate a legitimate-looking value, so the
+                # column is DROPPED from the result instead (redaction
+                # folded into projection)
+                del cols[a.name]
+    if not changed:
+        return sel
+    if set(cols) != set(sel.columns):
+        from geomesa_tpu.core.sft import SimpleFeatureType
+
+        kept = [a for a in sel.sft.attributes if a.name in cols]
+        sub = SimpleFeatureType(sel.sft.name, kept, sel.sft.user_data)
+        return FeatureBatch(sub, cols, sel.fids, sel.valid)
+    return dataclasses.replace(sel, columns=cols)
+
+
+def _check_attr_auth(sft: SimpleFeatureType, hints, names) -> None:
+    """Aggregations (stats/bin/density-weight) read attribute VALUES, so a
+    visibility-protected attribute the auths cannot see must refuse rather
+    than stream protected data through sketch/grid/record bytes."""
+    from geomesa_tpu.security.visibility import VisibilityEvaluator
+
+    ev = VisibilityEvaluator()
+    for name in names:
+        if not name or name not in sft:
+            continue
+        vis = sft.attribute(name).options.get("visibility")
+        if vis and not ev.can_see(vis, hints.auths):
+            raise PermissionError(
+                f"insufficient authorizations for attribute {name!r} "
+                f"(visibility {vis!r})"
+            )
+
+
+def aggregate(
+    sft: SimpleFeatureType,
+    batch,
+    dev,
+    mask: np.ndarray,
+    query: "Query",
+    fold_visibility: bool = True,
+):
+    """Dispatch on hints: density / stats / bin aggregation, else features.
+
+    Feature-level visibility folds into the mask HERE (unless the caller
+    already folded it — planner paths pass fold_visibility=False), so
+    every result kind (density mass, stats, bin records, features) hides
+    unauthorized rows identically; aggregations naming a protected
+    attribute refuse outright (_check_attr_auth)."""
     import jax.numpy as jnp
 
     from geomesa_tpu.plan.planner import QueryResult
 
+    if fold_visibility:
+        vm = visibility_mask(sft, batch, query.hints)
+        if vm is not None:
+            mask = np.asarray(mask) & vm
+
     hints = query.hints
+    if hints.is_stats:
+        from geomesa_tpu.stats import parse_stats
+
+        _check_attr_auth(
+            sft, hints,
+            [getattr(s, "attribute", None) for s in parse_stats(hints.stats_string).stats],
+        )
+    if hints.is_bin:
+        _check_attr_auth(sft, hints, [hints.bin_track, hints.bin_label])
+    if hints.is_density and hints.density_weight:
+        _check_attr_auth(sft, hints, [hints.density_weight])
     g = sft.default_geometry
 
     if hints.is_density:
@@ -114,13 +247,28 @@ def aggregate(sft: SimpleFeatureType, batch, dev, mask: np.ndarray, query: "Quer
     if hints.is_arrow:
         # ArrowScan analog: matched (projected) features as one Arrow IPC
         # stream with dictionary-encoded strings; batches from different
-        # shards/partitions concatenate at the IPC level client-side
-        from geomesa_tpu.core.arrow_io import to_ipc_bytes
+        # shards/partitions concatenate at the IPC level client-side. With
+        # arrow_sort_field set, the batch is emitted as a pre-sorted DELTA
+        # (sort stamped in metadata) for client-side merge_sorted_ipc —
+        # DeltaWriter parity (SURVEY.md:260-262)
+        from geomesa_tpu.core.arrow_io import to_ipc_bytes, to_sorted_ipc_bytes
 
         sel = finish_features(batch.select(np.nonzero(mask)[0]), query)
         sel = apply_fid_policy(sel, hints.arrow_include_fid)
+        if hints.arrow_sort_field:
+            if hints.arrow_sort_field not in sel.columns:
+                raise ValueError(
+                    f"arrow_sort_field {hints.arrow_sort_field!r} is not in "
+                    "the result columns — include it in the query's "
+                    "projection (the delta merge needs the key client-side)"
+                )
+            payload = to_sorted_ipc_bytes(
+                sel, hints.arrow_sort_field, hints.arrow_sort_reverse
+            )
+        else:
+            payload = to_ipc_bytes(sel)
         return QueryResult(
-            "arrow", arrow_bytes=to_ipc_bytes(sel), count=len(sel)
+            "arrow", arrow_bytes=payload, count=len(sel)
         )
 
     if hints.is_bin:
@@ -156,12 +304,14 @@ def aggregate(sft: SimpleFeatureType, batch, dev, mask: np.ndarray, query: "Quer
 
 
 def finish_features(sel: FeatureBatch, query: "Query") -> FeatureBatch:
-    """The LocalQueryRunner tail: sort, max-features, projection — shared
-    by the scan path and the cached per-partition path."""
+    """The LocalQueryRunner tail: sort, max-features, attribute
+    redaction, projection — shared by the scan path and the cached
+    per-partition path."""
     if query.sort_by:
         sel = sel.select(sort_order(sel, query.sort_by))
     if query.max_features is not None and len(sel) > query.max_features:
         sel = sel.select(np.arange(query.max_features))
+    sel = redact_attributes(sel, query.hints)
     if query.attributes is not None:
         sel = project(sel, query.attributes)
     return sel

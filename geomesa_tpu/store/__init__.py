@@ -15,7 +15,19 @@ from geomesa_tpu.store.partition import (
 )
 from geomesa_tpu.store.fs import FileSystemStorage
 
+
+def __getattr__(name):
+    # lazy: arrow_store rides the QueryPlanner, whose module imports
+    # store.fs — importing it eagerly here would close an import cycle
+    if name in ("ArrowDataStore", "ArrowFeatureSource"):
+        from geomesa_tpu.store import arrow_store
+
+        return getattr(arrow_store, name)
+    raise AttributeError(name)
+
 __all__ = [
+    "ArrowDataStore",
+    "ArrowFeatureSource",
     "PartitionScheme",
     "DateTimeScheme",
     "Z2Scheme",

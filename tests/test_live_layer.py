@@ -22,6 +22,7 @@ from geomesa_tpu.kafka import (
     KafkaDataStore,
     KafkaFeatureCache,
 )
+from geomesa_tpu.plan.hints import QueryHints
 from geomesa_tpu.plan.query import Query
 from geomesa_tpu.utils import geohash
 from geomesa_tpu.utils.spatial_index import BucketIndex, SizeSeparatedBucketIndex
@@ -396,3 +397,221 @@ class TestArrowMerge:
         out = from_arrow(merged)
         assert len(out) == 5
         assert out.columns["name"].decode() == ["a", "b", "a", "c", "b"]
+
+
+class TestAttributeIndexing:
+    """CQEngine-analog attribute hash index in the live cache
+    (SURVEY.md:323-324, round-1 missing #6)."""
+
+    SFT_IDX = SimpleFeatureType.from_spec(
+        "live2", "name:String:index=true,score:Double,dtg:Date,*geom:Point"
+    )
+
+    def _store(self, n=150):
+        rng = np.random.default_rng(4)
+        ds = KafkaDataStore()
+        src = ds.create_schema(self.SFT_IDX)
+        batch = FeatureBatch.from_pydict(
+            self.SFT_IDX,
+            {
+                "name": rng.choice(["a", "b", "c"], n).tolist(),
+                "score": rng.uniform(-5, 5, n),
+                "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+                "geom": np.stack(
+                    [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], 1
+                ),
+            },
+            fids=[f"f{i}" for i in range(n)],
+        )
+        src.write(batch)
+        return ds, src, batch
+
+    def test_equality_served_from_index(self):
+        ds, src, batch = self._store()
+        cache = ds.cache("live2")
+        assert cache.indexed_attributes == ["name"]
+        before = cache.attr_index_hits
+        r = src.get_features("name = 'a'")
+        assert cache.attr_index_hits == before + 1, "full scan not avoided"
+        names = np.array(batch.columns["name"].decode())
+        assert len(r.features) == int((names == "a").sum())
+        assert set(r.features.columns["name"].decode()) == {"a"}
+        # IN rides the index too
+        r = src.get_features("name IN ('a', 'b')")
+        assert cache.attr_index_hits == before + 2
+        assert len(r.features) == int(np.isin(names, ["a", "b"]).sum())
+
+    def test_index_tracks_upsert_delete(self):
+        ds, src, batch = self._store(n=10)
+        cache = ds.cache("live2")
+        names = batch.columns["name"].decode()
+        # overwrite f0 with a new name: old value must leave the index
+        from geomesa_tpu.core.wkt import point
+
+        ds.write("live2", FeatureBatch.from_pydict(
+            self.SFT_IDX,
+            {"name": ["zzz"], "score": [1.0],
+             "dtg": [1_595_000_000_000], "geom": [point(0.0, 0.0)]},
+            fids=["f0"],
+        ))
+        ds.delete("live2", "f1")
+        ds.poll("live2")
+        r = src.get_features("name = 'zzz'")
+        assert r.features is not None and r.features.fids.decode() == ["f0"]
+        old0 = src.get_features(f"name = '{names[0]}'")
+        got = [] if old0.features is None else old0.features.fids.decode()
+        assert "f0" not in got and "f1" not in got
+
+    def test_non_indexed_and_hinted_queries_bypass(self):
+        ds, src, batch = self._store()
+        cache = ds.cache("live2")
+        before = cache.attr_index_hits
+        # score is not indexed: planner path, parity preserved
+        r = src.get_features("score > 0")
+        assert cache.attr_index_hits == before
+        scores = np.asarray(batch.column("score"))
+        assert len(r.features) == int((scores > 0).sum())
+        # hinted queries must not shortcut (hints change the result KIND)
+        r = src.get_features(Query("live2", "name = 'a'", hints=QueryHints(
+            density_bbox=(-180, -90, 180, 90),
+            density_width=8, density_height=8)))
+        assert r.kind == "density"
+        assert cache.attr_index_hits == before
+
+
+class TestVisibilitySecurity:
+    """Feature-level visibility folded into every mask + per-attribute
+    redaction folded into projection (SURVEY.md C21, :464)."""
+
+    def _store(self, tmp_path):
+        from geomesa_tpu.plan.datastore import DataStore
+
+        sft = SimpleFeatureType.from_spec(
+            "sec",
+            "name:String,level:Double:visibility=admin,vis:String,"
+            "dtg:Date,*geom:Point;geomesa.vis.attr=vis",
+        )
+        rng = np.random.default_rng(11)
+        n = 60
+        batch = FeatureBatch.from_pydict(
+            sft,
+            {
+                "name": [f"n{i}" for i in range(n)],
+                "level": rng.uniform(0, 9, n),
+                "vis": (["admin"] * 20 + ["admin&usa"] * 20 + [None] * 20),
+                "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+                "geom": np.stack(
+                    [rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)], 1
+                ),
+            },
+        )
+        ds = DataStore(str(tmp_path / "sec"))
+        ds.create_schema(sft).write(batch)
+        return ds, batch
+
+    def test_feature_level_masking_all_kinds(self, tmp_path):
+        ds, batch = self._store(tmp_path)
+        src = ds.get_feature_source("sec")
+        # no auths: only the 20 public rows
+        assert src.get_count(
+            Query("sec", "INCLUDE", hints=QueryHints(exact_count=True))
+        ) == 20
+        q = Query("sec", "INCLUDE", hints=QueryHints(auths=("admin",)))
+        assert src.get_count(q) == 40
+        q = Query("sec", "INCLUDE", hints=QueryHints(auths=("admin", "usa")))
+        assert src.get_count(q) == 60
+        # density mass respects visibility too
+        q = Query("sec", "INCLUDE", hints=QueryHints(
+            auths=("admin",), density_bbox=(-180, -90, 180, 90),
+            density_width=8, density_height=8))
+        assert int(round(float(src.get_features(q).grid.sum()))) == 40
+
+    def test_attribute_redaction(self, tmp_path):
+        ds, batch = self._store(tmp_path)
+        src = ds.get_feature_source("sec")
+        q = Query("sec", "INCLUDE", hints=QueryHints(auths=("admin", "usa")))
+        r = src.get_features(q)
+        lv = np.asarray(r.features.column("level"))
+        assert np.isfinite(lv).all()  # admin sees the column
+        q2 = Query("sec", "INCLUDE", hints=QueryHints(auths=("usa",)))
+        r2 = src.get_features(q2)
+        lv2 = np.asarray(r2.features.column("level"))
+        assert np.isnan(lv2).all(), "unauthorized attribute not redacted"
+        # arrow export redacts identically
+        import io
+
+        import pyarrow as pa
+
+        q3 = Query("sec", "INCLUDE", hints=QueryHints(
+            auths=("usa",), arrow_encode=True))
+        t = pa.ipc.open_stream(
+            io.BytesIO(src.get_features(q3).arrow_bytes)).read_all()
+        vals = t.column("level").to_numpy(zero_copy_only=False)
+        assert np.isnan(vals).all()
+
+    def test_aggregations_refuse_protected_attributes(self, tmp_path):
+        # stats/bin/density-weight over a visibility-protected attribute
+        # must refuse, not stream protected values (round-2 review leak)
+        ds, batch = self._store(tmp_path)
+        src = ds.get_feature_source("sec")
+        q = Query("sec", "INCLUDE", hints=QueryHints(
+            auths=("usa",), stats_string="MinMax(level)"))
+        with pytest.raises(PermissionError, match="level"):
+            src.get_features(q)
+        q = Query("sec", "INCLUDE", hints=QueryHints(
+            auths=("usa",), density_bbox=(-180, -90, 180, 90),
+            density_width=8, density_height=8, density_weight="level"))
+        with pytest.raises(PermissionError, match="level"):
+            src.get_features(q)
+        # authorized auths pass
+        q = Query("sec", "INCLUDE", hints=QueryHints(
+            auths=("admin",), stats_string="MinMax(level)"))
+        assert src.get_features(q).kind == "stats"
+
+    def test_int_attribute_redaction_drops_column(self, tmp_path):
+        # ints have no null: redaction drops the column instead of
+        # fabricating zeros (round-2 review)
+        from geomesa_tpu.plan.datastore import DataStore
+
+        sft = SimpleFeatureType.from_spec(
+            "seci", "name:String,code:Integer:visibility=admin,*geom:Point"
+        )
+        rng = np.random.default_rng(2)
+        batch = FeatureBatch.from_pydict(sft, {
+            "name": ["a", "b"], "code": [7, 9],
+            "geom": rng.uniform(-10, 10, (2, 2))})
+        ds = DataStore(str(tmp_path / "seci"))
+        ds.create_schema(sft).write(batch)
+        src = ds.get_feature_source("seci")
+        r = src.get_features(Query("seci", "INCLUDE",
+                                   hints=QueryHints(auths=())))
+        assert "code" not in r.features.columns
+        r = src.get_features(Query("seci", "INCLUDE",
+                                   hints=QueryHints(auths=("admin",))))
+        assert np.asarray(r.features.column("code")).tolist() == [7, 9]
+
+    def test_live_fast_path_declines_visibility_types(self, tmp_path):
+        # the kafka attribute index has no auth awareness: visibility-
+        # configured types always take the planner path (round-2 review
+        # leak fix)
+        sft = SimpleFeatureType.from_spec(
+            "secl",
+            "name:String:index=true,vis:String,*geom:Point;"
+            "geomesa.vis.attr=vis",
+        )
+        rng = np.random.default_rng(3)
+        n = 20
+        batch = FeatureBatch.from_pydict(sft, {
+            "name": ["a"] * 10 + ["b"] * 10,
+            "vis": ["admin"] * 10 + [None] * 10,
+            "geom": rng.uniform(-10, 10, (n, 2))},
+            fids=[f"f{i}" for i in range(n)])
+        kds = KafkaDataStore()
+        src = kds.create_schema(sft)
+        src.write(batch)
+        cache = kds.cache("secl")
+        r = src.get_features("name = 'a'")
+        assert cache.attr_index_hits == 0, "fast path leaked protected rows"
+        # name='a' rows are all admin-protected: invisible without auths
+        got = 0 if r.features is None else len(r.features)
+        assert got == 0
