@@ -23,6 +23,33 @@ import time
 import numpy as np
 
 
+def _clustered(rng, n, extent, ncenters=64, frac_bg=0.1):
+    """Mixture-of-Gaussians hotspots + uniform background — the shape of
+    real GDELT/AIS data (heavily clustered; auto_grid_params documents
+    ~10x cell skew). Zipf-ish center weights make a few hotspots dominate,
+    which is the worst case for grid indexes and density scatter."""
+    x0, y0, x1, y1 = extent
+    w = 1.0 / np.arange(1, ncenters + 1) ** 1.1
+    w /= w.sum()
+    cx = rng.uniform(x0, x1, ncenters)
+    cy = rng.uniform(y0, y1, ncenters)
+    assign = rng.choice(ncenters, n, p=w)
+    sx = (x1 - x0) / 150.0
+    sy = (y1 - y0) / 150.0
+    x = cx[assign] + rng.normal(0, sx, n)
+    y = cy[assign] + rng.normal(0, sy, n)
+    bg = rng.random(n) < frac_bg
+    x[bg] = rng.uniform(x0, x1, int(bg.sum()))
+    y[bg] = rng.uniform(y0, y1, int(bg.sum()))
+    # clip INSIDE the extent by an f32-safe margin: boundary clusters put
+    # heavy mass exactly on the max edge, where f32 coordinate rounding
+    # moves points across the half-open grid boundary (device drops them,
+    # numpy's histogram2d last bin keeps them) and parity gates flap
+    mx = (x1 - x0) * 1e-3
+    my = (y1 - y0) * 1e-3
+    return np.clip(x, x0 + mx, x1 - mx), np.clip(y, y0 + my, y1 - my), cx, cy
+
+
 def _cpu_baseline(x, y, t, speed, qx, qy, k, bbox, t0, t1, repeats=3):
     """Vectorized NumPy: mask + argpartition kNN (per query, masked)."""
     from geomesa_tpu.engine.geodesy import haversine_m_np
@@ -131,7 +158,7 @@ def bench_pip(n, repeats):
     }
 
 
-def bench_density(n, repeats):
+def bench_density(n, repeats, dist="uniform"):
     """Config 4: DensityProcess 512x512 (NYC-TLC-style grid)."""
     import jax
     import jax.numpy as jnp
@@ -139,8 +166,11 @@ def bench_density(n, repeats):
     from geomesa_tpu.engine.density import density_grid_auto as density_grid
 
     rng = np.random.default_rng(11)
-    x = rng.uniform(-74.3, -73.7, n)
-    y = rng.uniform(40.5, 41.0, n)
+    if dist == "clustered":
+        x, y, _, _ = _clustered(rng, n, (-74.3, 40.5, -73.7, 41.0))
+    else:
+        x = rng.uniform(-74.3, -73.7, n)
+        y = rng.uniform(40.5, 41.0, n)
     w = rng.uniform(0, 5, n).astype(np.float32)
     bbox = (-74.3, 40.5, -73.7, 41.0)
     W = H = 512
@@ -170,7 +200,8 @@ def bench_density(n, repeats):
         "unit": "points/sec",
         "vs_baseline": round((n / dev_t) / (n / cpu_t), 3),
         "detail": {
-            "n": n, "grid": f"{W}x{H}", "device_time_s": round(dev_t, 5),
+            "n": n, "grid": f"{W}x{H}", "dist": dist,
+            "device_time_s": round(dev_t, 5),
             "cpu_time_s": round(cpu_t, 5), "grid_mass_parity": bool(mass_ok),
         },
     }
@@ -230,7 +261,181 @@ def bench_tube(n, repeats):
     }
 
 
-def bench_fs_query(n, repeats, tmpdir=None):
+def bench_polygon_density(n, repeats):
+    """Config 6 (round-2): extended-geometry density — rasterize n
+    polygons into a 512x512 grid (DensityScan line/polygon parity,
+    SURVEY.md:258-259). Two measurements: the raw kernel at full n
+    (vectorized CSR quads -> oriented edge table -> winding scatter +
+    row cumsum) and the end-to-end planner path (XZ2-partitioned store ->
+    density hint) at a store-friendly subset."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.engine.raster import (
+        _pow2, polygon_density, polygon_rowspan_bound)
+
+    rng = np.random.default_rng(23)
+    bbox = (-60.0, -45.0, 60.0, 45.0)
+    W = H = 512
+
+    # vectorized CCW quads: center + half-sizes + rotation
+    cx = rng.uniform(bbox[0], bbox[2], n)
+    cy = rng.uniform(bbox[1], bbox[3], n)
+    hw = rng.uniform(0.02, 0.15, n)
+    hh = rng.uniform(0.02, 0.15, n)
+    th = rng.uniform(0, np.pi / 2, n)
+    base = np.array([[-1, -1], [1, -1], [1, 1], [-1, 1]], np.float64)
+    cosr, sinr = np.cos(th), np.sin(th)
+    # corners [n, 4, 2], CCW
+    ux = base[None, :, 0] * hw[:, None]
+    uy = base[None, :, 1] * hh[:, None]
+    corx = cx[:, None] + ux * cosr[:, None] - uy * sinr[:, None]
+    cory = cy[:, None] + ux * sinr[:, None] + uy * cosr[:, None]
+    nxt = [1, 2, 3, 0]
+    x1 = corx.reshape(-1)
+    y1 = cory.reshape(-1)
+    x2 = corx[:, nxt].reshape(-1)
+    y2 = cory[:, nxt].reshape(-1)
+    wedge = np.repeat(rng.uniform(0.5, 2.0, n), 4).astype(np.float32)
+    efeat_weights = wedge  # per-edge owner weight
+    kspan = _pow2(polygon_rowspan_bound(y1, y2, bbox, H) + 1)
+
+    jx1, jy1 = jnp.asarray(x1, jnp.float32), jnp.asarray(y1, jnp.float32)
+    jx2, jy2 = jnp.asarray(x2, jnp.float32), jnp.asarray(y2, jnp.float32)
+    jw = jnp.asarray(efeat_weights)
+    jm = jnp.ones(len(x1), bool)
+
+    def run():
+        return polygon_density(
+            jx1, jy1, jx2, jy2, jw, jm, bbox, W, H, kspan
+        )
+
+    dev_t = _timeit(lambda: _sync(run()), repeats)
+    grid = np.asarray(run())
+
+    # CPU baseline: per-polygon cell-center coverage over the polygon's
+    # bbox cells (the direct rasterizer a CPU implementation would use),
+    # measured on a subsample and reported per polygon
+    psub = min(n, 20_000)
+    dx = (bbox[2] - bbox[0]) / W
+    dy = (bbox[3] - bbox[1]) / H
+
+    def cpu(limit=psub):
+        g = np.zeros((H, W))
+        for i in range(limit):
+            xc = corx[i]
+            yc = cory[i]
+            c0 = max(int((xc.min() - bbox[0]) / dx), 0)
+            c1 = min(int((xc.max() - bbox[0]) / dx) + 1, W)
+            r0 = max(int((yc.min() - bbox[1]) / dy), 0)
+            r1 = min(int((yc.max() - bbox[1]) / dy) + 1, H)
+            if c1 <= c0 or r1 <= r0:
+                continue
+            ccx = bbox[0] + (np.arange(c0, c1) + 0.5) * dx
+            ccy = bbox[1] + (np.arange(r0, r1) + 0.5) * dy
+            gx, gy = np.meshgrid(ccx, ccy)
+            inside = np.zeros(gx.shape, bool)
+            for e in range(4):
+                ax, ay = corx[i, e], cory[i, e]
+                bx, by = corx[i, nxt[e]], cory[i, nxt[e]]
+                cond = (ay <= gy) != (by <= gy)
+                tpar = (gy - ay) / np.where(by == ay, 1.0, by - ay)
+                xcr = ax + tpar * (bx - ax)
+                inside ^= cond & (xcr > gx)
+            g[r0:r1, c0:c1] += inside * efeat_weights[4 * i]
+        return g
+
+    last = {}
+
+    def cpu_timed():
+        last["grid"] = cpu()
+
+    cpu_t = _timeit(cpu_timed, max(1, repeats - 1))
+    cpu_grid = last["grid"]  # reuse the final timed run's result
+    # parity on the subsample: device grid over the same subset
+    sub_k = _pow2(polygon_rowspan_bound(y1[: 4 * psub], y2[: 4 * psub], bbox, H) + 1)
+    sub_grid = np.asarray(
+        polygon_density(
+            jx1[: 4 * psub], jy1[: 4 * psub], jx2[: 4 * psub], jy2[: 4 * psub],
+            jw[: 4 * psub], jm[: 4 * psub], bbox, W, H, sub_k,
+        )
+    )
+    denom = max(cpu_grid.sum(), 1.0)
+    mismatch_mass = float(np.abs(sub_grid - cpu_grid).sum() / denom)
+
+    # end-to-end: XZ2 store -> planner -> device rasterization
+    import shutil
+    import tempfile
+
+    from geomesa_tpu.core.columnar import FeatureBatch, GeometryColumn
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.plan.datastore import DataStore
+    from geomesa_tpu.plan.hints import QueryHints
+    from geomesa_tpu.plan.query import Query
+    from geomesa_tpu.store.partition import XZ2Scheme
+
+    n_store = min(n, 50_000)  # WKT serialization bounds the store size
+    verts = np.stack(
+        [
+            np.concatenate([corx[:n_store], corx[:n_store, :1]], 1).reshape(-1),
+            np.concatenate([cory[:n_store], cory[:n_store, :1]], 1).reshape(-1),
+        ],
+        1,
+    )
+    col = GeometryColumn(
+        "Polygon",
+        corx[:n_store, 0],
+        cory[:n_store, 0],
+        verts,
+        np.arange(0, 5 * n_store + 1, 5, dtype=np.int64),
+        np.arange(0, n_store + 1, dtype=np.int64),
+        [[1]] * n_store,
+        np.stack(
+            [corx[:n_store].min(1), cory[:n_store].min(1),
+             corx[:n_store].max(1), cory[:n_store].max(1)], 1,
+        ),
+    )
+    sft = SimpleFeatureType.from_spec("polys", "w:Double,*geom:Polygon")
+    pb = FeatureBatch(
+        sft, {"w": efeat_weights[:: 4][:n_store].astype(np.float64), "geom": col}
+    )
+    root = tempfile.mkdtemp(prefix="gmtpu_polybench_")
+    try:
+        ds = DataStore(root, use_device_cache=True)
+        src = ds.create_schema(sft, XZ2Scheme(g=2))
+        src.write(pb)
+        q = Query(
+            "polys", "INCLUDE",
+            hints=QueryHints(
+                density_bbox=bbox, density_width=W, density_height=H,
+                density_weight="w",
+            ),
+        )
+        src.get_features(q)  # warm (compile + cache)
+        e2e_t = _timeit(lambda: src.get_features(q), max(1, repeats - 1))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    cpu_pps = psub / cpu_t
+    return {
+        "metric": "polygon_density_polys_per_sec_per_chip",
+        "value": round(n / dev_t, 1),
+        "unit": "polygons/sec",
+        "vs_baseline": round((n / dev_t) / cpu_pps, 3),
+        "detail": {
+            "n": n, "grid": f"{W}x{H}", "device_time_s": round(dev_t, 5),
+            "cpu_polys": psub, "cpu_time_s": round(cpu_t, 5),
+            "mismatch_mass_frac": round(mismatch_mass, 6),
+            "parity": mismatch_mass < 1e-3,
+            "store_polys": n_store,
+            "e2e_query_time_s": round(e2e_t, 5),
+            "e2e_polys_per_sec": round(n_store / e2e_t, 1),
+            "note": "kernel at full n; e2e = XZ2 store -> planner -> "
+                    "device rasterization at store_polys",
+        },
+    }
+
+
+def bench_fs_query(n, repeats, tmpdir=None, cold=False):
     """Config 1: BBOX+time CQL through the full FS Parquet DataStore stack
     (plan -> prune -> parquet pushdown -> device residual mask), CPU
     baseline = the same filter in flat NumPy over the raw arrays."""
@@ -261,6 +466,18 @@ def bench_fs_query(n, repeats, tmpdir=None):
                "dtg DURING 2020-06-13T00:00:00Z/2020-08-21T00:00:00Z")
         q_t = _timeit(lambda: src.get_count(cql), repeats)
         count = src.get_count(cql)
+        cold_t = None
+        if cold:
+            # cold path: a fresh store with NO device cache — every query
+            # pays parquet read -> host columnar -> device transfer ->
+            # mask (the honest end-to-end number the round-1 review asked
+            # for; SURVEY.md:834-835 both-ways obligation)
+            ds_cold = DataStore(root, use_device_cache=False)
+            src_cold = ds_cold.get_feature_source("gdelt")
+            cold_t = _timeit(
+                lambda: src_cold.get_count(cql), max(1, repeats - 1)
+            )
+            assert src_cold.get_count(cql) == count
 
         import datetime as _dt
 
@@ -314,10 +531,21 @@ def bench_fs_query(n, repeats, tmpdir=None):
                 "cpu_parquet_time_s": round(cpu_t, 5),
                 "cpu_rawmask_time_s": round(raw_t, 5),
                 "parity": bool(parity),
+                **(
+                    {
+                        "cold_time_s": round(cold_t, 5),
+                        "cold_points_per_sec": round(n / cold_t, 1),
+                        "cold_vs_cpu": round((n / cold_t) / (n / cpu_t), 3),
+                    }
+                    if cold_t is not None
+                    else {}
+                ),
                 "note": "end-to-end HBM-resident DataStore query (plan + "
                         "residual mask + device count) vs pyarrow Parquet "
                         "predicate-pushdown scan on CPU (BASELINE config 1); "
-                        "cpu_rawmask is the no-IO in-memory lower bound",
+                        "cpu_rawmask is the no-IO in-memory lower bound; "
+                        "cold_* (with --cold) pays parquet->host->device "
+                        "every query",
             },
         }
     finally:
@@ -332,9 +560,20 @@ def main(argv=None) -> int:
     p.add_argument("--queries", type=int, default=None)
     p.add_argument("--k", type=int, default=10)
     p.add_argument(
-        "--config", type=int, default=None, choices=[1, 2, 3, 4, 5],
+        "--config", type=int, default=None, choices=[1, 2, 3, 4, 5, 6],
         help="BASELINE.json config to run (default: 3, the headline "
-             "BBOX+time+kNN metric; 1=fs-query 2=pip 4=density 5=tube)",
+             "BBOX+time+kNN metric; 1=fs-query 2=pip 4=density 5=tube "
+             "6=polygon-density rasterization)",
+    )
+    p.add_argument(
+        "--dist", choices=["uniform", "clustered"], default="uniform",
+        help="data distribution for configs 3/4: uniform (best case for "
+             "grids) or clustered hotspots (GDELT/AIS shape, ~10x skew)",
+    )
+    p.add_argument(
+        "--cold", action="store_true",
+        help="config 1: ALSO time the cold path (parquet -> host -> "
+             "device, no HBM residency) alongside the cached query",
     )
     p.add_argument(
         "--impl", choices=["mxu", "grid", "compact", "haversine"],
@@ -363,7 +602,8 @@ def main(argv=None) -> int:
     # over a GDELT-realistic batch; both sides scan the same n. Configs
     # whose CPU baseline is superlinear-or-heavy in n keep a smaller default
     # so a full 5-config sweep stays within a bench budget.
-    per_config = {1: 1 << 24, 2: 1 << 22, 3: 1 << 26, 4: 1 << 26, 5: 1 << 22}
+    per_config = {1: 1 << 24, 2: 1 << 22, 3: 1 << 26, 4: 1 << 26, 5: 1 << 22,
+                  6: 1 << 20}
     n = args.n or (
         1 << 17 if args.smoke else per_config.get(args.config or 3, 1 << 26)
     )
@@ -373,9 +613,16 @@ def main(argv=None) -> int:
     k = args.k
     repeats = 2 if args.smoke else 3
 
-    if args.config in (1, 2, 4, 5):
-        fn = {1: bench_fs_query, 2: bench_pip, 4: bench_density, 5: bench_tube}
-        print(json.dumps(fn[args.config](n, repeats)))
+    if args.config in (1, 2, 4, 5, 6):
+        if args.config == 1:
+            out = bench_fs_query(n, repeats, cold=args.cold)
+        elif args.config == 4:
+            out = bench_density(n, repeats, dist=args.dist)
+        elif args.config == 6:
+            out = bench_polygon_density(n, repeats)
+        else:
+            out = {2: bench_pip, 5: bench_tube}[args.config](n, repeats)
+        print(json.dumps(out))
         return 0
 
     import jax
@@ -384,12 +631,20 @@ def main(argv=None) -> int:
     from geomesa_tpu.engine.knn import knn, knn_compact, knn_mxu
 
     rng = np.random.default_rng(42)
-    x = rng.uniform(-180, 180, n)
-    y = rng.uniform(-90, 90, n)
+    if args.dist == "clustered":
+        # hotspot mixture (AIS/GDELT shape); queries drawn NEAR hotspots,
+        # where cell overflow and near-ties are the worst case
+        x, y, cxs, cys = _clustered(rng, n, (-180.0, -90.0, 180.0, 90.0))
+        pick = rng.integers(0, len(cxs), q)
+        qx = np.clip(cxs[pick] + rng.normal(0, 1.0, q), -180, 180)
+        qy = np.clip(cys[pick] + rng.normal(0, 1.0, q), -90, 90)
+    else:
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+        qx = rng.uniform(-30, 30, q)
+        qy = rng.uniform(30, 60, q)
     t = rng.integers(1_590_000_000_000, 1_600_000_000_000, n)
     speed = rng.uniform(0, 30, n)
-    qx = rng.uniform(-30, 30, q)
-    qy = rng.uniform(30, 60, q)
     BBOX = (-60.0, 20.0, 60.0, 70.0)
     T0, T1 = 1_592_000_000_000, 1_598_000_000_000
 
@@ -491,6 +746,7 @@ def main(argv=None) -> int:
                     "device_time_s": round(best, 5),
                     "cpu_time_s": round(cpu_time, 5),
                     "cpu_points_per_sec": round(cpu_pps, 1),
+                    "dist": args.dist,
                     "match_count": int(count),
                     "cpu_match_count": cpu_count,
                     "recall_parity": recall_ok,

@@ -317,7 +317,13 @@ class KVFeatureSource:
         # marker makes the nested get_features -> plan pass a no-op, so the
         # chain applies exactly once (no idempotence requirement)
         query = run_interceptors(query, self.interceptors)
-        if not query.hints.exact_count and isinstance(query.filter_ast, ast.Include):
+        if (
+            not query.hints.exact_count
+            and isinstance(query.filter_ast, ast.Include)
+            # live_count knows nothing about auths: visibility-configured
+            # types count through the masked aggregation path
+            and not (self.sft.user_data or {}).get("geomesa.vis.attr")
+        ):
             return self.live_count
         r = self.get_features(query)
         if r.kind == "features":

@@ -141,25 +141,52 @@ class KafkaFeatureSource(FeatureSource):
         else:
             return None
         cache = self._store.cache(self._name)
-        if name not in cache._attr_index:
+        if name not in cache.indexed_attributes:
             return None
+        import time as _time
+
+        t0 = _time.perf_counter()
         rows = cache.query_attribute(name, values)
         from geomesa_tpu.plan.planner import QueryResult
 
         if not rows:
-            return QueryResult("features", features=None, count=0)
-        sft = self.sft
-        data = {
-            a.name: [row.get(a.name) for _, row in rows]
-            for a in sft.attributes
-        }
-        batch = FeatureBatch.from_pydict(
-            sft, data, fids=[fid for fid, _ in rows]
-        )
-        from geomesa_tpu.plan.runner import finish_features
+            result = QueryResult("features", features=None, count=0)
+        else:
+            sft = self.sft
+            data = {
+                a.name: [row.get(a.name) for _, row in rows]
+                for a in sft.attributes
+            }
+            batch = FeatureBatch.from_pydict(
+                sft, data, fids=[fid for fid, _ in rows]
+            )
+            from geomesa_tpu.plan.runner import finish_features
 
-        batch = finish_features(batch, query)
-        return QueryResult("features", features=batch, count=len(batch))
+            batch = finish_features(batch, query)
+            result = QueryResult(
+                "features", features=batch, count=len(batch)
+            )
+        # the fast path must not dodge the audit trail: these are the most
+        # frequent live-layer queries
+        audit = self._store.audit
+        if audit is not None:
+            from geomesa_tpu.plan.audit import QueryEvent
+
+            dt = (_time.perf_counter() - t0) * 1000
+            audit.write(
+                QueryEvent(
+                    type_name=query.type_name,
+                    filter=ast.to_cql(query.filter_ast),
+                    hints="attr-index-fast-path",
+                    plan_time_ms=0.0,
+                    scan_time_ms=dt,
+                    compute_time_ms=0.0,
+                    result_count=result.count,
+                    partitions_scanned=1,
+                    partitions_total=1,
+                )
+            )
+        return result
 
     def get_features(self, query="INCLUDE"):
         self._store.poll(self._name)

@@ -403,6 +403,9 @@ class QueryPlanner:
             not query.hints.exact_count
             and not SystemProperties.FORCE_COUNT.get()
             and isinstance(query.filter_ast, ast.Include)
+            # a manifest count knows nothing about auths: visibility-
+            # configured types must count through the masked path
+            and not (self.storage.sft.user_data or {}).get("geomesa.vis.attr")
         ):
             return self.storage.count
         counting = dataclasses.replace(

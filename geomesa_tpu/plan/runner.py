@@ -226,10 +226,12 @@ def aggregate(
     if hints.is_stats:
         from geomesa_tpu.stats import parse_stats
 
-        _check_attr_auth(
-            sft, hints,
-            [getattr(s, "attribute", None) for s in parse_stats(hints.stats_string).stats],
-        )
+        names = []
+        for s in parse_stats(hints.stats_string).stats:
+            names.append(getattr(s, "attribute", None))
+            # Z3Histogram reads a second attribute (the dtg column)
+            names.append(getattr(s, "dtg", None))
+        _check_attr_auth(sft, hints, names)
     if hints.is_bin:
         _check_attr_auth(sft, hints, [hints.bin_track, hints.bin_label])
     if hints.is_density and hints.density_weight:

@@ -615,3 +615,59 @@ class TestVisibilitySecurity:
         # name='a' rows are all admin-protected: invisible without auths
         got = 0 if r.features is None else len(r.features)
         assert got == 0
+
+    def test_nonexact_count_respects_visibility(self, tmp_path):
+        # the manifest-count shortcut must not leak the true row count
+        # (round-2 review: exact_count=False returned 60 to auths=())
+        ds, batch = self._store(tmp_path)
+        src = ds.get_feature_source("sec")
+        q = Query("sec", "INCLUDE", hints=QueryHints(exact_count=False))
+        assert src.get_count(q) == 20
+        q = Query("sec", "INCLUDE",
+                  hints=QueryHints(exact_count=False, auths=("admin",)))
+        assert src.get_count(q) == 40
+
+    def test_z3histogram_stats_auth(self, tmp_path):
+        # Z3Histogram reads a second (dtg) attribute: protect it too
+        from geomesa_tpu.plan.datastore import DataStore
+
+        sft = SimpleFeatureType.from_spec(
+            "secz", "name:String,dtg:Date:visibility=admin,*geom:Point"
+        )
+        rng = np.random.default_rng(5)
+        n = 10
+        batch = FeatureBatch.from_pydict(sft, {
+            "name": [f"n{i}" for i in range(n)],
+            "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack([rng.uniform(-10, 10, n),
+                              rng.uniform(-10, 10, n)], 1)})
+        ds = DataStore(str(tmp_path / "secz"))
+        ds.create_schema(sft).write(batch)
+        src = ds.get_feature_source("secz")
+        q = Query("secz", "INCLUDE", hints=QueryHints(
+            auths=(), stats_string="Z3Histogram(geom,dtg,week,4)"))
+        with pytest.raises(PermissionError, match="dtg"):
+            src.get_features(q)
+
+
+class TestFastPathAudit:
+    def test_attr_fast_path_writes_audit(self):
+        from geomesa_tpu.plan.audit import AuditWriter
+
+        sft = SimpleFeatureType.from_spec(
+            "aud", "name:String:index=true,*geom:Point"
+        )
+        rng = np.random.default_rng(6)
+        audit = AuditWriter()
+        ds = KafkaDataStore(audit=audit)
+        src = ds.create_schema(sft)
+        src.write(FeatureBatch.from_pydict(sft, {
+            "name": ["a", "b", "a"],
+            "geom": rng.uniform(-10, 10, (3, 2))},
+            fids=["f0", "f1", "f2"]))
+        before = len(audit.events)
+        r = src.get_features("name = 'a'")
+        assert ds.cache("aud").attr_index_hits == 1  # fast path taken
+        assert len(audit.events) == before + 1
+        ev = audit.events[-1]
+        assert ev.result_count == 2 and "name" in ev.filter
