@@ -207,7 +207,7 @@ def converter_from_config(sft: SimpleFeatureType, config: dict):
         return DelimitedTextConverter(sft, config)
     if kind == "json":
         return JsonConverter(sft, config)
-    if kind in ("fixed-width", "xml", "shp", "avro"):
+    if kind in ("fixed-width", "xml", "shp", "avro", "parquet", "jdbc"):
         from geomesa_tpu.convert import formats
 
         cls = {
@@ -215,6 +215,8 @@ def converter_from_config(sft: SimpleFeatureType, config: dict):
             "xml": formats.XmlConverter,
             "shp": formats.ShapefileConverter,
             "avro": formats.AvroConverter,
+            "parquet": formats.ParquetConverter,
+            "jdbc": formats.JdbcConverter,
         }[kind]
         return cls(sft, config)
     raise ValueError(f"unknown converter type {kind!r}")

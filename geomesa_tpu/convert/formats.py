@@ -385,3 +385,81 @@ def _write_dbf(path: str, batch: FeatureBatch) -> None:
                 f.write(v.rjust(width).encode("latin-1") if ftype == "N"
                         else v.ljust(width).encode("latin-1"))
         f.write(b"\x1a")
+
+
+class ParquetConverter(_BaseConverter):
+    """Parquet input (upstream: geomesa-convert parquet input [L],
+    SURVEY.md:431-432). Fields address source columns by `path` (column
+    name) or positionally ($1..$N in schema order); transforms apply on
+    top as usual. Reads row-group batches columnar-side and only then
+    iterates rows, so the per-record Python work is dict assembly, not
+    parquet decoding."""
+
+    def _records(self, source):
+        import pyarrow.parquet as papq
+
+        pf = papq.ParquetFile(source)
+        names = pf.schema_arrow.names
+        line = 0
+        for rb in pf.iter_batches():
+            cols = [c.to_pylist() for c in rb.columns]
+            for i in range(rb.num_rows):
+                line += 1
+                row = {n: cols[j][i] for j, n in enumerate(names)}
+                yield EvalContext(
+                    positional=[row] + [cols[j][i] for j in range(len(names))],
+                    named=dict(row),
+                    line_no=line,
+                )
+
+    def _field_value(self, ctx, f):
+        return _columnar_field_value(self, ctx, f)
+
+
+class JdbcConverter(_BaseConverter):
+    """JDBC-style input over a SQL database (upstream: geomesa-convert
+    JDBC [L]). The config carries the query; the SOURCE is a DB-API
+    connection or a SQLite path (the zero-dependency stand-in for the
+    reference's JDBC URL). Columns address by name (`path`) or position.
+
+        {"type": "jdbc", "query": "SELECT id, lon, lat FROM obs", ...}
+    """
+
+    def _records(self, source):
+        import sqlite3
+
+        close = False
+        if isinstance(source, (str, bytes)):
+            conn = sqlite3.connect(source)
+            close = True
+        else:
+            conn = source
+        try:
+            cur = conn.execute(self.config["query"])
+            names = [d[0] for d in cur.description]
+            for line, rec in enumerate(cur, 1):
+                row = dict(zip(names, rec))
+                yield EvalContext(
+                    positional=[row] + list(rec),
+                    named=row,
+                    line_no=line,
+                )
+        finally:
+            if close:
+                conn.close()
+
+    def _field_value(self, ctx, f):
+        return _columnar_field_value(self, ctx, f)
+
+
+def _columnar_field_value(conv: _BaseConverter, ctx: EvalContext, f: _Field):
+    """Shared by the columnar-source converters (parquet/jdbc): `path`
+    addresses a source column by name; transforms see $0 = that value."""
+    if f.path is not None:
+        v = ctx.named
+        for seg in f.path:
+            v = v.get(seg) if isinstance(v, dict) else None
+        if f.transform is not None:
+            return f.transform(EvalContext([v], dict(ctx.named), ctx.line_no))
+        return v
+    return _BaseConverter._field_value(conv, ctx, f)

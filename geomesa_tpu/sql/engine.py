@@ -16,7 +16,9 @@ Supported: SELECT cols|*|aggregates (COUNT(*)/COUNT(col)/SUM/MIN/MAX/AVG,
 with AS aliases), WHERE with AND/OR/NOT over st_intersects/st_within/
 st_contains/st_dwithin/st_bbox + comparisons/BETWEEN/IN/LIKE (datetime-typed
 comparisons are translated to temporal predicates), GROUP BY, ORDER BY,
-LIMIT.
+LIMIT, and INNER JOIN on attribute equality (aliases, qualified columns,
+per-side WHERE pushdown riding each table's index, vectorized host-side
+hash join — the relation-join surface of SURVEY.md:381-383).
 
 Non-pushable scalar predicates (e.g. `st_area(geom) > 2` in WHERE) follow
 the reference's LocalQueryRunner contract (SURVEY.md:219): push what the
@@ -139,7 +141,311 @@ class _Where:
     host_desc: List[str]
 
 
-class SqlContext:
+_KEYWORDS = {
+    "JOIN", "INNER", "WHERE", "GROUP", "ORDER", "LIMIT", "ON", "AS", "AND",
+    "OR", "NOT", "BY",
+}
+
+
+class _JoinSide:
+    def __init__(self, table: str, alias: Optional[str], sft):
+        self.table = table
+        self.qual = alias or table
+        self.sft = sft
+        self.filters: List[ast.Filter] = []
+
+
+def _resolve(sides: List[_JoinSide], name: str):
+    """Resolve a (possibly qualified) column reference to (side, col)."""
+    if "." in name:
+        qual, col = name.split(".", 1)
+        for s in sides:
+            if s.qual == qual:
+                if col not in s.sft:
+                    raise SqlError(f"unknown column {name!r}")
+                return s, col
+        raise SqlError(f"unknown table qualifier {qual!r} in {name!r}")
+    owners = [s for s in sides if name in s.sft]
+    if len(owners) == 1:
+        return owners[0], name
+    if not owners:
+        raise SqlError(f"unknown column {name!r}")
+    raise SqlError(
+        f"ambiguous column {name!r}: qualify as "
+        + " or ".join(f"{s.qual}.{name}" for s in owners)
+    )
+
+
+class _SqlJoinMixin:
+    """Inner equi-join between two feature types (upstream: relation join
+    optimizations, SURVEY.md:381-383 [L]). Each side's WHERE conjuncts
+    push into that side's store query (riding its index) and the join
+    itself is a vectorized sort/searchsorted hash-join host-side."""
+
+    def _maybe_alias(self, toks: _Tokens) -> Optional[str]:
+        t = toks.peek()
+        if (
+            t
+            and t[0] == "word"
+            and t[1].upper() not in _KEYWORDS
+            and "." not in t[1]
+        ):
+            toks.next()
+            return t[1]
+        return None
+
+    def _join(self, toks: _Tokens, items, t1: str, a1: Optional[str]):
+        from geomesa_tpu.plan.planner import QueryResult
+
+        if items is None:
+            raise SqlError("JOIN needs an explicit select list (no *)")
+        if any(it.kind != "col" for it in items):
+            raise SqlError("aggregates over JOIN are not supported yet")
+        t2 = toks.next()[1]
+        a2 = self._maybe_alias(toks)
+        sides = [
+            _JoinSide(t1, a1, self.ds.get_schema(t1)),
+            _JoinSide(t2, a2, self.ds.get_schema(t2)),
+        ]
+        if sides[0].qual == sides[1].qual:
+            raise SqlError("self-joins need distinct aliases")
+        toks.expect_word("ON")
+        ls, lc = _resolve(sides, toks.next()[1])
+        op = toks.next()
+        if op != ("op", "="):
+            raise SqlError("JOIN ON supports equality only")
+        rs, rc = _resolve(sides, toks.next()[1])
+        if ls is rs:
+            raise SqlError("JOIN ON must reference both tables")
+        keys = {ls.qual: lc, rs.qual: rc}
+
+        if toks.accept_word("WHERE"):
+            self._join_where(toks, sides)
+        sort_by = None
+        if toks.accept_word("ORDER"):
+            toks.expect_word("BY")
+            sort_by = self._order_list(toks)
+        limit = None
+        if toks.accept_word("LIMIT"):
+            limit = int(toks.next()[1])
+        if toks.peek() is not None:
+            raise SqlError(f"trailing tokens at {toks.peek()}")
+
+        out_items = []  # (side_index, col, out_name)
+        used = set()
+        for it in items:
+            side, col = _resolve(sides, it.col)
+            si = sides.index(side)
+            name = it.alias if it.alias != it.col else (
+                col if col not in used and all(
+                    col not in s.sft or s is side for s in sides
+                ) else f"{side.qual}_{col}"
+            )
+            used.add(col)
+            out_items.append((si, col, name))
+
+        # fetch each side with ITS pushable filter, projected to the join
+        # key + that side's selected columns (no host residuals in JOIN
+        # WHERE, so the needed set is statically known)
+        batches = []
+        for si, s in enumerate(sides):
+            f: ast.Filter = ast.Include()
+            for c in s.filters:
+                f = c if isinstance(f, ast.Include) else ast.And((f, c))
+            needed = sorted(
+                {keys[s.qual]} | {c for j, c, _ in out_items if j == si}
+            )
+            r = self.ds.get_feature_source(s.table).get_features(
+                Query(s.table, f, attributes=needed)
+            )
+            b = r.features
+            if b is None:
+                # empty side: materialize a zero-row batch so the join
+                # result keeps its schema (no None dereference downstream)
+                from geomesa_tpu.core.columnar import FeatureBatch
+                from geomesa_tpu.core.sft import SimpleFeatureType
+
+                sub = SimpleFeatureType(
+                    s.sft.name,
+                    [s.sft.attribute(n_) for n_ in needed],
+                    s.sft.user_data,
+                )
+                b = FeatureBatch.from_pydict(sub, {n_: [] for n_ in needed})
+            batches.append(b)
+
+        li, ri = _equi_join_indices(
+            batches[0], keys[sides[0].qual], batches[1], keys[sides[1].qual]
+        )
+        result = _join_result(sides, batches, out_items, li, ri)
+        if sort_by:
+            # ORDER BY may use qualified names or aliases; map to the
+            # result's output column names
+            names = {}
+            for it, (si, col, out) in zip(items, out_items):
+                names[out] = out
+                names[it.col] = out  # the original (possibly qualified) ref
+                names[f"{sides[si].qual}.{col}"] = out
+            try:
+                sort_by = [(names[c], asc) for c, asc in sort_by]
+            except KeyError as e:
+                raise SqlError(
+                    f"ORDER BY column {e.args[0]!r} is not in the select list"
+                )
+        result = _sort_limit_batch(result, sort_by, limit)
+        return QueryResult("features", features=result, count=len(result))
+
+    def _join_where(self, toks: _Tokens, sides: List[_JoinSide]) -> None:
+        """Top-level AND conjuncts only; each conjunct must reference ONE
+        side (qualified or uniquely-owned columns), gets its qualifiers
+        stripped, and re-parses against that side's schema so the full
+        single-table predicate grammar applies per side."""
+        while True:
+            depth = 0
+            pending_between = 0  # BETWEEN's own AND must not split
+            start = toks.i
+            while True:
+                t = toks.peek()
+                if t is None:
+                    break
+                if t == ("punct", "("):
+                    depth += 1
+                elif t == ("punct", ")"):
+                    depth -= 1
+                elif t[0] == "word" and t[1].upper() == "BETWEEN":
+                    pending_between += 1
+                elif depth == 0 and t[0] == "word" and t[1].upper() in (
+                    "AND", "ORDER", "GROUP", "LIMIT",
+                ):
+                    if t[1].upper() == "AND" and pending_between > 0:
+                        pending_between -= 1
+                    else:
+                        break
+                toks.i += 1
+            conjunct = toks.toks[start:toks.i]
+            if not conjunct:
+                raise SqlError("expected predicate in JOIN WHERE")
+            # find the side + strip qualifiers
+            side = None
+            rewritten = []
+            for kind, text in conjunct:
+                if kind == "word" and "." in text and not text.replace(".", "").isdigit():
+                    qual, col = text.split(".", 1)
+                    owner = next((s for s in sides if s.qual == qual), None)
+                    if owner is not None:
+                        if side is not None and owner is not side:
+                            raise SqlError(
+                                "JOIN WHERE conjuncts must reference one "
+                                f"table each (mixed: {text!r})"
+                            )
+                        side = owner
+                        rewritten.append((kind, col))
+                        continue
+                rewritten.append((kind, text))
+            if side is None:
+                # bare columns: unique ownership decides
+                for kind, text in rewritten:
+                    if kind == "word" and text.upper() not in _KEYWORDS:
+                        owners = [s for s in sides if text in s.sft]
+                        if len(owners) == 1:
+                            side = owners[0]
+                            break
+            if side is None:
+                raise SqlError(
+                    "cannot attribute JOIN WHERE conjunct to a table: "
+                    + " ".join(t for _, t in conjunct)
+                )
+            sub = _Tokens("")
+            sub.toks = rewritten
+            sub.i = 0
+            parsed = self._not_expr(sub, side.sft)
+            if sub.peek() is not None:
+                raise SqlError(
+                    f"could not parse JOIN WHERE conjunct at {sub.peek()}"
+                )
+            if parsed.host:
+                raise SqlError(
+                    "non-pushable predicates are not supported in JOIN WHERE"
+                )
+            side.filters.append(parsed.cql)
+            if not toks.accept_word("AND"):
+                return
+
+
+def _key_array(batch, col: str) -> np.ndarray:
+    from geomesa_tpu.core.columnar import DictColumn, GeometryColumn
+
+    c = batch.columns[col]
+    if isinstance(c, GeometryColumn):
+        raise SqlError("cannot join on a geometry column")
+    if isinstance(c, DictColumn):
+        return np.array(
+            ["\x00missing" if v is None else v for v in c.decode()]
+        )
+    return np.asarray(c)
+
+
+def _equi_join_indices(ba, ca, bb, cb):
+    """Vectorized inner equi-join: sort side B once, then searchsorted
+    ranges per side-A key; NaN/null keys never match."""
+    if ba is None or bb is None or not len(ba) or not len(bb):
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    ka = _key_array(ba, ca)
+    kb = _key_array(bb, cb)
+    if ka.dtype.kind == "f":
+        valid_a = ~np.isnan(ka)
+    else:
+        valid_a = ka != "\x00missing" if ka.dtype.kind in "UO" else np.ones(len(ka), bool)
+    order_b = np.argsort(kb, kind="stable")
+    skb = kb[order_b]
+    if kb.dtype.kind == "f":
+        keep_b = ~np.isnan(skb)
+        order_b, skb = order_b[keep_b], skb[keep_b]
+    elif kb.dtype.kind in "UO":
+        keep_b = skb != "\x00missing"
+        order_b, skb = order_b[keep_b], skb[keep_b]
+    lo = np.searchsorted(skb, ka, "left")
+    hi = np.searchsorted(skb, ka, "right")
+    counts = np.where(valid_a, hi - lo, 0)
+    total = int(counts.sum())
+    if total == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    left = np.repeat(np.arange(len(ka)), counts)
+    base = np.repeat(lo, counts)
+    cum = np.concatenate([[0], np.cumsum(counts)])[:-1]
+    within = np.arange(total) - np.repeat(cum, counts)
+    right = order_b[base + within]
+    return left, right
+
+
+def _join_result(sides, batches, out_items, li, ri):
+    import dataclasses as _dc
+
+    from geomesa_tpu.core.columnar import (
+        DictColumn, FeatureBatch, GeometryColumn)
+    from geomesa_tpu.core.sft import SimpleFeatureType
+
+    attrs = []
+    cols = {}
+    seen_geom = False
+    idx = (li, ri)
+    for si, col, name in out_items:
+        a = sides[si].sft.attribute(col)
+        default_geom = a.is_geometry and not seen_geom
+        seen_geom = seen_geom or a.is_geometry
+        attrs.append(
+            _dc.replace(a, name=name, default_geom=default_geom)
+        )
+        src = batches[si].columns[col]
+        take = idx[si]
+        if isinstance(src, (DictColumn, GeometryColumn)):
+            cols[name] = src.take(take)
+        else:
+            cols[name] = np.asarray(src)[take]
+    sub = SimpleFeatureType("join", attrs)
+    return FeatureBatch(sub, cols)
+
+
+class SqlContext(_SqlJoinMixin):
     """Execute SQL SELECTs against a DataStore-shaped catalog."""
 
     def __init__(self, datastore):
@@ -154,6 +460,34 @@ class SqlContext:
         items = self._select_list(toks)
         toks.expect_word("FROM")
         table = toks.next()[1]
+        alias1 = self._maybe_alias(toks)
+        if toks.accept_word("INNER"):
+            toks.expect_word("JOIN")
+            return self._join(toks, items, table, alias1)
+        if toks.accept_word("JOIN"):
+            return self._join(toks, items, table, alias1)
+        # single-table with an alias: bind it by stripping `alias.` /
+        # `table.` qualifiers from every remaining reference (and from the
+        # already-parsed select list) so qualified refs resolve
+        quals = {f"{q}." for q in (alias1, table) if q}
+        if quals:
+            def _strip(name: str) -> str:
+                for pre in quals:
+                    if name.startswith(pre):
+                        return name[len(pre):]
+                return name
+
+            toks.toks = toks.toks[: toks.i] + [
+                (k, _strip(v) if k == "word" else v)
+                for k, v in toks.toks[toks.i:]
+            ]
+            if items is not None:
+                for it in items:
+                    if it.col is not None:
+                        stripped = _strip(it.col)
+                        if it.alias == it.col:
+                            it.alias = stripped
+                        it.col = stripped
         sft = self.ds.get_schema(table)
 
         where = _Where(ast.Include(), [], [])

@@ -151,3 +151,68 @@ def test_dbf_large_float_roundtrip(tmp_path):
     assert rows[0]["v"] == pytest.approx(1e20)
     assert rows[1]["v"] == pytest.approx(0.5)
     assert rows[2]["v"] == pytest.approx(1e-7, abs=1e-9)
+
+
+class TestParquetConverter:
+    def test_parquet_input(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as papq
+
+        from geomesa_tpu.convert import converter_from_config
+
+        p = str(tmp_path / "in.parquet")
+        papq.write_table(
+            pa.table({
+                "name": ["a", "b", "c"],
+                "score": [1.5, 2.5, None],
+                "lon": [10.0, 20.0, 30.0],
+                "lat": [1.0, 2.0, 3.0],
+            }),
+            p,
+        )
+        sft = SimpleFeatureType.from_spec(
+            "t", "name:String,score:Double,*geom:Point"
+        )
+        conv = converter_from_config(sft, {
+            "type": "parquet",
+            "id-field": "$name",
+            "fields": [
+                {"name": "name", "path": "name"},
+                {"name": "score", "path": "score"},
+                {"name": "geom", "transform": "point($lon, $lat)"},
+            ],
+        })
+        batch = conv.convert(p)
+        assert len(batch) == 3
+        assert batch.fids.decode() == ["a", "b", "c"]
+        assert batch.columns["name"].decode() == ["a", "b", "c"]
+        np.testing.assert_allclose(batch.columns["geom"].x, [10, 20, 30])
+
+    def test_jdbc_input(self, tmp_path):
+        import sqlite3
+
+        from geomesa_tpu.convert import converter_from_config
+
+        db = str(tmp_path / "obs.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE obs (id TEXT, lon REAL, lat REAL, v REAL)")
+        conn.executemany(
+            "INSERT INTO obs VALUES (?, ?, ?, ?)",
+            [("o1", 1.0, 2.0, 7.5), ("o2", 3.0, 4.0, 8.5)],
+        )
+        conn.commit()
+        conn.close()
+        sft = SimpleFeatureType.from_spec("t", "v:Double,*geom:Point")
+        conv = converter_from_config(sft, {
+            "type": "jdbc",
+            "query": "SELECT id, lon, lat, v FROM obs ORDER BY id",
+            "id-field": "$id",
+            "fields": [
+                {"name": "v", "path": "v"},
+                {"name": "geom", "transform": "point($lon, $lat)"},
+            ],
+        })
+        batch = conv.convert(db)
+        assert len(batch) == 2
+        assert batch.fids.decode() == ["o1", "o2"]
+        np.testing.assert_allclose(np.asarray(batch.column("v")), [7.5, 8.5])

@@ -381,3 +381,133 @@ class TestStBuffer:
         assert int(np.asarray(t.column("n"))[0]) == 0
         assert np.isnan(np.asarray(t.column("lo"))[0])
         assert np.isnan(np.asarray(t.column("m"))[0])
+
+
+class TestSqlJoin:
+    """Inner equi-join with per-side pushdown (SURVEY.md:381-383 relation
+    joins)."""
+
+    def _two_tables(self, tmp_path):
+        rng = np.random.default_rng(31)
+        events_sft = SimpleFeatureType.from_spec(
+            "events", "actor:String,score:Double,*geom:Point"
+        )
+        n = 200
+        actors = rng.choice(["USA", "FRA", "CHN", "XXX"], n)
+        events = FeatureBatch.from_pydict(events_sft, {
+            "actor": actors.tolist(),
+            "score": rng.uniform(-10, 10, n),
+            "geom": np.stack([rng.uniform(-170, 170, n),
+                              rng.uniform(-80, 80, n)], 1)})
+        countries_sft = SimpleFeatureType.from_spec(
+            "countries", "code:String,pop:Double,*geom:Point"
+        )
+        countries = FeatureBatch.from_pydict(countries_sft, {
+            "code": ["USA", "FRA", "CHN", "GBR"],
+            "pop": [331.0, 67.0, 1412.0, 67.2],
+            "geom": np.array([[-98.0, 39.0], [2.0, 46.0],
+                              [104.0, 35.0], [-2.0, 54.0]])})
+        ds = DataStore(str(tmp_path / "cat"))
+        ds.create_schema(events_sft).write(events)
+        ds.create_schema(countries_sft).write(countries)
+        return ds, events, countries, actors
+
+    def test_join_parity(self, tmp_path):
+        ds, events, countries, actors = self._two_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT e.actor, e.score, c.pop FROM events e "
+            "JOIN countries c ON e.actor = c.code "
+            "WHERE e.score > 0 AND c.pop > 100"
+        )
+        t = r.features
+        scores = np.asarray(events.column("score"))
+        pops = dict(zip(countries.columns["code"].decode(),
+                        np.asarray(countries.column("pop"))))
+        exp = sum(
+            1 for a, s in zip(actors, scores)
+            if s > 0 and a in pops and pops[a] > 100
+        )
+        assert len(t) == exp
+        got_pop = np.asarray(t.column("pop"))
+        got_actor = t.columns["actor"].decode()
+        for a, p in zip(got_actor, got_pop):
+            assert pops[a] == p and pops[a] > 100
+        # XXX actors (no matching country) never appear
+        assert "XXX" not in set(got_actor)
+
+    def test_join_order_limit_and_aliases(self, tmp_path):
+        ds, events, countries, actors = self._two_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT e.score AS s, c.code FROM events e "
+            "JOIN countries c ON e.actor = c.code "
+            "ORDER BY s DESC LIMIT 5"
+        )
+        t = r.features
+        assert len(t) == 5
+        s = np.asarray(t.column("s"))
+        assert (np.diff(s) <= 0).all()
+        scores = np.asarray(events.column("score"))
+        joined = scores[np.isin(actors, ["USA", "FRA", "CHN", "GBR"])]
+        np.testing.assert_allclose(s, np.sort(joined)[::-1][:5])
+
+    def test_join_errors(self, tmp_path):
+        ds, *_ = self._two_tables(tmp_path)
+        ctx = SqlContext(ds)
+        with pytest.raises(SqlError, match="select list"):
+            ctx.sql("SELECT * FROM events e JOIN countries c ON e.actor = c.code")
+        with pytest.raises(SqlError, match="ambiguous"):
+            ctx.sql("SELECT geom FROM events e JOIN countries c ON e.actor = c.code")
+        with pytest.raises(SqlError, match="both tables"):
+            ctx.sql("SELECT e.actor FROM events e JOIN countries c ON e.actor = e.actor")
+
+    def test_join_spatial_pushdown_per_side(self, tmp_path):
+        ds, events, countries, actors = self._two_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT e.actor FROM events e JOIN countries c "
+            "ON e.actor = c.code "
+            "WHERE st_intersects(e.geom, st_makeBBOX(-90, -45, 90, 45))"
+        )
+        g = events.columns["geom"]
+        sel = (g.x >= -90) & (g.x <= 90) & (g.y >= -45) & (g.y <= 45)
+        exp = sum(
+            1 for a, m in zip(actors, sel)
+            if m and a in ("USA", "FRA", "CHN", "GBR")
+        )
+        assert (0 if r.features is None else len(r.features)) == exp
+
+    def test_join_empty_side_and_between(self, tmp_path):
+        # (round-2 review) an empty side must yield an empty result, not
+        # crash; BETWEEN's AND must not split JOIN WHERE conjuncts
+        ds, events, countries, actors = self._two_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT e.actor, c.pop FROM events e "
+            "JOIN countries c ON e.actor = c.code "
+            "WHERE e.score > 1000000000"
+        )
+        assert len(r.features) == 0 and r.count == 0
+        r = ctx.sql(
+            "SELECT e.actor FROM events e "
+            "JOIN countries c ON e.actor = c.code "
+            "WHERE e.score BETWEEN 0 AND 5 AND c.pop > 100"
+        )
+        scores = np.asarray(events.column("score"))
+        pops = dict(zip(countries.columns["code"].decode(),
+                        np.asarray(countries.column("pop"))))
+        exp = sum(1 for a, s in zip(actors, scores)
+                  if 0 <= s <= 5 and a in pops and pops[a] > 100)
+        assert (0 if r.features is None else len(r.features)) == exp
+
+    def test_single_table_alias_binds(self, tmp_path):
+        # (round-2 review) a consumed alias must resolve qualified refs
+        ds, events, countries, actors = self._two_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql("SELECT e.score FROM events e WHERE e.score > 0 "
+                    "ORDER BY e.score DESC LIMIT 3")
+        scores = np.asarray(events.column("score"))
+        np.testing.assert_allclose(
+            np.asarray(r.features.column("score")),
+            np.sort(scores[scores > 0])[::-1][:3])
