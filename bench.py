@@ -577,13 +577,15 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--impl", choices=["mxu", "grid", "compact", "haversine"],
-        default="mxu",
-        help="config-3 kNN kernel: mxu = augmented-matmul ranking keys + "
-             "deferred block selection over the full batch (default), "
-             "grid = device-built spatial index + certified neighborhood "
-             "search (amortizes over many queries), compact = device "
-             "candidate compaction + MXU kNN over matches only, haversine "
-             "= elementwise VPU",
+        default="compact",
+        help="config-3 kNN kernel: compact = device candidate compaction "
+             "+ MXU kNN over matches only (default; fastest measured at "
+             "GDELT selectivity — 108M vs 102M pts/s for mxu on v5e), "
+             "mxu = augmented-matmul ranking keys + deferred block "
+             "selection over the full batch, grid = device-built spatial "
+             "index + certified neighborhood search (amortizes over many "
+             "queries; wins at >=2048 queries/batch), haversine = "
+             "elementwise VPU",
     )
     args = p.parse_args(argv)
 

@@ -92,10 +92,17 @@ def register(sub: "argparse._SubParsersAction") -> None:
     cmd(
         "bench", "run a BASELINE benchmark config",
         _bench,
-        [(["--config"], {"type": int, "default": 3, "choices": [1, 2, 3, 4, 5],
-          "help": "BASELINE.json config (3 = headline BBOX+time+kNN)"}),
+        [(["--config"], {"type": int, "default": 3,
+          "choices": [1, 2, 3, 4, 5, 6],
+          "help": "BASELINE.json config (3 = headline BBOX+time+kNN, "
+                  "6 = polygon density)"}),
          (["--smoke"], {"action": "store_true",
           "help": "small sizes, force CPU"}),
+         (["--dist"], {"choices": ["uniform", "clustered"],
+          "default": "uniform",
+          "help": "configs 3/4: data distribution"}),
+         (["--cold"], {"action": "store_true",
+          "help": "config 1: also time the parquet->device cold path"}),
          (["--n"], {"type": int, "default": None, "help": "points"})],
     )
 
@@ -637,9 +644,11 @@ def _bench(args) -> int:
     spec = importlib.util.spec_from_file_location("geomesa_tpu_bench", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    argv = ["--config", str(args.config)]
+    argv = ["--config", str(args.config), "--dist", args.dist]
     if args.smoke:
         argv.append("--smoke")
+    if args.cold:
+        argv.append("--cold")
     if args.n is not None:
         argv += ["--n", str(args.n)]
     return mod.main(argv)

@@ -435,7 +435,8 @@ class JdbcConverter(_BaseConverter):
         else:
             conn = source
         try:
-            cur = conn.execute(self.config["query"])
+            cur = conn.cursor()
+            cur.execute(self.config["query"])
             names = [d[0] for d in cur.description]
             for line, rec in enumerate(cur, 1):
                 row = dict(zip(names, rec))
@@ -454,11 +455,13 @@ class JdbcConverter(_BaseConverter):
 
 def _columnar_field_value(conv: _BaseConverter, ctx: EvalContext, f: _Field):
     """Shared by the columnar-source converters (parquet/jdbc): `path`
-    addresses a source column by name; transforms see $0 = that value."""
+    addresses a source column by name (nested struct/list segments use the
+    same _extract rules as the JSON converter); transforms see $0 = that
+    value."""
+    from geomesa_tpu.convert.converter import _extract
+
     if f.path is not None:
-        v = ctx.named
-        for seg in f.path:
-            v = v.get(seg) if isinstance(v, dict) else None
+        v = _extract(ctx.named, f.path)
         if f.transform is not None:
             return f.transform(EvalContext([v], dict(ctx.named), ctx.line_no))
         return v

@@ -233,6 +233,7 @@ class _SqlJoinMixin:
 
         out_items = []  # (side_index, col, out_name)
         used = set()
+        used_out = set()
         for it in items:
             side, col = _resolve(sides, it.col)
             si = sides.index(side)
@@ -242,6 +243,12 @@ class _SqlJoinMixin:
                 ) else f"{side.qual}_{col}"
             )
             used.add(col)
+            if name in used_out:
+                raise SqlError(
+                    f"duplicate output column {name!r} in JOIN select "
+                    "list — use distinct AS aliases"
+                )
+            used_out.add(name)
             out_items.append((si, col, name))
 
         # fetch each side with ITS pushable filter, projected to the join
@@ -311,7 +318,11 @@ class _SqlJoinMixin:
                     depth += 1
                 elif t == ("punct", ")"):
                     depth -= 1
-                elif t[0] == "word" and t[1].upper() == "BETWEEN":
+                elif (
+                    depth == 0 and t[0] == "word" and t[1].upper() == "BETWEEN"
+                ):
+                    # a parenthesized BETWEEN keeps its AND at depth > 0,
+                    # where the splitter never breaks anyway
                     pending_between += 1
                 elif depth == 0 and t[0] == "word" and t[1].upper() in (
                     "AND", "ORDER", "GROUP", "LIMIT",

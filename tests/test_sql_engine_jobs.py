@@ -511,3 +511,21 @@ class TestSqlJoin:
         np.testing.assert_allclose(
             np.asarray(r.features.column("score")),
             np.sort(scores[scores > 0])[::-1][:3])
+
+    def test_join_parenthesized_between_and_alias_collision(self, tmp_path):
+        ds, events, countries, actors = self._two_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT e.actor FROM events e JOIN countries c "
+            "ON e.actor = c.code "
+            "WHERE (e.score BETWEEN 0 AND 5) AND c.pop > 100"
+        )
+        scores = np.asarray(events.column("score"))
+        pops = dict(zip(countries.columns["code"].decode(),
+                        np.asarray(countries.column("pop"))))
+        exp = sum(1 for a, s in zip(actors, scores)
+                  if 0 <= s <= 5 and a in pops and pops[a] > 100)
+        assert (0 if r.features is None else len(r.features)) == exp
+        with pytest.raises(SqlError, match="duplicate output column"):
+            ctx.sql("SELECT e.score AS pop, c.pop FROM events e "
+                    "JOIN countries c ON e.actor = c.code")
