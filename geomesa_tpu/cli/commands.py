@@ -90,6 +90,14 @@ def register(sub: "argparse._SubParsersAction") -> None:
          (["--partition"], {"default": None, "help": "limit to one partition"})])
     cmd("env", "show system properties", _env, [])
     cmd(
+        "sql", "run a SQL SELECT against the catalog",
+        _sql,
+        [cat,
+         (["--query", "-q"], {"required": True, "help": "SQL SELECT text"}),
+         (["--format", "-F"], {"default": "csv",
+          "choices": ["csv", "json"], "help": "output format"})],
+    )
+    cmd(
         "bench", "run a BASELINE benchmark config",
         _bench,
         [(["--config"], {"type": int, "default": 3,
@@ -652,6 +660,57 @@ def _bench(args) -> int:
     if args.n is not None:
         argv += ["--n", str(args.n)]
     return mod.main(argv)
+
+
+def _sql(args) -> int:
+    """SQL surface through the CLI (upstream exposes SQL via Spark; the
+    engine here is sql/engine.py — pushdown, GROUP BY, JOIN)."""
+    import numpy as np
+
+    from geomesa_tpu.core.columnar import DictColumn, GeometryColumn
+    from geomesa_tpu.core.wkt import to_wkt
+    from geomesa_tpu.sql.engine import SqlContext
+
+    r = SqlContext(_store(args)).sql(args.query)
+    if r.kind == "count":
+        print(r.count)
+        return 0
+    batch = r.features
+    if batch is None or not len(batch):
+        if args.format == "json":
+            print("[]")
+        return 0
+
+    def cells(col):
+        if isinstance(col, DictColumn):
+            return col.decode()
+        if isinstance(col, GeometryColumn):
+            return [to_wkt(col.geometry(i)) for i in range(len(col))]
+        return [v.item() if hasattr(v, "item") else v for v in np.asarray(col)]
+
+    names = [a.name for a in batch.sft.attributes]
+    table = {n: cells(batch.columns[n]) for n in names}
+    if args.format == "json":
+        def jval(v):
+            # NaN is the engine's SQL NULL for doubles; bare NaN is not JSON
+            if isinstance(v, float) and v != v:
+                return None
+            return v
+
+        rows = [
+            {n: jval(table[n][i]) for n in names} for i in range(len(batch))
+        ]
+        print(json.dumps(rows, default=str))
+        return 0
+    import csv as _csv
+
+    w = _csv.writer(sys.stdout)
+    w.writerow(names)
+    for i in range(len(batch)):
+        w.writerow(
+            ["" if table[n][i] is None else table[n][i] for n in names]
+        )
+    return 0
 
 
 def _env(args) -> int:

@@ -129,6 +129,7 @@ class _SelectItem:
     kind: str  # "col" | "count" | "count_col" | "sum" | "min" | "max" | "avg"
     col: Optional[str]  # None for COUNT(*)
     alias: str
+    explicit_alias: bool = False  # True iff the user wrote AS
 
 
 @dataclasses.dataclass
@@ -199,8 +200,6 @@ class _SqlJoinMixin:
 
         if items is None:
             raise SqlError("JOIN needs an explicit select list (no *)")
-        if any(it.kind != "col" for it in items):
-            raise SqlError("aggregates over JOIN are not supported yet")
         t2 = toks.next()[1]
         a2 = self._maybe_alias(toks)
         sides = [
@@ -221,6 +220,13 @@ class _SqlJoinMixin:
 
         if toks.accept_word("WHERE"):
             self._join_where(toks, sides)
+        group_by: Optional[List[str]] = None
+        if toks.accept_word("GROUP"):
+            toks.expect_word("BY")
+            group_by = [toks.next()[1]]
+            while toks.peek() == ("punct", ","):
+                toks.next()
+                group_by.append(toks.next()[1])
         sort_by = None
         if toks.accept_word("ORDER"):
             toks.expect_word("BY")
@@ -231,25 +237,65 @@ class _SqlJoinMixin:
         if toks.peek() is not None:
             raise SqlError(f"trailing tokens at {toks.peek()}")
 
-        out_items = []  # (side_index, col, out_name)
+        has_aggs = any(it.kind != "col" for it in items)
+        if group_by is not None and not has_aggs:
+            raise SqlError("GROUP BY requires aggregate select items")
+
+        # one output column per REFERENCED source column (select refs +
+        # group keys); aggregates rename their OUTPUT via aliases, the
+        # joined intermediate always uses the source-column out names
+        out_names: dict = {}  # (si, col) -> out name
+        out_items = []  # (si, col, out_name) for the joined batch
         used = set()
-        used_out = set()
-        for it in items:
-            side, col = _resolve(sides, it.col)
+
+        def ref(name: str) -> Tuple[int, str]:
+            side, col = _resolve(sides, name)
             si = sides.index(side)
-            name = it.alias if it.alias != it.col else (
-                col if col not in used and all(
+            if (si, col) not in out_names:
+                out = col if col not in used and all(
                     col not in s.sft or s is side for s in sides
                 ) else f"{side.qual}_{col}"
-            )
-            used.add(col)
-            if name in used_out:
-                raise SqlError(
-                    f"duplicate output column {name!r} in JOIN select "
-                    "list — use distinct AS aliases"
-                )
-            used_out.add(name)
-            out_items.append((si, col, name))
+                used.add(col)
+                out_names[(si, col)] = out
+                out_items.append((si, col, out))
+            return si, col
+
+        group_out: Optional[List[str]] = None
+        if group_by is not None:
+            group_out = [out_names[ref(g)] for g in group_by]
+        item_refs = [
+            ref(it.col) if it.col is not None else None for it in items
+        ]
+        if has_aggs:
+            # the joined intermediate must carry >= 1 column so its row
+            # count survives (COUNT(*) alone references nothing); the join
+            # key is fetched anyway
+            ref(f"{ls.qual}.{lc}")
+        if has_aggs:
+            for it, r in zip(items, item_refs):
+                if it.kind == "col" and (
+                    group_out is None
+                    or out_names[r] not in group_out
+                ):
+                    raise SqlError(
+                        f"column {it.col!r} must appear in GROUP BY"
+                    )
+        else:
+            # plain select: aliases rename outputs; duplicates rejected
+            used_out = set()
+            for it, r in zip(items, item_refs):
+                name = it.alias if it.alias != it.col else out_names[r]
+                if name in used_out:
+                    raise SqlError(
+                        f"duplicate output column {name!r} in JOIN select "
+                        "list — use distinct AS aliases"
+                    )
+                used_out.add(name)
+            out_items = [
+                (r[0], r[1],
+                 it.alias if it.alias != it.col else out_names[r])
+                for it, r in zip(items, item_refs)
+            ]
 
         # fetch each side with ITS pushable filter, projected to the join
         # key + that side's selected columns (no host residuals in JOIN
@@ -284,14 +330,39 @@ class _SqlJoinMixin:
             batches[0], keys[sides[0].qual], batches[1], keys[sides[1].qual]
         )
         result = _join_result(sides, batches, out_items, li, ri)
-        if sort_by:
-            # ORDER BY may use qualified names or aliases; map to the
-            # result's output column names
-            names = {}
+
+        names: dict = {}  # any spelling -> final output column name
+        if has_aggs:
+            # aggregate the joined intermediate with the single-table
+            # machinery (device segment reductions, NULL semantics)
+            t_items = []
+            for it, r in zip(items, item_refs):
+                src = out_names[r] if r is not None else None
+                alias = it.alias
+                if not it.explicit_alias:  # derive from the joined name
+                    alias = src if it.kind == "col" else (
+                        "count" if it.kind == "count"
+                        else f"{it.kind.replace('_col', '')}_{src}"
+                    )
+                alias = alias.replace(".", "_")
+                if any(t.alias == alias for t in t_items):
+                    raise SqlError(
+                        f"duplicate output column {alias!r} in JOIN select "
+                        "list — use distinct AS aliases"
+                    )
+                t_items.append(_SelectItem(it.kind, src, alias))
+                if it.col is not None:
+                    names[it.col] = alias
+                names[alias] = alias
+            result = self._aggregate(
+                result.sft, result, t_items, group_out
+            )
+        else:
             for it, (si, col, out) in zip(items, out_items):
                 names[out] = out
                 names[it.col] = out  # the original (possibly qualified) ref
                 names[f"{sides[si].qual}.{col}"] = out
+        if sort_by:
             try:
                 sort_by = [(names[c], asc) for c, asc in sort_by]
             except KeyError as e:
@@ -638,6 +709,7 @@ class SqlContext(_SqlJoinMixin):
             item = _SelectItem("col", t[1], t[1])
         if toks.accept_word("AS"):
             item.alias = toks.next()[1]
+            item.explicit_alias = True
         return item
 
     def _order_list(self, toks: _Tokens):

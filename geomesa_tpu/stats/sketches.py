@@ -186,8 +186,17 @@ class Cardinality(Stat):
             np.zeros(self.m, np.uint8) if registers is None else np.asarray(registers, np.uint8)
         )
 
+    # processed per chunk so the hash/rank temporaries stay cache-resident:
+    # one 67M-value call measured 17.6s monolithic vs 4.6s chunked (the
+    # pipeline is memory-bandwidth-bound, ~8 array passes per value)
+    _CHUNK = 1 << 21
+
     def observe(self, values, mask=None):
         v = _masked(values, mask)
+        for s in range(0, len(v), self._CHUNK):
+            self._observe_chunk(v[s : s + self._CHUNK])
+
+    def _observe_chunk(self, v):
         if not len(v):
             return
         h = _hash64(v)

@@ -385,3 +385,43 @@ class TestCLI:
         assert run_cli(["version"], cli_env).returncode == 0
         r = run_cli([], cli_env)
         assert r.returncode == 1
+
+
+class TestCliSql:
+    def test_sql_subcommand(self, tmp_path, cli_env):
+        cat = str(tmp_path / "catalog")
+        r = run_cli(["create-schema", "-c", cat, "-f", "ev",
+                     "-s", "actor:String,score:Double,dtg:Date,*geom:Point"],
+                    cli_env)
+        assert r.returncode == 0, r.stderr
+        conv = tmp_path / "conv.json"
+        conv.write_text(json.dumps({
+            "type": "delimited-text", "format": "CSV",
+            "id-field": "$1",
+            "fields": [
+                {"name": "actor", "transform": "$2::string"},
+                {"name": "score", "transform": "$3::double"},
+                {"name": "dtg", "transform": "isoDateTime($4)"},
+                {"name": "geom", "transform": "point($5, $6)"},
+            ],
+        }))
+        data = tmp_path / "ev.csv"
+        rows = [
+            "1,USA,2.0,2020-06-01T00:00:00Z,1.0,2.0",
+            "2,USA,4.0,2020-06-01T00:00:00Z,3.0,4.0",
+            "3,FRA,6.0,2020-06-01T00:00:00Z,5.0,6.0",
+        ]
+        data.write_text("\n".join(rows) + "\n")
+        r = run_cli(["ingest", "-c", cat, "-f", "ev", "-C", str(conv),
+                     str(data)], cli_env)
+        assert "ingested 3 features" in r.stdout, r.stderr
+        r = run_cli(["sql", "-c", cat, "-q",
+                     "SELECT actor, COUNT(*) AS n, SUM(score) AS s FROM ev "
+                     "GROUP BY actor ORDER BY actor"], cli_env)
+        assert r.returncode == 0, r.stderr
+        lines = r.stdout.strip().splitlines()
+        assert lines[0] == "actor,n,s"
+        assert lines[1].startswith("FRA,1,6") and lines[2].startswith("USA,2,6")
+        r = run_cli(["sql", "-c", cat, "-F", "json", "-q",
+                     "SELECT COUNT(*) FROM ev WHERE score > 3"], cli_env)
+        assert r.stdout.strip() == "2"

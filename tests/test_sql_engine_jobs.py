@@ -529,3 +529,47 @@ class TestSqlJoin:
         with pytest.raises(SqlError, match="duplicate output column"):
             ctx.sql("SELECT e.score AS pop, c.pop FROM events e "
                     "JOIN countries c ON e.actor = c.code")
+
+    def test_join_group_by_aggregates(self, tmp_path):
+        ds, events, countries, actors = self._two_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT c.code, COUNT(*) AS n, AVG(e.score) AS m, SUM(c.pop) "
+            "FROM events e JOIN countries c ON e.actor = c.code "
+            "WHERE e.score > 0 GROUP BY c.code ORDER BY c.code"
+        )
+        t = r.features
+        scores = np.asarray(events.column("score"))
+        pops = dict(zip(countries.columns["code"].decode(),
+                        np.asarray(countries.column("pop"))))
+        exp = {}
+        for a, s in zip(actors, scores):
+            if s > 0 and a in pops:
+                cnt, tot = exp.get(a, (0, 0.0))
+                exp[a] = (cnt + 1, tot + s)
+        codes = t.columns["code"].decode()
+        assert codes == sorted(exp)
+        for i, a in enumerate(codes):
+            cnt, tot = exp[a]
+            assert int(np.asarray(t.column("n"))[i]) == cnt
+            np.testing.assert_allclose(
+                np.asarray(t.column("m"))[i], tot / cnt, rtol=1e-9)
+            np.testing.assert_allclose(
+                np.asarray(t.column("sum_pop"))[i], pops[a] * cnt, rtol=1e-9)
+
+    def test_join_global_aggregate(self, tmp_path):
+        ds, events, countries, actors = self._two_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT COUNT(*) AS n FROM events e "
+            "JOIN countries c ON e.actor = c.code"
+        )
+        exp = sum(1 for a in actors if a in ("USA", "FRA", "CHN", "GBR"))
+        assert int(np.asarray(r.features.column("n"))[0]) == exp
+
+    def test_join_aggregate_duplicate_alias_rejected(self, tmp_path):
+        ds, *_ = self._two_tables(tmp_path)
+        ctx = SqlContext(ds)
+        with pytest.raises(SqlError, match="duplicate output column"):
+            ctx.sql("SELECT COUNT(*) AS x, SUM(e.score) AS x FROM events e "
+                    "JOIN countries c ON e.actor = c.code")
