@@ -1189,3 +1189,92 @@ def _sort_limit_batch(batch, sort_by, limit):
     if limit is not None and len(batch) > limit:
         batch = batch.select(np.arange(limit))
     return batch
+
+
+# -- HAVING -----------------------------------------------------------------
+
+_HAVING_KINDS = {
+    "COUNT": ("count", "count_col"),
+    "SUM": ("sum",),
+    "MIN": ("min",),
+    "MAX": ("max",),
+    "AVG": ("avg",),
+}
+
+_CMP_OPS = {
+    "=": lambda a, b: a == b, "<>": lambda a, b: a != b,
+    "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+}
+
+
+def _parse_having(toks: _Tokens):
+    """HAVING ref op literal [AND ...]; ref = output alias | AGG(col) |
+    COUNT(*). Returns [(ref, op, value)] where ref is ("NAME", x) or
+    (AGG, col)."""
+    out = []
+    while True:
+        t = toks.next()
+        if t[0] != "word":
+            raise SqlError(f"expected HAVING reference, got {t}")
+        if t[1].upper() in _AGG_FNS and toks.peek() == ("punct", "("):
+            toks.next()
+            if toks.peek() == ("punct", "*"):
+                toks.next()
+                arg = "*"
+            else:
+                arg = toks.next()[1]
+            toks.expect_punct(")")
+            ref = (t[1].upper(), arg)
+        else:
+            ref = ("NAME", t[1])
+        op_t = toks.next()
+        if op_t[0] != "op":
+            raise SqlError(f"expected comparison in HAVING, got {op_t}")
+        op = "<>" if op_t[1] == "!=" else op_t[1]
+        lit = toks.next()
+        if lit[0] == "number":
+            v = float(lit[1])
+        elif lit[0] == "string":
+            v = lit[1][1:-1].replace("''", "'")
+        else:
+            raise SqlError(f"expected literal in HAVING, got {lit}")
+        out.append((ref, op, v))
+        if not toks.accept_word("AND"):
+            return out
+
+
+def _having_alias(items, final_aliases, ref) -> str:
+    """Map a HAVING reference to the aggregate result's column name."""
+    if ref[0] == "NAME":
+        for it, fa in zip(items, final_aliases):
+            if ref[1] in (it.alias, fa):
+                return fa
+        raise SqlError(f"HAVING references unknown column {ref[1]!r}")
+    for it, fa in zip(items, final_aliases):
+        if ref[0] == "COUNT" and ref[1] == "*" and it.kind == "count":
+            return fa
+        if it.kind in _HAVING_KINDS[ref[0]] and it.col == ref[1]:
+            return fa
+    raise SqlError(
+        f"HAVING references {ref[0]}({ref[1]}) which is not in the "
+        "select list"
+    )
+
+
+def _apply_having(batch, having, items, final_aliases):
+    from geomesa_tpu.core.columnar import DictColumn
+
+    m = np.ones(len(batch), bool)
+    for ref, op, v in having:
+        name = _having_alias(items, final_aliases, ref)
+        col = batch.columns[name]
+        if isinstance(col, DictColumn):
+            vals = np.array(
+                ["" if x is None else x for x in col.decode()]
+            )
+            v = str(v)
+        else:
+            vals = np.asarray(col)
+        m &= _CMP_OPS[op](vals, v)
+    return batch.select(np.nonzero(m)[0])
