@@ -143,8 +143,8 @@ class _Where:
 
 
 _KEYWORDS = {
-    "JOIN", "INNER", "WHERE", "GROUP", "ORDER", "LIMIT", "ON", "AS", "AND",
-    "OR", "NOT", "BY",
+    "JOIN", "INNER", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "ON",
+    "AS", "AND", "OR", "NOT", "BY",
 }
 
 
@@ -227,6 +227,9 @@ class _SqlJoinMixin:
             while toks.peek() == ("punct", ","):
                 toks.next()
                 group_by.append(toks.next()[1])
+        having = None
+        if toks.accept_word("HAVING"):
+            having = _parse_having(toks)
         sort_by = None
         if toks.accept_word("ORDER"):
             toks.expect_word("BY")
@@ -240,6 +243,8 @@ class _SqlJoinMixin:
         has_aggs = any(it.kind != "col" for it in items)
         if group_by is not None and not has_aggs:
             raise SqlError("GROUP BY requires aggregate select items")
+        if having is not None and not has_aggs:
+            raise SqlError("HAVING requires an aggregated select list")
 
         # one output column per REFERENCED source column (select refs +
         # group keys); aggregates rename their OUTPUT via aliases, the
@@ -357,17 +362,43 @@ class _SqlJoinMixin:
             result = self._aggregate(
                 result.sft, result, t_items, group_out
             )
+            if having:
+                # translate qualified aggregate args (HAVING SUM(a.price))
+                # to the joined intermediate's column names before matching
+                t_having = []
+                for h_ref, h_op, h_val in having:
+                    if h_ref[0] == "NAME":
+                        # qualified group keys (HAVING c.code <> 'USA') map
+                        # through the same spelling table SELECT/ORDER use
+                        h_ref = ("NAME", names.get(h_ref[1], h_ref[1]))
+                    elif h_ref[1] != "*":
+                        h_ref = (h_ref[0], out_names[ref(h_ref[1])])
+                    t_having.append((h_ref, h_op, h_val))
+                result = _apply_having(
+                    result, t_having, t_items, [t.alias for t in t_items]
+                )
         else:
             for it, (si, col, out) in zip(items, out_items):
                 names[out] = out
                 names[it.col] = out  # the original (possibly qualified) ref
                 names[f"{sides[si].qual}.{col}"] = out
+            # a bare column name resolves when exactly one selected output
+            # carries it (it may have been renamed qual_col to disambiguate)
+            bare: dict = {}
+            for si, col, out in out_items:
+                bare.setdefault(col, set()).add(out)
+            for col, outs in bare.items():
+                if col not in names and len(outs) == 1:
+                    names[col] = next(iter(outs))
         if sort_by:
             try:
                 sort_by = [(names[c], asc) for c, asc in sort_by]
             except KeyError as e:
                 raise SqlError(
-                    f"ORDER BY column {e.args[0]!r} is not in the select list"
+                    f"ORDER BY column {e.args[0]!r} does not name exactly "
+                    "one selected output (columns present on both sides "
+                    "are renamed <alias>_<col> for disambiguation); valid "
+                    f"spellings: {sorted(set(names))}"
                 )
         result = _sort_limit_batch(result, sort_by, limit)
         return QueryResult("features", features=result, count=len(result))
@@ -396,7 +427,7 @@ class _SqlJoinMixin:
                     # where the splitter never breaks anyway
                     pending_between += 1
                 elif depth == 0 and t[0] == "word" and t[1].upper() in (
-                    "AND", "ORDER", "GROUP", "LIMIT",
+                    "AND", "ORDER", "GROUP", "HAVING", "LIMIT",
                 ):
                     if t[1].upper() == "AND" and pending_between > 0:
                         pending_between -= 1
@@ -585,6 +616,9 @@ class SqlContext(_SqlJoinMixin):
             for c in group_by:
                 if c not in sft:
                     raise SqlError(f"unknown GROUP BY column {c!r}")
+        having = None
+        if toks.accept_word("HAVING"):
+            having = _parse_having(toks)
         sort_by = None
         if toks.accept_word("ORDER"):
             toks.expect_word("BY")
@@ -601,6 +635,8 @@ class SqlContext(_SqlJoinMixin):
         )
         if group_by is not None and not has_aggs:
             raise SqlError("GROUP BY requires aggregate select items")
+        if having is not None and not has_aggs:
+            raise SqlError("HAVING requires an aggregated select list")
         if has_aggs:
             for it in items:
                 if it.kind == "col" and (
@@ -613,15 +649,19 @@ class SqlContext(_SqlJoinMixin):
         from geomesa_tpu.plan.planner import QueryResult
 
         # fast path: bare COUNT(*) with fully-pushable WHERE rides the
-        # store's count machinery (estimate shortcuts included)
+        # store's count machinery (estimate shortcuts included). LIMIT
+        # applies to the (single-row) result, never to the counted rows,
+        # so it must NOT become Query.max_features
         if (
             has_aggs
             and group_by is None
+            and having is None
+            and limit != 0  # LIMIT 0 must yield zero rows, not the count
             and len(items) == 1
             and items[0].kind == "count"
             and not where.host
         ):
-            q = Query(table, where.cql, max_features=limit)
+            q = Query(table, where.cql)
             return QueryResult("count", count=src.get_count(q))
 
         if has_aggs:
@@ -639,6 +679,10 @@ class SqlContext(_SqlJoinMixin):
             if batch is not None and where.host:
                 batch = self._apply_host(batch, where)
             result = self._aggregate(sft, batch, items, group_by)
+            if having:
+                result = _apply_having(
+                    result, having, items, [it.alias for it in items]
+                )
             result = _sort_limit_batch(result, sort_by, limit)
             return QueryResult(
                 "features", features=result, count=len(result)
@@ -1270,11 +1314,20 @@ def _apply_having(batch, having, items, final_aliases):
         name = _having_alias(items, final_aliases, ref)
         col = batch.columns[name]
         if isinstance(col, DictColumn):
+            if not isinstance(v, str):
+                raise SqlError(
+                    f"HAVING compares string column {name!r} against "
+                    f"numeric literal {v!r}"
+                )
             vals = np.array(
                 ["" if x is None else x for x in col.decode()]
             )
-            v = str(v)
         else:
+            if isinstance(v, str):
+                raise SqlError(
+                    f"HAVING compares numeric column {name!r} against "
+                    f"string literal {v!r}"
+                )
             vals = np.asarray(col)
         m &= _CMP_OPS[op](vals, v)
     return batch.select(np.nonzero(m)[0])

@@ -573,3 +573,147 @@ class TestSqlJoin:
         with pytest.raises(SqlError, match="duplicate output column"):
             ctx.sql("SELECT COUNT(*) AS x, SUM(e.score) AS x FROM events e "
                     "JOIN countries c ON e.actor = c.code")
+
+
+class TestSqlHaving:
+    """HAVING + COUNT(*) LIMIT semantics (round-2 advisor findings)."""
+
+    def test_count_star_limit_not_capped(self, tmp_path):
+        # LIMIT applies to the single result row, never to the counted rows
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        full = ctx.sql("SELECT COUNT(*) FROM gdelt WHERE score > 0").count
+        assert full > 5
+        r = ctx.sql("SELECT COUNT(*) FROM gdelt WHERE score > 0 LIMIT 5")
+        assert r.count == full
+
+    def test_having_on_group_by(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT actor, COUNT(*) AS n, AVG(score) AS m FROM gdelt "
+            "GROUP BY actor HAVING COUNT(*) > 100 AND m > -5 ORDER BY actor"
+        )
+        actors = batch.columns["actor"].decode()
+        scores = np.asarray(batch.column("score"))
+        exp = {}
+        for a, s in zip(actors, scores):
+            c, t = exp.get(a, (0, 0.0))
+            exp[a] = (c + 1, t + s)
+        keep = sorted(
+            a for a, (c, t) in exp.items() if c > 100 and t / c > -5
+        )
+        t = r.features
+        assert t.columns["actor"].decode() == keep
+        for i, a in enumerate(keep):
+            assert int(np.asarray(t.column("n"))[i]) == exp[a][0]
+
+    def test_having_agg_not_selected_rejected(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        with pytest.raises(SqlError, match="not in the\n?.*select list|not in the select"):
+            ctx.sql(
+                "SELECT actor, COUNT(*) FROM gdelt GROUP BY actor "
+                "HAVING SUM(score) > 0"
+            )
+
+    def test_having_without_aggregates_rejected(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        with pytest.raises(SqlError, match="HAVING requires"):
+            ctx.sql("SELECT actor FROM gdelt HAVING actor = 'USA'")
+
+    def test_join_having_qualified_agg(self, tmp_path):
+        rng = np.random.default_rng(31)
+        events_sft = SimpleFeatureType.from_spec(
+            "events", "actor:String,score:Double,*geom:Point"
+        )
+        n = 200
+        actors = rng.choice(["USA", "FRA", "CHN", "XXX"], n)
+        events = FeatureBatch.from_pydict(events_sft, {
+            "actor": actors.tolist(),
+            "score": rng.uniform(-10, 10, n),
+            "geom": np.stack([rng.uniform(-170, 170, n),
+                              rng.uniform(-80, 80, n)], 1)})
+        countries_sft = SimpleFeatureType.from_spec(
+            "countries", "code:String,pop:Double,*geom:Point"
+        )
+        countries = FeatureBatch.from_pydict(countries_sft, {
+            "code": ["USA", "FRA", "CHN", "GBR"],
+            "pop": [331.0, 67.0, 1412.0, 67.2],
+            "geom": np.array([[-98.0, 39.0], [2.0, 46.0],
+                              [104.0, 35.0], [-2.0, 54.0]])})
+        ds = DataStore(str(tmp_path / "cat"))
+        ds.create_schema(events_sft).write(events)
+        ds.create_schema(countries_sft).write(countries)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT c.code, COUNT(*) AS n, SUM(e.score) FROM events e "
+            "JOIN countries c ON e.actor = c.code "
+            "GROUP BY c.code HAVING SUM(e.score) > 0 ORDER BY c.code"
+        )
+        scores = np.asarray(events.column("score"))
+        exp = {}
+        for a, s in zip(actors, scores):
+            if a in ("USA", "FRA", "CHN", "GBR"):
+                c, t = exp.get(a, (0, 0.0))
+                exp[a] = (c + 1, t + s)
+        keep = sorted(a for a, (c, t) in exp.items() if t > 0)
+        assert r.features.columns["code"].decode() == keep
+
+    def test_join_order_by_unambiguous_bare_name(self, tmp_path):
+        # both sides carry 'geom'; 'pop' only exists on countries but was
+        # renamed is not the case -- select both sides' score-like columns
+        rng = np.random.default_rng(31)
+        a_sft = SimpleFeatureType.from_spec("ta", "k:String,v:Double,*geom:Point")
+        b_sft = SimpleFeatureType.from_spec("tb", "k:String,w:Double,*geom:Point")
+        na = 20
+        ka = rng.choice(["p", "q"], na)
+        ds = DataStore(str(tmp_path / "cat"))
+        ds.create_schema(a_sft).write(FeatureBatch.from_pydict(a_sft, {
+            "k": ka.tolist(), "v": rng.uniform(0, 1, na),
+            "geom": np.stack([rng.uniform(-10, 10, na),
+                              rng.uniform(-10, 10, na)], 1)}))
+        ds.create_schema(b_sft).write(FeatureBatch.from_pydict(b_sft, {
+            "k": ["p", "q"], "w": [1.0, 2.0],
+            "geom": np.array([[0.0, 0.0], [1.0, 1.0]])}))
+        ctx = SqlContext(ds)
+        # 'k' exists on both sides -> selected a.k is renamed a_k; the bare
+        # spelling still resolves because only ONE selected output carries it
+        r = ctx.sql(
+            "SELECT a.k, a.v FROM ta a JOIN tb b ON a.k = b.k ORDER BY k"
+        )
+        got = r.features.columns["a_k"].decode()
+        assert got == sorted(got)
+        # ambiguous bare name in ORDER BY lists valid spellings
+        with pytest.raises(SqlError, match="valid spellings"):
+            ctx.sql(
+                "SELECT a.k AS x, b.k AS yz, a.v FROM ta a "
+                "JOIN tb b ON a.k = b.k ORDER BY nosuch"
+            )
+
+    def test_having_review_fixes(self, tmp_path):
+        # string-vs-number HAVING comparisons error instead of silently
+        # stringifying; COUNT(*) LIMIT 0 yields zero rows; qualified group
+        # keys resolve in JOIN HAVING
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        with pytest.raises(SqlError, match="string column"):
+            ctx.sql("SELECT actor, COUNT(*) FROM gdelt GROUP BY actor "
+                    "HAVING actor > 5")
+        with pytest.raises(SqlError, match="numeric column"):
+            ctx.sql("SELECT actor, COUNT(*) AS n FROM gdelt GROUP BY actor "
+                    "HAVING n = 'x'")
+        r = ctx.sql("SELECT COUNT(*) FROM gdelt LIMIT 0")
+        assert r.features is not None and len(r.features) == 0
+
+    def test_join_having_qualified_group_key(self, tmp_path):
+        ds, events, countries, actors = TestSqlJoin()._two_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT c.code, COUNT(*) AS n FROM events e "
+            "JOIN countries c ON e.actor = c.code "
+            "GROUP BY c.code HAVING c.code <> 'USA' ORDER BY c.code"
+        )
+        got = r.features.columns["code"].decode()
+        assert "USA" not in got and got == sorted(got)
