@@ -1,0 +1,425 @@
+"""Fused scan kNN: Pallas chord-key block-minima + deferred block refine.
+
+Parity role: the server-side scan half of KNearestNeighborSearchProcess
+(geomesa-process knn/) — the reference streams index-scan hits through a
+per-tablet iterator and merges client-side; here ONE fused device pass
+scans the whole candidate batch (SURVEY.md §5.7 feature-set scaling).
+
+Why these kernels exist (measured on v5e, 67M points, 256 queries):
+the XLA path (`knn_compact`) pays three separate HBM regimes —
+  1. flat `lax.top_k` stream compaction over 67M lanes   ~180 ms
+  2. element gather of 4.2M matched rows                  ~90 ms
+  3. `knn_mxu`'s scan, whose [Q, data_tile] ranking-key
+     matmul output round-trips HBM every fold step       ~20 ms/4.2M
+                                                         (~320 ms at 67M)
+The dense kernel (`knn_fullscan`) replaces all three with the
+flash-attention access pattern: stream coordinate tiles through VMEM,
+compute the centered chord ranking key (MXU matmul, K=4) IN VMEM, reduce
+each BLK-lane block to its minimum, and emit only the [Q, N/BLK] minima:
+
+  minima = pallas_scan(x, y, maskf)             # one HBM pass, fused
+  blocks = two-level top-m over minima          # m winning blocks/query
+  refine = exact haversine over m*BLK gathered  # block-granular gather —
+           lanes -> top-k                       # measured as fast as a
+                                                # contiguous copy
+
+Its wall is the MXU OUTPUT RATE, not HBM: [Q=256] x [N=67M] keys at ~128
+results/cycle is ~134 M cycles (~140 ms @ 0.94 GHz) no matter how the
+reduction is tuned (measured 122 ms with the VPU reduction overlapped).
+Brute force is therefore Q-bound, which is what the SPARSE kernel
+(`knn_sparse_scan`) attacks: a scalar-prefetched list of match-bearing
+data tiles drives the BlockSpec index maps, so unselected tiles never
+leave HBM and the MXU bound scales with sum(selected tiles) instead of N.
+On store-ordered (Z-sorted) batches a bbox predicate touches ~selectivity
+fraction of tiles; on randomly-ordered batches it degrades to the dense
+cost plus one cheap pass (every tile holds a match).
+
+Exactness (both kernels): identical argument to knn_mxu's deferred block
+selection — if a true top-m element's block were unpicked, the m picked
+blocks each hold an element with key <= it, so its rank exceeds m >= k
+(m_blocks >= k is REQUIRED and checked at trace time). The final k always
+comes from exact haversine over the gathered candidates, and the
+guarantee is noise-independent: it needs only a per-row-monotonic ranking
+key, which any f32 rounding of chord^2 still is within each block's min.
+
+The ranking key is the centered augmented form (knn_mxu's derivation):
+  key(q, d) = |d-c|^2 - 2 (q-c).(d-c) + (1-mask) * 1e9
+monotonic in chord^2 within a query row; c = the query set's mean unit
+vector, so f32 resolution scales with distance-from-centroid.
+
+Mosaic constraints that shaped the code (each cost a compile attempt):
+64-bit anything is rejected -> trace under jax.enable_x64(False); output
+block lane dims must be >=128 or the full array -> DATA_TILE/BLK = 128;
+dynamic (fori_loop-indexed) sub-128-lane vector stores don't legalize ->
+the chunk sweep is a PYTHON loop (static store offsets), and >8 unrolled
+bodies send Mosaic compile time past 10 minutes -> DATA_TILE/CHUNK = 4.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from geomesa_tpu.engine.geodesy import haversine_m
+from geomesa_tpu.engine.knn import _topk_smallest, _twolevel_smallest, _unit3
+
+BLK = 128  # minima granularity: one minimum per BLK data lanes
+DATA_TILE = 16384  # lanes per pallas program (output block [Q, 128])
+CHUNK = 4096  # key-matrix chunk inside the kernel ([Q, CHUNK] in VMEM)
+PENALTY = 1e9  # additive key for masked rows (|key| <= 12 for real rows)
+
+
+def _chunk_body(aug_q, cx, cy, cz, x_ref, y_ref, m_ref, out_ref, s: int,
+                chunk: int, blk: int, extra: float = 0.0):
+    """One static chunk: unit vectors + MXU key + blk-lane minima."""
+    q = aug_q.shape[0]
+    sl = slice(s * chunk, (s + 1) * chunk)
+    rlon = jnp.radians(x_ref[0, sl])  # [chunk]
+    rlat = jnp.radians(y_ref[0, sl])
+    cl = jnp.cos(rlat)
+    dx = cl * jnp.cos(rlon) - cx
+    dy = cl * jnp.sin(rlon) - cy
+    dz = jnp.sin(rlat) - cz
+    nd = dx * dx + dy * dy + dz * dz
+    ndm = nd + (1.0 - m_ref[0, sl]) * PENALTY + extra  # [chunk]
+
+    # [Q, 4] x [4, chunk] on the MXU: key = ndm - 2 (q-c).(d-c)
+    aug_d = jnp.stack([dx, dy, dz, ndm])  # [4, chunk]
+    key = jnp.dot(
+        aug_q, aug_d,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [Q, chunk]
+    nb = chunk // blk
+    out_ref[:, s * nb: (s + 1) * nb] = key.reshape(q, nb, blk).min(axis=-1)
+
+
+def _make_kernel(data_tile: int, chunk: int, blk: int):
+    def _scan_kernel(aug_q_ref, c_ref, x_ref, y_ref, m_ref, out_ref):
+        aug_q = aug_q_ref[...]
+        cx = c_ref[0, 0]
+        cy = c_ref[0, 1]
+        cz = c_ref[0, 2]
+        # the [Q, data_tile] key matrix would blow VMEM, so the tile is
+        # swept in chunk-lane slices (static Python loop — see module
+        # docstring for why not fori_loop)
+        for s in range(data_tile // chunk):
+            _chunk_body(aug_q, cx, cy, cz, x_ref, y_ref, m_ref, out_ref,
+                        s, chunk, blk)
+
+    return _scan_kernel
+
+
+def chord_blockmin(
+    qx: jax.Array,
+    qy: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    maskf: jax.Array,
+    blk: int = BLK,
+    data_tile: int = DATA_TILE,
+    chunk: int = CHUNK,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """One fused pass: [Q] queries x [N] points -> ([Q, N/blk] block
+    minima of the centered chord ranking key, [3] centroid). N must be a
+    multiple of data_tile; maskf is the predicate mask as f32 0/1."""
+    from jax.experimental import pallas as pl
+
+    n = x.shape[0]
+    q = qx.shape[0]
+    assert n % data_tile == 0, (n, data_tile)
+    chunk = min(chunk, data_tile)
+    assert data_tile % chunk == 0 and chunk % blk == 0, (
+        data_tile, chunk, blk)
+    qu = _unit3(qx, qy)  # [Q, 3]
+    c = qu.mean(axis=0)  # [3]
+    qc = qu - c
+    aug_q = jnp.concatenate([-2.0 * qc, jnp.ones((q, 1), jnp.float32)], 1)
+    carr = jnp.zeros((1, 128), jnp.float32).at[0, :3].set(c)
+
+    grid = (n // data_tile,)
+    out_lanes = data_tile // blk
+    # Mosaic rejects 64-bit types; trace with x64 off so index-map and
+    # in-kernel literals stay i32/f32 under the repo's global x64 mode
+    with jax.enable_x64(False):
+        minima = pl.pallas_call(
+            _make_kernel(data_tile, chunk, blk),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((q, 4), lambda j: (0, 0)),
+                pl.BlockSpec((1, 128), lambda j: (0, 0)),
+                pl.BlockSpec((1, data_tile), lambda j: (0, j)),
+                pl.BlockSpec((1, data_tile), lambda j: (0, j)),
+                pl.BlockSpec((1, data_tile), lambda j: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((q, out_lanes), lambda j: (0, j)),
+            out_shape=jax.ShapeDtypeStruct((q, n // blk), jnp.float32),
+            interpret=interpret,
+        )(aug_q, carr, x.reshape(1, n), y.reshape(1, n), maskf.reshape(1, n))
+    return minima, c
+
+
+def _make_sparse_kernel(data_tile: int, chunk: int, blk: int):
+    """Program p processes the data tile named by the scalar-prefetched
+    `ids` array; programs past `nsel` (capacity padding) emit PENALTY
+    without touching the MXU."""
+
+    def _kernel(ids_ref, nsel_ref, aug_q_ref, c_ref, x_ref, y_ref, m_ref,
+                out_ref):
+        from jax.experimental import pallas as pl
+
+        p = pl.program_id(0)
+
+        @pl.when(p < nsel_ref[0])
+        def _live():
+            aug_q = aug_q_ref[...]
+            cx = c_ref[0, 0]
+            cy = c_ref[0, 1]
+            cz = c_ref[0, 2]
+            for s in range(data_tile // chunk):
+                _chunk_body(aug_q, cx, cy, cz, x_ref, y_ref, m_ref,
+                            out_ref, s, chunk, blk)
+
+        @pl.when(p >= nsel_ref[0])
+        def _dead():
+            out_ref[...] = jnp.full_like(out_ref, PENALTY)
+
+    return _kernel
+
+
+def chord_blockmin_sparse(
+    qx: jax.Array,
+    qy: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    maskf: jax.Array,
+    tile_ids: jax.Array,
+    n_sel: jax.Array,
+    blk: int = BLK,
+    data_tile: int = DATA_TILE,
+    chunk: int = CHUNK,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Sparse block-minima: only the data tiles named by `tile_ids` are
+    scanned. tile_ids is a static-capacity [C] int32 array (entries past
+    `n_sel` are ignored — their minima come out as +PENALTY). Returns
+    ([Q, C * data_tile/blk] minima over the SELECTED tiles in tile_ids
+    order, [3] centroid)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = x.shape[0]
+    q = qx.shape[0]
+    assert n % data_tile == 0, (n, data_tile)
+    chunk = min(chunk, data_tile)
+    cap = tile_ids.shape[0]
+    qu = _unit3(qx, qy)
+    c = qu.mean(axis=0)
+    qc = qu - c
+    aug_q = jnp.concatenate([-2.0 * qc, jnp.ones((q, 1), jnp.float32)], 1)
+    carr = jnp.zeros((1, 128), jnp.float32).at[0, :3].set(c)
+    out_lanes = data_tile // blk
+
+    with jax.enable_x64(False):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # tile_ids, n_sel
+            grid=(cap,),
+            in_specs=[
+                pl.BlockSpec((q, 4), lambda p, ids, ns: (0, 0)),
+                pl.BlockSpec((1, 128), lambda p, ids, ns: (0, 0)),
+                pl.BlockSpec((1, data_tile), lambda p, ids, ns: (0, ids[p])),
+                pl.BlockSpec((1, data_tile), lambda p, ids, ns: (0, ids[p])),
+                pl.BlockSpec((1, data_tile), lambda p, ids, ns: (0, ids[p])),
+            ],
+            out_specs=pl.BlockSpec(
+                (q, out_lanes), lambda p, ids, ns: (0, p)
+            ),
+        )
+        minima = pl.pallas_call(
+            _make_sparse_kernel(data_tile, chunk, blk),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((q, cap * out_lanes), jnp.float32),
+            interpret=interpret,
+        )(
+            tile_ids.astype(jnp.int32),
+            jnp.asarray(n_sel, jnp.int32).reshape(1),
+            aug_q, carr,
+            x.reshape(1, n), y.reshape(1, n), maskf.reshape(1, n),
+        )
+    return minima, c
+
+
+def _refine(qx, qy, xf, yf, maskf, orig_blk, n, k, blk):
+    """Exact haversine over the selected blocks' lanes -> top-k.
+    Block-granular gather: rows of blk contiguous lanes (measured as fast
+    as a contiguous copy; element gathers are ~50x slower)."""
+    q = qx.shape[0]
+    mb = orig_blk.shape[1]
+    nb = xf.shape[0] // blk
+    xb = xf.reshape(nb, blk)
+    yb = yf.reshape(nb, blk)
+    vb = maskf.reshape(nb, blk) > 0.5
+    gx = jnp.take(xb, orig_blk, axis=0).reshape(q, mb * blk)
+    gy = jnp.take(yb, orig_blk, axis=0).reshape(q, mb * blk)
+    gv = jnp.take(vb, orig_blk, axis=0).reshape(q, mb * blk)
+    lane = (orig_blk[:, :, None] * blk + jnp.arange(blk, dtype=jnp.int32)
+            ).reshape(q, mb * blk)
+
+    d = haversine_m(
+        qx[:, None].astype(jnp.float32), qy[:, None].astype(jnp.float32),
+        gx, gy,
+    )
+    d = jnp.where(gv & (lane < n), d, jnp.float32(jnp.inf))
+    fd, sel = _topk_smallest(d, k)
+    fi = jnp.minimum(jnp.take_along_axis(lane, sel, axis=1), n - 1)
+    return fd, fi
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "m_blocks", "blk", "data_tile", "interpret"),
+)
+def knn_fullscan(
+    qx: jax.Array,
+    qy: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    k: int,
+    m_blocks: int = 64,
+    blk: int = BLK,
+    data_tile: int = DATA_TILE,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN over the masked batch in one fused dense scan (no
+    compaction, no capacity, no host round trip). Same contract as `knn`:
+    returns (dists [Q, k] meters, indices [Q, k] into the original
+    arrays). m_blocks >= k required (see module docstring); N is padded
+    to data_tile internally (padded lanes masked out)."""
+    n = x.shape[0]
+    q = qx.shape[0]
+    if k > m_blocks:  # trace-time contract: exactness needs m >= k
+        raise ValueError(
+            f"k={k} exceeds m_blocks={m_blocks}: the deferred block "
+            "selection only guarantees the top-m_blocks elements"
+        )
+    pad = (-n) % data_tile
+    xf = jnp.pad(x.astype(jnp.float32), (0, pad))
+    yf = jnp.pad(y.astype(jnp.float32), (0, pad))
+    maskf = jnp.pad(mask.astype(jnp.float32), (0, pad))
+    npad = n + pad
+
+    minima, _ = chord_blockmin(
+        qx, qy, xf, yf, maskf,
+        blk=blk, data_tile=data_tile, interpret=interpret,
+    )
+    mb = min(m_blocks, npad // blk)
+    _, blkid = _twolevel_smallest(minima, mb)  # [Q, mb]
+    return _refine(qx, qy, xf, yf, maskf, blkid, n, k, blk)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k", "m_blocks", "blk", "data_tile", "tile_capacity", "interpret"
+    ),
+)
+def knn_sparse_scan(
+    qx: jax.Array,
+    qy: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    k: int,
+    tile_capacity: int,
+    m_blocks: int = 64,
+    blk: int = BLK,
+    data_tile: int = DATA_TILE,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Exact kNN over the masked batch scanning ONLY data tiles that hold
+    at least one match. Same contract as `knn` plus an overflow flag:
+    (dists [Q, k], indices [Q, k], overflow bool scalar).
+
+    The win is proportional to match clustering: on store-ordered
+    (Z-sorted) batches a bbox predicate selects a contiguous ~selectivity
+    fraction of tiles; on randomly-ordered batches nearly every tile has
+    a match and this degrades to the dense kernel plus one cheap pass.
+    `tile_capacity` is the static bound on selected tiles (callers bucket
+    it pow2 from the planner's selectivity estimate — overshoot is cheap,
+    dead programs skip the MXU); if more tiles match, `overflow` is True,
+    the top-k silently ignored the highest-id matching tiles, and the
+    caller MUST fall back (knn_fullscan). m_blocks >= k required."""
+    n = x.shape[0]
+    if k > m_blocks:
+        raise ValueError(
+            f"k={k} exceeds m_blocks={m_blocks}: the deferred block "
+            "selection only guarantees the top-m_blocks elements"
+        )
+    pad = (-n) % data_tile
+    xf = jnp.pad(x.astype(jnp.float32), (0, pad))
+    yf = jnp.pad(y.astype(jnp.float32), (0, pad))
+    maskf = jnp.pad(mask.astype(jnp.float32), (0, pad))
+    npad = n + pad
+    ntiles = npad // data_tile
+    tile_capacity = min(tile_capacity, ntiles)
+
+    # matching tiles (ascending ids), static capacity
+    tmatch = maskf.reshape(ntiles, data_tile).max(axis=1) > 0.0
+    n_sel = jnp.sum(tmatch.astype(jnp.int32))
+    overflow = n_sel > tile_capacity
+    picked = jax.lax.top_k(
+        jnp.where(tmatch, -jnp.arange(ntiles, dtype=jnp.int32),
+                  -(1 << 30)),
+        tile_capacity,
+    )[0]
+    tile_ids = jnp.where(picked > -(1 << 30), -picked, 0)
+
+    minima, _ = chord_blockmin_sparse(
+        qx, qy, xf, yf, maskf, tile_ids, n_sel,
+        blk=blk, data_tile=data_tile, interpret=interpret,
+    )
+    bpt = data_tile // blk  # blocks per tile
+    mb = min(m_blocks, minima.shape[1])
+    _, selblk = _twolevel_smallest(minima, mb)  # [Q, mb] in minima space
+    # minima-space block -> original block id
+    orig_blk = jnp.take(tile_ids, selblk // bpt) * bpt + selblk % bpt
+    fd, fi = _refine(qx, qy, xf, yf, maskf, orig_blk, n, k, blk)
+    return fd, fi, overflow
+
+
+def knn_fullscan_tiled(
+    qx: jax.Array,
+    qy: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    k: int,
+    m_blocks: int = 64,
+    query_tile: int = 256,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """knn_fullscan for arbitrary Q: queries processed in centroid-centered
+    tiles of `query_tile` (each tile re-scans the batch — the scan is one
+    HBM pass, so wall time scales with ceil(Q/query_tile))."""
+    q = qx.shape[0]
+    if q <= query_tile:
+        return knn_fullscan(qx, qy, x, y, mask, k=k, m_blocks=m_blocks,
+                            interpret=interpret)
+    pad = (-q) % query_tile
+    qxp = jnp.pad(qx, (0, pad), mode="edge")
+    qyp = jnp.pad(qy, (0, pad), mode="edge")
+
+    def tile(args):
+        tx, ty = args
+        return knn_fullscan(tx, ty, x, y, mask, k=k, m_blocks=m_blocks,
+                            interpret=interpret)
+
+    fd, fi = jax.lax.map(
+        tile, (qxp.reshape(-1, query_tile), qyp.reshape(-1, query_tile))
+    )
+    return fd.reshape(-1, k)[:q], fi.reshape(-1, k)[:q]

@@ -364,13 +364,21 @@ class _SqlJoinMixin:
             )
             if having:
                 # translate qualified aggregate args (HAVING SUM(a.price))
-                # to the joined intermediate's column names before matching
+                # to the joined intermediate's column names before
+                # matching. NAME refs may only be output aliases or group
+                # keys — `names` also maps aggregate ARGUMENT spellings
+                # (e.g. 'e.score' -> 'sum_score'), which must NOT make a
+                # raw ungrouped column reference silently mean its SUM
+                h_names = {}
+                for it, t in zip(items, t_items):
+                    h_names[t.alias] = t.alias
+                    if t.kind == "col":
+                        h_names[it.col] = t.alias
+                        h_names[t.col] = t.alias
                 t_having = []
                 for h_ref, h_op, h_val in having:
                     if h_ref[0] == "NAME":
-                        # qualified group keys (HAVING c.code <> 'USA') map
-                        # through the same spelling table SELECT/ORDER use
-                        h_ref = ("NAME", names.get(h_ref[1], h_ref[1]))
+                        h_ref = ("NAME", h_names.get(h_ref[1], h_ref[1]))
                     elif h_ref[1] != "*":
                         h_ref = (h_ref[0], out_names[ref(h_ref[1])])
                     t_having.append((h_ref, h_op, h_val))
@@ -656,11 +664,22 @@ class SqlContext(_SqlJoinMixin):
             has_aggs
             and group_by is None
             and having is None
-            and limit != 0  # LIMIT 0 must yield zero rows, not the count
             and len(items) == 1
             and items[0].kind == "count"
             and not where.host
         ):
+            if limit == 0:
+                # LIMIT 0 yields zero rows — WITHOUT scanning anything
+                from geomesa_tpu.core.columnar import FeatureBatch
+                from geomesa_tpu.core.sft import SimpleFeatureType
+
+                empty = FeatureBatch.from_pydict(
+                    SimpleFeatureType.from_spec(
+                        "result", f"{items[0].alias}:Long"
+                    ),
+                    {items[0].alias: np.zeros(0, np.int64)},
+                )
+                return QueryResult("features", features=empty, count=0)
             q = Query(table, where.cql)
             return QueryResult("count", count=src.get_count(q))
 

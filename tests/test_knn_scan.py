@@ -1,0 +1,154 @@
+"""Fused-scan kNN kernel tests (Pallas interpret mode on CPU; the same
+code path compiles via Mosaic on TPU — measured there at 570M pts/s
+sparse / 259M dense on the 67M-point config-3 shape).
+
+Parity oracle: NumPy f64 haversine + argpartition over the masked rows
+(tests/reference_engine.py style), the same oracle the bench gates on.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from geomesa_tpu.engine.geodesy import haversine_m_np
+from geomesa_tpu.engine.knn_scan import (
+    chord_blockmin, knn_fullscan, knn_fullscan_tiled, knn_sparse_scan)
+
+# tiny tiles: interpret mode executes the grid serially in Python — the
+# TPU-targeted tile sizes would take minutes per call on CPU
+TINY = dict(blk=256, data_tile=2048)
+
+
+def oracle(qx, qy, x, y, mask, k):
+    out = np.empty((len(qx), k))
+    cx, cy = x[mask], y[mask]
+    for i in range(len(qx)):
+        d = haversine_m_np(qx[i], qy[i], cx, cy)
+        if len(d) >= k:
+            out[i] = np.sort(d[np.argpartition(d, k - 1)[:k]])
+        else:
+            out[i, : len(d)] = np.sort(d)
+            out[i, len(d):] = np.inf
+    return out
+
+
+def make(n, q, seed=7, sorted_x=False, sel=0.4):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-180, 180, n)
+    if sorted_x:
+        x = np.sort(x)
+    y = rng.uniform(-90, 90, n)
+    mask = rng.random(n) < sel
+    qx = rng.uniform(-30, 30, q)
+    qy = rng.uniform(-60, 60, q)
+    dev = [jnp.asarray(a, jnp.float32) for a in (qx, qy, x, y)]
+    return qx, qy, x, y, mask, dev + [jnp.asarray(mask)]
+
+
+class TestFullscan:
+    def test_parity_random_mask(self):
+        qx, qy, x, y, mask, dev = make(6000, 24)
+        fd, fi = knn_fullscan(*dev, k=5, m_blocks=8, interpret=True, **TINY)
+        exp = oracle(qx, qy, x, y, mask, 5)
+        np.testing.assert_allclose(
+            np.sort(np.asarray(fd), 1), exp, rtol=1e-4, atol=1.0)
+        # returned indices are real matches whose distances reproduce fd
+        idx = np.asarray(fi)
+        for i in range(5):
+            dd = haversine_m_np(qx[i], qy[i], x[idx[i]], y[idx[i]])
+            np.testing.assert_allclose(
+                np.sort(dd), np.sort(np.asarray(fd)[i]), rtol=1e-4, atol=1.0)
+            assert mask[idx[i]].all()
+
+    def test_fewer_matches_than_k(self):
+        qx, qy, x, y, _, dev = make(4096, 8)
+        mask = np.zeros(4096, bool)
+        mask[[5, 99, 3000]] = True
+        dev[4] = jnp.asarray(mask)
+        fd, fi = knn_fullscan(*dev, k=6, m_blocks=8, interpret=True, **TINY)
+        fd = np.asarray(fd)
+        assert np.isfinite(fd[:, :3]).all() and np.isinf(fd[:, 3:]).all()
+        assert mask[np.asarray(fi)[:, :3]].all()
+
+    def test_m_blocks_contract(self):
+        _, _, _, _, _, dev = make(2048, 4)
+        with pytest.raises(ValueError, match="m_blocks"):
+            knn_fullscan(*dev, k=9, m_blocks=8, interpret=True, **TINY)
+
+    def test_query_tiling(self):
+        qx, qy, x, y, mask, dev = make(4096, 40)
+        fd, _ = knn_fullscan_tiled(
+            *dev, k=3, m_blocks=4, query_tile=16, interpret=True)
+        exp = oracle(qx, qy, x, y, mask, 3)
+        np.testing.assert_allclose(
+            np.sort(np.asarray(fd), 1), exp, rtol=1e-4, atol=1.0)
+
+    def test_blockmin_matches_dense_key(self):
+        rng = np.random.default_rng(3)
+        n, q = 2048, 8
+        x = rng.uniform(-180, 180, n).astype(np.float32)
+        y = rng.uniform(-90, 90, n).astype(np.float32)
+        mf = (rng.random(n) < 0.5).astype(np.float32)
+        qx = rng.uniform(-30, 30, q).astype(np.float32)
+        qy = rng.uniform(30, 60, q).astype(np.float32)
+        minima, c = chord_blockmin(
+            jnp.asarray(qx), jnp.asarray(qy), jnp.asarray(x), jnp.asarray(y),
+            jnp.asarray(mf), blk=256, data_tile=2048, interpret=True)
+
+        def unit3(lon, lat):
+            rl, rt = np.radians(lon), np.radians(lat)
+            return np.stack([np.cos(rt) * np.cos(rl),
+                             np.cos(rt) * np.sin(rl), np.sin(rt)], -1)
+
+        qu = unit3(qx, qy).astype(np.float32)
+        cc = qu.mean(0)
+        dc = unit3(x, y).astype(np.float32) - cc
+        nd = (dc * dc).sum(1) + (1 - mf) * 1e9
+        key = nd[None, :] - 2 * ((qu - cc) @ dc.T)
+        exp = key.reshape(q, -1, 256).min(-1)
+        got = np.asarray(minima)
+        # f32 association-order noise only
+        assert np.abs(got - exp).max() / np.abs(exp).max() < 1e-2
+
+
+class TestSparseScan:
+    def test_parity_and_no_overflow_on_sorted(self):
+        qx, qy, x, y, _, dev = make(16384, 12, sorted_x=True)
+        mask = (x > -60) & (x < 60)
+        dev[4] = jnp.asarray(mask)
+        fd, fi, ov = knn_sparse_scan(
+            *dev, k=5, tile_capacity=8, m_blocks=8, interpret=True, **TINY)
+        assert not bool(ov)
+        exp = oracle(qx, qy, x, y, mask, 5)
+        np.testing.assert_allclose(
+            np.sort(np.asarray(fd), 1), exp, rtol=1e-4, atol=1.0)
+
+    def test_overflow_flags_capacity_breach(self):
+        _, _, x, y, _, dev = make(16384, 4)
+        dev[4] = jnp.asarray(np.ones(16384, bool))
+        _, _, ov = knn_sparse_scan(
+            *dev, k=3, tile_capacity=4, m_blocks=8, interpret=True, **TINY)
+        assert bool(ov)
+
+    def test_empty_mask(self):
+        qx, qy, x, y, _, dev = make(4096, 4)
+        dev[4] = jnp.asarray(np.zeros(4096, bool))
+        fd, _, ov = knn_sparse_scan(
+            *dev, k=3, tile_capacity=4, m_blocks=8, interpret=True, **TINY)
+        assert not bool(ov)
+        assert np.isinf(np.asarray(fd)).all()
+
+    def test_matches_only_in_last_tile(self):
+        # selection order: tile ids must map back to ORIGINAL lanes
+        qx, qy, x, y, _, dev = make(8192, 6)
+        mask = np.zeros(8192, bool)
+        mask[-50:] = True
+        dev[4] = jnp.asarray(mask)
+        fd, fi, ov = knn_sparse_scan(
+            *dev, k=4, tile_capacity=2, m_blocks=8, interpret=True, **TINY)
+        assert not bool(ov)
+        exp = oracle(qx, qy, x, y, mask, 4)
+        np.testing.assert_allclose(
+            np.sort(np.asarray(fd), 1), exp, rtol=1e-4, atol=1.0)
+        assert (np.asarray(fi) >= 8192 - 50).all()

@@ -717,3 +717,15 @@ class TestSqlHaving:
         )
         got = r.features.columns["code"].decode()
         assert "USA" not in got and got == sorted(got)
+
+    def test_join_having_raw_column_rejected(self, tmp_path):
+        # a raw ungrouped column in JOIN HAVING must error, not silently
+        # become its aggregate
+        ds, events, countries, actors = TestSqlJoin()._two_tables(tmp_path)
+        ctx = SqlContext(ds)
+        with pytest.raises(SqlError, match="unknown column"):
+            ctx.sql(
+                "SELECT c.code, SUM(e.score) FROM events e "
+                "JOIN countries c ON e.actor = c.code "
+                "GROUP BY c.code HAVING e.score > 0"
+            )
