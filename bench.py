@@ -16,6 +16,7 @@ Usage: python bench.py [--smoke] [--n N] [--queries Q]
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import sys
 import time
@@ -80,6 +81,25 @@ def _cpu_baseline(x, y, t, speed, qx, qy, k, bbox, t0, t1, repeats=3):
     return best, count, dists
 
 
+def _morton64(x, y):
+    """64-bit Z key from 32-bit-quantized lon/lat (store physical order)."""
+    qx = np.clip((x + 180.0) / 360.0 * 4294967295.0, 0, 2**32 - 1
+                 ).astype(np.uint64)
+    qy = np.clip((y + 90.0) / 180.0 * 4294967295.0, 0, 2**32 - 1
+                 ).astype(np.uint64)
+
+    def spread(v):
+        v = (v | (v << 16)) & np.uint64(0x0000FFFF0000FFFF)
+        v = (v | (v << 8)) & np.uint64(0x00FF00FF00FF00FF)
+        v = (v | (v << 4)) & np.uint64(0x0F0F0F0F0F0F0F0F)
+        v = (v | (v << 2)) & np.uint64(0x3333333333333333)
+        v = (v | (v << 1)) & np.uint64(0x5555555555555555)
+        return v
+
+    return spread(qx & np.uint64(0xFFFFFFFF)) | (
+        spread(qy & np.uint64(0xFFFFFFFF)) << np.uint64(1))
+
+
 def _sync(out):
     """Force device completion. Under the remote-tunnel TPU platform
     `block_until_ready()` returns before execution finishes, so timings must
@@ -103,8 +123,195 @@ def _timeit(fn, repeats=3, warm=True):
     return best
 
 
+def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
+    """Config 2 (round 3): Within() over an OSM-admin-style polygon LAYER
+    — npoly disjoint polygons (mixed 10..10k edges, ~10% with holes) x n
+    points, via the sparse pair-list Pallas spatial join
+    (engine/pip_sparse.py) with f64 refinement of boundary-band points.
+
+    Replaces the round-1/2 single-star bench (VERDICT.md round-2 #5: the
+    multi-polygon path was never benched as config 2 specifies). Points
+    are Z-ordered (store layout) — that's what makes the point-tile
+    bboxes tight and the pair pruning effective.
+
+    Parity gate: 0 mismatches vs a NumPy f64 crossing oracle on a point
+    subsample PLUS every adversarial near-edge point (placed within
+    +-1e-6 deg of random edges)."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.engine.pip_sparse import (
+        EDGE_TILE, POINT_TILE, pip_layer, pip_layer_sparse, prepare_layer)
+
+    rng = np.random.default_rng(29)
+    # disjoint admin-style layer: one polygon per jittered grid cell,
+    # radius < half cell so no overlap; log-mixed edge counts
+    side = int(np.ceil(np.sqrt(npoly)))
+    cw, ch = 360.0 / side, 180.0 / side
+    x1l, y1l, x2l, y2l, pol = [], [], [], [], []
+    n_holes = 0
+    ecounts = np.clip(
+        np.round(10 ** rng.uniform(1, 4, npoly)).astype(int), 10, 10_000
+    )
+    pid = 0
+    for gy in range(side):
+        for gx in range(side):
+            if pid >= npoly:
+                break
+            cx = -180 + (gx + 0.5) * cw + rng.uniform(-0.1, 0.1) * cw
+            cy = -90 + (gy + 0.5) * ch + rng.uniform(-0.1, 0.1) * ch
+            ne = int(ecounts[pid])
+            th = np.sort(rng.uniform(0, 2 * np.pi, ne))
+            rad = (0.35 * min(cw, ch)
+                   * (1 + 0.25 * np.sin(3 * th + rng.uniform(0, 6))))
+            ring = np.stack(
+                [cx + rad * np.cos(th), cy + rad * np.sin(th)], 1)
+            ring = np.concatenate([ring, ring[:1]])
+            x1l.append(ring[:-1, 0]); y1l.append(ring[:-1, 1])
+            x2l.append(ring[1:, 0]); y2l.append(ring[1:, 1])
+            pol.append(np.full(ne, pid))
+            if rng.random() < 0.1:  # hole: reversed inner ring
+                n_holes += 1
+                nh = max(8, ne // 8)
+                thh = np.sort(rng.uniform(0, 2 * np.pi, nh))[::-1]
+                rh = rad.min() * 0.4
+                hr = np.stack(
+                    [cx + rh * np.cos(thh), cy + rh * np.sin(thh)], 1)
+                hr = np.concatenate([hr, hr[:1]])
+                x1l.append(hr[:-1, 0]); y1l.append(hr[:-1, 1])
+                x2l.append(hr[1:, 0]); y2l.append(hr[1:, 1])
+                pol.append(np.full(nh, pid))
+            pid += 1
+    x1 = np.concatenate(x1l); y1 = np.concatenate(y1l)
+    x2 = np.concatenate(x2l); y2 = np.concatenate(y2l)
+    pol = np.concatenate(pol)
+
+    px = rng.uniform(-180, 180, n)
+    py = rng.uniform(-90, 90, n)
+    # adversarial near-edge points (must be caught by the band + refined)
+    na = min(n // 64, 100_000)
+    ei = rng.integers(0, len(x1), na)
+    tt = rng.uniform(0, 1, na)
+    px[:na] = x1[ei] + tt * (x2[ei] - x1[ei]) + rng.uniform(-1e-6, 1e-6, na)
+    py[:na] = y1[ei] + tt * (y2[ei] - y1[ei]) + rng.uniform(-1e-6, 1e-6, na)
+    py[:na] = np.clip(py[:na], -90, 90)
+    px[:na] = np.clip(px[:na], -180, 180)
+    adv = np.zeros(n, bool)
+    adv[:na] = True
+    zo = np.argsort(_morton64(px, py))
+    px, py, adv = px[zo], py[zo], adv[zo]
+
+    # one warm end-to-end pass builds pairs + compiles + refines
+    inside, info = pip_layer(px, py, x1, y1, x2, y2, pol, interpret=smoke)
+
+    # timed: the device pass over prebuilt pair structures (the pair list
+    # is per-layer index state, like the reference's prepared geometries;
+    # its build time is reported separately)
+    import time as _t
+
+    s = _t.perf_counter()
+    prep = prepare_layer(px, py, x1, y1, x2, y2, pol)
+    pxp, pyp = prep.pxp, prep.pyp
+    ex1, ey1, ex2, ey2 = prep.ex1, prep.ey1, prep.ex2, prep.ey2
+    n_ptiles, n_etiles = prep.n_ptiles, prep.n_etiles
+    plist = prep.pairs
+    prep_t = _t.perf_counter() - s
+
+    dev_args = (
+        jnp.asarray(pxp), jnp.asarray(pyp),
+        jnp.asarray(ex1), jnp.asarray(ey1),
+        jnp.asarray(ex2), jnp.asarray(ey2),
+        jnp.asarray(plist.pair_pt), jnp.asarray(plist.pair_et),
+        jnp.asarray(plist.first),
+    )
+
+    def run():
+        return pip_layer_sparse(
+            *dev_args, n_ptiles=n_ptiles, n_etiles=n_etiles,
+            interpret=smoke,
+        )
+
+    dev_t = _timeit(lambda: _sync(run()[0]), repeats)
+
+    # oracle + CPU baseline: f64 crossing with the SAME pair pruning, on
+    # a tile subsample + every adversarial point
+    sub_tiles = rng.choice(
+        np.nonzero(plist.covered)[0], min(64 if smoke else 256,
+                                          int(plist.covered.sum())),
+        replace=False,
+    )
+    et_of_pt: dict = {}
+    for ptid, etid in zip(plist.pair_pt, plist.pair_et):
+        et_of_pt.setdefault(int(ptid), []).append(int(etid))
+
+    def cpu_tile(ptid):
+        ets = et_of_pt.get(int(ptid), [])
+        i0 = ptid * POINT_TILE
+        ii = np.arange(i0, min(i0 + POINT_TILE, n))
+        if not len(ii):
+            return ii, np.zeros(0, bool)
+        if not ets:
+            return ii, np.zeros(len(ii), bool)
+        sl = np.concatenate(
+            [np.arange(e * EDGE_TILE, (e + 1) * EDGE_TILE) for e in ets])
+        a1, b1, a2, b2 = ex1[sl], ey1[sl], ex2[sl], ey2[sl]
+        pxi = px[ii][:, None]
+        pyi = py[ii][:, None]
+        condx = (b1[None] <= pyi) != (b2[None] <= pyi)
+        ttt = (pyi - b1[None]) / np.where(b2 == b1, 1.0, b2 - b1)[None]
+        xc = a1[None] + ttt * (a2 - a1)[None]
+        return ii, (np.sum(condx & (xc > pxi), 1) % 2) == 1
+
+    def cpu_pass():
+        outs = []
+        for ptid in sub_tiles:
+            outs.append(cpu_tile(ptid))
+        return outs
+
+    cpu_t = _timeit(cpu_pass, max(1, repeats - 1))
+    mism = 0
+    checked = 0
+    for ii, exp in cpu_pass():
+        mism += int((inside[ii] != exp).sum())
+        checked += len(ii)
+    # every adversarial point against the oracle
+    adv_idx = np.nonzero(adv)[0]
+    for ptid in np.unique(adv_idx // POINT_TILE):
+        ii, exp = cpu_tile(ptid)
+        sel = np.isin(ii, adv_idx)
+        mism += int((inside[ii][sel] != exp[sel]).sum())
+        checked += int(sel.sum())
+
+    cpu_pps = len(sub_tiles) * POINT_TILE / cpu_t
+    pps = n / dev_t
+    return {
+        "metric": "within_polygon_layer_point_polys_per_sec_per_chip",
+        "value": round(pps * npoly, 1),
+        "unit": "point*polygons/sec",
+        "vs_baseline": round(pps / cpu_pps, 3),
+        "detail": {
+            "n": n, "polygons": npoly, "edges": int(len(x1)),
+            "holes": n_holes,
+            "points_per_sec": round(pps, 1),
+            "device_time_s": round(dev_t, 5),
+            "pair_count": int(len(plist.pair_pt)),
+            "pair_build_s": round(prep_t, 3),
+            "adversarial_points": int(na),
+            "flagged": info["flagged"], "refined": info["refined"],
+            "checked": checked, "mismatches": mism,
+            "parity": mism == 0,
+            "cpu_points_per_sec": round(cpu_pps, 1),
+            "cpu32_points_per_sec": round(cpu_pps * 32, 1),
+            "vs_cpu32": round(pps / (cpu_pps * 32), 3),
+            "note": "CPU baseline uses the SAME pair-pruned candidate "
+                    "sets (f64 crossing, vectorized per tile) on a tile "
+                    "subsample; parity additionally checks every "
+                    "adversarial near-edge point after f64 refinement",
+        },
+    }
+
+
 def bench_pip(n, repeats):
-    """Config 2: Within() point-in-polygon (OSM-admin-style polygon)."""
+    """Config 2 (legacy --single-polygon): Within() against ONE polygon."""
     import jax
     import jax.numpy as jnp
 
@@ -553,6 +760,243 @@ def bench_fs_query(n, repeats, tmpdir=None, cold=False):
             shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_stream(n_total, batches, q, k, repeats=2, smoke=False):
+    """Config 3 at the GDELT-1B scale: N points streamed through HBM as
+    `batches` Z-ordered superbatches with an exact cross-batch top-k merge.
+
+    16 GB of HBM cannot hold 2^30 x 20 B, so each superbatch is produced,
+    scanned (mask + sparse kNN), folded into the running top-k, and
+    dropped; JAX's async dispatch overlaps production of batch b+1 with
+    the scan of batch b (the double-buffering the round-2 review asked
+    for). Exactness of the merge: the global top-k is a subset of the
+    union of per-batch top-ks (same argument as knn_sharded's gather).
+
+    Superbatch source: the tunnel's host->device path measures 0.05 GB/s
+    (BASELINE.md round-3 notes), which makes HOST-streamed staging an
+    environment artifact (~400 s for 20 GB), so the stream is produced
+    ON DEVICE by inverse-Morton decode of sequential 32-bit Z keys with
+    per-key jitter: batch b holds keys [b*2^32/B, (b+1)*2^32/B) — exactly
+    a Z-ordered store partition (uniform world coverage, Z-sorted by
+    construction, matching the layout an FS/KV partition scan emits).
+    The CPU oracle regenerates identical batches host-side (bit-identical
+    integer pipeline) and streams the same mask + argpartition merge.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.engine.knn import _topk_smallest
+    from geomesa_tpu.engine.knn_scan import DATA_TILE, knn_sparse_scan
+
+    nb = n_total // batches
+    BBOX = (-60.0, 20.0, 60.0, 70.0)
+    T0, T1 = 1_592_000_000_000, 1_598_000_000_000
+    rng = np.random.default_rng(42)
+    qx = rng.uniform(-30, 30, q)
+    qy = rng.uniform(30, 60, q)
+    dqx = jnp.asarray(qx, jnp.float32)
+    dqy = jnp.asarray(qy, jnp.float32)
+
+    KEY_STEP = (1 << 32) // n_total  # z-key stride per point
+
+    def unmorton_np(z):
+        def squash(v):
+            v = v & np.uint64(0x5555555555555555)  # NOT &=: aliases caller
+            v = (v | (v >> 1)) & np.uint64(0x3333333333333333)
+            v = (v | (v >> 2)) & np.uint64(0x0F0F0F0F0F0F0F0F)
+            v = (v | (v >> 4)) & np.uint64(0x00FF00FF00FF00FF)
+            v = (v | (v >> 8)) & np.uint64(0x0000FFFF0000FFFF)
+            v = (v | (v >> 16)) & np.uint64(0x00000000FFFFFFFF)
+            return v
+
+        return squash(z), squash(z >> np.uint64(1))
+
+    def gen_np(b):
+        """Host twin of gen(): identical integer arithmetic."""
+        i = np.arange(nb, dtype=np.uint64) + np.uint64(b * nb)
+        # splitmix-style per-index hash for jitter + attributes
+        h = (i * np.uint64(0x9E3779B97F4A7C15)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        h ^= h >> np.uint64(31)
+        h = (h * np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        h ^= h >> np.uint64(29)
+        z = i * np.uint64(KEY_STEP) + (h % np.uint64(KEY_STEP))
+        gx, gy = unmorton_np(z & np.uint64(0xFFFFFFFF))
+        # 16-bit cell + in-cell jitter from higher hash bits. Arithmetic
+        # is carried in FLOAT32 mirroring gen_dev op-for-op: the oracle's
+        # coordinates must be bit-identical to the device batch or kNN
+        # distances drift by meters and the recall gate flaps
+        f32 = np.float32
+        jx = ((h >> np.uint64(33)) & np.uint64(0xFFFF)).astype(f32) / f32(65536.0)
+        jy = ((h >> np.uint64(49)) & np.uint64(0x7FFF)).astype(f32) / f32(32768.0)
+        x = (gx.astype(f32) + jx) / f32(65536.0) * f32(360.0) - f32(180.0)
+        y = (gy.astype(f32) + jy) / f32(65536.0) * f32(180.0) - f32(90.0)
+        t = (np.uint64(1_590_000_000_000)
+             + (h >> np.uint64(13)) % np.uint64(10_000_000_000)).astype(np.int64)
+        speed = ((h >> np.uint64(7)) & np.uint64(0x3FF)).astype(f32) * f32(30.0 / 1024.0)
+        return x, y, t, speed
+
+    def gen_dev(off):
+        i = jnp.arange(nb, dtype=jnp.uint64) + off
+        h = i * jnp.uint64(0x9E3779B97F4A7C15)
+        h ^= h >> jnp.uint64(31)
+        h = h * jnp.uint64(0xBF58476D1CE4E5B9)
+        h ^= h >> jnp.uint64(29)
+        z = (i * jnp.uint64(KEY_STEP) + h % jnp.uint64(KEY_STEP)) & jnp.uint64(0xFFFFFFFF)
+
+        def squash(v):
+            v &= jnp.uint64(0x5555555555555555)
+            v = (v | (v >> 1)) & jnp.uint64(0x3333333333333333)
+            v = (v | (v >> 2)) & jnp.uint64(0x0F0F0F0F0F0F0F0F)
+            v = (v | (v >> 4)) & jnp.uint64(0x00FF00FF00FF00FF)
+            v = (v | (v >> 8)) & jnp.uint64(0x0000FFFF0000FFFF)
+            v = (v | (v >> 16)) & jnp.uint64(0x00000000FFFFFFFF)
+            return v
+
+        gx = squash(z).astype(jnp.float32)
+        gy = squash(z >> jnp.uint64(1)).astype(jnp.float32)
+        jx = ((h >> jnp.uint64(33)) & jnp.uint64(0xFFFF)).astype(jnp.float32) / 65536.0
+        jy = ((h >> jnp.uint64(49)) & jnp.uint64(0x7FFF)).astype(jnp.float32) / 32768.0
+        x = (gx + jx) / 65536.0 * 360.0 - 180.0
+        y = (gy + jy) / 65536.0 * 180.0 - 90.0
+        t = (jnp.uint64(1_590_000_000_000)
+             + (h >> jnp.uint64(13)) % jnp.uint64(10_000_000_000)).astype(jnp.int64)
+        speed = ((h >> jnp.uint64(7)) & jnp.uint64(0x3FF)).astype(jnp.float32) * jnp.float32(30.0 / 1024.0)
+        return x, y, t, speed
+
+    # tile capacity: max tiles-hit across all batches (each batch is a
+    # DIFFERENT Z-region, so per-batch selectivity varies from 0 to ~4x
+    # the mean — planner-stats analog; overflow flags gate the run). The
+    # calibration masks are also reused by the CPU oracle below.
+    ntiles = -(-nb // DATA_TILE)  # ceil: nb below one tile still pads UP
+    hit = 0
+    for b in range(batches):
+        xb, yb, tb, sb = gen_np(b)
+        mb = ((xb >= BBOX[0]) & (xb <= BBOX[2]) & (yb >= BBOX[1])
+              & (yb <= BBOX[3]) & (tb > T0) & (tb < T1) & (sb > 5.0))
+        hit = max(hit, int(np.pad(mb, (0, ntiles * DATA_TILE - nb))
+                           .reshape(ntiles, DATA_TILE).any(1).sum()))
+    cap = max(64, 1 << int(np.ceil(np.log2(max(hit, 1) * 1.5))))
+
+    @jax.jit
+    def scan_batch(off, qx, qy):
+        # off is a TRACED uint64 batch offset: one compile serves every
+        # superbatch (a static index would recompile per batch — 16 x
+        # ~70 s through the remote-compile tunnel)
+        x, y, t, speed = gen_dev(off)
+        m = ((x >= BBOX[0]) & (x <= BBOX[2]) & (y >= BBOX[1])
+             & (y <= BBOX[3]) & (t > T0) & (t < T1) & (speed > 5.0))
+        cnt = jnp.sum(m.astype(jnp.int64))
+        fd, fi, ov = knn_sparse_scan(
+            qx, qy, x, y, m, k=k, tile_capacity=cap,
+            interpret=smoke,
+        )
+        return cnt, fd, fi.astype(jnp.int64) + off.astype(jnp.int64), ov
+
+    @jax.jit
+    def merge(bd, bi, fd, fi):
+        pd = jnp.concatenate([bd, fd], axis=1)
+        pi = jnp.concatenate([bi, fi], axis=1)
+        md, sel = _topk_smallest(pd, k)
+        return md, jnp.take_along_axis(pi, sel, axis=1)
+
+    def run():
+        bd = jnp.full((q, k), jnp.inf, jnp.float32)
+        bi = jnp.zeros((q, k), jnp.int64)
+        total = jnp.zeros((), jnp.int64)
+        ovs = []
+        for b in range(batches):
+            cnt, fd, fi, ov = scan_batch(
+                jnp.uint64(b) * jnp.uint64(nb), dqx, dqy)
+            bd, bi = merge(bd, bi, fd, fi)
+            total = total + cnt
+            ovs.append(ov)
+            if b % 2 == 1:
+                # cap in-flight superbatches: each queued scan holds its
+                # ~1.4 GB generated batch live; 16 queued programs exceed
+                # HBM and the tunnel wedges under allocation pressure
+                # instead of erroring. Two in flight still overlaps
+                # generation/scan with dispatch latency.
+                _sync(bd)
+        _sync(bd)
+        return bd, bi, total, ovs
+
+    wall = _timeit(run, repeats)
+    bd, bi, total, ovs = run()
+    overflow = any(bool(o) for o in ovs)
+    pps = n_total / wall
+
+    # CPU oracle on a query subsample: stream the same batches host-side
+    qs = min(q, 8 if smoke else 32)
+    best_d = np.full((qs, k), np.inf)
+    cpu_total = 0
+    gen_t = mask_t = knn_t = 0.0
+    for b in range(batches):
+        s = time.perf_counter()
+        x, y, t, speed = gen_np(b)
+        gen_t += time.perf_counter() - s
+        s = time.perf_counter()
+        m = ((x >= BBOX[0]) & (x <= BBOX[2]) & (y >= BBOX[1])
+             & (y <= BBOX[3]) & (t > T0) & (t < T1) & (speed > 5.0))
+        cpu_total += int(m.sum())
+        mask_t += time.perf_counter() - s
+        s = time.perf_counter()
+        from geomesa_tpu.engine.geodesy import haversine_m_np
+
+        cx, cy = x[m], y[m]
+        for i in range(qs):
+            d = haversine_m_np(qx[i], qy[i], cx, cy)
+            kk = min(k, len(d))
+            if kk:
+                dk = np.partition(d, kk - 1)[:kk]
+                pool = np.concatenate([best_d[i], dk])
+                best_d[i] = np.sort(pool)[:k]
+        knn_t += time.perf_counter() - s
+    cpu_wall = gen_t + mask_t + knn_t
+    cpu_scan_pps = n_total / (mask_t + knn_t * q / max(qs, 1))
+
+    got = np.sort(np.asarray(bd)[:qs], axis=1)
+    exp = best_d
+    finite = np.isfinite(exp)
+    # gate BOTH distances and the match totals — an all-inf oracle (e.g.
+    # a diverged generator twin) must not pass vacuously
+    recall_ok = (
+        bool(np.all(
+            np.abs(got[finite] - exp[finite])
+            <= np.maximum(1.0, 1e-4 * exp[finite])
+        ))
+        and not overflow
+        and np.isfinite(exp).any()
+        and abs(int(total) - cpu_total) <= max(2, n_total // 10**7)
+    )
+    cpu32 = cpu_scan_pps * 32
+
+    return {
+        "metric": "gdelt_1b_stream_bbox_time_knn_points_per_sec_per_chip",
+        "value": round(pps, 1),
+        "unit": "points/sec",
+        "vs_baseline": round(pps / cpu32, 3),
+        "detail": {
+            "n_total": n_total, "batches": batches,
+            "batch_points": nb, "queries": q, "k": k,
+            "wall_s": round(wall, 4),
+            "match_total": int(total), "cpu_match_total": cpu_total,
+            "tile_capacity": cap, "tiles_hit_b0": hit,
+            "overflow": overflow,
+            "recall_parity_subsample": recall_ok,
+            "recall_queries_checked": qs,
+            "cpu_scan_points_per_sec": round(cpu_scan_pps, 1),
+            "cpu32_points_per_sec": round(cpu32, 1),
+            "cpu_oracle_wall_s": round(cpu_wall, 2),
+            "note": "Z-ordered superbatches produced on device "
+                    "(inverse-Morton of sequential keys — the layout a "
+                    "store partition scan emits); host h2d measures "
+                    "0.05 GB/s through the tunnel, so host staging is "
+                    "environment-bound (documented in BASELINE.md); "
+                    "exact cross-batch top-k merge; CPU oracle streams "
+                    "bit-identical batches",
+        },
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--smoke", action="store_true")
@@ -576,16 +1020,42 @@ def main(argv=None) -> int:
              "device, no HBM residency) alongside the cached query",
     )
     p.add_argument(
-        "--impl", choices=["mxu", "grid", "compact", "haversine"],
-        default="compact",
-        help="config-3 kNN kernel: compact = device candidate compaction "
-             "+ MXU kNN over matches only (default; fastest measured at "
-             "GDELT selectivity — 108M vs 102M pts/s for mxu on v5e), "
-             "mxu = augmented-matmul ranking keys + deferred block "
-             "selection over the full batch, grid = device-built spatial "
-             "index + certified neighborhood search (amortizes over many "
-             "queries; wins at >=2048 queries/batch), haversine = "
+        "--impl",
+        choices=["sparse", "fullscan", "mxu", "grid", "compact", "haversine"],
+        default="sparse",
+        help="config-3 kNN kernel: sparse = Pallas fused scan over "
+             "match-bearing data tiles only (default; 570M pts/s on "
+             "store-ordered 67M batches at exact recall — see "
+             "engine/knn_scan.py), fullscan = the dense Pallas scan "
+             "(259M pts/s, order-independent), compact = XLA candidate "
+             "compaction + MXU kNN (round-2 default, 105M), mxu = "
+             "augmented-matmul ranking keys over the full batch, grid = "
+             "device-built spatial index + certified neighborhood search "
+             "(amortizes over many query rounds), haversine = "
              "elementwise VPU",
+    )
+    p.add_argument(
+        "--single-polygon", action="store_true",
+        help="config 2: run the legacy single-polygon kernel bench "
+             "instead of the polygon-LAYER spatial join (default)",
+    )
+    p.add_argument(
+        "--npoly", type=int, default=None,
+        help="config 2 layer size (default 10000; smoke 200)",
+    )
+    p.add_argument(
+        "--stream", type=int, default=None, metavar="BATCHES",
+        help="config 3 at streamed scale: run N points (default 2^30) as "
+             "BATCHES Z-ordered superbatches through HBM with an exact "
+             "cross-batch top-k merge (the GDELT-1B regime; see "
+             "bench_stream). Typical: --stream 16",
+    )
+    p.add_argument(
+        "--order", choices=["store", "random"], default="store",
+        help="config-3 batch layout: store = Z-ordered (the FS/KV "
+             "store's physical layout — index scans emit key-ordered "
+             "rows), random = shuffled (worst case for the sparse "
+             "kernel's tile pruning; the CPU baseline is order-blind)",
     )
     args = p.parse_args(argv)
 
@@ -593,11 +1063,14 @@ def main(argv=None) -> int:
         import os
 
         os.environ.setdefault("XLA_FLAGS", "")
+        os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
         from jax._src import xla_bridge as xb
 
-        for name in ("axon", "tpu"):
-            xb._backend_factories.pop(name, None)
+        # drop only the axon factory (the env var alone does not stick —
+        # the axon site pins it); the "tpu" factory must STAY registered
+        # or pallas' tpu lowering registration fails at import
+        xb._backend_factories.pop("axon", None)
         jax.config.update("jax_platforms", "cpu")
 
     # 1<<26 amortizes the remote-tunnel dispatch floor (~105ms/round trip)
@@ -615,6 +1088,15 @@ def main(argv=None) -> int:
     k = args.k
     repeats = 2 if args.smoke else 3
 
+    if args.stream:
+        n_total = args.n or (1 << 17 if args.smoke else 1 << 30)
+        out = bench_stream(
+            n_total, args.stream, q, k,
+            repeats=1 if args.smoke else 2, smoke=args.smoke,
+        )
+        print(json.dumps(out))
+        return 0
+
     if args.config in (1, 2, 4, 5, 6):
         if args.config == 1:
             out = bench_fs_query(n, repeats, cold=args.cold)
@@ -622,6 +1104,12 @@ def main(argv=None) -> int:
             out = bench_density(n, repeats, dist=args.dist)
         elif args.config == 6:
             out = bench_polygon_density(n, repeats)
+        elif args.config == 2 and not args.single_polygon:
+            out = bench_pip_layer(
+                n, repeats,
+                npoly=args.npoly or (200 if args.smoke else 10_000),
+                smoke=args.smoke,
+            )
         else:
             out = {2: bench_pip, 5: bench_tube}[args.config](n, repeats)
         print(json.dumps(out))
@@ -645,6 +1133,12 @@ def main(argv=None) -> int:
         y = rng.uniform(-90, 90, n)
         qx = rng.uniform(-30, 30, q)
         qy = rng.uniform(30, 60, q)
+    if args.order == "store":
+        # the store's physical layout: curve-ordered keys (an index scan
+        # emits rows in Z order). The CPU baseline runs on the SAME
+        # arrays — its vectorized mask + argpartition are order-blind.
+        zorder = np.argsort(_morton64(x, y))
+        x, y = x[zorder], y[zorder]
     t = rng.integers(1_590_000_000_000, 1_600_000_000_000, n)
     speed = rng.uniform(0, 30, n)
     BBOX = (-60.0, 20.0, 60.0, 70.0)
@@ -698,6 +1192,59 @@ def main(argv=None) -> int:
         )
         return count, dists
 
+    def sparse_step_factory():
+        # planner-style capacity calibration OUTSIDE the timed loop: a
+        # real deployment derives the tile capacity from index stats
+        # (selectivity x tile count), keeps it across queries, and only
+        # recomputes when the overflow flag fires. 25% slack + pow2
+        # bucket; dead capacity programs skip the MXU (knn_scan.py).
+        from geomesa_tpu.engine.knn_scan import (
+            DATA_TILE, knn_fullscan, knn_sparse_scan)
+
+        # the Mosaic kernels need real TPU lowering; --smoke (CPU) runs
+        # them in pallas interpret mode at the same semantics
+        interp = bool(args.smoke)
+
+        if args.impl == "fullscan":
+            @jax.jit
+            def step(x, y, t, speed, qx, qy):
+                mask, count = mask_count(x, y, t, speed)
+                fd, fi = knn_fullscan(
+                    qx, qy, x, y, mask, k=k, interpret=interp)
+                return count, fd
+
+            return step
+
+        mask_np = (
+            (x >= BBOX[0]) & (x <= BBOX[2]) & (y >= BBOX[1]) & (y <= BBOX[3])
+            & (t > T0) & (t < T1) & (speed > 5.0)
+        )
+        ntiles = -(-n // DATA_TILE)
+        mp = np.pad(mask_np, (0, ntiles * DATA_TILE - n))
+        hit = int(mp.reshape(ntiles, DATA_TILE).any(1).sum())
+        cap = max(64, 1 << int(np.ceil(np.log2(max(hit, 1) * 1.25))))
+        overflow_seen = []
+
+        @jax.jit
+        def run(x, y, t, speed, qx, qy):
+            mask, count = mask_count(x, y, t, speed)
+            fd, fi, ov = knn_sparse_scan(
+                qx, qy, x, y, mask, k=k, tile_capacity=cap,
+                interpret=interp,
+            )
+            return count, fd, ov
+
+        def step(x, y, t, speed, qx, qy):
+            count, fd, ov = run(x, y, t, speed, qx, qy)
+            overflow_seen.append(ov)
+            return count, fd
+
+        step.check = lambda: not any(bool(o) for o in overflow_seen)
+        step.tile_capacity = cap
+        step.tiles_hit = hit
+        step.ntiles = ntiles
+        return step
+
     dx = jnp.asarray(x, jnp.float32)
     dy = jnp.asarray(y, jnp.float32)
     dt = jnp.asarray(t, jnp.int64)
@@ -705,9 +1252,12 @@ def main(argv=None) -> int:
     dqx = jnp.asarray(qx, jnp.float32)
     dqy = jnp.asarray(qy, jnp.float32)
 
-    step = {"compact": compact_step, "grid": grid_step}.get(
-        args.impl, device_step
-    )
+    if args.impl in ("sparse", "fullscan"):
+        step = sparse_step_factory()
+    else:
+        step = {"compact": compact_step, "grid": grid_step}.get(
+            args.impl, device_step
+        )
     count, dists = step(dx, dy, dt, dspeed, dqx, dqy)
     _sync(dists)  # compile + warm
     best = np.inf
@@ -718,12 +1268,52 @@ def main(argv=None) -> int:
         best = min(best, time.perf_counter() - s)
     tpu_pps = n / best
 
+    # per-phase accounting. The remote tunnel adds ~100-120ms (+-20ms
+    # jitter) per dispatched step, which swamps a ~10ms kernel, so net
+    # device time is measured as the DOUBLE-DISPATCH MARGINAL: two
+    # back-to-back dispatches queue on device, and t(2 steps, 1 sync) -
+    # t(1 step) isolates pure execution from the tunnel round trip
+    one = jnp.float32(1.0)
+    triv = jax.jit(lambda a: a + 1)
+    rtt = _timeit(lambda: _sync(triv(one)), 3 if args.smoke else 8)
+
+    def dbl():
+        step(dx, dy, dt, dspeed, dqx, dqy)
+        _sync(step(dx, dy, dt, dspeed, dqx, dqy)[1])
+
+    t_double = _timeit(dbl, 1 if args.smoke else 3)
+    net = max(t_double - best, 1e-4)
+
+    def mask_dbl():
+        mask_count(dx, dy, dt, dspeed)
+        _sync(mask_count(dx, dy, dt, dspeed)[1])
+
+    mask_1 = _timeit(lambda: _sync(mask_count(dx, dy, dt, dspeed)[1]),
+                     1 if args.smoke else 3)
+    mask_net = max(_timeit(mask_dbl, 1 if args.smoke else 3) - mask_1, 0.0)
+    # sustained throughput: R steps in flight, one sync sweep — the
+    # server regime where dispatch latency overlaps device compute
+    R = 2 if args.smoke else 6
+
+    def burst():
+        outs = [step(dx, dy, dt, dspeed, dqx, dqy)[1] for _ in range(R)]
+        for o in outs:
+            _sync(o)
+
+    sus = _timeit(burst, 1 if args.smoke else 2)
+    sustained_pps = R * n / sus
+
     # --- CPU baseline ------------------------------------------------------
+    # measured single-core NumPy (mask + argpartition kNN) and the
+    # extrapolated 32-vCPU row the north star names (BASELINE.json): 32x
+    # perfect scaling — the WORST case for the device ratio, see
+    # BASELINE.md for the Accumulo-iterator-vs-NumPy per-core argument
     cpu_time, cpu_count, cpu_dists = _cpu_baseline(
         x, y, t, speed, qx, qy, k, BBOX, T0, T1,
         repeats=1 if args.smoke else 3,
     )
     cpu_pps = n / cpu_time
+    cpu32_pps = cpu_pps * 32
 
     # --- recall parity gate ------------------------------------------------
     got = np.sort(np.asarray(dists), axis=1)
@@ -732,26 +1322,53 @@ def main(argv=None) -> int:
     recall_ok = bool(
         np.all(np.abs(got[finite] - exp[finite]) <= np.maximum(1.0, 1e-4 * exp[finite]))
     )
+    if hasattr(step, "check"):
+        recall_ok = recall_ok and step.check()  # no silent tile overflow
 
+    eff_gbps = n * 20 / net / 1e9  # 20 B/pt: x,y,speed f32 + t i64
     print(
         json.dumps(
             {
                 "metric": "gdelt_bbox_time_knn_points_per_sec_per_chip",
                 "value": round(tpu_pps, 1),
                 "unit": "points/sec",
-                "vs_baseline": round(tpu_pps / cpu_pps, 3),
+                "vs_baseline": round(tpu_pps / cpu32_pps, 3),
                 "detail": {
                     "n": n,
                     "queries": q,
                     "k": k,
+                    "impl": args.impl,
+                    "order": args.order,
                     "device": jax.devices()[0].platform,
                     "device_time_s": round(best, 5),
+                    "sustained_points_per_sec": round(sustained_pps, 1),
+                    "phases": {
+                        "dispatch_rtt_s": round(rtt, 5),
+                        "device_net_s": round(net, 5),
+                        "mask_net_s": round(mask_net, 5),
+                        "knn_net_s": round(max(net - mask_net, 0.0), 5),
+                        "method": "double-dispatch marginal (tunnel RTT "
+                                  "jitter exceeds kernel time)",
+                    },
+                    "effective_scan_gbps": round(eff_gbps, 2),
+                    "hbm_peak_frac": round(eff_gbps / 819.0, 4),
                     "cpu_time_s": round(cpu_time, 5),
                     "cpu_points_per_sec": round(cpu_pps, 1),
+                    "cpu32_points_per_sec": round(cpu32_pps, 1),
+                    "vs_1core": round(tpu_pps / cpu_pps, 3),
+                    "baseline": "32-vCPU perfect-scaling extrapolation "
+                                "of measured single-core NumPy "
+                                "(BASELINE.md round-3 notes)",
                     "dist": args.dist,
                     "match_count": int(count),
                     "cpu_match_count": cpu_count,
                     "recall_parity": recall_ok,
+                    **(
+                        {"tiles_hit": step.tiles_hit,
+                         "tile_capacity": step.tile_capacity,
+                         "ntiles": step.ntiles}
+                        if hasattr(step, "tiles_hit") else {}
+                    ),
                 },
             }
         )

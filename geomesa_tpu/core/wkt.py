@@ -262,3 +262,119 @@ def to_geojson(g: Geometry) -> dict:
         return {"type": "MultiPolygon", "coordinates": polys}
     # GeometryCollection-ish fallback: emit each part as a polygon ring list
     return {"type": "MultiLineString", "coordinates": [ring(r) for r in g.rings]}
+
+
+# -- WKB ---------------------------------------------------------------------
+# ISO WKB, little-endian, 2-D (the WKBUtils role: geomesa-utils
+# o.l.g.utils.text.WKBUtils [upstream, unverified]).
+
+import struct as _struct
+
+_WKB_KIND = {
+    "Point": 1, "LineString": 2, "Polygon": 3,
+    "MultiPoint": 4, "MultiLineString": 5, "MultiPolygon": 6,
+}
+_WKB_NAME = {v: k for k, v in _WKB_KIND.items()}
+
+
+def to_wkb(g: Geometry) -> bytes:
+    """Encode little-endian ISO WKB."""
+    out = bytearray()
+
+    def header(kind_code: int):
+        out.append(1)  # little-endian
+        out.extend(_struct.pack("<I", kind_code))
+
+    def ring(r: np.ndarray):
+        out.extend(_struct.pack("<I", len(r)))
+        out.extend(np.ascontiguousarray(r, "<f8").tobytes())
+
+    k = g.kind
+    header(_WKB_KIND[k])
+    if k == "Point":
+        x, y = g.point
+        out.extend(_struct.pack("<dd", float(x), float(y)))
+    elif k == "LineString":
+        ring(g.rings[0])
+    elif k == "Polygon":
+        out.extend(_struct.pack("<I", len(g.rings)))
+        for r in g.rings:
+            ring(r)
+    elif k == "MultiPoint":
+        pts = np.concatenate([np.asarray(r, np.float64) for r in g.rings], 0)
+        out.extend(_struct.pack("<I", len(pts)))
+        for x, y in pts:
+            header(1)
+            out.extend(_struct.pack("<dd", float(x), float(y)))
+    elif k == "MultiLineString":
+        out.extend(_struct.pack("<I", len(g.rings)))
+        for r in g.rings:
+            header(2)
+            ring(r)
+    elif k == "MultiPolygon":
+        out.extend(_struct.pack("<I", len(g.parts)))
+        i = 0
+        for n in g.parts:
+            header(3)
+            out.extend(_struct.pack("<I", n))
+            for r in g.rings[i: i + n]:
+                ring(r)
+            i += n
+    else:
+        raise ValueError(f"cannot WKB-encode {k}")
+    return bytes(out)
+
+
+def parse_wkb(buf: bytes) -> Geometry:
+    """Decode (a prefix of) WKB; both byte orders accepted."""
+    pos = [0]
+
+    def take(n):
+        s = buf[pos[0]: pos[0] + n]
+        if len(s) < n:
+            raise ValueError("truncated WKB")
+        pos[0] += n
+        return s
+
+    def geometry() -> Geometry:
+        bo = "<" if take(1)[0] == 1 else ">"
+        code = _struct.unpack(bo + "I", take(4))[0]
+        if code > 1000:
+            # Z/M/ZM variants change the per-point stride; reading them
+            # as 2-D would silently produce garbage coordinates
+            raise ValueError(
+                f"WKB geometry code {code}: Z/M dimensions unsupported"
+            )
+        kind = _WKB_NAME.get(code)
+        if kind is None:
+            raise ValueError(f"unsupported WKB geometry code {code}")
+
+        def ring():
+            n = _struct.unpack(bo + "I", take(4))[0]
+            return np.frombuffer(
+                take(16 * n), dtype=bo + "f8"
+            ).reshape(n, 2).astype(np.float64)
+
+        if kind == "Point":
+            x, y = _struct.unpack(bo + "dd", take(16))
+            return point(x, y)
+        if kind == "LineString":
+            return Geometry("LineString", [ring()])
+        if kind == "Polygon":
+            n = _struct.unpack(bo + "I", take(4))[0]
+            return Geometry("Polygon", [ring() for _ in range(n)])
+        n = _struct.unpack(bo + "I", take(4))[0]
+        subs = [geometry() for _ in range(n)]
+        if kind == "MultiPoint":
+            pts = np.concatenate([s.rings[0] for s in subs], 0)
+            return Geometry("MultiPoint", [pts[i:i + 1] for i in range(len(pts))])
+        if kind == "MultiLineString":
+            return Geometry("MultiLineString", [s.rings[0] for s in subs])
+        rings: List[np.ndarray] = []
+        parts: List[int] = []
+        for s in subs:
+            rings.extend(s.rings)
+            parts.append(len(s.rings))
+        return Geometry("MultiPolygon", rings, parts)
+
+    return geometry()

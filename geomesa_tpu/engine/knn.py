@@ -547,6 +547,52 @@ def knn_sharded(
     return run(qx, qy, dx, dy, mask)
 
 
+def knn_compact_sharded(
+    mesh: Mesh,
+    qx: jax.Array,
+    qy: jax.Array,
+    dx: jax.Array,
+    dy: jax.Array,
+    mask: jax.Array,
+    k: int,
+    capacity: int,
+    query_tile: int = 64,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """knn_compact under the data-sharded merge: each shard compacts its
+    own matches (static per-shard `capacity`) and runs the MXU kNN over
+    them; the per-shard top-ks merge via all_gather exactly as
+    `knn_sharded`. Returns (dists [Q,k], global indices [Q,k],
+    overflow bool — True if ANY shard's matches exceeded capacity, in
+    which case callers MUST fall back to the full sharded scan)."""
+    d_count = mesh.devices.size
+    shard_n = dx.shape[0] // d_count
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,  # post-gather re-top-k replicated (see knn_sharded)
+    )
+    def run(qx, qy, dx, dy, mask):
+        fd, fi, ov = knn_compact(
+            qx, qy, dx, dy, mask, k=k, capacity=capacity,
+            query_tile=query_tile,
+        )
+        shard = jax.lax.axis_index(SHARD_AXIS)
+        gidx = fi + shard * shard_n
+        all_d = jax.lax.all_gather(fd, SHARD_AXIS)
+        all_i = jax.lax.all_gather(gidx, SHARD_AXIS)
+        pool_d = jnp.moveaxis(all_d, 0, 1).reshape(fd.shape[0], -1)
+        pool_i = jnp.moveaxis(all_i, 0, 1).reshape(fd.shape[0], -1)
+        md, mi = _topk_smallest(pool_d, k)
+        gi = jnp.take_along_axis(pool_i, mi, axis=1)
+        ov_any = jnp.any(jax.lax.all_gather(ov, SHARD_AXIS))
+        return md, gi, ov_any
+
+    return run(qx, qy, dx, dy, mask)
+
+
 def knn_ring(
     mesh: Mesh,
     qx: jax.Array,

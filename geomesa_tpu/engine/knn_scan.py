@@ -253,10 +253,13 @@ def chord_blockmin_sparse(
     return minima, c
 
 
-def _refine(qx, qy, xf, yf, maskf, orig_blk, n, k, blk):
+def _refine(qx, qy, xf, yf, maskf, orig_blk, n, k, blk, blk_ok=None):
     """Exact haversine over the selected blocks' lanes -> top-k.
     Block-granular gather: rows of blk contiguous lanes (measured as fast
-    as a contiguous copy; element gathers are ~50x slower)."""
+    as a contiguous copy; element gathers are ~50x slower). `blk_ok`
+    [Q, mb] masks out selected blocks that are capacity-padding artifacts
+    (sparse scan: dead slots alias data tile 0 and would otherwise
+    DUPLICATE tile-0 lanes in the pool)."""
     q = qx.shape[0]
     mb = orig_blk.shape[1]
     nb = xf.shape[0] // blk
@@ -266,6 +269,8 @@ def _refine(qx, qy, xf, yf, maskf, orig_blk, n, k, blk):
     gx = jnp.take(xb, orig_blk, axis=0).reshape(q, mb * blk)
     gy = jnp.take(yb, orig_blk, axis=0).reshape(q, mb * blk)
     gv = jnp.take(vb, orig_blk, axis=0).reshape(q, mb * blk)
+    if blk_ok is not None:
+        gv = gv & jnp.repeat(blk_ok, blk, axis=1)
     lane = (orig_blk[:, :, None] * blk + jnp.arange(blk, dtype=jnp.int32)
             ).reshape(q, mb * blk)
 
@@ -385,10 +390,15 @@ def knn_sparse_scan(
     )
     bpt = data_tile // blk  # blocks per tile
     mb = min(m_blocks, minima.shape[1])
-    _, selblk = _twolevel_smallest(minima, mb)  # [Q, mb] in minima space
-    # minima-space block -> original block id
+    vals, selblk = _twolevel_smallest(minima, mb)  # [Q, mb] minima space
+    # minima-space block -> original block id. Dead capacity-padding
+    # programs emit exactly PENALTY and alias data tile 0 — a selected
+    # block is real only if its minimum is below the mask penalty (real
+    # matched blocks carry keys <= 12; all-masked and dead blocks >= 1e9)
+    blk_ok = vals < jnp.float32(PENALTY / 2)
     orig_blk = jnp.take(tile_ids, selblk // bpt) * bpt + selblk % bpt
-    fd, fi = _refine(qx, qy, xf, yf, maskf, orig_blk, n, k, blk)
+    fd, fi = _refine(qx, qy, xf, yf, maskf, orig_blk, n, k, blk,
+                     blk_ok=blk_ok)
     return fd, fi, overflow
 
 

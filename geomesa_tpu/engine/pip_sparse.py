@@ -1,0 +1,410 @@
+"""Sparse pair-list point-in-polygon-LAYER: the config-2 spatial join.
+
+Parity role: `Within()` over an OSM-admin-style polygon LAYER x point
+events (BASELINE.json config 2; upstream: geomesa's Z2/XZ2 index scan +
+JTS prepared-geometry per candidate — SURVEY.md §3.2). The reference
+prunes candidates per polygon through the key-value index; the TPU-native
+equivalent prunes (point-tile x edge-tile) PAIRS on the host from the
+store's Z-order and lets a scalar-prefetched Pallas kernel stream only
+the surviving pairs.
+
+Geometry of the pruning (why skipping whole polygons is exact): the
+crossing-number ray runs to +x. A CLOSED ring never containing the point
+crosses the ray an even number of times, so parity is unchanged if every
+edge of that ring is dropped TOGETHER. Hence:
+  - polygons whose bbox misses the point tile's bbox are dropped whole;
+  - for polygons kept, an edge TILE is dropped only when it provably adds
+    zero crossings for every point in the tile (no y-overlap, or entirely
+    left of the tile) — this never splits a ring's parity.
+To keep "whole polygon" well-defined at tile granularity, the edge table
+pads each polygon to a multiple of EDGE_TILE with degenerate edges
+(y1 == y2 == BIG: never cross, never flag).
+
+Union semantics: the layer's total crossing parity equals point-in-union
+for DISJOINT polygons (admin boundaries; containment count <= 1). Holes
+are interior rings in the same table (parity cancels). Overlapping
+polygons would need per-polygon parity — documented non-goal here.
+
+f32 boundary: a companion band kernel (same pair list) flags points whose
+result is ambiguous at f32 resolution; callers re-evaluate flagged points
+exactly in f64 on the host (cql.hosteval pattern). The refinement uses
+the SAME pair list, so its candidate set is identical.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+POINT_TILE = 512
+EDGE_TILE = 128
+BIG = 1e9  # degenerate-edge y (never crosses, never near a real point)
+
+
+class PairList(NamedTuple):
+    """Host-built sparse join structure (all numpy)."""
+
+    pair_pt: np.ndarray     # [M] point-tile id per pair (sorted)
+    pair_et: np.ndarray     # [M] edge-tile id per pair
+    first: np.ndarray       # [M] 1 where a new point tile starts
+    covered: np.ndarray     # [n_ptiles] bool: tile appears in >=1 pair
+    n_ptiles: int
+    n_etiles: int
+
+
+def pad_polygon_edges(
+    x1, y1, x2, y2, poly_of_edge
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Pad the concatenated oriented edge table so each polygon occupies
+    whole EDGE_TILE tiles (degenerate BIG edges fill the tail). Returns
+    (x1, y1, x2, y2, poly_of_tile [n_etiles])."""
+    outs = [[], [], [], []]
+    poly_of_tile = []
+    for pid in np.unique(poly_of_edge):
+        sel = poly_of_edge == pid
+        e = int(sel.sum())
+        pad = (-e) % EDGE_TILE
+        for o, arr, fill in zip(
+            outs, (x1, y1, x2, y2), (0.0, BIG, 0.0, BIG)
+        ):
+            o.append(np.concatenate([arr[sel], np.full(pad, fill)]))
+        poly_of_tile.extend([pid] * ((e + pad) // EDGE_TILE))
+    return (*(np.concatenate(o) for o in outs),
+            np.asarray(poly_of_tile, np.int64))
+
+
+def build_pairs(
+    ptile_bbox: np.ndarray,   # [T, 4] xmin,ymin,xmax,ymax per point tile
+    etile_bbox: np.ndarray,   # [E, 4] per edge tile (degenerates excluded)
+    poly_of_tile: np.ndarray,  # [E] owning polygon per edge tile
+    poly_bbox: np.ndarray,    # [P, 4]
+    margin: float = 1e-3,
+) -> PairList:
+    """Bbox-prune (point tile x edge tile) pairs, polygon-atomically.
+
+    Pair (T, et) survives iff bbox(poly(et)) intersects bbox(T) (expanded
+    by `margin` for the f32 band) AND et y-overlaps T AND et is not
+    entirely left of T. Sorted by point tile for revisited-output
+    accumulation."""
+    T = ptile_bbox.shape[0]
+    E = etile_bbox.shape[0]
+    pairs_pt = []
+    pairs_et = []
+    # polygon -> its edge tiles (contiguous by construction)
+    # vectorized per polygon: tiles of P vs all point tiles
+    et_of_poly = {}
+    for et, pid in enumerate(poly_of_tile):
+        et_of_poly.setdefault(int(pid), []).append(et)
+    px0, py0, px1, py1 = (ptile_bbox[:, i] for i in range(4))
+    for pid, ets in et_of_poly.items():
+        bx0, by0, bx1, by1 = poly_bbox[pid]
+        hit = np.nonzero(
+            (px1 >= bx0 - margin) & (px0 <= bx1 + margin)
+            & (py1 >= by0 - margin) & (py0 <= by1 + margin)
+        )[0]
+        if not len(hit):
+            continue
+        for et in ets:
+            ex0, ey0, ex1, ey1 = etile_bbox[et]
+            keep = hit[
+                (py1[hit] >= ey0 - margin) & (py0[hit] <= ey1 + margin)
+                & (px1[hit] >= ex0 - margin)
+            ]
+            pairs_pt.append(keep)
+            pairs_et.append(np.full(len(keep), et, np.int64))
+    if pairs_pt:
+        pt = np.concatenate(pairs_pt)
+        et = np.concatenate(pairs_et)
+    else:
+        pt = np.zeros(0, np.int64)
+        et = np.zeros(0, np.int64)
+    order = np.argsort(pt, kind="stable")
+    pt, et = pt[order], et[order]
+    first = np.ones(len(pt), np.int32)
+    first[1:] = (pt[1:] != pt[:-1]).astype(np.int32)
+    covered = np.zeros(T, bool)
+    covered[pt] = True
+    return PairList(pt.astype(np.int32), et.astype(np.int32), first,
+                    covered, T, E)
+
+
+def _sparse_kernel(pt_ref, et_ref, first_ref, px_ref, py_ref,
+                   x1_ref, y1_ref, x2_ref, y2_ref, out_ref):
+    import jax.experimental.pallas as pl
+
+    m = pl.program_id(0)
+
+    @pl.when(first_ref[m] == 1)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    px = px_ref[0]
+    py = py_ref[0]
+    x1 = x1_ref[0]
+    y1 = y1_ref[0]
+    x2 = x2_ref[0]
+    y2 = y2_ref[0]
+    cond = (y1 <= py) != (y2 <= py)
+    t = (py - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
+    xc = x1 + t * (x2 - x1)
+    partial = jnp.sum((cond & (xc > px)).astype(jnp.int32), axis=0)
+    out_ref[...] += partial.reshape(out_ref.shape)
+
+
+def _sparse_band_kernel(pt_ref, et_ref, first_ref, px_ref, py_ref,
+                        x1_ref, y1_ref, x2_ref, y2_ref, out_ref, *,
+                        eps: float):
+    import jax.experimental.pallas as pl
+
+    m = pl.program_id(0)
+
+    @pl.when(first_ref[m] == 1)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    px = px_ref[0]
+    py = py_ref[0]
+    x1 = x1_ref[0]
+    y1 = y1_ref[0]
+    x2 = x2_ref[0]
+    y2 = y2_ref[0]
+    near_end = (jnp.abs(py - y1) <= eps) | (jnp.abs(py - y2) <= eps)
+    cond = (y1 <= py) != (y2 <= py)
+    t = (py - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
+    xc = x1 + t * (x2 - x1)
+    err = eps * (1.0 + jnp.abs(x2 - x1) / jnp.maximum(jnp.abs(y2 - y1), eps))
+    flag = jnp.sum(
+        (near_end | (cond & (jnp.abs(xc - px) <= err))).astype(jnp.int32),
+        axis=0,
+    )
+    out_ref[...] += flag.reshape(out_ref.shape)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_ptiles", "n_etiles", "eps", "interpret")
+)
+def pip_layer_sparse(
+    px: jax.Array,          # [n_ptiles * POINT_TILE] padded, tile-ordered
+    py: jax.Array,
+    x1: jax.Array,          # [n_etiles * EDGE_TILE] polygon-padded
+    y1: jax.Array,
+    x2: jax.Array,
+    y2: jax.Array,
+    pair_pt: jax.Array,     # [M] int32, sorted
+    pair_et: jax.Array,     # [M] int32
+    first: jax.Array,       # [M] int32
+    n_ptiles: int,
+    n_etiles: int,
+    eps: float = 1e-4,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Sparse-pair crossing counts + boundary-band flags.
+
+    Returns (counts int32 [n_ptiles*POINT_TILE], band int32 same shape).
+    Tiles never named in pair_pt hold GARBAGE — mask with PairList.covered
+    (they are provably outside every polygon bbox => count 0, band 0)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    dt = jnp.float32
+    pxp = px.astype(dt).reshape(-1, 1, POINT_TILE)
+    pyp = py.astype(dt).reshape(-1, 1, POINT_TILE)
+    e1 = x1.astype(dt).reshape(-1, EDGE_TILE, 1)
+    f1 = y1.astype(dt).reshape(-1, EDGE_TILE, 1)
+    e2 = x2.astype(dt).reshape(-1, EDGE_TILE, 1)
+    f2 = y2.astype(dt).reshape(-1, EDGE_TILE, 1)
+    assert pxp.shape[0] == n_ptiles and e1.shape[0] == n_etiles
+    M = pair_pt.shape[0]
+
+    point_block = pl.BlockSpec(
+        (1, 1, POINT_TILE), lambda m, pt, et, fr: (pt[m], 0, 0)
+    )
+    edge_block = pl.BlockSpec(
+        (1, EDGE_TILE, 1), lambda m, pt, et, fr: (et[m], 0, 0)
+    )
+    out_block = pl.BlockSpec(
+        (1, 1, POINT_TILE), lambda m, pt, et, fr: (pt[m], 0, 0)
+    )
+
+    with jax.enable_x64(False):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,  # pair_pt, pair_et, first
+            grid=(M,),
+            in_specs=[point_block, point_block,
+                      edge_block, edge_block, edge_block, edge_block],
+            out_specs=out_block,
+        )
+        counts = pl.pallas_call(
+            _sparse_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(
+                (n_ptiles, 1, POINT_TILE), jnp.int32
+            ),
+            interpret=interpret,
+        )(pair_pt, pair_et, first, pxp, pyp, e1, f1, e2, f2)
+
+        grid_spec_b = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(M,),
+            in_specs=[point_block, point_block,
+                      edge_block, edge_block, edge_block, edge_block],
+            out_specs=out_block,
+        )
+        band = pl.pallas_call(
+            functools.partial(_sparse_band_kernel, eps=eps),
+            grid_spec=grid_spec_b,
+            out_shape=jax.ShapeDtypeStruct(
+                (n_ptiles, 1, POINT_TILE), jnp.int32
+            ),
+            interpret=interpret,
+        )(pair_pt, pair_et, first, pxp, pyp, e1, f1, e2, f2)
+    return counts.reshape(-1), band.reshape(-1)
+
+
+class LayerPrep(NamedTuple):
+    """Everything the sparse kernels need, host-built once per layer
+    (the prepared-geometry/index analog; reused by bench.py so the bench
+    and the engine can never desynchronize)."""
+
+    pxp: np.ndarray
+    pyp: np.ndarray
+    ex1: np.ndarray
+    ey1: np.ndarray
+    ex2: np.ndarray
+    ey2: np.ndarray
+    pairs: PairList
+    n_ptiles: int
+    n_etiles: int
+
+
+def prepare_layer(
+    px_np, py_np, x1, y1, x2, y2, poly_of_edge, margin: float = 1e-3
+) -> LayerPrep:
+    """Z-tile the points, polygon-pad the edges, bbox-prune pairs."""
+    n = len(px_np)
+    npad = (-n) % POINT_TILE
+    pxp = np.concatenate([px_np, np.full(npad, 1e8)])
+    pyp = np.concatenate([py_np, np.full(npad, 1e8)])
+    n_ptiles = len(pxp) // POINT_TILE
+    tx = pxp.reshape(n_ptiles, POINT_TILE)
+    ty = pyp.reshape(n_ptiles, POINT_TILE)
+    ptile_bbox = np.stack(
+        [tx.min(1), ty.min(1), tx.max(1), ty.max(1)], 1
+    )
+    # padded tail tile bbox is at 1e8: never intersects a polygon
+
+    ex1, ey1, ex2, ey2, poly_of_tile = pad_polygon_edges(
+        x1, y1, x2, y2, poly_of_edge
+    )
+    n_etiles = len(ex1) // EDGE_TILE
+    tiles = lambda a: a.reshape(n_etiles, EDGE_TILE)  # noqa: E731
+    real = tiles(ey1) < BIG / 2  # degenerate edges excluded from bboxes
+
+    def _bb(a, lo):
+        v = np.where(real, tiles(a), np.inf if lo else -np.inf)
+        return v.min(1) if lo else v.max(1)
+
+    etile_bbox = np.stack([
+        _bb(np.minimum(ex1, ex2), True), _bb(np.minimum(ey1, ey2), True),
+        _bb(np.maximum(ex1, ex2), False), _bb(np.maximum(ey1, ey2), False),
+    ], 1)
+    pids = np.unique(poly_of_edge)
+    poly_bbox = np.zeros((int(pids.max()) + 1, 4))
+    for pid in pids:
+        sel = poly_of_edge == pid
+        poly_bbox[pid] = [
+            min(x1[sel].min(), x2[sel].min()),
+            min(y1[sel].min(), y2[sel].min()),
+            max(x1[sel].max(), x2[sel].max()),
+            max(y1[sel].max(), y2[sel].max()),
+        ]
+    pairs = build_pairs(
+        ptile_bbox, etile_bbox, poly_of_tile, poly_bbox, margin=margin
+    )
+    return LayerPrep(pxp, pyp, ex1, ey1, ex2, ey2, pairs,
+                     n_ptiles, n_etiles)
+
+
+def pip_layer(
+    px_np: np.ndarray,
+    py_np: np.ndarray,
+    x1: np.ndarray,
+    y1: np.ndarray,
+    x2: np.ndarray,
+    y2: np.ndarray,
+    poly_of_edge: np.ndarray,
+    eps: float = 1e-4,
+    interpret: bool = False,
+    refine_f64: bool = True,
+):
+    """End-to-end host orchestration: prepare_layer + sparse kernels +
+    f64 band refinement.
+
+    Returns (inside bool [N], info dict). Points are assumed Z/store-
+    ordered (tile bboxes are only tight then); correctness holds for any
+    order."""
+    n = len(px_np)
+    prep = prepare_layer(px_np, py_np, x1, y1, x2, y2, poly_of_edge)
+    pxp, pyp = prep.pxp, prep.pyp
+    ex1, ey1, ex2, ey2 = prep.ex1, prep.ey1, prep.ex2, prep.ey2
+    n_ptiles, n_etiles = prep.n_ptiles, prep.n_etiles
+    pl_ = prep.pairs
+
+    if len(pl_.pair_pt) == 0:
+        return np.zeros(n, bool), {"pairs": 0, "refined": 0,
+                                   "n_ptiles": n_ptiles,
+                                   "n_etiles": n_etiles}
+
+    counts, band = pip_layer_sparse(
+        jnp.asarray(pxp), jnp.asarray(pyp),
+        jnp.asarray(ex1), jnp.asarray(ey1),
+        jnp.asarray(ex2), jnp.asarray(ey2),
+        jnp.asarray(pl_.pair_pt), jnp.asarray(pl_.pair_et),
+        jnp.asarray(pl_.first),
+        n_ptiles=n_ptiles, n_etiles=n_etiles, eps=eps,
+        interpret=interpret,
+    )
+    counts = np.array(counts).reshape(n_ptiles, POINT_TILE)
+    band_np = np.array(band).reshape(n_ptiles, POINT_TILE)
+    counts[~pl_.covered] = 0
+    band_np[~pl_.covered] = 0
+    inside = (counts.reshape(-1)[:n] % 2) == 1
+    flagged = np.nonzero(band_np.reshape(-1)[:n] > 0)[0]
+
+    refined = 0
+    if refine_f64 and len(flagged):
+        # exact f64 re-evaluation of flagged points over the SAME pair
+        # candidate set, vectorized per point tile ([pts-in-tile, E] ops)
+        et_of_pt: dict = {}
+        for ptid, etid in zip(pl_.pair_pt, pl_.pair_et):
+            et_of_pt.setdefault(int(ptid), []).append(int(etid))
+        by_tile: dict = {}
+        for i in flagged:
+            by_tile.setdefault(i // POINT_TILE, []).append(i)
+        for ptid, idxs in by_tile.items():
+            ets = et_of_pt.get(ptid, [])
+            ii = np.asarray(idxs)
+            if not ets:
+                inside[ii] = False
+                continue
+            sl = np.concatenate(
+                [np.arange(e * EDGE_TILE, (e + 1) * EDGE_TILE) for e in ets]
+            )
+            a1, b1 = ex1[sl], ey1[sl]
+            a2, b2 = ex2[sl], ey2[sl]
+            pxi = px_np[ii][:, None]
+            pyi = py_np[ii][:, None]
+            condx = (b1[None, :] <= pyi) != (b2[None, :] <= pyi)
+            tt = (pyi - b1[None, :]) / np.where(b2 == b1, 1.0, b2 - b1)[None, :]
+            xc = a1[None, :] + tt * (a2 - a1)[None, :]
+            inside[ii] = (np.sum(condx & (xc > pxi), axis=1) % 2) == 1
+            refined += len(ii)
+    return inside, {
+        "pairs": int(len(pl_.pair_pt)), "refined": refined,
+        "n_ptiles": n_ptiles, "n_etiles": n_etiles,
+        "flagged": int(len(flagged)),
+    }

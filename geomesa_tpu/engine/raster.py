@@ -253,6 +253,20 @@ def polygon_density(
     Requires the oriented edge table (shells CCW, holes CW); `wedge` is the
     owning feature's weight replicated per edge.
     """
+    return jnp.maximum(
+        _polygon_density_signed(
+            x1, y1, x2, y2, wedge, edgemask, bbox, width, height, k,
+            seg_tile,
+        ),
+        0.0,
+    )
+
+
+def _polygon_density_signed(
+    x1, y1, x2, y2, wedge, edgemask, bbox: BBox,
+    width: int, height: int, k: int, seg_tile: int = 2048,
+) -> jax.Array:
+    """Signed (pre-clamp) winding grid — linear in the edge set."""
     xmin, ymin, xmax, ymax = bbox
     dx = (xmax - xmin) / width
     dy = (ymax - ymin) / height
@@ -296,7 +310,11 @@ def polygon_density(
         acc = acc.at[idx.reshape(-1)].add(wv.reshape(-1))
         return acc, None
 
-    init = jnp.zeros(height * (width + 1), f32)
+    # derive the init from the inputs so it inherits their varying-
+    # mesh-axes tag (lax.scan carry typing under shard_map — same trick
+    # as engine.knn)
+    vzero = jnp.sum(x1[:1].astype(f32) * 0)
+    init = jnp.zeros(height * (width + 1), f32) + vzero
     acc, _ = jax.lax.scan(tile, init, tuple(arrs) + (mp,))
     a = acc.reshape(height, width + 1)
     rev = jnp.cumsum(a[:, ::-1], axis=1)[:, ::-1]
@@ -306,7 +324,55 @@ def polygon_density(
     # Clamp keeps the grid non-negative; the affected weight mass is
     # bounded by the band width (tested against the f64 oracle as a
     # mismatch-mass fraction, not bitwise).
-    return jnp.maximum(rev[:, 1:], 0.0)
+    # The PRE-clamp grid is linear in the edge set (scatter + cumsum are
+    # both linear), which is what lets polygon_density_sharded psum
+    # per-shard signed grids and clamp ONCE at the end (polygon_density
+    # itself applies the clamp).
+    return rev[:, 1:]
+
+
+def polygon_density_sharded(
+    mesh,
+    x1: jax.Array,
+    y1: jax.Array,
+    x2: jax.Array,
+    y2: jax.Array,
+    wedge: jax.Array,
+    edgemask: jax.Array,
+    bbox: BBox,
+    width: int,
+    height: int,
+    k: int,
+    seg_tile: int = 2048,
+) -> jax.Array:
+    """polygon_density with the oriented EDGE table sharded over the mesh:
+    per-shard signed winding grids psum-merge exactly (the signed grid is
+    linear in edges; edges of one polygon may land on different shards),
+    clamped once after the merge. Returns the full grid, replicated."""
+    import functools as _ft
+
+    from jax.sharding import PartitionSpec as P
+
+    from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+    @_ft.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS),) * 6,
+        out_specs=P(),
+    )
+    def run(a, b, c, d, w, m):
+        # per-shard signed grid = polygon_density minus its final clamp:
+        # recompute via the public kernel on the shard, minus clamping --
+        # the clamp is idempotent on the true grid but NOT linear, so it
+        # must not run before the psum. We get the signed grid by running
+        # the kernel body with clamping disabled.
+        g = _polygon_density_signed(
+            a, b, c, d, w, m, bbox, width, height, k, seg_tile
+        )
+        return jnp.maximum(jax.lax.psum(g, SHARD_AXIS), 0.0)
+
+    return run(x1, y1, x2, y2, wedge, edgemask)
 
 
 def _pow2(n: int) -> int:

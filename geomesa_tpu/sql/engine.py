@@ -12,13 +12,16 @@ the mesh/pjit layer, and this module supplies the SQL surface:
             "WHERE st_intersects(geom, st_geomFromWKT('POLYGON(...)')) "
             "AND score > 0 ORDER BY score DESC LIMIT 10")
 
-Supported: SELECT cols|*|aggregates (COUNT(*)/COUNT(col)/SUM/MIN/MAX/AVG,
-with AS aliases), WHERE with AND/OR/NOT over st_intersects/st_within/
-st_contains/st_dwithin/st_bbox + comparisons/BETWEEN/IN/LIKE (datetime-typed
-comparisons are translated to temporal predicates), GROUP BY, ORDER BY,
-LIMIT, and INNER JOIN on attribute equality (aliases, qualified columns,
-per-side WHERE pushdown riding each table's index, vectorized host-side
-hash join — the relation-join surface of SURVEY.md:381-383).
+Supported: SELECT [DISTINCT] cols|*|aggregates (COUNT(*)/COUNT(col)/
+SUM/MIN/MAX/AVG, with AS aliases), WHERE with AND/OR/NOT over
+st_intersects/st_within/st_contains/st_dwithin/st_bbox + comparisons/
+BETWEEN/IN/LIKE (datetime-typed comparisons are translated to temporal
+predicates), GROUP BY, HAVING, ORDER BY, LIMIT, and JOIN CHAINS on
+attribute equality — INNER / LEFT [OUTER] / RIGHT [OUTER], any number of
+tables left-deep (aliases, qualified columns, per-side WHERE pushdown
+riding each table's index, vectorized host-side hash join; outer-join
+NULLs: NaN doubles, code -1 strings, NULL_I64 ints — the relation-join
+surface of SURVEY.md:381-383).
 
 Non-pushable scalar predicates (e.g. `st_area(geom) > 2` in WHERE) follow
 the reference's LocalQueryRunner contract (SURVEY.md:219): push what the
@@ -143,8 +146,8 @@ class _Where:
 
 
 _KEYWORDS = {
-    "JOIN", "INNER", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "ON",
-    "AS", "AND", "OR", "NOT", "BY",
+    "JOIN", "INNER", "LEFT", "RIGHT", "OUTER", "WHERE", "GROUP", "HAVING",
+    "ORDER", "LIMIT", "ON", "AS", "AND", "OR", "NOT", "BY",
 }
 
 
@@ -195,28 +198,71 @@ class _SqlJoinMixin:
             return t[1]
         return None
 
-    def _join(self, toks: _Tokens, items, t1: str, a1: Optional[str]):
+    def _join(self, toks: _Tokens, items, t1: str, a1: Optional[str],
+              distinct: bool = False):
+        """JOIN chain parser + executor.
+
+        The parse builds a small LOGICAL PLAN — `sides` (table scans with
+        per-side pushdown filters) and `steps` (left-deep equi-join steps
+        with a kind each: inner / left / right) — executed by
+        `_run_join_steps` over per-side row-index arrays where -1 marks
+        an outer join's null-extended row. Aggregation, HAVING, DISTINCT,
+        ORDER BY and LIMIT then operate on the joined intermediate.
+
+        WHERE placement semantics: conjuncts push into each side's SCAN
+        (index-riding, the reference's pushdown contract) — equivalent to
+        ON-clause placement. For OUTER joins this deliberately differs
+        from standard post-join WHERE, where a predicate on the nullable
+        side silently collapses the join to inner; here the filtered side
+        simply scans fewer rows and unmatched rows still null-extend."""
         from geomesa_tpu.plan.planner import QueryResult
 
         if items is None:
             raise SqlError("JOIN needs an explicit select list (no *)")
-        t2 = toks.next()[1]
-        a2 = self._maybe_alias(toks)
-        sides = [
-            _JoinSide(t1, a1, self.ds.get_schema(t1)),
-            _JoinSide(t2, a2, self.ds.get_schema(t2)),
-        ]
-        if sides[0].qual == sides[1].qual:
-            raise SqlError("self-joins need distinct aliases")
-        toks.expect_word("ON")
-        ls, lc = _resolve(sides, toks.next()[1])
-        op = toks.next()
-        if op != ("op", "="):
-            raise SqlError("JOIN ON supports equality only")
-        rs, rc = _resolve(sides, toks.next()[1])
-        if ls is rs:
-            raise SqlError("JOIN ON must reference both tables")
-        keys = {ls.qual: lc, rs.qual: rc}
+        sides = [_JoinSide(t1, a1, self.ds.get_schema(t1))]
+        steps = []  # (kind, (si_prior, col), (si_new, col))
+        while True:
+            kind = "inner"
+            if toks.accept_word("LEFT"):
+                toks.accept_word("OUTER")
+                kind = "left"
+                toks.expect_word("JOIN")
+            elif toks.accept_word("RIGHT"):
+                toks.accept_word("OUTER")
+                kind = "right"
+                toks.expect_word("JOIN")
+            elif toks.accept_word("INNER"):
+                toks.expect_word("JOIN")
+            elif not toks.accept_word("JOIN"):
+                break
+            tn = toks.next()[1]
+            an = self._maybe_alias(toks)
+            new_side = _JoinSide(tn, an, self.ds.get_schema(tn))
+            if any(s.qual == new_side.qual for s in sides):
+                raise SqlError(
+                    f"duplicate table qualifier {new_side.qual!r} — "
+                    "self-joins need distinct aliases"
+                )
+            sides.append(new_side)
+            ni = len(sides) - 1
+            toks.expect_word("ON")
+            s_a, c_a = _resolve(sides, toks.next()[1])
+            if toks.next() != ("op", "="):
+                raise SqlError("JOIN ON supports equality only")
+            s_b, c_b = _resolve(sides, toks.next()[1])
+            ia, ib = sides.index(s_a), sides.index(s_b)
+            if ia == ib:
+                raise SqlError("JOIN ON must reference two tables")
+            if ib == ni:
+                steps.append((kind, (ia, c_a), (ib, c_b)))
+            elif ia == ni:
+                # ON b.x = a.y with the NEW side first: normalize operand
+                # order only — LEFT/RIGHT name TABLES, not operands
+                steps.append((kind, (ib, c_b), (ia, c_a)))
+            else:
+                raise SqlError(
+                    "JOIN ON must reference the table being joined"
+                )
 
         if toks.accept_word("WHERE"):
             self._join_where(toks, sides)
@@ -273,9 +319,10 @@ class _SqlJoinMixin:
         ]
         if has_aggs:
             # the joined intermediate must carry >= 1 column so its row
-            # count survives (COUNT(*) alone references nothing); the join
-            # key is fetched anyway
-            ref(f"{ls.qual}.{lc}")
+            # count survives (COUNT(*) alone references nothing); the
+            # first join key is fetched anyway
+            si0, col0 = steps[0][1]
+            ref(f"{sides[si0].qual}.{col0}")
         if has_aggs:
             for it, r in zip(items, item_refs):
                 if it.kind == "col" and (
@@ -302,16 +349,21 @@ class _SqlJoinMixin:
                 for it, r in zip(items, item_refs)
             ]
 
-        # fetch each side with ITS pushable filter, projected to the join
-        # key + that side's selected columns (no host residuals in JOIN
+        # fetch each side with ITS pushable filter, projected to its join
+        # keys + that side's selected columns (no host residuals in JOIN
         # WHERE, so the needed set is statically known)
+        key_cols: dict = {}  # si -> set of join-key column names
+        for _, (ia, ca), (ib, cb) in steps:
+            key_cols.setdefault(ia, set()).add(ca)
+            key_cols.setdefault(ib, set()).add(cb)
         batches = []
         for si, s in enumerate(sides):
             f: ast.Filter = ast.Include()
             for c in s.filters:
                 f = c if isinstance(f, ast.Include) else ast.And((f, c))
             needed = sorted(
-                {keys[s.qual]} | {c for j, c, _ in out_items if j == si}
+                key_cols.get(si, set())
+                | {c for j, c, _ in out_items if j == si}
             )
             r = self.ds.get_feature_source(s.table).get_features(
                 Query(s.table, f, attributes=needed)
@@ -331,10 +383,8 @@ class _SqlJoinMixin:
                 b = FeatureBatch.from_pydict(sub, {n_: [] for n_ in needed})
             batches.append(b)
 
-        li, ri = _equi_join_indices(
-            batches[0], keys[sides[0].qual], batches[1], keys[sides[1].qual]
-        )
-        result = _join_result(sides, batches, out_items, li, ri)
+        rowidx = _run_join_steps(batches, steps)
+        result = _join_result(sides, batches, out_items, rowidx)
 
         names: dict = {}  # any spelling -> final output column name
         if has_aggs:
@@ -408,6 +458,8 @@ class _SqlJoinMixin:
                     "are renamed <alias>_<col> for disambiguation); valid "
                     f"spellings: {sorted(set(names))}"
                 )
+        if distinct:
+            result = _distinct_batch(result)
         result = _sort_limit_batch(result, sort_by, limit)
         return QueryResult("features", features=result, count=len(result))
 
@@ -506,12 +558,17 @@ def _key_array(batch, col: str) -> np.ndarray:
 
 
 def _equi_join_indices(ba, ca, bb, cb):
-    """Vectorized inner equi-join: sort side B once, then searchsorted
-    ranges per side-A key; NaN/null keys never match."""
+    """Vectorized inner equi-join of two batches on named key columns."""
     if ba is None or bb is None or not len(ba) or not len(bb):
         return np.zeros(0, np.int64), np.zeros(0, np.int64)
-    ka = _key_array(ba, ca)
-    kb = _key_array(bb, cb)
+    return _equi_join_indices_keys(_key_array(ba, ca), _key_array(bb, cb))
+
+
+def _equi_join_indices_keys(ka, kb):
+    """Vectorized inner equi-join on key ARRAYS: sort side B once, then
+    searchsorted ranges per side-A key; NaN/null keys never match."""
+    if not len(ka) or not len(kb):
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
     if ka.dtype.kind == "f":
         valid_a = ~np.isnan(ka)
     else:
@@ -538,7 +595,76 @@ def _equi_join_indices(ba, ca, bb, cb):
     return left, right
 
 
-def _join_result(sides, batches, out_items, li, ri):
+# int64 columns (Date/Long) carry outer-join NULLs as this sentinel —
+# float columns use NaN and dictionary columns code -1 (the conventions
+# the aggregate nonnull_mask already understands)
+NULL_I64 = np.iinfo(np.int64).min
+
+
+def _run_join_steps(batches, steps):
+    """Execute the left-deep join plan -> per-side row-index arrays
+    (length = result rows; -1 marks a null-extended outer row)."""
+    n_sides = len(batches)
+    rowidx = [np.zeros(0, np.int64) for _ in range(n_sides)]
+    n0 = len(batches[0]) if batches[0] is not None else 0
+    rowidx[0] = np.arange(n0, dtype=np.int64)
+    joined = {0}
+    for kind, (ia, ca), (ib, cb) in steps:
+        if ia not in joined:  # pragma: no cover - parser guarantees order
+            raise SqlError("join step references an unjoined table")
+        # key values for the CURRENT result rows (null rows never match)
+        ka_full = _key_array(batches[ia], ca)
+        sel = rowidx[ia]
+        if len(ka_full) == 0:  # empty side: every current row is null-keyed
+            ka_full = np.full(1, np.nan)
+        ka = ka_full[np.clip(sel, 0, len(ka_full) - 1)]
+        null_row = sel < 0
+        if ka.dtype.kind == "f":
+            ka = np.where(null_row, np.nan, ka)
+        elif ka.dtype.kind in "UO":
+            ka = np.where(null_row, "\x00missing", ka)
+        else:
+            ka = np.where(null_row, NULL_I64, ka)
+            # integer sentinel could collide with real data only at
+            # INT64_MIN — not a representable Date/Long in practice
+        li, ri = _equi_join_indices_keys(ka, _key_array(batches[ib], cb))
+        out = []
+        for si in range(n_sides):
+            if si == ib:
+                out.append(ri)
+            elif si in joined:
+                out.append(rowidx[si][li])
+            else:
+                out.append(np.zeros(0, np.int64))
+        if kind in ("left", "right"):
+            if kind == "left":
+                matched = np.zeros(len(ka), bool)
+                matched[li] = True
+                keep = np.nonzero(~matched)[0]
+                for si in range(n_sides):
+                    if si == ib:
+                        out[si] = np.concatenate(
+                            [out[si], np.full(len(keep), -1, np.int64)])
+                    elif si in joined:
+                        out[si] = np.concatenate(
+                            [out[si], rowidx[si][keep]])
+            else:  # right: keep unmatched NEW-side rows, null the rest
+                nb = len(batches[ib]) if batches[ib] is not None else 0
+                matched = np.zeros(nb, bool)
+                matched[ri] = True
+                keep = np.nonzero(~matched)[0]
+                for si in range(n_sides):
+                    if si == ib:
+                        out[si] = np.concatenate([out[si], keep])
+                    elif si in joined:
+                        out[si] = np.concatenate(
+                            [out[si], np.full(len(keep), -1, np.int64)])
+        rowidx = out
+        joined.add(ib)
+    return rowidx
+
+
+def _join_result(sides, batches, out_items, rowidx):
     import dataclasses as _dc
 
     from geomesa_tpu.core.columnar import (
@@ -548,20 +674,57 @@ def _join_result(sides, batches, out_items, li, ri):
     attrs = []
     cols = {}
     seen_geom = False
-    idx = (li, ri)
     for si, col, name in out_items:
         a = sides[si].sft.attribute(col)
         default_geom = a.is_geometry and not seen_geom
         seen_geom = seen_geom or a.is_geometry
+        take = rowidx[si]
+        nulls = take < 0
+        has_nulls = bool(nulls.any())
+        src = batches[si].columns[col]
+        # an EMPTY side can still be null-extended by an outer join: no
+        # row 0 exists to alias, so clip against max(len-1, 0) and rely
+        # on the null fill below (every take is -1 then)
+        safe = np.clip(take, 0, max(len(batches[si]) - 1, 0))
+        if len(batches[si]) == 0:
+            # all rows null-extended; synthesize a null column directly
+            if isinstance(src, DictColumn):
+                cols[name] = DictColumn(
+                    np.full(len(take), -1, np.int32), list(src.vocab))
+            elif isinstance(src, GeometryColumn):
+                cols[name] = GeometryColumn.from_points(
+                    np.full(len(take), np.nan), np.full(len(take), np.nan))
+            else:
+                v = np.asarray(src)
+                if v.dtype.kind == "f":
+                    cols[name] = np.full(len(take), np.nan)
+                else:
+                    cols[name] = np.full(len(take), NULL_I64, np.int64)
+            attrs.append(
+                _dc.replace(a, name=name, default_geom=default_geom))
+            continue
+        if isinstance(src, DictColumn):
+            c = src.take(safe)
+            if has_nulls:
+                codes = np.array(c.codes)
+                codes[nulls] = -1
+                c = DictColumn(codes, c.vocab)
+            cols[name] = c
+        elif isinstance(src, GeometryColumn):
+            cols[name] = src.take(safe)  # outer-null geometry: row 0 copy
+        else:
+            v = np.asarray(src)[safe]
+            if has_nulls:
+                if v.dtype.kind == "f":
+                    v = v.copy()
+                    v[nulls] = np.nan
+                elif v.dtype.kind in "iu":
+                    v = v.astype(np.int64, copy=True)
+                    v[nulls] = NULL_I64
+            cols[name] = v
         attrs.append(
             _dc.replace(a, name=name, default_geom=default_geom)
         )
-        src = batches[si].columns[col]
-        take = idx[si]
-        if isinstance(src, (DictColumn, GeometryColumn)):
-            cols[name] = src.take(take)
-        else:
-            cols[name] = np.asarray(src)[take]
     sub = SimpleFeatureType("join", attrs)
     return FeatureBatch(sub, cols)
 
@@ -578,15 +741,16 @@ class SqlContext(_SqlJoinMixin):
         """Run a SELECT; returns QueryResult (features/count)."""
         toks = _Tokens(text.strip().rstrip(";"))
         toks.expect_word("SELECT")
+        distinct = bool(toks.accept_word("DISTINCT"))
         items = self._select_list(toks)
         toks.expect_word("FROM")
         table = toks.next()[1]
         alias1 = self._maybe_alias(toks)
-        if toks.accept_word("INNER"):
-            toks.expect_word("JOIN")
-            return self._join(toks, items, table, alias1)
-        if toks.accept_word("JOIN"):
-            return self._join(toks, items, table, alias1)
+        nxt = toks.peek()
+        if nxt and nxt[0] == "word" and nxt[1].upper() in (
+            "JOIN", "INNER", "LEFT", "RIGHT"
+        ):
+            return self._join(toks, items, table, alias1, distinct=distinct)
         # single-table with an alias: bind it by stripping `alias.` /
         # `table.` qualifiers from every remaining reference (and from the
         # already-parsed select list) so qualified refs resolve
@@ -702,18 +866,28 @@ class SqlContext(_SqlJoinMixin):
                 result = _apply_having(
                     result, having, items, [it.alias for it in items]
                 )
+            if distinct:
+                result = _distinct_batch(result)
             result = _sort_limit_batch(result, sort_by, limit)
             return QueryResult(
                 "features", features=result, count=len(result)
             )
 
         cols = [it.col for it in items] if items is not None else None
-        if not where.host:
+        if not where.host and not distinct:
             q = Query(
                 table, where.cql, attributes=cols,
                 sort_by=sort_by, max_features=limit,
             )
             return src.get_features(q)
+        if not where.host:  # DISTINCT: dedup before LIMIT, sort pushed
+            q = Query(table, where.cql, attributes=cols, sort_by=sort_by)
+            r = src.get_features(q)
+            batch = _distinct_batch(r.features)
+            if batch is not None and limit is not None and len(batch) > limit:
+                batch = batch.select(np.arange(limit))
+            n_out = 0 if batch is None else len(batch)
+            return QueryResult("features", features=batch, count=n_out)
         # local post-filter path: fetch unlimited (the limit applies to
         # post-filter survivors), all attributes (the host predicates may
         # read columns the projection would drop), project afterwards
@@ -723,10 +897,12 @@ class SqlContext(_SqlJoinMixin):
         if batch is None or not len(batch):
             return r
         batch = self._apply_host(batch, where)
-        if limit is not None and len(batch) > limit:
-            batch = batch.select(np.arange(limit))
         if cols:
             batch = _project(batch, cols)
+        if distinct:
+            batch = _distinct_batch(batch)
+        if limit is not None and len(batch) > limit:
+            batch = batch.select(np.arange(limit))
         return QueryResult("features", features=batch, count=len(batch))
 
     def _apply_host(self, batch, where: _Where):
@@ -1223,6 +1399,49 @@ def _project(batch, cols: List[str]):
     return FeatureBatch(
         sub, {c: batch.columns[c] for c in cols}, batch.fids, batch.valid
     )
+
+
+def _distinct_batch(batch):
+    """SELECT DISTINCT: drop duplicate result rows (first occurrence
+    wins, preserving any prior sort). Row keys: dict codes (batch-local,
+    consistent within one result), raw numeric values, and for geometry
+    columns the WKT serialization (exact for every kind)."""
+    from geomesa_tpu.core.columnar import DictColumn, GeometryColumn
+
+    if batch is None or not len(batch):
+        return batch
+    keys = []
+    for name in batch.sft.attribute_names:
+        col = batch.columns.get(name)
+        if col is None:
+            continue
+        if isinstance(col, DictColumn):
+            keys.append(np.asarray(col.codes))
+        elif isinstance(col, GeometryColumn):
+            from geomesa_tpu.core.wkt import to_wkt
+
+            keys.append(np.asarray(
+                [to_wkt(col.geometry(i)) for i in range(len(col))],
+                dtype=object,
+            ))
+        else:
+            keys.append(np.asarray(col))
+    if not keys:
+        return batch
+    seen: dict = {}
+    keep = []
+    for i in range(len(batch)):
+        k = tuple(a[i] if a.dtype != object else a[i] for a in keys)
+        # NaN != NaN would make every null row distinct; canonicalize
+        k = tuple(
+            "\x00nan" if isinstance(v, float) and v != v else v for v in k
+        )
+        if k not in seen:
+            seen[k] = True
+            keep.append(i)
+    if len(keep) == len(batch):
+        return batch
+    return batch.select(np.asarray(keep))
 
 
 def _sort_limit_batch(batch, sort_by, limit):

@@ -33,8 +33,55 @@ _U33 = np.uint64(33)
 # stamped into hash-dependent sketch JSON; loading a sketch built with a
 # different hash family would silently corrupt CMS counts / HLL registers,
 # so deserialization rejects mismatches (StatsManager drops + warns, and
-# stats-analyze regenerates — sketches are derived data)
-HASH_VERSION = "fnv1a-fmix64-v1"
+# stats-analyze regenerates — sketches are derived data).
+# v2: numeric values hash through a PURE-32-BIT pipeline (2x murmur32
+# fmix over the value's 32-bit halves; floats canonicalized via their f32
+# bit pattern) so the DEVICE observation kernels (engine.stats) can run
+# it — the TPU x64 rewriter has no rule for 64-bit bitcasts, so an
+# f64-bit-pattern hash cannot compile there. Strings keep FNV-1a+fmix64
+# (host-only path). f32 canonicalization merges float values closer than
+# f32 resolution — irrelevant at sketch precision.
+HASH_VERSION = "fnv1a-fmix64-str.m32x2-num-v2"
+
+_M32_1 = np.uint32(0x85EBCA6B)
+_M32_2 = np.uint32(0xC2B2AE35)
+
+
+def _fmix32(h: np.ndarray) -> np.ndarray:
+    h = h ^ (h >> np.uint32(16))
+    h = h * _M32_1
+    h = h ^ (h >> np.uint32(13))
+    h = h * _M32_2
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def _halves_u32(u: np.ndarray):
+    """(lo, hi) 32-bit halves of a numeric column's canonical pattern:
+    floats -> their f32 bit pattern (hi = 0), ints/bools -> 64-bit wrap
+    split. Mirrored exactly by engine.stats._halves_u32_dev."""
+    if u.dtype.kind == "f":
+        return u.astype(np.float32).view(np.uint32), np.zeros(
+            len(u), np.uint32
+        )
+    if u.dtype.kind == "M":
+        u = u.astype("datetime64[ms]").view(np.int64)
+    v = u.astype(np.uint64)
+    return (v & np.uint64(0xFFFFFFFF)).astype(np.uint32), (
+        v >> np.uint64(32)
+    ).astype(np.uint32)
+
+
+def _hash64_numeric(lo: np.ndarray, hi: np.ndarray, seed: int):
+    """(h1, h2) u32 pair — the numeric hash family shared with the device
+    kernels. h1 carries the HLL register index / CMS column, (h1, h2)
+    together form the 64-bit rank word."""
+    s1 = np.uint32((seed * 0x9E3779B9 + 0x165667B1) & 0xFFFFFFFF)
+    s2 = np.uint32((seed * 0x85EBCA77 + 0x27D4EB2F) & 0xFFFFFFFF)
+    with np.errstate(over="ignore"):
+        h1 = _fmix32(lo ^ _fmix32(hi ^ s1))
+        h2 = _fmix32(h1 ^ hi ^ s2)
+    return h1, h2
 
 
 def _hash64(values, seed: int = 0) -> np.ndarray:
@@ -51,23 +98,13 @@ def _hash64(values, seed: int = 0) -> np.ndarray:
     """
     u = np.asarray(values)
     init = np.uint64((_FNV_OFFSET ^ (seed * _SEED_MIX)) & 0xFFFFFFFFFFFFFFFF)
-    if u.dtype.kind in "iub" and u.dtype.itemsize <= 8:
-        # numeric fast path: hash the 64-bit pattern directly (no string
-        # materialization). Same-value-same-hash holds because a column
-        # keeps one dtype; only register-merge consistency matters (there
-        # is no string-keyed lookup against HLL registers).
-        with np.errstate(over="ignore"):
-            h = u.astype(np.uint64) ^ init
-            h ^= h >> _U33
-            h *= _M1
-            h ^= h >> _U33
-            h *= _M2
-            h ^= h >> _U33
-        return h
-    if u.dtype.kind == "f":
-        return _hash64(u.astype(np.float64).view(np.uint64), seed)
-    if u.dtype.kind == "M":
-        return _hash64(u.astype("datetime64[ms]").view(np.int64), seed)
+    if u.dtype.kind in "iubfM" and u.dtype.itemsize <= 8:
+        # numeric fast path: the device-shared pure-32-bit family (no
+        # string materialization). Same-value-same-hash holds because a
+        # column keeps one dtype; only register-merge consistency matters.
+        lo, hi = _halves_u32(u)
+        h1, h2 = _hash64_numeric(lo, hi, seed)
+        return (h1.astype(np.uint64) << np.uint64(32)) | h2.astype(np.uint64)
     if u.dtype.kind != "U":
         u = u.astype(str)
     n = u.shape[0]
@@ -96,14 +133,23 @@ def _hash64(values, seed: int = 0) -> np.ndarray:
 
 
 def _bit_length_u64(x: np.ndarray) -> np.ndarray:
-    """Vectorized bit_length of uint64 values (0 -> 0) via the float64
-    exponent field — no transcendentals (log2 over the batch cost ~20s at
-    67M rows). Round-to-nearest can overstate the length by 1 only for
-    values with >=52 consecutive 1-bits after the leading bit (probability
-    ~2^-52): deterministic per value, irrelevant at HLL precision."""
-    f = x.astype(np.float64)
-    exp = (f.view(np.uint64) >> np.uint64(52)).astype(np.int64) & 0x7FF
-    return np.where(x > 0, exp - 1022, 0)
+    """Vectorized bit_length of uint64 values (0 -> 0), computed from the
+    value's 32-bit halves via the FLOAT32 exponent field — the exact
+    formulation the device kernels use (engine.stats._bit_length_u32_dev;
+    the TPU x64 rewriter cannot bitcast 64-bit), so host- and device-
+    observed HLL ranks agree bit-for-bit. Round-to-nearest can overstate
+    a half's length by 1 for values with >=23 consecutive 1-bits after
+    the leading bit (~2^-23): deterministic and IDENTICAL on both sides,
+    irrelevant at HLL precision."""
+    hi = (x >> np.uint64(32)).astype(np.uint32)
+    lo = (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+    def bl32(v):
+        f = v.astype(np.float32)
+        exp = (f.view(np.uint32) >> np.uint32(23)).astype(np.int64) & 0xFF
+        return np.where(v > 0, exp - 126, 0)
+
+    return np.where(hi > 0, 32 + bl32(hi), bl32(lo))
 
 
 class Stat:
@@ -214,6 +260,18 @@ class Cardinality(Stat):
         batch_max = ((occ > 0) * np.arange(65)).max(axis=1).astype(np.uint8)
         self.registers = np.maximum(self.registers, batch_max)
 
+    def observe_registers(self, ranks: np.ndarray):
+        """Fold device-computed register ranks (engine.stats.hll_registers
+        — bit-identical hash family, so max-merge is lossless)."""
+        ranks = np.asarray(ranks)
+        if ranks.shape != (self.m,):
+            raise ValueError(
+                f"register fold shape {ranks.shape} != (m={self.m},)"
+            )
+        self.registers = np.maximum(
+            self.registers, ranks.astype(np.uint8)
+        )
+
     def merge(self, other):
         self.registers = np.maximum(self.registers, other.registers)
         return self
@@ -243,14 +301,23 @@ class Cardinality(Stat):
 
 
 class Frequency(Stat):
-    """Count-Min sketch for value frequencies (upstream: Frequency)."""
+    """Count-Min sketch for value frequencies (upstream: Frequency).
+
+    Two keying modes, fixed at construction and enforced across merge and
+    JSON round trips: string keys (default — values are stringified before
+    hashing, matching dictionary-column feeds) or NUMERIC keys (the raw
+    64-bit value pattern — what the device observation kernel
+    engine.stats.cms_table produces; upstream likewise hashes primitive
+    attribute values directly)."""
 
     kind = "frequency"
 
-    def __init__(self, attribute: str, width: int = 1024, depth: int = 4, table=None):
+    def __init__(self, attribute: str, width: int = 1024, depth: int = 4,
+                 table=None, numeric_keys: bool = False):
         self.attribute = attribute
         self.width = width
         self.depth = depth
+        self.numeric_keys = numeric_keys
         self.table = (
             np.zeros((depth, width), np.int64) if table is None else np.asarray(table, np.int64)
         )
@@ -260,6 +327,21 @@ class Frequency(Stat):
             np.int64
         )
 
+    def observe_table(self, table: np.ndarray):
+        """Fold a device-computed [depth, width] observation
+        (engine.stats.cms_table; numeric-keyed sketches only)."""
+        if not self.numeric_keys:
+            raise ValueError(
+                "observe_table feeds numeric-keyed CMS observations; this "
+                "sketch is string-keyed (construct with numeric_keys=True)"
+            )
+        table = np.asarray(table, np.int64)
+        if table.shape != self.table.shape:
+            raise ValueError(
+                f"CMS fold shape {table.shape} != {self.table.shape}"
+            )
+        self.table += table
+
     def _add(self, vals: np.ndarray, counts: np.ndarray):
         counts = np.asarray(counts, np.int64)
         for d in range(self.depth):
@@ -268,6 +350,11 @@ class Frequency(Stat):
     def observe(self, values, mask=None):
         v = _masked(np.asarray(values), mask)
         if not len(v):
+            return
+        if self.numeric_keys:
+            # raw 64-bit pattern keying (device-kernel-compatible)
+            uniq, counts = np.unique(v, return_counts=True)
+            self._add(uniq, counts)
             return
         # unique on RAW values (cheap for numeric columns), stringify only
         # the distinct values so hashing matches the string-keyed count()
@@ -279,15 +366,28 @@ class Frequency(Stat):
 
     def observe_counts(self, vocab: Sequence[str], counts: np.ndarray):
         """Feed from engine.stats.masked_value_counts results."""
+        if self.numeric_keys:
+            raise ValueError("numeric-keyed CMS cannot fold string vocab")
         self._add(np.asarray(vocab, dtype=str), counts)
 
     def count(self, value) -> int:
-        vals = np.asarray([str(value)])
+        if self.numeric_keys:
+            vals = np.asarray([value])
+            if vals.dtype.kind not in "iufb":
+                raise ValueError(
+                    "numeric-keyed CMS lookups need a numeric value"
+                )
+        else:
+            vals = np.asarray([str(value)])
         return int(
             min(self.table[d, self._cols(vals, d)[0]] for d in range(self.depth))
         )
 
     def merge(self, other):
+        if self.numeric_keys != getattr(other, "numeric_keys", False):
+            raise ValueError(
+                "cannot merge numeric-keyed and string-keyed CMS sketches"
+            )
         self.table += other.table
         return self
 
@@ -297,7 +397,8 @@ class Frequency(Stat):
     def to_json(self):
         return {"kind": self.kind, "attribute": self.attribute,
                 "width": self.width, "depth": self.depth,
-                "hash": HASH_VERSION, "table": self.table.tolist()}
+                "hash": HASH_VERSION, "numeric_keys": self.numeric_keys,
+                "table": self.table.tolist()}
 
     @classmethod
     def _from_json(cls, d):
@@ -307,7 +408,8 @@ class Frequency(Stat):
                 f"{d.get('hash', 'blake2b-v0')!r}, this build uses "
                 f"{HASH_VERSION!r}; rerun stats-analyze"
             )
-        return cls(d["attribute"], d["width"], d["depth"], d["table"])
+        return cls(d["attribute"], d["width"], d["depth"], d["table"],
+                   numeric_keys=bool(d.get("numeric_keys", False)))
 
 
 class TopK(Stat):

@@ -152,3 +152,32 @@ class TestSparseScan:
         np.testing.assert_allclose(
             np.sort(np.asarray(fd), 1), exp, rtol=1e-4, atol=1.0)
         assert (np.asarray(fi) >= 8192 - 50).all()
+
+
+def test_sparse_dead_slots_never_duplicate_tile0():
+    # capacity-padding programs alias data tile 0; with sparse matches
+    # (fewer real blocks than m_blocks) their PENALTY minima used to win
+    # selection and duplicate tile-0 lanes in the refine pool
+    rng = np.random.default_rng(13)
+    n, q, k = 8192, 6, 4
+    x = np.sort(rng.uniform(-180, 180, n))
+    y = rng.uniform(-90, 90, n)
+    mask = np.zeros(n, bool)
+    mask[:6] = True  # all matches in tile 0, fewer than k*blk
+    qx = rng.uniform(-30, 30, q)
+    qy = rng.uniform(-60, 60, q)
+    dev = [jnp.asarray(a, jnp.float32) for a in (qx, qy, x, y)]
+    fd, fi, ov = knn_sparse_scan(
+        *dev, jnp.asarray(mask), k=k, tile_capacity=8, m_blocks=8,
+        interpret=True, **TINY)
+    assert not bool(ov)
+    fd = np.asarray(fd)
+    fi = np.asarray(fi)
+    for i in range(q):
+        fin = np.isfinite(fd[i])
+        assert fin.sum() == k  # 6 matches exist, k=4 all fillable
+        # no duplicated neighbor indices among finite results
+        assert len(set(fi[i][fin].tolist())) == int(fin.sum())
+    exp = oracle(qx, qy, x, y, mask, k)
+    np.testing.assert_allclose(
+        np.sort(fd, 1), exp, rtol=1e-4, atol=1.0)

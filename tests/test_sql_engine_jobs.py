@@ -459,7 +459,7 @@ class TestSqlJoin:
             ctx.sql("SELECT * FROM events e JOIN countries c ON e.actor = c.code")
         with pytest.raises(SqlError, match="ambiguous"):
             ctx.sql("SELECT geom FROM events e JOIN countries c ON e.actor = c.code")
-        with pytest.raises(SqlError, match="both tables"):
+        with pytest.raises(SqlError, match="two tables"):
             ctx.sql("SELECT e.actor FROM events e JOIN countries c ON e.actor = e.actor")
 
     def test_join_spatial_pushdown_per_side(self, tmp_path):
@@ -729,3 +729,142 @@ class TestSqlHaving:
                 "JOIN countries c ON e.actor = c.code "
                 "GROUP BY c.code HAVING e.score > 0"
             )
+
+
+class TestSqlJoinVariants:
+    """Round-3 surface: multi-table chains, LEFT/RIGHT OUTER, DISTINCT
+    (VERDICT.md round-2 task 6)."""
+
+    def _three_tables(self, tmp_path):
+        rng = np.random.default_rng(37)
+        ev_sft = SimpleFeatureType.from_spec(
+            "events", "actor:String,score:Double,*geom:Point")
+        n = 120
+        actors = rng.choice(["USA", "FRA", "CHN", "XXX"], n)
+        ds = DataStore(str(tmp_path / "cat"))
+        ds.create_schema(ev_sft).write(FeatureBatch.from_pydict(ev_sft, {
+            "actor": actors.tolist(),
+            "score": rng.uniform(-10, 10, n),
+            "geom": np.stack([rng.uniform(-170, 170, n),
+                              rng.uniform(-80, 80, n)], 1)}))
+        c_sft = SimpleFeatureType.from_spec(
+            "countries", "code:String,region:String,pop:Double,*geom:Point")
+        ds.create_schema(c_sft).write(FeatureBatch.from_pydict(c_sft, {
+            "code": ["USA", "FRA", "CHN", "GBR"],
+            "region": ["AM", "EU", "AS", "EU"],
+            "pop": [331.0, 67.0, 1412.0, 67.2],
+            "geom": np.array([[-98.0, 39.0], [2.0, 46.0],
+                              [104.0, 35.0], [-2.0, 54.0]])}))
+        r_sft = SimpleFeatureType.from_spec(
+            "regions", "rcode:String,rname:String,*geom:Point")
+        ds.create_schema(r_sft).write(FeatureBatch.from_pydict(r_sft, {
+            "rcode": ["AM", "EU"],
+            "rname": ["America", "Europe"],
+            "geom": np.array([[-90.0, 40.0], [10.0, 50.0]])}))
+        return ds, actors
+
+    def test_three_table_chain(self, tmp_path):
+        ds, actors = self._three_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT e.actor, c.region, r.rname FROM events e "
+            "JOIN countries c ON e.actor = c.code "
+            "JOIN regions r ON c.region = r.rcode "
+            "ORDER BY e.actor"
+        )
+        t = r.features
+        reg = {"USA": "AM", "FRA": "EU", "CHN": None, "GBR": "EU"}
+        exp = sum(1 for a in actors if reg.get(a) in ("AM", "EU"))
+        assert len(t) == exp
+        names = dict(AM="America", EU="Europe")
+        for a, rn in zip(t.columns["actor"].decode(),
+                         t.columns["rname"].decode()):
+            assert names[reg[a]] == rn
+
+    def test_left_outer_join(self, tmp_path):
+        ds, actors = self._three_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT e.actor, c.pop FROM events e "
+            "LEFT JOIN countries c ON e.actor = c.code"
+        )
+        t = r.features
+        assert len(t) == len(actors)  # every event row survives
+        pops = {"USA": 331.0, "FRA": 67.0, "CHN": 1412.0}
+        got_pop = np.asarray(t.column("pop"))
+        for a, p in zip(t.columns["actor"].decode(), got_pop):
+            if a in pops:
+                assert p == pops[a]
+            else:
+                assert np.isnan(p)  # XXX has no country -> NULL
+
+    def test_right_outer_join(self, tmp_path):
+        ds, actors = self._three_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT e.actor, c.code FROM events e "
+            "RIGHT JOIN countries c ON e.actor = c.code"
+        )
+        t = r.features
+        n_matched = sum(1 for a in actors if a in ("USA", "FRA", "CHN"))
+        assert len(t) == n_matched + 1  # GBR row survives unmatched
+        codes = t.columns["code"].decode()
+        assert "GBR" in codes
+        i = codes.index("GBR")
+        assert t.columns["actor"].decode()[i] is None  # null-extended
+
+    def test_left_join_aggregate_counts_nulls_correctly(self, tmp_path):
+        ds, actors = self._three_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT e.actor, COUNT(c.pop) AS npop, COUNT(*) AS nrows "
+            "FROM events e LEFT JOIN countries c ON e.actor = c.code "
+            "GROUP BY e.actor ORDER BY e.actor"
+        )
+        t = r.features
+        for a, np_, nr in zip(t.columns["actor"].decode(),
+                              np.asarray(t.column("npop")),
+                              np.asarray(t.column("nrows"))):
+            exp_rows = int((actors == a).sum())
+            assert nr == exp_rows
+            assert np_ == (exp_rows if a != "XXX" else 0)  # NULLs skipped
+
+    def test_distinct_single_table(self, tmp_path):
+        sft, batch, ds = make_store(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql("SELECT DISTINCT actor FROM gdelt ORDER BY actor")
+        got = r.features.columns["actor"].decode()
+        assert got == sorted(set(batch.columns["actor"].decode()))
+        # DISTINCT + LIMIT: dedup happens before the limit
+        r2 = ctx.sql("SELECT DISTINCT actor FROM gdelt LIMIT 2")
+        assert len(r2.features) == 2
+        assert len(set(r2.features.columns["actor"].decode())) == 2
+
+    def test_distinct_join(self, tmp_path):
+        ds, actors = self._three_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT DISTINCT c.region FROM events e "
+            "JOIN countries c ON e.actor = c.code ORDER BY c.region"
+        )
+        got = r.features.columns["region"].decode()
+        present = {a for a in actors if a in ("USA", "FRA", "CHN")}
+        exp = sorted({{"USA": "AM", "FRA": "EU", "CHN": "AS"}[a]
+                      for a in present})
+        assert got == exp
+
+    def test_outer_join_empty_side(self, tmp_path):
+        # an outer join whose filtered side is EMPTY must null-extend,
+        # not crash (round-3 review finding). NB: WHERE pushes into the
+        # SCAN (ON-clause placement; documented in _join) — post-join
+        # WHERE semantics would instead collapse the join to inner
+        ds, actors = self._three_tables(tmp_path)
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT e.actor, c.pop FROM events e "
+            "LEFT JOIN countries c ON e.actor = c.code "
+            "WHERE c.pop > 1e9"
+        )
+        t = r.features
+        assert len(t) == len(actors)
+        assert np.isnan(np.asarray(t.column("pop"))).all()

@@ -143,3 +143,102 @@ class TestSerialization:
                 np.testing.assert_array_equal(r1, r2)
             else:
                 assert r1 == r2
+
+
+class TestDeviceSketchObservation:
+    """Device-side hash+fold kernels (engine.stats.hll_registers /
+    cms_table) must be bit-compatible with the host sketch pipeline —
+    the merge laws only hold if both observers agree per value."""
+
+    def test_hll_registers_match_host(self):
+        import jax.numpy as jnp
+
+        from geomesa_tpu.engine.stats import hll_registers
+        from geomesa_tpu.stats.sketches import Cardinality
+
+        rng = np.random.default_rng(3)
+        for vals in (
+            rng.integers(0, 10_000, 40_000),
+            rng.uniform(-1000, 1000, 40_000),
+        ):
+            mask = rng.random(len(vals)) < 0.7
+            host = Cardinality("a")
+            host.observe(vals, mask)
+            dev = Cardinality("a")
+            dev.observe_registers(
+                np.asarray(hll_registers(jnp.asarray(vals), jnp.asarray(mask)))
+            )
+            np.testing.assert_array_equal(dev.registers, host.registers)
+            # merge law: folding device registers into a host-observed
+            # sketch is a no-op when they saw the same values
+            host.observe_registers(dev.registers)
+            np.testing.assert_array_equal(dev.registers, host.registers)
+
+    def test_cms_table_matches_numeric_keyed_host(self):
+        import jax.numpy as jnp
+
+        from geomesa_tpu.engine.stats import cms_table
+        from geomesa_tpu.stats.sketches import Frequency
+
+        rng = np.random.default_rng(5)
+        vals = rng.integers(0, 50, 20_000)
+        mask = rng.random(len(vals)) < 0.5
+        host = Frequency("a", numeric_keys=True)
+        host.observe(vals, mask)
+        dev = Frequency("a", numeric_keys=True)
+        dev.observe_table(
+            np.asarray(cms_table(jnp.asarray(vals), jnp.asarray(mask)))
+        )
+        np.testing.assert_array_equal(dev.table, host.table)
+        # point lookups over-estimate but never under-estimate
+        true = np.bincount(vals[mask], minlength=50)
+        for v in range(50):
+            assert dev.count(v) >= true[v]
+
+    def test_cms_keying_contract(self):
+        import pytest as _pytest
+
+        from geomesa_tpu.stats.sketches import Frequency, Stat
+
+        s = Frequency("a")  # string-keyed
+        with _pytest.raises(ValueError, match="numeric"):
+            s.observe_table(np.zeros((4, 1024)))
+        n = Frequency("a", numeric_keys=True)
+        with _pytest.raises(ValueError, match="merge"):
+            n.merge(s)
+        # keying survives the JSON round trip
+        j = Stat.from_json(n.to_json())
+        assert j.numeric_keys is True
+
+    def test_stats_scan_uses_device_hll(self, tmp_path):
+        # end-to-end: a stats-scan over a numeric column produces the
+        # same HLL estimate as a pure host observation
+        import jax.numpy as jnp  # noqa: F401
+
+        from geomesa_tpu.core.columnar import FeatureBatch
+        from geomesa_tpu.core.sft import SimpleFeatureType
+        from geomesa_tpu.plan.datastore import DataStore
+        from geomesa_tpu.plan.hints import QueryHints
+        from geomesa_tpu.plan.query import Query
+        from geomesa_tpu.stats.sketches import Cardinality
+
+        rng = np.random.default_rng(11)
+        n = 4000
+        score = rng.integers(0, 500, n).astype(np.float64)
+        sft = SimpleFeatureType.from_spec("t", "score:Double,*geom:Point")
+        ds = DataStore(str(tmp_path / "cat"))
+        src = ds.create_schema(sft)
+        src.write(FeatureBatch.from_pydict(sft, {
+            "score": score,
+            "geom": np.stack([rng.uniform(-10, 10, n),
+                              rng.uniform(-10, 10, n)], 1),
+        }))
+        r = src.get_features(Query(
+            "t", "INCLUDE",
+            hints=QueryHints(stats_string="Cardinality(score)"),
+        ))
+        got = [s for s in r.stats.stats if isinstance(s, Cardinality)]
+        assert got, "stats scan returned no Cardinality sketch"
+        host = Cardinality("score")
+        host.observe(score)
+        np.testing.assert_array_equal(got[0].registers, host.registers)
