@@ -220,8 +220,7 @@ def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
         jnp.asarray(pxp), jnp.asarray(pyp),
         jnp.asarray(ex1), jnp.asarray(ey1),
         jnp.asarray(ex2), jnp.asarray(ey2),
-        jnp.asarray(plist.pair_pt), jnp.asarray(plist.pair_et),
-        jnp.asarray(plist.first),
+        plist.pair_pt, plist.pair_et,
     )
 
     def run():

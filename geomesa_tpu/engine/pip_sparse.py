@@ -132,22 +132,28 @@ def build_pairs(
                     covered, T, E)
 
 
-def _sparse_kernel(pt_ref, et_ref, first_ref, px_ref, py_ref,
+def _sparse_kernel(pt_ref, et_ref, px_ref, py_ref,
                    x1_ref, y1_ref, x2_ref, y2_ref, out_ref):
     import jax.experimental.pallas as pl
 
     m = pl.program_id(0)
+    # first-visit detection from the pt scalars themselves (a dedicated
+    # flags array would blow the 1 MB SMEM prefetch budget at ~100k pairs)
+    prev = pt_ref[jnp.maximum(m - 1, 0)]
 
-    @pl.when(first_ref[m] == 1)
+    @pl.when((m == 0) | (pt_ref[m] != prev))
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
     px = px_ref[0]
     py = py_ref[0]
-    x1 = x1_ref[0]
-    y1 = y1_ref[0]
-    x2 = x2_ref[0]
-    y2 = y2_ref[0]
+    # edges arrive lane-major ([1, EDGE_TILE]: a [E, 128, 1] layout pads
+    # the 1-wide lane dim 128x -> 7 GB/array at 15M edge slots) and are
+    # transposed onto sublanes in VMEM for the [E, P] broadcast
+    x1 = x1_ref[0].reshape(EDGE_TILE, 1)
+    y1 = y1_ref[0].reshape(EDGE_TILE, 1)
+    x2 = x2_ref[0].reshape(EDGE_TILE, 1)
+    y2 = y2_ref[0].reshape(EDGE_TILE, 1)
     cond = (y1 <= py) != (y2 <= py)
     t = (py - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
     xc = x1 + t * (x2 - x1)
@@ -155,23 +161,24 @@ def _sparse_kernel(pt_ref, et_ref, first_ref, px_ref, py_ref,
     out_ref[...] += partial.reshape(out_ref.shape)
 
 
-def _sparse_band_kernel(pt_ref, et_ref, first_ref, px_ref, py_ref,
+def _sparse_band_kernel(pt_ref, et_ref, px_ref, py_ref,
                         x1_ref, y1_ref, x2_ref, y2_ref, out_ref, *,
                         eps: float):
     import jax.experimental.pallas as pl
 
     m = pl.program_id(0)
+    prev = pt_ref[jnp.maximum(m - 1, 0)]
 
-    @pl.when(first_ref[m] == 1)
+    @pl.when((m == 0) | (pt_ref[m] != prev))
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
     px = px_ref[0]
     py = py_ref[0]
-    x1 = x1_ref[0]
-    y1 = y1_ref[0]
-    x2 = x2_ref[0]
-    y2 = y2_ref[0]
+    x1 = x1_ref[0].reshape(EDGE_TILE, 1)
+    y1 = y1_ref[0].reshape(EDGE_TILE, 1)
+    x2 = x2_ref[0].reshape(EDGE_TILE, 1)
+    y2 = y2_ref[0].reshape(EDGE_TILE, 1)
     near_end = (jnp.abs(py - y1) <= eps) | (jnp.abs(py - y2) <= eps)
     cond = (y1 <= py) != (y2 <= py)
     t = (py - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
@@ -187,6 +194,103 @@ def _sparse_band_kernel(pt_ref, et_ref, first_ref, px_ref, py_ref,
 @functools.partial(
     jax.jit, static_argnames=("n_ptiles", "n_etiles", "eps", "interpret")
 )
+def _pip_sparse_call(
+    px, py, x1, y1, x2, y2, pair_pt, pair_et,
+    n_ptiles: int, n_etiles: int, eps: float, interpret: bool,
+):
+    """One pallas invocation over one (pow2-padded) pair chunk. The out
+    array carries ONE EXTRA scratch tile (index n_ptiles) that padding
+    pairs target, so real tiles are never corrupted."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    dt = jnp.float32
+    # one extra SCRATCH point tile (index n_ptiles): capacity-padding
+    # pairs target it for both input fetch AND output, so padded programs
+    # never address out-of-bounds blocks (round-3 review finding)
+    pxp = jnp.concatenate(
+        [px.astype(dt), jnp.full(POINT_TILE, 1e8, dt)]
+    ).reshape(-1, 1, POINT_TILE)
+    pyp = jnp.concatenate(
+        [py.astype(dt), jnp.full(POINT_TILE, 1e8, dt)]
+    ).reshape(-1, 1, POINT_TILE)
+    e1 = x1.astype(dt).reshape(-1, 1, EDGE_TILE)
+    f1 = y1.astype(dt).reshape(-1, 1, EDGE_TILE)
+    e2 = x2.astype(dt).reshape(-1, 1, EDGE_TILE)
+    f2 = y2.astype(dt).reshape(-1, 1, EDGE_TILE)
+    M = pair_pt.shape[0]
+
+    point_block = pl.BlockSpec(
+        (1, 1, POINT_TILE), lambda m, pt, et: (pt[m], 0, 0)
+    )
+    edge_block = pl.BlockSpec(
+        (1, 1, EDGE_TILE), lambda m, pt, et: (et[m], 0, 0)
+    )
+    out_block = pl.BlockSpec(
+        (1, 1, POINT_TILE), lambda m, pt, et: (pt[m], 0, 0)
+    )
+    out_shape = jax.ShapeDtypeStruct(
+        (n_ptiles + 1, 1, POINT_TILE), jnp.int32
+    )
+
+    with jax.enable_x64(False):
+        counts = pl.pallas_call(
+            _sparse_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(M,),
+                in_specs=[point_block, point_block,
+                          edge_block, edge_block, edge_block, edge_block],
+                out_specs=out_block,
+            ),
+            out_shape=out_shape,
+            interpret=interpret,
+        )(pair_pt, pair_et, pxp, pyp, e1, f1, e2, f2)
+        band = pl.pallas_call(
+            functools.partial(_sparse_band_kernel, eps=eps),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(M,),
+                in_specs=[point_block, point_block,
+                          edge_block, edge_block, edge_block, edge_block],
+                out_specs=out_block,
+            ),
+            out_shape=out_shape,
+            interpret=interpret,
+        )(pair_pt, pair_et, pxp, pyp, e1, f1, e2, f2)
+    return counts, band
+
+
+# at ~8 B of SMEM per pair (two i32 scalars), the TPU's ~1 MB scalar-
+# prefetch budget caps a single call near 128k pairs; chunks split at
+# point-tile boundaries so every tile's accumulation stays in one call
+MAX_PAIRS_PER_CALL = 1 << 16
+
+
+def chunk_pairs(pair_pt, pair_et, cap=MAX_PAIRS_PER_CALL):
+    """Split the (pt-sorted) pair list into chunks of <= cap pairs,
+    PREFERRING tile boundaries. A single tile denser than cap is split
+    mid-tile — the caller ACCUMULATES (+=) rather than assigns for tiles
+    it has already seen, and the kernel's first-visit zeroing only fires
+    on each chunk's first pair of a tile, so partial counts add exactly
+    (crossing counts and band flags are both additive)."""
+    M = len(pair_pt)
+    chunks = []
+    start = 0
+    while start < M:
+        end = min(start + cap, M)
+        if end < M:
+            # back off to the last tile boundary if one exists
+            back = end
+            while back > start and pair_pt[back] == pair_pt[back - 1]:
+                back -= 1
+            if back > start:
+                end = back
+        chunks.append((start, end))
+        start = end
+    return chunks
+
+
 def pip_layer_sparse(
     px: jax.Array,          # [n_ptiles * POINT_TILE] padded, tile-ordered
     py: jax.Array,
@@ -194,75 +298,54 @@ def pip_layer_sparse(
     y1: jax.Array,
     x2: jax.Array,
     y2: jax.Array,
-    pair_pt: jax.Array,     # [M] int32, sorted
-    pair_et: jax.Array,     # [M] int32
-    first: jax.Array,       # [M] int32
-    n_ptiles: int,
-    n_etiles: int,
+    pair_pt,                # [M] int32, sorted by point tile
+    pair_et,                # [M] int32
+    n_ptiles: int = 0,
+    n_etiles: int = 0,
     eps: float = 1e-4,
     interpret: bool = False,
+    max_pairs_per_call: int = MAX_PAIRS_PER_CALL,
 ) -> Tuple[jax.Array, jax.Array]:
     """Sparse-pair crossing counts + boundary-band flags.
 
     Returns (counts int32 [n_ptiles*POINT_TILE], band int32 same shape).
     Tiles never named in pair_pt hold GARBAGE — mask with PairList.covered
-    (they are provably outside every polygon bbox => count 0, band 0)."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    (they are provably outside every polygon bbox => count 0, band 0).
+    Internally chunked: each pallas call takes <= MAX_PAIRS_PER_CALL
+    pairs (SMEM scalar-prefetch budget), split at tile boundaries."""
+    from geomesa_tpu.utils.padding import next_pow2
 
-    dt = jnp.float32
-    pxp = px.astype(dt).reshape(-1, 1, POINT_TILE)
-    pyp = py.astype(dt).reshape(-1, 1, POINT_TILE)
-    e1 = x1.astype(dt).reshape(-1, EDGE_TILE, 1)
-    f1 = y1.astype(dt).reshape(-1, EDGE_TILE, 1)
-    e2 = x2.astype(dt).reshape(-1, EDGE_TILE, 1)
-    f2 = y2.astype(dt).reshape(-1, EDGE_TILE, 1)
-    assert pxp.shape[0] == n_ptiles and e1.shape[0] == n_etiles
-    M = pair_pt.shape[0]
-
-    point_block = pl.BlockSpec(
-        (1, 1, POINT_TILE), lambda m, pt, et, fr: (pt[m], 0, 0)
-    )
-    edge_block = pl.BlockSpec(
-        (1, EDGE_TILE, 1), lambda m, pt, et, fr: (et[m], 0, 0)
-    )
-    out_block = pl.BlockSpec(
-        (1, 1, POINT_TILE), lambda m, pt, et, fr: (pt[m], 0, 0)
-    )
-
-    with jax.enable_x64(False):
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,  # pair_pt, pair_et, first
-            grid=(M,),
-            in_specs=[point_block, point_block,
-                      edge_block, edge_block, edge_block, edge_block],
-            out_specs=out_block,
-        )
-        counts = pl.pallas_call(
-            _sparse_kernel,
-            grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct(
-                (n_ptiles, 1, POINT_TILE), jnp.int32
-            ),
+    pt_np = np.asarray(pair_pt, np.int32)
+    et_np = np.asarray(pair_et, np.int32)
+    out_c = np.zeros((n_ptiles, POINT_TILE), np.int32)
+    out_b = np.zeros((n_ptiles, POINT_TILE), np.int32)
+    seen: set = set()
+    for s0, s1 in chunk_pairs(pt_np, et_np, cap=max_pairs_per_call):
+        seg_pt = pt_np[s0:s1]
+        seg_et = et_np[s0:s1]
+        cap = max(next_pow2(len(seg_pt)), 256)
+        pad = cap - len(seg_pt)
+        if pad:
+            seg_pt = np.concatenate(
+                [seg_pt, np.full(pad, n_ptiles, np.int32)])
+            seg_et = np.concatenate([seg_et, np.zeros(pad, np.int32)])
+        counts, band = _pip_sparse_call(
+            px, py, x1, y1, x2, y2,
+            jnp.asarray(seg_pt), jnp.asarray(seg_et),
+            n_ptiles=n_ptiles, n_etiles=n_etiles, eps=eps,
             interpret=interpret,
-        )(pair_pt, pair_et, first, pxp, pyp, e1, f1, e2, f2)
-
-        grid_spec_b = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
-            grid=(M,),
-            in_specs=[point_block, point_block,
-                      edge_block, edge_block, edge_block, edge_block],
-            out_specs=out_block,
         )
-        band = pl.pallas_call(
-            functools.partial(_sparse_band_kernel, eps=eps),
-            grid_spec=grid_spec_b,
-            out_shape=jax.ShapeDtypeStruct(
-                (n_ptiles, 1, POINT_TILE), jnp.int32
-            ),
-            interpret=interpret,
-        )(pair_pt, pair_et, first, pxp, pyp, e1, f1, e2, f2)
-    return counts.reshape(-1), band.reshape(-1)
+        cc = np.asarray(counts).reshape(n_ptiles + 1, POINT_TILE)
+        bb = np.asarray(band).reshape(n_ptiles + 1, POINT_TILE)
+        for t in np.unique(pt_np[s0:s1]):
+            if t in seen:  # tile split across chunks: partials ADD
+                out_c[t] += cc[t]
+                out_b[t] += bb[t]
+            else:
+                out_c[t] = cc[t]
+                out_b[t] = bb[t]
+                seen.add(int(t))
+    return out_c.reshape(-1), out_b.reshape(-1)
 
 
 class LayerPrep(NamedTuple):
@@ -363,8 +446,7 @@ def pip_layer(
         jnp.asarray(pxp), jnp.asarray(pyp),
         jnp.asarray(ex1), jnp.asarray(ey1),
         jnp.asarray(ex2), jnp.asarray(ey2),
-        jnp.asarray(pl_.pair_pt), jnp.asarray(pl_.pair_et),
-        jnp.asarray(pl_.first),
+        pl_.pair_pt, pl_.pair_et,
         n_ptiles=n_ptiles, n_etiles=n_etiles, eps=eps,
         interpret=interpret,
     )

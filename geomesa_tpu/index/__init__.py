@@ -16,6 +16,7 @@ from geomesa_tpu.index.keyspace import (
     AttributeIndex,
     IdIndex,
     IndexKeySpace,
+    S2Index,
     XZ2Index,
     XZ3Index,
     Z2Index,
@@ -33,6 +34,7 @@ __all__ = [
     "IndexKeySpace",
     "Z3Index",
     "Z2Index",
+    "S2Index",
     "XZ2Index",
     "XZ3Index",
     "IdIndex",

@@ -202,6 +202,57 @@ class Z2Index(IndexKeySpace):
         return self._shard_ranges(inner)
 
 
+class S2Index(IndexKeySpace):
+    """S2-style cube-face keyspace (curve/s2.py): [shard][8B cellid][fid].
+
+    Parity: the reference's S2 index variant (SURVEY.md:241-242 [L],
+    geomesa s2 module over the sidx library) — deferred in rounds 1-2,
+    built in round 3. Point geometries only (the reference's S2 index is
+    likewise point-oriented; extended geometries keep XZ2/XZ3). Wins over
+    Z2 for high-latitude workloads: cube faces bound cell-area distortion
+    where Z2's lon/lat cells degenerate toward the poles."""
+
+    name = "s2"
+
+    def __init__(self, sft: SimpleFeatureType, shards: int = 4,
+                 level: int = 15):
+        super().__init__(sft, shards)
+        from geomesa_tpu.curve.s2 import S2SFC
+
+        self.sfc = S2SFC(level)
+
+    def write_keys(self, batch, fids, rows):
+        col: GeometryColumn = batch.columns[self._geom()]
+        cells = self.sfc.index(col.x, col.y)
+        out = []
+        for i in range(len(batch)):
+            shard = _shard_of(fids[i], self.shards)
+            key = (
+                bytes([shard])
+                + struct.pack(">Q", int(cells[i]))
+                + fids[i].encode("utf-8")
+            )
+            out.append(WriteKey(key, rows[i]))
+        return out
+
+    def supports(self, f):
+        bbox = extract_bbox(f, self._geom())
+        return not bbox.is_whole_world and not bbox.is_empty
+
+    def ranges(self, f, max_ranges=512):
+        bbox = extract_bbox(f, self._geom())
+        if bbox.is_empty:
+            return []
+        rs = self.sfc.ranges(
+            bbox.xmin, bbox.ymin, bbox.xmax, bbox.ymax, max_ranges=max_ranges
+        )
+        inner = [
+            (struct.pack(">Q", r.lower), struct.pack(">Q", r.upper + 1))
+            for r in rs
+        ]
+        return self._shard_ranges(inner)
+
+
 class XZ2Index(IndexKeySpace):
     name = "xz2"
 

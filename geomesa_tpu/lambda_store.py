@@ -91,11 +91,54 @@ class LambdaDataStore:
 
     def get_features(self, query: "Query | str") -> QueryResult:
         """Query both tiers; merge feature results with transient-wins
-        dedupe by fid. Aggregations (density/stats) run per tier and are
-        NOT merged here — run them post-persist or on one tier."""
+        dedupe by fid.
+
+        Aggregation hints (density/stats/bin/arrow) run over the MERGED
+        deduped rows (round-3; previously unsupported): both tiers are
+        fetched as features with the same filter, deduped transient-wins,
+        and the standard hint dispatcher (plan.runner.aggregate) runs on
+        the merged batch — semantics identical to aggregating a single
+        store holding the merged view. Trade: the merged rows come back
+        to the host before aggregation (no per-tier partial aggregation;
+        the transient tier is small by design, so the persistent tier's
+        feature fetch dominates either way)."""
         if isinstance(query, str):
             name = self.get_type_names()[0] if "(" not in query else None
             raise TypeError("pass a Query(type_name, cql) to LambdaDataStore")
+        if query.hints is not None and (
+            query.hints.is_density or query.hints.is_stats
+            or query.hints.is_bin or query.hints.is_arrow
+        ):
+            import dataclasses as _dc
+
+            # strip ONLY the aggregation-kind fields: auths/sampling/etc
+            # must survive into the tier fetches (a fresh QueryHints()
+            # would fold visibility with EMPTY auths and hide rows the
+            # caller is authorized to see — round-3 review finding)
+            plain = _dc.replace(query, hints=_dc.replace(
+                query.hints,
+                density_bbox=None, density_width=None,
+                density_height=None, density_weight=None,
+                bin_track=None, bin_label=None,
+                stats_string=None, arrow_encode=False,
+            ))
+            merged = self.get_features(plain)
+            mb = merged.features
+            sft = self.get_schema(query.type_name)
+            if mb is None or not len(mb):
+                from geomesa_tpu.core.columnar import FeatureBatch as _FB
+
+                mb = _FB.from_pydict(
+                    sft, {a.name: [] for a in sft.attributes}
+                )
+            from geomesa_tpu.engine.device import to_device
+            from geomesa_tpu.plan.runner import aggregate
+
+            dev = to_device(mb)
+            return aggregate(
+                sft, mb, dev, np.ones(len(mb), bool), query,
+                fold_visibility=False,  # folded by each tier's fetch
+            )
         p = self.persistent.get_feature_source(query.type_name).get_features(query)
         t = self.transient.get_feature_source(query.type_name).get_features(query)
         if p.kind != "features":

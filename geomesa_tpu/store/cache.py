@@ -44,6 +44,7 @@ class CacheEntry:
     count: int  # valid rows
     padded: int  # padded device length (pow2)
     batch: FeatureBatch  # host copy (padded)
+    dev: Optional[dict] = None  # per-partition device segment (flat stores)
 
 
 @dataclasses.dataclass
@@ -61,12 +62,15 @@ class SuperBatch:
     ids: Dict[str, int]          # partition name -> id
     version: int
 
-    # Known cost trade (deliberate for round 1): the host concat doubles
-    # host RAM for the resident set (per-partition batches are kept for
-    # double-buffered single-partition reloads), and any residency change
-    # rebuilds + re-uploads the whole superbatch. Incremental segment
-    # replacement (device-side concat of per-partition buffers) is the
-    # planned refinement if write-heavy workloads need it.
+    # Round-3: residency changes no longer re-upload unchanged segments
+    # for FLAT stores (point geometry + numeric/date/dict columns): each
+    # partition keeps its own device segment, dictionary columns are
+    # re-encoded against a store-level grow-only vocab at load time (so
+    # device codes stay comparable across partitions), and the superbatch
+    # is a DEVICE-side concat of segments. Non-point geometry (CSR ring
+    # tables need offset rewrites on concat) falls back to the round-1
+    # full host-concat + re-upload. Host RAM still holds per-partition
+    # copies for the double-buffered reload path.
 
 
 class DeviceCacheManager:
@@ -78,11 +82,45 @@ class DeviceCacheManager:
         self._entries: Dict[str, CacheEntry] = {}
         self._super: Optional[SuperBatch] = None
         self._version = 0
+        # store-level grow-only vocabularies (per dict column) so device
+        # code segments from different partitions remain comparable
+        self._vocab: Dict[str, list] = {}
+        self.upload_count = 0  # partitions transferred host->device
+        self._flat = all(
+            (not a.is_geometry) or a.type == "Point"
+            for a in storage.sft.attributes
+        )
 
     # -- residency ---------------------------------------------------------
 
     def _partition_files(self, name: str) -> List[str]:
         return sorted(e["file"] for e in self.storage.manifest.get(name, []))
+
+    def _shared_vocab_recode(self, batch: FeatureBatch) -> FeatureBatch:
+        """Re-encode dict columns against the store-level vocabularies
+        (append-only merge) so per-partition device code segments are
+        directly concatenable."""
+        from geomesa_tpu.core.columnar import DictColumn
+
+        cols = dict(batch.columns)
+        changed = False
+        for name, col in batch.columns.items():
+            if not isinstance(col, DictColumn):
+                continue
+            vocab = self._vocab.setdefault(name, [])
+            lookup = {v: i for i, v in enumerate(vocab)}
+            remap = np.empty(len(col.vocab), np.int32)
+            for i, v in enumerate(col.vocab):
+                if v not in lookup:
+                    lookup[v] = len(vocab)
+                    vocab.append(v)
+                remap[i] = lookup[v]
+            codes = np.where(col.codes >= 0, remap[np.maximum(col.codes, 0)], -1)
+            cols[name] = DictColumn(codes.astype(np.int32), vocab)
+            changed = True
+        if not changed:
+            return batch
+        return FeatureBatch(batch.sft, cols, batch.fids, batch.valid)
 
     def _load_partition(self, name: str) -> Optional[CacheEntry]:
         batches = list(self.storage.scan_partitions([name]))
@@ -91,11 +129,20 @@ class DeviceCacheManager:
         batch = FeatureBatch.concat(batches)
         n = len(batch)
         padded = batch.pad_to(_next_pow2(n))
+        dev = None
+        if self._flat:
+            from geomesa_tpu.engine.device import to_device
+
+            padded = self._shared_vocab_recode(padded)
+            kw = {"coord_dtype": self.coord_dtype} if self.coord_dtype else {}
+            dev = to_device(padded, **kw)
+            self.upload_count += 1
         return CacheEntry(
             files=self._partition_files(name),
             count=n,
             padded=len(padded),
             batch=padded,
+            dev=dev,
         )
 
     def ensure(self, partitions: Optional[List[str]] = None) -> List[str]:
@@ -169,10 +216,26 @@ class DeviceCacheManager:
         pids_host = np.concatenate([
             np.full(e.padded, i, np.int32) for i, e in enumerate(entries)
         ])
-        kw = {"coord_dtype": self.coord_dtype} if self.coord_dtype else {}
+        if self._flat and all(e.dev is not None for e in entries):
+            # incremental path: DEVICE-side concat of the per-partition
+            # segments — changed partitions were re-uploaded at load; the
+            # unchanged ones never cross the host boundary again. The
+            # shared-vocab recode (load time) makes dict-code segments
+            # directly comparable; host `batch` concat re-encodes too but
+            # the ORDER of first-appearance matches the grow-only vocab,
+            # so host and device code spaces agree (asserted in tests).
+            keys = entries[0].dev.keys()
+            dev = {
+                k: jnp.concatenate([e.dev[k] for e in entries])
+                for k in keys
+            }
+        else:
+            kw = {"coord_dtype": self.coord_dtype} if self.coord_dtype else {}
+            dev = to_device(batch, **kw)
+            self.upload_count += 1
         self._super = SuperBatch(
             batch=batch,
-            dev=to_device(batch, **kw),
+            dev=dev,
             pids=jnp.asarray(pids_host),
             ids={n: i for i, n in enumerate(names)},
             version=self._version,

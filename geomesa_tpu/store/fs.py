@@ -347,19 +347,17 @@ class FileSystemStorage:
                     # include fids only when the file actually has them
                     schema_names = self._file_schema_names(path)
                     cols = phys_cols + ([FID] if FID in schema_names else [])
-                t = self._read_file(path, expr, cols)
-                if not len(t):
-                    continue
                 # geomesa.scan.batch.size bounds per-yield rows so one huge
-                # file cannot force an oversized host allocation
+                # file cannot force an oversized host allocation — and the
+                # parquet path STREAMS row groups (pads.Scanner.to_batches)
+                # so consumers can overlap decode with device compute (the
+                # cold-path pipeline; the whole file is never materialized)
                 from geomesa_tpu.utils.config import SystemProperties
 
                 target = int(SystemProperties.SCAN_BATCH_SIZE.get())
-                if len(t) <= target:
-                    yield _table_to_batch(t, self.sft)
-                else:
-                    for off in range(0, len(t), target):
-                        yield _table_to_batch(t.slice(off, target), self.sft)
+                for t in self._stream_file(path, expr, cols, target):
+                    if len(t):
+                        yield _table_to_batch(t, self.sft)
 
     def scan_partitions(self, names: Sequence[str]) -> Iterator[FeatureBatch]:
         """Yield every row (all columns) of the named partitions, no
@@ -404,6 +402,35 @@ class FileSystemStorage:
             dataset = pads.dataset(path, format="orc")
             return dataset.to_table(filter=expr, columns=cols)
         return pq.read_table(path, filters=expr, columns=cols)
+
+    def _stream_file(self, path: str, expr, cols, target: int):
+        """Yield ~target-row pyarrow Tables from one file incrementally.
+        Parquet decodes row-group-wise with predicate+column pushdown;
+        ORC falls back to a whole-file read chunked afterwards."""
+        if self.encoding == "orc":
+            t = self._read_file(path, expr, cols)
+            for off in range(0, max(len(t), 1), target):
+                yield t.slice(off, target)
+            return
+        import pyarrow as pa
+        import pyarrow.dataset as pads
+
+        scanner = pads.dataset(path, format="parquet").scanner(
+            filter=expr, columns=cols, batch_size=target
+        )
+        pending = []
+        rows = 0
+        for rb in scanner.to_batches():
+            while rb.num_rows:
+                take = min(rb.num_rows, target - rows)
+                pending.append(rb.slice(0, take))
+                rb = rb.slice(take)
+                rows += take
+                if rows >= target:  # hard per-yield bound (SCAN_BATCH_SIZE)
+                    yield pa.Table.from_batches(pending)
+                    pending, rows = [], 0
+        if pending:
+            yield pa.Table.from_batches(pending)
 
     def read_all(self) -> Optional[FeatureBatch]:
         batches = list(self.scan())

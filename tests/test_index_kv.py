@@ -454,3 +454,52 @@ def test_stale_hash_sketches_dropped(tmp_path):
     d_old.pop("hash")  # as written by the round-1 blake2b code
     with pytest.raises(ValueError, match="rerun stats-analyze"):
         Stat.from_json(d_old)
+
+
+class TestS2Index:
+    """S2 cube-face keyspace (round 3 — SURVEY.md:241-242): result parity
+    against the brute-force reference through the full KV stack, plus the
+    polar regime where S2 beats Z2 structurally."""
+
+    def _store(self, tmp_path, n=600, polar=False):
+        from geomesa_tpu.index import S2Index
+
+        rng = np.random.default_rng(41)
+        sft = SimpleFeatureType.from_spec(
+            "ais", "speed:Double,dtg:Date,*geom:Point"
+        )
+        lat = (rng.uniform(60, 90, n) if polar
+               else rng.uniform(-80, 80, n))
+        batch = FeatureBatch.from_pydict(sft, {
+            "speed": rng.uniform(0, 30, n),
+            "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack([rng.uniform(-180, 180, n), lat], 1),
+        })
+        ds = KVDataStore()
+        src = ds.create_schema(
+            sft, indices=[S2Index(sft, shards=2, level=13)]
+        )
+        src.write(batch)
+        return src, batch
+
+    @pytest.mark.parametrize("polar", [False, True])
+    def test_bbox_parity(self, tmp_path, polar):
+        src, batch = self._store(tmp_path, polar=polar)
+        boxes = [
+            "BBOX(geom, -60, 20, 60, 70)",
+            "BBOX(geom, 150, 60, 180, 90)",   # polar + antimeridian edge
+            "BBOX(geom, -10, -5, 10, 5)",
+        ]
+        for cql in boxes:
+            f = parse_cql(cql)
+            exp = int(eval_filter(f, batch).sum())
+            got = src.get_features(Query("ais", f))
+            n_got = 0 if got.features is None else len(got.features)
+            assert n_got == exp, cql
+
+    def test_planner_picks_s2_and_explains(self, tmp_path):
+        src, batch = self._store(tmp_path)
+        f = parse_cql("BBOX(geom, -60, 20, 60, 70) AND speed > 5")
+        r = src.get_features(Query("ais", f))
+        exp = int(eval_filter(f, batch).sum())
+        assert (0 if r.features is None else len(r.features)) == exp
