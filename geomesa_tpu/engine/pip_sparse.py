@@ -61,20 +61,30 @@ def pad_polygon_edges(
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Pad the concatenated oriented edge table so each polygon occupies
     whole EDGE_TILE tiles (degenerate BIG edges fill the tail). Returns
-    (x1, y1, x2, y2, poly_of_tile [n_etiles])."""
-    outs = [[], [], [], []]
-    poly_of_tile = []
-    for pid in np.unique(poly_of_edge):
-        sel = poly_of_edge == pid
-        e = int(sel.sum())
-        pad = (-e) % EDGE_TILE
-        for o, arr, fill in zip(
-            outs, (x1, y1, x2, y2), (0.0, BIG, 0.0, BIG)
-        ):
-            o.append(np.concatenate([arr[sel], np.full(pad, fill)]))
-        poly_of_tile.extend([pid] * ((e + pad) // EDGE_TILE))
-    return (*(np.concatenate(o) for o in outs),
-            np.asarray(poly_of_tile, np.int64))
+    (x1, y1, x2, y2, poly_of_tile [n_etiles]).
+
+    Fully vectorized: the round-3 bench measured the per-polygon python
+    loop at ~100 s over 10k polygons x 1.5M edges (each iteration scanned
+    the whole edge table); this is one sort + one scatter."""
+    poly_of_edge = np.asarray(poly_of_edge, np.int64)
+    order = np.argsort(poly_of_edge, kind="stable")
+    pids, counts = np.unique(poly_of_edge, return_counts=True)
+    padded_counts = -(-counts // EDGE_TILE) * EDGE_TILE
+    total = int(padded_counts.sum())
+    starts = np.concatenate([[0], np.cumsum(padded_counts)[:-1]])
+    # destination of each (pid-sorted) edge = its polygon's padded start
+    # + rank within the polygon
+    src_starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    rank = np.arange(len(poly_of_edge)) - np.repeat(src_starts, counts)
+    dest = np.repeat(starts, counts) + rank
+    outs = []
+    for arr, fill in zip((x1, y1, x2, y2), (0.0, BIG, 0.0, BIG)):
+        buf = np.full(total, fill, np.float64)
+        buf[dest] = np.asarray(arr, np.float64)[order]
+        outs.append(buf)
+    tiles_per = padded_counts // EDGE_TILE
+    poly_of_tile = np.repeat(pids, tiles_per)
+    return (*outs, poly_of_tile)
 
 
 def build_pairs(
@@ -95,17 +105,51 @@ def build_pairs(
     pairs_pt = []
     pairs_et = []
     # polygon -> its edge tiles (contiguous by construction)
-    # vectorized per polygon: tiles of P vs all point tiles
     et_of_poly = {}
     for et, pid in enumerate(poly_of_tile):
         et_of_poly.setdefault(int(pid), []).append(et)
     px0, py0, px1, py1 = (ptile_bbox[:, i] for i in range(4))
+    # coarse bucket grid over point-tile bboxes: a polygon tests only the
+    # tiles registered in the cells its bbox covers (the all-tiles scan
+    # per polygon cost ~2 min at 10k polys x 131k tiles in the round-3
+    # bench). Tiles register in every cell their bbox touches, so the
+    # per-polygon candidate set is a superset of the true hits.
+    G = 128
+    gx0 = np.clip(((px0 + 180) / 360 * G).astype(int), 0, G - 1)
+    gx1 = np.clip(((px1 + 180) / 360 * G).astype(int), 0, G - 1)
+    gy0 = np.clip(((py0 + 90) / 180 * G).astype(int), 0, G - 1)
+    gy1 = np.clip(((py1 + 90) / 180 * G).astype(int), 0, G - 1)
+    cells: dict = {}
+    # tiles register in every covered cell (Z-ordered tiles overwhelmingly
+    # span one cell; seam/tail tiles span a few)
+    for t_ in range(T):
+        for cx_ in range(gx0[t_], gx1[t_] + 1):
+            for cy_ in range(gy0[t_], gy1[t_] + 1):
+                cells.setdefault((cx_, cy_), []).append(t_)
+    cells = {k: np.asarray(v) for k, v in cells.items()}
+
     for pid, ets in et_of_poly.items():
         bx0, by0, bx1, by1 = poly_bbox[pid]
-        hit = np.nonzero(
-            (px1 >= bx0 - margin) & (px0 <= bx1 + margin)
-            & (py1 >= by0 - margin) & (py0 <= by1 + margin)
-        )[0]
+        # clamp BOTH ends into the grid: tiles are clipped into edge
+        # cells, so an out-of-domain polygon bbox must still query them
+        # (one-sided clamping silently dropped such polygons — review)
+        cx_lo = min(max(int((bx0 - margin + 180) / 360 * G), 0), G - 1)
+        cx_hi = max(min(int((bx1 + margin + 180) / 360 * G), G - 1), 0)
+        cy_lo = min(max(int((by0 - margin + 90) / 180 * G), 0), G - 1)
+        cy_hi = max(min(int((by1 + margin + 90) / 180 * G), G - 1), 0)
+        cand_lists = [
+            cells[(cx_, cy_)]
+            for cx_ in range(cx_lo, cx_hi + 1)
+            for cy_ in range(cy_lo, cy_hi + 1)
+            if (cx_, cy_) in cells
+        ]
+        if not cand_lists:
+            continue
+        cand = np.unique(np.concatenate(cand_lists))
+        hit = cand[
+            (px1[cand] >= bx0 - margin) & (px0[cand] <= bx1 + margin)
+            & (py1[cand] >= by0 - margin) & (py0[cand] <= by1 + margin)
+        ]
         if not len(hit):
             continue
         for et in ets:
@@ -395,16 +439,22 @@ def prepare_layer(
         _bb(np.minimum(ex1, ex2), True), _bb(np.minimum(ey1, ey2), True),
         _bb(np.maximum(ex1, ex2), False), _bb(np.maximum(ey1, ey2), False),
     ], 1)
-    pids = np.unique(poly_of_edge)
+    # per-polygon bboxes via reduceat over pid-sorted edges (the naive
+    # per-polygon masking re-scanned the edge table 10k times)
+    order = np.argsort(np.asarray(poly_of_edge, np.int64), kind="stable")
+    pids, counts = np.unique(
+        np.asarray(poly_of_edge), return_counts=True
+    )
+    bounds = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    exmin = np.minimum(x1, x2)[order]
+    eymin = np.minimum(y1, y2)[order]
+    exmax = np.maximum(x1, x2)[order]
+    eymax = np.maximum(y1, y2)[order]
     poly_bbox = np.zeros((int(pids.max()) + 1, 4))
-    for pid in pids:
-        sel = poly_of_edge == pid
-        poly_bbox[pid] = [
-            min(x1[sel].min(), x2[sel].min()),
-            min(y1[sel].min(), y2[sel].min()),
-            max(x1[sel].max(), x2[sel].max()),
-            max(y1[sel].max(), y2[sel].max()),
-        ]
+    poly_bbox[pids, 0] = np.minimum.reduceat(exmin, bounds)
+    poly_bbox[pids, 1] = np.minimum.reduceat(eymin, bounds)
+    poly_bbox[pids, 2] = np.maximum.reduceat(exmax, bounds)
+    poly_bbox[pids, 3] = np.maximum.reduceat(eymax, bounds)
     pairs = build_pairs(
         ptile_bbox, etile_bbox, poly_of_tile, poly_bbox, margin=margin
     )

@@ -114,3 +114,16 @@ class TestPipLayer:
         inside, info = pip_layer(px, py, x1, y1, x2, y2, pol,
                                  interpret=True)
         assert not inside.any()
+
+
+def test_build_pairs_out_of_domain_polygon():
+    # grid pruning must not drop polygons whose bbox leaves the lon/lat
+    # domain (review finding: one-sided clamping emitted 0 pairs)
+    from geomesa_tpu.engine.pip_sparse import PairList, build_pairs
+
+    ptile_bbox = np.array([[190.0, 10.0, 191.0, 11.0]])
+    etile_bbox = np.array([[189.0, 9.0, 196.0, 20.0]])
+    poly_of_tile = np.array([0])
+    poly_bbox = np.array([[189.0, 9.0, 196.0, 20.0]])
+    pl = build_pairs(ptile_bbox, etile_bbox, poly_of_tile, poly_bbox)
+    assert len(pl.pair_pt) == 1
