@@ -140,7 +140,7 @@ def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
     import jax.numpy as jnp
 
     from geomesa_tpu.engine.pip_sparse import (
-        EDGE_TILE, POINT_TILE, pip_layer, pip_layer_sparse, prepare_layer)
+        EDGE_TILE, POINT_TILE, pip_layer, pip_layer_grouped, prepare_layer)
 
     rng = np.random.default_rng(29)
     # disjoint admin-style layer: one polygon per jittered grid cell,
@@ -217,14 +217,14 @@ def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
     prep_t = _t.perf_counter() - s
 
     dev_args = (
-        jnp.asarray(pxp), jnp.asarray(pyp),
-        jnp.asarray(ex1), jnp.asarray(ey1),
-        jnp.asarray(ex2), jnp.asarray(ey2),
+        jnp.asarray(pxp), jnp.asarray(pyp),  # device-resident: the timed
+        jnp.asarray(ex1), jnp.asarray(ey1),  # loop must not re-upload
+        jnp.asarray(ex2), jnp.asarray(ey2),  # through the 0.05 GB/s link
         plist.pair_pt, plist.pair_et,
     )
 
     def run():
-        return pip_layer_sparse(
+        return pip_layer_grouped(
             *dev_args, n_ptiles=n_ptiles, n_etiles=n_etiles,
             interpret=smoke,
         )
