@@ -372,7 +372,11 @@ def pip_layer_grouped(
     for sel in classes:
         if not len(sel):
             continue
-        cap_c = int(max(counts[sel].max(), 1))
+        from geomesa_tpu.utils.padding import next_pow2 as _np2
+
+        # pow2 cap stabilizes the pallas jit cache across layers/queries
+        # (raw data-dependent shapes recompiled ~0.65s per novel shape)
+        cap_c = max(_np2(int(max(counts[sel].max(), 1))), 4)
         # vectorized etab fill (repeat/rank scatter, same idiom as
         # pad_polygon_edges — a per-row python loop sat in the timed path)
         etab = np.full((len(sel), cap_c), n_etiles, np.int32)
@@ -393,12 +397,25 @@ def pip_layer_grouped(
             per_call = max(1, MAX_ETAB_SLOTS // max(cap_k, 32))
             for c0 in range(0, len(sel), per_call):
                 c1 = min(c0 + per_call, len(sel))
-                jid = _jnp.asarray(ptids[c0:c1])
+                ids = ptids[c0:c1]
+                tab = np.ascontiguousarray(sub[c0:c1])
+                # pow2 tile-count bucket: padding rows reuse a real tile
+                # id with an ALL-DUMMY etab row, contributing exact zeros
+                # through the scatter-add
+                tc_pad = max(_np2(len(ids)), 8) - len(ids)
+                if tc_pad:
+                    ids = np.concatenate(
+                        [ids, np.full(tc_pad, ids[0], ids.dtype)])
+                    tab = np.concatenate([
+                        tab,
+                        np.full((tc_pad, cap_k), n_etiles, np.int32),
+                    ])
+                jid = _jnp.asarray(ids)
                 cc, bb = _pip_grouped_call(
                     _jnp.take(pxt, jid, axis=0),
                     _jnp.take(pyt, jid, axis=0),
                     ax1, ay1, ax2, ay2,
-                    _jnp.asarray(np.ascontiguousarray(sub[c0:c1])),
+                    _jnp.asarray(tab),
                     cap=cap_k, n_etiles=n_etiles, eps=eps,
                     interpret=interpret,
                 )

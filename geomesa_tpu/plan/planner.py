@@ -229,7 +229,28 @@ class QueryPlanner:
         if can_stream_count:
             from concurrent.futures import ThreadPoolExecutor
 
+            # decode-ahead thread hides parquet time behind upload+mask;
+            # decoded chunks ACCUMULATE to a large upload unit first —
+            # each host->device transfer carries a ~0.5 s fixed cost
+            # through the remote tunnel, so per-SCAN_BATCH_SIZE uploads
+            # (16 of them at bench scale) tripled the cold wall time
+            UPLOAD_ROWS = 1 << 23
             counts = []
+            pending = []
+            pending_rows = 0
+
+            def flush():
+                nonlocal pending, pending_rows
+                if not pending:
+                    return
+                big = (pending[0] if len(pending) == 1
+                       else FeatureBatch.concat(pending))
+                padded = big.pad_to(_next_pow2(len(big)))
+                dev = to_device(padded, coord_dtype=self.coord_dtype)
+                m = plan.compiled.mask(dev, padded)
+                counts.append(jnp.sum(m, dtype=jnp.int32))
+                pending, pending_rows = [], 0
+
             with ThreadPoolExecutor(max_workers=1) as ex:
                 fut = ex.submit(lambda: next(scan_iter, None))
                 while True:
@@ -237,10 +258,15 @@ class QueryPlanner:
                     if chunk is None:
                         break
                     fut = ex.submit(lambda: next(scan_iter, None))
-                    padded = chunk.pad_to(_next_pow2(len(chunk)))
-                    dev = to_device(padded, coord_dtype=self.coord_dtype)
-                    m = plan.compiled.mask(dev, padded)
-                    counts.append(jnp.sum(m, dtype=jnp.int32))
+                    # flush BEFORE overshooting: a unit that crosses the
+                    # bound pow2-pads to DOUBLE the bytes on the wire
+                    if pending_rows and pending_rows + len(chunk) > UPLOAD_ROWS:
+                        flush()
+                    pending.append(chunk)
+                    pending_rows += len(chunk)
+                    if pending_rows >= UPLOAD_ROWS:
+                        flush()
+                flush()
             t_scan = time.perf_counter()
             check_timeout("scan")
             mask_count = int(sum(int(np.asarray(c)) for c in counts))
