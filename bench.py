@@ -144,7 +144,8 @@ def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
 
     rng = np.random.default_rng(29)
     # disjoint admin-style layer: one polygon per jittered grid cell,
-    # radius < half cell so no overlap; log-mixed edge counts
+    # max lobe provably under half the min center separation (see the
+    # rad comment below); log-mixed edge counts
     side = int(np.ceil(np.sqrt(npoly)))
     cw, ch = 360.0 / side, 180.0 / side
     x1l, y1l, x2l, y2l, pol = [], [], [], [], []
@@ -161,7 +162,13 @@ def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
             cy = -90 + (gy + 0.5) * ch + rng.uniform(-0.1, 0.1) * ch
             ne = int(ecounts[pid])
             th = np.sort(rng.uniform(0, 2 * np.pi, ne))
-            rad = (0.35 * min(cw, ch)
+            # max lobe = 0.3*1.25 = 0.375*min(cw,ch) < 0.4*min(cw,ch) =
+            # half the worst-case center separation (0.8 cell after the
+            # +-0.1-cell jitter), so the layer is PROVABLY disjoint —
+            # round 3 used 0.35*1.25 = 0.4375 and actually had 30
+            # overlapping neighbor pairs (review finding; the parity
+            # oracle is now XOR so overlap would be harmless anyway)
+            rad = (0.3 * min(cw, ch)
                    * (1 + 0.25 * np.sin(3 * th + rng.uniform(0, 6))))
             ring = np.stack(
                 [cx + rad * np.cos(th), cy + rad * np.sin(th)], 1)
@@ -267,18 +274,63 @@ def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
         return outs
 
     cpu_t = _timeit(cpu_pass, max(1, repeats - 1))
-    mism = 0
-    checked = 0
-    for ii, exp in cpu_pass():
-        mism += int((inside[ii] != exp).sum())
-        checked += len(ii)
-    # every adversarial point against the oracle
+
+    # ---- INDEPENDENT parity oracle (round-4 fix of the circular gate) --
+    # Round 3 gated parity against cpu_tile, which evaluates the SAME
+    # pruned pair list as the kernel — it could never catch a pair-build
+    # bug (and didn't: the inverted x-prune shipped with "exact parity").
+    # This oracle shares NOTHING with prepare_layer/build_pairs: per-
+    # polygon f64 crossing parity over the ORIGINAL unpadded edge table,
+    # candidate polygons by bbox containment computed here from raw edges.
+    op = np.argsort(pol, kind="stable")
+    xs1, ys1, xs2, ys2 = x1[op], y1[op], x2[op], y2[op]
+    counts_o = np.unique(pol, return_counts=True)[1]
+    starts_o = np.concatenate([[0], np.cumsum(counts_o)[:-1]])
+    pbx0 = np.minimum.reduceat(np.minimum(xs1, xs2), starts_o)
+    pby0 = np.minimum.reduceat(np.minimum(ys1, ys2), starts_o)
+    pbx1 = np.maximum.reduceat(np.maximum(xs1, xs2), starts_o)
+    pby1 = np.maximum.reduceat(np.maximum(ys1, ys2), starts_o)
+
+    def oracle_all_edges(ii):
+        """Inside-union for point indices ii, f64, all real edges of
+        every bbox-candidate polygon."""
+        out = np.zeros(len(ii), bool)
+        pxi, pyi = px[ii], py[ii]
+        for c0 in range(0, len(ii), 4096):
+            sl_i = slice(c0, min(c0 + 4096, len(ii)))
+            pc, qc = pxi[sl_i], pyi[sl_i]
+            hitm = ((pc[:, None] >= pbx0[None]) & (pc[:, None] <= pbx1[None])
+                    & (qc[:, None] >= pby0[None]) & (qc[:, None] <= pby1[None]))
+            pt_k, po_k = np.nonzero(hitm)
+            for k in np.unique(po_k):
+                es = slice(starts_o[k], starts_o[k] + counts_o[k])
+                a1, b1 = xs1[es], ys1[es]
+                a2, b2 = xs2[es], ys2[es]
+                pts = pt_k[po_k == k]
+                pp = pc[pts][:, None]
+                qq = qc[pts][:, None]
+                condx = (b1[None] <= qq) != (b2[None] <= qq)
+                ttt = (qq - b1[None]) / np.where(
+                    b2 == b1, 1.0, b2 - b1)[None]
+                xc = a1[None] + ttt * (a2 - a1)[None]
+                ins = (np.sum(condx & (xc > pp), 1) % 2) == 1
+                # XOR of per-polygon parities == total crossing parity
+                # (the kernel's contract); identical to OR for disjoint
+                # layers and still exact if any polygons overlap
+                out[c0 + pts] ^= ins
+        return out
+
     adv_idx = np.nonzero(adv)[0]
-    for ptid in np.unique(adv_idx // POINT_TILE):
-        ii, exp = cpu_tile(ptid)
-        sel = np.isin(ii, adv_idx)
-        mism += int((inside[ii][sel] != exp[sel]).sum())
-        checked += int(sel.sum())
+    check_idx = np.unique(np.concatenate([
+        np.concatenate([
+            np.arange(t * POINT_TILE, min((t + 1) * POINT_TILE, n))
+            for t in sub_tiles
+        ]),
+        adv_idx,
+    ]))
+    exp_ind = oracle_all_edges(check_idx)
+    mism = int((inside[check_idx] != exp_ind).sum())
+    checked = int(len(check_idx))
 
     cpu_pps = len(sub_tiles) * POINT_TILE / cpu_t
     pps = n / dev_t
@@ -301,10 +353,12 @@ def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
             "cpu_points_per_sec": round(cpu_pps, 1),
             "cpu32_points_per_sec": round(cpu_pps * 32, 1),
             "vs_cpu32": round(pps / (cpu_pps * 32), 3),
-            "note": "CPU baseline uses the SAME pair-pruned candidate "
-                    "sets (f64 crossing, vectorized per tile) on a tile "
-                    "subsample; parity additionally checks every "
-                    "adversarial near-edge point after f64 refinement",
+            "note": "CPU TIMING baseline uses pair-pruned candidate sets "
+                    "(overstates CPU speed => conservative ratio); the "
+                    "PARITY gate is an INDEPENDENT all-edges f64 oracle "
+                    "(bbox candidates from raw edges, nothing shared "
+                    "with build_pairs) over the tile subsample plus "
+                    "every adversarial near-edge point",
         },
     }
 

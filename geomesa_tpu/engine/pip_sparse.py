@@ -158,9 +158,14 @@ def build_pairs(
             continue
         for et in ets:
             ex0, ey0, ex1, ey1 = etile_bbox[et]
+            # x-prune: drop edge tiles entirely LEFT of the point tile
+            # (ex1 < px0): the +x crossing ray can never reach them. Tiles
+            # to the RIGHT must be kept — the ray points at them. (The
+            # round-3 code had this mirrored, dropping right-side tiles;
+            # any ring spanning >1 edge tile lost crossings.)
             keep = hit[
                 (py1[hit] >= ey0 - margin) & (py0[hit] <= ey1 + margin)
-                & (px1[hit] >= ex0 - margin)
+                & (px0[hit] <= ex1 + margin)
             ]
             pairs_pt.append(keep)
             pairs_et.append(np.full(len(keep), et, np.int64))

@@ -116,6 +116,52 @@ class TestPipLayer:
         assert not inside.any()
 
 
+class TestMultiTilePolygon:
+    """Rings spanning >1 edge tile (>512 edges) exercise the per-tile
+    x/y prune inside build_pairs — the path where the round-3 inverted
+    x-prune lived (edge tiles RIGHT of the point tile were dropped,
+    losing every +x-ray crossing; fixed round 4)."""
+
+    def _ring(self, cx, cy, ne, rx, ry):
+        th = np.linspace(0, 2 * np.pi, ne, endpoint=False)
+        ring = np.stack([cx + rx * np.cos(th), cy + ry * np.sin(th)], 1)
+        ring = np.concatenate([ring, ring[:1]])
+        return (ring[:-1, 0], ring[:-1, 1], ring[1:, 0], ring[1:, 1])
+
+    def test_2000_edge_ring_left_interior(self):
+        # points hug the LEFT interior edge in a narrow tile: every
+        # crossing comes from edge tiles strictly to their right
+        x1, y1, x2, y2 = self._ring(0.0, 0.0, 2000, 30.0, 20.0)
+        pol = np.zeros(2000, np.int64)
+        rng = np.random.default_rng(7)
+        px = np.sort(rng.uniform(-29.5, -27.0, 4096))
+        py = rng.uniform(-3.0, 3.0, 4096)
+        inside, info = pip_layer(px, py, x1, y1, x2, y2, pol,
+                                 interpret=True)
+        exp = oracle(px, py, x1, y1, x2, y2)
+        assert exp.sum() > 3000  # the scenario is non-vacuous
+        np.testing.assert_array_equal(inside, exp)
+
+    def test_random_points_multi_tile_layer(self):
+        # a 2000-edge ring + a 900-edge ring + small polygons, random
+        # points everywhere, vs the all-edges oracle
+        parts = [self._ring(0.0, 0.0, 2000, 30.0, 20.0),
+                 self._ring(70.0, 10.0, 900, 12.0, 25.0),
+                 self._ring(-60.0, -30.0, 64, 8.0, 8.0)]
+        x1 = np.concatenate([p[0] for p in parts])
+        y1 = np.concatenate([p[1] for p in parts])
+        x2 = np.concatenate([p[2] for p in parts])
+        y2 = np.concatenate([p[3] for p in parts])
+        pol = np.concatenate([np.full(2000, 0), np.full(900, 1),
+                              np.full(64, 2)])
+        rng = np.random.default_rng(11)
+        px, py = make_points(rng, x1, y1, x2, y2, n=4096, na=64)
+        inside, info = pip_layer(px, py, x1, y1, x2, y2, pol,
+                                 interpret=True)
+        exp = oracle(px, py, x1, y1, x2, y2)
+        np.testing.assert_array_equal(inside, exp)
+
+
 def test_build_pairs_out_of_domain_polygon():
     # grid pruning must not drop polygons whose bbox leaves the lon/lat
     # domain (review finding: one-sided clamping emitted 0 pairs)
