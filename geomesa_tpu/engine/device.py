@@ -83,3 +83,32 @@ def to_device(
 # edge tables are built by GeometryColumn.edge_table() (vectorized,
 # memoized, ring-orientation-normalized for polygon kinds) — see
 # core.columnar.EdgeTable.
+
+
+# -- batch-identity device cache --------------------------------------------
+# Repeat analytics over one materialized batch (the KNN process's steady
+# state, the SQL engine's table scans) must not re-upload coordinates per
+# call — the remote-tunnel host->device path is the dominant cost at scale.
+# Keyed by object identity + dtype; evicted when the batch is collected.
+# (FeatureBatch is an eq=True dataclass, hence unhashable — id() keying
+# with a weakref.finalize eviction hook instead of a WeakKeyDictionary.)
+_BATCH_CACHE: Dict[int, Dict[str, DeviceBatch]] = {}
+
+
+def to_device_cached(
+    batch: FeatureBatch, coord_dtype=jnp.float32, device=None
+) -> DeviceBatch:
+    """`to_device` memoized on the batch OBJECT (not value): safe because
+    FeatureBatch columns are treated as immutable throughout the engine
+    (every mutation path builds a new batch via select/concat/pad_to)."""
+    import weakref
+
+    key = id(batch)
+    slot = _BATCH_CACHE.get(key)
+    if slot is None:
+        slot = _BATCH_CACHE[key] = {}
+        weakref.finalize(batch, _BATCH_CACHE.pop, key, None)
+    dkey = f"{jnp.dtype(coord_dtype)}|{device}"
+    if dkey not in slot:
+        slot[dkey] = to_device(batch, coord_dtype=coord_dtype, device=device)
+    return slot[dkey]

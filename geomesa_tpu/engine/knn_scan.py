@@ -62,6 +62,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from geomesa_tpu.engine.geodesy import haversine_m
 from geomesa_tpu.engine.knn import _topk_smallest, _twolevel_smallest, _unit3
@@ -400,6 +401,120 @@ def knn_sparse_scan(
     fd, fi = _refine(qx, qy, xf, yf, maskf, orig_blk, n, k, blk,
                      blk_ok=blk_ok)
     return fd, fi, overflow
+
+
+def default_interpret() -> bool:
+    """Pallas interpret mode when the default device is CPU (Mosaic
+    kernels lower only on TPU) — used by product paths that run the same
+    code in CI (virtual CPU devices) and on hardware."""
+    return jax.devices()[0].platform == "cpu"
+
+
+@functools.partial(jax.jit, static_argnames=("data_tile",))
+def count_match_tiles(mask: jax.Array, data_tile: int = DATA_TILE):
+    """Device count of match-bearing data tiles (the planner's capacity
+    calibration input — one i32 scalar crosses the tunnel, not the mask)."""
+    n = mask.shape[0]
+    pad = (-n) % data_tile
+    mf = jnp.pad(mask.astype(jnp.int32), (0, pad))
+    return jnp.sum(
+        (mf.reshape(-1, data_tile).max(axis=1) > 0).astype(jnp.int32)
+    )
+
+
+def capacity_bucket(tiles_hit: int, slack: float = 1.25,
+                    floor: int = 64) -> int:
+    """pow2 capacity bucket from a tiles-hit measurement/estimate: slack
+    absorbs drift between calibration and the live query (overshoot is
+    cheap — dead capacity programs skip the MXU), pow2 keeps the pallas
+    jit cache stable across queries."""
+    need = max(int(tiles_hit * slack), 1)
+    return max(floor, 1 << int(np.ceil(np.log2(need))))
+
+
+def knn_sparse_auto(
+    qx: jax.Array,
+    qy: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    k: int,
+    tile_capacity: "int | None" = None,
+    m_blocks: int = 64,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, int]:
+    """The framework-facing sparse kNN: calibrate capacity if the caller
+    has no estimate (one device scalar fetch), run the sparse scan, and
+    on overflow fall back to the dense fullscan (documented contract of
+    `knn_sparse_scan`). Returns (dists, idx, capacity_used) — callers
+    cache capacity_used across queries and only pay calibration again
+    after an overflow (capacity_used == -1 signals the fallback ran, so
+    the next query recalibrates)."""
+    if tile_capacity is None:
+        tile_capacity = capacity_bucket(int(np.asarray(
+            count_match_tiles(mask))))
+    fd, fi, ov = knn_sparse_scan(
+        qx, qy, x, y, mask, k=k, tile_capacity=tile_capacity,
+        m_blocks=m_blocks, interpret=interpret,
+    )
+    if bool(np.asarray(ov)):
+        fd, fi = knn_fullscan(
+            qx, qy, x, y, mask, k=k, m_blocks=m_blocks,
+            interpret=interpret)
+        return fd, fi, -1
+    return fd, fi, tile_capacity
+
+
+def knn_sparse_sharded(
+    mesh,
+    qx: jax.Array,
+    qy: jax.Array,
+    dx: jax.Array,
+    dy: jax.Array,
+    mask: jax.Array,
+    k: int,
+    tile_capacity: int,
+    m_blocks: int = 64,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """`knn_sparse_scan` under the data-sharded all_gather merge (same
+    shape as `knn.knn_compact_sharded`): each shard scans only its own
+    match-bearing tiles (static per-shard `tile_capacity`), per-shard
+    top-ks merge exactly. Returns (dists [Q,k], global indices [Q,k],
+    overflow — True if ANY shard overflowed its tile capacity, in which
+    case the caller MUST fall back to a dense sharded scan)."""
+    from jax.sharding import PartitionSpec as P
+
+    from geomesa_tpu.engine.knn import _topk_smallest
+    from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+    d_count = mesh.devices.size
+    shard_n = dx.shape[0] // d_count
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,  # post-gather re-top-k replicated (see knn_sharded)
+    )
+    def run(qx, qy, dx, dy, mask):
+        fd, fi, ov = knn_sparse_scan(
+            qx, qy, dx, dy, mask, k=k, tile_capacity=tile_capacity,
+            m_blocks=m_blocks, interpret=interpret,
+        )
+        shard = jax.lax.axis_index(SHARD_AXIS)
+        gidx = fi + shard * shard_n
+        all_d = jax.lax.all_gather(fd, SHARD_AXIS)
+        all_i = jax.lax.all_gather(gidx, SHARD_AXIS)
+        pool_d = jnp.moveaxis(all_d, 0, 1).reshape(fd.shape[0], -1)
+        pool_i = jnp.moveaxis(all_i, 0, 1).reshape(fd.shape[0], -1)
+        md, mi = _topk_smallest(pool_d, k)
+        gi = jnp.take_along_axis(pool_i, mi, axis=1)
+        ov_any = jnp.any(jax.lax.all_gather(ov, SHARD_AXIS))
+        return md, gi, ov_any
+
+    return run(qx, qy, dx, dy, mask)
 
 
 def knn_fullscan_tiled(

@@ -51,6 +51,14 @@ class FeatureSource:
     def write(self, batch: FeatureBatch) -> None:
         self.storage.write(batch)
 
+    def knn(
+        self, query: "Query | str", qx, qy, k: int = 10,
+        impl: str = "sparse",
+    ):
+        """KNN push-down: device predicate mask + fused sparse Pallas scan
+        (see QueryPlanner.knn). Returns (dists, indices, batch)."""
+        return self.planner.knn(query, qx, qy, k=k, impl=impl)
+
     def explain(self, query: "Query | str") -> str:
         if isinstance(query, str):
             query = Query(self.sft.name, query)

@@ -28,18 +28,25 @@ def filter_batch(batch: FeatureBatch, cql_filter: str) -> FeatureBatch:
     return batch.select(np.asarray(compiled.mask(dev, batch)))
 
 
+def window_filter(sft, bbox: BBox, cql_filter: str = "INCLUDE") -> ast.Filter:
+    """BBOX window ANDed with an optional ECQL filter, as an AST (shared
+    by the materializing window_query and the planner kNN push-down)."""
+    g = sft.default_geometry
+    window = ast.SpatialPredicate(
+        "BBOX", ast.Property(g.name),
+        box(bbox.xmin, bbox.ymin, bbox.xmax, bbox.ymax),
+    )
+    base = parse_cql(cql_filter)
+    return window if isinstance(base, ast.Include) else ast.And((window, base))
+
+
 def window_query(
     source,  # FeatureSource
     bbox: BBox,
     cql_filter: str = "INCLUDE",
 ) -> Optional[FeatureBatch]:
     """BBOX-window query ANDed with an optional ECQL filter."""
-    g = source.sft.default_geometry
-    window = ast.SpatialPredicate(
-        "BBOX", ast.Property(g.name), box(bbox.xmin, bbox.ymin, bbox.xmax, bbox.ymax)
-    )
-    base = parse_cql(cql_filter)
-    combined = window if isinstance(base, ast.Include) else ast.And((window, base))
+    combined = window_filter(source.sft, bbox, cql_filter)
     return source.get_features(Query(source.sft.name, combined)).features
 
 

@@ -1,0 +1,248 @@
+"""The sparse fused-scan kNN through the PRODUCT paths (round-4
+integration: VERDICT r3 #1 — the framework API must run the same kernel
+the bench headline runs, not an 8x slower fallback).
+
+Covers: process impl="sparse"/"fullscan"/auto resolution, the planner's
+knn push-down (cached + scan paths), capacity calibration + overflow
+fallback, and the sharded sparse scan's all_gather merge parity.
+Interpret-mode Pallas on CPU — the same code Mosaic-compiles on TPU.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.engine.geodesy import haversine_m_np
+from geomesa_tpu.engine.knn_scan import (
+    capacity_bucket, count_match_tiles, knn_sparse_auto, knn_sparse_sharded)
+from geomesa_tpu.plan import DataStore
+from geomesa_tpu.process.knn import KNearestNeighborSearchProcess
+
+SPEC = "speed:Double,dtg:Date,*geom:Point"
+T0 = int(np.datetime64("2021-03-01T00:00:00", "ms").astype(np.int64))
+DAY = 86400_000
+
+
+def oracle(qx, qy, x, y, mask, k):
+    out = np.empty((len(qx), k))
+    cx, cy = x[mask], y[mask]
+    for i in range(len(qx)):
+        d = haversine_m_np(qx[i], qy[i], cx, cy)
+        if len(d) >= k:
+            out[i] = np.sort(d[np.argpartition(d, k - 1)[:k]])
+        else:
+            out[i, : len(d)] = np.sort(d)
+            out[i, len(d):] = np.inf
+    return out
+
+
+def make_batch(n=20_000, seed=3):
+    r = np.random.default_rng(seed)
+    sft = SimpleFeatureType.from_spec("ais", SPEC)
+    x = np.sort(r.uniform(-5, 5, n))  # pseudo store order
+    y = r.uniform(50, 60, n)
+    return FeatureBatch.from_pydict(
+        sft,
+        {
+            "speed": r.uniform(0, 30, n),
+            "dtg": r.integers(T0, T0 + 7 * DAY, n),
+            "geom": np.stack([x, y], 1),
+        },
+    )
+
+
+class TestProcessSparse:
+    @pytest.mark.parametrize("impl", ["sparse", "fullscan"])
+    def test_filtered_batch_parity(self, impl):
+        batch = make_batch()
+        g = batch.columns["geom"]
+        x, y = np.asarray(g.x), np.asarray(g.y)
+        speed = np.asarray(batch.columns["speed"])
+        rng = np.random.default_rng(5)
+        qsft = SimpleFeatureType.from_spec("q", "*geom:Point")
+        qx = rng.uniform(-4, 4, 12)
+        qy = rng.uniform(52, 58, 12)
+        queries = FeatureBatch.from_pydict(
+            qsft, {"geom": np.stack([qx, qy], 1)}
+        )
+        proc = KNearestNeighborSearchProcess()
+        res = proc.execute(
+            queries, batch, num_desired=5,
+            cql_filter="speed > 20 AND BBOX(geom, -3, 51, 3, 59)",
+            impl=impl,
+        )
+        mask = (speed > 20) & (x >= -3) & (x <= 3) & (y >= 51) & (y <= 59)
+        exp = oracle(qx, qy, x, y, mask, 5)
+        np.testing.assert_allclose(
+            np.sort(res.distances_m, 1), exp, rtol=1e-4, atol=1.0)
+        # indices refer to the FULL batch and land on true matches
+        assert res.features is batch
+        assert mask[res.indices[np.isfinite(res.distances_m)]].all()
+        if impl == "sparse":
+            # capacity cached for the repeat query (planner-stats analog)
+            assert len(proc._cap_cache) == 1
+            res2 = proc.execute(
+                queries, batch, num_desired=5,
+                cql_filter="speed > 20 AND BBOX(geom, -3, 51, 3, 59)",
+                impl=impl,
+            )
+            np.testing.assert_array_equal(res.distances_m, res2.distances_m)
+
+    def test_polygon_filter_band_refine(self):
+        # points within the f32 band of a polygon edge must classify
+        # exactly on the fused-scan path (f64 refine — the filter_batch
+        # path it replaces was f64 end-to-end)
+        rng = np.random.default_rng(31)
+        n = 4096
+        sft = SimpleFeatureType.from_spec("t", "speed:Double,*geom:Point")
+        x = np.sort(rng.uniform(0.0, 2.0, n))
+        # plant points straddling the x=1.0 edge closer than f32 epsilon
+        x[100] = 1.0 - 1e-9   # inside (f64), on-edge at f32
+        x[101] = 1.0 + 1e-9   # outside (f64)
+        y = rng.uniform(0.0, 1.0, n)
+        y[100] = y[101] = 0.5
+        batch = FeatureBatch.from_pydict(
+            sft, {"speed": rng.uniform(0, 30, n),
+                  "geom": np.stack([x, y], 1)})
+        qsft = SimpleFeatureType.from_spec("q", "*geom:Point")
+        queries = FeatureBatch.from_pydict(
+            qsft, {"geom": np.array([[0.99, 0.5]])})
+        proc = KNearestNeighborSearchProcess()
+        cql = "INTERSECTS(geom, POLYGON((0 0, 1 0, 1 1, 0 1, 0 0)))"
+        res = proc.execute(queries, batch, num_desired=5,
+                           cql_filter=cql, impl="sparse")
+        mask = (x <= 1.0) & (x >= 0.0) & (y >= 0.0) & (y <= 1.0)
+        exp = oracle(np.array([0.99]), np.array([0.5]), x, y, mask, 5)
+        np.testing.assert_allclose(
+            np.sort(res.distances_m, 1), exp, rtol=1e-4, atol=1.0)
+        fin = np.isfinite(res.distances_m)
+        assert mask[res.indices[fin]].all()
+        assert 101 not in res.indices[fin]
+
+    def test_auto_resolution(self):
+        r = KNearestNeighborSearchProcess._resolve_impl
+        assert r("auto", 1 << 21, "speed > 5") == "sparse"
+        assert r("auto", 1 << 21, "INCLUDE") == "fullscan"
+        assert r("auto", 1 << 10, "speed > 5") == "haversine"
+        assert r("mxu", 1 << 21, "INCLUDE") == "mxu"
+
+
+class TestSparseAuto:
+    def test_calibration_and_overflow_fallback(self):
+        rng = np.random.default_rng(11)
+        n = 1 << 15
+        x = np.sort(rng.uniform(-180, 180, n))
+        y = rng.uniform(-90, 90, n)
+        mask = (x > -30) & (x < 30)
+        qx = jnp.asarray(rng.uniform(-20, 20, 8), jnp.float32)
+        qy = jnp.asarray(rng.uniform(-40, 40, 8), jnp.float32)
+        jx = jnp.asarray(x, jnp.float32)
+        jy = jnp.asarray(y, jnp.float32)
+        jm = jnp.asarray(mask)
+        exp = oracle(np.asarray(qx), np.asarray(qy), x, y, mask, 4)
+        # auto-calibrated capacity covers the matching tiles
+        fd, fi, cap = knn_sparse_auto(
+            qx, qy, jx, jy, jm, k=4, interpret=True)
+        assert cap >= int(np.asarray(count_match_tiles(jm)))
+        np.testing.assert_allclose(
+            np.sort(np.asarray(fd), 1), exp, rtol=1e-4, atol=1.0)
+        # undersized capacity overflows -> dense fallback, still exact
+        fd2, fi2, cap2 = knn_sparse_auto(
+            qx, qy, jx, jy, jm, k=4, tile_capacity=1, interpret=True)
+        assert cap2 == -1
+        np.testing.assert_allclose(
+            np.sort(np.asarray(fd2), 1), exp, rtol=1e-4, atol=1.0)
+
+    def test_capacity_bucket(self):
+        assert capacity_bucket(0) == 64
+        assert capacity_bucket(100) == 128
+        assert capacity_bucket(120) == 256  # slack pushes past 128
+
+
+class TestPlannerKnn:
+    def _mk_store(self, tmp_path, cached):
+        batch = make_batch(n=6000, seed=9)
+        ds = DataStore(str(tmp_path / ("c" if cached else "p")),
+                       use_device_cache=cached)
+        src = ds.create_schema(batch.sft)
+        src.write(batch)
+        return src, batch
+
+    @pytest.mark.parametrize("cached", [False, True])
+    def test_store_parity(self, tmp_path, cached):
+        src, batch = self._mk_store(tmp_path, cached)
+        g = batch.columns["geom"]
+        x, y = np.asarray(g.x), np.asarray(g.y)
+        speed = np.asarray(batch.columns["speed"])
+        rng = np.random.default_rng(13)
+        qx = rng.uniform(-4, 4, 6)
+        qy = rng.uniform(52, 58, 6)
+        d, i, got = src.knn(
+            "speed > 10 AND BBOX(geom, -4, 51, 4, 59)", qx, qy, k=3)
+        mask = (speed > 10) & (x >= -4) & (x <= 4) & (y >= 51) & (y <= 59)
+        exp = oracle(qx, qy, x, y, mask, 3)
+        np.testing.assert_allclose(np.sort(d, 1), exp, rtol=1e-4, atol=1.0)
+        # indices resolve to real matching rows of the returned batch
+        gg = got.columns["geom"]
+        gx, gy = np.asarray(gg.x), np.asarray(gg.y)
+        gs = np.asarray(got.columns["speed"])
+        fin = np.isfinite(d)
+        sel = i[fin]
+        assert (gs[sel] > 10).all()
+        assert ((gx[sel] >= -4) & (gx[sel] <= 4)).all()
+
+    def test_process_routes_through_planner(self, tmp_path):
+        src, batch = self._mk_store(tmp_path, True)
+        g = batch.columns["geom"]
+        x, y = np.asarray(g.x), np.asarray(g.y)
+        rng = np.random.default_rng(17)
+        qsft = SimpleFeatureType.from_spec("q", "*geom:Point")
+        qx = rng.uniform(-2, 2, 4)
+        qy = rng.uniform(53, 57, 4)
+        queries = FeatureBatch.from_pydict(
+            qsft, {"geom": np.stack([qx, qy], 1)})
+        proc = KNearestNeighborSearchProcess()
+        res = proc.execute(
+            queries, src, num_desired=4, estimated_distance_m=500_000.0,
+            max_search_distance_m=2_000_000.0, impl="sparse",
+        )
+        mask = np.ones(len(x), bool)
+        exp = oracle(qx, qy, x, y, mask, 4)
+        # window-grown search must still be exact (recall condition)
+        np.testing.assert_allclose(
+            np.sort(res.distances_m, 1), exp, rtol=1e-4, atol=1.0)
+
+
+class TestSparseSharded:
+    def test_matches_single_device(self):
+        import jax
+        from jax.sharding import Mesh
+
+        from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+        devs = jax.devices()
+        if len(devs) < 4:
+            pytest.skip("needs >=4 virtual devices")
+        mesh = Mesh(np.asarray(devs[:4]), (SHARD_AXIS,))
+        rng = np.random.default_rng(23)
+        n = 4 * 4096
+        x = np.sort(rng.uniform(-60, 60, n))
+        y = rng.uniform(-45, 45, n)
+        mask = rng.random(n) < 0.3
+        qx = rng.uniform(-30, 30, 8)
+        qy = rng.uniform(-30, 30, 8)
+        jq = (jnp.asarray(qx, jnp.float32), jnp.asarray(qy, jnp.float32))
+        jd = (jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32),
+              jnp.asarray(mask))
+        fd, fi, ov = knn_sparse_sharded(
+            mesh, *jq, *jd, k=4, tile_capacity=8, interpret=True)
+        assert not bool(np.asarray(ov))
+        exp = oracle(qx, qy, x, y, mask, 4)
+        np.testing.assert_allclose(
+            np.sort(np.asarray(fd), 1), exp, rtol=1e-4, atol=1.0)
+        # global indices hit true matches
+        idx = np.asarray(fi)
+        assert mask[idx[np.isfinite(np.asarray(fd))]].all()
