@@ -1,0 +1,348 @@
+"""Z-locality density: the store-order-aware heatmap kernel.
+
+Parity role: DensityScan / DensityProcess (SURVEY.md §3.5) at the
+north-star scale — config 4's 512x512 heatmap over 10s of millions of
+points. The round-2 kernels pay per-point costs that dwarf the HBM
+roofline: XLA scatter-add serializes (~1 cycle/point), and the dense MXU
+one-hot formulation (`density.density_grid_mxu`) materializes [T, H] and
+[T, W] one-hots through HBM (~137 GB at 67M points / 512^2 — measured
+0.65 s, vs a ~2 ms read-the-data bound).
+
+The insight (same as the sparse kNN scan): index scans emit rows in
+STORE ORDER — the Z curve — so consecutive points are spatially local,
+and a 16384-point data tile touches only a narrow band of density cells.
+In MORTON order over the density grid those cells are near-contiguous:
+measured on the config-4 shapes, a tile's (max - min) Morton-cell span
+is ~64-256 out of 262144. That turns the histogram into
+
+  per tile:  local = morton_cell(point) - tile_base     (in [0, CAP))
+             counts[local] += w                          (VMEM one-hot)
+  finally:   scatter per-tile count rows into the Morton-flat grid,
+             permute Morton -> raster once (static per W,H)
+
+The per-tile one-hot is [chunk, CAP] with CAP ~128-1024 instead of
+[chunk, H] + [chunk, W] with H = W = 512, and it never leaves VMEM.
+Cost: ~0.3-0.5 VPU cycles/point — an HBM-bound kernel.
+
+Exactness: identical contract to `density_grid` (same binning, same
+mask/out-of-bounds exclusion). Weighted sums run the one-hot matmul in
+f32 (HIGHEST); counts are exact, weighted grids agree with the scatter
+path to f32 summation-order noise. Tiles whose span exceeds CAP (Z-curve
+quadrant seams, sparse regions) and tiles with no matching points are
+EXCLUDED from the kernel: empty tiles are pruned outright (the VERDICT
+r3 tile-pruning item), overflow tiles are evaluated by the caller on the
+dense path over block-gathered points (`density_zsparse` handles both).
+
+Mosaic notes (same constraints as knn_scan.py): i32 bit-twiddling only
+(Morton interleave in 32-bit), trace under enable_x64(False), static
+chunk loop (4 bodies), output lanes >= 128.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BBox = Tuple[float, float, float, float]
+
+DATA_TILE = 16384
+CHUNK = 4096
+MAX_CAP = 4096  # beyond this span the dense path is cheaper anyway
+
+
+def _interleave16(v):
+    """Spread the low 16 bits of each lane to even bit positions."""
+    v = v & 0xFFFF
+    v = (v | (v << 8)) & 0x00FF00FF
+    v = (v | (v << 4)) & 0x0F0F0F0F
+    v = (v | (v << 2)) & 0x33333333
+    v = (v | (v << 1)) & 0x55555555
+    return v
+
+
+def _morton_cells(col, row):
+    """Morton (Z) cell id from grid col/row (i32, grids up to 2^15)."""
+    return _interleave16(col) | (_interleave16(row) << 1)
+
+
+@functools.lru_cache(maxsize=8)
+def _raster_of_morton(width: int, height: int) -> np.ndarray:
+    """[n_morton] i32: raster index (row*W+col) per Morton cell id, for
+    the final permutation. Static per grid shape."""
+    side = 1 << int(np.ceil(np.log2(max(width, height, 2))))
+    cc, rr = np.meshgrid(np.arange(side), np.arange(side), indexing="xy")
+
+    def spread(v):
+        v = v.astype(np.uint32)
+        v = (v | (v << 8)) & np.uint32(0x00FF00FF)
+        v = (v | (v << 4)) & np.uint32(0x0F0F0F0F)
+        v = (v | (v << 2)) & np.uint32(0x33333333)
+        v = (v | (v << 1)) & np.uint32(0x55555555)
+        return v
+
+    z = spread(cc) | (spread(rr) << np.uint32(1))
+    out = np.full(side * side, width * height, np.int32)  # sink for pads
+    inb = (cc < width) & (rr < height)
+    out[z[inb]] = (rr[inb] * width + cc[inb]).astype(np.int32)
+    return out
+
+
+def _bin_cells(x, y, mask, bbox: BBox, width: int, height: int):
+    """Shared binning math: (morton cell i32, in-bounds-and-masked)."""
+    xmin, ymin, xmax, ymax = bbox
+    dx = (xmax - xmin) / width
+    dy = (ymax - ymin) / height
+    col = jnp.floor((x - xmin) / dx).astype(jnp.int32)
+    row = jnp.floor((y - ymin) / dy).astype(jnp.int32)
+    inb = (col >= 0) & (col < width) & (row >= 0) & (row < height) & mask
+    col = jnp.clip(col, 0, width - 1)
+    row = jnp.clip(row, 0, height - 1)
+    return _morton_cells(col, row), inb
+
+
+class DensityCalib(NamedTuple):
+    """Host-side plan from one calibration pass (cacheable across
+    queries, like the sparse kNN tile capacity)."""
+
+    tile_ids: np.ndarray   # [S] tiles the sparse kernel scans
+    tile_base: np.ndarray  # [S] morton base cell per tile
+    cap: int               # local one-hot width (pow2)
+    dense_ids: np.ndarray  # tiles overflowing cap -> dense fallback
+    n_tiles: int
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bbox", "width", "height", "data_tile")
+)
+def _tile_ranges(x, y, mask, bbox: BBox, width: int, height: int,
+                 data_tile: int):
+    n = x.shape[0]
+    pad = (-n) % data_tile
+    xp = jnp.pad(x.astype(jnp.float32), (0, pad))
+    yp = jnp.pad(y.astype(jnp.float32), (0, pad))
+    mp = jnp.pad(mask, (0, pad))
+    zc, ok = _bin_cells(xp, yp, mp, bbox, width, height)
+    nt = zc.shape[0] // data_tile
+    zt = zc.reshape(nt, data_tile)
+    okt = ok.reshape(nt, data_tile)
+    big = jnp.int32(1 << 30)
+    zmin = jnp.where(okt, zt, big).min(axis=1)
+    zmax = jnp.where(okt, zt, -1).max(axis=1)
+    return zmin, zmax
+
+
+def calibrate_density(
+    x, y, mask, bbox: BBox, width: int, height: int,
+    data_tile: int = DATA_TILE, slack: float = 2.0,
+) -> DensityCalib:
+    """One device pass + one small ([n_tiles] x2 i32) fetch: per-tile
+    Morton cell ranges under the CURRENT mask. cap is a pow2 bucket of
+    the median span x slack — covering most tiles keeps the one-hot
+    narrow; the tail goes to the dense fallback list."""
+    zmin, zmax = _tile_ranges(x, y, mask, bbox, width, height, data_tile)
+    zmin = np.asarray(zmin)
+    zmax = np.asarray(zmax)
+    nt = len(zmin)
+    has = zmax >= 0  # tile bears >= 1 matching point; others pruned
+    ids = np.nonzero(has)[0]
+    if len(ids) == 0:
+        return DensityCalib(
+            np.zeros(0, np.int32), np.zeros(0, np.int32), 128,
+            np.zeros(0, np.int32), nt,
+        )
+    span = zmax[ids] - zmin[ids] + 1
+    cap = int(min(MAX_CAP, max(
+        128, 1 << int(np.ceil(np.log2(max(np.median(span) * slack, 2))))
+    )))
+    fits = span <= cap
+    return DensityCalib(
+        ids[fits].astype(np.int32),
+        zmin[ids][fits].astype(np.int32),
+        cap,
+        ids[~fits].astype(np.int32),
+        nt,
+    )
+
+
+def _make_kernel(data_tile: int, chunk: int, cap: int, bbox: BBox,
+                 width: int, height: int):
+    def _kernel(ids_ref, base_ref, x_ref, y_ref, w_ref, m_ref, out_ref):
+        from jax.experimental import pallas as pl
+
+        p = pl.program_id(0)
+        base = base_ref[p]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, cap), 1)
+        acc = jnp.zeros((1, cap), jnp.float32)
+        for s in range(data_tile // chunk):
+            sl = slice(s * chunk, (s + 1) * chunk)
+            zc, ok = _bin_cells(
+                x_ref[0, sl], y_ref[0, sl], m_ref[0, sl] > 0.5,
+                bbox, width, height,
+            )
+            local = jnp.clip(zc - base, 0, cap - 1)
+            lw = jnp.where(
+                ok & (zc >= base) & (zc < base + cap),
+                w_ref[0, sl], 0.0,
+            ).reshape(1, chunk)
+            onehot = (
+                local.reshape(chunk, 1) == iota
+            ).astype(jnp.float32)
+            acc = acc + jax.lax.dot_general(
+                lw, onehot, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+        out_ref[...] = acc
+
+    return _kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cap", "bbox", "width", "height", "data_tile", "chunk", "interpret"
+    ),
+)
+def _zsparse_call(
+    x, y, w, maskf, tile_ids, tile_base,
+    cap: int, bbox: BBox, width: int, height: int,
+    data_tile: int, chunk: int, interpret: bool,
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = x.shape[0]
+    s = tile_ids.shape[0]
+    xr = x.astype(jnp.float32).reshape(1, n)
+    yr = y.astype(jnp.float32).reshape(1, n)
+    wr = w.astype(jnp.float32).reshape(1, n)
+    mr = maskf.reshape(1, n)
+
+    data_block = pl.BlockSpec(
+        (1, data_tile), lambda p, ids, base: (0, ids[p])
+    )
+    with jax.enable_x64(False):
+        counts = pl.pallas_call(
+            _make_kernel(data_tile, chunk, cap, bbox, width, height),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(s,),
+                in_specs=[data_block] * 4,
+                out_specs=pl.BlockSpec((1, cap), lambda p, ids, base: (p, 0)),
+            ),
+            out_shape=jax.ShapeDtypeStruct((s, cap), jnp.float32),
+            interpret=interpret,
+        )(tile_ids.astype(jnp.int32), tile_base.astype(jnp.int32),
+          xr, yr, wr, mr)
+    return counts
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cap", "width", "height"),
+)
+def _fold_counts(counts, tile_base, raster_of_z, cap: int, width: int,
+                 height: int):
+    """Scatter per-tile count rows into the Morton-flat grid, then
+    permute Morton -> raster (one static scatter each)."""
+    n_morton = raster_of_z.shape[0]
+    idx = tile_base[:, None] + jnp.arange(cap, dtype=jnp.int32)[None, :]
+    flat_z = jnp.zeros(n_morton + cap, jnp.float32)
+    flat_z = flat_z.at[idx.reshape(-1)].add(counts.reshape(-1))
+    # raster_of_z routes Morton pads (cells outside WxH) to a sink slot
+    grid = jnp.zeros(width * height + 1, jnp.float32)
+    grid = grid.at[raster_of_z].add(flat_z[:n_morton])
+    return grid[: width * height].reshape(height, width)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bbox", "width", "height")
+)
+def _expected_mass(x, y, w, mask, bbox: BBox, width: int, height: int):
+    _, ok = _bin_cells(x, y, mask, bbox, width, height)
+    return jnp.sum(jnp.where(ok, w.astype(jnp.float64), 0.0))
+
+
+def density_zsparse(
+    x: jax.Array,
+    y: jax.Array,
+    weights: jax.Array,
+    mask: jax.Array,
+    bbox: BBox,
+    width: int,
+    height: int,
+    calib: Optional[DensityCalib] = None,
+    data_tile: int = DATA_TILE,
+    interpret: bool = False,
+    check_stale: bool = True,
+) -> Tuple[jax.Array, DensityCalib]:
+    """Store-order density grid (see module docstring). Returns
+    ([height, width] f32 grid, calib) — pass `calib` back in on repeat
+    queries over the same batch+filter to skip the calibration fetch.
+    Exact contract of `density.density_grid` for any input order; the
+    sparse win requires store (Z) order, the fallback keeps it correct
+    otherwise.
+
+    A REUSED calib is validated (`check_stale`): unlike the kNN tile
+    capacity, a stale density plan is a silent correctness failure (a
+    point in a tile pruned under the OLD mask, or outside a tile's
+    cached cell band, would vanish from the grid), so the grid's total
+    mass is checked against the mask's expected mass and a mismatch
+    triggers automatic recalibration. Callers looping the IDENTICAL
+    query (mask unchanged) may pass check_stale=False to skip the extra
+    device reduction + fetch."""
+    from geomesa_tpu.engine.density import density_grid_mxu
+
+    reused_calib = calib is not None
+    n = x.shape[0]
+    pad = (-n) % data_tile
+    xp = jnp.pad(x.astype(jnp.float32), (0, pad))
+    yp = jnp.pad(y.astype(jnp.float32), (0, pad))
+    wp = jnp.pad(weights.astype(jnp.float32), (0, pad))
+    mp = jnp.pad(mask, (0, pad))
+    if calib is None:
+        calib = calibrate_density(
+            xp, yp, mp, bbox, width, height, data_tile=data_tile
+        )
+
+    grid = jnp.zeros((height, width), jnp.float32)
+    if len(calib.tile_ids):
+        counts = _zsparse_call(
+            xp, yp, wp, mp.astype(jnp.float32),
+            jnp.asarray(calib.tile_ids), jnp.asarray(calib.tile_base),
+            cap=calib.cap, bbox=tuple(bbox), width=width, height=height,
+            data_tile=data_tile, chunk=min(CHUNK, data_tile),
+            interpret=interpret,
+        )
+        raster = jnp.asarray(_raster_of_morton(width, height))
+        grid = grid + _fold_counts(
+            counts, jnp.asarray(calib.tile_base), raster,
+            cap=calib.cap, width=width, height=height,
+        )
+    if len(calib.dense_ids):
+        # overflow tiles (Z seams / sparse regions): block-gather their
+        # points (contiguous 16k rows — fast) and run the dense MXU path
+        ids = jnp.asarray(calib.dense_ids)
+        gx = jnp.take(xp.reshape(-1, data_tile), ids, axis=0).reshape(-1)
+        gy = jnp.take(yp.reshape(-1, data_tile), ids, axis=0).reshape(-1)
+        gw = jnp.take(wp.reshape(-1, data_tile), ids, axis=0).reshape(-1)
+        gm = jnp.take(mp.reshape(-1, data_tile), ids, axis=0).reshape(-1)
+        grid = grid + density_grid_mxu(
+            gx, gy, gw, gm, tuple(bbox), width, height,
+            point_tile=min(8192, max(len(calib.dense_ids) * data_tile, 128)),
+        )
+    if reused_calib and check_stale:
+        expected = float(_expected_mass(
+            xp, yp, wp, mp, tuple(bbox), width, height))
+        got = float(np.asarray(grid, np.float64).sum())
+        if not np.isclose(got, expected, rtol=1e-5, atol=1e-3):
+            # the cached plan no longer covers this mask: recalibrate
+            return density_zsparse(
+                x, y, weights, mask, bbox, width, height, calib=None,
+                data_tile=data_tile, interpret=interpret,
+            )
+    return grid, calib

@@ -446,10 +446,11 @@ def knn_sparse_auto(
     """The framework-facing sparse kNN: calibrate capacity if the caller
     has no estimate (one device scalar fetch), run the sparse scan, and
     on overflow fall back to the dense fullscan (documented contract of
-    `knn_sparse_scan`). Returns (dists, idx, capacity_used) — callers
-    cache capacity_used across queries and only pay calibration again
-    after an overflow (capacity_used == -1 signals the fallback ran, so
-    the next query recalibrates)."""
+    `knn_sparse_scan`). Returns (dists, idx, capacity_used) with dists/
+    idx as HOST numpy arrays (results and the overflow flag come back in
+    one transfer). Callers cache capacity_used across queries and only
+    pay calibration again after an overflow (capacity_used == -1 signals
+    the fallback ran, so the next query recalibrates)."""
     if tile_capacity is None:
         tile_capacity = capacity_bucket(int(np.asarray(
             count_match_tiles(mask))))
@@ -457,10 +458,14 @@ def knn_sparse_auto(
         qx, qy, x, y, mask, k=k, tile_capacity=tile_capacity,
         m_blocks=m_blocks, interpret=interpret,
     )
-    if bool(np.asarray(ov)):
-        fd, fi = knn_fullscan(
+    # ONE transfer for results + overflow flag: fetching ov alone first
+    # would serialize a second tunnel round trip (~110 ms on the remote
+    # platform) before the caller's own result fetch
+    fd, fi, ov = jax.device_get((fd, fi, ov))
+    if bool(ov):
+        fd, fi = jax.device_get(knn_fullscan(
             qx, qy, x, y, mask, k=k, m_blocks=m_blocks,
-            interpret=interpret)
+            interpret=interpret))
         return fd, fi, -1
     return fd, fi, tile_capacity
 

@@ -83,7 +83,7 @@ class KNearestNeighborSearchProcess:
                 # path must run the same kernel the bench headline runs)
                 return self._solve_scan(
                     qx, qy, data_features, cql_filter, num_desired,
-                    max_search_distance_m, eff,
+                    max_search_distance_m, eff, query_tile=query_tile,
                 )
             # materialized input: one exact pass, no window growth possible
             candidates = filter_batch(data_features, cql_filter)
@@ -157,8 +157,11 @@ class KNearestNeighborSearchProcess:
     def _solve_scan(
         self, qx, qy, batch: FeatureBatch, cql_filter: str, k: int,
         max_dist: float, eff: str, interpret: bool = False,
+        query_tile: int = 256,
     ) -> KnnResult:
-        """Fused-scan solve over the full device-resident batch."""
+        """Fused-scan solve over the full device-resident batch.
+        query_tile applies to the fullscan route (per-tile batch
+        rescans); the sparse route ranks all queries in one pass."""
         import jax.numpy as jnp
 
         from geomesa_tpu.cql import ast, compile_filter, parse_cql
@@ -222,7 +225,7 @@ class KNearestNeighborSearchProcess:
         else:
             fd, fi = knn_fullscan_tiled(
                 jqx, jqy, cx, cy, mask, k=kk, m_blocks=mb,
-                query_tile=256, interpret=interpret,
+                query_tile=query_tile, interpret=interpret,
             )
         from geomesa_tpu.plan.planner import _pad_to_k
 

@@ -88,7 +88,7 @@ class TubeSelectProcess:
         import jax.numpy as jnp
 
         from geomesa_tpu.engine.device import to_device
-        from geomesa_tpu.engine.tube import tube_select
+        from geomesa_tpu.engine.tube import tube_select_pruned
 
         from geomesa_tpu.process.util import candidates_for
 
@@ -105,7 +105,10 @@ class TubeSelectProcess:
         dev = to_device(candidates, coord_dtype=jnp.float64)
         g = candidates.sft.default_geometry
         d = candidates.sft.default_dtg
-        mask = tube_select(
+        # tile-pruned corridor join (round 4): data tiles outside the
+        # corridor's per-segment reach are never scanned; exact for any
+        # order, fast when candidates arrive store(Z)-ordered
+        mask, _cap = tube_select_pruned(
             dev[f"{g.name}__x"],
             dev[f"{g.name}__y"],
             dev[d.name],

@@ -1,0 +1,154 @@
+"""Z-locality density kernel tests (interpret-mode Pallas on CPU).
+
+Oracle: the scatter-path `density_grid` (itself gated against
+np.histogram2d in test_engine.py) — the zsparse kernel must reproduce it
+exactly for counts and to f32-summation noise for weights, on Z-ordered
+AND random-ordered (fallback-heavy) inputs."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from geomesa_tpu.engine.density import density_grid
+from geomesa_tpu.engine.density_zsparse import (
+    _raster_of_morton, calibrate_density, density_zsparse)
+
+BBOX = (-60.0, -45.0, 60.0, 45.0)
+
+
+def _morton64(x, y):
+    qx = ((np.asarray(x, np.float64) + 180) / 360 * (1 << 16)).astype(np.uint64)
+    qy = ((np.asarray(y, np.float64) + 90) / 180 * (1 << 16)).astype(np.uint64)
+
+    def spread(v):
+        v = (v | (v << np.uint64(16))) & np.uint64(0x0000FFFF0000FFFF)
+        v = (v | (v << np.uint64(8))) & np.uint64(0x00FF00FF00FF00FF)
+        v = (v | (v << np.uint64(4))) & np.uint64(0x0F0F0F0F0F0F0F0F)
+        v = (v | (v << np.uint64(2))) & np.uint64(0x3333333333333333)
+        v = (v | (v << np.uint64(1))) & np.uint64(0x5555555555555555)
+        return v
+
+    return spread(qx) | (spread(qy) << np.uint64(1))
+
+
+def make(n, seed=5, z_order=True, clustered=False):
+    rng = np.random.default_rng(seed)
+    if clustered:
+        k = 20
+        cx = rng.uniform(-50, 50, k)
+        cy = rng.uniform(-40, 40, k)
+        pick = rng.integers(0, k, n)
+        x = np.clip(cx[pick] + rng.normal(0, 2, n), -180, 180)
+        y = np.clip(cy[pick] + rng.normal(0, 2, n), -90, 90)
+        bg = rng.random(n) < 0.1
+        x[bg] = rng.uniform(-180, 180, bg.sum())
+        y[bg] = rng.uniform(-90, 90, bg.sum())
+    else:
+        x = rng.uniform(-80, 80, n)
+        y = rng.uniform(-60, 60, n)
+    if z_order:
+        o = np.argsort(_morton64(x, y))
+        x, y = x[o], y[o]
+    w = rng.uniform(0.5, 2.0, n)
+    mask = rng.random(n) < 0.7
+    return x, y, w, mask
+
+
+def run_both(x, y, w, mask, W=64, H=64, data_tile=2048, weights=None):
+    jx = jnp.asarray(x, jnp.float32)
+    jy = jnp.asarray(y, jnp.float32)
+    jw = jnp.asarray(w if weights is None else weights, jnp.float32)
+    jm = jnp.asarray(mask)
+    ref = np.asarray(density_grid(jx, jy, jw, jm, BBOX, W, H))
+    got, calib = density_zsparse(
+        jx, jy, jw, jm, BBOX, W, H, data_tile=data_tile, interpret=True)
+    return np.asarray(got), ref, calib
+
+
+class TestZsparseDensity:
+    def test_counts_exact_z_order(self):
+        x, y, w, mask = make(1 << 15)
+        got, ref, calib = run_both(x, y, w, mask, weights=np.ones(len(x)))
+        np.testing.assert_array_equal(got, ref)
+        assert len(calib.tile_ids) > 0  # the sparse path actually ran
+
+    def test_weighted_close_z_order(self):
+        x, y, w, mask = make(1 << 15, seed=7)
+        got, ref, calib = run_both(x, y, w, mask)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-3)
+        np.testing.assert_allclose(got.sum(), ref.sum(), rtol=1e-6)
+
+    def test_random_order_falls_back_exactly(self):
+        # unsorted input: spans blow past cap, tiles route to the dense
+        # path — result must still match (the correctness-for-any-order
+        # contract); here weights=1 so equality is exact
+        x, y, w, mask = make(1 << 14, seed=9, z_order=False)
+        # 256x256: random-order tile spans exceed MAX_CAP, forcing the
+        # dense route (64x64 fits entirely within one cap)
+        got, ref, calib = run_both(
+            x, y, w, mask, W=256, H=256, weights=np.ones(len(x)))
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-4)
+        assert len(calib.dense_ids) > 0  # fallback exercised
+
+    def test_clustered_z_order(self):
+        x, y, w, mask = make(1 << 15, seed=11, clustered=True)
+        got, ref, calib = run_both(x, y, w, mask, weights=np.ones(len(x)))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_calib_reuse(self):
+        x, y, w, mask = make(1 << 14, seed=13)
+        jx = jnp.asarray(x, jnp.float32)
+        jy = jnp.asarray(y, jnp.float32)
+        jw = jnp.asarray(np.ones(len(x)), jnp.float32)
+        jm = jnp.asarray(mask)
+        g1, calib = density_zsparse(
+            jx, jy, jw, jm, BBOX, 64, 64, data_tile=2048, interpret=True)
+        g2, _ = density_zsparse(
+            jx, jy, jw, jm, BBOX, 64, 64, calib=calib, data_tile=2048,
+            interpret=True)
+        np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+    def test_empty_mask(self):
+        x, y, w, mask = make(1 << 12, seed=15)
+        got, ref, calib = run_both(
+            x, y, w, np.zeros_like(mask), weights=np.ones(len(x)))
+        assert got.sum() == 0
+        assert len(calib.tile_ids) == 0 and len(calib.dense_ids) == 0
+
+    def test_all_points_outside_bbox(self):
+        rng = np.random.default_rng(17)
+        n = 1 << 12
+        x = rng.uniform(100, 170, n)
+        y = rng.uniform(50, 80, n)
+        got, ref, calib = run_both(
+            x, y, np.ones(n), np.ones(n, bool), weights=np.ones(n))
+        assert got.sum() == 0
+
+    def test_raster_of_morton_permutation(self):
+        # every raster cell appears exactly once; pads hit the sink
+        for W, H in [(64, 64), (48, 32), (512, 512)]:
+            r = _raster_of_morton(W, H)
+            real = r[r < W * H]
+            assert len(real) == W * H
+            assert len(np.unique(real)) == W * H
+
+    def test_non_square_grid(self):
+        x, y, w, mask = make(1 << 14, seed=19)
+        got, ref, calib = run_both(
+            x, y, w, mask, W=96, H=40, weights=np.ones(len(x)))
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-4)
+
+
+def test_calibration_prunes_empty_tiles():
+    # points concentrated in one corner: most tiles carry no matches and
+    # must be absent from BOTH lists (pruned, never scanned)
+    rng = np.random.default_rng(21)
+    n = 1 << 14
+    x = np.sort(rng.uniform(-59, -50, n))
+    y = rng.uniform(-44, -40, n)
+    calib = calibrate_density(
+        jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32),
+        jnp.ones(n, bool), BBOX, 64, 64, data_tile=1024,
+    )
+    assert len(calib.tile_ids) + len(calib.dense_ids) <= calib.n_tiles

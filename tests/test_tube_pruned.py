@@ -1,0 +1,123 @@
+"""Tile-pruned tube-select tests: parity with the dense kernel (which
+test_engine.py gates against a NumPy sweep) on Z-ordered and random
+inputs, overflow fallback, and the sharded variant."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from geomesa_tpu.engine.tube import (
+    tube_select, tube_select_pruned, tube_select_pruned_sharded)
+
+
+def make(n=40_000, seed=3, z_order=True):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-20, 20, n)
+    y = rng.uniform(40, 70, n)
+    if z_order:
+        o = np.argsort(x + 1e-3 * y)  # cheap store-order proxy
+        x, y = x[o], y[o]
+    t = rng.integers(0, 86_400_000, n)
+    T = 192
+    tx = np.linspace(-15, 15, T)
+    ty = np.linspace(42, 68, T) + rng.normal(0, 0.05, T)
+    tt = np.linspace(0, 86_400_000, T).astype(np.int64)
+    return x, y, t, tx, ty, tt
+
+
+def dev_args(x, y, t, mask, tx, ty, tt, radius, win):
+    return (
+        jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32),
+        jnp.asarray(t, jnp.int64), jnp.asarray(mask),
+        jnp.asarray(tx, jnp.float32), jnp.asarray(ty, jnp.float32),
+        jnp.asarray(tt, jnp.int64),
+        jnp.float32(radius), jnp.int64(win),
+    )
+
+
+class TestTubePruned:
+    @pytest.mark.parametrize("z_order", [True, False])
+    def test_parity_with_dense(self, z_order):
+        x, y, t, tx, ty, tt = make(z_order=z_order)
+        mask = np.random.default_rng(5).random(len(x)) < 0.8
+        args = dev_args(x, y, t, mask, tx, ty, tt, 30_000.0, 3_600_000)
+        dense = np.asarray(tube_select(*args, data_tile=2048))
+        pruned, cap = tube_select_pruned(*args, data_tile=2048)
+        assert cap != 0
+        np.testing.assert_array_equal(np.asarray(pruned), dense)
+        assert dense.sum() > 0  # non-vacuous
+
+    def test_prunes_far_tiles(self):
+        # corridor confined to a corner: most Z-ordered tiles are out of
+        # reach, so a small capacity suffices without overflow
+        x, y, t, tx, ty, tt = make()
+        tx = np.linspace(-19, -17, len(tx))
+        ty = np.linspace(41, 43, len(ty))
+        mask = np.ones(len(x), bool)
+        args = dev_args(x, y, t, mask, tx, ty, tt, 10_000.0, 86_400_000)
+        dense = np.asarray(tube_select(*args, data_tile=2048))
+        pruned, cap = tube_select_pruned(
+            *args, data_tile=2048, tile_capacity=8)
+        assert cap == 8  # no overflow at a tiny capacity = real pruning
+        np.testing.assert_array_equal(np.asarray(pruned), dense)
+
+    def test_overflow_falls_back_exactly(self):
+        x, y, t, tx, ty, tt = make(n=20_000)
+        mask = np.ones(len(x), bool)
+        # 100km corridor across everything at capacity 1: must overflow
+        args = dev_args(x, y, t, mask, tx, ty, tt, 100_000.0, 86_400_000)
+        dense = np.asarray(tube_select(*args, data_tile=1024))
+        pruned, cap = tube_select_pruned(
+            *args, data_tile=1024, tile_capacity=1)
+        assert cap == -1  # fallback ran
+        np.testing.assert_array_equal(np.asarray(pruned), dense)
+
+    def test_time_pruning(self):
+        # spatially-overlapping corridor, disjoint time range: nothing
+        # matches, and the time envelope prune keeps capacity tiny
+        x, y, t, tx, ty, tt = make(n=10_000)
+        tt = tt + 200 * 86_400_000
+        mask = np.ones(len(x), bool)
+        args = dev_args(x, y, t, mask, tx, ty, tt, 30_000.0, 60_000)
+        pruned, cap = tube_select_pruned(
+            *args, data_tile=1024, tile_capacity=1)
+        assert cap == 1 and not np.asarray(pruned).any()
+
+    def test_f64_path(self):
+        # the process path runs f64 coords through the same kernel
+        x, y, t, tx, ty, tt = make(n=8_000)
+        mask = np.ones(len(x), bool)
+        args = (
+            jnp.asarray(x, jnp.float64), jnp.asarray(y, jnp.float64),
+            jnp.asarray(t, jnp.int64), jnp.asarray(mask),
+            jnp.asarray(tx, jnp.float64), jnp.asarray(ty, jnp.float64),
+            jnp.asarray(tt, jnp.int64),
+            30_000.0, 3_600_000,
+        )
+        dense = np.asarray(tube_select(
+            args[0], args[1], args[2], args[3], args[4], args[5], args[6],
+            jnp.float32(30_000.0), jnp.int64(3_600_000), data_tile=1024))
+        pruned, _ = tube_select_pruned(*args, data_tile=1024)
+        np.testing.assert_array_equal(np.asarray(pruned), dense)
+
+
+class TestTubePrunedSharded:
+    def test_matches_dense(self):
+        import jax
+        from jax.sharding import Mesh
+
+        from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+        devs = jax.devices()
+        if len(devs) < 4:
+            pytest.skip("needs >=4 virtual devices")
+        mesh = Mesh(np.asarray(devs[:4]), (SHARD_AXIS,))
+        x, y, t, tx, ty, tt = make(n=4 * 8192)
+        mask = np.ones(len(x), bool)
+        args = dev_args(x, y, t, mask, tx, ty, tt, 30_000.0, 3_600_000)
+        dense = np.asarray(tube_select(*args, data_tile=1024))
+        hits, ov = tube_select_pruned_sharded(
+            mesh, *args, data_tile=1024, tile_capacity=8)
+        assert not bool(np.asarray(ov))
+        np.testing.assert_array_equal(np.asarray(hits), dense)
