@@ -69,10 +69,19 @@ def pad_polygon_edges(
 
     Fully vectorized: the round-3 bench measured the per-polygon python
     loop at ~100 s over 10k polygons x 1.5M edges (each iteration scanned
-    the whole edge table); this is one sort + one scatter."""
+    the whole edge table); this is one (skippable) sort + one scatter.
+    bincount over dense pids replaces np.unique (~1 s at 10M edges), and
+    already-pid-sorted tables (every generator and the columnar edge
+    table emit them sorted) skip the argsort + gather entirely."""
     poly_of_edge = np.asarray(poly_of_edge, np.int64)
-    order = np.argsort(poly_of_edge, kind="stable")
-    pids, counts = np.unique(poly_of_edge, return_counts=True)
+    sorted_in = bool((np.diff(poly_of_edge) >= 0).all())
+    counts_all = np.bincount(poly_of_edge)
+    pids = np.nonzero(counts_all)[0]
+    counts = counts_all[pids]
+    if sorted_in:
+        order = slice(None)
+    else:
+        order = np.argsort(poly_of_edge, kind="stable")
     padded_counts = -(-counts // EDGE_TILE) * EDGE_TILE
     total = int(padded_counts.sum())
     starts = np.concatenate([[0], np.cumsum(padded_counts)[:-1]])
@@ -83,12 +92,27 @@ def pad_polygon_edges(
     dest = np.repeat(starts, counts) + rank
     outs = []
     for arr, fill in zip((x1, y1, x2, y2), (0.0, BIG, 0.0, BIG)):
+        # x slots of degenerate edges are logically dead (the y-based
+        # crossing test gates them out) but MUST hold finite values:
+        # uninitialized garbage flowed into the f64 refine arithmetic and
+        # the f32 upload, raising overflow warnings (round-4 review)
         buf = np.full(total, fill, np.float64)
         buf[dest] = np.asarray(arr, np.float64)[order]
         outs.append(buf)
     tiles_per = padded_counts // EDGE_TILE
     poly_of_tile = np.repeat(pids, tiles_per)
     return (*outs, poly_of_tile)
+
+
+def _cumsum0(counts):
+    return np.concatenate([[0], np.cumsum(counts)[:-1]])
+
+
+def _expand_ranges(starts, counts):
+    """[sum(counts)] indices: for each i, starts[i] .. starts[i]+counts[i]."""
+    total = int(counts.sum())
+    rank = np.arange(total) - np.repeat(_cumsum0(counts), counts)
+    return np.repeat(starts, counts) + rank
 
 
 def build_pairs(
@@ -102,79 +126,113 @@ def build_pairs(
 
     Pair (T, et) survives iff bbox(poly(et)) intersects bbox(T) (expanded
     by `margin` for the f32 band) AND et y-overlaps T AND et is not
-    entirely left of T. Sorted by point tile for revisited-output
-    accumulation."""
+    entirely LEFT of T (the +x crossing ray can never reach a tile whose
+    ex1 < px0; right-side tiles must be kept — the ray points at them.
+    Round 3 had this mirrored; rings spanning >1 edge tile lost
+    crossings). Sorted by point tile for revisited-output accumulation.
+
+    Fully vectorized (round 4): the per-polygon python loop measured
+    3.9 s at 10k polygons — most of the config-2 end-to-end time. Now:
+    tiles and polygons expand into bucket-grid (cell, id) pairs, a CSR
+    over cells joins them into (polygon, tile) candidates, and the
+    per-pair prunes are flat boolean masks."""
     T = ptile_bbox.shape[0]
     E = etile_bbox.shape[0]
-    pairs_pt = []
-    pairs_et = []
-    # polygon -> its edge tiles (contiguous by construction)
-    et_of_poly = {}
-    for et, pid in enumerate(poly_of_tile):
-        et_of_poly.setdefault(int(pid), []).append(et)
+    P = poly_bbox.shape[0]
     px0, py0, px1, py1 = (ptile_bbox[:, i] for i in range(4))
-    # coarse bucket grid over point-tile bboxes: a polygon tests only the
-    # tiles registered in the cells its bbox covers (the all-tiles scan
-    # per polygon cost ~2 min at 10k polys x 131k tiles in the round-3
-    # bench). Tiles register in every cell their bbox touches, so the
-    # per-polygon candidate set is a superset of the true hits.
-    G = 128
-    gx0 = np.clip(((px0 + 180) / 360 * G).astype(int), 0, G - 1)
-    gx1 = np.clip(((px1 + 180) / 360 * G).astype(int), 0, G - 1)
-    gy0 = np.clip(((py0 + 90) / 180 * G).astype(int), 0, G - 1)
-    gy1 = np.clip(((py1 + 90) / 180 * G).astype(int), 0, G - 1)
-    cells: dict = {}
-    # tiles register in every covered cell (Z-ordered tiles overwhelmingly
-    # span one cell; seam/tail tiles span a few)
-    for t_ in range(T):
-        for cx_ in range(gx0[t_], gx1[t_] + 1):
-            for cy_ in range(gy0[t_], gy1[t_] + 1):
-                cells.setdefault((cx_, cy_), []).append(t_)
-    cells = {k: np.asarray(v) for k, v in cells.items()}
 
-    for pid, ets in et_of_poly.items():
-        bx0, by0, bx1, by1 = poly_bbox[pid]
-        # clamp BOTH ends into the grid: tiles are clipped into edge
-        # cells, so an out-of-domain polygon bbox must still query them
-        # (one-sided clamping silently dropped such polygons — review)
-        cx_lo = min(max(int((bx0 - margin + 180) / 360 * G), 0), G - 1)
-        cx_hi = max(min(int((bx1 + margin + 180) / 360 * G), G - 1), 0)
-        cy_lo = min(max(int((by0 - margin + 90) / 180 * G), 0), G - 1)
-        cy_hi = max(min(int((by1 + margin + 90) / 180 * G), G - 1), 0)
-        cand_lists = [
-            cells[(cx_, cy_)]
-            for cx_ in range(cx_lo, cx_hi + 1)
-            for cy_ in range(cy_lo, cy_hi + 1)
-            if (cx_, cy_) in cells
-        ]
-        if not cand_lists:
-            continue
-        cand = np.unique(np.concatenate(cand_lists))
-        hit = cand[
-            (px1[cand] >= bx0 - margin) & (px0[cand] <= bx1 + margin)
-            & (py1[cand] >= by0 - margin) & (py0[cand] <= by1 + margin)
-        ]
-        if not len(hit):
-            continue
-        for et in ets:
-            ex0, ey0, ex1, ey1 = etile_bbox[et]
-            # x-prune: drop edge tiles entirely LEFT of the point tile
-            # (ex1 < px0): the +x crossing ray can never reach them. Tiles
-            # to the RIGHT must be kept — the ray points at them. (The
-            # round-3 code had this mirrored, dropping right-side tiles;
-            # any ring spanning >1 edge tile lost crossings.)
-            keep = hit[
-                (py1[hit] >= ey0 - margin) & (py0[hit] <= ey1 + margin)
-                & (px0[hit] <= ex1 + margin)
-            ]
-            pairs_pt.append(keep)
-            pairs_et.append(np.full(len(keep), et, np.int64))
-    if pairs_pt:
-        pt = np.concatenate(pairs_pt)
-        et = np.concatenate(pairs_et)
-    else:
-        pt = np.zeros(0, np.int64)
-        et = np.zeros(0, np.int64)
+    empty = PairList(np.zeros(0, np.int32), np.zeros(0, np.int32),
+                     np.ones(0, np.int32), np.zeros(T, bool), T, E)
+    if T == 0 or E == 0 or P == 0:
+        return empty
+
+    # ---- bucket grid CSR: cell -> point tiles (tiles register in every
+    # cell their bbox touches; Z-ordered tiles overwhelmingly span one)
+    G = 128
+    gx0 = np.clip(((px0 + 180) / 360 * G).astype(np.int64), 0, G - 1)
+    gx1 = np.clip(((px1 + 180) / 360 * G).astype(np.int64), 0, G - 1)
+    gy0 = np.clip(((py0 + 90) / 180 * G).astype(np.int64), 0, G - 1)
+    gy1 = np.clip(((py1 + 90) / 180 * G).astype(np.int64), 0, G - 1)
+    w = gx1 - gx0 + 1
+    h = gy1 - gy0 + 1
+    reps = w * h
+    tid = np.repeat(np.arange(T), reps)
+    rank = np.arange(int(reps.sum())) - np.repeat(_cumsum0(reps), reps)
+    wrep = np.repeat(w, reps)
+    cell = ((np.repeat(gx0, reps) + rank % wrep) * G
+            + np.repeat(gy0, reps) + rank // wrep)
+    order = np.argsort(cell, kind="stable")
+    cell_s, tile_s = cell[order], tid[order]
+    cell_lo = np.searchsorted(cell_s, np.arange(G * G))
+    cell_hi = np.searchsorted(cell_s, np.arange(G * G) + 1)
+
+    # ---- polygons -> covered cells (both ends clamped INTO the grid so
+    # out-of-domain bboxes still query the edge cells — round-3 review)
+    bx0, by0, bx1, by1 = (poly_bbox[:, i] for i in range(4))
+    cx_lo = np.minimum(
+        np.maximum(((bx0 - margin + 180) / 360 * G).astype(np.int64), 0),
+        G - 1)
+    cx_hi = np.maximum(
+        np.minimum(((bx1 + margin + 180) / 360 * G).astype(np.int64), G - 1),
+        0)
+    cy_lo = np.minimum(
+        np.maximum(((by0 - margin + 90) / 180 * G).astype(np.int64), 0),
+        G - 1)
+    cy_hi = np.maximum(
+        np.minimum(((by1 + margin + 90) / 180 * G).astype(np.int64), G - 1),
+        0)
+    pw = cx_hi - cx_lo + 1
+    ph = cy_hi - cy_lo + 1
+    preps = pw * ph
+    pid_c = np.repeat(np.arange(P), preps)
+    prank = np.arange(int(preps.sum())) - np.repeat(_cumsum0(preps), preps)
+    pwrep = np.repeat(pw, preps)
+    pcell = ((np.repeat(cx_lo, preps) + prank % pwrep) * G
+             + np.repeat(cy_lo, preps) + prank // pwrep)
+
+    # ---- CSR join: (polygon, cell) -> candidate (polygon, tile)
+    cnt = cell_hi[pcell] - cell_lo[pcell]
+    if cnt.sum() == 0:
+        return empty
+    cand_poly = np.repeat(pid_c, cnt)
+    cand_tile = tile_s[_expand_ranges(cell_lo[pcell], cnt)]
+    # dedupe (a tile can reach one polygon through several cells)
+    key = np.unique(cand_poly.astype(np.int64) * T + cand_tile)
+    cand_poly = (key // T).astype(np.int64)
+    cand_tile = (key % T).astype(np.int64)
+
+    # ---- polygon-bbox x tile-bbox filter
+    hit = (
+        (px1[cand_tile] >= bx0[cand_poly] - margin)
+        & (px0[cand_tile] <= bx1[cand_poly] + margin)
+        & (py1[cand_tile] >= by0[cand_poly] - margin)
+        & (py0[cand_tile] <= by1[cand_poly] + margin)
+    )
+    cand_poly, cand_tile = cand_poly[hit], cand_tile[hit]
+    if not len(cand_poly):
+        return empty
+
+    # ---- expand each surviving (polygon, tile) over the polygon's edge
+    # tiles (contiguous in poly_of_tile by construction: pad_polygon_edges
+    # emits pid-sorted tiles)
+    et_lo = np.searchsorted(poly_of_tile, cand_poly, side="left")
+    et_hi = np.searchsorted(poly_of_tile, cand_poly, side="right")
+    ecnt = et_hi - et_lo
+    pair_pt = np.repeat(cand_tile, ecnt)
+    pair_et = _expand_ranges(et_lo, ecnt)
+
+    # ---- per-pair y-overlap + not-entirely-left prune (degenerate-only
+    # tiles carry +-inf bboxes and fail the y test)
+    ex1b = etile_bbox[pair_et, 2]
+    ey0b = etile_bbox[pair_et, 1]
+    ey1b = etile_bbox[pair_et, 3]
+    keep = (
+        (py1[pair_pt] >= ey0b - margin) & (py0[pair_pt] <= ey1b + margin)
+        & (px0[pair_pt] <= ex1b + margin)
+    )
+    pt = pair_pt[keep]
+    et = pair_et[keep]
+
     order = np.argsort(pt, kind="stable")
     pt, et = pt[order], et[order]
     first = np.ones(len(pt), np.int32)
@@ -247,53 +305,59 @@ def _sparse_band_kernel(pt_ref, et_ref, px_ref, py_ref,
         out_ref.shape)
 
 
-def _grouped_kernel(etab_ref, px_ref, py_ref, x1_ref, y1_ref,
-                    x2_ref, y2_ref, out_ref, band_ref, *, eps: float):
-    """Grid (tiles, cap): program (i, j) folds edge tile etab[i, j] into
-    point tile i's accumulators (crossing counts AND band flags in ONE
-    pass — two passes doubled the per-program DMA bill). Point/out blocks
-    are indexed by pure arithmetic (i, 0, 0) — provably revisited across
-    j, so they stay in VMEM and only the edge fetch pays a per-program
-    DMA. (The pair-list kernel's scalar-driven out/point maps forced a
-    write-back + refetch EVERY program: measured ~90 us/program on v5e —
-    ~100x the arithmetic.)"""
-    import jax.experimental.pallas as pl
+def _make_multi_kernel(e_per: int, eps: float):
+    """Grid (tiles, cap/e_per): program (i, j) folds E_PER edge tiles
+    into point tile i's accumulators in ONE program. Each edge tile is a
+    SEPARATE scalar-indexed operand, so Mosaic issues their DMAs
+    concurrently — the round-3 one-tile-per-program kernel paid ~15 us
+    of edge-DMA latency per ~1 MFLOP program (BASELINE.md round-3 gap
+    analysis); e_per tiles amortize it e_per-fold."""
 
-    j = pl.program_id(1)
+    def _kernel(etab_ref, px_ref, py_ref, *refs):
+        import jax.experimental.pallas as pl
 
-    @pl.when(j == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
-        band_ref[...] = jnp.zeros_like(band_ref)
+        out_ref, band_ref = refs[-2], refs[-1]
+        j = pl.program_id(1)
 
-    px = px_ref[0]
-    py = py_ref[0]
-    x1 = x1_ref[0].reshape(EDGE_TILE, 1)
-    y1 = y1_ref[0].reshape(EDGE_TILE, 1)
-    x2 = x2_ref[0].reshape(EDGE_TILE, 1)
-    y2 = y2_ref[0].reshape(EDGE_TILE, 1)
-    crossing, flag = _crossing_and_band(px, py, x1, y1, x2, y2, eps)
-    out_ref[...] += jnp.sum(crossing.astype(jnp.int32), axis=0).reshape(
-        out_ref.shape)
-    band_ref[...] += jnp.sum(flag.astype(jnp.int32), axis=0).reshape(
-        band_ref.shape)
+        @pl.when(j == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+            band_ref[...] = jnp.zeros_like(band_ref)
+
+        px = px_ref[0]
+        py = py_ref[0]
+        for e in range(e_per):
+            x1 = refs[4 * e][0].reshape(EDGE_TILE, 1)
+            y1 = refs[4 * e + 1][0].reshape(EDGE_TILE, 1)
+            x2 = refs[4 * e + 2][0].reshape(EDGE_TILE, 1)
+            y2 = refs[4 * e + 3][0].reshape(EDGE_TILE, 1)
+            crossing, flag = _crossing_and_band(px, py, x1, y1, x2, y2, eps)
+            out_ref[...] += jnp.sum(
+                crossing.astype(jnp.int32), axis=0).reshape(out_ref.shape)
+            band_ref[...] += jnp.sum(
+                flag.astype(jnp.int32), axis=0).reshape(band_ref.shape)
+
+    return _kernel
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cap", "n_etiles", "eps", "interpret"),
+    static_argnames=("cap", "n_etiles", "eps", "interpret", "e_per"),
 )
 def _pip_grouped_call(
     px_cov, py_cov, x1, y1, x2, y2, etab,
-    cap: int, n_etiles: int, eps: float, interpret: bool,
+    cap: int, n_etiles: int, eps: float, interpret: bool, e_per: int = 8,
 ):
     """One capacity class: [Tc] gathered point tiles x up to `cap` edge
     tiles each (etab [Tc, cap] i32; entries == n_etiles hit the appended
     all-degenerate dummy tile — the caller appends it ONCE per query).
+    cap must be a multiple of e_per (callers pad etab with the dummy).
     Returns (counts [Tc, POINT_TILE], band [Tc, POINT_TILE])."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    e_per = min(e_per, cap)
+    assert cap % e_per == 0, (cap, e_per)
     dt = jnp.float32
     tc = px_cov.shape[0]
     pxp = px_cov.astype(dt).reshape(tc, 1, POINT_TILE)
@@ -304,26 +368,34 @@ def _pip_grouped_call(
     f2 = y2.astype(dt).reshape(-1, 1, EDGE_TILE)
 
     point_block = pl.BlockSpec((1, 1, POINT_TILE), lambda i, j, et: (i, 0, 0))
-    edge_block = pl.BlockSpec(
-        (1, 1, EDGE_TILE), lambda i, j, et: (et[i, j], 0, 0)
-    )
+
+    def edge_block(e):
+        return pl.BlockSpec(
+            (1, 1, EDGE_TILE),
+            lambda i, j, et, e=e: (et[i, j * e_per + e], 0, 0),
+        )
+
     out_block = pl.BlockSpec((1, 1, POINT_TILE), lambda i, j, et: (i, 0, 0))
     out_shape = jax.ShapeDtypeStruct((tc, 1, POINT_TILE), jnp.int32)
 
+    edge_specs = []
+    edge_args = []
+    for e in range(e_per):
+        edge_specs.extend([edge_block(e)] * 4)
+        edge_args.extend([e1, f1, e2, f2])
+
     with jax.enable_x64(False):
         counts, band = pl.pallas_call(
-            functools.partial(_grouped_kernel, eps=eps),
+            _make_multi_kernel(e_per, eps),
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1,
-                grid=(tc, cap),
-                in_specs=[point_block, point_block,
-                          edge_block, edge_block,
-                          edge_block, edge_block],
+                grid=(tc, cap // e_per),
+                in_specs=[point_block, point_block] + edge_specs,
                 out_specs=(out_block, out_block),
             ),
             out_shape=(out_shape, out_shape),
             interpret=interpret,
-        )(etab, pxp, pyp, e1, f1, e2, f2)
+        )(etab, pxp, pyp, *edge_args)
     return counts.reshape(tc, POINT_TILE), band.reshape(tc, POINT_TILE)
 
 
@@ -427,6 +499,393 @@ def pip_layer_grouped(
                 out_c = out_c.at[jid].add(cc)
                 out_b = out_b.at[jid].add(bb)
     return out_c.reshape(-1), out_b.reshape(-1)
+
+
+def _make_assign_kernel(e_per: int, eps: float):
+    """Per-POLYGON parity (the relation-join kernel): like the union
+    kernel, but a running per-point crossing accumulator FLUSHES at each
+    polygon boundary (pinfo slot < 0), adding parity * (pid+1) into the
+    assignment and parity into the containment count. For a disjoint
+    layer, assignment-1 is exactly the containing polygon id (or -1).
+    Requires each row's pairs grouped contiguously by polygon — the
+    pair list is built that way (build_pairs expands polygon-major)."""
+
+    def _kernel(etab_ref, pinfo_ref, px_ref, py_ref, *refs):
+        import jax.experimental.pallas as pl
+
+        assign_ref, count_ref, band_ref, cur_ref = refs[-4:]
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            assign_ref[...] = jnp.zeros_like(assign_ref)
+            count_ref[...] = jnp.zeros_like(count_ref)
+            band_ref[...] = jnp.zeros_like(band_ref)
+            cur_ref[...] = jnp.zeros_like(cur_ref)
+
+        px = px_ref[0]
+        py = py_ref[0]
+        for e in range(e_per):
+            x1 = refs[4 * e][0].reshape(EDGE_TILE, 1)
+            y1 = refs[4 * e + 1][0].reshape(EDGE_TILE, 1)
+            x2 = refs[4 * e + 2][0].reshape(EDGE_TILE, 1)
+            y2 = refs[4 * e + 3][0].reshape(EDGE_TILE, 1)
+            crossing, flag = _crossing_and_band(px, py, x1, y1, x2, y2, eps)
+            cur_ref[...] += jnp.sum(
+                crossing.astype(jnp.int32), axis=0).reshape(cur_ref.shape)
+            band_ref[...] += jnp.sum(
+                flag.astype(jnp.int32), axis=0).reshape(band_ref.shape)
+            info = pinfo_ref[i, j * e_per + e]
+
+            @pl.when(info < 0)
+            def _flush(info=info):
+                parity = cur_ref[...] & 1
+                assign_ref[...] += parity * (-info)
+                count_ref[...] += parity
+                cur_ref[...] = jnp.zeros_like(cur_ref)
+
+    return _kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cap", "n_etiles", "eps", "interpret", "e_per"),
+)
+def _pip_assign_call(
+    px_cov, py_cov, x1, y1, x2, y2, etab, pinfo,
+    cap: int, n_etiles: int, eps: float, interpret: bool, e_per: int = 8,
+):
+    """Assignment-mode capacity class (see _make_assign_kernel). Returns
+    (assign, count, band) each [Tc, POINT_TILE] i32. `pinfo[i, j]` is
+    pid+1 of the pair's polygon, NEGATED on the last slot of that
+    polygon's run in row i, 0 for dummy padding."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    e_per = min(e_per, cap)
+    assert cap % e_per == 0, (cap, e_per)
+    dt = jnp.float32
+    tc = px_cov.shape[0]
+    pxp = px_cov.astype(dt).reshape(tc, 1, POINT_TILE)
+    pyp = py_cov.astype(dt).reshape(tc, 1, POINT_TILE)
+    e1 = x1.astype(dt).reshape(-1, 1, EDGE_TILE)
+    f1 = y1.astype(dt).reshape(-1, 1, EDGE_TILE)
+    e2 = x2.astype(dt).reshape(-1, 1, EDGE_TILE)
+    f2 = y2.astype(dt).reshape(-1, 1, EDGE_TILE)
+
+    point_block = pl.BlockSpec(
+        (1, 1, POINT_TILE), lambda i, j, et, pi: (i, 0, 0))
+
+    def edge_block(e):
+        return pl.BlockSpec(
+            (1, 1, EDGE_TILE),
+            lambda i, j, et, pi, e=e: (et[i, j * e_per + e], 0, 0),
+        )
+
+    out_block = pl.BlockSpec(
+        (1, 1, POINT_TILE), lambda i, j, et, pi: (i, 0, 0))
+    out_shape = jax.ShapeDtypeStruct((tc, 1, POINT_TILE), jnp.int32)
+
+    edge_specs = []
+    edge_args = []
+    for e in range(e_per):
+        edge_specs.extend([edge_block(e)] * 4)
+        edge_args.extend([e1, f1, e2, f2])
+
+    with jax.enable_x64(False):
+        assign, count, band, _cur = pl.pallas_call(
+            _make_assign_kernel(e_per, eps),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(tc, cap // e_per),
+                in_specs=[point_block, point_block] + edge_specs,
+                out_specs=(out_block, out_block, out_block, out_block),
+            ),
+            out_shape=(out_shape,) * 4,
+            interpret=interpret,
+        )(etab, pinfo, pxp, pyp, *edge_args)
+    return (assign.reshape(tc, POINT_TILE), count.reshape(tc, POINT_TILE),
+            band.reshape(tc, POINT_TILE))
+
+
+def pip_layer_assign(
+    px_np: np.ndarray,
+    py_np: np.ndarray,
+    x1: np.ndarray,
+    y1: np.ndarray,
+    x2: np.ndarray,
+    y2: np.ndarray,
+    poly_of_edge: np.ndarray,
+    eps: float = 1e-4,
+    interpret: bool = False,
+    refine_f64: bool = True,
+    prep: "LayerPrep | None" = None,
+    poly_of_tile: "np.ndarray | None" = None,
+) -> Tuple[np.ndarray, np.ndarray, dict]:
+    """Point -> polygon ASSIGNMENT over the layer (the relation-join /
+    JoinProcess result shape, SURVEY.md:382-383, 415): returns
+    (poly_id [N] int32 — containing polygon id, -1 outside every polygon,
+    count [N] int32 — how many polygons contain the point (==1 for
+    disjoint layers; >1 reveals overlap, where poly_id is a sum and NOT
+    a valid id), info dict). Band-flagged points are re-evaluated in f64
+    per candidate polygon on the host (exact assignment)."""
+    n = len(px_np)
+    if prep is None:
+        prep = prepare_layer(px_np, py_np, x1, y1, x2, y2, poly_of_edge)
+    pl_ = prep.pairs
+    n_ptiles, n_etiles = prep.n_ptiles, prep.n_etiles
+    if len(pl_.pair_pt) == 0:
+        return (np.full(n, -1, np.int32), np.zeros(n, np.int32),
+                {"pairs": 0, "refined": 0})
+
+    import jax.numpy as _jnp
+    from geomesa_tpu.utils.padding import next_pow2 as _np2
+
+    # polygon of each edge tile, reconstructed the way pad_polygon_edges
+    # laid the table out (pid-sorted, per-polygon padded counts) —
+    # callers holding one (pip_layer_join) pass it in
+    if poly_of_tile is None:
+        poly_of_tile = _poly_of_tile_from(prep, poly_of_edge)
+
+    pt_np = np.asarray(pl_.pair_pt, np.int64)
+    et_np = np.asarray(pl_.pair_et, np.int64)
+    pid_np = poly_of_tile[et_np]
+    # group each row's pairs by polygon (they are already polygon-major
+    # from build_pairs; a stable (pt, pid) sort makes it unconditional)
+    order = np.lexsort((pid_np, pt_np))
+    pt_np, et_np, pid_np = pt_np[order], et_np[order], pid_np[order]
+    # flush marker: last slot of each (tile, polygon) run
+    last = np.ones(len(pt_np), bool)
+    last[:-1] = (pt_np[1:] != pt_np[:-1]) | (pid_np[1:] != pid_np[:-1])
+    pinfo_val = np.where(last, -(pid_np + 1), pid_np + 1).astype(np.int32)
+
+    tiles, counts = np.unique(pt_np, return_counts=True)
+    starts = _cumsum0(counts)
+    pxt = _jnp.asarray(prep.pxp).reshape(n_ptiles, POINT_TILE)
+    pyt = _jnp.asarray(prep.pyp).reshape(n_ptiles, POINT_TILE)
+    out_a = np.zeros((n_ptiles, POINT_TILE), np.int32)
+    out_n = np.zeros((n_ptiles, POINT_TILE), np.int32)
+    out_b = np.zeros((n_ptiles, POINT_TILE), np.int32)
+    dt32 = _jnp.float32
+    ax1 = _jnp.concatenate([_jnp.asarray(prep.ex1, dt32),
+                            _jnp.zeros(EDGE_TILE, dt32)])
+    ay1 = _jnp.concatenate([_jnp.asarray(prep.ey1, dt32),
+                            _jnp.full(EDGE_TILE, BIG, dt32)])
+    ax2 = _jnp.concatenate([_jnp.asarray(prep.ex2, dt32),
+                            _jnp.zeros(EDGE_TILE, dt32)])
+    ay2 = _jnp.concatenate([_jnp.asarray(prep.ey2, dt32),
+                            _jnp.full(EDGE_TILE, BIG, dt32)])
+
+    host_rows = []
+    split = 16
+    for sel in (np.nonzero(counts <= split)[0],
+                np.nonzero(counts > split)[0]):
+        if not len(sel):
+            continue
+        cap_c = max(_np2(int(max(counts[sel].max(), 1))), 4)
+        if cap_c > MAX_ETAB_SLOTS:
+            # assignment cannot split a row across calls (the running
+            # parity would be lost between them): rows this dense are
+            # evaluated exactly on the host instead
+            over = sel[counts[sel] > MAX_ETAB_SLOTS]
+            host_rows.extend(tiles[over].tolist())
+            sel = sel[counts[sel] <= MAX_ETAB_SLOTS]
+            if not len(sel):
+                continue
+            cap_c = max(_np2(int(max(counts[sel].max(), 1))), 4)
+        etab = np.full((len(sel), cap_c), n_etiles, np.int32)
+        pinf = np.zeros((len(sel), cap_c), np.int32)
+        cnt_s = counts[sel]
+        row_of = np.repeat(np.arange(len(sel)), cnt_s)
+        col_of = (np.arange(cnt_s.sum()) - np.repeat(_cumsum0(cnt_s), cnt_s))
+        src = np.repeat(starts[sel], cnt_s) + col_of
+        etab[row_of, col_of] = et_np[src]
+        pinf[row_of, col_of] = pinfo_val[src]
+        ptids = tiles[sel]
+        per_call = max(1, MAX_ETAB_SLOTS // max(cap_c, 32))
+        for c0 in range(0, len(sel), per_call):
+            c1 = min(c0 + per_call, len(sel))
+            ids = ptids[c0:c1]
+            tab = np.ascontiguousarray(etab[c0:c1])
+            pin = np.ascontiguousarray(pinf[c0:c1])
+            tc_pad = max(_np2(len(ids)), 8) - len(ids)
+            if tc_pad:
+                ids = np.concatenate([ids, np.full(tc_pad, ids[0], ids.dtype)])
+                tab = np.concatenate(
+                    [tab, np.full((tc_pad, cap_c), n_etiles, np.int32)])
+                pin = np.concatenate(
+                    [pin, np.zeros((tc_pad, cap_c), np.int32)])
+            jid = _jnp.asarray(ids)
+            aa, nn, bb = _pip_assign_call(
+                _jnp.take(pxt, jid, axis=0), _jnp.take(pyt, jid, axis=0),
+                ax1, ay1, ax2, ay2,
+                _jnp.asarray(tab), _jnp.asarray(pin),
+                cap=cap_c, n_etiles=n_etiles, eps=eps, interpret=interpret,
+            )
+            la = len(ptids[c0:c1])
+            out_a[ptids[c0:c1]] = np.asarray(aa)[:la]
+            out_n[ptids[c0:c1]] = np.asarray(nn)[:la]
+            out_b[ptids[c0:c1]] = np.asarray(bb)[:la]
+
+    out_a[~pl_.covered] = 0
+    out_n[~pl_.covered] = 0
+    out_b[~pl_.covered] = 0
+    assign = out_a.reshape(-1)[:n]
+    count = out_n.reshape(-1)[:n]
+    band = out_b.reshape(-1)[:n]
+    poly_id = np.where(count == 1, assign - 1, -1).astype(np.int32)
+
+    # host-exact rows: band-flagged points (skippable via refine_f64) +
+    # tiles too dense for one call (NEVER skippable — the kernel computed
+    # nothing for them, so skipping would silently report every point of
+    # the tile as outside; round-4 review)
+    refine_idx = np.nonzero(band > 0)[0] if refine_f64 else (
+        np.zeros(0, np.int64))
+    if host_rows:
+        hr = np.concatenate([
+            np.arange(t * POINT_TILE, min((t + 1) * POINT_TILE, n))
+            for t in host_rows
+        ])
+        refine_idx = np.unique(np.concatenate([refine_idx, hr]))
+    refined = 0
+    if len(refine_idx):
+        poly_id, count = _refine_assign_f64(
+            refine_idx, poly_id, count, px_np, py_np, prep, poly_of_tile)
+        refined = len(refine_idx)
+    return poly_id, count, {
+        "pairs": int(len(pl_.pair_pt)), "refined": refined,
+        "host_rows": len(host_rows),
+        "flagged": int((band > 0).sum()),
+    }
+
+
+def _poly_of_tile_from(prep: "LayerPrep", poly_of_edge) -> np.ndarray:
+    """Reconstruct the per-edge-tile polygon ids the same way
+    pad_polygon_edges produced them (pid-sorted, padded counts)."""
+    poe = np.asarray(poly_of_edge, np.int64)
+    counts_all = np.bincount(poe)
+    pids = np.nonzero(counts_all)[0]
+    counts = counts_all[pids]
+    tiles_per = -(-counts // EDGE_TILE)
+    return np.repeat(pids, tiles_per)
+
+
+def pip_layer_join(
+    px_np: np.ndarray,
+    py_np: np.ndarray,
+    x1: np.ndarray,
+    y1: np.ndarray,
+    x2: np.ndarray,
+    y2: np.ndarray,
+    poly_of_edge: np.ndarray,
+    eps: float = 1e-4,
+    interpret: bool = False,
+    prep: "LayerPrep | None" = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Full spatial-join pair emission: returns (point_rows [M],
+    polygon_ids [M]) — one row per (point, containing polygon) pair,
+    INCLUDING multiplicity for overlapping layers (points contained in
+    k polygons emit k pairs, enumerated exactly on the host from the
+    pair list's candidates). The SQL engine's ON st_contains path."""
+    if prep is None:
+        prep = prepare_layer(px_np, py_np, x1, y1, x2, y2, poly_of_edge)
+    poly_of_tile = _poly_of_tile_from(prep, poly_of_edge)
+    poly_id, count, _info = pip_layer_assign(
+        px_np, py_np, x1, y1, x2, y2, poly_of_edge,
+        eps=eps, interpret=interpret, prep=prep,
+        poly_of_tile=poly_of_tile,
+    )
+    single = np.nonzero(count == 1)[0]
+    pt_rows = [single]
+    polys = [poly_id[single].astype(np.int64)]
+    multi = np.nonzero(count > 1)[0]
+    if len(multi):
+        mp, mpoly = _multi_assign_f64(multi, px_np, py_np, prep,
+                                      poly_of_tile)
+        pt_rows.append(mp)
+        polys.append(mpoly)
+    return np.concatenate(pt_rows), np.concatenate(polys)
+
+
+def _multi_assign_f64(idx, px_np, py_np, prep, poly_of_tile):
+    """Exact f64 enumeration of EVERY containing polygon for the given
+    points (the overlap path of pip_layer_join)."""
+    pl_ = prep.pairs
+    ex1, ey1, ex2, ey2 = prep.ex1, prep.ey1, prep.ex2, prep.ey2
+    csr_tiles, csr_starts = _tile_pair_csr(pl_)
+    out_pt = []
+    out_poly = []
+    by_tile: dict = {}
+    for i in idx:
+        by_tile.setdefault(i // POINT_TILE, []).append(i)
+    for ptid, pts in by_tile.items():
+        ets = _ets_of_tile(pl_, csr_tiles, csr_starts, int(ptid))
+        if not len(ets):
+            continue
+        pids = poly_of_tile[ets]
+        ii = np.asarray(pts)
+        pxi = px_np[ii][:, None]
+        pyi = py_np[ii][:, None]
+        for pid in np.unique(pids):
+            sl = np.concatenate([
+                np.arange(e * EDGE_TILE, (e + 1) * EDGE_TILE)
+                for e in ets[pids == pid]
+            ])
+            a1, b1 = ex1[sl], ey1[sl]
+            a2, b2 = ex2[sl], ey2[sl]
+            condx = (b1[None] <= pyi) != (b2[None] <= pyi)
+            tt = (pyi - b1[None]) / np.where(b2 == b1, 1.0, b2 - b1)[None]
+            xc = a1[None] + tt * (a2 - a1)[None]
+            inside = (np.sum(condx & (xc > pxi), 1) % 2) == 1
+            hit = ii[inside]
+            out_pt.append(hit)
+            out_poly.append(np.full(len(hit), pid, np.int64))
+    if not out_pt:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    return np.concatenate(out_pt), np.concatenate(out_poly)
+
+
+def _refine_assign_f64(idx, poly_id, count, px_np, py_np, prep,
+                       poly_of_tile):
+    """Exact f64 per-polygon parity for the given point indices, over the
+    pair list's candidate polygons of each point's tile."""
+    pl_ = prep.pairs
+    ex1, ey1, ex2, ey2 = prep.ex1, prep.ey1, prep.ex2, prep.ey2
+    csr_tiles, csr_starts = _tile_pair_csr(pl_)
+    by_tile: dict = {}
+    for i in idx:
+        by_tile.setdefault(i // POINT_TILE, []).append(i)
+    poly_id = poly_id.copy()
+    count = count.copy()
+    for ptid, pts in by_tile.items():
+        ets = _ets_of_tile(pl_, csr_tiles, csr_starts, int(ptid))
+        ii = np.asarray(pts)
+        if not len(ets):
+            poly_id[ii] = -1
+            count[ii] = 0
+            continue
+        pids = poly_of_tile[ets]
+        pxi = px_np[ii][:, None]
+        pyi = py_np[ii][:, None]
+        acc_id = np.full(len(ii), -1, np.int64)
+        acc_n = np.zeros(len(ii), np.int64)
+        for pid in np.unique(pids):
+            sl = np.concatenate([
+                np.arange(e * EDGE_TILE, (e + 1) * EDGE_TILE)
+                for e in ets[pids == pid]
+            ])
+            a1, b1 = ex1[sl], ey1[sl]
+            a2, b2 = ex2[sl], ey2[sl]
+            condx = (b1[None] <= pyi) != (b2[None] <= pyi)
+            tt = (pyi - b1[None]) / np.where(b2 == b1, 1.0, b2 - b1)[None]
+            xc = a1[None] + tt * (a2 - a1)[None]
+            inside = (np.sum(condx & (xc > pxi), 1) % 2) == 1
+            acc_id = np.where(inside, pid, acc_id)
+            acc_n += inside
+        poly_id[ii] = np.where(acc_n == 1, acc_id, -1)
+        count[ii] = acc_n
+    return poly_id, count
 
 
 @functools.partial(
@@ -586,6 +1045,24 @@ def pip_layer_sparse(
     return out_c.reshape(-1), out_b.reshape(-1)
 
 
+def _tile_pair_csr(pl_: "PairList"):
+    """CSR view of the (pt-sorted) pair list: (tiles [K], starts [K+1])
+    so tile tiles[i]'s edge tiles are pair_et[starts[i]:starts[i+1]].
+    O(K) from the precomputed `first` markers — the refine paths used to
+    rebuild a python dict by looping the ENTIRE pair list (round-4
+    review: seconds of host time at config-2 scale)."""
+    pt = np.asarray(pl_.pair_pt, np.int64)
+    s = np.nonzero(np.asarray(pl_.first))[0]
+    return pt[s], np.concatenate([s, [len(pt)]])
+
+
+def _ets_of_tile(pl_, tiles, starts, ptid: int) -> np.ndarray:
+    k = int(np.searchsorted(tiles, ptid))
+    if k >= len(tiles) or tiles[k] != ptid:
+        return np.zeros(0, np.int64)
+    return np.asarray(pl_.pair_et[starts[k]: starts[k + 1]], np.int64)
+
+
 class LayerPrep(NamedTuple):
     """Everything the sparse kernels need, host-built once per layer
     (the prepared-geometry/index analog; reused by bench.py so the bench
@@ -634,11 +1111,14 @@ def prepare_layer(
         _bb(np.maximum(ex1, ex2), False), _bb(np.maximum(ey1, ey2), False),
     ], 1)
     # per-polygon bboxes via reduceat over pid-sorted edges (the naive
-    # per-polygon masking re-scanned the edge table 10k times)
-    order = np.argsort(np.asarray(poly_of_edge, np.int64), kind="stable")
-    pids, counts = np.unique(
-        np.asarray(poly_of_edge), return_counts=True
-    )
+    # per-polygon masking re-scanned the edge table 10k times); dense
+    # bincount + sorted fast path as in pad_polygon_edges
+    poe = np.asarray(poly_of_edge, np.int64)
+    counts_all = np.bincount(poe)
+    pids = np.nonzero(counts_all)[0]
+    counts = counts_all[pids]
+    order = (slice(None) if bool((np.diff(poe) >= 0).all())
+             else np.argsort(poe, kind="stable"))
     bounds = np.concatenate([[0], np.cumsum(counts)[:-1]])
     exmin = np.minimum(x1, x2)[order]
     eymin = np.minimum(y1, y2)[order]
@@ -705,16 +1185,14 @@ def pip_layer(
     if refine_f64 and len(flagged):
         # exact f64 re-evaluation of flagged points over the SAME pair
         # candidate set, vectorized per point tile ([pts-in-tile, E] ops)
-        et_of_pt: dict = {}
-        for ptid, etid in zip(pl_.pair_pt, pl_.pair_et):
-            et_of_pt.setdefault(int(ptid), []).append(int(etid))
+        csr_tiles, csr_starts = _tile_pair_csr(pl_)
         by_tile: dict = {}
         for i in flagged:
             by_tile.setdefault(i // POINT_TILE, []).append(i)
         for ptid, idxs in by_tile.items():
-            ets = et_of_pt.get(ptid, [])
+            ets = _ets_of_tile(pl_, csr_tiles, csr_starts, ptid)
             ii = np.asarray(idxs)
-            if not ets:
+            if not len(ets):
                 inside[ii] = False
                 continue
             sl = np.concatenate(

@@ -204,9 +204,14 @@ def tube_margins(tube_y, radius_m) -> Tuple[float, float]:
     cos(lat), evaluated at the highest latitude the corridor can reach."""
     rmax = float(np.max(np.asarray(radius_m)))
     margin_lat = rmax / 110574.0 * 1.01
-    lat_reach = min(
-        89.5, float(np.max(np.abs(np.asarray(tube_y)))) + margin_lat
-    )
+    lat_max = float(np.max(np.abs(np.asarray(tube_y))))
+    # a corridor whose reach includes a pole spans EVERY longitude (a
+    # hard 89.5-deg clamp under-margined polar corridors and silently
+    # dropped true matches — round-4 review, reproduced at 89.8N)
+    pole_dist_m = max(90.0 - lat_max, 0.0) * 110574.0
+    if rmax * 1.01 >= pole_dist_m:
+        return 360.0, float(margin_lat)
+    lat_reach = lat_max + margin_lat  # provably < 90 here
     margin_lon = min(
         360.0,
         rmax / (111320.0 * np.cos(np.radians(lat_reach))) * 1.01,

@@ -246,9 +246,40 @@ class _SqlJoinMixin:
             sides.append(new_side)
             ni = len(sides) - 1
             toks.expect_word("ON")
+            t = toks.peek()
+            if (
+                t is not None and t[0] == "word"
+                and t[1].lower() in _SPATIAL_JOIN_FNS
+                and toks.peek(1) == ("punct", "(")
+            ):
+                # spatial join: ON st_contains(polys.geom, points.geom) /
+                # st_within(points.geom, polys.geom) / st_intersects(...)
+                # — executed by the polygon-layer assignment kernel
+                # (engine.pip_sparse.pip_layer_join), relation-join parity
+                fn = toks.next()[1].lower()
+                toks.expect_punct("(")
+                s_a, c_a = _resolve(sides, toks.next()[1])
+                toks.expect_punct(",")
+                s_b, c_b = _resolve(sides, toks.next()[1])
+                toks.expect_punct(")")
+                ia, ib = sides.index(s_a), sides.index(s_b)
+                if ia == ib:
+                    raise SqlError("JOIN ON must reference two tables")
+                if ni not in (ia, ib):
+                    raise SqlError(
+                        "JOIN ON must reference the table being joined")
+                poly_si = _spatial_poly_side(fn, sides, (ia, c_a), (ib, c_b))
+                # 4-tuple marks a spatial step (kind, prior, new, poly_si)
+                if ib == ni:
+                    steps.append((kind, (ia, c_a), (ib, c_b), poly_si))
+                else:
+                    steps.append((kind, (ib, c_b), (ia, c_a), poly_si))
+                continue
             s_a, c_a = _resolve(sides, toks.next()[1])
             if toks.next() != ("op", "="):
-                raise SqlError("JOIN ON supports equality only")
+                raise SqlError(
+                    "JOIN ON supports equality or "
+                    "st_contains/st_within/st_intersects")
             s_b, c_b = _resolve(sides, toks.next()[1])
             ia, ib = sides.index(s_a), sides.index(s_b)
             if ia == ib:
@@ -353,7 +384,8 @@ class _SqlJoinMixin:
         # keys + that side's selected columns (no host residuals in JOIN
         # WHERE, so the needed set is statically known)
         key_cols: dict = {}  # si -> set of join-key column names
-        for _, (ia, ca), (ib, cb) in steps:
+        for step in steps:
+            _, (ia, ca), (ib, cb) = step[:3]
             key_cols.setdefault(ia, set()).add(ca)
             key_cols.setdefault(ib, set()).add(cb)
         batches = []
@@ -601,6 +633,61 @@ def _equi_join_indices_keys(ka, kb):
 NULL_I64 = np.iinfo(np.int64).min
 
 
+_SPATIAL_JOIN_FNS = ("st_contains", "st_within", "st_intersects")
+_POLY_KINDS = ("Polygon", "MultiPolygon")
+
+
+def _spatial_poly_side(fn: str, sides, a, b) -> int:
+    """Which side index is the POLYGON side of a spatial join predicate
+    (validating the polygon/point geometry kinds)."""
+
+    def kind_of(si, col):
+        attr = sides[si].sft.attribute(col)
+        if not attr.is_geometry:
+            raise SqlError(f"{col!r} is not a geometry column")
+        return attr.type
+
+    ta, tb = kind_of(*a), kind_of(*b)
+    if fn == "st_contains":     # contains(container, contained)
+        poly, pt = a, b
+    elif fn == "st_within":     # within(contained, container)
+        poly, pt = b, a
+    else:                       # st_intersects: kind decides
+        if ta in _POLY_KINDS and tb == "Point":
+            poly, pt = a, b
+        elif tb in _POLY_KINDS and ta == "Point":
+            poly, pt = b, a
+        else:
+            raise SqlError(
+                "st_intersects join needs one polygon-kind side and one "
+                f"point side (got {ta}, {tb})")
+    if kind_of(*poly) not in _POLY_KINDS or kind_of(*pt) != "Point":
+        raise SqlError(
+            f"{fn} join needs a polygon-kind and a point geometry "
+            f"(got {kind_of(*poly)}, {kind_of(*pt)})")
+    return poly[0]
+
+
+def _spatial_pairs(poly_batch, poly_col, pt_batch, pt_col):
+    """(polygon_rows, point_rows) containment pairs via the polygon-layer
+    assignment kernel (f64 band refinement; overlap multiplicity exact)."""
+    from geomesa_tpu.engine.knn_scan import default_interpret
+    from geomesa_tpu.engine.pip_sparse import pip_layer_join
+
+    if len(poly_batch) == 0 or len(pt_batch) == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    et = poly_batch.columns[poly_col].edge_table()
+    pc = pt_batch.columns[pt_col]
+    pt_rows, poly_rows = pip_layer_join(
+        np.asarray(pc.x, np.float64), np.asarray(pc.y, np.float64),
+        np.asarray(et.x1, np.float64), np.asarray(et.y1, np.float64),
+        np.asarray(et.x2, np.float64), np.asarray(et.y2, np.float64),
+        np.asarray(et.efeat, np.int64),
+        interpret=default_interpret(),
+    )
+    return poly_rows.astype(np.int64), pt_rows.astype(np.int64)
+
+
 def _run_join_steps(batches, steps):
     """Execute the left-deep join plan -> per-side row-index arrays
     (length = result rows; -1 marks a null-extended outer row)."""
@@ -609,25 +696,44 @@ def _run_join_steps(batches, steps):
     n0 = len(batches[0]) if batches[0] is not None else 0
     rowidx[0] = np.arange(n0, dtype=np.int64)
     joined = {0}
-    for kind, (ia, ca), (ib, cb) in steps:
+    for step in steps:
+        kind, (ia, ca), (ib, cb) = step[:3]
         if ia not in joined:  # pragma: no cover - parser guarantees order
             raise SqlError("join step references an unjoined table")
-        # key values for the CURRENT result rows (null rows never match)
-        ka_full = _key_array(batches[ia], ca)
         sel = rowidx[ia]
-        if len(ka_full) == 0:  # empty side: every current row is null-keyed
-            ka_full = np.full(1, np.nan)
-        ka = ka_full[np.clip(sel, 0, len(ka_full) - 1)]
-        null_row = sel < 0
-        if ka.dtype.kind == "f":
-            ka = np.where(null_row, np.nan, ka)
-        elif ka.dtype.kind in "UO":
-            ka = np.where(null_row, "\x00missing", ka)
+        if len(step) == 4:
+            # spatial step: RAW-row containment pairs from the polygon-
+            # layer kernel, then the same composite-row machinery with
+            # the prior side's ROW INDEX as the join key
+            poly_si = step[3]
+            if poly_si == ia:
+                prow, trow = _spatial_pairs(batches[ia], ca,
+                                            batches[ib], cb)
+                pair_a, pair_b = prow, trow
+            else:
+                prow, trow = _spatial_pairs(batches[ib], cb,
+                                            batches[ia], ca)
+                pair_a, pair_b = trow, prow
+            ka = np.where(sel < 0, NULL_I64, sel)
+            li, pi = _equi_join_indices_keys(ka, pair_a)
+            ri = pair_b[pi]
         else:
-            ka = np.where(null_row, NULL_I64, ka)
-            # integer sentinel could collide with real data only at
-            # INT64_MIN — not a representable Date/Long in practice
-        li, ri = _equi_join_indices_keys(ka, _key_array(batches[ib], cb))
+            # key values for the CURRENT result rows (null rows never
+            # match)
+            ka_full = _key_array(batches[ia], ca)
+            if len(ka_full) == 0:  # empty side: every row is null-keyed
+                ka_full = np.full(1, np.nan)
+            ka = ka_full[np.clip(sel, 0, len(ka_full) - 1)]
+            null_row = sel < 0
+            if ka.dtype.kind == "f":
+                ka = np.where(null_row, np.nan, ka)
+            elif ka.dtype.kind in "UO":
+                ka = np.where(null_row, "\x00missing", ka)
+            else:
+                ka = np.where(null_row, NULL_I64, ka)
+                # integer sentinel could collide with real data only at
+                # INT64_MIN — not a representable Date/Long in practice
+            li, ri = _equi_join_indices_keys(ka, _key_array(batches[ib], cb))
         out = []
         for si in range(n_sides):
             if si == ib:

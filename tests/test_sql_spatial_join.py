@@ -1,0 +1,144 @@
+"""SQL spatial join (JOIN ... ON st_contains/st_within/st_intersects)
+end-to-end over on-disk stores, vs a f64 all-edges containment oracle."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.core.columnar import FeatureBatch, Geometry
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.plan.datastore import DataStore
+from geomesa_tpu.sql.engine import SqlContext, SqlError
+
+
+def ring(cx, cy, r, ne=24, reverse=False):
+    th = np.linspace(0, 2 * np.pi, ne, endpoint=False)
+    if reverse:
+        th = th[::-1]
+    pts = np.stack([cx + r * np.cos(th), cy + r * np.sin(th)], 1)
+    return np.concatenate([pts, pts[:1]])
+
+
+@pytest.fixture()
+def stores(tmp_path):
+    rng = np.random.default_rng(41)
+    rsft = SimpleFeatureType.from_spec("regions", "name:String,*geom:Polygon")
+    centers = [(-20.0, -10.0), (0.0, 15.0), (25.0, -5.0), (40.0, 20.0)]
+    polys = [Geometry("Polygon", [ring(cx, cy, 8.0)]) for cx, cy in centers]
+    # region 1 gets a hole (points inside it must NOT join)
+    polys[1] = Geometry(
+        "Polygon", [ring(0.0, 15.0, 8.0), ring(0.0, 15.0, 3.0, reverse=True)]
+    )
+    regions = FeatureBatch.from_pydict(
+        rsft,
+        {"name": [f"r{i}" for i in range(len(polys))], "geom": polys},
+    )
+    esft = SimpleFeatureType.from_spec("events", "val:Double,*geom:Point")
+    n = 4000
+    px = np.sort(rng.uniform(-40, 60, n))
+    py = rng.uniform(-30, 40, n)
+    events = FeatureBatch.from_pydict(
+        esft, {"val": rng.uniform(0, 10, n), "geom": np.stack([px, py], 1)}
+    )
+    ds = DataStore(str(tmp_path / "cat"))
+    ds.create_schema(rsft).write(regions)
+    ds.create_schema(esft).write(events)
+    return ds, centers, polys
+
+
+def oracle_assign(polys, px, py):
+    """[N] region row containing each point (-1 none), f64 all edges."""
+    out = np.full(len(px), -1, np.int64)
+    for i, g in enumerate(polys):
+        inside = np.zeros(len(px), bool)
+        cross = np.zeros(len(px), np.int64)
+        for rg in g.rings:
+            a = np.asarray(rg)
+            x1, y1 = a[:-1, 0], a[:-1, 1]
+            x2, y2 = a[1:, 0], a[1:, 1]
+            condx = (y1[None] <= py[:, None]) != (y2[None] <= py[:, None])
+            t = (py[:, None] - y1[None]) / np.where(
+                y2 == y1, 1.0, y2 - y1)[None]
+            xc = x1[None] + t * (x2 - x1)[None]
+            cross += np.sum(condx & (xc > px[:, None]), 1)
+        inside = (cross % 2) == 1
+        out[inside] = i
+    return out
+
+
+class TestSqlSpatialJoin:
+    def test_st_contains_assignment(self, stores):
+        ds, centers, polys = stores
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT e.val AS val, r.name AS region FROM events e "
+            "JOIN regions r ON st_contains(r.geom, e.geom)"
+        )
+        ev = ds.get_feature_source("events").get_features().features
+        g = ev.columns["geom"]
+        exp = oracle_assign(polys, np.asarray(g.x), np.asarray(g.y))
+        assert r.count == int((exp >= 0).sum())
+        # every joined row names the oracle's region for its point: match
+        # multisets of (region name) counts
+        got_names = list(r.features.columns["region"].decode())
+        import collections
+
+        exp_names = collections.Counter(
+            f"r{i}" for i in exp[exp >= 0])
+        assert collections.Counter(got_names) == exp_names
+
+    def test_st_within_and_intersects_equivalent(self, stores):
+        ds, centers, polys = stores
+        ctx = SqlContext(ds)
+        base = ctx.sql(
+            "SELECT e.val AS val, r.name AS region FROM events e "
+            "JOIN regions r ON st_contains(r.geom, e.geom)")
+        w = ctx.sql(
+            "SELECT e.val AS val, r.name AS region FROM events e "
+            "JOIN regions r ON st_within(e.geom, r.geom)")
+        i = ctx.sql(
+            "SELECT e.val AS val, r.name AS region FROM regions r "
+            "JOIN events e ON st_intersects(r.geom, e.geom)")
+        assert base.count == w.count == i.count
+
+    def test_left_outer_spatial(self, stores):
+        ds, centers, polys = stores
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT e.val AS val, r.name AS region FROM events e "
+            "LEFT JOIN regions r ON st_contains(r.geom, e.geom)"
+        )
+        ev = ds.get_feature_source("events").get_features().features
+        g = ev.columns["geom"]
+        exp = oracle_assign(polys, np.asarray(g.x), np.asarray(g.y))
+        # every event appears; unmatched ones carry a null region
+        assert r.count == len(ev)
+        got_names = np.asarray(list(r.features.columns["region"].decode()),
+                               dtype=object)
+        n_null = int(sum(1 for v in got_names if v is None))
+        assert n_null == int((exp < 0).sum())
+
+    def test_aggregate_over_spatial_join(self, stores):
+        ds, centers, polys = stores
+        ctx = SqlContext(ds)
+        r = ctx.sql(
+            "SELECT r.name AS region, COUNT(*) AS n FROM events e "
+            "JOIN regions r ON st_contains(r.geom, e.geom) "
+            "GROUP BY r.name ORDER BY region"
+        )
+        ev = ds.get_feature_source("events").get_features().features
+        g = ev.columns["geom"]
+        exp = oracle_assign(polys, np.asarray(g.x), np.asarray(g.y))
+        import collections
+
+        expc = collections.Counter(f"r{i}" for i in exp[exp >= 0])
+        names = list(r.features.columns["region"].decode())
+        counts = np.asarray(r.features.columns["n"])
+        assert dict(zip(names, counts.tolist())) == dict(expc)
+
+    def test_point_point_join_rejected(self, stores):
+        ds, _, _ = stores
+        ctx = SqlContext(ds)
+        with pytest.raises(SqlError, match="polygon"):
+            ctx.sql(
+                "SELECT e.val AS v FROM events e "
+                "JOIN events f ON st_intersects(e.geom, f.geom)")

@@ -84,6 +84,21 @@ class TestTubePruned:
             *args, data_tile=1024, tile_capacity=1)
         assert cap == 1 and not np.asarray(pruned).any()
 
+    def test_polar_corridor_spans_all_longitudes(self):
+        # a corridor whose radius reaches the pole matches points at ANY
+        # longitude (review repro: the old 89.5-deg clamp dropped them)
+        n = 5000
+        x = np.full(n, 100.0)
+        y = np.full(n, 89.8)
+        t = np.zeros(n, np.int64)
+        mask = np.ones(n, bool)
+        args = dev_args(x, y, t, mask, np.array([0.0]), np.array([89.8]),
+                        np.array([0], np.int64), 50_000.0, 1_000_000)
+        dense = np.asarray(tube_select(*args, data_tile=1024))
+        pruned, _ = tube_select_pruned(*args, data_tile=1024)
+        np.testing.assert_array_equal(np.asarray(pruned), dense)
+        assert dense.all()  # 34 km away: every point matches
+
     def test_f64_path(self):
         # the process path runs f64 coords through the same kernel
         x, y, t, tx, ty, tt = make(n=8_000)
