@@ -40,6 +40,14 @@ def register(sub: "argparse._SubParsersAction") -> None:
     cmd("get-type-names", "list feature types", _get_type_names, [cat])
     cmd("describe-schema", "show a feature type", _describe_schema, [cat, feat])
     cmd("remove-schema", "drop a feature type and its data", _remove_schema, [cat, feat])
+    cmd("delete-features", "delete features matching a CQL filter",
+        _delete_features, [cat, feat, cql])
+    cmd("age-off", "delete features older than an ISO instant",
+        _age_off,
+        [cat, feat,
+         (["--older-than"], {"required": True,
+                             "help": "ISO-8601 instant (e.g. "
+                                     "2020-06-01T00:00:00Z)"})])
     cmd(
         "ingest", "ingest files through a converter",
         _ingest,
@@ -169,6 +177,24 @@ def _describe_schema(args) -> int:
 def _remove_schema(args) -> int:
     _store(args).remove_schema(args.feature_name)
     print(f"removed schema {args.feature_name}")
+    return 0
+
+
+def _delete_features(args) -> int:
+    src = _store(args).get_feature_source(args.feature_name)
+    n = src.delete_features(args.cql)
+    print(f"deleted {n} features from {args.feature_name}")
+    return 0
+
+
+def _age_off(args) -> int:
+    import numpy as np
+
+    cutoff = int(np.datetime64(
+        args.older_than.replace("Z", ""), "ms").astype(np.int64))
+    src = _store(args).get_feature_source(args.feature_name)
+    n = src.age_off(cutoff)
+    print(f"aged off {n} features from {args.feature_name}")
     return 0
 
 

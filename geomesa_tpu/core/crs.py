@@ -1,0 +1,120 @@
+"""Minimal CRS registry + reprojection (round 4, VERDICT r3 #7).
+
+Parity role: the LocalQueryRunner's reprojection step (upstream
+o.l.g.index.planning.LocalQueryRunner via GeoTools ReprojectingFeature-
+Collection — SURVEY.md:219-220): a Query may request output in a CRS
+other than the store's native one, applied as a finish step on result
+geometries. The registry is deliberately small — EPSG:4326 (lon/lat
+WGS84, the engine's native frame) and EPSG:3857 (spherical web
+mercator) — with closed-form vectorized transforms; anything else
+raises. st_transform in the SQL layer shares these functions.
+
+All engine math (curves, predicates, kernels) stays in 4326; 3857 is an
+OUTPUT (or input-normalization) frame only, matching how the reference
+keeps indexing in a single CRS and reprojects at the edges.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import numpy as np
+
+R_MAJOR = 6378137.0  # spherical mercator earth radius (EPSG:3857)
+_MAX_LAT = 85.051128779806604  # atan(sinh(pi)) — 3857's latitude bound
+
+
+def _ident(x, y):
+    return np.asarray(x, np.float64), np.asarray(y, np.float64)
+
+
+def _to_mercator(x, y):
+    lon = np.asarray(x, np.float64)
+    lat = np.clip(np.asarray(y, np.float64), -_MAX_LAT, _MAX_LAT)
+    mx = np.radians(lon) * R_MAJOR
+    my = R_MAJOR * np.log(np.tan(np.pi / 4.0 + np.radians(lat) / 2.0))
+    return mx, my
+
+
+def _from_mercator(x, y):
+    mx = np.asarray(x, np.float64)
+    my = np.asarray(y, np.float64)
+    lon = np.degrees(mx / R_MAJOR)
+    lat = np.degrees(2.0 * np.arctan(np.exp(my / R_MAJOR)) - np.pi / 2.0)
+    return lon, lat
+
+
+_TRANSFORMS: Dict[Tuple[int, int], Callable] = {
+    (4326, 4326): _ident,
+    (3857, 3857): _ident,
+    (4326, 3857): _to_mercator,
+    (3857, 4326): _from_mercator,
+}
+
+
+def supported(from_srid: int, to_srid: int) -> bool:
+    return (int(from_srid), int(to_srid)) in _TRANSFORMS
+
+
+def transform(x, y, from_srid: int, to_srid: int):
+    """Vectorized coordinate transform. Raises ValueError on an
+    unregistered CRS pair (same contract as an unknown EPSG code in the
+    reference's referencing factory)."""
+    key = (int(from_srid), int(to_srid))
+    fn = _TRANSFORMS.get(key)
+    if fn is None:
+        raise ValueError(
+            f"unsupported CRS transform EPSG:{key[0]} -> EPSG:{key[1]} "
+            "(registered: 4326, 3857)"
+        )
+    return fn(x, y)
+
+
+def reproject_batch(batch, to_srid: int):
+    """Return a FeatureBatch with every geometry column transformed from
+    its attribute srid (default 4326) to `to_srid`; attribute options are
+    updated so the result self-describes its CRS. No-op (same object)
+    when every geometry is already in `to_srid`."""
+    import dataclasses
+
+    from geomesa_tpu.core.columnar import FeatureBatch, GeometryColumn
+    from geomesa_tpu.core.sft import SimpleFeatureType
+
+    changed = False
+    cols = dict(batch.columns)
+    attrs = []
+    for a in batch.sft.attributes:
+        if not a.is_geometry:
+            attrs.append(a)
+            continue
+        src = int(a.options.get("srid", 4326))
+        if src == int(to_srid):
+            attrs.append(a)
+            continue
+        changed = True
+        col = cols[a.name]
+        if col.is_point:
+            nx, ny = transform(col.x, col.y, src, to_srid)
+            cols[a.name] = GeometryColumn(col.kind, nx, ny)
+        else:
+            vx, vy = transform(
+                col.vertices[:, 0], col.vertices[:, 1], src, to_srid)
+            bx0, by0 = transform(col.bbox[:, 0], col.bbox[:, 1], src, to_srid)
+            bx1, by1 = transform(col.bbox[:, 2], col.bbox[:, 3], src, to_srid)
+            cx, cy = transform(col.x, col.y, src, to_srid)
+            cols[a.name] = GeometryColumn(
+                col.kind, cx, cy,
+                np.stack([vx, vy], 1), col.ring_offsets,
+                col.feature_rings, col.feature_parts,
+                np.stack([bx0, by0, bx1, by1], 1),
+                # mixed-kind columns keep their per-feature kind codes —
+                # dropping them re-types every feature to the column kind
+                col.feature_kinds,
+            )
+        opts = dict(a.options)
+        opts["srid"] = str(int(to_srid))
+        attrs.append(dataclasses.replace(a, options=opts))
+    if not changed:
+        return batch
+    sft = SimpleFeatureType(batch.sft.name, attrs, batch.sft.user_data)
+    return FeatureBatch(sft, cols, batch.fids, batch.valid)

@@ -43,11 +43,14 @@ MAX_LEVEL = 30
 
 
 def _uv_to_st(u):
-    """S2 quadratic projection, vectorized."""
+    """S2 quadratic projection, vectorized. Both np.where branches are
+    evaluated for every lane, so each sqrt argument is clamped at 0 —
+    the unclamped form emitted RuntimeWarning NaNs on the unselected
+    branch (u outside [-1/3, 1/3] in exactly one of them)."""
     u = np.asarray(u, np.float64)
     return np.where(
-        u >= 0, 0.5 * np.sqrt(1.0 + 3.0 * u),
-        1.0 - 0.5 * np.sqrt(1.0 - 3.0 * u),
+        u >= 0, 0.5 * np.sqrt(np.maximum(1.0 + 3.0 * u, 0.0)),
+        1.0 - 0.5 * np.sqrt(np.maximum(1.0 - 3.0 * u, 0.0)),
     )
 
 

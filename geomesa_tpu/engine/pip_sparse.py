@@ -60,28 +60,39 @@ class PairList(NamedTuple):
     n_etiles: int
 
 
+def _group_ids(ids: np.ndarray):
+    """(unique_ids, counts, order): group ANY int id array (sparse,
+    large, unsorted — the public contract; a bincount here would
+    allocate O(max id) and reject negatives, round-4 review) with an
+    O(n) run-length fast path for already-sorted input (every generator
+    and the columnar edge table emit sorted ids). `order` sorts ids
+    grouped (slice(None) when already sorted)."""
+    ids = np.asarray(ids, np.int64)
+    if bool((np.diff(ids) >= 0).all()):
+        order = slice(None)
+        s = ids
+    else:
+        order = np.argsort(ids, kind="stable")
+        s = ids[order]
+    if not len(s):
+        return s, np.zeros(0, np.int64), order
+    starts = np.concatenate([[0], np.nonzero(np.diff(s))[0] + 1])
+    counts = np.diff(np.concatenate([starts, [len(s)]]))
+    return s[starts], counts, order
+
+
 def pad_polygon_edges(
     x1, y1, x2, y2, poly_of_edge
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Pad the concatenated oriented edge table so each polygon occupies
     whole EDGE_TILE tiles (degenerate BIG edges fill the tail). Returns
-    (x1, y1, x2, y2, poly_of_tile [n_etiles]).
+    (x1, y1, x2, y2, poly_of_tile [n_etiles] — ORIGINAL polygon ids).
 
     Fully vectorized: the round-3 bench measured the per-polygon python
     loop at ~100 s over 10k polygons x 1.5M edges (each iteration scanned
-    the whole edge table); this is one (skippable) sort + one scatter.
-    bincount over dense pids replaces np.unique (~1 s at 10M edges), and
-    already-pid-sorted tables (every generator and the columnar edge
-    table emit them sorted) skip the argsort + gather entirely."""
+    the whole edge table); this is one (skippable) sort + one scatter."""
     poly_of_edge = np.asarray(poly_of_edge, np.int64)
-    sorted_in = bool((np.diff(poly_of_edge) >= 0).all())
-    counts_all = np.bincount(poly_of_edge)
-    pids = np.nonzero(counts_all)[0]
-    counts = counts_all[pids]
-    if sorted_in:
-        order = slice(None)
-    else:
-        order = np.argsort(poly_of_edge, kind="stable")
+    pids, counts, order = _group_ids(poly_of_edge)
     padded_counts = -(-counts // EDGE_TILE) * EDGE_TILE
     total = int(padded_counts.sum())
     starts = np.concatenate([[0], np.cumsum(padded_counts)[:-1]])
@@ -309,9 +320,12 @@ def _make_multi_kernel(e_per: int, eps: float):
     """Grid (tiles, cap/e_per): program (i, j) folds E_PER edge tiles
     into point tile i's accumulators in ONE program. Each edge tile is a
     SEPARATE scalar-indexed operand, so Mosaic issues their DMAs
-    concurrently — the round-3 one-tile-per-program kernel paid ~15 us
-    of edge-DMA latency per ~1 MFLOP program (BASELINE.md round-3 gap
-    analysis); e_per tiles amortize it e_per-fold."""
+    concurrently. Measured on the config-2 layer (v5e, round 4):
+    e_per=2 is the sweet spot (0.55 s vs 1.49 s at e_per=1); 4/8 regress
+    (~1.1-1.2 s — wider programs starve the double-buffering). The
+    decisive round-4 fix was pow2 capacity BUCKETS in the caller, not
+    e_per: two coarse classes let one dense tile inflate cap for
+    thousands of rows and the pallas call count dominated (6 s)."""
 
     def _kernel(etab_ref, px_ref, py_ref, *refs):
         import jax.experimental.pallas as pl
@@ -346,7 +360,7 @@ def _make_multi_kernel(e_per: int, eps: float):
 )
 def _pip_grouped_call(
     px_cov, py_cov, x1, y1, x2, y2, etab,
-    cap: int, n_etiles: int, eps: float, interpret: bool, e_per: int = 8,
+    cap: int, n_etiles: int, eps: float, interpret: bool, e_per: int = 2,
 ):
     """One capacity class: [Tc] gathered point tiles x up to `cap` edge
     tiles each (etab [Tc, cap] i32; entries == n_etiles hit the appended
@@ -405,10 +419,20 @@ def _pip_grouped_call(
 MAX_ETAB_SLOTS = 1 << 15
 
 
+def _pow2_caps(counts: np.ndarray) -> np.ndarray:
+    """pow2 capacity bucket per tile row (floor 4). Shared by the union
+    and assignment drivers: a coarse two-class scheme let one dense tile
+    inflate cap for thousands of rows, and the collapsed rows-per-call
+    made pallas dispatch count dominate (measured 6 s on the config-2
+    layer; bucketing brings total calls to ~total_slots/MAX_ETAB_SLOTS)."""
+    return np.maximum(
+        2 ** np.ceil(np.log2(np.maximum(counts, 1))).astype(np.int64), 4)
+
+
 def pip_layer_grouped(
     px, py, x1, y1, x2, y2, pair_pt, pair_et,
     n_ptiles: int = 0, n_etiles: int = 0, eps: float = 1e-4,
-    interpret: bool = False,
+    interpret: bool = False, e_per: int = 2,
 ):
     """Grouped-by-point-tile execution of the pair list (the fast path;
     same result contract as pip_layer_sparse but returns DEVICE arrays).
@@ -441,19 +465,12 @@ def pip_layer_grouped(
     ay2 = _jnp.concatenate([_jnp.asarray(y2, dt32),
                             _jnp.full(EDGE_TILE, BIG, dt32)])
 
-    split = 16
-    classes = [
-        np.nonzero(counts <= split)[0],
-        np.nonzero(counts > split)[0],
-    ]
-    for sel in classes:
-        if not len(sel):
-            continue
-        from geomesa_tpu.utils.padding import next_pow2 as _np2
+    from geomesa_tpu.utils.padding import next_pow2 as _np2
 
-        # pow2 cap stabilizes the pallas jit cache across layers/queries
-        # (raw data-dependent shapes recompiled ~0.65s per novel shape)
-        cap_c = max(_np2(int(max(counts[sel].max(), 1))), 4)
+    caps_of = _pow2_caps(counts)
+    for cap_c in np.unique(caps_of):
+        sel = np.nonzero(caps_of == cap_c)[0]
+        cap_c = int(cap_c)
         # vectorized etab fill (repeat/rank scatter, same idiom as
         # pad_polygon_edges — a per-row python loop sat in the timed path)
         etab = np.full((len(sel), cap_c), n_etiles, np.int32)
@@ -494,7 +511,7 @@ def pip_layer_grouped(
                     ax1, ay1, ax2, ay2,
                     _jnp.asarray(tab),
                     cap=cap_k, n_etiles=n_etiles, eps=eps,
-                    interpret=interpret,
+                    interpret=interpret, e_per=e_per,
                 )
                 out_c = out_c.at[jid].add(cc)
                 out_b = out_b.at[jid].add(bb)
@@ -554,7 +571,7 @@ def _make_assign_kernel(e_per: int, eps: float):
 )
 def _pip_assign_call(
     px_cov, py_cov, x1, y1, x2, y2, etab, pinfo,
-    cap: int, n_etiles: int, eps: float, interpret: bool, e_per: int = 8,
+    cap: int, n_etiles: int, eps: float, interpret: bool, e_per: int = 2,
 ):
     """Assignment-mode capacity class (see _make_assign_kernel). Returns
     (assign, count, band) each [Tc, POINT_TILE] i32. `pinfo[i, j]` is
@@ -642,11 +659,12 @@ def pip_layer_assign(
     import jax.numpy as _jnp
     from geomesa_tpu.utils.padding import next_pow2 as _np2
 
-    # polygon of each edge tile, reconstructed the way pad_polygon_edges
-    # laid the table out (pid-sorted, per-polygon padded counts) —
-    # callers holding one (pip_layer_join) pass it in
+    # polygon RANKS per edge tile + rank->id mapping (see
+    # _poly_of_tile_from) — callers holding one (pip_layer_join) pass it
     if poly_of_tile is None:
-        poly_of_tile = _poly_of_tile_from(prep, poly_of_edge)
+        poly_of_tile, poly_uids = _poly_of_tile_from(prep, poly_of_edge)
+    else:
+        poly_of_tile, poly_uids = poly_of_tile
 
     pt_np = np.asarray(pl_.pair_pt, np.int64)
     et_np = np.asarray(pl_.pair_et, np.int64)
@@ -678,22 +696,16 @@ def pip_layer_assign(
                             _jnp.full(EDGE_TILE, BIG, dt32)])
 
     host_rows = []
-    split = 16
-    for sel in (np.nonzero(counts <= split)[0],
-                np.nonzero(counts > split)[0]):
-        if not len(sel):
-            continue
-        cap_c = max(_np2(int(max(counts[sel].max(), 1))), 4)
+    caps_of = _pow2_caps(counts)
+    for cap_c in np.unique(caps_of):
+        sel = np.nonzero(caps_of == cap_c)[0]
+        cap_c = int(cap_c)
         if cap_c > MAX_ETAB_SLOTS:
             # assignment cannot split a row across calls (the running
             # parity would be lost between them): rows this dense are
             # evaluated exactly on the host instead
-            over = sel[counts[sel] > MAX_ETAB_SLOTS]
-            host_rows.extend(tiles[over].tolist())
-            sel = sel[counts[sel] <= MAX_ETAB_SLOTS]
-            if not len(sel):
-                continue
-            cap_c = max(_np2(int(max(counts[sel].max(), 1))), 4)
+            host_rows.extend(tiles[sel].tolist())
+            continue
         etab = np.full((len(sel), cap_c), n_etiles, np.int32)
         pinf = np.zeros((len(sel), cap_c), np.int32)
         cnt_s = counts[sel]
@@ -753,22 +765,25 @@ def pip_layer_assign(
         poly_id, count = _refine_assign_f64(
             refine_idx, poly_id, count, px_np, py_np, prep, poly_of_tile)
         refined = len(refine_idx)
-    return poly_id, count, {
+    # map dense kernel ranks back to the caller's original polygon ids
+    out_ids = np.full(n, -1, np.int64)
+    valid_a = poly_id >= 0
+    out_ids[valid_a] = poly_uids[poly_id[valid_a]]
+    return out_ids, count, {
         "pairs": int(len(pl_.pair_pt)), "refined": refined,
         "host_rows": len(host_rows),
         "flagged": int((band > 0).sum()),
     }
 
 
-def _poly_of_tile_from(prep: "LayerPrep", poly_of_edge) -> np.ndarray:
-    """Reconstruct the per-edge-tile polygon ids the same way
-    pad_polygon_edges produced them (pid-sorted, padded counts)."""
-    poe = np.asarray(poly_of_edge, np.int64)
-    counts_all = np.bincount(poe)
-    pids = np.nonzero(counts_all)[0]
-    counts = counts_all[pids]
+def _poly_of_tile_from(prep: "LayerPrep", poly_of_edge):
+    """(rank_of_tile [n_etiles], unique_ids [P]): per-edge-tile polygon
+    RANKS (dense 0..P-1 — the i32 kernel encoding and every internal
+    group key use ranks, so sparse/large ids neither overflow nor size
+    arrays) plus the rank -> original-id mapping for outputs."""
+    pids, counts, _ = _group_ids(np.asarray(poly_of_edge, np.int64))
     tiles_per = -(-counts // EDGE_TILE)
-    return np.repeat(pids, tiles_per)
+    return np.repeat(np.arange(len(pids)), tiles_per), pids
 
 
 def pip_layer_join(
@@ -790,21 +805,21 @@ def pip_layer_join(
     pair list's candidates). The SQL engine's ON st_contains path."""
     if prep is None:
         prep = prepare_layer(px_np, py_np, x1, y1, x2, y2, poly_of_edge)
-    poly_of_tile = _poly_of_tile_from(prep, poly_of_edge)
+    groups = _poly_of_tile_from(prep, poly_of_edge)
     poly_id, count, _info = pip_layer_assign(
         px_np, py_np, x1, y1, x2, y2, poly_of_edge,
         eps=eps, interpret=interpret, prep=prep,
-        poly_of_tile=poly_of_tile,
+        poly_of_tile=groups,
     )
     single = np.nonzero(count == 1)[0]
     pt_rows = [single]
     polys = [poly_id[single].astype(np.int64)]
     multi = np.nonzero(count > 1)[0]
     if len(multi):
-        mp, mpoly = _multi_assign_f64(multi, px_np, py_np, prep,
-                                      poly_of_tile)
+        mp, mrank = _multi_assign_f64(multi, px_np, py_np, prep,
+                                      groups[0])
         pt_rows.append(mp)
-        polys.append(mpoly)
+        polys.append(groups[1][mrank])  # ranks -> original ids
     return np.concatenate(pt_rows), np.concatenate(polys)
 
 
@@ -1111,26 +1126,25 @@ def prepare_layer(
         _bb(np.maximum(ex1, ex2), False), _bb(np.maximum(ey1, ey2), False),
     ], 1)
     # per-polygon bboxes via reduceat over pid-sorted edges (the naive
-    # per-polygon masking re-scanned the edge table 10k times); dense
-    # bincount + sorted fast path as in pad_polygon_edges
+    # per-polygon masking re-scanned the edge table 10k times). Both the
+    # bbox table and build_pairs work in DENSE RANK space (0..P-1), so
+    # sparse/large polygon ids never size an array (round-4 review)
     poe = np.asarray(poly_of_edge, np.int64)
-    counts_all = np.bincount(poe)
-    pids = np.nonzero(counts_all)[0]
-    counts = counts_all[pids]
-    order = (slice(None) if bool((np.diff(poe) >= 0).all())
-             else np.argsort(poe, kind="stable"))
+    pids, counts, order = _group_ids(poe)
     bounds = np.concatenate([[0], np.cumsum(counts)[:-1]])
     exmin = np.minimum(x1, x2)[order]
     eymin = np.minimum(y1, y2)[order]
     exmax = np.maximum(x1, x2)[order]
     eymax = np.maximum(y1, y2)[order]
-    poly_bbox = np.zeros((int(pids.max()) + 1, 4))
-    poly_bbox[pids, 0] = np.minimum.reduceat(exmin, bounds)
-    poly_bbox[pids, 1] = np.minimum.reduceat(eymin, bounds)
-    poly_bbox[pids, 2] = np.maximum.reduceat(exmax, bounds)
-    poly_bbox[pids, 3] = np.maximum.reduceat(eymax, bounds)
+    poly_bbox = np.stack([
+        np.minimum.reduceat(exmin, bounds),
+        np.minimum.reduceat(eymin, bounds),
+        np.maximum.reduceat(exmax, bounds),
+        np.maximum.reduceat(eymax, bounds),
+    ], 1)
+    pot_rank = np.searchsorted(pids, poly_of_tile)
     pairs = build_pairs(
-        ptile_bbox, etile_bbox, poly_of_tile, poly_bbox, margin=margin
+        ptile_bbox, etile_bbox, pot_rank, poly_bbox, margin=margin
     )
     return LayerPrep(pxp, pyp, ex1, ey1, ex2, ey2, pairs,
                      n_ptiles, n_etiles)

@@ -50,6 +50,25 @@ class FeatureSource:
 
     def write(self, batch: FeatureBatch) -> None:
         self.storage.write(batch)
+        # write-path StatUpdater (SURVEY.md:199-200): sketches stay live
+        # without an explicit stats-analyze
+        self.planner.update_stats(batch)
+
+    def delete_features(self, cql: str = "INCLUDE") -> int:
+        """Delete features matching an ECQL filter (delete-features
+        parity). Sketch stats cannot un-observe, so they are invalidated
+        (planner estimates fall back until re-analyze/next write)."""
+        n = self.storage.delete_features(cql)
+        if n:
+            self.planner.stats_manager().invalidate()
+        return n
+
+    def age_off(self, older_than_ms: int) -> int:
+        """Delete features older than the cutoff (FS age-off parity)."""
+        n = self.storage.age_off(older_than_ms)
+        if n:
+            self.planner.stats_manager().invalidate()
+        return n
 
     def knn(
         self, query: "Query | str", qx, qy, k: int = 10,

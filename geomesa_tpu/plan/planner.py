@@ -147,17 +147,26 @@ class QueryPlanner:
             cached[key] = compile_filter(residual, sft)
         return cached[key]
 
-    def _stats_estimate(self, bbox: BBox, interval: Interval):
-        """Sketch-based selectivity (StatsBasedEstimator analog); None when
-        stats-analyze has never run on this store."""
+    def stats_manager(self):
         if not hasattr(self, "_stats_mgr"):
             from geomesa_tpu.plan.stats_manager import StatsManager
 
             self._stats_mgr = StatsManager(self.storage)
-        self._stats_mgr.refresh()
-        if not self._stats_mgr.stats:
+        return self._stats_mgr
+
+    def _stats_estimate(self, bbox: BBox, interval: Interval):
+        """Sketch-based selectivity (StatsBasedEstimator analog); None when
+        no stats exist (neither analyzed nor write-path updated)."""
+        mgr = self.stats_manager()
+        mgr.refresh()
+        if not mgr.stats:
             return None
-        return self._stats_mgr.estimate_count(bbox, interval)
+        return mgr.estimate_count(bbox, interval)
+
+    def update_stats(self, batch) -> None:
+        """Write-path stats hook (StatUpdater analog): called by
+        FeatureSource.write after the storage append."""
+        self.stats_manager().update(batch)
 
     # -- execution ---------------------------------------------------------
 
@@ -222,7 +231,7 @@ class QueryPlanner:
         # features need the materialized rows.
         can_stream_count = (
             hints.count_only and not hints.sampling
-            and plan.compiled is not None and not plan.compiled.has_band
+            and plan.compiled is not None
             and getattr(self.storage.sft, "user_data", {}).get(
                 "geomesa.vis.attr") is None
         )
@@ -236,6 +245,7 @@ class QueryPlanner:
             # (16 of them at bench scale) tripled the cold wall time
             UPLOAD_ROWS = 1 << 23
             counts = []
+            corrections = [0]
             pending = []
             pending_rows = 0
 
@@ -249,6 +259,12 @@ class QueryPlanner:
                 dev = to_device(padded, coord_dtype=self.coord_dtype)
                 m = plan.compiled.mask(dev, padded)
                 counts.append(jnp.sum(m, dtype=jnp.int32))
+                if plan.compiled.has_band:
+                    # f64-exact counts (VERDICT r3 #5): correct this
+                    # unit's count for f32 boundary rows — a small sync
+                    # per ~8M-row unit, not a full-mask fetch
+                    corrections[0] += plan.compiled.band_count_correction(
+                        dev, padded, m)
                 pending, pending_rows = [], 0
 
             with ThreadPoolExecutor(max_workers=1) as ex:
@@ -269,7 +285,8 @@ class QueryPlanner:
                 flush()
             t_scan = time.perf_counter()
             check_timeout("scan")
-            mask_count = int(sum(int(np.asarray(c)) for c in counts))
+            mask_count = int(
+                sum(int(np.asarray(c)) for c in counts)) + corrections[0]
             t_done = time.perf_counter()
             self._record(query, plan, hints, mask_count,
                          t0, t_plan, t_scan, t_done)
@@ -297,14 +314,19 @@ class QueryPlanner:
 
             has_band = plan.compiled is not None and plan.compiled.has_band
             vm = visibility_mask(self.storage.sft, padded, hints)
-            if (
-                hints.count_only and not hints.sampling
-                and not has_band and vm is None
-            ):
-                # device reduction: fetch one scalar instead of the mask
-                # (polygon filters and visibility skip this: exact counts
-                # need the f64 refinement / auth mask folded below)
-                mask_count = int(np.asarray(jnp.sum(dev_mask, dtype=jnp.int32)))
+            if hints.count_only and not hints.sampling:
+                # device reduction: one scalar (plus a small band-row
+                # correction for f32-boundary exactness) instead of a
+                # full-mask fetch
+                m = dev_mask
+                if vm is not None:
+                    m = m & jnp.asarray(vm)
+                mask_count = int(np.asarray(jnp.sum(m, dtype=jnp.int64)))
+                if has_band:
+                    mask_count += plan.compiled.band_count_correction(
+                        dev, padded, m,
+                        extra=(jnp.asarray(vm) if vm is not None else None),
+                    )
                 t_done = time.perf_counter()
                 self._record(query, plan, hints, mask_count,
                              t0, t_plan, t_scan, t_done)
@@ -319,12 +341,6 @@ class QueryPlanner:
                 # feature-level visibility: rows the auths cannot see are
                 # invisible to counts and every aggregation
                 mask = mask & vm
-            if hints.count_only and not hints.sampling:
-                mask_count = int(mask.sum())
-                t_done = time.perf_counter()
-                self._record(query, plan, hints, mask_count,
-                             t0, t_plan, t_scan, t_done)
-                return QueryResult("count", count=mask_count)
             if hints.sampling:
                 groups = None
                 if hints.sample_by:
@@ -408,8 +424,14 @@ class QueryPlanner:
         if vm is not None:
             dev_mask = dev_mask & jnp.asarray(vm)
 
-        if hints.count_only and not hints.sampling and not has_band:
-            total = int(np.asarray(jnp.sum(dev_mask, dtype=jnp.int32)))
+        if hints.count_only and not hints.sampling:
+            total = int(np.asarray(jnp.sum(dev_mask, dtype=jnp.int64)))
+            if has_band:
+                extra = jnp.asarray(allowed)[sb.pids]
+                if vm is not None:
+                    extra = extra & jnp.asarray(vm)
+                total += plan.compiled.band_count_correction(
+                    sb.dev, sb.batch, dev_mask, extra=extra)
             return QueryResult("count", count=total), total, t_scan
 
         if hints.is_density:
@@ -434,13 +456,12 @@ class QueryPlanner:
             # refine patches band rows with the pure-filter f64 value, so
             # re-AND the partition-allowed + visibility components it
             # cannot know about
+            # non-inplace: refine returns the caller's (possibly read-
+            # only numpy-view) mask unchanged when no rows are flagged
             mask = plan.compiled.refine(mask, sb.dev, sb.batch)
-            mask &= allowed[np.asarray(sb.pids)]
+            mask = mask & allowed[np.asarray(sb.pids)]
             if vm is not None:
-                mask &= vm
-        if hints.count_only and not hints.sampling:
-            total = int(mask.sum())
-            return QueryResult("count", count=total), total, t_scan
+                mask = mask & vm
         total = int(mask.sum())
         if total == 0:
             return self._empty_result(hints, query), 0, t_scan

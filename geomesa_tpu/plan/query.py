@@ -20,6 +20,11 @@ class Query:
     attributes: Optional[Sequence[str]] = None  # projection; None = all
     sort_by: Optional[Sequence[Tuple[str, bool]]] = None  # (attr, ascending)
     max_features: Optional[int] = None
+    # output CRS (EPSG code): result geometries are reprojected in the
+    # runner's finish step when this differs from the stored srid
+    # (LocalQueryRunner reprojection parity, SURVEY.md:219-220); None =
+    # native. Filters/indexes always evaluate in the native CRS.
+    crs: Optional[int] = None
     hints: QueryHints = dataclasses.field(default_factory=QueryHints)
     # set by run_interceptors on its output so re-entrant paths (count ->
     # execute -> plan) apply the chain exactly once; upstream's

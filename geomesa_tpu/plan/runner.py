@@ -316,6 +316,10 @@ def finish_features(sel: FeatureBatch, query: "Query") -> FeatureBatch:
     sel = redact_attributes(sel, query.hints)
     if query.attributes is not None:
         sel = project(sel, query.attributes)
+    if query.crs is not None:
+        from geomesa_tpu.core.crs import reproject_batch
+
+        sel = reproject_batch(sel, query.crs)
     return sel
 
 

@@ -77,8 +77,7 @@ class StatsManager:
             json.dump({k: s.to_json() for k, s in self.stats.items()}, f)
         self._loaded_mtime = os.path.getmtime(self.path)
 
-    def analyze(self) -> dict:
-        """Full-store sketch computation (the stats-analyze command)."""
+    def _init_stats(self) -> Dict[str, Stat]:
         sft = self.storage.sft
         g = sft.default_geometry
         d = sft.default_dtg
@@ -92,45 +91,88 @@ class StatsManager:
                 stats[f"minmax:{a.name}"] = MinMax(a.name)
         if g is not None and g.type == "Point" and d is not None:
             stats["z3"] = Z3HistogramStat(g.name, d.name, "week", 16)
+        return stats
 
+    def _observe_batch(self, stats: Dict[str, Stat], batch) -> None:
+        sft = self.storage.sft
+        g = sft.default_geometry
+        d = sft.default_dtg
+        n = len(batch)
+        stats["count"].observe_moments(n, 0.0, 0.0)
+        for a in sft.attributes:
+            col = batch.columns.get(a.name)
+            if col is None:
+                continue
+            key_minmax = f"minmax:{a.name}"
+            key_topk = f"topk:{a.name}"
+            if key_minmax in stats and not isinstance(col, (DictColumn, GeometryColumn)):
+                stats[key_minmax].observe(np.asarray(col))
+            elif key_topk in stats and isinstance(col, DictColumn):
+                # dict-coded: bincount the int32 codes and feed
+                # (vocab, counts) — never materialize row strings
+                valid = col.codes[col.codes >= 0]
+                counts = np.bincount(valid, minlength=len(col.vocab))
+                stats[key_topk].observe_counts(col.vocab, counts)
+        if "z3" in stats and g is not None and d is not None:
+            gc = batch.columns[g.name]
+            bins, _ = to_binned_time(np.asarray(batch.columns[d.name]), TimePeriod.WEEK)
+            z3: Z3HistogramStat = stats["z3"]  # type: ignore[assignment]
+            b16 = z3.bins_per_dim
+            cx = np.clip(((np.asarray(gc.x) + 180.0) / 360.0 * b16).astype(int), 0, b16 - 1)
+            cy = np.clip(((np.asarray(gc.y) + 90.0) / 180.0 * b16).astype(int), 0, b16 - 1)
+            # one bincount over (time-bin, cell) composite keys instead
+            # of a per-bin np.add.at pass (ufunc.at is unbuffered and
+            # ~100x slower at bench scale)
+            ubins, binv = np.unique(bins, return_inverse=True)
+            cells = b16 * b16
+            flat = np.bincount(
+                binv * cells + cy * b16 + cx, minlength=len(ubins) * cells
+            ).reshape(len(ubins), b16, b16)
+            for i, b in enumerate(ubins):
+                z3.observe_grid(int(b), flat[i])
+
+    def invalidate(self) -> None:
+        """Drop persisted sketches (mergeable sketches cannot UN-observe,
+        so deletes make them stale — the planner falls back to heuristics
+        until the next analyze or write)."""
+        self.stats = {}
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+        self._loaded_mtime = -1.0
+
+    def analyze(self) -> dict:
+        """Full-store sketch computation (the stats-analyze command)."""
+        stats = self._init_stats()
         for batch in self.storage.scan():
-            n = len(batch)
-            stats["count"].observe_moments(n, 0.0, 0.0)
-            for a in sft.attributes:
-                col = batch.columns.get(a.name)
-                if col is None:
-                    continue
-                key_minmax = f"minmax:{a.name}"
-                key_topk = f"topk:{a.name}"
-                if key_minmax in stats and not isinstance(col, (DictColumn, GeometryColumn)):
-                    stats[key_minmax].observe(np.asarray(col))
-                elif key_topk in stats and isinstance(col, DictColumn):
-                    # dict-coded: bincount the int32 codes and feed
-                    # (vocab, counts) — never materialize row strings
-                    valid = col.codes[col.codes >= 0]
-                    counts = np.bincount(valid, minlength=len(col.vocab))
-                    stats[key_topk].observe_counts(col.vocab, counts)
-            if "z3" in stats:
-                gc = batch.columns[g.name]
-                bins, _ = to_binned_time(np.asarray(batch.columns[d.name]), TimePeriod.WEEK)
-                z3: Z3HistogramStat = stats["z3"]  # type: ignore[assignment]
-                b16 = z3.bins_per_dim
-                cx = np.clip(((np.asarray(gc.x) + 180.0) / 360.0 * b16).astype(int), 0, b16 - 1)
-                cy = np.clip(((np.asarray(gc.y) + 90.0) / 180.0 * b16).astype(int), 0, b16 - 1)
-                # one bincount over (time-bin, cell) composite keys instead
-                # of a per-bin np.add.at pass (ufunc.at is unbuffered and
-                # ~100x slower at bench scale)
-                ubins, binv = np.unique(bins, return_inverse=True)
-                cells = b16 * b16
-                flat = np.bincount(
-                    binv * cells + cy * b16 + cx, minlength=len(ubins) * cells
-                ).reshape(len(ubins), b16, b16)
-                for i, b in enumerate(ubins):
-                    z3.observe_grid(int(b), flat[i])
-
+            self._observe_batch(stats, batch)
         self.stats = stats
         self._save()
         return self.summary()
+
+    def update(self, batch) -> None:
+        """Write-path StatUpdater (SURVEY.md:199-200, upstream
+        o.l.g.index.stats StatUpdater): fold ONE written batch into the
+        persisted sketches, so planner estimates are live immediately
+        after ingest with no stats-analyze. Sketches are mergeable, so
+        incremental observation equals a fresh analyze over old+new data
+        — PROVIDED the sketches cover everything already stored. With no
+        sketches but existing data (store predating stats, or stats
+        invalidated by a delete), a one-batch init would silently claim
+        subset stats for the whole store (round-4 review, reproduced:
+        ~2x-wrong counts), so that case runs a full analyze instead —
+        the written batch is already on disk and is included."""
+        self.refresh()
+        if not self.stats:
+            if self.storage.count > len(batch):
+                self.analyze()
+                return
+            self.stats = self._init_stats()
+        if batch.valid is not None and not batch.valid.all():
+            batch = batch.select(batch.valid)
+        self._observe_batch(self.stats, batch)
+        self._save()
 
     def summary(self) -> dict:
         out = {}

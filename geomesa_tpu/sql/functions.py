@@ -83,6 +83,7 @@ __all__ = [
     "st_point",
     "st_pointN",
     "st_touches",
+    "st_transform",
     "st_translate",
     "st_within",
     "st_x",
@@ -440,6 +441,26 @@ def st_dwithin(
 
 # ---------------------------------------------------------------------------
 # processors
+
+
+def st_transform(g: Geometry, from_srid, to_srid) -> Geometry:
+    """Reproject between registered CRSs (EPSG:4326 <-> EPSG:3857; see
+    core.crs). Accepts codes as ints or 'EPSG:NNNN' strings (upstream
+    st_transform takes CRS names)."""
+    from geomesa_tpu.core.crs import transform as _crs_transform
+
+    def _code(v):
+        if isinstance(v, str):
+            v = v.upper().replace("EPSG:", "")
+        return int(v)
+
+    src, dst = _code(from_srid), _code(to_srid)
+    rings = []
+    for r in g.rings:
+        a = np.asarray(r, np.float64)
+        x, y = _crs_transform(a[:, 0], a[:, 1], src, dst)
+        rings.append(np.stack([x, y], 1))
+    return Geometry(g.kind, rings, parts=list(g.parts))
 
 
 def st_translate(g: Geometry, dx: float, dy: float) -> Geometry:

@@ -264,6 +264,91 @@ class FileSystemStorage:
                 removed += 1
         return removed
 
+    def delete_features(self, cql: "str | object") -> int:
+        """Delete features matching an ECQL filter (geomesa-tools
+        delete-features; upstream writes deletion mutations — here each
+        touched file is rewritten without the matching rows). Exact f64
+        host evaluation; crash-safety ordering as in compact (new file +
+        manifest first, removals last). Returns rows deleted."""
+        from geomesa_tpu.cql import ast, parse_cql
+        from geomesa_tpu.cql.hosteval import eval_filter_host
+
+        f = parse_cql(cql) if isinstance(cql, str) else cql
+        if isinstance(f, ast.Include):
+            # delete-all: clear every partition (schema stays). Same
+            # crash-safety ordering as below: persist the emptied
+            # manifest FIRST, remove files last — a crash then leaves
+            # either the old manifest (files intact) or the new one
+            # (orphaned files, harmless), never references to missing
+            # files.
+            total = self.count
+            paths = [
+                os.path.join(self.root, name, entry["file"])
+                for name, entries in self.manifest.items()
+                for entry in entries
+            ]
+            self.manifest = {}
+            self._save_metadata()
+            for p in paths:
+                os.remove(p)
+            return total
+        deleted = 0
+        for name in list(self.manifest):
+            new_entries = []
+            removals = []
+            changed = False
+            for entry in self.manifest[name]:
+                path = os.path.join(self.root, name, entry["file"])
+                batch = _table_to_batch(
+                    self._read_file(path, None, None), self.sft)
+                hit = eval_filter_host(f, batch)
+                nh = int(hit.sum())
+                if nh == 0:
+                    new_entries.append(entry)
+                    continue
+                changed = True
+                deleted += nh
+                removals.append(entry["file"])
+                keep = batch.select(~hit)
+                if len(keep):
+                    fname = f"{uuid.uuid4().hex}.{self.encoding}"
+                    out = os.path.join(self.root, name, fname)
+                    if self.encoding == "orc":
+                        from pyarrow import orc
+
+                        orc.write_table(
+                            self._decode_dictionaries(_batch_to_table(keep)),
+                            out, compression="zstd")
+                    else:
+                        pq.write_table(
+                            _batch_to_table(keep), out, compression="zstd",
+                            row_group_size=64 * 1024)
+                    new_entries.append({"file": fname, "count": len(keep)})
+            if changed:
+                if new_entries:
+                    self.manifest[name] = new_entries
+                else:
+                    del self.manifest[name]
+                self._save_metadata()
+                for fname in removals:
+                    os.remove(os.path.join(self.root, name, fname))
+        return deleted
+
+    def age_off(self, older_than_ms: int, dtg_attr: "str | None" = None) -> int:
+        """Delete features whose dtg is strictly before `older_than_ms`
+        (the FS analog of the KV store's age-off; upstream: the age-off
+        iterators/filters). Returns rows deleted."""
+        from geomesa_tpu.cql import ast
+
+        d = (self.sft.attribute(dtg_attr) if dtg_attr
+             else self.sft.default_dtg)
+        if d is None:
+            raise ValueError("age_off needs a dtg attribute")
+        return self.delete_features(
+            ast.TemporalPredicate(
+                "BEFORE", ast.Property(d.name), int(older_than_ms), None)
+        )
+
     # -- read --------------------------------------------------------------
 
     def partitions(self) -> List[str]:

@@ -425,3 +425,43 @@ class TestCliSql:
         r = run_cli(["sql", "-c", cat, "-F", "json", "-q",
                      "SELECT COUNT(*) FROM ev WHERE score > 3"], cli_env)
         assert r.stdout.strip() == "2"
+
+
+class TestCLIDeleteFeatures:
+    def test_delete_and_age_off(self, tmp_path, cli_env):
+        cat = str(tmp_path / "catalog")
+        r = run_cli(["create-schema", "-c", cat, "-f", "ev",
+                     "-s", "name:String,dtg:Date,*geom:Point"], cli_env)
+        assert r.returncode == 0, r.stderr
+        csv = tmp_path / "rows.csv"
+        csv.write_text(
+            "id,name,dtg,lon,lat\n"
+            "1,alpha,2020-06-01T00:00:00,10.0,20.0\n"
+            "2,beta,2020-06-20T00:00:00,11.0,21.0\n"
+            "3,alpha,2020-07-05T00:00:00,12.0,22.0\n"
+        )
+        conv = tmp_path / "conv.json"
+        conv.write_text(json.dumps({
+            "type": "delimited-text", "format": "CSV",
+            "options": {"skip-lines": 1},
+            "id-field": "$1",
+            "fields": [
+                {"name": "name", "transform": "$2::string"},
+                {"name": "dtg", "transform": "isoDateTime($3)"},
+                {"name": "geom", "transform": "point($4, $5)"},
+            ],
+        }))
+        r = run_cli(["ingest", "-c", cat, "-f", "ev",
+                     "--converter", str(conv), str(csv)], cli_env)
+        assert r.returncode == 0, r.stderr
+        r = run_cli(["delete-features", "-c", cat, "-f", "ev",
+                     "-q", "name = 'beta'"], cli_env)
+        assert r.returncode == 0, r.stderr
+        assert "deleted 1 features" in r.stdout
+        r = run_cli(["age-off", "-c", cat, "-f", "ev",
+                     "--older-than", "2020-07-01T00:00:00Z"], cli_env)
+        assert "aged off 1 features" in r.stdout
+        r = run_cli(["stats-count", "-c", cat, "-f", "ev",
+                     "-q", "INCLUDE"], cli_env)
+        assert r.returncode == 0, r.stderr
+        assert "1" in r.stdout

@@ -104,3 +104,28 @@ class TestPipAssign:
             px, py, x1, y1, x2, y2, pol, interpret=True)
         np.testing.assert_array_equal(a1_, a2_)
         np.testing.assert_array_equal(c1_, c2_)
+
+
+def test_sparse_large_polygon_ids():
+    # public contract (round-4 review): polygon ids may be sparse and
+    # huge (e.g. feature ids) — no O(max id) allocation, no i32
+    # overflow; outputs carry the ORIGINAL ids
+    th = np.linspace(0, 2 * np.pi, 32, endpoint=False)
+    def ring(cx, cy, r):
+        x1 = cx + r * np.cos(th); y1 = cy + r * np.sin(th)
+        return x1, y1, np.roll(x1, -1), np.roll(y1, -1)
+    a = ring(-20.0, 0.0, 8.0)
+    b = ring(20.0, 0.0, 8.0)
+    x1 = np.concatenate([a[0], b[0]]); y1 = np.concatenate([a[1], b[1]])
+    x2 = np.concatenate([a[2], b[2]]); y2 = np.concatenate([a[3], b[3]])
+    big_a, big_b = 3_000_000_000_017, 9_000_000_000_001
+    pol = np.concatenate([np.full(32, big_a, np.int64),
+                          np.full(32, big_b, np.int64)])
+    rng = np.random.default_rng(13)
+    px = np.sort(rng.uniform(-35, 35, 4096)); py = rng.uniform(-12, 12, 4096)
+    pid, cnt, info = pip_layer_assign(px, py, x1, y1, x2, y2, pol,
+                                      interpret=True)
+    exp_id, exp_n = assign_oracle(px, py, x1, y1, x2, y2, pol)
+    np.testing.assert_array_equal(pid, exp_id)
+    assert set(np.unique(pid)) <= {-1, big_a, big_b}
+    assert (pid == big_a).sum() > 50 and (pid == big_b).sum() > 50
