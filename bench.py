@@ -418,17 +418,24 @@ def bench_hw_smoke():
         exp_id[ins] = p
     gates["pip_assign"] = bool((pid == exp_id).all())
 
-    # 3. z-sparse density vs the scatter kernel (exact for counts)
+    # 3. z-sparse density vs the scatter kernel (exact for counts).
+    # MORTON-ordered copy: x-sorted data sends every tile to the dense
+    # fallback, silently skipping the sparse kernel's Mosaic compile
+    # (exactly how the out-BlockSpec bug slipped past the first hw-smoke)
     from geomesa_tpu.engine.density import density_grid
     from geomesa_tpu.engine.density_zsparse import density_zsparse
 
     bbox = (-60.0, -40.0, 60.0, 40.0)
+    zo = np.argsort(_morton64(x, y))
+    zx = jnp.asarray(x[zo], jnp.float32)
+    zy = jnp.asarray(y[zo], jnp.float32)
     w1 = jnp.ones(n, jnp.float32)
     dm = jnp.asarray(rng.random(n) < 0.8)
-    g1, _ = density_zsparse(jd[0], jd[1], w1, dm, bbox, 256, 256)
-    g2 = density_grid(jd[0], jd[1], w1, dm, bbox, 256, 256)
+    g1, calib = density_zsparse(zx, zy, w1, dm, bbox, 256, 256)
+    g2 = density_grid(zx, zy, w1, dm, bbox, 256, 256)
     gates["density_zsparse"] = bool(
-        np.array_equal(np.asarray(g1), np.asarray(g2)))
+        np.array_equal(np.asarray(g1), np.asarray(g2))
+    ) and len(calib.tile_ids) > 0  # the sparse kernel actually compiled
 
     # 4. pruned tube vs dense tube
     from geomesa_tpu.engine.tube import tube_select, tube_select_pruned

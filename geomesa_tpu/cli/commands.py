@@ -40,8 +40,15 @@ def register(sub: "argparse._SubParsersAction") -> None:
     cmd("get-type-names", "list feature types", _get_type_names, [cat])
     cmd("describe-schema", "show a feature type", _describe_schema, [cat, feat])
     cmd("remove-schema", "drop a feature type and its data", _remove_schema, [cat, feat])
-    cmd("delete-features", "delete features matching a CQL filter",
-        _delete_features, [cat, feat, cql])
+    # destructive: the filter is REQUIRED (the shared --cql default of
+    # INCLUDE would make a forgotten -q silently delete everything —
+    # round-4 review); delete-all must be spelled out as -q INCLUDE
+    cmd("delete-features", "delete features matching a CQL filter "
+        "(explicit -q INCLUDE deletes all)",
+        _delete_features,
+        [cat, feat,
+         (["--cql", "-q"], {"required": True, "help": "ECQL filter "
+                            "(INCLUDE = delete every feature)"})])
     cmd("age-off", "delete features older than an ISO instant",
         _age_off,
         [cat, feat,
@@ -188,10 +195,18 @@ def _delete_features(args) -> int:
 
 
 def _age_off(args) -> int:
-    import numpy as np
+    import datetime as _dt
 
-    cutoff = int(np.datetime64(
-        args.older_than.replace("Z", ""), "ms").astype(np.int64))
+    try:
+        dt = _dt.datetime.fromisoformat(
+            args.older_than.replace("Z", "+00:00"))
+    except ValueError:
+        print(f"error: --older-than {args.older_than!r} is not a valid "
+              "ISO-8601 instant", file=sys.stderr)
+        return 2
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    cutoff = int(dt.timestamp() * 1000)
     src = _store(args).get_feature_source(args.feature_name)
     n = src.age_off(cutoff)
     print(f"aged off {n} features from {args.feature_name}")

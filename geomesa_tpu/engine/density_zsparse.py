@@ -196,7 +196,7 @@ def _make_kernel(data_tile: int, chunk: int, cap: int, bbox: BBox,
                 preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.HIGHEST,
             )
-        out_ref[...] = acc
+        out_ref[...] = acc.reshape(out_ref.shape)
 
     return _kernel
 
@@ -225,6 +225,10 @@ def _zsparse_call(
     data_block = pl.BlockSpec(
         (1, data_tile), lambda p, ids, base: (0, ids[p])
     )
+    # out rows live in a 3-D [S, 1, cap] array with (1, 1, cap) blocks:
+    # Mosaic requires the last two block dims divisible by (8, 128) OR
+    # equal to the array dims — a 2-D (1, cap) block over [S, cap] fails
+    # that check (caught on hardware; interpret mode never sees Mosaic)
     with jax.enable_x64(False):
         counts = pl.pallas_call(
             _make_kernel(data_tile, chunk, cap, bbox, width, height),
@@ -232,13 +236,14 @@ def _zsparse_call(
                 num_scalar_prefetch=2,
                 grid=(s,),
                 in_specs=[data_block] * 4,
-                out_specs=pl.BlockSpec((1, cap), lambda p, ids, base: (p, 0)),
+                out_specs=pl.BlockSpec(
+                    (1, 1, cap), lambda p, ids, base: (p, 0, 0)),
             ),
-            out_shape=jax.ShapeDtypeStruct((s, cap), jnp.float32),
+            out_shape=jax.ShapeDtypeStruct((s, 1, cap), jnp.float32),
             interpret=interpret,
         )(tile_ids.astype(jnp.int32), tile_base.astype(jnp.int32),
           xr, yr, wr, mr)
-    return counts
+    return counts.reshape(s, cap)
 
 
 @functools.partial(

@@ -98,7 +98,12 @@ def tube_select(
     return hits.reshape(-1)[:n] & mask
 
 
-SEG = 128  # tube samples per pruning segment (lane quantum)
+# tube samples per pruning segment: a long track's segment boxes must
+# stay LOCAL or the prune is vacuous — at SEG=128 a 256-sample diagonal
+# corridor became 2 region-sized boxes covering ~half the data (measured
+# round 4: tile_capacity overflowed to ALL tiles, 4.6x; at SEG=16 the
+# boxes hug the corridor). The [n_tiles, K] overlap test stays trivial.
+SEG = 16
 
 
 @functools.partial(

@@ -54,10 +54,12 @@ class FeatureSource:
         # without an explicit stats-analyze
         self.planner.update_stats(batch)
 
-    def delete_features(self, cql: str = "INCLUDE") -> int:
+    def delete_features(self, cql: str) -> int:
         """Delete features matching an ECQL filter (delete-features
-        parity). Sketch stats cannot un-observe, so they are invalidated
-        (planner estimates fall back until re-analyze/next write)."""
+        parity; the filter is required — pass "INCLUDE" explicitly to
+        delete everything). Sketch stats cannot un-observe, so they are
+        invalidated (planner estimates fall back until re-analyze/next
+        write)."""
         n = self.storage.delete_features(cql)
         if n:
             self.planner.stats_manager().invalidate()

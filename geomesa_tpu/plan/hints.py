@@ -25,6 +25,12 @@ class QueryHints:
     # points (round-1 advisor finding: fidelity needs an opt-out that does
     # not bypass the DataStore API)
     density_exact_weights: bool = False
+    # Z-locality density kernel (engine.density_zsparse): per-tile local
+    # one-hots over the Morton band a STORE-ORDERED tile touches — the
+    # config-4 fast path. Opt-in because it pays a small calibration
+    # fetch per query and only wins on Z-ordered layouts (exact for any
+    # order via its dense fallback)
+    density_zsparse: bool = False
 
     # bin aggregation (BinAggregatingScan): compact dot-map records
     bin_track: Optional[str] = None  # attribute used as track id

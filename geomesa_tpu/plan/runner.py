@@ -55,6 +55,22 @@ def density_device_grid(sft: SimpleFeatureType, batch, dev, dev_mask, hints):
             hints.density_width,
             hints.density_height,
         )
+    if hints.density_zsparse and not (
+        hints.density_exact_weights and hints.density_weight
+    ):
+        # exact_weights + a weight column pins the f32 scatter path —
+        # the zsparse matmul accumulates weights in f32 and must not
+        # silently override the fidelity opt-in (round-4 review)
+        from geomesa_tpu.engine.density_zsparse import density_zsparse
+        from geomesa_tpu.engine.knn_scan import default_interpret
+
+        grid, _calib = density_zsparse(
+            dev[f"{g.name}__x"], dev[f"{g.name}__y"], w, dev_mask,
+            tuple(hints.density_bbox),
+            hints.density_width, hints.density_height,
+            interpret=default_interpret(),
+        )
+        return grid
     return density_grid(
         dev[f"{g.name}__x"],
         dev[f"{g.name}__y"],

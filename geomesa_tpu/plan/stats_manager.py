@@ -64,10 +64,17 @@ class StatsManager:
     def refresh(self) -> None:
         """Reload stats.json if it changed on disk since the last load, so a
         long-lived planner sees stats analyzed after it was constructed
-        (parity: GeoMesa's expiring metadata cache)."""
+        (parity: GeoMesa's expiring metadata cache). A file that EXISTED
+        at load time but is gone now means another process invalidated
+        the stats (delete-features) — the in-memory copy must drop too,
+        or update() would fold new batches into pre-delete sketches and
+        re-persist them (round-4 review)."""
         try:
             mtime = os.path.getmtime(self.path)
         except OSError:
+            if self._loaded_mtime != -1.0:
+                self.stats = {}
+                self._loaded_mtime = -1.0
             return
         if mtime != self._loaded_mtime:
             self._load()

@@ -397,9 +397,23 @@ class _SqlJoinMixin:
                 key_cols.get(si, set())
                 | {c for j, c, _ in out_items if j == si}
             )
-            r = self.ds.get_feature_source(s.table).get_features(
-                Query(s.table, f, attributes=needed)
-            )
+            from geomesa_tpu.utils.config import SystemProperties
+
+            cap = int(SystemProperties.SQL_JOIN_MAX_ROWS.get())
+            src_ = self.ds.get_feature_source(s.table)
+            # size guard (round-4): joins materialize their sides host-
+            # side — a silent 67M-row pull would exhaust host memory.
+            # The free manifest total gates whether the (device-cheap)
+            # filtered count is even worth running.
+            if cap and getattr(src_.storage, "count", 0) > cap:
+                est = src_.get_count(Query(s.table, f))
+                if est > cap:
+                    raise SqlError(
+                        f"join side {s.table!r} matches {est} rows "
+                        f"(> geomesa.sql.join.max.rows={cap}); push "
+                        "filters into WHERE or raise the cap"
+                    )
+            r = src_.get_features(Query(s.table, f, attributes=needed))
             b = r.features
             if b is None:
                 # empty side: materialize a zero-row batch so the join

@@ -74,6 +74,13 @@ class SystemProperties:
         lambda s: s.lower() in ("1", "true"),
         "reject queries whose filter constrains nothing (full-table scans)",
     )
+    SQL_JOIN_MAX_ROWS = SystemProperty(
+        "geomesa.sql.join.max.rows", 1 << 25, int,
+        "per-side row cap for SQL joins (the join itself is a host-side "
+        "hash/kernel join over materialized sides; a silent 67M-row "
+        "materialization would exhaust host memory — push filters into "
+        "the WHERE clause or raise the cap deliberately)",
+    )
     PROFILE_DIR = SystemProperty(
         "geomesa.profile.dir", "", str,
         "emit a jax profiler trace per query execution into this directory",

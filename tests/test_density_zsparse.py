@@ -152,3 +152,35 @@ def test_calibration_prunes_empty_tiles():
         jnp.ones(n, bool), BBOX, 64, 64, data_tile=1024,
     )
     assert len(calib.tile_ids) + len(calib.dense_ids) <= calib.n_tiles
+
+
+def test_density_zsparse_hint_through_datastore(tmp_path):
+    # product wiring: the density_zsparse hint produces the same grid as
+    # the default scatter path through the full DataStore query
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.plan.datastore import DataStore
+    from geomesa_tpu.plan.hints import QueryHints
+    from geomesa_tpu.plan.query import Query
+
+    rng = np.random.default_rng(23)
+    n = 20_000
+    sft = SimpleFeatureType.from_spec("d", "*geom:Point")
+    x = rng.uniform(-50, 50, n)
+    y = rng.uniform(-40, 40, n)
+    o = np.argsort(_morton64(x, y))
+    batch = FeatureBatch.from_pydict(
+        sft, {"geom": np.stack([x[o], y[o]], 1)})
+    ds = DataStore(str(tmp_path / "d"))
+    src = ds.create_schema(sft)
+    src.write(batch)
+
+    def q(zs):
+        hints = QueryHints(
+            density_bbox=(-60.0, -45.0, 60.0, 45.0),
+            density_width=64, density_height=64, density_zsparse=zs)
+        return src.get_features(
+            Query("d", "BBOX(geom, -45, -35, 45, 35)", hints=hints)).grid
+
+    np.testing.assert_allclose(q(True), q(False), rtol=1e-6, atol=1e-3)
+    assert q(True).sum() > 0
