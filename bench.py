@@ -1025,16 +1025,23 @@ def bench_fs_query(n, repeats, tmpdir=None, cold=False):
         planner = src.planner
         sb = planner.cache.superbatch()
         compiled = planner._compile_cached(_parse(cql), sft)
+        # device arrays must be ARGUMENTS, not closure captures: a
+        # zero-arg jit embeds them as HLO constants and the remote
+        # compile payload (hundreds of MB at 16M rows) broke the tunnel
+        # pipe twice before this was diagnosed
+        mask_fn = compiled.mask_fn()
+        params = compiled.params(sb.batch)
 
         @jax.jit
-        def _devcount():
-            return jnp.sum(compiled.mask(sb.dev, sb.batch), dtype=jnp.int32)
+        def _devcount(params, dev):
+            return jnp.sum(mask_fn(params, dev), dtype=jnp.int32)
 
-        one_t = _timeit(lambda: int(np.asarray(_devcount())), repeats)
+        one_t = _timeit(
+            lambda: int(np.asarray(_devcount(params, sb.dev))), repeats)
 
         def _dbl():
-            _devcount()
-            int(np.asarray(_devcount()))
+            _devcount(params, sb.dev)
+            int(np.asarray(_devcount(params, sb.dev)))
 
         net = max(_timeit(_dbl, repeats) - one_t, 1e-4)
         cpu_pps = n / cpu_t

@@ -316,18 +316,37 @@ def density_zsparse(
 
     grid = jnp.zeros((height, width), jnp.float32)
     if len(calib.tile_ids):
-        counts = _zsparse_call(
-            xp, yp, wp, mp.astype(jnp.float32),
-            jnp.asarray(calib.tile_ids), jnp.asarray(calib.tile_base),
-            cap=calib.cap, bbox=tuple(bbox), width=width, height=height,
-            data_tile=data_tile, chunk=min(CHUNK, data_tile),
-            interpret=interpret,
-        )
         raster = jnp.asarray(_raster_of_morton(width, height))
-        grid = grid + _fold_counts(
-            counts, jnp.asarray(calib.tile_base), raster,
-            cap=calib.cap, width=width, height=height,
-        )
+        # chunk the tile list so one call's output stays ~4 MB: XLA may
+        # place a pallas output in VMEM, and a full [S, 1, cap] count
+        # array blew the 16 MB scoped-vmem limit at bench scale (caught
+        # on hardware: S=3074, cap=4096 -> 50 MB)
+        maxs = max(256, (1 << 20) // max(calib.cap, 1))
+        S = len(calib.tile_ids)
+        for c0 in range(0, S, maxs):
+            c1 = min(c0 + maxs, S)
+            ids_c = calib.tile_ids[c0:c1]
+            base_c = calib.tile_base[c0:c1]
+            pad_c = maxs - len(ids_c) if S > maxs else 0
+            if pad_c:  # stable shapes across chunks (one compile)
+                ids_c = np.concatenate(
+                    [ids_c, np.full(pad_c, ids_c[0], ids_c.dtype)])
+                base_c = np.concatenate(
+                    [base_c, np.full(pad_c, 1 << 29, base_c.dtype)])
+                # padding rows re-scan a real tile with an impossible
+                # base: every local index clips out, contributing zeros
+            counts = _zsparse_call(
+                xp, yp, wp, mp.astype(jnp.float32),
+                jnp.asarray(ids_c), jnp.asarray(base_c),
+                cap=calib.cap, bbox=tuple(bbox), width=width,
+                height=height,
+                data_tile=data_tile, chunk=min(CHUNK, data_tile),
+                interpret=interpret,
+            )
+            grid = grid + _fold_counts(
+                counts, jnp.asarray(base_c), raster,
+                cap=calib.cap, width=width, height=height,
+            )
     if len(calib.dense_ids):
         # overflow tiles (Z seams / sparse regions): block-gather their
         # points (contiguous 16k rows — fast) and run the dense MXU path

@@ -24,7 +24,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from geomesa_tpu.engine.geodesy import haversine_m
 from geomesa_tpu.parallel.mesh import SHARD_AXIS
 
 
@@ -48,12 +47,29 @@ def tube_select(
     Tiled over BOTH axes: the [data_tile, tube_tile] hit block is the only
     pairwise intermediate, so HBM stays O(N + T) regardless of problem size
     (a flat [N, T] broadcast at N=4M, T=2k would materialize ~32 GB).
+
+    The pairwise test is a CHORD-SQUARED compare (round 4): d <= r on
+    the sphere iff |u_point - u_tube|^2 <= (2 sin(r/2R))^2 — identical
+    to the haversine compare in exact arithmetic, but the per-pair work
+    is 8 elementwise flops instead of transcendental-heavy haversine
+    (per-pair sin/cos/asin on the VPU). The DIFFERENCE form is
+    essential: the dot-product form (dot >= cos(r/R)) cancels
+    catastrophically in f32 — cos(r/R) rounds to exactly 1.0f below
+    r ~ 2.2 km, silently dropping true matches (round-4 review,
+    reproduced at 500 m radius); differences of unit-vector components
+    keep ~1% relative accuracy at any radius, the same ~1 m floor as
+    f32 coordinates themselves. Unit vectors and thresholds are
+    precomputed once per point/sample in the INPUT dtype, so f64 inputs
+    (the process path, CPU tests) stay f64-exact.
     """
+    from geomesa_tpu.engine.geodesy import EARTH_RADIUS_M
+
     T = tube_x.shape[0]
     n = x.shape[0]
     if T == 0:
         return jnp.zeros((n,), bool)
-    radius_m = jnp.broadcast_to(jnp.asarray(radius_m, jnp.float32), (T,))
+    radius_m = jnp.broadcast_to(
+        jnp.asarray(radius_m, x.dtype), (T,))
     half_window_ms = jnp.broadcast_to(
         jnp.asarray(half_window_ms, jnp.int64), (T,)
     )
@@ -64,13 +80,24 @@ def tube_select(
     tx = jnp.pad(tube_x, (0, tpad))
     ty = jnp.pad(tube_y, (0, tpad))
     tt = jnp.pad(tube_t, (0, tpad))
-    tr = jnp.pad(radius_m, (0, tpad), constant_values=-1.0)  # pad never matches
+    tr = jnp.pad(radius_m, (0, tpad), constant_values=-1.0)
     tw = jnp.pad(half_window_ms, (0, tpad))
+
+    def unit3(lon, lat):
+        rlon = jnp.radians(lon)
+        rlat = jnp.radians(lat)
+        cl = jnp.cos(rlat)
+        return jnp.stack(
+            [cl * jnp.cos(rlon), cl * jnp.sin(rlon), jnp.sin(rlat)], -1)
+
+    tu = unit3(tx, ty)                      # [Tp, 3]
+    # pad samples (r < 0) get threshold -1: chord^2 >= 0 never matches
+    half = jnp.sin(tr / (2.0 * EARTH_RADIUS_M))
+    thresh = jnp.where(tr < 0, -1.0, 4.0 * half * half)
     tube = (
-        tx.reshape(-1, tube_tile),
-        ty.reshape(-1, tube_tile),
+        tu.reshape(-1, tube_tile, 3),
+        thresh.reshape(-1, tube_tile),
         tt.reshape(-1, tube_tile),
-        tr.reshape(-1, tube_tile),
         tw.reshape(-1, tube_tile),
     )
 
@@ -82,12 +109,16 @@ def tube_select(
 
     def data_block(_, args):
         xi, yi, ti = args
+        ui = unit3(xi, yi)                  # [data_tile, 3]
 
         def tube_block(carry, targs):
-            txi, tyi, tti, tri, twi = targs
-            d = haversine_m(xi[:, None], yi[:, None], txi[None, :], tyi[None, :])
+            tui, thi, tti, twi = targs
+            dx = ui[:, None, 0] - tui[None, :, 0]
+            dy = ui[:, None, 1] - tui[None, :, 1]
+            dz = ui[:, None, 2] - tui[None, :, 2]
+            chord_sq = dx * dx + dy * dy + dz * dz
             dt = jnp.abs(ti[:, None] - tti[None, :])
-            hit = (d <= tri[None, :]) & (dt <= twi[None, :])
+            hit = (chord_sq <= thi[None, :]) & (dt <= twi[None, :])
             return carry | jnp.any(hit, axis=1), None
 
         init = jnp.zeros_like(xi, dtype=bool)

@@ -405,7 +405,11 @@ class _SqlJoinMixin:
             # side — a silent 67M-row pull would exhaust host memory.
             # The free manifest total gates whether the (device-cheap)
             # filtered count is even worth running.
-            if cap and getattr(src_.storage, "count", 0) > cap:
+            # getattr chain: KV-backed sources have no .storage — the
+            # engine stays duck-typed over the FeatureSource surface
+            if cap and getattr(
+                getattr(src_, "storage", None), "count", 0
+            ) > cap:
                 est = src_.get_count(Query(s.table, f))
                 if est > cap:
                     raise SqlError(

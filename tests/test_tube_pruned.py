@@ -136,3 +136,35 @@ class TestTubePrunedSharded:
             mesh, *args, data_tile=1024, tile_capacity=8)
         assert not bool(np.asarray(ov))
         np.testing.assert_array_equal(np.asarray(hits), dense)
+
+
+def test_small_radius_f32_exact():
+    # round-4 review repro: the dot-form chord test lost true matches at
+    # small radii (cos(r/R) rounds to 1.0f below ~2.2 km); the
+    # difference form must find every point 50 m from a sample at a
+    # 500 m radius, in f32
+    rng = np.random.default_rng(41)
+    T = 8
+    tx = np.linspace(10.0, 10.01, T)
+    ty = np.linspace(45.0, 45.01, T)
+    tt = np.zeros(T, np.int64)
+    # points planted ~50 m east of each sample (1 deg lon ~ 78.8 km at 45N)
+    n = 2000
+    pick = rng.integers(0, T, n)
+    px = tx[pick] + 50.0 / 78_847.0
+    py = ty[pick]
+    pt = np.zeros(n, np.int64)
+    args = (
+        jnp.asarray(px, jnp.float32), jnp.asarray(py, jnp.float32),
+        jnp.asarray(pt), jnp.ones(n, bool),
+        jnp.asarray(tx, jnp.float32), jnp.asarray(ty, jnp.float32),
+        jnp.asarray(tt), jnp.float32(500.0), jnp.int64(1000),
+    )
+    got = np.asarray(tube_select(*args, data_tile=1024))
+    assert got.all(), f"missed {int((~got).sum())}/{n} at 500 m radius"
+    # and a 500 m-away point must NOT match a 100 m radius
+    args2 = args[:7] + (jnp.float32(100.0), jnp.int64(1000))
+    px2 = tx[pick] + 500.0 / 78_847.0
+    args2 = (jnp.asarray(px2, jnp.float32),) + args2[1:]
+    got2 = np.asarray(tube_select(*args2, data_tile=1024))
+    assert not got2.any()
