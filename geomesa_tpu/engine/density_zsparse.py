@@ -49,9 +49,14 @@ import numpy as np
 
 BBox = Tuple[float, float, float, float]
 
-DATA_TILE = 16384
-CHUNK = 4096
-MAX_CAP = 4096  # beyond this span the dense path is cheaper anyway
+# kernel geometry bounded by scoped VMEM (~16 MB): the in-kernel one-hot
+# is [CHUNK, cap] f32, so CHUNK x MAX_CAP x 4 B must stay well under the
+# limit (the first hardware run allocated 64 MB at 4096x4096 and the
+# compile OOMed). Smaller data tiles also shrink per-tile Morton spans,
+# keeping more tiles on the sparse path at the smaller cap.
+DATA_TILE = 4096
+CHUNK = 2048
+MAX_CAP = 1024  # beyond this span the dense path is cheaper anyway
 
 
 def _interleave16(v):
