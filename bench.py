@@ -626,7 +626,7 @@ def bench_density(n, repeats, dist="uniform", order="store", smoke=False,
         out["detail"]["sparse_tiles"] = int(len(calib.tile_ids))
         out["detail"]["dense_fallback_tiles"] = int(len(calib.dense_ids))
         out["detail"]["tiles_total"] = int(calib.n_tiles)
-        out["detail"]["local_cap"] = int(calib.cap)
+        out["detail"]["dict_capd"] = int(calib.capd)
     return out
 
 

@@ -1,41 +1,43 @@
-"""Z-locality density: the store-order-aware heatmap kernel.
+"""Cell-dictionary density: the store-order-aware heatmap kernel.
 
 Parity role: DensityScan / DensityProcess (SURVEY.md §3.5) at the
 north-star scale — config 4's 512x512 heatmap over 10s of millions of
 points. The round-2 kernels pay per-point costs that dwarf the HBM
 roofline: XLA scatter-add serializes (~1 cycle/point), and the dense MXU
-one-hot formulation (`density.density_grid_mxu`) materializes [T, H] and
-[T, W] one-hots through HBM (~137 GB at 67M points / 512^2 — measured
-0.65 s, vs a ~2 ms read-the-data bound).
+one-hot formulation (`density.density_grid_mxu`) builds [T, H] + [T, W]
+one-hots (~3 VPU cycles/point at 512^2 — measured 0.45-0.65 s at 67M).
 
-The insight (same as the sparse kNN scan): index scans emit rows in
-STORE ORDER — the Z curve — so consecutive points are spatially local,
-and a 16384-point data tile touches only a narrow band of density cells.
-In MORTON order over the density grid those cells are near-contiguous:
-measured on the config-4 shapes, a tile's (max - min) Morton-cell span
-is ~64-256 out of 262144. That turns the histogram into
+The insight (same family as the sparse kNN scan): index scans emit rows
+in STORE ORDER — the Z curve — so consecutive points are spatially
+local, and a 4096-point data tile touches only a HANDFUL of distinct
+density cells (~16-64 at config-4 shapes; uniform 67M over 512^2 is
+~256 points per cell). Each tile gets a DICTIONARY of its distinct cell
+ids, built on device (sort + dedupe, one calibration pass), and the
+kernel one-hots points against that narrow dictionary:
 
-  per tile:  local = morton_cell(point) - tile_base     (in [0, CAP))
-             counts[local] += w                          (VMEM one-hot)
-  finally:   scatter per-tile count rows into the Morton-flat grid,
-             permute Morton -> raster once (static per W,H)
+  per tile:  match[i, j] = (cell(point_i) == dict[j])     [chunk, capd]
+             counts[j] += sum_i match[i, j] * w_i          (VMEM)
+  finally:   grid.at[dict].add(counts)                     (one scatter)
 
-The per-tile one-hot is [chunk, CAP] with CAP ~128-1024 instead of
-[chunk, H] + [chunk, W] with H = W = 512, and it never leaves VMEM.
-Cost: ~0.3-0.5 VPU cycles/point — an HBM-bound kernel.
+capd is the pow2 bucket of the median distinct-cell count (~64), so the
+per-point cost is ~capd/1024 lanes * ~3 ops ~ 0.2 VPU cycles — an
+HBM-bound kernel. A span-based variant (round-4 first cut) used
+base+offset locality instead; measured Morton spans of store tiles run
+512-1024 (alignment + world-vs-grid curve mismatch), making its one-hot
+as wide as the dense kernel's — the dictionary restores the ~10x.
 
 Exactness: identical contract to `density_grid` (same binning, same
-mask/out-of-bounds exclusion). Weighted sums run the one-hot matmul in
-f32 (HIGHEST); counts are exact, weighted grids agree with the scatter
-path to f32 summation-order noise. Tiles whose span exceeds CAP (Z-curve
-quadrant seams, sparse regions) and tiles with no matching points are
-EXCLUDED from the kernel: empty tiles are pruned outright (the VERDICT
-r3 tile-pruning item), overflow tiles are evaluated by the caller on the
-dense path over block-gathered points (`density_zsparse` handles both).
+mask/out-of-bounds exclusion). Counts are exact; weighted sums agree
+with the scatter path to f32 summation-order noise. Tiles with more
+distinct cells than capd and tiles with no matching points are EXCLUDED
+from the kernel: empty tiles are pruned outright (the VERDICT r3
+tile-pruning item), overflow tiles go to the caller's EXACT scatter
+fallback (the bf16 hi/lo MXU fallback of the first cut failed the
+weighted cells-parity gate on hardware).
 
-Mosaic notes (same constraints as knn_scan.py): i32 bit-twiddling only
-(Morton interleave in 32-bit), trace under enable_x64(False), static
-chunk loop (4 bodies), output lanes >= 128.
+Mosaic notes: the dictionary rides as a (1, 1, capd) VMEM operand
+(block == array dims satisfies the lane rule at any capd); out blocks
+use the same 3-D idiom; scoped VMEM bounds chunk x capd.
 """
 
 from __future__ import annotations
@@ -49,55 +51,14 @@ import numpy as np
 
 BBox = Tuple[float, float, float, float]
 
-# kernel geometry bounded by scoped VMEM (~16 MB): the in-kernel one-hot
-# is [CHUNK, cap] f32, so CHUNK x MAX_CAP x 4 B must stay well under the
-# limit (the first hardware run allocated 64 MB at 4096x4096 and the
-# compile OOMed). Smaller data tiles also shrink per-tile Morton spans,
-# keeping more tiles on the sparse path at the smaller cap.
 DATA_TILE = 4096
 CHUNK = 2048
-MAX_CAP = 1024  # beyond this span the dense path is cheaper anyway
-
-
-def _interleave16(v):
-    """Spread the low 16 bits of each lane to even bit positions."""
-    v = v & 0xFFFF
-    v = (v | (v << 8)) & 0x00FF00FF
-    v = (v | (v << 4)) & 0x0F0F0F0F
-    v = (v | (v << 2)) & 0x33333333
-    v = (v | (v << 1)) & 0x55555555
-    return v
-
-
-def _morton_cells(col, row):
-    """Morton (Z) cell id from grid col/row (i32, grids up to 2^15)."""
-    return _interleave16(col) | (_interleave16(row) << 1)
-
-
-@functools.lru_cache(maxsize=8)
-def _raster_of_morton(width: int, height: int) -> np.ndarray:
-    """[n_morton] i32: raster index (row*W+col) per Morton cell id, for
-    the final permutation. Static per grid shape."""
-    side = 1 << int(np.ceil(np.log2(max(width, height, 2))))
-    cc, rr = np.meshgrid(np.arange(side), np.arange(side), indexing="xy")
-
-    def spread(v):
-        v = v.astype(np.uint32)
-        v = (v | (v << 8)) & np.uint32(0x00FF00FF)
-        v = (v | (v << 4)) & np.uint32(0x0F0F0F0F)
-        v = (v | (v << 2)) & np.uint32(0x33333333)
-        v = (v | (v << 1)) & np.uint32(0x55555555)
-        return v
-
-    z = spread(cc) | (spread(rr) << np.uint32(1))
-    out = np.full(side * side, width * height, np.int32)  # sink for pads
-    inb = (cc < width) & (rr < height)
-    out[z[inb]] = (rr[inb] * width + cc[inb]).astype(np.int32)
-    return out
+MAX_CAPD = 512   # beyond this many distinct cells the scatter path wins
+BIGCELL = 1 << 30
 
 
 def _bin_cells(x, y, mask, bbox: BBox, width: int, height: int):
-    """Shared binning math: (morton cell i32, in-bounds-and-masked)."""
+    """Shared binning math: (raster cell id row*W+col i32, in-bounds)."""
     xmin, ymin, xmax, ymax = bbox
     dx = (xmax - xmin) / width
     dy = (ymax - ymin) / height
@@ -106,101 +67,99 @@ def _bin_cells(x, y, mask, bbox: BBox, width: int, height: int):
     inb = (col >= 0) & (col < width) & (row >= 0) & (row < height) & mask
     col = jnp.clip(col, 0, width - 1)
     row = jnp.clip(row, 0, height - 1)
-    return _morton_cells(col, row), inb
+    return row * width + col, inb
 
 
 class DensityCalib(NamedTuple):
-    """Host-side plan from one calibration pass (cacheable across
-    queries, like the sparse kNN tile capacity)."""
+    """Plan from one calibration pass (cacheable across queries, like
+    the sparse kNN tile capacity). `dicts` is a DEVICE array."""
 
     tile_ids: np.ndarray   # [S] tiles the sparse kernel scans
-    tile_base: np.ndarray  # [S] morton base cell per tile
-    cap: int               # local one-hot width (pow2)
-    dense_ids: np.ndarray  # tiles overflowing cap -> dense fallback
+    dicts: object          # [S, capd] i32 device: distinct cells (-1 pad)
+    capd: int              # dictionary width (pow2)
+    dense_ids: np.ndarray  # tiles with > capd distinct cells -> fallback
     n_tiles: int
 
 
 @functools.partial(
     jax.jit, static_argnames=("bbox", "width", "height", "data_tile")
 )
-def _tile_ranges(x, y, mask, bbox: BBox, width: int, height: int,
-                 data_tile: int):
+def _tile_sorted_cells(x, y, mask, bbox: BBox, width: int, height: int,
+                       data_tile: int):
+    """Per-tile sorted cell ids (+BIGCELL for masked/out rows), first-
+    occurrence flags, and distinct counts."""
     n = x.shape[0]
     pad = (-n) % data_tile
     xp = jnp.pad(x.astype(jnp.float32), (0, pad))
     yp = jnp.pad(y.astype(jnp.float32), (0, pad))
     mp = jnp.pad(mask, (0, pad))
-    zc, ok = _bin_cells(xp, yp, mp, bbox, width, height)
-    nt = zc.shape[0] // data_tile
-    zt = zc.reshape(nt, data_tile)
-    okt = ok.reshape(nt, data_tile)
-    big = jnp.int32(1 << 30)
-    zmin = jnp.where(okt, zt, big).min(axis=1)
-    zmax = jnp.where(okt, zt, -1).max(axis=1)
-    return zmin, zmax
+    cells, ok = _bin_cells(xp, yp, mp, bbox, width, height)
+    nt = cells.shape[0] // data_tile
+    zt = jnp.where(ok, cells, BIGCELL).reshape(nt, data_tile)
+    s = jnp.sort(zt, axis=1)
+    live = s < BIGCELL
+    first = jnp.concatenate(
+        [live[:, :1],
+         (s[:, 1:] != s[:, :-1]) & live[:, 1:]], axis=1)
+    return s, first, jnp.sum(first.astype(jnp.int32), axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("capd",))
+def _tile_dicts(s, first, capd: int):
+    """[nt, capd] distinct-cell dictionaries (-1 pads): re-sort with
+    duplicates pushed to BIGCELL, take the first capd slots."""
+    t = jnp.where(first, s, BIGCELL)
+    t2 = jnp.sort(t, axis=1)[:, :capd]
+    return jnp.where(t2 >= BIGCELL, -1, t2).astype(jnp.int32)
 
 
 def calibrate_density(
     x, y, mask, bbox: BBox, width: int, height: int,
     data_tile: int = DATA_TILE, slack: float = 2.0,
 ) -> DensityCalib:
-    """One device pass + one small ([n_tiles] x2 i32) fetch: per-tile
-    Morton cell ranges under the CURRENT mask. cap is a pow2 bucket of
-    the median span x slack — covering most tiles keeps the one-hot
-    narrow; the tail goes to the dense fallback list."""
-    zmin, zmax = _tile_ranges(x, y, mask, bbox, width, height, data_tile)
-    zmin = np.asarray(zmin)
-    zmax = np.asarray(zmax)
-    nt = len(zmin)
-    has = zmax >= 0  # tile bears >= 1 matching point; others pruned
-    ids = np.nonzero(has)[0]
+    """One device sort pass + one small ([n_tiles] i32) fetch: per-tile
+    distinct-cell dictionaries under the CURRENT mask. capd is a pow2
+    bucket of the median distinct count x slack."""
+    s, first, distinct = _tile_sorted_cells(
+        x, y, mask, bbox, width, height, data_tile)
+    dn = np.asarray(distinct)
+    nt = len(dn)
+    ids = np.nonzero(dn > 0)[0]
     if len(ids) == 0:
         return DensityCalib(
-            np.zeros(0, np.int32), np.zeros(0, np.int32), 128,
+            np.zeros(0, np.int32), jnp.zeros((0, 8), jnp.int32), 8,
             np.zeros(0, np.int32), nt,
         )
-    span = zmax[ids] - zmin[ids] + 1
-    cap = int(min(MAX_CAP, max(
-        128, 1 << int(np.ceil(np.log2(max(np.median(span) * slack, 2))))
+    capd = int(min(MAX_CAPD, max(
+        8, 1 << int(np.ceil(np.log2(max(
+            float(np.median(dn[ids])) * slack, 2.0))))
     )))
-    fits = span <= cap
+    fits = dn[ids] <= capd
+    sel = ids[fits].astype(np.int32)
+    dicts = jnp.take(_tile_dicts(s, first, capd), jnp.asarray(sel), axis=0)
     return DensityCalib(
-        ids[fits].astype(np.int32),
-        zmin[ids][fits].astype(np.int32),
-        cap,
-        ids[~fits].astype(np.int32),
-        nt,
+        sel, dicts, capd, ids[~fits].astype(np.int32), nt,
     )
 
 
-def _make_kernel(data_tile: int, chunk: int, cap: int, bbox: BBox,
+def _make_kernel(data_tile: int, chunk: int, capd: int, bbox: BBox,
                  width: int, height: int):
-    def _kernel(ids_ref, base_ref, x_ref, y_ref, w_ref, m_ref, out_ref):
-        from jax.experimental import pallas as pl
-
-        p = pl.program_id(0)
-        base = base_ref[p]
-        iota = jax.lax.broadcasted_iota(jnp.int32, (1, cap), 1)
-        acc = jnp.zeros((1, cap), jnp.float32)
+    def _kernel(ids_ref, dict_ref, x_ref, y_ref, w_ref, m_ref, out_ref):
+        drow = dict_ref[0, 0, :].reshape(1, capd)
+        acc = jnp.zeros((1, capd), jnp.float32)
         for s in range(data_tile // chunk):
             sl = slice(s * chunk, (s + 1) * chunk)
-            zc, ok = _bin_cells(
+            cells, ok = _bin_cells(
                 x_ref[0, sl], y_ref[0, sl], m_ref[0, sl] > 0.5,
                 bbox, width, height,
             )
-            local = jnp.clip(zc - base, 0, cap - 1)
-            lw = jnp.where(
-                ok & (zc >= base) & (zc < base + cap),
-                w_ref[0, sl], 0.0,
-            ).reshape(1, chunk)
-            onehot = (
-                local.reshape(chunk, 1) == iota
-            ).astype(jnp.float32)
-            acc = acc + jax.lax.dot_general(
-                lw, onehot, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            )
+            # the mask folds into the f32 weights, NOT a bool reshape:
+            # Mosaic rejects minor-dim insertion on i1 vectors
+            lw = jnp.where(ok, w_ref[0, sl], 0.0).reshape(chunk, 1)
+            match = cells.reshape(chunk, 1) == drow
+            acc = acc + jnp.sum(
+                jnp.where(match, lw, 0.0), axis=0,
+            ).reshape(1, capd)
         out_ref[...] = acc.reshape(out_ref.shape)
 
     return _kernel
@@ -209,12 +168,12 @@ def _make_kernel(data_tile: int, chunk: int, cap: int, bbox: BBox,
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "cap", "bbox", "width", "height", "data_tile", "chunk", "interpret"
+        "capd", "bbox", "width", "height", "data_tile", "chunk", "interpret"
     ),
 )
 def _zsparse_call(
-    x, y, w, maskf, tile_ids, tile_base,
-    cap: int, bbox: BBox, width: int, height: int,
+    x, y, w, maskf, tile_ids, dicts,
+    capd: int, bbox: BBox, width: int, height: int,
     data_tile: int, chunk: int, interpret: bool,
 ):
     from jax.experimental import pallas as pl
@@ -226,47 +185,35 @@ def _zsparse_call(
     yr = y.astype(jnp.float32).reshape(1, n)
     wr = w.astype(jnp.float32).reshape(1, n)
     mr = maskf.reshape(1, n)
+    dr = dicts.reshape(s, 1, capd)
 
-    data_block = pl.BlockSpec(
-        (1, data_tile), lambda p, ids, base: (0, ids[p])
-    )
-    # out rows live in a 3-D [S, 1, cap] array with (1, 1, cap) blocks:
-    # Mosaic requires the last two block dims divisible by (8, 128) OR
-    # equal to the array dims — a 2-D (1, cap) block over [S, cap] fails
-    # that check (caught on hardware; interpret mode never sees Mosaic)
+    data_block = pl.BlockSpec((1, data_tile), lambda p, ids: (0, ids[p]))
+    dict_block = pl.BlockSpec((1, 1, capd), lambda p, ids: (p, 0, 0))
     with jax.enable_x64(False):
         counts = pl.pallas_call(
-            _make_kernel(data_tile, chunk, cap, bbox, width, height),
+            _make_kernel(data_tile, chunk, capd, bbox, width, height),
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2,
+                num_scalar_prefetch=1,
                 grid=(s,),
-                in_specs=[data_block] * 4,
+                in_specs=[dict_block] + [data_block] * 4,
                 out_specs=pl.BlockSpec(
-                    (1, 1, cap), lambda p, ids, base: (p, 0, 0)),
+                    (1, 1, capd), lambda p, ids: (p, 0, 0)),
             ),
-            out_shape=jax.ShapeDtypeStruct((s, 1, cap), jnp.float32),
+            out_shape=jax.ShapeDtypeStruct((s, 1, capd), jnp.float32),
             interpret=interpret,
-        )(tile_ids.astype(jnp.int32), tile_base.astype(jnp.int32),
-          xr, yr, wr, mr)
-    return counts.reshape(s, cap)
+        )(tile_ids.astype(jnp.int32), dr, xr, yr, wr, mr)
+    return counts.reshape(s, capd)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cap", "width", "height"),
-)
-def _fold_counts(counts, tile_base, raster_of_z, cap: int, width: int,
-                 height: int):
-    """Scatter per-tile count rows into the Morton-flat grid, then
-    permute Morton -> raster (one static scatter each)."""
-    n_morton = raster_of_z.shape[0]
-    idx = tile_base[:, None] + jnp.arange(cap, dtype=jnp.int32)[None, :]
-    flat_z = jnp.zeros(n_morton + cap, jnp.float32)
-    flat_z = flat_z.at[idx.reshape(-1)].add(counts.reshape(-1))
-    # raster_of_z routes Morton pads (cells outside WxH) to a sink slot
-    grid = jnp.zeros(width * height + 1, jnp.float32)
-    grid = grid.at[raster_of_z].add(flat_z[:n_morton])
-    return grid[: width * height].reshape(height, width)
+@functools.partial(jax.jit, static_argnames=("width", "height"))
+def _fold_counts(counts, dicts, width: int, height: int):
+    """Scatter per-tile count rows into the raster grid via their cell
+    dictionaries (-1 pads route to a sink slot)."""
+    sink = width * height
+    idx = jnp.where(dicts < 0, sink, dicts)
+    grid = jnp.zeros(sink + 1, jnp.float32)
+    grid = grid.at[idx.reshape(-1)].add(counts.reshape(-1))
+    return grid[:sink].reshape(height, width)
 
 
 @functools.partial(
@@ -292,20 +239,20 @@ def density_zsparse(
 ) -> Tuple[jax.Array, DensityCalib]:
     """Store-order density grid (see module docstring). Returns
     ([height, width] f32 grid, calib) — pass `calib` back in on repeat
-    queries over the same batch+filter to skip the calibration fetch.
+    queries over the same batch+filter to skip the calibration pass.
     Exact contract of `density.density_grid` for any input order; the
     sparse win requires store (Z) order, the fallback keeps it correct
     otherwise.
 
     A REUSED calib is validated (`check_stale`): unlike the kNN tile
     capacity, a stale density plan is a silent correctness failure (a
-    point in a tile pruned under the OLD mask, or outside a tile's
-    cached cell band, would vanish from the grid), so the grid's total
-    mass is checked against the mask's expected mass and a mismatch
-    triggers automatic recalibration. Callers looping the IDENTICAL
-    query (mask unchanged) may pass check_stale=False to skip the extra
-    device reduction + fetch."""
-    from geomesa_tpu.engine.density import density_grid_mxu
+    point in a tile pruned under the OLD mask, or whose cell is missing
+    from the tile's cached dictionary, would vanish from the grid), so
+    the grid's total mass is checked against the mask's expected mass
+    and a mismatch triggers automatic recalibration. Callers looping
+    the IDENTICAL query (mask unchanged) may pass check_stale=False to
+    skip the extra device reduction + fetch."""
+    from geomesa_tpu.engine.density import density_grid
 
     reused_calib = calib is not None
     n = x.shape[0]
@@ -321,49 +268,46 @@ def density_zsparse(
 
     grid = jnp.zeros((height, width), jnp.float32)
     if len(calib.tile_ids):
-        raster = jnp.asarray(_raster_of_morton(width, height))
-        # chunk the tile list so one call's output stays ~4 MB: XLA may
-        # place a pallas output in VMEM, and a full [S, 1, cap] count
-        # array blew the 16 MB scoped-vmem limit at bench scale (caught
-        # on hardware: S=3074, cap=4096 -> 50 MB)
-        maxs = max(256, (1 << 20) // max(calib.cap, 1))
+        # chunk the tile list so one call's output + dictionary operand
+        # stay small (XLA may place a pallas output in VMEM; a full
+        # [S, 1, cap] array blew the 16 MB scoped limit at bench scale)
+        maxs = max(256, (1 << 20) // max(calib.capd, 1))
         S = len(calib.tile_ids)
         for c0 in range(0, S, maxs):
             c1 = min(c0 + maxs, S)
             ids_c = calib.tile_ids[c0:c1]
-            base_c = calib.tile_base[c0:c1]
+            dict_c = calib.dicts[c0:c1]
             pad_c = maxs - len(ids_c) if S > maxs else 0
             if pad_c:  # stable shapes across chunks (one compile)
                 ids_c = np.concatenate(
                     [ids_c, np.full(pad_c, ids_c[0], ids_c.dtype)])
-                base_c = np.concatenate(
-                    [base_c, np.full(pad_c, 1 << 29, base_c.dtype)])
-                # padding rows re-scan a real tile with an impossible
-                # base: every local index clips out, contributing zeros
+                dict_c = jnp.concatenate([
+                    dict_c,
+                    jnp.full((pad_c, calib.capd), -1, jnp.int32),
+                ])
+                # padding rows re-scan a real tile against an all-pad
+                # dictionary: nothing matches, zeros fold into the sink
             counts = _zsparse_call(
                 xp, yp, wp, mp.astype(jnp.float32),
-                jnp.asarray(ids_c), jnp.asarray(base_c),
-                cap=calib.cap, bbox=tuple(bbox), width=width,
+                jnp.asarray(ids_c), jnp.asarray(dict_c),
+                capd=calib.capd, bbox=tuple(bbox), width=width,
                 height=height,
                 data_tile=data_tile, chunk=min(CHUNK, data_tile),
                 interpret=interpret,
             )
             grid = grid + _fold_counts(
-                counts, jnp.asarray(base_c), raster,
-                cap=calib.cap, width=width, height=height,
-            )
+                counts, dict_c, width=width, height=height)
     if len(calib.dense_ids):
-        # overflow tiles (Z seams / sparse regions): block-gather their
-        # points (contiguous 16k rows — fast) and run the dense MXU path
+        # overflow tiles (unsorted input / cell-dense regions): block-
+        # gather their points and take the EXACT scatter path (the bf16
+        # hi/lo MXU fallback failed the weighted cells-parity gate)
         ids = jnp.asarray(calib.dense_ids)
         gx = jnp.take(xp.reshape(-1, data_tile), ids, axis=0).reshape(-1)
         gy = jnp.take(yp.reshape(-1, data_tile), ids, axis=0).reshape(-1)
         gw = jnp.take(wp.reshape(-1, data_tile), ids, axis=0).reshape(-1)
         gm = jnp.take(mp.reshape(-1, data_tile), ids, axis=0).reshape(-1)
-        grid = grid + density_grid_mxu(
-            gx, gy, gw, gm, tuple(bbox), width, height,
-            point_tile=min(8192, max(len(calib.dense_ids) * data_tile, 128)),
-        )
+        grid = grid + density_grid(gx, gy, gw, gm, tuple(bbox),
+                                   width, height)
     if reused_calib and check_stale:
         expected = float(_expected_mass(
             xp, yp, wp, mp, tuple(bbox), width, height))

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 from geomesa_tpu.engine.density import density_grid
 from geomesa_tpu.engine.density_zsparse import (
-    _raster_of_morton, calibrate_density, density_zsparse)
+    calibrate_density, density_zsparse)
 
 BBOX = (-60.0, -45.0, 60.0, 45.0)
 
@@ -125,13 +125,23 @@ class TestZsparseDensity:
             x, y, np.ones(n), np.ones(n, bool), weights=np.ones(n))
         assert got.sum() == 0
 
-    def test_raster_of_morton_permutation(self):
-        # every raster cell appears exactly once; pads hit the sink
-        for W, H in [(64, 64), (48, 32), (512, 512)]:
-            r = _raster_of_morton(W, H)
-            real = r[r < W * H]
-            assert len(real) == W * H
-            assert len(np.unique(real)) == W * H
+    def test_dictionaries_cover_distinct_cells(self):
+        # each selected tile's dictionary holds exactly its distinct
+        # matching cells (pads are -1)
+        x, y, w, mask = make(1 << 13, seed=25)
+        jx = jnp.asarray(x, jnp.float32)
+        jy = jnp.asarray(y, jnp.float32)
+        jm = jnp.asarray(mask)
+        calib = calibrate_density(jx, jy, jm, BBOX, 64, 64, data_tile=1024)
+        from geomesa_tpu.engine.density_zsparse import _bin_cells
+        cells = np.asarray(_bin_cells(jx, jy, jm, BBOX, 64, 64)[0])
+        ok = np.asarray(_bin_cells(jx, jy, jm, BBOX, 64, 64)[1])
+        dicts = np.asarray(calib.dicts)
+        for row, t in enumerate(calib.tile_ids[:8]):
+            sl = slice(t * 1024, (t + 1) * 1024)
+            exp = np.unique(cells[sl][ok[sl]])
+            got = dicts[row][dicts[row] >= 0]
+            np.testing.assert_array_equal(np.sort(got), exp)
 
     def test_non_square_grid(self):
         x, y, w, mask = make(1 << 14, seed=19)
