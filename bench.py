@@ -18,10 +18,98 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# ---------------------------------------------------------------------------
+# Harness plumbing (round 5): the round-4 driver run timed out with ZERO
+# output (BENCH_r04.json rc=124, parsed=null) because this file printed one
+# JSON line only at the very end of every phase. The driver parses the LAST
+# JSON line of the stdout tail, so the contract is now:
+#   1. print the HEADLINE line as soon as the device pipeline + parity gate
+#      + CPU baseline are done (a timeout after that still leaves a number);
+#   2. run budget-gated extras (phase accounting, burst) and print one
+#      richer line at the end — last-line-wins upgrades the headline;
+#   3. narrate progress on stderr so a timeout leaves a trace;
+#   4. cache the deterministic CPU baseline on disk (.bench_cache/) and the
+#      XLA executables (.jax_cache/ via the persistent compilation cache —
+#      remote compiles through the tunnel cost 4-120 s each).
+# ---------------------------------------------------------------------------
+
+START = time.time()
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def log(msg):
+    """Progress note on stderr (stdout carries only the JSON lines)."""
+    print(f"[bench +{time.time() - START:6.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+BUDGET_DEFAULT_S = 360.0
+
+
+def budget_total_s():
+    return float(
+        os.environ.get("GEOMESA_TPU_BENCH_BUDGET_S", str(BUDGET_DEFAULT_S)))
+
+
+def budget_remaining_s():
+    """Seconds left of the internal wall-clock budget. Phases that are not
+    needed for the headline line degrade (fewer repeats) or skip entirely
+    when this runs low — a slow tunnel day must shrink the run, not kill
+    it silently (VERDICT r4 weak #1)."""
+    return budget_total_s() - (time.time() - START)
+
+
+def enable_compile_cache():
+    """Persistent XLA compilation cache shared across bench runs (and with
+    the driver's run). Verified working through the axon tunnel: a 2048^2
+    matmul compile drops 3.7 s -> 1.2 s; the Mosaic kernels are the ones
+    that cost 60-120 s cold."""
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:  # cache is an optimization, never a failure
+        log(f"compile cache unavailable: {e}")
+
+
+def cached_cpu_baseline(key: str, compute):
+    """Disk cache for deterministic CPU-baseline measurements.
+
+    `compute()` returns a dict of numpy arrays/scalars; it is stored as an
+    .npz under .bench_cache/ keyed by the workload tuple. The baselines are
+    deterministic (fixed seeds), so re-measuring 3x34 s of NumPy per run
+    was pure waste (VERDICT r4 task 1b). Timing numbers in the cache were
+    measured once on this same host."""
+    d = os.path.join(_REPO, ".bench_cache")
+    path = os.path.join(d, key + ".npz")
+    if os.path.exists(path):
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                out = {k: z[k] for k in z.files}
+            log(f"cpu baseline cache HIT {key}")
+            return out
+        except Exception as e:
+            log(f"cpu baseline cache unreadable ({e}); recomputing")
+    out = compute()
+    try:
+        os.makedirs(d, exist_ok=True)
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **out)
+        os.replace(tmp, path)
+        log(f"cpu baseline cache WROTE {key}")
+    except Exception as e:
+        log(f"cpu baseline cache write failed: {e}")
+    return out
 
 
 def _clustered(rng, n, extent, ncenters=64, frac_bg=0.1):
@@ -51,7 +139,8 @@ def _clustered(rng, n, extent, ncenters=64, frac_bg=0.1):
     return np.clip(x, x0 + mx, x1 - mx), np.clip(y, y0 + my, y1 - my), cx, cy
 
 
-def _cpu_baseline(x, y, t, speed, qx, qy, k, bbox, t0, t1, repeats=3):
+def _cpu_baseline(x, y, t, speed, qx, qy, k, bbox, t0, t1, repeats=3,
+                  warm=True):
     """Vectorized NumPy: mask + argpartition kNN (per query, masked)."""
     from geomesa_tpu.engine.geodesy import haversine_m_np
 
@@ -72,7 +161,8 @@ def _cpu_baseline(x, y, t, speed, qx, qy, k, bbox, t0, t1, repeats=3):
                 out[i, len(d):] = np.inf
         return int(mask.sum()), out
 
-    run()  # warm caches
+    if warm:
+        run()  # warm caches
     best = np.inf
     for _ in range(repeats):
         s = time.perf_counter()
@@ -930,7 +1020,6 @@ def bench_fs_query(n, repeats, tmpdir=None, cold=False):
     """Config 1: BBOX+time CQL through the full FS Parquet DataStore stack
     (plan -> prune -> parquet pushdown -> device residual mask), CPU
     baseline = the same filter in flat NumPy over the raw arrays."""
-    import os
     import shutil
     import tempfile
 
@@ -1394,8 +1483,6 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if args.smoke:
-        import os
-
         os.environ.setdefault("XLA_FLAGS", "")
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
@@ -1406,6 +1493,10 @@ def main(argv=None) -> int:
         # or pallas' tpu lowering registration fails at import
         xb._backend_factories.pop("axon", None)
         jax.config.update("jax_platforms", "cpu")
+
+    enable_compile_cache()
+    log(f"bench start: argv={argv if argv is not None else sys.argv[1:]}, "
+        f"budget={budget_total_s():.0f}s")
 
     # 1<<26 amortizes the remote-tunnel dispatch floor (~105ms/round trip)
     # over a GDELT-realistic batch; both sides scan the same n. Configs
@@ -1469,6 +1560,8 @@ def main(argv=None) -> int:
 
     from geomesa_tpu.engine.knn import knn, knn_compact, knn_mxu
 
+    log(f"generating {n / 1e6:.0f}M-point workload ({args.dist}, "
+        f"{args.order} order)")
     rng = np.random.default_rng(42)
     if args.dist == "clustered":
         # hotspot mixture (AIS/GDELT shape); queries drawn NEAR hotspots,
@@ -1632,12 +1725,15 @@ def main(argv=None) -> int:
 
         return step
 
+    log("uploading arrays to device (~1.3GB at 67M; tunnel h2d ~0.05GB/s)")
     dx = jnp.asarray(x, jnp.float32)
     dy = jnp.asarray(y, jnp.float32)
     dt = jnp.asarray(t, jnp.int64)
     dspeed = jnp.asarray(speed, jnp.float32)
     dqx = jnp.asarray(qx, jnp.float32)
     dqy = jnp.asarray(qy, jnp.float32)
+    _sync(dspeed)
+    log("upload done; building step")
 
     if args.impl == "process":
         step = process_step_factory()
@@ -1647,96 +1743,106 @@ def main(argv=None) -> int:
         step = {"compact": compact_step, "grid": grid_step}.get(
             args.impl, device_step
         )
+    log("compiling + warming device pipeline")
     count, dists = step(dx, dy, dt, dspeed, dqx, dqy)
     _sync(dists)  # compile + warm
+    log("device pipeline warm; timing")
+    reps = 2 if args.smoke else (5 if budget_remaining_s() > 60 else 2)
     best = np.inf
-    for _ in range(5 if not args.smoke else 2):
+    for _ in range(reps):
         s = time.perf_counter()
         count, dists = step(dx, dy, dt, dspeed, dqx, dqy)
         _sync(dists)
         best = min(best, time.perf_counter() - s)
     tpu_pps = n / best
+    log(f"device best-of-{reps}: {best:.4f}s ({tpu_pps / 1e6:.0f}M pts/s)")
 
-    # per-phase accounting. The remote tunnel adds ~100-120ms (+-20ms
-    # jitter) per dispatched step, which swamps a ~10ms kernel, so net
-    # device time is measured as the DOUBLE-DISPATCH MARGINAL: two
-    # back-to-back dispatches queue on device, and t(2 steps, 1 sync) -
-    # t(1 step) isolates pure execution from the tunnel round trip
-    one = jnp.float32(1.0)
-    triv = jax.jit(lambda a: a + 1)
-    rtt = _timeit(lambda: _sync(triv(one)), 3 if args.smoke else 8)
+    # --- f64-exact match count (VERDICT r3 #5), host-side (round 5) --------
+    # the device mask runs on f32 coords/speed, so rows within the f32 ulp
+    # band of a bbox edge or the speed threshold can flip sides vs the f64
+    # oracle. NumPy f32 comparisons are bit-identical to the device's, so
+    # the whole band correction runs host-side: no extra device compile and
+    # no gather round trips (round 4 spent a dedicated jit on this; its
+    # compile contributed to the driver timeout).
+    from geomesa_tpu.cql.compile import f32_ulp_band as _eps
 
-    def dbl():
-        step(dx, dy, dt, dspeed, dqx, dqy)
-        _sync(step(dx, dy, dt, dspeed, dqx, dqy)[1])
+    f32 = np.float32
+    xf, yf, sf = x.astype(f32), y.astype(f32), speed.astype(f32)
+    band_np = (
+        (np.abs(xf - f32(BBOX[0])) <= _eps(BBOX[0]))
+        | (np.abs(xf - f32(BBOX[2])) <= _eps(BBOX[2]))
+        | (np.abs(yf - f32(BBOX[1])) <= _eps(BBOX[1]))
+        | (np.abs(yf - f32(BBOX[3])) <= _eps(BBOX[3]))
+        | (np.abs(sf - f32(5.0)) <= _eps(5.0))
+    )
+    bidx = np.nonzero(band_np)[0]
+    nband = int(len(bidx))
+    match_exact = int(np.asarray(count))
+    if nband:
+        approx = int(np.sum(
+            (xf[bidx] >= f32(BBOX[0])) & (xf[bidx] <= f32(BBOX[2]))
+            & (yf[bidx] >= f32(BBOX[1])) & (yf[bidx] <= f32(BBOX[3]))
+            & (t[bidx] > T0) & (t[bidx] < T1) & (sf[bidx] > f32(5.0))
+        ))
+        exact = int(np.sum(
+            (x[bidx] >= BBOX[0]) & (x[bidx] <= BBOX[2])
+            & (y[bidx] >= BBOX[1]) & (y[bidx] <= BBOX[3])
+            & (t[bidx] > T0) & (t[bidx] < T1) & (speed[bidx] > 5.0)
+        ))
+        match_exact += exact - approx
+    log(f"band-exact count {match_exact} ({nband} band rows, host-refined)")
 
-    t_double = _timeit(dbl, 1 if args.smoke else 3)
-    net = max(t_double - best, 1e-4)
-
-    def mask_dbl():
-        mask_count(dx, dy, dt, dspeed)
-        _sync(mask_count(dx, dy, dt, dspeed)[1])
-
-    mask_1 = _timeit(lambda: _sync(mask_count(dx, dy, dt, dspeed)[1]),
-                     1 if args.smoke else 3)
-    mask_net = max(_timeit(mask_dbl, 1 if args.smoke else 3) - mask_1, 0.0)
-    # sustained throughput: R steps in flight, one sync sweep — the
-    # server regime where dispatch latency overlaps device compute
-    R = 2 if args.smoke else 6
-
-    def burst():
-        outs = [step(dx, dy, dt, dspeed, dqx, dqy)[1] for _ in range(R)]
-        for o in outs:
-            _sync(o)
-
-    sus = _timeit(burst, 1 if args.smoke else 2)
-    sustained_pps = R * n / sus
-
-    # --- CPU baseline ------------------------------------------------------
+    # --- CPU baseline (disk-cached — deterministic workload) ---------------
     # measured single-core NumPy (mask + argpartition kNN) and the
     # extrapolated 32-vCPU row the north star names (BASELINE.json): 32x
     # perfect scaling — the WORST case for the device ratio, see
     # BASELINE.md for the Accumulo-iterator-vs-NumPy per-core argument
-    cpu_time, cpu_count, cpu_dists = _cpu_baseline(
-        x, y, t, speed, qx, qy, k, BBOX, T0, T1,
-        repeats=1 if args.smoke else 3,
-    )
+    ckey = f"c3_n{n}_q{q}_k{k}_{args.dist}_{args.order}_s42"
+
+    def _compute_cpu():
+        # ~2M pts/s measured => one repeat ~ n/2e6 s; only multi-repeat
+        # when the budget clearly affords it
+        est = n / 2e6
+        creps = 1 if (args.smoke or budget_remaining_s() < 3.5 * est) else 3
+        log(f"cpu baseline: {creps} repeat(s), ~{est:.0f}s each")
+        ct, cc, cd = _cpu_baseline(
+            x, y, t, speed, qx, qy, k, BBOX, T0, T1,
+            repeats=creps, warm=creps > 1,
+        )
+        return {"cpu_time": ct, "cpu_count": cc, "cpu_dists": cd,
+                "cpu_repeats": creps}
+
+    cb = cached_cpu_baseline(ckey, _compute_cpu)
+    if (not args.smoke
+            and int(cb.get("cpu_repeats", 3)) < 3
+            and budget_remaining_s() > 4.5 * float(cb["cpu_time"])):
+        # a budget-squeezed earlier run cached a single repeat; upgrade to
+        # best-of-3 and keep the MIN ever measured — the strongest CPU
+        # baseline is the conservative ratio. cpu_repeats records what the
+        # FRESH measurement actually ran (a budget dip mid-upgrade may
+        # still produce 1 — review finding: never stamp 3 unearned).
+        log("upgrading cached cpu baseline to best-of-3")
+        fresh = _compute_cpu()
+        merged = dict(fresh) if (
+            float(fresh["cpu_time"]) < float(cb["cpu_time"])) else dict(cb)
+        merged["cpu_time"] = min(float(fresh["cpu_time"]),
+                                 float(cb["cpu_time"]))
+        merged["cpu_repeats"] = max(int(fresh["cpu_repeats"]),
+                                    int(cb.get("cpu_repeats", 1)))
+        cb = merged
+        try:
+            d = os.path.join(_REPO, ".bench_cache")
+            tmp = os.path.join(d, ckey + f".npz.tmp{os.getpid()}")
+            with open(tmp, "wb") as f:
+                np.savez(f, **cb)
+            os.replace(tmp, os.path.join(d, ckey + ".npz"))
+        except Exception as e:
+            log(f"cache update failed: {e}")
+    cpu_time = float(cb["cpu_time"])
+    cpu_count = int(cb["cpu_count"])
+    cpu_dists = np.asarray(cb["cpu_dists"])
     cpu_pps = n / cpu_time
     cpu32_pps = cpu_pps * 32
-
-    # --- f64-exact match count (VERDICT r3 #5) -----------------------------
-    # the device mask runs on f32 coords/speed, so rows within the f32
-    # ulp band of a bbox edge or the speed threshold can flip sides vs
-    # the f64 oracle (round-3's +-1-in-67M caveat). Correct the device
-    # count by re-evaluating ONLY the band rows in f64 host-side — a
-    # handful of indices cross the tunnel, never the mask.
-    from geomesa_tpu.cql.compile import f32_ulp_band as _eps
-
-    @jax.jit
-    def _band_mask():
-        band = (
-            (jnp.abs(dx - BBOX[0]) <= _eps(BBOX[0]))
-            | (jnp.abs(dx - BBOX[2]) <= _eps(BBOX[2]))
-            | (jnp.abs(dy - BBOX[1]) <= _eps(BBOX[1]))
-            | (jnp.abs(dy - BBOX[3]) <= _eps(BBOX[3]))
-            | (jnp.abs(dspeed - 5.0) <= _eps(5.0))
-        )
-        return band, jnp.sum(band.astype(jnp.int32))
-
-    bandm, nb_dev = _band_mask()
-    nb = int(np.asarray(nb_dev))
-    match_exact = int(np.asarray(count))
-    if nb:
-        idx = np.asarray(jnp.nonzero(bandm, size=nb)[0])
-        approx = int(np.asarray(jnp.sum(
-            mask_count(dx, dy, dt, dspeed)[0][jnp.asarray(idx)],
-            dtype=jnp.int32)))
-        exact = int(np.sum(
-            (x[idx] >= BBOX[0]) & (x[idx] <= BBOX[2])
-            & (y[idx] >= BBOX[1]) & (y[idx] <= BBOX[3])
-            & (t[idx] > T0) & (t[idx] < T1) & (speed[idx] > 5.0)
-        ))
-        match_exact += exact - approx
 
     # --- recall parity gate ------------------------------------------------
     got = np.sort(np.asarray(dists), axis=1)
@@ -1748,57 +1854,110 @@ def main(argv=None) -> int:
     if hasattr(step, "check"):
         recall_ok = recall_ok and step.check()  # no silent tile overflow
 
-    eff_gbps = n * 20 / net / 1e9  # 20 B/pt: x,y,speed f32 + t i64
-    print(
-        json.dumps(
-            {
-                "metric": "gdelt_bbox_time_knn_points_per_sec_per_chip",
-                "value": round(tpu_pps, 1),
-                "unit": "points/sec",
-                "vs_baseline": round(tpu_pps / cpu32_pps, 3),
-                "detail": {
-                    "n": n,
-                    "queries": q,
-                    "k": k,
-                    "impl": args.impl,
-                    "order": args.order,
-                    "device": jax.devices()[0].platform,
-                    "device_time_s": round(best, 5),
-                    "sustained_points_per_sec": round(sustained_pps, 1),
-                    "phases": {
-                        "dispatch_rtt_s": round(rtt, 5),
-                        "device_net_s": round(net, 5),
-                        "mask_net_s": round(mask_net, 5),
-                        "knn_net_s": round(max(net - mask_net, 0.0), 5),
-                        "method": "double-dispatch marginal (tunnel RTT "
-                                  "jitter exceeds kernel time)",
-                    },
-                    "effective_scan_gbps": round(eff_gbps, 2),
-                    "hbm_peak_frac": round(eff_gbps / 819.0, 4),
-                    "cpu_time_s": round(cpu_time, 5),
-                    "cpu_points_per_sec": round(cpu_pps, 1),
-                    "cpu32_points_per_sec": round(cpu32_pps, 1),
-                    "vs_1core": round(tpu_pps / cpu_pps, 3),
-                    "baseline": "32-vCPU perfect-scaling extrapolation "
-                                "of measured single-core NumPy "
-                                "(BASELINE.md round-3 notes)",
-                    "dist": args.dist,
-                    "match_count": match_exact,
-                    "match_count_f32": int(count),
-                    "band_rows": nb,
-                    "cpu_match_count": cpu_count,
-                    "count_exact": match_exact == cpu_count,
-                    "recall_parity": recall_ok,
-                    **(
-                        {"tiles_hit": step.tiles_hit,
-                         "tile_capacity": step.tile_capacity,
-                         "ntiles": step.ntiles}
-                        if hasattr(step, "tiles_hit") else {}
-                    ),
-                },
+    detail = {
+        "n": n,
+        "queries": q,
+        "k": k,
+        "impl": args.impl,
+        "order": args.order,
+        "device": jax.devices()[0].platform,
+        "device_time_s": round(best, 5),
+        "cpu_time_s": round(cpu_time, 5),
+        "cpu_points_per_sec": round(cpu_pps, 1),
+        "cpu32_points_per_sec": round(cpu32_pps, 1),
+        "vs_1core": round(tpu_pps / cpu_pps, 3),
+        "baseline": "32-vCPU perfect-scaling extrapolation "
+                    "of measured single-core NumPy "
+                    "(BASELINE.md round-3 notes)",
+        "dist": args.dist,
+        "match_count": match_exact,
+        "match_count_f32": int(count),
+        "band_rows": nband,
+        "cpu_match_count": cpu_count,
+        "count_exact": match_exact == cpu_count,
+        "recall_parity": recall_ok,
+        **(
+            {"tiles_hit": step.tiles_hit,
+             "tile_capacity": step.tile_capacity,
+             "ntiles": step.ntiles}
+            if hasattr(step, "tiles_hit") else {}
+        ),
+    }
+    headline = {
+        "metric": "gdelt_bbox_time_knn_points_per_sec_per_chip",
+        "value": round(tpu_pps, 1),
+        "unit": "points/sec",
+        "vs_baseline": round(tpu_pps / cpu32_pps, 3),
+        "detail": detail,
+    }
+    # HEADLINE OUT NOW: a timeout during the extras below still leaves the
+    # driver a parseable last line (the richer reprint below upgrades it)
+    print(json.dumps(headline), flush=True)
+    log("headline printed; running budget-gated extras")
+
+    # --- extras: phase accounting + sustained burst (budget-gated) ---------
+    # The remote tunnel adds ~100-120ms (+-20ms jitter) per dispatched
+    # step, which swamps a ~10ms kernel, so net device time is measured as
+    # the DOUBLE-DISPATCH MARGINAL: two back-to-back dispatches queue on
+    # device, and t(2 steps, 1 sync) - t(1 step) isolates pure execution
+    # from the tunnel round trip.
+    try:
+        if budget_remaining_s() > 20:
+            one = jnp.float32(1.0)
+            triv = jax.jit(lambda a: a + 1)
+            rtt = _timeit(lambda: _sync(triv(one)), 3 if args.smoke else 8)
+
+            def dbl():
+                step(dx, dy, dt, dspeed, dqx, dqy)
+                _sync(step(dx, dy, dt, dspeed, dqx, dqy)[1])
+
+            t_double = _timeit(dbl, 1 if args.smoke else 3)
+            net = max(t_double - best, 1e-4)
+            eff_gbps = n * 20 / net / 1e9  # 20 B/pt: x,y,speed f32 + t i64
+            detail["phases"] = {
+                "dispatch_rtt_s": round(rtt, 5),
+                "device_net_s": round(net, 5),
+                "method": "double-dispatch marginal (tunnel RTT "
+                          "jitter exceeds kernel time)",
             }
-        )
-    )
+            detail["effective_scan_gbps"] = round(eff_gbps, 2)
+            detail["hbm_peak_frac"] = round(eff_gbps / 819.0, 4)
+            log(f"net device {net:.4f}s, rtt {rtt:.4f}s")
+        if budget_remaining_s() > 45:
+            # mask_count standalone is a separate (cacheable) compile
+            def mask_dbl():
+                mask_count(dx, dy, dt, dspeed)
+                _sync(mask_count(dx, dy, dt, dspeed)[1])
+
+            mask_1 = _timeit(
+                lambda: _sync(mask_count(dx, dy, dt, dspeed)[1]),
+                1 if args.smoke else 3)
+            mask_net = max(
+                _timeit(mask_dbl, 1 if args.smoke else 3) - mask_1, 0.0)
+            detail["phases"]["mask_net_s"] = round(mask_net, 5)
+            detail["phases"]["knn_net_s"] = round(
+                max(net - mask_net, 0.0), 5)
+            log(f"mask net {mask_net:.4f}s")
+        if budget_remaining_s() > 20:
+            # sustained throughput: R steps in flight, one sync sweep —
+            # the server regime where dispatch latency overlaps compute
+            R = 2 if args.smoke else 6
+
+            def burst():
+                outs = [step(dx, dy, dt, dspeed, dqx, dqy)[1]
+                        for _ in range(R)]
+                for o in outs:
+                    _sync(o)
+
+            sus = _timeit(burst, 1 if args.smoke else 2)
+            detail["sustained_points_per_sec"] = round(R * n / sus, 1)
+            log(f"sustained {R * n / sus / 1e6:.0f}M pts/s")
+        else:
+            log(f"extras trimmed (budget {budget_remaining_s():.0f}s left)")
+    except Exception as e:  # extras must never cost us the headline
+        log(f"extras failed ({type(e).__name__}: {e}); headline stands")
+
+    print(json.dumps(headline), flush=True)  # last-line-wins, richer
     return 0
 
 

@@ -44,6 +44,128 @@ def _from_mercator(x, y):
     return lon, lat
 
 
+# --- UTM zone family (round 5, VERDICT r4 task 8) --------------------------
+# EPSG:326zz (north) / 327zz (south), zz = 01..60. Ellipsoidal transverse
+# Mercator via the 6th-order Krueger flattening series (the formulation
+# PROJ's `tmerc` approximates; in-zone error << 1 mm on WGS84). UTM is the
+# most common analytic output frame after 3857 (upstream reprojection is
+# any GeoTools CRS — SURVEY.md:219-220; this covers the projected family
+# analysts actually request).
+
+_WGS84_A = 6378137.0
+_WGS84_F = 1.0 / 298.257223563
+_UTM_K0 = 0.9996
+_UTM_FE = 500_000.0
+_UTM_FN_SOUTH = 10_000_000.0
+
+_N = _WGS84_F / (2.0 - _WGS84_F)
+
+
+def _series(coeffs):
+    return np.array(coeffs, np.float64)
+
+
+_n = _N
+# rectifying radius and the alpha/beta/delta series in n (Krueger 1912,
+# coefficients as tabulated by Deakin/Karney to n^6)
+_A_RECT = _WGS84_A / (1 + _n) * (
+    1 + _n**2 / 4 + _n**4 / 64 + _n**6 / 256)
+_ALPHA = _series([
+    _n / 2 - 2 * _n**2 / 3 + 5 * _n**3 / 16 + 41 * _n**4 / 180
+    - 127 * _n**5 / 288 + 7891 * _n**6 / 37800,
+    13 * _n**2 / 48 - 3 * _n**3 / 5 + 557 * _n**4 / 1440
+    + 281 * _n**5 / 630 - 1983433 * _n**6 / 1935360,
+    61 * _n**3 / 240 - 103 * _n**4 / 140 + 15061 * _n**5 / 26880
+    + 167603 * _n**6 / 181440,
+    49561 * _n**4 / 161280 - 179 * _n**5 / 168 + 6601661 * _n**6 / 7257600,
+    34729 * _n**5 / 80640 - 3418889 * _n**6 / 1995840,
+    212378941 * _n**6 / 319334400,
+])
+_BETA = _series([
+    _n / 2 - 2 * _n**2 / 3 + 37 * _n**3 / 96 - _n**4 / 360
+    - 81 * _n**5 / 512 + 96199 * _n**6 / 604800,
+    _n**2 / 48 + _n**3 / 15 - 437 * _n**4 / 1440 + 46 * _n**5 / 105
+    - 1118711 * _n**6 / 3870720,
+    17 * _n**3 / 480 - 37 * _n**4 / 840 - 209 * _n**5 / 4480
+    + 5569 * _n**6 / 90720,
+    4397 * _n**4 / 161280 - 11 * _n**5 / 504 - 830251 * _n**6 / 7257600,
+    4583 * _n**5 / 161280 - 108847 * _n**6 / 3991680,
+    20648693 * _n**6 / 638668800,
+])
+_DELTA = _series([
+    2 * _n - 2 * _n**2 / 3 - 2 * _n**3 + 116 * _n**4 / 45
+    + 26 * _n**5 / 45 - 2854 * _n**6 / 675,
+    7 * _n**2 / 3 - 8 * _n**3 / 5 - 227 * _n**4 / 45 + 2704 * _n**5 / 315
+    + 2323 * _n**6 / 945,
+    56 * _n**3 / 15 - 136 * _n**4 / 35 - 1262 * _n**5 / 105
+    + 73814 * _n**6 / 2835,
+    4279 * _n**4 / 630 - 332 * _n**5 / 35 - 399572 * _n**6 / 14175,
+    4174 * _n**5 / 315 - 144838 * _n**6 / 6237,
+    601676 * _n**6 / 22275,
+])
+_E2N = 2.0 * np.sqrt(_N) / (1.0 + _N)  # 2*sqrt(n)/(1+n), conformal-lat term
+
+
+def utm_zone_srid(lon: float, lat: float) -> int:
+    """The canonical UTM zone EPSG code for a lon/lat (the zone picker a
+    CLI/analyst uses; Norway/Svalbard exceptions intentionally omitted —
+    they are cartographic conventions, not math)."""
+    zone = int(np.clip((np.floor((lon + 180.0) / 6.0) + 1), 1, 60))
+    return (32600 if lat >= 0 else 32700) + zone
+
+
+def _utm_params(srid: int):
+    srid = int(srid)
+    if 32601 <= srid <= 32660:
+        zone, south = srid - 32600, False
+    elif 32701 <= srid <= 32760:
+        zone, south = srid - 32700, True
+    else:
+        return None
+    lon0 = -183.0 + 6.0 * zone
+    return lon0, (_UTM_FN_SOUTH if south else 0.0)
+
+
+def _to_utm(x, y, lon0: float, fn: float):
+    lon = np.asarray(x, np.float64)
+    lat = np.asarray(y, np.float64)
+    phi = np.radians(lat)
+    dlam = np.radians(lon - lon0)
+    s = np.sin(phi)
+    # conformal latitude tau' (Karney form, numerically stable)
+    t = np.sinh(np.arctanh(s) - _E2N * np.arctanh(_E2N * s))
+    xi_p = np.arctan2(t, np.cos(dlam))
+    eta_p = np.arcsinh(np.sin(dlam) / np.hypot(t, np.cos(dlam)))
+    xi = xi_p.copy()
+    eta = eta_p.copy()
+    for j in range(6):
+        w = 2.0 * (j + 1)
+        xi += _ALPHA[j] * np.sin(w * xi_p) * np.cosh(w * eta_p)
+        eta += _ALPHA[j] * np.cos(w * xi_p) * np.sinh(w * eta_p)
+    return (_UTM_FE + _UTM_K0 * _A_RECT * eta,
+            fn + _UTM_K0 * _A_RECT * xi)
+
+
+def _from_utm(x, y, lon0: float, fn: float):
+    e = np.asarray(x, np.float64)
+    nn = np.asarray(y, np.float64)
+    xi = (nn - fn) / (_UTM_K0 * _A_RECT)
+    eta = (e - _UTM_FE) / (_UTM_K0 * _A_RECT)
+    xi_p = xi.copy()
+    eta_p = eta.copy()
+    for j in range(6):
+        w = 2.0 * (j + 1)
+        xi_p -= _BETA[j] * np.sin(w * xi) * np.cosh(w * eta)
+        eta_p -= _BETA[j] * np.cos(w * xi) * np.sinh(w * eta)
+    chi = np.arcsin(np.sin(xi_p) / np.cosh(eta_p))  # conformal latitude
+    phi = chi.copy()
+    for j in range(6):
+        w = 2.0 * (j + 1)
+        phi += _DELTA[j] * np.sin(w * chi)
+    dlam = np.arctan2(np.sinh(eta_p), np.cos(xi_p))
+    return lon0 + np.degrees(dlam), np.degrees(phi)
+
+
 _TRANSFORMS: Dict[Tuple[int, int], Callable] = {
     (4326, 4326): _ident,
     (3857, 3857): _ident,
@@ -53,7 +175,37 @@ _TRANSFORMS: Dict[Tuple[int, int], Callable] = {
 
 
 def supported(from_srid: int, to_srid: int) -> bool:
-    return (int(from_srid), int(to_srid)) in _TRANSFORMS
+    return _lookup(int(from_srid), int(to_srid)) is not None
+
+
+def _lookup(src: int, dst: int):
+    fn = _TRANSFORMS.get((src, dst))
+    if fn is not None:
+        return fn
+    pu_src = _utm_params(src)
+    pu_dst = _utm_params(dst)
+    if src == dst and pu_src is not None:
+        # same-zone no-op must be EXACT pass-through, not a lossy
+        # UTM->4326->UTM round trip (review finding)
+        return _ident
+    if pu_dst is not None:
+        to_utm = lambda lx, ly: _to_utm(lx, ly, *pu_dst)  # noqa: E731
+        if src == 4326:
+            return to_utm
+        if src == 3857 or pu_src is not None:
+            # route through 4326 (the native frame, exactly invertible)
+            via = (
+                _from_mercator if src == 3857
+                else (lambda ex, ey: _from_utm(ex, ey, *pu_src))
+            )
+            return lambda ex, ey: to_utm(*via(ex, ey))
+    if pu_src is not None:
+        from_utm = lambda ex, ey: _from_utm(ex, ey, *pu_src)  # noqa: E731
+        if dst == 4326:
+            return from_utm
+        if dst == 3857:
+            return lambda ex, ey: _to_mercator(*from_utm(ex, ey))
+    return None
 
 
 def transform(x, y, from_srid: int, to_srid: int):
@@ -61,11 +213,11 @@ def transform(x, y, from_srid: int, to_srid: int):
     unregistered CRS pair (same contract as an unknown EPSG code in the
     reference's referencing factory)."""
     key = (int(from_srid), int(to_srid))
-    fn = _TRANSFORMS.get(key)
+    fn = _lookup(*key)
     if fn is None:
         raise ValueError(
             f"unsupported CRS transform EPSG:{key[0]} -> EPSG:{key[1]} "
-            "(registered: 4326, 3857)"
+            "(registered: 4326, 3857, UTM 326xx/327xx)"
         )
     return fn(x, y)
 

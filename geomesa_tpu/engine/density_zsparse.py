@@ -236,6 +236,7 @@ def density_zsparse(
     data_tile: int = DATA_TILE,
     interpret: bool = False,
     check_stale: bool = True,
+    stale_exact: bool = False,
 ) -> Tuple[jax.Array, DensityCalib]:
     """Store-order density grid (see module docstring). Returns
     ([height, width] f32 grid, calib) — pass `calib` back in on repeat
@@ -251,7 +252,15 @@ def density_zsparse(
     the grid's total mass is checked against the mask's expected mass
     and a mismatch triggers automatic recalibration. Callers looping
     the IDENTICAL query (mask unchanged) may pass check_stale=False to
-    skip the extra device reduction + fetch."""
+    skip the extra device reduction + fetch.
+
+    With `stale_exact` (unweighted grids: cell values are small-integer
+    counts, exact in f32), the mass check runs at atol=0.5 — ONE dropped
+    point triggers recalibration. The default relative tolerance only
+    bounds f32 summation noise for WEIGHTED grids; a sub-noise deficit
+    (a handful of points against tens of millions) can pass it, so
+    callers caching calibs across queries must key the cache on the
+    FILTER as well as the arrays (see plan.runner._zsparse_grid)."""
     from geomesa_tpu.engine.density import density_grid
 
     reused_calib = calib is not None
@@ -312,10 +321,141 @@ def density_zsparse(
         expected = float(_expected_mass(
             xp, yp, wp, mp, tuple(bbox), width, height))
         got = float(np.asarray(grid, np.float64).sum())
-        if not np.isclose(got, expected, rtol=1e-5, atol=1e-3):
+        rtol, atol = (0.0, 0.5) if stale_exact else (1e-5, 1e-3)
+        if not np.isclose(got, expected, rtol=rtol, atol=atol):
             # the cached plan no longer covers this mask: recalibrate
             return density_zsparse(
                 x, y, weights, mask, bbox, width, height, calib=None,
                 data_tile=data_tile, interpret=interpret,
             )
     return grid, calib
+
+
+def density_zsparse_sharded(
+    mesh,
+    x: jax.Array,
+    y: jax.Array,
+    weights: jax.Array,
+    mask: jax.Array,
+    bbox: BBox,
+    width: int,
+    height: int,
+    data_tile: int = DATA_TILE,
+    interpret: bool = False,
+):
+    """Data-parallel cell-dictionary density over a device mesh.
+
+    One GLOBAL calibration pass (per-tile dictionaries are a property of
+    the row layout, not of the shard cut), partitioned by shard — rows
+    are split contiguously and the shard size is a tile multiple, so a
+    data tile never crosses a shard boundary. Each shard runs the same
+    Pallas kernel over its local tiles (lists padded to a common length
+    with all(-1) dictionaries — pad rows match nothing and fold zeros),
+    overflow tiles take the exact per-shard scatter fallback, and the
+    per-shard grids merge with one psum — the C25 reduction-tree shape
+    (SURVEY.md:318-329) on XLA collectives.
+
+    Returns the REPLICATED [height, width] grid (same contract as
+    density_sharded)."""
+    import jax.lax as lax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from geomesa_tpu.engine.density import density_grid
+    from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+    D = int(np.prod(mesh.devices.shape))
+    n = int(x.shape[0])
+    per = n // D
+    if n % D or per % data_tile:
+        raise ValueError(
+            f"n={n} must split into {D} shards of data_tile={data_tile} "
+            "multiples (pad the batch; the planner's pow2 padding does)"
+        )
+    calib = calibrate_density(
+        x, y, mask, bbox, width, height, data_tile=data_tile)
+    tpd = per // data_tile
+
+    def _partition(global_ids, payload=None, fill=0):
+        """[n_sel] global tile ids -> ([D, S] local ids, [D, S] valid,
+        optionally [D, S, ...] payload) padded to the max shard count."""
+        shard_of = global_ids // tpd
+        counts = np.bincount(shard_of, minlength=D)
+        S = max(int(counts.max()), 1)
+        ids = np.full((D, S), fill, np.int32)
+        valid = np.zeros((D, S), bool)
+        pay = None
+        if payload is not None:
+            pay = np.full((D, S) + payload.shape[1:], -1, payload.dtype)
+        for d in range(D):
+            sel = np.nonzero(shard_of == d)[0]
+            ids[d, : len(sel)] = global_ids[sel] - d * tpd
+            valid[d, : len(sel)] = True
+            if payload is not None:
+                pay[d, : len(sel)] = payload[sel]
+        return ids, valid, pay
+
+    sp_ids, _, sp_dicts = _partition(
+        calib.tile_ids.astype(np.int64), np.asarray(calib.dicts))
+    have_dense = len(calib.dense_ids) > 0
+    if have_dense:
+        dn_ids, dn_valid, _ = _partition(calib.dense_ids.astype(np.int64))
+    else:
+        dn_ids = np.zeros((D, 1), np.int32)
+        dn_valid = np.zeros((D, 1), bool)
+
+    capd = calib.capd
+    bbox = tuple(bbox)
+
+    def shard_fn(xl, yl, wl, ml, idsl, dictsl, didl, dvall):
+        # sharded [D, ...] operands arrive with a leading length-1 dim
+        idsl = idsl.reshape(-1)
+        dictsl = dictsl.reshape(-1, capd)
+        didl = didl.reshape(-1)
+        dvall = dvall.reshape(-1)
+        mlf = ml.astype(jnp.float32)
+        # chunk the tile list exactly like the single-device driver: a
+        # full [S, 1, capd] pallas output may land in VMEM and blew the
+        # 16 MB scoped limit at bench scale (review finding — the mesh
+        # path must survive the scale it exists for)
+        S = int(idsl.shape[0])
+        maxs = max(256, (1 << 20) // max(capd, 1))
+        grid = jnp.zeros((height, width), jnp.float32)
+        for c0 in range(0, S, maxs):
+            c1 = min(c0 + maxs, S)
+            counts = _zsparse_call(
+                xl, yl, wl, mlf, idsl[c0:c1], dictsl[c0:c1],
+                capd=capd, bbox=bbox, width=width, height=height,
+                data_tile=data_tile, chunk=min(CHUNK, data_tile),
+                interpret=interpret,
+            )
+            grid = grid + _fold_counts(
+                counts, dictsl[c0:c1], width=width, height=height)
+        if have_dense:
+            gx = jnp.take(xl.reshape(tpd, data_tile), didl, axis=0)
+            gy = jnp.take(yl.reshape(tpd, data_tile), didl, axis=0)
+            gw = jnp.take(wl.reshape(tpd, data_tile), didl, axis=0)
+            gm = jnp.take(ml.reshape(tpd, data_tile), didl, axis=0)
+            gm = gm & dvall[:, None]
+            grid = grid + density_grid(
+                gx.reshape(-1), gy.reshape(-1), gw.reshape(-1),
+                gm.reshape(-1), bbox, width, height,
+            )
+        return lax.psum(grid, SHARD_AXIS)
+
+    f = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+        ),
+        out_specs=P(),
+        check_vma=False,  # pallas output vma; psum replicates (knn idiom)
+    )
+    return f(
+        x.astype(jnp.float32), y.astype(jnp.float32),
+        weights.astype(jnp.float32), mask,
+        jnp.asarray(sp_ids), jnp.asarray(sp_dicts),
+        jnp.asarray(dn_ids), jnp.asarray(dn_valid),
+    )

@@ -25,12 +25,18 @@ class QueryHints:
     # points (round-1 advisor finding: fidelity needs an opt-out that does
     # not bypass the DataStore API)
     density_exact_weights: bool = False
-    # Z-locality density kernel (engine.density_zsparse): per-tile local
-    # one-hots over the Morton band a STORE-ORDERED tile touches — the
-    # config-4 fast path. Opt-in because it pays a small calibration
-    # fetch per query and only wins on Z-ordered layouts (exact for any
-    # order via its dense fallback)
-    density_zsparse: bool = False
+    # Z-locality density kernel (engine.density_zsparse): per-tile cell
+    # dictionaries over the Morton band a STORE-ORDERED tile touches —
+    # the config-4 fast path. Tri-state (VERDICT r4 task 3):
+    #   None  (default) = AUTO: point layers take the zsparse kernel,
+    #          whose calibration pass routes each tile dictionary-vs-
+    #          scatter (overflow/unsorted tiles go to the exact scatter
+    #          fallback, so random order costs calibration, not
+    #          correctness); pinned OFF by exact_weights + a weight
+    #          column (the fidelity opt-out keeps the f32 scatter path)
+    #   True  = force zsparse (still honors the exact_weights pin)
+    #   False = force the round-2 scatter/MXU dispatch
+    density_zsparse: Optional[bool] = None
 
     # bin aggregation (BinAggregatingScan): compact dot-map records
     bin_track: Optional[str] = None  # attribute used as track id

@@ -435,10 +435,15 @@ class QueryPlanner:
             return QueryResult("count", count=total), total, t_scan
 
         if hints.is_density:
-            from geomesa_tpu.plan.runner import density_device_grid
+            from geomesa_tpu.plan.runner import (
+                density_device_grid, query_mask_token)
 
+            # partition pruning feeds the mask too: extend the token so a
+            # plan scanning different partitions never reuses the calib
+            token = query_mask_token(query) + (tuple(sorted(plan.partitions)),)
             grid = density_device_grid(
-                self.storage.sft, sb.batch, sb.dev, dev_mask, hints
+                self.storage.sft, sb.batch, sb.dev, dev_mask, hints,
+                mask_token=token,
             )
             total = int(np.asarray(jnp.sum(dev_mask, dtype=jnp.int32)))
             if total == 0:

@@ -39,7 +39,117 @@ class TestTransform:
         x, y = transform([1.0], [2.0], 4326, 4326)
         assert x[0] == 1.0 and y[0] == 2.0
         with pytest.raises(ValueError, match="unsupported CRS"):
-            transform([0.0], [0.0], 4326, 32633)
+            transform([0.0], [0.0], 4326, 27700)  # OSGB: not registered
+
+
+def _snyder_utm(lon, lat, lon0, fn):
+    """INDEPENDENT oracle: Snyder (1987) eq. 8-9..8-13 truncated series for
+    the ellipsoidal transverse Mercator — a different formulation from the
+    Krueger flattening series in core.crs (different expansion variable:
+    e^2, not n). Agreement << 1 mm in-zone certifies both."""
+    a, f = 6378137.0, 1 / 298.257223563
+    e2 = f * (2 - f)
+    ep2 = e2 / (1 - e2)
+    k0 = 0.9996
+    phi = np.radians(np.asarray(lat, np.float64))
+    lam = np.radians(np.asarray(lon, np.float64) - lon0)
+    sp, cp = np.sin(phi), np.cos(phi)
+    N = a / np.sqrt(1 - e2 * sp**2)
+    T = (sp / cp) ** 2
+    C = ep2 * cp**2
+    A = lam * cp
+    M = a * (
+        (1 - e2 / 4 - 3 * e2**2 / 64 - 5 * e2**3 / 256) * phi
+        - (3 * e2 / 8 + 3 * e2**2 / 32 + 45 * e2**3 / 1024) * np.sin(2 * phi)
+        + (15 * e2**2 / 256 + 45 * e2**3 / 1024) * np.sin(4 * phi)
+        - (35 * e2**3 / 3072) * np.sin(6 * phi)
+    )
+    E = 500000.0 + k0 * N * (
+        A + (1 - T + C) * A**3 / 6
+        + (5 - 18 * T + T**2 + 72 * C - 58 * ep2) * A**5 / 120
+    )
+    Nn = fn + k0 * (
+        M + N * (sp / cp) * (
+            A**2 / 2 + (5 - T + 9 * C + 4 * C**2) * A**4 / 24
+            + (61 - 58 * T + T**2 + 600 * C - 330 * ep2) * A**6 / 720
+        )
+    )
+    return E, Nn
+
+
+class TestUTM:
+    def test_against_snyder_oracle(self):
+        # in-zone points across hemispheres and latitudes (zone 33: lon0=15)
+        lon = np.array([15.0, 12.5, 17.9, 13.3, 16.7])
+        lat = np.array([0.5, 48.2, 67.9, 22.0, 5.1])
+        ex, ey = _snyder_utm(lon, lat, 15.0, 0.0)
+        gx, gy = transform(lon, lat, 4326, 32633)
+        np.testing.assert_allclose(gx, ex, atol=1e-3)  # < 1 mm
+        np.testing.assert_allclose(gy, ey, atol=1e-3)
+        # southern hemisphere, zone 56 (lon0=153): Sydney-ish
+        ex, ey = _snyder_utm([151.2093], [-33.8688], 153.0, 10_000_000.0)
+        gx, gy = transform([151.2093], [-33.8688], 4326, 32756)
+        np.testing.assert_allclose(gx, ex, atol=1e-3)
+        np.testing.assert_allclose(gy, ey, atol=1e-3)
+
+    def test_anchor_points(self):
+        # equator on the central meridian is EXACTLY (500000, 0) north
+        e, n = transform([15.0], [0.0], 4326, 32633)
+        assert abs(e[0] - 500000.0) < 1e-6 and abs(n[0]) < 1e-6
+        # and (500000, 10000000) south
+        e, n = transform([153.0], [0.0], 4326, 32756)
+        assert abs(e[0] - 500000.0) < 1e-6 and abs(n[0] - 1e7) < 1e-6
+        # meridian scale factor == k0: 1 deg of northing near the equator
+        e1, n1 = transform([15.0], [0.0], 4326, 32633)
+        e2, n2 = transform([15.0], [1e-4], 4326, 32633)
+        # local meridian arc at the equator: ds = rho(0) dphi with the
+        # meridional radius of curvature rho(0) = a(1-e^2)
+        a, f = 6378137.0, 1 / 298.257223563
+        e2_ = f * (2 - f)
+        arc = a * (1 - e2_) * np.radians(1e-4)
+        assert abs((n2[0] - n1[0]) / arc - 0.9996) < 1e-6
+
+    def test_round_trip_mm(self):
+        rng = np.random.default_rng(5)
+        for srid, lon0, latr in ((32633, 15.0, (0.0, 84.0)),
+                                 (32756, 153.0, (-80.0, 0.0))):
+            lon = rng.uniform(lon0 - 3, lon0 + 3, 500)
+            lat = rng.uniform(*latr, 500)
+            e, n = transform(lon, lat, 4326, srid)
+            lon2, lat2 = transform(e, n, srid, 4326)
+            # < 1e-9 deg ~ 0.1 mm
+            np.testing.assert_allclose(lon2, lon, atol=1e-9)
+            np.testing.assert_allclose(lat2, lat, atol=1e-9)
+
+    def test_cross_frame_routes(self):
+        # UTM -> UTM (adjacent zones) and UTM <-> 3857 route through 4326
+        lon, lat = np.array([17.5]), np.array([59.3])
+        e33, n33 = transform(lon, lat, 4326, 32633)
+        e34, n34 = transform(e33, n33, 32633, 32634)
+        ed, nd = transform(lon, lat, 4326, 32634)
+        np.testing.assert_allclose([e34[0], n34[0]], [ed[0], nd[0]],
+                                   atol=1e-6)
+        mx, my = transform(e33, n33, 32633, 3857)
+        ex, ey = transform(lon, lat, 4326, 3857)
+        np.testing.assert_allclose([mx[0], my[0]], [ex[0], ey[0]], atol=1e-6)
+
+    def test_zone_picker(self):
+        from geomesa_tpu.core.crs import utm_zone_srid
+
+        assert utm_zone_srid(15.0, 48.0) == 32633
+        assert utm_zone_srid(151.2, -33.9) == 32756
+        assert utm_zone_srid(-179.9, 10.0) == 32601
+        assert utm_zone_srid(179.9, -10.0) == 32760
+
+    def test_sql_st_transform_utm(self):
+        from geomesa_tpu.core.wkt import Geometry
+        from geomesa_tpu.sql.functions import st_transform
+
+        g = Geometry("Point", [np.array([[15.0, 48.0]])])
+        out = st_transform(g, "EPSG:4326", "EPSG:32633")
+        ex, ey = transform([15.0], [48.0], 4326, 32633)
+        np.testing.assert_allclose(out.rings[0][0], [ex[0], ey[0]],
+                                   rtol=1e-12)
 
 
 class TestQueryReprojection:

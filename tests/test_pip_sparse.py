@@ -173,3 +173,22 @@ def test_build_pairs_out_of_domain_polygon():
     poly_bbox = np.array([[189.0, 9.0, 196.0, 20.0]])
     pl = build_pairs(ptile_bbox, etile_bbox, poly_of_tile, poly_bbox)
     assert len(pl.pair_pt) == 1
+
+
+def test_pip_layer_sharded_matches_single_device():
+    # mesh variant (round 5): point tiles sharded over the 8-device CPU
+    # mesh, edge table replicated — must reproduce pip_layer (and the f64
+    # oracle) exactly, including band refinement of adversarial points
+    from geomesa_tpu.engine.pip_sparse import pip_layer_sharded
+    from geomesa_tpu.parallel import default_mesh
+
+    rng = np.random.default_rng(11)
+    x1, y1, x2, y2, pol = make_layer(rng)
+    px, py = make_points(rng, x1, y1, x2, y2, n=20_000)
+    mesh = default_mesh()
+    inside_s, info_s = pip_layer_sharded(
+        mesh, px, py, x1, y1, x2, y2, pol, interpret=True)
+    exp = oracle(px, py, x1, y1, x2, y2)
+    assert (inside_s == exp).all()
+    assert info_s["shards"] == int(np.prod(mesh.devices.shape))
+    assert info_s["pairs"] > 0
