@@ -203,32 +203,15 @@ def _timeit(fn, repeats=3, warm=True):
     return best
 
 
-def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
-    """Config 2 (round 3): Within() over an OSM-admin-style polygon LAYER
-    — npoly disjoint polygons (mixed 10..10k edges, ~10% with holes) x n
-    points, via the sparse pair-list Pallas spatial join
-    (engine/pip_sparse.py) with f64 refinement of boundary-band points.
-
-    Replaces the round-1/2 single-star bench (VERDICT.md round-2 #5: the
-    multi-polygon path was never benched as config 2 specifies). Points
-    are Z-ordered (store layout) — that's what makes the point-tile
-    bboxes tight and the pair pruning effective.
-
-    Parity gate: 0 mismatches vs a NumPy f64 crossing oracle on a point
-    subsample PLUS every adversarial near-edge point (placed within
-    +-1e-6 deg of random edges)."""
-    import jax.numpy as jnp
-
-    from geomesa_tpu.engine.pip_sparse import (
-        EDGE_TILE, POINT_TILE, pip_layer, pip_layer_grouped, prepare_layer)
-
-    rng = np.random.default_rng(29)
-    # disjoint admin-style layer: one polygon per jittered grid cell,
-    # max lobe provably under half the min center separation (see the
-    # rad comment below); log-mixed edge counts
+def _gen_admin_layer(rng, npoly, keep_rings=False):
+    """OSM-admin-style disjoint polygon layer: one polygon per jittered
+    grid cell, log-mixed edge counts (10..10k), ~10% with holes. Returns
+    (x1, y1, x2, y2, pol, n_holes, rings) — rings per polygon only when
+    keep_rings (the SQL path builds Geometry objects from them)."""
     side = int(np.ceil(np.sqrt(npoly)))
     cw, ch = 360.0 / side, 180.0 / side
     x1l, y1l, x2l, y2l, pol = [], [], [], [], []
+    rings: list = []
     n_holes = 0
     ecounts = np.clip(
         np.round(10 ** rng.uniform(1, 4, npoly)).astype(int), 10, 10_000
@@ -256,6 +239,7 @@ def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
             x1l.append(ring[:-1, 0]); y1l.append(ring[:-1, 1])
             x2l.append(ring[1:, 0]); y2l.append(ring[1:, 1])
             pol.append(np.full(ne, pid))
+            prings = [ring]
             if rng.random() < 0.1:  # hole: reversed inner ring
                 n_holes += 1
                 nh = max(8, ne // 8)
@@ -267,10 +251,36 @@ def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
                 x1l.append(hr[:-1, 0]); y1l.append(hr[:-1, 1])
                 x2l.append(hr[1:, 0]); y2l.append(hr[1:, 1])
                 pol.append(np.full(nh, pid))
+                prings.append(hr)
+            if keep_rings:
+                rings.append(prings)
             pid += 1
-    x1 = np.concatenate(x1l); y1 = np.concatenate(y1l)
-    x2 = np.concatenate(x2l); y2 = np.concatenate(y2l)
-    pol = np.concatenate(pol)
+    return (np.concatenate(x1l), np.concatenate(y1l),
+            np.concatenate(x2l), np.concatenate(y2l),
+            np.concatenate(pol), n_holes, rings)
+
+
+def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
+    """Config 2 (round 3): Within() over an OSM-admin-style polygon LAYER
+    — npoly disjoint polygons (mixed 10..10k edges, ~10% with holes) x n
+    points, via the sparse pair-list Pallas spatial join
+    (engine/pip_sparse.py) with f64 refinement of boundary-band points.
+
+    Replaces the round-1/2 single-star bench (VERDICT.md round-2 #5: the
+    multi-polygon path was never benched as config 2 specifies). Points
+    are Z-ordered (store layout) — that's what makes the point-tile
+    bboxes tight and the pair pruning effective.
+
+    Parity gate: 0 mismatches vs a NumPy f64 crossing oracle on a point
+    subsample PLUS every adversarial near-edge point (placed within
+    +-1e-6 deg of random edges)."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.engine.pip_sparse import (
+        EDGE_TILE, POINT_TILE, pip_layer, pip_layer_grouped)
+
+    rng = np.random.default_rng(29)
+    x1, y1, x2, y2, pol, n_holes, _ = _gen_admin_layer(rng, npoly)
 
     px = rng.uniform(-180, 180, n)
     py = rng.uniform(-90, 90, n)
@@ -287,24 +297,56 @@ def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
     zo = np.argsort(_morton64(px, py))
     px, py, adv = px[zo], py[zo], adv[zo]
 
-    # one warm end-to-end pass builds pairs + compiles + refines
-    inside, info = pip_layer(px, py, x1, y1, x2, y2, pol, interpret=smoke)
-
-    # timed: the device pass over prebuilt pair structures (the pair list
-    # is per-layer index state, like the reference's prepared geometries;
-    # its build time is reported separately)
+    # FIRST QUERY end-to-end (VERDICT r4 task 5): the prep build runs on a
+    # worker thread behind the content-addressed disk cache
+    # (.bench_cache/layerprep_*.npz — the prepared-geometry analog), and
+    # the first full query (prep + kernel + f64 band refine) is timed as
+    # one wall measurement. Cache hit: prep loads in ~0.1 s instead of the
+    # ~5 s host build, so the first query stops being host-bound.
     import time as _t
 
-    s = _t.perf_counter()
-    prep = prepare_layer(px, py, x1, y1, x2, y2, pol)
-    pxp, pyp = prep.pxp, prep.pyp
+    cdir = os.path.join(_REPO, ".bench_cache")
+    key = None
+    try:
+        from geomesa_tpu.engine.pip_sparse import layer_prep_key
+
+        key = layer_prep_key(px, py, x1, y1, x2, y2, pol)
+        prep_cache_hit = os.path.exists(
+            os.path.join(cdir, f"layerprep_{key}.npz"))
+    except Exception:
+        prep_cache_hit = False
+    from geomesa_tpu.engine.pip_sparse import prepare_layer_async
+
+    s0 = _t.perf_counter()
+    prep_handle = prepare_layer_async(
+        px, py, x1, y1, x2, y2, pol, cache_dir=cdir, key=key)
+    # OVERLAP (the task-5 second half): the padded point upload depends
+    # only on (px, py), so it rides the tunnel while the pair build runs
+    # on the worker thread; pip_layer then reuses the device arrays
+    npad = (-n) % POINT_TILE
+    dev_pxp = jnp.asarray(
+        np.concatenate([px, np.full(npad, 1e8)]), jnp.float32)
+    dev_pyp = jnp.asarray(
+        np.concatenate([py, np.full(npad, 1e8)]), jnp.float32)
+    _sync(dev_pyp)
+    upload_t = _t.perf_counter() - s0
+    prep = prep_handle()
+    prep_t = _t.perf_counter() - s0
+    inside, info = pip_layer(px, py, x1, y1, x2, y2, pol, interpret=smoke,
+                             prep=prep, points_device=(dev_pxp, dev_pyp))
+    first_q_t = _t.perf_counter() - s0
+    log(f"config2 first query e2e {first_q_t:.2f}s (prep "
+        f"{'hit' if prep_cache_hit else 'miss'} {prep_t:.2f}s, upload "
+        f"{upload_t:.2f}s overlapped)")
+
+    # timed: the device pass over prebuilt pair structures (points ride
+    # the pre-uploaded dev_pxp/dev_pyp — never re-upload in the loop)
     ex1, ey1, ex2, ey2 = prep.ex1, prep.ey1, prep.ex2, prep.ey2
     n_ptiles, n_etiles = prep.n_ptiles, prep.n_etiles
     plist = prep.pairs
-    prep_t = _t.perf_counter() - s
 
     dev_args = (
-        jnp.asarray(pxp), jnp.asarray(pyp),  # device-resident: the timed
+        dev_pxp, dev_pyp,                    # device-resident: the timed
         jnp.asarray(ex1), jnp.asarray(ey1),  # loop must not re-upload
         jnp.asarray(ex2), jnp.asarray(ey2),  # through the 0.05 GB/s link
         plist.pair_pt, plist.pair_et,
@@ -426,6 +468,9 @@ def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
             "device_time_s": round(dev_t, 5),
             "pair_count": int(len(plist.pair_pt)),
             "pair_build_s": round(prep_t, 3),
+            "prep_cache": "hit" if prep_cache_hit else "miss",
+            "first_query_e2e_s": round(first_q_t, 3),
+            "first_query_points_per_sec": round(n / first_q_t, 1),
             "adversarial_points": int(na),
             "flagged": info["flagged"], "refined": info["refined"],
             "checked": checked, "mismatches": mism,
@@ -441,6 +486,98 @@ def bench_pip_layer(n, repeats, npoly=10_000, smoke=False):
                     "every adversarial near-edge point",
         },
     }
+
+
+def bench_pip_layer_sql(n, repeats, npoly=10_000, smoke=False):
+    """Config 2 THROUGH THE SQL SURFACE (round 5, VERDICT r4 task 7):
+    `SELECT polys.pid, COUNT(*) FROM pts JOIN polys ON
+    st_contains(polys.geom, pts.geom) GROUP BY polys.pid` against a real
+    FS DataStore holding the 10k-polygon layer and the Z-ordered point
+    batch — the same shape the engine-direct row runs. Parity: the SQL
+    group-count total equals the engine-direct pip_layer_join pair count.
+    Overhead: (t_sql - t_engine) / t_engine on warm caches, target <10%."""
+    import shutil
+    import tempfile
+    import time as _t
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.core.wkt import Geometry
+    from geomesa_tpu.engine.knn_scan import default_interpret
+    from geomesa_tpu.engine.pip_sparse import (
+        pip_layer_join, prepare_layer_cached)
+    from geomesa_tpu.plan.datastore import DataStore
+    from geomesa_tpu.sql.engine import SqlContext
+
+    rng = np.random.default_rng(29)  # same layer/points as the direct row
+    x1, y1, x2, y2, pol, n_holes, rings = _gen_admin_layer(
+        rng, npoly, keep_rings=True)
+    px = rng.uniform(-180, 180, n)
+    py = rng.uniform(-90, 90, n)
+    zo = np.argsort(_morton64(px, py))
+    px, py = px[zo], py[zo]
+
+    log(f"sql config2: building stores ({npoly} polys, {n / 1e6:.1f}M pts)")
+    root = tempfile.mkdtemp(prefix="gmtpu_sqlbench_")
+    try:
+        ds = DataStore(root, use_device_cache=True)
+        psft = SimpleFeatureType.from_spec("pts", "*geom:Point")
+        psrc = ds.create_schema(psft)
+        psrc.write(FeatureBatch.from_pydict(
+            psft, {"geom": np.stack([px, py], 1)}))
+        gsft = SimpleFeatureType.from_spec("polys", "pid:Integer,*geom:Polygon")
+        gsrc = ds.create_schema(gsft)
+        geoms = [Geometry("Polygon", pr) for pr in rings]
+        gsrc.write(FeatureBatch.from_pydict(
+            gsft, {"pid": np.arange(npoly, dtype=np.int64), "geom": geoms}))
+        log("stores written; running SQL join (cold)")
+
+        ctx = SqlContext(ds)
+        q = ("SELECT polys.pid AS pid, COUNT(*) AS c FROM pts "
+             "JOIN polys ON st_contains(polys.geom, pts.geom) "
+             "GROUP BY polys.pid")
+        s = _t.perf_counter()
+        r_cold = ctx.sql(q)
+        sql_cold_t = _t.perf_counter() - s
+        log(f"sql cold {sql_cold_t:.2f}s; timing warm")
+        sql_t = _timeit(lambda: ctx.sql(q), max(1, repeats - 1), warm=False)
+        sql_total = int(np.asarray(r_cold.features.columns["c"]).sum())
+
+        # engine-direct on the same arrays (warm prep via the same cache)
+        args = (px, py, x1, y1, x2, y2, pol)
+        prep = prepare_layer_cached(*args)
+        interp = smoke or default_interpret()
+
+        def direct():
+            return pip_layer_join(*args, interpret=interp, prep=prep)
+
+        pt_rows, poly_rows = direct()
+        eng_t = _timeit(direct, max(1, repeats - 1), warm=False)
+        eng_total = int(len(pt_rows))
+        overhead = (sql_t - eng_t) / max(eng_t, 1e-9)
+        return {
+            "metric": "sql_spatial_join_points_per_sec_per_chip",
+            "value": round(n / sql_t, 1),
+            "unit": "points/sec",
+            "vs_baseline": round(eng_t / sql_t, 3),
+            "detail": {
+                "n": n, "polygons": npoly, "holes": n_holes,
+                "sql_cold_s": round(sql_cold_t, 3),
+                "sql_warm_s": round(sql_t, 3),
+                "engine_direct_s": round(eng_t, 3),
+                "sql_overhead_frac": round(overhead, 4),
+                "sql_overhead_ok": overhead < 0.10,
+                "sql_pairs": sql_total,
+                "engine_pairs": eng_total,
+                "parity": sql_total == eng_total,
+                "note": "SQL JOIN ON st_contains through SqlContext + FS "
+                        "DataStore vs engine-direct pip_layer_join on the "
+                        "same arrays; vs_baseline = engine/sql time ratio "
+                        "(1.0 = zero overhead)",
+            },
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def bench_hw_smoke():
@@ -1456,6 +1593,12 @@ def main(argv=None) -> int:
              "instead of the polygon-LAYER spatial join (default)",
     )
     p.add_argument(
+        "--sql", action="store_true",
+        help="config 2: run the layer join THROUGH the SQL surface "
+             "(SELECT ... JOIN ON st_contains over a real FS DataStore) "
+             "and report overhead vs the engine-direct row",
+    )
+    p.add_argument(
         "--npoly", type=int, default=None,
         help="config 2 layer size (default 10000; smoke 200)",
     )
@@ -1494,7 +1637,11 @@ def main(argv=None) -> int:
         xb._backend_factories.pop("axon", None)
         jax.config.update("jax_platforms", "cpu")
 
-    enable_compile_cache()
+    if not args.smoke:
+        # the cache stores host-feature-tagged CPU AOT results too; smoke
+        # (forced-CPU) runs sharing the TPU run's dir trip XLA's machine-
+        # feature mismatch warnings, so only device runs use it
+        enable_compile_cache()
     log(f"bench start: argv={argv if argv is not None else sys.argv[1:]}, "
         f"budget={budget_total_s():.0f}s")
 
@@ -1539,6 +1686,12 @@ def main(argv=None) -> int:
             )
         elif args.config == 6:
             out = bench_polygon_density(n, repeats)
+        elif args.config == 2 and args.sql:
+            out = bench_pip_layer_sql(
+                n, repeats,
+                npoly=args.npoly or (200 if args.smoke else 10_000),
+                smoke=args.smoke,
+            )
         elif args.config == 2 and not args.single_polygon:
             out = bench_pip_layer(
                 n, repeats,

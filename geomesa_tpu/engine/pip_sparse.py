@@ -1181,6 +1181,187 @@ def _refine_band_f64(px_np, py_np, ex1, ey1, ex2, ey2, pl_, inside, flagged):
     return refined
 
 
+# --- LayerPrep persistence (round 5, VERDICT r4 task 5) ---------------------
+# The pair list is (point-batch x layer)-intrinsic state, exactly like the
+# reference's prepared-geometry cache (SURVEY.md:184-186): content-addressed
+# on the input arrays, persisted as one .npz, with a small in-process LRU in
+# front. At the 10k-polygon config-2 shape the host build costs ~5 s; a
+# cache hit loads in ~0.1 s, so the FIRST query of a new process stops being
+# host-bound.
+
+_PREP_MEM_CACHE: "dict[str, LayerPrep]" = {}
+_PREP_MEM_MAX = 4
+# bytes cap so one-shot joins over big batches cannot pin multi-GB padded
+# copies for the process lifetime (review finding); the entry just built
+# is always admitted — eviction only sheds OLDER entries
+_PREP_MEM_MAX_BYTES = 512 << 20
+_PREP_LOCK = None
+
+
+def _prep_lock():
+    global _PREP_LOCK
+    if _PREP_LOCK is None:
+        import threading
+
+        _PREP_LOCK = threading.Lock()
+    return _PREP_LOCK
+
+
+def _prep_nbytes(prep: LayerPrep) -> int:
+    return sum(a.nbytes for a in prep[:6]) + sum(
+        a.nbytes for a in prep.pairs[:4])
+
+
+def _prep_cache_put(key: str, prep: LayerPrep) -> None:
+    with _prep_lock():
+        _PREP_MEM_CACHE[key] = prep
+        while len(_PREP_MEM_CACHE) > 1 and (
+            len(_PREP_MEM_CACHE) > _PREP_MEM_MAX
+            or sum(map(_prep_nbytes, _PREP_MEM_CACHE.values()))
+            > _PREP_MEM_MAX_BYTES
+        ):
+            oldest = next(iter(_PREP_MEM_CACHE))
+            if oldest == key:  # never evict the entry just inserted
+                break
+            _PREP_MEM_CACHE.pop(oldest)
+
+
+def layer_prep_key(px_np, py_np, x1, y1, x2, y2, poly_of_edge,
+                   margin: float = 1e-3) -> str:
+    """Content fingerprint of (point batch, polygon layer, tiling
+    constants). sha1 over the raw bytes: ~100 ms at 4M points — 50x
+    cheaper than the build it saves."""
+    import hashlib
+
+    h = hashlib.sha1()
+    for a in (px_np, py_np, x1, y1, x2, y2, poly_of_edge):
+        a = np.ascontiguousarray(a)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    h.update(f"m{margin};pt{POINT_TILE};et{EDGE_TILE};v1".encode())
+    return h.hexdigest()
+
+
+def save_layer_prep(prep: LayerPrep, path: str) -> None:
+    import os
+
+    tmp = path + f".tmp{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(
+                f,
+                pxp=prep.pxp, pyp=prep.pyp,
+                ex1=prep.ex1, ey1=prep.ey1, ex2=prep.ex2, ey2=prep.ey2,
+                pair_pt=prep.pairs.pair_pt, pair_et=prep.pairs.pair_et,
+                first=prep.pairs.first, covered=prep.pairs.covered,
+                scalars=np.asarray(
+                    [prep.n_ptiles, prep.n_etiles,
+                     prep.pairs.n_ptiles, prep.pairs.n_etiles], np.int64),
+            )
+        os.replace(tmp, path)
+    except BaseException:
+        # never leave a partial multi-hundred-MB tmp behind (ENOSPC would
+        # otherwise worsen the very pressure that caused the failure)
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_layer_prep(path: str) -> LayerPrep:
+    with np.load(path, allow_pickle=False) as z:
+        sc = z["scalars"]
+        return LayerPrep(
+            z["pxp"], z["pyp"], z["ex1"], z["ey1"], z["ex2"], z["ey2"],
+            PairList(z["pair_pt"], z["pair_et"], z["first"], z["covered"],
+                     int(sc[2]), int(sc[3])),
+            int(sc[0]), int(sc[1]),
+        )
+
+
+def prepare_layer_cached(
+    px_np, py_np, x1, y1, x2, y2, poly_of_edge,
+    margin: float = 1e-3, cache_dir: "str | None" = None,
+    key: "str | None" = None,
+) -> LayerPrep:
+    """prepare_layer behind a content-addressed cache: in-process LRU
+    first, then `cache_dir` (or the geomesa.spatial.prep.cache.dir system
+    property; empty = memory only) on disk. A corrupt/unreadable disk
+    entry falls through to a rebuild. `key` may carry a precomputed
+    layer_prep_key to skip re-hashing the inputs."""
+    import os
+
+    from geomesa_tpu.utils.config import SystemProperties
+
+    if key is None:
+        key = layer_prep_key(
+            px_np, py_np, x1, y1, x2, y2, poly_of_edge, margin)
+    with _prep_lock():
+        hit = _PREP_MEM_CACHE.get(key)
+        if hit is not None:
+            # true LRU: refresh recency (eviction pops insertion order)
+            _PREP_MEM_CACHE.pop(key)
+            _PREP_MEM_CACHE[key] = hit
+    if hit is not None:
+        return hit
+    if cache_dir is None:
+        cache_dir = str(SystemProperties.SPATIAL_PREP_CACHE_DIR.get()) or None
+    path = os.path.join(cache_dir, f"layerprep_{key}.npz") if cache_dir else None
+    prep = None
+    if path and os.path.exists(path):
+        try:
+            prep = load_layer_prep(path)
+        except Exception:
+            prep = None
+    if prep is None:
+        prep = prepare_layer(px_np, py_np, x1, y1, x2, y2, poly_of_edge,
+                             margin=margin)
+        if path:
+            try:
+                os.makedirs(cache_dir, exist_ok=True)
+                save_layer_prep(prep, path)
+            except OSError:
+                pass
+    _prep_cache_put(key, prep)
+    return prep
+
+
+def prepare_layer_async(
+    px_np, py_np, x1, y1, x2, y2, poly_of_edge,
+    margin: float = 1e-3, cache_dir: "str | None" = None,
+    key: "str | None" = None,
+):
+    """Kick the (cached) prep build onto a worker thread so the caller can
+    overlap it with device work that does not need pairs — point upload
+    and kernel warm-up (VERDICT r4 task 5's overlap half). Returns a
+    0-arg callable that joins and yields the LayerPrep. The build is pure
+    numpy, so the thread releases the GIL for the big vector ops."""
+    import threading
+
+    out: dict = {}
+
+    def work():
+        try:
+            out["prep"] = prepare_layer_cached(
+                px_np, py_np, x1, y1, x2, y2, poly_of_edge,
+                margin=margin, cache_dir=cache_dir, key=key)
+        except BaseException as e:  # re-raise on join
+            out["err"] = e
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+
+    def result() -> LayerPrep:
+        t.join()
+        if "err" in out:
+            raise out["err"]
+        return out["prep"]
+
+    return result
+
+
 def pip_layer(
     px_np: np.ndarray,
     py_np: np.ndarray,
@@ -1192,15 +1373,20 @@ def pip_layer(
     eps: float = 1e-4,
     interpret: bool = False,
     refine_f64: bool = True,
+    prep: "LayerPrep | None" = None,
+    points_device=None,
 ):
     """End-to-end host orchestration: prepare_layer + sparse kernels +
     f64 band refinement.
 
     Returns (inside bool [N], info dict). Points are assumed Z/store-
     ordered (tile bboxes are only tight then); correctness holds for any
-    order."""
+    order. `points_device` optionally supplies the PADDED point arrays
+    already device-resident (uploaded concurrently with an async prep
+    build — the overlap path); the host refine still reads px_np/py_np."""
     n = len(px_np)
-    prep = prepare_layer(px_np, py_np, x1, y1, x2, y2, poly_of_edge)
+    if prep is None:
+        prep = prepare_layer(px_np, py_np, x1, y1, x2, y2, poly_of_edge)
     pxp, pyp = prep.pxp, prep.pyp
     ex1, ey1, ex2, ey2 = prep.ex1, prep.ey1, prep.ex2, prep.ey2
     n_ptiles, n_etiles = prep.n_ptiles, prep.n_etiles
@@ -1211,6 +1397,8 @@ def pip_layer(
                                    "n_ptiles": n_ptiles,
                                    "n_etiles": n_etiles}
 
+    if points_device is not None:
+        pxp, pyp = points_device  # padded, already device-resident
     counts, band = pip_layer_grouped(
         pxp, pyp,
         jnp.asarray(ex1), jnp.asarray(ey1),

@@ -239,10 +239,12 @@ class KNearestNeighborSearchProcess:
     ) -> KnnResult:
         """Store path: planner-evaluated device mask + fused scan.
         planner.knn already pads to k columns; only the distance clamp
-        applies here."""
+        applies here. "auto" flows through: the planner resolves it from
+        its stats sketches (selectivity-typed, not string-typed —
+        VERDICT r4 task 6)."""
         dists, idx, batch = source.planner.knn(
             _window_cql(source.sft, bbox, cql_filter), qx, qy, k=k,
-            impl=("sparse" if impl == "auto" else impl),
+            impl=impl,
         )
         dists = np.where(dists <= max_dist, dists, np.inf)
         return KnnResult(idx, dists, batch)

@@ -690,18 +690,25 @@ def _spatial_pairs(poly_batch, poly_col, pt_batch, pt_col):
     """(polygon_rows, point_rows) containment pairs via the polygon-layer
     assignment kernel (f64 band refinement; overlap multiplicity exact)."""
     from geomesa_tpu.engine.knn_scan import default_interpret
-    from geomesa_tpu.engine.pip_sparse import pip_layer_join
+    from geomesa_tpu.engine.pip_sparse import (
+        pip_layer_join, prepare_layer_cached)
 
     if len(poly_batch) == 0 or len(pt_batch) == 0:
         return np.zeros(0, np.int64), np.zeros(0, np.int64)
     et = poly_batch.columns[poly_col].edge_table()
     pc = pt_batch.columns[pt_col]
-    pt_rows, poly_rows = pip_layer_join(
+    args = (
         np.asarray(pc.x, np.float64), np.asarray(pc.y, np.float64),
         np.asarray(et.x1, np.float64), np.asarray(et.y1, np.float64),
         np.asarray(et.x2, np.float64), np.asarray(et.y2, np.float64),
         np.asarray(et.efeat, np.int64),
-        interpret=default_interpret(),
+    )
+    # prep is (point-batch x layer)-intrinsic: content-addressed cache
+    # (in-process + geomesa.spatial.prep.cache.dir) makes repeated joins
+    # and fresh-process first queries skip the host pair build
+    prep = prepare_layer_cached(*args)
+    pt_rows, poly_rows = pip_layer_join(
+        *args, interpret=default_interpret(), prep=prep,
     )
     return poly_rows.astype(np.int64), pt_rows.astype(np.int64)
 

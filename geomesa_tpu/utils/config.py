@@ -85,6 +85,19 @@ class SystemProperties:
         "geomesa.profile.dir", "", str,
         "emit a jax profiler trace per query execution into this directory",
     )
+    SPATIAL_PREP_CACHE_DIR = SystemProperty(
+        "geomesa.spatial.prep.cache.dir", "", str,
+        "disk cache directory for polygon-layer prep structures (pair "
+        "lists / padded edge tables — the prepared-geometry analog); "
+        "empty = in-process cache only",
+    )
+    KNN_FULLSCAN_SELECTIVITY = SystemProperty(
+        "geomesa.knn.fullscan.selectivity", 0.5, float,
+        "kNN auto kernel choice: estimated filter selectivity at or above "
+        "which the dense fullscan replaces the sparse tile scan (stats-"
+        "driven StrategyDecider analog; sparse pruning cannot win when "
+        "nearly every data tile bears a match)",
+    )
     LOAD_INTERCEPTORS = SystemProperty(
         "geomesa.query.interceptors.load", False,
         lambda s: s.lower() in ("1", "true"),

@@ -192,3 +192,37 @@ def test_pip_layer_sharded_matches_single_device():
     assert (inside_s == exp).all()
     assert info_s["shards"] == int(np.prod(mesh.devices.shape))
     assert info_s["pairs"] > 0
+
+
+def test_layer_prep_cache_roundtrip(tmp_path):
+    # persistence (round 5): save/load round trip is exact, the disk cache
+    # hits on identical inputs, and a cached prep yields identical results
+    from geomesa_tpu.engine.pip_sparse import (
+        _PREP_MEM_CACHE, layer_prep_key, load_layer_prep, pip_layer,
+        prepare_layer, prepare_layer_cached, save_layer_prep)
+
+    rng = np.random.default_rng(17)
+    x1, y1, x2, y2, pol = make_layer(rng, npoly=8)
+    px, py = make_points(rng, x1, y1, x2, y2, n=4_000, na=50)
+    prep = prepare_layer(px, py, x1, y1, x2, y2, pol)
+    p = str(tmp_path / "prep.npz")
+    save_layer_prep(prep, p)
+    back = load_layer_prep(p)
+    for a, b in zip(prep[:6], back[:6]):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(prep.pairs, back.pairs):
+        np.testing.assert_array_equal(a, b)
+    assert (prep.n_ptiles, prep.n_etiles) == (back.n_ptiles, back.n_etiles)
+
+    _PREP_MEM_CACHE.clear()
+    c1 = prepare_layer_cached(px, py, x1, y1, x2, y2, pol,
+                              cache_dir=str(tmp_path))
+    key = layer_prep_key(px, py, x1, y1, x2, y2, pol)
+    assert (tmp_path / f"layerprep_{key}.npz").exists()
+    _PREP_MEM_CACHE.clear()  # force the DISK path
+    c2 = prepare_layer_cached(px, py, x1, y1, x2, y2, pol,
+                              cache_dir=str(tmp_path))
+    np.testing.assert_array_equal(c1.pairs.pair_pt, c2.pairs.pair_pt)
+    i1, _ = pip_layer(px, py, x1, y1, x2, y2, pol, interpret=True, prep=c2)
+    exp = oracle(px, py, x1, y1, x2, y2)
+    assert (i1 == exp).all()
