@@ -78,7 +78,10 @@ def register(sub: "argparse._SubParsersAction") -> None:
                       "shp", "parquet", "orc", "leaflet"]}),
          (["--attributes", "-a"], {"default": None, "help": "comma-sep projection"}),
          (["--max-features", "-m"], {"type": int, "default": None}),
-         (["--bin-track"], {"default": None, "help": "track attr for bin format"})],
+         (["--bin-track"], {"default": None, "help": "track attr for bin format"}),
+         (["--crs"], {"default": None,
+          "help": "output CRS: an EPSG code (4326, 3857, UTM 326xx/327xx) "
+                  "or 'utm' to pick the zone of the query bbox center"})],
     )
     cmd("explain", "print the query plan", _explain, [cat, feat, cql])
     cmd("stats-analyze", "compute and persist stats", _stats_analyze, [cat, feat])
@@ -273,8 +276,30 @@ def _export(args) -> int:
         if track is None:
             raise ValueError("bin export needs --bin-track (no non-geometry attribute)")
         hints = QueryHints(bin_track=track)
+    crs = None
+    if getattr(args, "crs", None):
+        if str(args.crs).lower() == "utm":
+            # auto zone from the query's spatial center (reprojection to
+            # the local UTM zone — the common analytic output request)
+            from geomesa_tpu.core.crs import utm_zone_srid
+            from geomesa_tpu.cql import parse_cql
+            from geomesa_tpu.cql.extract import extract_bbox
+
+            g = src.sft.default_geometry
+            bbox = extract_bbox(parse_cql(args.cql),
+                                g.name if g is not None else "")
+            if bbox.is_whole_world:
+                raise ValueError(
+                    "--crs utm needs a spatial filter (the zone is picked "
+                    "from the query bbox center); give an EPSG code instead"
+                )
+            crs = utm_zone_srid((bbox.xmin + bbox.xmax) / 2,
+                                (bbox.ymin + bbox.ymax) / 2)
+            print(f"auto UTM zone: EPSG:{crs}", file=sys.stderr)
+        else:
+            crs = int(str(args.crs).replace("EPSG:", "").replace("epsg:", ""))
     q = Query(args.feature_name, args.cql, attributes=attrs,
-              max_features=args.max_features, hints=hints)
+              max_features=args.max_features, hints=hints, crs=crs)
     r = src.get_features(q)
     if args.format == "shp":
         if args.output == "-":

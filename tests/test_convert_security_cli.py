@@ -358,6 +358,21 @@ class TestCLI:
         r = run_cli(["export", "-c", cat, "-f", "pois", "-q", "name = 'cafe'",
                      "-F", "csv"], cli_env)
         assert "cafe" in r.stdout and "pub" not in r.stdout
+
+        # round 5: export in a projected CRS (explicit EPSG and auto-UTM)
+        r = run_cli(["export", "-c", cat, "-f", "pois", "-q",
+                     "BBOX(geom, 0, 45, 5, 50)", "-F", "csv",
+                     "--crs", "3857"], cli_env)
+        assert r.returncode == 0, r.stderr
+        assert "261600.80" in r.stdout  # 2.35 deg lon -> 261600.8 m web mercator
+        r = run_cli(["export", "-c", cat, "-f", "pois", "-q",
+                     "BBOX(geom, 0, 45, 5, 50)", "-F", "csv",
+                     "--crs", "utm"], cli_env)
+        assert r.returncode == 0, r.stderr
+        assert "auto UTM zone: EPSG:32631" in r.stderr  # lon 2.5 -> zone 31
+        r = run_cli(["export", "-c", cat, "-f", "pois", "-q", "INCLUDE",
+                     "-F", "csv", "--crs", "utm"], cli_env)
+        assert r.returncode != 0  # no spatial filter: zone is ambiguous
         r = run_cli(["export", "-c", cat, "-f", "pois", "-F", "gml"], cli_env)
         assert r.returncode == 0, r.stderr
         assert "<gml:FeatureCollection" in r.stdout and "gml:pos" in r.stdout

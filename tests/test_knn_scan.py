@@ -181,3 +181,104 @@ def test_sparse_dead_slots_never_duplicate_tile0():
     exp = oracle(qx, qy, x, y, mask, k)
     np.testing.assert_allclose(
         np.sort(fd, 1), exp, rtol=1e-4, atol=1.0)
+
+
+class TestKnnExactRefine:
+    # round 5 (VERDICT r4 task 10): f64 re-ranking at the k-th boundary
+    # with a miss-impossible certificate
+
+    def test_engineered_f32_ties_rerank_exactly(self):
+        from geomesa_tpu.engine.geodesy import haversine_m_np
+        from geomesa_tpu.engine.knn_scan import (
+            knn_exact_refine, knn_sparse_auto)
+
+        rng = np.random.default_rng(41)
+        n, k, pad = 1 << 12, 5, 8
+        qx, qy = np.array([10.0]), np.array([45.0])
+        # the k-th boundary is a TIE CLUSTER that fits inside the pad:
+        # 3 clearly-closer points (~50 km, distinct) + 8 points along ONE
+        # bearing at ~71 km spaced ~1e-10 deg (~10 um) — far below f32
+        # resolution, so the f32 kernel genuinely cannot order them
+        # (review finding: a random-angle shell spread the distances by
+        # 190 m - 2 km and never created a tie). The true top-5 = the 3
+        # close + the f64-smallest 2 of the tied 8; only the f64 re-rank
+        # can pick those 2, and the certificate holds because the whole
+        # cluster fits within k' = k + pad.
+        rr = 0.9 + np.arange(8) * 1e-10
+        x = np.concatenate([
+            qx[0] + np.array([0.63, 0.64, 0.65]),
+            qx[0] + rr,
+            rng.uniform(30, 60, n - 11),  # far background
+        ])
+        y = np.concatenate([
+            np.full(11, qy[0]),
+            rng.uniform(-60, -30, n - 11),
+        ])
+        mask = np.ones(n, bool)
+        # the engineered tie cluster really is f32-indistinguishable
+        d32 = haversine_m_np(qx[0], qy[0], x[3:11], y[3:11]).astype(np.float32)
+        assert len(np.unique(d32)) < 8
+        fd, fi, cap = knn_sparse_auto(
+            jnp.asarray(qx, jnp.float32), jnp.asarray(qy, jnp.float32),
+            jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32),
+            jnp.asarray(mask), k=k + pad, interpret=True)
+        d64, idx, cert = knn_exact_refine(qx, qy, x, y, fd, fi, k)
+        exp_all = haversine_m_np(qx[0], qy[0], x, y)
+        exp = np.sort(exp_all)[:k]
+        # EXACT equality: both sides are the same f64 formula over the
+        # same original coordinates
+        np.testing.assert_array_equal(d64[0], exp)
+        assert bool(cert[0])
+        # the refined set is the true index set (distances here are
+        # distinct in f64 by construction)
+        assert set(idx[0].tolist()) == set(np.argsort(exp_all)[:k].tolist())
+
+    def test_antipodal_boundary_decertifies(self):
+        # near the antipode the f32 haversine error reaches km scale
+        # (asin amplification); the certificate must refuse there even
+        # with a comfortable-looking f32 margin (review finding: a flat
+        # 4 m + 1e-5*d model falsely certified this regime)
+        from geomesa_tpu.engine.knn_scan import (
+            knn_exact_refine, knn_f32_err_m)
+
+        assert knn_f32_err_m(100e3) < 10.0           # mid-range: meters
+        assert knn_f32_err_m(19.9e6) > 2_000.0       # antipodal: km scale
+        qx, qy = np.array([0.0]), np.array([0.0])
+        # candidates ~100 km short of the antipode, 500 m apart in f64
+        x = 179.0 + np.arange(64) * 0.005
+        y = np.full(64, 0.5)
+        from geomesa_tpu.engine.geodesy import haversine_m_np
+
+        d_all = haversine_m_np(qx[0], qy[0], x, y)
+        o = np.argsort(d_all)[:8]
+        fd = d_all[o].astype(np.float32)[None]
+        fi = o[None]
+        d64, idx, cert = knn_exact_refine(qx, qy, x, y, fd, fi, k=5)
+        assert not bool(cert[0])  # 1.5 km margin < km-scale f32 error
+
+    def test_uncertified_when_pad_is_all_ties(self):
+        from geomesa_tpu.engine.knn_scan import knn_exact_refine
+
+        # every candidate within sub-resolution of the k-th boundary and
+        # beyond the pad: the certificate must refuse
+        qx, qy = np.array([0.0]), np.array([0.0])
+        x = np.full(64, 1.0)
+        y = np.zeros(64)
+        fd = np.full((1, 8), np.float32(111194.9), np.float32)
+        fi = np.arange(8, dtype=np.int64)[None]
+        d64, idx, cert = knn_exact_refine(qx, qy, x, y, fd, fi, k=5)
+        assert not bool(cert[0])
+
+    def test_certified_short_result(self):
+        from geomesa_tpu.engine.knn_scan import knn_exact_refine
+
+        # fewer matches than k': nothing was cut off -> certified
+        qx, qy = np.array([0.0]), np.array([0.0])
+        x = np.array([1.0, 2.0, 3.0])
+        y = np.zeros(3)
+        fd = np.array([[111000.0, 222000.0, 333000.0, np.inf, np.inf,
+                        np.inf, np.inf, np.inf]], np.float32)
+        fi = np.array([[0, 1, 2, 0, 0, 0, 0, 0]], np.int64)
+        d64, idx, cert = knn_exact_refine(qx, qy, x, y, fd, fi, k=5)
+        assert bool(cert[0])
+        assert np.isinf(d64[0, 3:]).all()
