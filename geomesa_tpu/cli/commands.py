@@ -297,7 +297,22 @@ def _export(args) -> int:
                                 (bbox.ymin + bbox.ymax) / 2)
             print(f"auto UTM zone: EPSG:{crs}", file=sys.stderr)
         else:
-            crs = int(str(args.crs).replace("EPSG:", "").replace("epsg:", ""))
+            s = str(args.crs).lower().removeprefix("epsg:")
+            try:
+                crs = int(s)
+            except ValueError:
+                raise ValueError(
+                    f"--crs must be 'utm' or an EPSG code (got {args.crs!r})"
+                ) from None
+    if crs is not None and crs != 4326:
+        # bin results bypass finish_features (raw stored lon/lat), and
+        # leaflet plots lat/lng — a projected CRS would silently corrupt both
+        if args.format == "bin":
+            raise ValueError("--crs is not supported for -F bin "
+                             "(BIN encodes stored lon/lat)")
+        if args.format == "leaflet":
+            raise ValueError("--crs is not supported for -F leaflet "
+                             "(leaflet maps plot EPSG:4326 lat/lng)")
     q = Query(args.feature_name, args.cql, attributes=attrs,
               max_features=args.max_features, hints=hints, crs=crs)
     r = src.get_features(q)

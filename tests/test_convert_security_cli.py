@@ -373,6 +373,22 @@ class TestCLI:
         r = run_cli(["export", "-c", cat, "-f", "pois", "-q", "INCLUDE",
                      "-F", "csv", "--crs", "utm"], cli_env)
         assert r.returncode != 0  # no spatial filter: zone is ambiguous
+        # mixed-case prefix parses; garbage gets a clear error, not a
+        # traceback; projected CRS is rejected for formats that would
+        # silently corrupt (bin stores raw lon/lat, leaflet plots lat/lng)
+        r = run_cli(["export", "-c", cat, "-f", "pois", "-q",
+                     "BBOX(geom, 0, 45, 5, 50)", "-F", "csv",
+                     "--crs", "Epsg:3857"], cli_env)
+        assert r.returncode == 0 and "261600.80" in r.stdout, r.stderr
+        r = run_cli(["export", "-c", cat, "-f", "pois", "-F", "csv",
+                     "--crs", "3857m"], cli_env)
+        assert r.returncode != 0 and "EPSG" in r.stderr
+        r = run_cli(["export", "-c", cat, "-f", "pois", "-F", "bin",
+                     "--crs", "3857"], cli_env)
+        assert r.returncode != 0 and "bin" in r.stderr.lower()
+        r = run_cli(["export", "-c", cat, "-f", "pois", "-F", "leaflet",
+                     "--crs", "3857"], cli_env)
+        assert r.returncode != 0 and "leaflet" in r.stderr.lower()
         r = run_cli(["export", "-c", cat, "-f", "pois", "-F", "gml"], cli_env)
         assert r.returncode == 0, r.stderr
         assert "<gml:FeatureCollection" in r.stdout and "gml:pos" in r.stdout
