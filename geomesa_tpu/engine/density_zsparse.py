@@ -52,7 +52,7 @@ import numpy as np
 BBox = Tuple[float, float, float, float]
 
 DATA_TILE = 4096
-CHUNK = 2048
+CHUNK = 1024  # hardware sweep (round 5): 144 ms vs 185 ms at 2048/4096
 MAX_CAPD = 512   # beyond this many distinct cells the scatter path wins
 BIGCELL = 1 << 30
 
@@ -143,66 +143,107 @@ def calibrate_density(
 
 
 def _make_kernel(data_tile: int, chunk: int, capd: int, bbox: BBox,
-                 width: int, height: int):
-    def _kernel(ids_ref, dict_ref, x_ref, y_ref, w_ref, m_ref, out_ref):
-        drow = dict_ref[0, 0, :].reshape(1, capd)
-        acc = jnp.zeros((1, capd), jnp.float32)
-        for s in range(data_tile // chunk):
-            sl = slice(s * chunk, (s + 1) * chunk)
-            cells, ok = _bin_cells(
-                x_ref[0, sl], y_ref[0, sl], m_ref[0, sl] > 0.5,
-                bbox, width, height,
-            )
-            # the mask folds into the f32 weights, NOT a bool reshape:
-            # Mosaic rejects minor-dim insertion on i1 vectors
-            lw = jnp.where(ok, w_ref[0, sl], 0.0).reshape(chunk, 1)
-            match = cells.reshape(chunk, 1) == drow
-            acc = acc + jnp.sum(
-                jnp.where(match, lw, 0.0), axis=0,
-            ).reshape(1, capd)
-        out_ref[...] = acc.reshape(out_ref.shape)
+                 width: int, height: int, tpp: int):
+    """tpp data tiles folded per program (each a separate scalar-indexed
+    operand triple, the pip-kernel e_per idiom): at bench scale the
+    one-tile-per-program grid paid ~16k program launches of fixed
+    overhead (~33 ms) against ~6 ms of VPU work — tiles-per-program
+    amortizes it tpp-fold. The filter mask arrives pre-folded into the
+    weights (masked-out rows carry w=0), saving one operand array per
+    tile and a full HBM pass over the mask."""
+
+    def _kernel(ids_ref, dict_ref, *refs):
+        out_ref = refs[-1]
+        rows = []
+        for e in range(tpp):
+            x_ref, y_ref, w_ref = refs[3 * e: 3 * e + 3]
+            drow = dict_ref[0, e, :].reshape(1, capd)
+            acc = jnp.zeros((1, capd), jnp.float32)
+            for s in range(data_tile // chunk):
+                sl = slice(s * chunk, (s + 1) * chunk)
+                cells, ok = _bin_cells(
+                    x_ref[0, sl], y_ref[0, sl], True,
+                    bbox, width, height,
+                )
+                # out-of-bounds zeroing folds into the f32 weights, NOT
+                # a bool reshape: Mosaic rejects minor-dim insertion on i1
+                lw = jnp.where(ok, w_ref[0, sl], 0.0).reshape(chunk, 1)
+                match = cells.reshape(chunk, 1) == drow
+                acc = acc + jnp.sum(
+                    jnp.where(match, lw, 0.0), axis=0,
+                ).reshape(1, capd)
+            rows.append(acc)
+        out_ref[...] = jnp.concatenate(rows, axis=0).reshape(out_ref.shape)
 
     return _kernel
+
+
+TILES_PER_PROGRAM = 4
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "capd", "bbox", "width", "height", "data_tile", "chunk", "interpret"
+        "capd", "bbox", "width", "height", "data_tile", "chunk",
+        "interpret", "tpp",
     ),
 )
 def _zsparse_call(
-    x, y, w, maskf, tile_ids, dicts,
+    x, y, lw, tile_ids, dicts,
     capd: int, bbox: BBox, width: int, height: int,
     data_tile: int, chunk: int, interpret: bool,
+    tpp: int = TILES_PER_PROGRAM,
 ):
+    """`lw` carries the mask pre-folded (w where mask else 0). VMEM
+    budget at tpp=4, capd<=512: 12 data blocks x 128 KB (sublane-padded)
+    x 2 (double-buffer) + the padded out stack block — comfortably
+    inside the 16 MB scoped limit (tpp=8 with a separate mask operand
+    measured 30.6 MB and failed to compile)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n = x.shape[0]
-    s = tile_ids.shape[0]
+    s0 = tile_ids.shape[0]
+    tpp = min(tpp, s0)
+    pad = (-s0) % tpp
+    if pad:
+        # pad rows scan tile 0 against an all(-1) dictionary: nothing
+        # matches, zeros fold into the sink slot
+        tile_ids = jnp.concatenate(
+            [tile_ids, jnp.zeros(pad, tile_ids.dtype)])
+        dicts = jnp.concatenate(
+            [dicts, jnp.full((pad, capd), -1, dicts.dtype)])
+    s = s0 + pad
     xr = x.astype(jnp.float32).reshape(1, n)
     yr = y.astype(jnp.float32).reshape(1, n)
-    wr = w.astype(jnp.float32).reshape(1, n)
-    mr = maskf.reshape(1, n)
-    dr = dicts.reshape(s, 1, capd)
+    wr = lw.astype(jnp.float32).reshape(1, n)
+    dr = dicts.reshape(s // tpp, tpp, capd)
 
-    data_block = pl.BlockSpec((1, data_tile), lambda p, ids: (0, ids[p]))
-    dict_block = pl.BlockSpec((1, 1, capd), lambda p, ids: (p, 0, 0))
+    def data_block(e):
+        return pl.BlockSpec(
+            (1, data_tile), lambda p, ids, e=e: (0, ids[p * tpp + e]))
+
+    dict_block = pl.BlockSpec((1, tpp, capd), lambda p, ids: (p, 0, 0))
+    data_specs = []
+    data_args = []
+    for e in range(tpp):
+        data_specs.extend([data_block(e)] * 3)
+        data_args.extend([xr, yr, wr])
     with jax.enable_x64(False):
         counts = pl.pallas_call(
-            _make_kernel(data_tile, chunk, capd, bbox, width, height),
+            _make_kernel(data_tile, chunk, capd, bbox, width, height, tpp),
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1,
-                grid=(s,),
-                in_specs=[dict_block] + [data_block] * 4,
+                grid=(s // tpp,),
+                in_specs=[dict_block] + data_specs,
                 out_specs=pl.BlockSpec(
-                    (1, 1, capd), lambda p, ids: (p, 0, 0)),
+                    (1, tpp, capd), lambda p, ids: (p, 0, 0)),
             ),
-            out_shape=jax.ShapeDtypeStruct((s, 1, capd), jnp.float32),
+            out_shape=jax.ShapeDtypeStruct((s // tpp, tpp, capd),
+                                           jnp.float32),
             interpret=interpret,
-        )(tile_ids.astype(jnp.int32), dr, xr, yr, wr, mr)
-    return counts.reshape(s, capd)
+        )(tile_ids.astype(jnp.int32), dr, *data_args)
+    return counts.reshape(s, capd)[:s0]
 
 
 @functools.partial(jax.jit, static_argnames=("width", "height"))
@@ -276,11 +317,12 @@ def density_zsparse(
         )
 
     grid = jnp.zeros((height, width), jnp.float32)
+    lwp = jnp.where(mp, wp, 0.0)  # mask pre-folded (one fused pass)
     if len(calib.tile_ids):
         # chunk the tile list so one call's output + dictionary operand
         # stay small (XLA may place a pallas output in VMEM; a full
         # [S, 1, cap] array blew the 16 MB scoped limit at bench scale)
-        maxs = max(256, (1 << 20) // max(calib.capd, 1))
+        maxs = max(256, (1 << 19) // max(calib.capd, 1))
         S = len(calib.tile_ids)
         for c0 in range(0, S, maxs):
             c1 = min(c0 + maxs, S)
@@ -297,7 +339,7 @@ def density_zsparse(
                 # padding rows re-scan a real tile against an all-pad
                 # dictionary: nothing matches, zeros fold into the sink
             counts = _zsparse_call(
-                xp, yp, wp, mp.astype(jnp.float32),
+                xp, yp, lwp,
                 jnp.asarray(ids_c), jnp.asarray(dict_c),
                 capd=calib.capd, bbox=tuple(bbox), width=width,
                 height=height,
@@ -413,18 +455,18 @@ def density_zsparse_sharded(
         dictsl = dictsl.reshape(-1, capd)
         didl = didl.reshape(-1)
         dvall = dvall.reshape(-1)
-        mlf = ml.astype(jnp.float32)
+        lwl = jnp.where(ml, wl, 0.0)  # mask pre-folded (driver idiom)
         # chunk the tile list exactly like the single-device driver: a
         # full [S, 1, capd] pallas output may land in VMEM and blew the
         # 16 MB scoped limit at bench scale (review finding — the mesh
         # path must survive the scale it exists for)
         S = int(idsl.shape[0])
-        maxs = max(256, (1 << 20) // max(capd, 1))
+        maxs = max(256, (1 << 19) // max(capd, 1))
         grid = jnp.zeros((height, width), jnp.float32)
         for c0 in range(0, S, maxs):
             c1 = min(c0 + maxs, S)
             counts = _zsparse_call(
-                xl, yl, wl, mlf, idsl[c0:c1], dictsl[c0:c1],
+                xl, yl, lwl, idsl[c0:c1], dictsl[c0:c1],
                 capd=capd, bbox=bbox, width=width, height=height,
                 data_tile=data_tile, chunk=min(CHUNK, data_tile),
                 interpret=interpret,

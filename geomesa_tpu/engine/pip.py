@@ -96,10 +96,15 @@ BAND_EPS = 1e-4
 def points_in_polygon_band(px, py, x1, y1, x2, y2, eps: float = BAND_EPS):
     """Boundary-ambiguity flags: True where the f32 crossing test may
     disagree with f64 (SURVEY.md:824-827 robustness plan). Flag rule per
-    edge: endpoint-y proximity (the span condition itself can flip), or a
-    crossing whose x lands within the slope-amplified error of px. Callers
-    re-evaluate flagged rows on host in f64 (cql.hosteval) — see
-    CompiledFilter.mask_refined."""
+    edge (see pip_sparse._crossing_and_band for the proof): a crossing
+    whose x lands within the slope-amplified error of px, or a
+    near-horizontal edge (both endpoint ys within eps of py) whose
+    eps-inflated bbox contains the point — the only case where the two
+    span comparisons can flip independently. A general endpoint-y strip
+    is NOT needed: vertex comparisons are bit-consistent across a closed
+    ring's incident edges in any precision, so parity survives rounding
+    away from the boundary. Callers re-evaluate flagged rows on host in
+    f64 (cql.hosteval) — see CompiledFilter.mask_refined."""
     from geomesa_tpu.engine.pip_pallas import (
         points_in_polygon_band_pallas,
         use_pallas_pip,
@@ -109,8 +114,15 @@ def points_in_polygon_band(px, py, x1, y1, x2, y2, eps: float = BAND_EPS):
         return points_in_polygon_band_pallas(px, py, x1, y1, x2, y2, eps=eps)
     px = px[:, None]
     py = py[:, None]
-    near_end = (jnp.abs(py - y1[None, :]) <= eps) | (
-        jnp.abs(py - y2[None, :]) <= eps
+    # band terms match pip_sparse._crossing_and_band (see its proof):
+    # edge-crossing proximity + the near-horizontal-edge bbox; a general
+    # endpoint-y strip is unnecessary (vertex comparisons are consistent
+    # across a closed ring's incident edges in any precision)
+    near_flat = (
+        (jnp.abs(py - y1[None, :]) <= eps)
+        & (jnp.abs(py - y2[None, :]) <= eps)
+        & (px >= jnp.minimum(x1, x2)[None, :] - eps)
+        & (px <= jnp.maximum(x1, x2)[None, :] + eps)
     )
     cond = (y1[None, :] <= py) != (y2[None, :] <= py)
     dy = jnp.where(y2 == y1, 1.0, y2 - y1)[None, :]
@@ -121,7 +133,7 @@ def points_in_polygon_band(px, py, x1, y1, x2, y2, eps: float = BAND_EPS):
         + jnp.abs(x2 - x1)[None, :] / jnp.maximum(jnp.abs(y2 - y1), eps)[None, :]
     )
     near_cross = cond & (jnp.abs(xc - px) <= err)
-    return jnp.any(near_end | near_cross, axis=1)
+    return jnp.any(near_flat | near_cross, axis=1)
 
 
 def points_in_polygon_np(px, py, geom: Geometry) -> np.ndarray:

@@ -124,12 +124,15 @@ def _pip_band_kernel(
     x2 = x2_ref[0]
     y2 = y2_ref[0]
 
-    near_end = (jnp.abs(py - y1) <= eps) | (jnp.abs(py - y2) <= eps)
+    # band terms match pip_sparse._crossing_and_band (see its proof)
+    near_flat = ((jnp.abs(py - y1) <= eps) & (jnp.abs(py - y2) <= eps)
+                 & (px >= jnp.minimum(x1, x2) - eps)
+                 & (px <= jnp.maximum(x1, x2) + eps))
     cond = (y1 <= py) != (y2 <= py)
     t = (py - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
     xc = x1 + t * (x2 - x1)
     err = eps * (1.0 + jnp.abs(x2 - x1) / jnp.maximum(jnp.abs(y2 - y1), eps))
-    flag = jnp.sum((near_end | (cond & (jnp.abs(xc - px) <= err))).astype(jnp.int32), axis=0)
+    flag = jnp.sum((near_flat | (cond & (jnp.abs(xc - px) <= err))).astype(jnp.int32), axis=0)
     out_ref[...] += flag.reshape(out_ref.shape)
 
 

@@ -256,14 +256,42 @@ def build_pairs(
 
 def _crossing_and_band(px, py, x1, y1, x2, y2, eps: float):
     """Shared predicate math for the PIP kernel bodies: returns
-    (crossing bool [E, P], band-flag bool [E, P])."""
+    (crossing bool [E, P], band-flag bool [E, P]).
+
+    Why the flag needs NO general endpoint-y strip (round 5; the old
+    `|py - y_end| <= eps` term flagged 23% of config-2 points — a
+    horizontal strip across the whole tile per endpoint — and made the
+    host f64 refine the first-query bottleneck): f32 evaluation computes
+    the EXACT even-odd parity of a perturbed polygon. Each vertex
+    comparison `(V.y <= py)` is computed bit-identically by both edges
+    incident to V (rings are closed; both store the same f32 V), so a
+    rounding flip moves V to the other side of the ray CONSISTENTLY —
+    pass-through vertices still count once, extrema 0 or 2. Parity of
+    the perturbed polygon differs from the true one only for points
+    within the perturbation distance of the BOUNDARY, which two cheap
+    local tests cover exactly:
+      1. `cond & |xc - px| <= err` — horizontal proximity to the edge's
+         ray crossing, with `err` inflated by the slope so y-rounding of
+         a shallow edge (dxc = slope * dy) stays inside the band;
+      2. `near_flat` — an edge whose BOTH endpoint ys are within eps of
+         py can have its two comparisons flip independently (the
+         vertex-consistency argument couples comparisons across edges,
+         not within one); that edge is then near-horizontal at py, so
+         the affected points lie inside its eps-inflated bbox — flag
+         exactly those, not the whole strip.
+    Points outside both bands provably match the f64 oracle; flagged
+    points are re-evaluated in f64 by _refine_band_f64."""
     cond = (y1 <= py) != (y2 <= py)
     t = (py - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
     xc = x1 + t * (x2 - x1)
-    near_end = (jnp.abs(py - y1) <= eps) | (jnp.abs(py - y2) <= eps)
     err = eps * (1.0 + jnp.abs(x2 - x1)
                  / jnp.maximum(jnp.abs(y2 - y1), eps))
-    return cond & (xc > px), near_end | (cond & (jnp.abs(xc - px) <= err))
+    near_flat = (
+        (jnp.abs(py - y1) <= eps) & (jnp.abs(py - y2) <= eps)
+        & (px >= jnp.minimum(x1, x2) - eps)
+        & (px <= jnp.maximum(x1, x2) + eps)
+    )
+    return cond & (xc > px), near_flat | (cond & (jnp.abs(xc - px) <= err))
 
 
 def _sparse_kernel(pt_ref, et_ref, px_ref, py_ref,

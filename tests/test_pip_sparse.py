@@ -72,6 +72,54 @@ class TestPipLayer:
         assert (inside == exp).all()
         assert info["pairs"] > 0 and info["refined"] > 0
 
+    def test_vertex_aligned_far_points_exact_and_unflagged(self):
+        """Points whose y sits on polygon-vertex ys but far away in x:
+        the pre-round-5 endpoint strip flagged essentially all of them
+        (23% of config-2 points — the first-query bottleneck); the
+        vertex-consistency argument (_crossing_and_band docstring) says
+        they need no f64 refinement and must still match the oracle."""
+        rng = np.random.default_rng(7)
+        x1, y1, x2, y2, pol = make_layer(rng)
+        k = 4096
+        vi = rng.integers(0, len(x1), k)
+        py = y1[vi] + rng.choice([0.0, 1e-7, -1e-7], k)
+        px = rng.uniform(-60, 60, k)
+        o = np.argsort(px + 1e-3 * py)
+        px, py = px[o], py[o]
+        inside, info = pip_layer(px, py, x1, y1, x2, y2, pol,
+                                 interpret=True)
+        exp = oracle(px, py, x1, y1, x2, y2)
+        assert (inside == exp).all()
+        # flagging must be edge-proximity-local now, not strip-global
+        assert info["flagged"] < k // 8
+
+    def test_near_horizontal_edge_points_exact(self):
+        """A long near-horizontal edge: both endpoint comparisons can
+        flip independently, so points within rounding distance above or
+        below it across its whole x-span must be flagged (near_flat)
+        and refined to the f64 answer."""
+        h = 2.5e-5  # edge y-slope smaller than the 1e-4 band
+        ring = np.array([
+            [-40.0, 10.0], [40.0, 10.0 + h], [40.0, 30.0],
+            [-40.0, 30.0], [-40.0, 10.0],
+        ])
+        x1, y1 = ring[:-1, 0], ring[:-1, 1]
+        x2, y2 = ring[1:, 0], ring[1:, 1]
+        pol = np.zeros(4, np.int64)
+        rng = np.random.default_rng(9)
+        k = 2048
+        px = rng.uniform(-39, 39, k)
+        # y on/around the shallow edge at each point's x, within f32 noise
+        ye = 10.0 + (px + 40.0) / 80.0 * h
+        py = ye + rng.uniform(-1e-6, 1e-6, k)
+        o = np.argsort(px)
+        px, py = px[o], py[o]
+        inside, info = pip_layer(px, py, x1, y1, x2, y2, pol,
+                                 interpret=True)
+        exp = oracle(px, py, x1, y1, x2, y2)
+        assert (inside == exp).all()
+        assert info["refined"] > 0  # the band caught them
+
     def test_chunked_calls_match_single_call(self):
         # force multi-chunk execution INCLUDING an intra-tile split: the
         # per-chunk partial counts must add exactly (round-3 review:
