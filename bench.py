@@ -832,14 +832,34 @@ def bench_density(n, repeats, dist="uniform", order="store", smoke=False,
     grid_cpu = cpu()
     # histogram2d puts top-edge values in the last bin; compare total mass
     mass_ok = abs(grid_dev.sum() - grid_cpu.sum()) / max(grid_cpu.sum(), 1) < 1e-3
-    # cell-exact parity vs the repo's own scatter oracle (device f32
-    # binning differs from histogram2d's f64 edges at edge-sitting
-    # points, so the mass gate covers histogram2d; cells are gated
-    # against density_grid, the kernel contract)
+    # Two-part cells gate (round 5). Both gates compare the two DEVICE
+    # kernels — identical binning by construction (a host-emulated f32
+    # reference cannot match it: --xla_allow_excess_precision lets XLA
+    # compile the f32 division as reciprocal-multiply, so boundary
+    # points rebin by one cell vs IEEE division):
+    #  (a) EXACT integer parity of the unweighted count grid — counts
+    #      are f32-exact below 2^24 per cell, so any dropped/duplicated
+    #      point is a hard mismatch (this is the data-loss gate);
+    #  (b) weighted zsparse vs weighted scatter within per-cell
+    #      summation-order noise: f32 accumulation of c addends walks
+    #      ~ sqrt(c) * eps32 * mass (clustered hot cells hold ~1e6
+    #      points = 2e-4 relative, far beyond any flat rtol); bound =
+    #      5x headroom over eps32 = 6e-8 plus a 0.5 absolute floor.
     from geomesa_tpu.engine.density import density_grid as _scatter
 
-    grid_ref = np.asarray(_scatter(dx, dy, dw, m, bbox, W, H))
-    cell_ok = bool(np.allclose(grid_dev, grid_ref, rtol=1e-5, atol=1e-2))
+    ones = jnp.ones_like(dw)
+    if impl == "zsparse":
+        cnt_dev = np.asarray(density_zsparse(
+            dx, dy, ones, m, bbox, W, H, interpret=smoke)[0])
+    else:
+        cnt_dev = np.asarray(run(dx, dy, ones, m))
+    cnt_ref = np.asarray(_scatter(dx, dy, ones, m, bbox, W, H))
+    count_exact = bool(np.array_equal(cnt_dev, cnt_ref))
+    grid_ref = np.asarray(
+        _scatter(dx, dy, dw, m, bbox, W, H), np.float64)
+    tol = 3e-7 * np.sqrt(np.maximum(cnt_ref, 1.0)) * np.abs(grid_ref) + 0.5
+    cell_ok = count_exact and bool(
+        (np.abs(grid_dev - grid_ref) <= tol).all())
     pps = n / dev_t
     out = {
         "metric": "density_512_points_per_sec_per_chip",
@@ -861,6 +881,7 @@ def bench_density(n, repeats, dist="uniform", order="store", smoke=False,
                         "measured single-core np.histogram2d",
             "grid_mass_parity": bool(mass_ok),
             "grid_cells_parity": cell_ok,
+            "count_grid_exact": count_exact,
         },
     }
     if impl == "zsparse":

@@ -4,10 +4,13 @@ Parity role: the LocalQueryRunner's reprojection step (upstream
 o.l.g.index.planning.LocalQueryRunner via GeoTools ReprojectingFeature-
 Collection — SURVEY.md:219-220): a Query may request output in a CRS
 other than the store's native one, applied as a finish step on result
-geometries. The registry is deliberately small — EPSG:4326 (lon/lat
-WGS84, the engine's native frame) and EPSG:3857 (spherical web
-mercator) — with closed-form vectorized transforms; anything else
-raises. st_transform in the SQL layer shares these functions.
+geometries. Registered families, all closed-form and vectorized:
+EPSG:4326 (lon/lat WGS84, the engine's native frame), EPSG:3857
+(spherical web mercator), the UTM zone grid (326xx/327xx, 6th-order
+Krueger), polar stereographic (3413/3031/3976, the NSIDC/Antarctic
+frames) and LAEA Europe (3035) — the projected frames geospatial
+analysts actually request; anything else raises. st_transform in the
+SQL layer shares these functions.
 
 All engine math (curves, predicates, kernels) stays in 4326; 3857 is an
 OUTPUT (or input-normalization) frame only, matching how the reference
@@ -166,45 +169,202 @@ def _from_utm(x, y, lon0: float, fn: float):
     return lon0 + np.degrees(dlam), np.degrees(phi)
 
 
-_TRANSFORMS: Dict[Tuple[int, int], Callable] = {
-    (4326, 4326): _ident,
-    (3857, 3857): _ident,
-    (4326, 3857): _to_mercator,
-    (3857, 4326): _from_mercator,
+# --- polar stereographic family (round 5) ----------------------------------
+# EPSG 9829 (variant B, standard-parallel form), Snyder 21-32..21-41:
+# the NSIDC / Antarctic analytic frames. Registered: 3413 (NSIDC Arctic,
+# lat_ts 70N, lon0 -45), 3031 (Antarctic, lat_ts 71S, lon0 0), 3976
+# (NSIDC Sea Ice South, lat_ts 70S, lon0 0). All WGS84, FE = FN = 0.
+
+_E = np.sqrt(_WGS84_F * (2.0 - _WGS84_F))  # first eccentricity
+
+# srid -> (lon0_deg, lat_ts_deg, south)
+_POLAR: Dict[int, Tuple[float, float, bool]] = {
+    3413: (-45.0, 70.0, False),
+    3031: (0.0, -71.0, True),
+    3976: (0.0, -70.0, True),
 }
+
+
+def _ps_t(phi):
+    """Snyder 15-9: the isometric-colatitude parameter t."""
+    s = _E * np.sin(phi)
+    return (np.tan(np.pi / 4.0 - phi / 2.0)
+            / ((1.0 - s) / (1.0 + s)) ** (_E / 2.0))
+
+
+def _to_polar(x, y, lon0: float, lat_ts: float, south: bool):
+    lon = np.asarray(x, np.float64)
+    lat = np.asarray(y, np.float64)
+    if south:  # solve on the north-polar form with mirrored latitude
+        lat = -lat
+        lon = -lon
+        lon0 = -lon0
+    phi = np.radians(lat)
+    phi_c = np.radians(abs(lat_ts))
+    mc = np.cos(phi_c) / np.sqrt(1.0 - (_E * np.sin(phi_c)) ** 2)
+    rho = _WGS84_A * mc * _ps_t(phi) / _ps_t(phi_c)
+    dlam = np.radians(lon - lon0)
+    ex = rho * np.sin(dlam)
+    ny = -rho * np.cos(dlam)
+    if south:
+        ex, ny = -ex, -ny
+    return ex, ny
+
+
+def _from_polar(x, y, lon0: float, lat_ts: float, south: bool):
+    ex = np.asarray(x, np.float64)
+    ny = np.asarray(y, np.float64)
+    if south:
+        ex, ny = -ex, -ny
+        lon0 = -lon0
+    phi_c = np.radians(abs(lat_ts))
+    mc = np.cos(phi_c) / np.sqrt(1.0 - (_E * np.sin(phi_c)) ** 2)
+    rho = np.hypot(ex, ny)
+    t = rho * _ps_t(phi_c) / (_WGS84_A * mc)
+    phi = np.pi / 2.0 - 2.0 * np.arctan(t)
+    for _ in range(6):  # Snyder 7-9 fixed point; quadratic convergence
+        s = _E * np.sin(phi)
+        phi = (np.pi / 2.0
+               - 2.0 * np.arctan(t * ((1.0 - s) / (1.0 + s)) ** (_E / 2.0)))
+    dlam = np.arctan2(ex, -ny)
+    lon = lon0 + np.degrees(dlam)
+    lat = np.degrees(phi)
+    if south:
+        lon, lat = -lon, -lat
+    return lon, lat
+
+
+# --- Lambert azimuthal equal-area: EPSG 3035 (ETRS89-extended / LAEA
+# Europe; treated as WGS84 — the datums agree to <1 m) ----------------------
+# Snyder 24-2..24-16 with authalic latitudes; the statistical-analysis
+# frame for pan-European grids.
+
+_LAEA: Dict[int, Tuple[float, float, float, float]] = {
+    # srid -> (lon0, lat0, false easting, false northing)
+    3035: (10.0, 52.0, 4_321_000.0, 3_210_000.0),
+}
+_E2 = _E * _E
+
+
+def _laea_q(phi):
+    s = np.sin(phi)
+    es = _E * s
+    return (1.0 - _E2) * (
+        s / (1.0 - _E2 * s * s)
+        - np.log((1.0 - es) / (1.0 + es)) / (2.0 * _E)
+    )
+
+
+_QP = _laea_q(np.pi / 2.0)
+_RQ = _WGS84_A * np.sqrt(_QP / 2.0)
+# authalic -> geodetic series coefficients (Snyder 3-18)
+_AUTH = (
+    _E2 / 3.0 + 31.0 * _E2**2 / 180.0 + 517.0 * _E2**3 / 5040.0,
+    23.0 * _E2**2 / 360.0 + 251.0 * _E2**3 / 3780.0,
+    761.0 * _E2**3 / 45360.0,
+)
+
+
+def _to_laea(x, y, lon0: float, lat0: float, fe: float, fn: float):
+    lon = np.asarray(x, np.float64)
+    lat = np.asarray(y, np.float64)
+    phi = np.radians(lat)
+    lam0 = np.radians(lon0)
+    phi0 = np.radians(lat0)
+    beta = np.arcsin(np.clip(_laea_q(phi) / _QP, -1.0, 1.0))
+    beta0 = np.arcsin(np.clip(_laea_q(phi0) / _QP, -1.0, 1.0))
+    m0 = np.cos(phi0) / np.sqrt(1.0 - (_E * np.sin(phi0)) ** 2)
+    d = _WGS84_A * m0 / (_RQ * np.cos(beta0))
+    dlam = np.radians(lon) - lam0
+    denom = 1.0 + (np.sin(beta0) * np.sin(beta)
+                   + np.cos(beta0) * np.cos(beta) * np.cos(dlam))
+    b = _RQ * np.sqrt(2.0 / denom)
+    ex = fe + b * d * np.cos(beta) * np.sin(dlam)
+    ny = fn + (b / d) * (np.cos(beta0) * np.sin(beta)
+                         - np.sin(beta0) * np.cos(beta) * np.cos(dlam))
+    return ex, ny
+
+
+def _from_laea(x, y, lon0: float, lat0: float, fe: float, fn: float):
+    ex = np.asarray(x, np.float64) - fe
+    ny = np.asarray(y, np.float64) - fn
+    phi0 = np.radians(lat0)
+    beta0 = np.arcsin(np.clip(_laea_q(phi0) / _QP, -1.0, 1.0))
+    m0 = np.cos(phi0) / np.sqrt(1.0 - (_E * np.sin(phi0)) ** 2)
+    d = _WGS84_A * m0 / (_RQ * np.cos(beta0))
+    rho = np.hypot(ex / d, d * ny)
+    ce = 2.0 * np.arcsin(np.clip(rho / (2.0 * _RQ), -1.0, 1.0))
+    with np.errstate(invalid="ignore", divide="ignore"):
+        beta = np.where(
+            rho == 0.0, beta0,
+            np.arcsin(np.clip(
+                np.cos(ce) * np.sin(beta0)
+                + (d * ny * np.sin(ce) * np.cos(beta0)) / np.where(
+                    rho == 0.0, 1.0, rho), -1.0, 1.0)),
+        )
+        dlam = np.arctan2(
+            (ex / d) * np.sin(ce),
+            rho * np.cos(beta0) * np.cos(ce)
+            - d * ny * np.sin(beta0) * np.sin(ce),
+        )
+        dlam = np.where(rho == 0.0, 0.0, dlam)
+    phi = beta + (_AUTH[0] * np.sin(2.0 * beta)
+                  + _AUTH[1] * np.sin(4.0 * beta)
+                  + _AUTH[2] * np.sin(6.0 * beta))
+    # the 3-term authalic series leaves ~1e-8 deg (~1.3 mm); two Newton
+    # steps on q(phi) = q (Snyder 3-16) converge to f64 round-trip
+    q = _QP * np.sin(beta)
+    for _ in range(2):
+        s = np.sin(phi)
+        es = _E * s
+        w2 = 1.0 - _E2 * s * s
+        phi = phi + (w2 ** 2 / (2.0 * np.cos(phi))) * (
+            q / (1.0 - _E2) - s / w2
+            + np.log((1.0 - es) / (1.0 + es)) / (2.0 * _E)
+        )
+    return lon0 + np.degrees(dlam), np.degrees(phi)
 
 
 def supported(from_srid: int, to_srid: int) -> bool:
     return _lookup(int(from_srid), int(to_srid)) is not None
 
 
+def _proj_pair(srid: int):
+    """(to_from_4326, from_to_4326) for any registered projected CRS —
+    spherical mercator, the UTM zone grid, polar stereographic, LAEA —
+    or None. Every projected<->projected route goes through 4326 (the
+    native frame, exactly invertible at f64)."""
+    pu = _utm_params(srid)
+    if pu is not None:
+        return (lambda lx, ly: _to_utm(lx, ly, *pu),
+                lambda ex, ey: _from_utm(ex, ey, *pu))
+    if srid == 3857:
+        return _to_mercator, _from_mercator
+    pp = _POLAR.get(srid)
+    if pp is not None:
+        return (lambda lx, ly: _to_polar(lx, ly, *pp),
+                lambda ex, ey: _from_polar(ex, ey, *pp))
+    pq = _LAEA.get(srid)
+    if pq is not None:
+        return (lambda lx, ly: _to_laea(lx, ly, *pq),
+                lambda ex, ey: _from_laea(ex, ey, *pq))
+    return None
+
+
 def _lookup(src: int, dst: int):
-    fn = _TRANSFORMS.get((src, dst))
-    if fn is not None:
-        return fn
-    pu_src = _utm_params(src)
-    pu_dst = _utm_params(dst)
-    if src == dst and pu_src is not None:
-        # same-zone no-op must be EXACT pass-through, not a lossy
-        # UTM->4326->UTM round trip (review finding)
-        return _ident
-    if pu_dst is not None:
-        to_utm = lambda lx, ly: _to_utm(lx, ly, *pu_dst)  # noqa: E731
-        if src == 4326:
-            return to_utm
-        if src == 3857 or pu_src is not None:
-            # route through 4326 (the native frame, exactly invertible)
-            via = (
-                _from_mercator if src == 3857
-                else (lambda ex, ey: _from_utm(ex, ey, *pu_src))
-            )
-            return lambda ex, ey: to_utm(*via(ex, ey))
-    if pu_src is not None:
-        from_utm = lambda ex, ey: _from_utm(ex, ey, *pu_src)  # noqa: E731
-        if dst == 4326:
-            return from_utm
-        if dst == 3857:
-            return lambda ex, ey: _to_mercator(*from_utm(ex, ey))
+    if src == dst:
+        # same-CRS no-op must be EXACT pass-through, not a lossy
+        # round trip through 4326 (review finding)
+        return _ident if (src == 4326 or _proj_pair(src)) else None
+    if src == 4326:
+        p = _proj_pair(dst)
+        return p[0] if p else None
+    if dst == 4326:
+        p = _proj_pair(src)
+        return p[1] if p else None
+    ps, pd = _proj_pair(src), _proj_pair(dst)
+    if ps is not None and pd is not None:
+        return lambda ex, ey: pd[0](*ps[1](ex, ey))
     return None
 
 
@@ -217,7 +377,8 @@ def transform(x, y, from_srid: int, to_srid: int):
     if fn is None:
         raise ValueError(
             f"unsupported CRS transform EPSG:{key[0]} -> EPSG:{key[1]} "
-            "(registered: 4326, 3857, UTM 326xx/327xx)"
+            "(registered: 4326, 3857, UTM 326xx/327xx, polar "
+            "3413/3031/3976, LAEA 3035)"
         )
     return fn(x, y)
 

@@ -204,6 +204,10 @@ def _zsparse_call(
 
     n = x.shape[0]
     s0 = tile_ids.shape[0]
+    # per-program VMEM scales with tpp * capd (data blocks + the
+    # [chunk, capd] match transients): capd=512 at tpp=4 measured 16.35M
+    # scoped and failed to compile — shrink tpp as the dictionary widens
+    tpp = max(1, min(tpp, (64 * TILES_PER_PROGRAM) // max(capd, 64)))
     tpp = min(tpp, s0)
     pad = (-s0) % tpp
     if pad:

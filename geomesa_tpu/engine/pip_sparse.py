@@ -728,10 +728,13 @@ def pip_layer_assign(
     for cap_c in np.unique(caps_of):
         sel = np.nonzero(caps_of == cap_c)[0]
         cap_c = int(cap_c)
-        if cap_c > MAX_ETAB_SLOTS:
+        if cap_c > MAX_ETAB_SLOTS // 2:
             # assignment cannot split a row across calls (the running
             # parity would be lost between them): rows this dense are
-            # evaluated exactly on the host instead
+            # evaluated exactly on the host instead. Half the union
+            # budget: this kernel prefetches TWO scalar arrays
+            # (etab + pinfo), and SMEM overflowed by 1.2K at the 10k-
+            # polygon SQL-join scale when budgeted for one.
             host_rows.extend(tiles[sel].tolist())
             continue
         etab = np.full((len(sel), cap_c), n_etiles, np.int32)
@@ -743,7 +746,8 @@ def pip_layer_assign(
         etab[row_of, col_of] = et_np[src]
         pinf[row_of, col_of] = pinfo_val[src]
         ptids = tiles[sel]
-        per_call = max(1, MAX_ETAB_SLOTS // max(cap_c, 32))
+        # half the union kernel's SMEM budget: etab AND pinfo prefetch
+        per_call = max(1, (MAX_ETAB_SLOTS // 2) // max(cap_c, 32))
         for c0 in range(0, len(sel), per_call):
             c1 = min(c0 + per_call, len(sel))
             ids = ptids[c0:c1]
@@ -1443,13 +1447,18 @@ def pip_layer(
     flagged = np.nonzero(band_np.reshape(-1)[:n] > 0)[0]
 
     refined = 0
+    refine_s = 0.0
     if refine_f64 and len(flagged):
+        import time as _time
+
+        _t0 = _time.perf_counter()
         refined = _refine_band_f64(
             px_np, py_np, ex1, ey1, ex2, ey2, pl_, inside, flagged)
+        refine_s = _time.perf_counter() - _t0
     return inside, {
         "pairs": int(len(pl_.pair_pt)), "refined": refined,
         "n_ptiles": n_ptiles, "n_etiles": n_etiles,
-        "flagged": int(len(flagged)),
+        "flagged": int(len(flagged)), "refine_s": round(refine_s, 3),
     }
 
 

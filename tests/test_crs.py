@@ -198,3 +198,115 @@ def test_sql_st_transform():
     out = st_transform(g, "EPSG:4326", "EPSG:3857")
     ex, ey = transform([10.0], [53.55], 4326, 3857)
     np.testing.assert_allclose(out.rings[0][0], [ex[0], ey[0]], rtol=1e-12)
+
+
+class TestPolarLAEA:
+    """Round-5 families. Oracles are geometric INVARIANTS of the
+    projections (no external library exists in this env to compare
+    against): polar stereographic has scale factor exactly 1 along its
+    standard parallel; LAEA preserves area element exactly; both must
+    round-trip to sub-mm."""
+
+    def _scale_along_parallel(self, srid, lat, lon):
+        """Local east-west scale factor k = |dE/dlam| / (parallel radius)
+        by central difference, on the ellipsoid."""
+        from geomesa_tpu.core.crs import transform
+
+        a, f = 6378137.0, 1 / 298.257223563
+        e2 = f * (2 - f)
+        h = 1e-6
+        x1, y1 = transform(np.array([lon - h]), np.array([lat]), 4326, srid)
+        x2, y2 = transform(np.array([lon + h]), np.array([lat]), 4326, srid)
+        dm = np.hypot(x2 - x1, y2 - y1)[0]
+        phi = np.radians(lat)
+        # radius of the parallel circle on the ellipsoid
+        rp = a * np.cos(phi) / np.sqrt(1 - e2 * np.sin(phi) ** 2)
+        return dm / (rp * np.radians(2 * h))
+
+    def test_polar_unit_scale_at_standard_parallel(self):
+        for srid, lat_ts in ((3413, 70.0), (3031, -71.0), (3976, -70.0)):
+            for lon in (-120.0, -45.0, 0.0, 60.0, 179.0):
+                k = self._scale_along_parallel(srid, lat_ts, lon)
+                assert k == pytest.approx(1.0, abs=1e-7), (srid, lon)
+
+    def test_polar_round_trip_mm(self):
+        from geomesa_tpu.core.crs import transform
+
+        rng = np.random.default_rng(3)
+        for srid, south in ((3413, False), (3031, True), (3976, True)):
+            lat = (rng.uniform(-88, -45, 500) if south
+                   else rng.uniform(45, 88, 500))
+            lon = rng.uniform(-180, 180, 500)
+            ex, ny = transform(lon, lat, 4326, srid)
+            lo, la = transform(ex, ny, srid, 4326)
+            # 1e-8 deg ~ 1 mm
+            dl = (np.abs(lo - lon) + 360) % 360
+            dl = np.minimum(dl, 360 - dl)
+            assert dl.max() < 1e-8 and np.abs(la - lat).max() < 1e-8
+
+    def test_polar_pole_and_meridian_geometry(self):
+        from geomesa_tpu.core.crs import transform
+
+        # the pole maps to the origin (FE=FN=0 for all three)
+        for srid, pole in ((3413, 90.0), (3031, -90.0), (3976, -90.0)):
+            ex, ny = transform(np.array([33.0]), np.array([pole]),
+                               4326, srid)
+            assert abs(ex[0]) < 1e-6 and abs(ny[0]) < 1e-6
+        # 3413: the central meridian (45W) runs down the -y axis
+        ex, ny = transform(np.array([-45.0]), np.array([75.0]), 4326, 3413)
+        assert abs(ex[0]) < 1e-6 and ny[0] < 0
+
+    def test_laea_equal_area_jacobian(self):
+        """The defining property: |det J| equals the ellipsoidal area
+        element M*N*cos(phi) (meridian x parallel curvature radii)
+        everywhere, checked by central differences across Europe."""
+        from geomesa_tpu.core.crs import transform
+
+        a, f = 6378137.0, 1 / 298.257223563
+        e2 = f * (2 - f)
+        h = 1e-6
+        for lon, lat in ((10.0, 52.0), (-10.0, 35.0), (30.0, 70.0),
+                         (25.0, 40.0), (0.0, 60.0)):
+            def T(lo, la):
+                x, y = transform(np.array([lo]), np.array([la]), 4326, 3035)
+                return x[0], y[0]
+
+            x0, _ = T(lon - h, lat); x1, _ = T(lon + h, lat)
+            _, y0 = T(lon, lat - h); _, y1 = T(lon, lat + h)
+            xa, ya = T(lon - h, lat); xb, yb = T(lon + h, lat)
+            xc, yc = T(lon, lat - h); xd, yd = T(lon, lat + h)
+            dxdlam = (xb - xa) / (2 * h); dydlam = (yb - ya) / (2 * h)
+            dxdphi = (xd - xc) / (2 * h); dydphi = (yd - yc) / (2 * h)
+            det = abs(dxdlam * dydphi - dydlam * dxdphi) * (180 / np.pi) ** 2
+            phi = np.radians(lat)
+            w2 = 1 - e2 * np.sin(phi) ** 2
+            mrad = a * (1 - e2) / w2 ** 1.5
+            nrad = a / np.sqrt(w2)
+            assert det == pytest.approx(
+                mrad * nrad * np.cos(phi), rel=1e-6), (lon, lat)
+
+    def test_laea_round_trip_and_origin(self):
+        from geomesa_tpu.core.crs import transform
+
+        rng = np.random.default_rng(5)
+        lon = rng.uniform(-15, 45, 1000)
+        lat = rng.uniform(30, 72, 1000)
+        ex, ny = transform(lon, lat, 4326, 3035)
+        lo, la = transform(ex, ny, 3035, 4326)
+        assert np.abs(lo - lon).max() < 1e-8
+        assert np.abs(la - lat).max() < 1e-8
+        # projection origin lands on the false easting/northing
+        ex, ny = transform(np.array([10.0]), np.array([52.0]), 4326, 3035)
+        assert ex[0] == pytest.approx(4_321_000.0, abs=1e-6)
+        assert ny[0] == pytest.approx(3_210_000.0, abs=1e-6)
+
+    def test_cross_family_routing(self):
+        from geomesa_tpu.core.crs import transform
+
+        # arctic frame -> web mercator -> back, through 4326 internally
+        lon = np.array([20.0]); lat = np.array([72.0])
+        ex, ny = transform(lon, lat, 4326, 3413)
+        mx, my = transform(ex, ny, 3413, 3857)
+        lo, la = transform(mx, my, 3857, 4326)
+        assert lo[0] == pytest.approx(20.0, abs=1e-8)
+        assert la[0] == pytest.approx(72.0, abs=1e-8)
