@@ -1,0 +1,16 @@
+"""geomesa_tpu.analysis — JAX-aware static analysis + runtime guards.
+
+`gmtpu-lint` walks the package AST (never importing it) and reports
+JAX-specific hazards GT01..GT06; `runtime` adds opt-in recompile
+counters and transfer guards around the engine's jit caches. See
+docs/ANALYSIS.md for the rule catalog and waiver syntax.
+"""
+
+from geomesa_tpu.analysis.model import RULES, Finding
+from geomesa_tpu.analysis.linter import (
+    exit_code, lint_paths, render_json, render_text)
+
+__all__ = [
+    "RULES", "Finding", "lint_paths", "render_text", "render_json",
+    "exit_code",
+]

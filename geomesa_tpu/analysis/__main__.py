@@ -1,0 +1,22 @@
+"""`python -m geomesa_tpu.analysis` — standalone gmtpu-lint entry point
+(the same linter the `gmtpu lint` CLI subcommand wires up)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from geomesa_tpu.analysis.linter import add_lint_arguments, run_cli
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="gmtpu-lint",
+        description="JAX-aware static analysis for geomesa-tpu "
+                    "(rules GT01..GT06)")
+    add_lint_arguments(p)
+    return run_cli(p.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

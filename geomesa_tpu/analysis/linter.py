@@ -1,0 +1,267 @@
+"""gmtpu-lint orchestration: scan -> index -> rules -> report.
+
+`lint_paths` is the programmatic entry point (the CLI, the CI gate, and
+the tests all go through it). The scan set is what gets linted; the
+*reference universe* for cross-module questions (GT05 liveness, GT04/GT01
+jit-name resolution) additionally pulls in every other .py file under the
+repo root (pyproject.toml discoverable above the scan path) — a jitted
+kernel linted in isolation whose callers live in `plan/`, `tests/` or
+`bench.py` is an API, not a corpse.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Set
+
+from geomesa_tpu.analysis.model import SEVERITIES, Finding
+from geomesa_tpu.analysis.modinfo import JitDef, ModInfo
+from geomesa_tpu.analysis.rules import ALL_RULES
+from geomesa_tpu.analysis.waivers import (
+    DEFAULT_WAIVER_FILENAME, apply_file_waivers, load_waiver_file)
+
+
+class Project:
+    """The cross-module context handed to every rule."""
+
+    def __init__(self, modules: List[ModInfo], ref_modules: List[ModInfo]):
+        self.modules = modules
+        self.ref_modules = ref_modules
+        self.jit_by_name: Dict[str, JitDef] = {}
+        for m in modules:
+            for jd in m.jit_defs:
+                self.jit_by_name.setdefault(jd.name, jd)
+        names: Set[str] = set(self.jit_by_name)
+        for m in modules:
+            m._gt_project_jit_names = names  # type: ignore[attr-defined]
+        self._refs: Optional[Dict[str, int]] = None
+
+    # -- GT05 reference universe ------------------------------------------
+
+    def reference_count(self, name: str) -> int:
+        if self._refs is None:
+            self._refs = self._count_references()
+        return self._refs.get(name, 0)
+
+    def _count_references(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        wanted = set(self.jit_by_name)
+
+        def bump(n: str) -> None:
+            if n in wanted:
+                counts[n] = counts.get(n, 0) + 1
+
+        for m in self.modules + self.ref_modules:
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Load):
+                    bump(node.id)
+                elif isinstance(node, ast.Attribute) and isinstance(
+                        node.ctx, ast.Load):
+                    bump(node.attr)
+                elif isinstance(node, ast.ImportFrom):
+                    for a in node.names:
+                        bump(a.name)
+                elif (isinstance(node, ast.Assign)
+                      and len(node.targets) == 1
+                      and isinstance(node.targets[0], ast.Name)
+                      and node.targets[0].id == "__all__"
+                      and isinstance(node.value, (ast.List, ast.Tuple))):
+                    for e in node.value.elts:
+                        if isinstance(e, ast.Constant) and isinstance(
+                                e.value, str):
+                            bump(e.value)
+        # a jitted def's own wrapping (`x = jax.jit(_fn)`) loads `_fn`,
+        # not `x`; decorated defs are not Name loads — no self-counts to
+        # subtract for the bound names themselves
+        return counts
+
+
+def _iter_py_files(path: str) -> Iterable[str]:
+    if os.path.isfile(path):
+        if path.endswith(".py"):
+            yield path
+        return
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", ".git"))
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def find_repo_root(start: str) -> Optional[str]:
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        if os.path.exists(os.path.join(cur, "pyproject.toml")):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return None
+        cur = nxt
+
+
+def _load_module(path: str, base: Optional[str]) -> Optional[ModInfo]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        rel = os.path.relpath(path, base) if base else path
+        return ModInfo(path, src, relpath=rel.replace(os.sep, "/"))
+    except (SyntaxError, UnicodeDecodeError, OSError):
+        return None
+
+
+def build_project(paths: List[str],
+                  extra_ref_paths: Optional[List[str]] = None,
+                  repo_root: Optional[str] = None) -> Project:
+    if repo_root is None:
+        repo_root = find_repo_root(paths[0]) if paths else None
+    modules: List[ModInfo] = []
+    seen: Set[str] = set()
+    for p in paths:
+        for f in _iter_py_files(p):
+            af = os.path.abspath(f)
+            if af in seen:
+                continue
+            seen.add(af)
+            m = _load_module(f, repo_root)
+            if m is not None:
+                modules.append(m)
+    ref_paths: List[str] = list(extra_ref_paths or ())
+    if repo_root and extra_ref_paths is None:
+        # the rest of the repo (scan set deduped below via `seen`):
+        # subset scans must still see callers outside the subset
+        ref_paths.append(repo_root)
+    refs: List[ModInfo] = []
+    for p in ref_paths:
+        for f in _iter_py_files(p):
+            af = os.path.abspath(f)
+            if af in seen:
+                continue
+            seen.add(af)
+            m = _load_module(f, repo_root)
+            if m is not None:
+                refs.append(m)
+    return Project(modules, refs)
+
+
+def lint_paths(paths: List[str],
+               rules: Optional[List[str]] = None,
+               waiver_file: Optional[str] = None,
+               extra_ref_paths: Optional[List[str]] = None,
+               include_waived: bool = True) -> List[Finding]:
+    """Run the linter; returns findings sorted by (path, line, rule).
+    Waived findings are included with .waived=True (the gate ignores
+    them; --format json surfaces them for audit)."""
+    project = build_project(paths, extra_ref_paths=extra_ref_paths)
+    if not project.modules:
+        # a CWD-relative default path from the wrong directory must not
+        # read as a clean pass: zero coverage is an error, not a green
+        raise FileNotFoundError(
+            f"gmtpu-lint: no .py files found under {paths!r}")
+    selected = rules or sorted(ALL_RULES)
+    findings: List[Finding] = []
+    for mod in project.modules:
+        for code in selected:
+            for f in ALL_RULES[code](mod, project):
+                if mod.is_waived(f.rule, f.line):
+                    f.waived = True
+                    f.waived_by = f"inline:{mod.relpath}:{f.line}"
+                findings.append(f)
+    entries = []
+    if waiver_file is None:
+        root = find_repo_root(paths[0]) if paths else None
+        cand = os.path.join(root, DEFAULT_WAIVER_FILENAME) if root else None
+        if cand and os.path.exists(cand):
+            waiver_file = cand
+    if waiver_file:
+        entries = load_waiver_file(waiver_file)
+    apply_file_waivers(findings, entries)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if not include_waived:
+        findings = [f for f in findings if not f.waived]
+    return findings
+
+
+def render_text(findings: List[Finding], show_waived: bool = False) -> str:
+    """Pass the FULL findings list (waived included): the summary line
+    discloses the waived count either way; `show_waived` only controls
+    whether the waived findings' own lines print."""
+    active = [f for f in findings if not f.waived]
+    waived = len(findings) - len(active)
+    lines = [f.render() for f in (findings if show_waived else active)]
+    lines.append(
+        f"gmtpu-lint: {len(active)} finding(s)"
+        + (f", {waived} waived" if waived else ""))
+    return "\n".join(lines)
+
+
+def render_json(findings: List[Finding]) -> str:
+    return json.dumps({
+        "findings": [f.to_dict() for f in findings],
+        "active": sum(1 for f in findings if not f.waived),
+        "waived": sum(1 for f in findings if f.waived),
+    }, indent=2)
+
+
+def exit_code(findings: List[Finding], fail_on: str) -> int:
+    if fail_on == "never":
+        return 0
+    threshold = SEVERITIES.index(fail_on)
+    for f in findings:
+        if f.waived:
+            continue
+        if SEVERITIES.index(f.severity) >= threshold:
+            return 1
+    return 0
+
+
+def run_cli(args) -> int:
+    """Shared by `gmtpu lint` and `python -m geomesa_tpu.analysis`."""
+    rules = None
+    if getattr(args, "rules", None):
+        rules = sorted({r.strip().upper() for r in args.rules.split(",")})
+        unknown = [r for r in rules if r not in ALL_RULES]
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(unknown)} "
+                             f"(have {', '.join(sorted(ALL_RULES))})")
+    try:
+        findings = lint_paths(
+            list(args.paths) or ["geomesa_tpu"],
+            rules=rules,
+            waiver_file=getattr(args, "waivers", None),
+        )
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2
+    if getattr(args, "format", "text") == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings,
+                          show_waived=getattr(args, "show_waived", False)))
+    return exit_code(findings, getattr(args, "fail_on", "warn"))
+
+
+def add_lint_arguments(p) -> None:
+    p.add_argument("paths", nargs="*", default=["geomesa_tpu"],
+                   help="files or directories to lint "
+                        "(default: geomesa_tpu)")
+    p.add_argument("--fail-on", dest="fail_on", default="warn",
+                   choices=["never"] + list(SEVERITIES),
+                   help="minimum severity that makes the exit code "
+                        "nonzero (default: warn)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule codes to run "
+                        "(default: all)")
+    p.add_argument("--waivers", default=None,
+                   help=f"waiver file (default: {DEFAULT_WAIVER_FILENAME} "
+                        f"at the repo root, if present)")
+    p.add_argument("--format", default="text", choices=["text", "json"],
+                   help="output format")
+    p.add_argument("--show-waived", action="store_true",
+                   help="include waived findings in text output")

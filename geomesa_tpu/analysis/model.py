@@ -1,0 +1,70 @@
+"""Finding model + rule registry for gmtpu-lint.
+
+Every rule reports `Finding`s with a stable code (GT01..GT06), a file:line
+anchor, and a message precise enough to act on. Severity is uniform
+("warn") today; the gate's --fail-on flag decides what fails the build,
+so new advisory rules can land as "info" without breaking CI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+SEVERITIES = ("info", "warn", "error")
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str
+    title: str
+    severity: str = "warn"
+
+
+RULES: Dict[str, Rule] = {
+    r.code: r
+    for r in (
+        Rule("GT01", "retrace storm: loop-varying or unhashable value "
+                     "passed to a static jit argument"),
+        Rule("GT02", "implicit host transfer inside jit scope"),
+        Rule("GT03", "dtype drift: float64 reachable from an f32 kernel "
+                     "path without a '# gt: f64-refine' waiver"),
+        Rule("GT04", "unsynced timing: device dispatch timed without "
+                     "block_until_ready (or another sync) before the "
+                     "closing timestamp"),
+        Rule("GT05", "dead jit entry point: jitted callable with no "
+                     "remaining call sites"),
+        Rule("GT06", "inconsistent mask plumbing: sibling call sites of "
+                     "the same kernel disagree on validity masking"),
+    )
+}
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    severity: str = "warn"
+    waived: bool = False
+    waived_by: str = ""
+    extra: dict = field(default_factory=dict)
+
+    def render(self) -> str:
+        tag = " (waived)" if self.waived else ""
+        return (f"{self.path}:{self.line}:{self.col} "
+                f"{self.rule} [{self.severity}]{tag} {self.message}")
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "severity": self.severity,
+            "message": self.message,
+            "waived": self.waived,
+            **({"waived_by": self.waived_by} if self.waived else {}),
+        }

@@ -1,0 +1,318 @@
+"""Per-module AST index for gmtpu-lint.
+
+One parse per file, shared by every rule: alias resolution (``jax``,
+``jnp``, ``np``, ``time``, ``functools.partial``), the module's jitted
+definitions (decorated functions, ``x = jax.jit(fn, ...)`` assignments,
+``self.attr = jax.jit(...)``), parent links for lexical-scope questions
+(is this call inside a ``for``?), and the ``# gt:`` waiver-comment map.
+
+The index is deliberately name-based rather than import-graph-exact:
+cross-module questions (GT05 liveness, GT04 device calls) match on bare
+identifier names across the scanned universe. That trades a little
+precision for zero import-time side effects — the linter never imports
+the code it analyzes.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+_GT_DIRECTIVE = re.compile(r"#\s*gt:\s*(?P<body>.+)$")
+
+
+@dataclass
+class JitDef:
+    """A jitted callable defined in this module."""
+
+    name: str                    # bound name: function name or attr name
+    kind: str                    # "function" | "alias" | "attr"
+    line: int
+    static_names: Set[str] = field(default_factory=set)
+    static_nums: Set[int] = field(default_factory=set)
+    func: Optional[ast.FunctionDef] = None  # wrapped body, when resolvable
+    params: Tuple[str, ...] = ()
+
+    def static_params(self) -> Set[str]:
+        out = set(self.static_names)
+        for i in self.static_nums:
+            if 0 <= i < len(self.params):
+                out.add(self.params[i])
+        return out
+
+
+class ModInfo:
+    """Parsed module + the indexes every rule consumes."""
+
+    def __init__(self, path: str, source: str, relpath: str = ""):
+        self.path = path
+        self.relpath = relpath or path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._link_parents()
+        # alias sets, each holding local names that mean the thing
+        self.jax_aliases: Set[str] = set()
+        self.jit_aliases: Set[str] = set()       # bare `jit` refs
+        self.partial_aliases: Set[str] = set()   # bare `partial` refs
+        self.functools_aliases: Set[str] = set()
+        self.numpy_aliases: Set[str] = set()     # host numpy
+        self.jnp_aliases: Set[str] = set()       # jax.numpy (device-safe)
+        self.time_aliases: Set[str] = set()
+        self.time_fn_aliases: Set[str] = set()   # bare perf_counter/time refs
+        self._collect_aliases()
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self._collect_functions()
+        self.jit_defs: List[JitDef] = []
+        self._collect_jit_defs()
+        self.waivers: Dict[int, Set[str]] = {}
+        self._collect_waivers()
+
+    # -- structure ---------------------------------------------------------
+
+    def _link_parents(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._gt_parent = node  # type: ignore[attr-defined]
+
+    @staticmethod
+    def parent(node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_gt_parent", None)
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.FunctionDef]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    # -- aliases -----------------------------------------------------------
+
+    def _collect_aliases(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    bound = a.asname or a.name.split(".")[0]
+                    if a.name == "jax":
+                        self.jax_aliases.add(bound)
+                    elif a.name in ("jax.numpy",):
+                        self.jnp_aliases.add(a.asname or "jnp")
+                    elif a.name == "numpy":
+                        self.numpy_aliases.add(bound)
+                    elif a.name == "functools":
+                        self.functools_aliases.add(bound)
+                    elif a.name == "time":
+                        self.time_aliases.add(bound)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    bound = a.asname or a.name
+                    if mod == "jax" and a.name == "jit":
+                        self.jit_aliases.add(bound)
+                    elif mod == "jax" and a.name == "numpy":
+                        self.jnp_aliases.add(bound)
+                    elif mod == "functools" and a.name == "partial":
+                        self.partial_aliases.add(bound)
+                    elif mod == "time" and a.name in ("perf_counter", "time",
+                                                      "monotonic"):
+                        self.time_fn_aliases.add(bound)
+
+    # -- expression classifiers -------------------------------------------
+
+    def is_jit_ref(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.jit_aliases
+        if isinstance(node, ast.Attribute) and node.attr == "jit":
+            return (isinstance(node.value, ast.Name)
+                    and node.value.id in self.jax_aliases)
+        return False
+
+    def is_partial_ref(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.partial_aliases
+        if isinstance(node, ast.Attribute) and node.attr == "partial":
+            return (isinstance(node.value, ast.Name)
+                    and node.value.id in self.functools_aliases)
+        return False
+
+    def is_numpy_ref(self, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Name)
+                and node.id in self.numpy_aliases)
+
+    def is_jnp_ref(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Name) and node.id in self.jnp_aliases
+
+    def is_timer_call(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Name):
+            return f.id in self.time_fn_aliases
+        if isinstance(f, ast.Attribute):
+            return (f.attr in ("perf_counter", "time", "monotonic")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in self.time_aliases)
+        return False
+
+    # -- functions ---------------------------------------------------------
+
+    def _collect_functions(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.FunctionDef):
+                # last definition wins; good enough for lookup of the
+                # local fn handed to jax.jit a few lines below its def
+                self.functions[node.name] = node
+
+    @staticmethod
+    def func_params(fn: ast.FunctionDef) -> Tuple[str, ...]:
+        names = [a.arg for a in fn.args.posonlyargs]
+        names += [a.arg for a in fn.args.args]
+        return tuple(names)
+
+    # -- jit defs ----------------------------------------------------------
+
+    @staticmethod
+    def _const_strs(node: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.add(node.value)
+        elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.add(e.value)
+        return out
+
+    @staticmethod
+    def _const_ints(node: ast.AST) -> Set[int]:
+        out: Set[int] = set()
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            out.add(node.value)
+        elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.add(e.value)
+        return out
+
+    def _statics_from_keywords(self, keywords) -> Tuple[Set[str], Set[int]]:
+        names: Set[str] = set()
+        nums: Set[int] = set()
+        for kw in keywords or ():
+            if kw.arg == "static_argnames":
+                names |= self._const_strs(kw.value)
+            elif kw.arg == "static_argnums":
+                nums |= self._const_ints(kw.value)
+        return names, nums
+
+    def _jit_call_parts(self, call: ast.Call):
+        """If `call` is jax.jit(fn, ...) return (fn_node, statics) else
+        None. Handles `jit(fn)`, `jax.jit(fn, static_*=...)`."""
+        if not self.is_jit_ref(call.func):
+            return None
+        fn_node = call.args[0] if call.args else None
+        names, nums = self._statics_from_keywords(call.keywords)
+        return fn_node, names, nums
+
+    def _collect_jit_defs(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.FunctionDef):
+                self._jit_from_decorators(node)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                self._jit_from_assign(node)
+
+    def _jit_from_decorators(self, fn: ast.FunctionDef) -> None:
+        for dec in fn.decorator_list:
+            names: Set[str] = set()
+            nums: Set[int] = set()
+            hit = False
+            if self.is_jit_ref(dec):
+                hit = True
+            elif isinstance(dec, ast.Call):
+                if self.is_jit_ref(dec.func):  # @jax.jit(donate_argnums=..)
+                    hit = True
+                    names, nums = self._statics_from_keywords(dec.keywords)
+                elif (self.is_partial_ref(dec.func) and dec.args
+                      and self.is_jit_ref(dec.args[0])):
+                    hit = True
+                    names, nums = self._statics_from_keywords(dec.keywords)
+            if hit:
+                self.jit_defs.append(JitDef(
+                    name=fn.name, kind="function", line=fn.lineno,
+                    static_names=names, static_nums=nums, func=fn,
+                    params=self.func_params(fn),
+                ))
+                return
+
+    def _jit_from_assign(self, node: ast.Assign) -> None:
+        if not isinstance(node.value, ast.Call):
+            return
+        parts = self._jit_call_parts(node.value)
+        if parts is None:
+            return
+        fn_node, names, nums = parts
+        target = node.targets[0]
+        if isinstance(target, ast.Name):
+            bound, kind = target.id, "alias"
+        elif isinstance(target, ast.Attribute):
+            bound, kind = target.attr, "attr"
+        else:
+            return
+        func = None
+        if isinstance(fn_node, ast.Name):
+            func = self.functions.get(fn_node.id)
+        jd = JitDef(name=bound, kind=kind, line=node.lineno,
+                    static_names=names, static_nums=nums, func=func,
+                    params=self.func_params(func) if func else ())
+        self.jit_defs.append(jd)
+
+    # -- waiver comments ---------------------------------------------------
+
+    def _collect_waivers(self) -> None:
+        raw: Dict[int, Set[str]] = {}
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _GT_DIRECTIVE.search(tok.string)
+                if not m:
+                    continue
+                items = {t.strip() for t in m.group("body").split(",")}
+                raw.setdefault(tok.start[0], set()).update(
+                    t for t in items if t)
+        except tokenize.TokenError:
+            pass
+        # a directive on a comment-only line also covers the next code
+        # line, cascading past further comment/blank lines and through
+        # decorators (findings on a decorated def anchor at the `def`)
+        self.waivers = {ln: set(ts) for ln, ts in raw.items()}
+        for ln in sorted(raw):
+            stripped = self.lines[ln - 1].lstrip() if ln <= len(self.lines) \
+                else ""
+            if not stripped.startswith("#"):
+                continue  # inline directive: covers its own line only
+            nxt = ln + 1
+            while nxt <= len(self.lines):
+                s = self.lines[nxt - 1].strip()
+                self.waivers.setdefault(nxt, set()).update(raw[ln])
+                if s and not s.startswith("#") and not s.startswith("@"):
+                    break
+                nxt += 1
+
+    def waiver_tokens(self, line: int) -> Set[str]:
+        return self.waivers.get(line, set())
+
+    def is_waived(self, rule: str, line: int) -> bool:
+        toks = self.waiver_tokens(line)
+        if f"waive {rule}" in toks or "waive all" in toks:
+            return True
+        # rule-specific spellings
+        if rule == "GT03" and "f64-refine" in toks:
+            return True
+        return False

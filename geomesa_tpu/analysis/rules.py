@@ -1,0 +1,518 @@
+"""GT01..GT06 rule implementations.
+
+Each rule is a generator ``rule(mod, project) -> Iterator[Finding]``.
+Rules never import the code under analysis; everything is answered from
+the per-module AST index (`ModInfo`) and the cross-module name universe
+(`Project`). Precision notes live next to each rule — the gate runs with
+--fail-on warn, so a rule that cries wolf on the shipped tree is a bug
+here, not in the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from geomesa_tpu.analysis.model import Finding
+from geomesa_tpu.analysis.modinfo import JitDef, ModInfo
+
+_SYNC_ATTRS = {"block_until_ready", "item", "tolist"}
+_SYNC_NP_FNS = {"asarray", "array"}
+_HOST_CAST_BUILTINS = {"float", "int", "bool"}
+_VALID_WORD = "valid"
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _finding(rule: str, mod: ModInfo, node: ast.AST, msg: str) -> Finding:
+    return Finding(rule=rule, path=mod.relpath,
+                   line=getattr(node, "lineno", 0),
+                   col=getattr(node, "col_offset", 0), message=msg)
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _map_call_args(call: ast.Call, jd: JitDef):
+    """Yield (param_name_or_None, value_node) for each argument."""
+    for i, a in enumerate(call.args):
+        name = jd.params[i] if i < len(jd.params) else None
+        yield name, i, a
+    for kw in call.keywords:
+        yield kw.arg, None, kw.value
+
+
+# -- GT01: retrace storms ---------------------------------------------------
+
+
+def gt01(mod: ModInfo, project) -> Iterator[Finding]:
+    """Static jit arguments fed loop-varying or unhashable values.
+
+    (a) a `for` loop variable passed to a static param retraces every
+    iteration; (b) a list/set/dict literal passed to a static param is
+    unhashable and fails (or, for tuples of arrays, retraces per call).
+    `while` grow-loops (pow2 capacity style) are deliberately exempt —
+    they bound their own retrace count.
+    """
+    for call in ast.walk(mod.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        callee = _callee_name(call)
+        jd = project.jit_by_name.get(callee) if callee else None
+        if jd is None:
+            continue
+        statics = jd.static_params()
+        loop_vars = _enclosing_for_targets(mod, call)
+        for pname, pos, value in _map_call_args(call, jd):
+            is_static = (pname in statics) or (pos is not None
+                                               and pos in jd.static_nums)
+            if not is_static:
+                continue
+            if isinstance(value, (ast.List, ast.Set, ast.Dict)):
+                yield _finding(
+                    "GT01", mod, value,
+                    f"unhashable {type(value).__name__.lower()} literal "
+                    f"passed to static argument "
+                    f"{pname or pos!r} of jitted {callee!r}")
+            elif isinstance(value, ast.Name) and value.id in loop_vars:
+                yield _finding(
+                    "GT01", mod, call,
+                    f"loop variable {value.id!r} passed to static argument "
+                    f"{pname or pos!r} of jitted {callee!r}: retraces every "
+                    f"iteration")
+
+
+def _enclosing_for_targets(mod: ModInfo, node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for anc in mod.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+        if isinstance(anc, ast.For):
+            out |= {n.id for n in ast.walk(anc.target)
+                    if isinstance(n, ast.Name)}
+    return out
+
+
+# -- GT02: implicit host transfers inside jit scope -------------------------
+
+
+def gt02(mod: ModInfo, project) -> Iterator[Finding]:
+    """Host operations on traced values inside a jitted function body:
+    `np.asarray`/`np.array` on a tracer, `float()`/`int()`/`bool()`
+    or `.item()`/`.tolist()` on a tracer, and Python `for` loops
+    iterating a tracer. Static params are excluded (they are Python
+    values at trace time)."""
+    for jd in mod.jit_defs:
+        if jd.func is None:
+            continue
+        tracers = set(jd.params) - jd.static_params()
+        if not tracers:
+            continue
+        for node in ast.walk(jd.func):
+            if isinstance(node, ast.Call):
+                hit = _gt02_call_hit(mod, node, tracers)
+                if hit:
+                    yield _finding("GT02", mod, node,
+                                   f"{hit} on traced value inside jitted "
+                                   f"{jd.name!r}: forces a device->host "
+                                   f"transfer per call")
+            elif isinstance(node, ast.For):
+                if _names_in(node.iter) & tracers:
+                    yield _finding(
+                        "GT02", mod, node,
+                        f"host `for` loop over traced value in jitted "
+                        f"{jd.name!r}: unrolls/transfers instead of "
+                        f"staying on device")
+
+
+def _gt02_call_hit(mod: ModInfo, call: ast.Call,
+                   tracers: Set[str]) -> Optional[str]:
+    f = call.func
+    args_names = set()
+    for a in call.args:
+        args_names |= _names_in(a)
+    if not (args_names & tracers):
+        # .item() takes no args; check the receiver instead
+        if (isinstance(f, ast.Attribute) and f.attr in ("item", "tolist")
+                and _names_in(f.value) & tracers):
+            return f".{f.attr}()"
+        return None
+    if isinstance(f, ast.Attribute) and mod.is_numpy_ref(f.value):
+        if f.attr in _SYNC_NP_FNS | {"frombuffer", "copy"}:
+            return f"np.{f.attr}()"
+    if isinstance(f, ast.Name) and f.id in _HOST_CAST_BUILTINS:
+        return f"{f.id}()"
+    return None
+
+
+# -- GT03: dtype drift ------------------------------------------------------
+
+
+def gt03(mod: ModInfo, project) -> Iterator[Finding]:
+    """float64 indicators inside jitted bodies or module-local helpers
+    transitively called from them — the f32 kernel paths. An explicit
+    `# gt: f64-refine` comment on the line (or the line above) waives
+    the deliberate refine arithmetic."""
+    kernel_fns = _f32_kernel_functions(mod)
+    seen: Set[int] = set()
+    for fn in kernel_fns:
+        for node in ast.walk(fn):
+            hit = _f64_indicator(mod, node)
+            if hit is None or node.lineno in seen:
+                continue
+            seen.add(node.lineno)
+            yield _finding(
+                "GT03", mod, node,
+                f"{hit} reachable from f32 kernel path {fn.name!r} "
+                f"(waive deliberate refinement with '# gt: f64-refine')")
+
+
+def _f32_kernel_functions(mod: ModInfo) -> List[ast.FunctionDef]:
+    roots = [jd.func for jd in mod.jit_defs if jd.func is not None]
+    out: List[ast.FunctionDef] = []
+    seen: Set[str] = set()
+    queue = list(roots)
+    while queue:
+        fn = queue.pop()
+        if fn.name in seen:
+            continue
+        seen.add(fn.name)
+        out.append(fn)
+        for call in ast.walk(fn):
+            if isinstance(call, ast.Call):
+                name = _callee_name(call)
+                target = mod.functions.get(name) if name else None
+                if target is not None and target.name not in seen:
+                    queue.append(target)
+    return out
+
+
+def _f64_indicator(mod: ModInfo, node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and node.attr == "float64":
+        if isinstance(node.value, ast.Name) and (
+                mod.is_numpy_ref(node.value) or mod.is_jnp_ref(node.value)):
+            return f"{node.value.id}.float64"
+    if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and node.value == "float64"):
+        return "'float64' literal"
+    return None
+
+
+# -- GT04: unsynced timing --------------------------------------------------
+
+
+def gt04(mod: ModInfo, project) -> Iterator[Finding]:
+    """A timestamp pair bracketing a device dispatch with no sync
+    (`block_until_ready`, `jax.device_get`, `np.asarray`/`np.array`,
+    `.item()`, `float()`/`int()`) between the dispatch and the closing
+    timestamp measures dispatch, not compute. Events are collected in
+    source order per function; nested defs are separate scopes."""
+    order = {"device": 0, "sync": 1, "timer": 2}
+    for fn in _all_functions(mod):
+        # same-line ordering: a sync wrapping a device call on one line
+        # (`np.asarray(kern(x))`) synchronizes it, so device events sort
+        # before syncs; timers close the line
+        events = sorted(_gt04_events(mod, fn),
+                        key=lambda e: (e[1], order[e[0]]))
+        saw_timer = False
+        pending: Optional[Tuple[int, str]] = None
+        for kind, line, detail in events:
+            if kind == "timer":
+                if saw_timer and pending is not None:
+                    yield Finding(
+                        rule="GT04", path=mod.relpath, line=line, col=0,
+                        message=(f"timestamp at line {line} closes a timing "
+                                 f"window over device call {pending[1]!r} "
+                                 f"(line {pending[0]}) with no "
+                                 f"block_until_ready/sync in between"))
+                saw_timer = True
+                pending = None
+            elif kind == "device" and saw_timer:
+                pending = (line, detail)
+            elif kind == "sync":
+                pending = None
+
+
+def _all_functions(mod: ModInfo):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _gt04_events(mod: ModInfo, fn: ast.FunctionDef):
+    own_nested = {n for n in ast.walk(fn)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda))
+                  and n is not fn}
+    skip: Set[int] = set()
+    for n in own_nested:
+        for sub in ast.walk(n):
+            skip.add(id(sub))
+    for node in ast.walk(fn):
+        if id(node) in skip or not isinstance(node, ast.Call):
+            continue
+        line = node.lineno
+        if mod.is_timer_call(node):
+            yield ("timer", line, "")
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _SYNC_ATTRS or f.attr == "device_get":
+                yield ("sync", line, f.attr)
+                continue
+            if (f.attr in _SYNC_NP_FNS and isinstance(f.value, ast.Name)
+                    and mod.is_numpy_ref(f.value)):
+                yield ("sync", line, f"np.{f.attr}")
+                continue
+        if isinstance(f, ast.Name) and f.id in _HOST_CAST_BUILTINS:
+            yield ("sync", line, f.id)
+            continue
+        callee = _callee_name(node)
+        if callee is None:
+            continue
+        if callee.lstrip("_").startswith("sync"):
+            # sync()/_sync() wrapper idiom (bench.py, scripts/_util.py)
+            yield ("sync", line, callee)
+            continue
+        resolved = _resolve_local_def(mod, node, callee)
+        if resolved is not None:
+            def_node, is_jit = resolved
+            if is_jit:
+                yield ("device", line, callee)
+            elif isinstance(def_node, ast.FunctionDef) and _body_syncs(
+                    mod, def_node):
+                yield ("sync", line, callee)
+            # plain local call without syncs: neither device nor sync —
+            # that function is linted on its own
+            continue
+        if callee in project_jit_names(mod):
+            yield ("device", line, callee)
+
+
+def _local_defs(mod: ModInfo):
+    """name -> [(def_node, enclosing_function|None, is_jit)] for every
+    function def and jit-binding assignment in the module, with the
+    scope each lives in. Cached per module."""
+    cache = getattr(mod, "_gt_local_defs", None)
+    if cache is not None:
+        return cache
+    defs = {}
+    jitted_fn_nodes = {id(jd.func) for jd in mod.jit_defs
+                       if jd.kind == "function" and jd.func is not None}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, []).append(
+                (node, mod.enclosing_function(node),
+                 id(node) in jitted_fn_nodes))
+        elif (isinstance(node, ast.Assign) and len(node.targets) == 1
+              and isinstance(node.value, ast.Call)
+              and mod._jit_call_parts(node.value) is not None):
+            t = node.targets[0]
+            name = t.id if isinstance(t, ast.Name) else (
+                t.attr if isinstance(t, ast.Attribute) else None)
+            if name is not None:
+                defs.setdefault(name, []).append(
+                    (node, mod.enclosing_function(node), True))
+    mod._gt_local_defs = defs  # type: ignore[attr-defined]
+    return defs
+
+
+def _resolve_local_def(mod: ModInfo, call: ast.Call, name: str):
+    """Resolve `name` at this call site to the nearest definition in the
+    call's lexical scope chain (innermost wins; within a scope, the
+    last definition at or before the call line). Returns
+    (def_node, is_jit) or None. This is what keeps a nested plain
+    `run()` closure distinct from a module-level `run = jax.jit(...)`
+    three functions away (bench.py's shape)."""
+    cands = _local_defs(mod).get(name)
+    if not cands:
+        return None
+    chain = [a for a in mod.ancestors(call)
+             if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    chain.append(None)  # module scope last
+    for scope in chain:
+        scoped = sorted((c for c in cands if c[1] is scope),
+                        key=lambda c: c[0].lineno)
+        if not scoped:
+            continue
+        pick = None
+        for c in scoped:
+            if c[0].lineno <= call.lineno:
+                pick = c
+        pick = pick or scoped[-1]
+        return pick[0], pick[2]
+    return None
+
+
+def _body_syncs(mod: ModInfo, fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and (
+                f.attr in _SYNC_ATTRS or f.attr == "device_get"):
+            return True
+        if isinstance(f, ast.Attribute) and f.attr in _SYNC_NP_FNS and \
+                isinstance(f.value, ast.Name) and mod.is_numpy_ref(f.value):
+            return True
+        if isinstance(f, ast.Name) and f.id in _HOST_CAST_BUILTINS:
+            return True
+    return False
+
+
+def project_jit_names(mod: ModInfo) -> Set[str]:
+    # populated by the linter before rules run (project-wide jit names);
+    # falling back to the module's own defs keeps ModInfo usable alone
+    names = getattr(mod, "_gt_project_jit_names", None)
+    if names is not None:
+        return names
+    return {jd.name for jd in mod.jit_defs}
+
+
+# -- GT05: dead jit entry points --------------------------------------------
+
+
+def gt05(mod: ModInfo, project) -> Iterator[Finding]:
+    """A jitted definition nobody references is a stale entry point:
+    it keeps a compile cache alive and rots silently when signatures
+    drift. References are counted across the scan roots plus the repo's
+    tests/bench/scripts (name loads, attribute loads, import aliases,
+    and __all__ exports)."""
+    for jd in mod.jit_defs:
+        refs = project.reference_count(jd.name)
+        if refs == 0:
+            yield Finding(
+                rule="GT05", path=mod.relpath, line=jd.line, col=0,
+                message=(f"jitted callable {jd.name!r} has no call sites "
+                         f"anywhere in the scanned tree: dead entry point"))
+
+
+# -- GT06: inconsistent mask plumbing ---------------------------------------
+
+
+def gt06(mod: ModInfo, project) -> Iterator[Finding]:
+    """Within one function, sibling call sites of the same callee whose
+    results are mask-combined: if one site ANDs a validity token
+    (`*.valid`, `valid`, `row_valid`, ...) into its result and another
+    does not, an invalid row can be resurrected on the second path —
+    the planner cache-branch band-scatter bug, generalized."""
+    for fn in _all_functions(mod):
+        sites = _gt06_sites(mod, fn)
+        by_callee = {}
+        for site in sites:
+            by_callee.setdefault(site["callee"], []).append(site)
+        for callee, group in by_callee.items():
+            if len(group) < 2:
+                continue
+            with_valid = [s for s in group if s["valid"]]
+            without = [s for s in group if not s["valid"]]
+            if not with_valid or not without:
+                continue
+            for s in without:
+                yield Finding(
+                    rule="GT06", path=mod.relpath,
+                    line=s["line"], col=s["col"],
+                    message=(f"call site of {callee!r} does not AND a "
+                             f"validity mask into its result, but its "
+                             f"sibling at line {with_valid[0]['line']} "
+                             f"does: invalid rows can leak through this "
+                             f"branch"))
+
+
+def _gt06_sites(mod: ModInfo, fn: ast.FunctionDef):
+    """Call sites inside `fn` whose results are bound to names: each gets
+    a signature `valid` = does any `&`-combination of a bound name, in
+    this site's block (the call statement and its following siblings),
+    involve a validity token."""
+    sites = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call):
+            continue
+        callee = _callee_name(node.value)
+        if callee is None:
+            continue
+        bound: Set[str] = set()
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                bound.add(t.id)
+            elif isinstance(t, ast.Tuple):
+                bound |= {e.id for e in t.elts if isinstance(e, ast.Name)}
+        if not bound:
+            continue
+        region = _region_after(mod, fn, node)
+        sites.append({
+            "callee": callee, "line": node.value.lineno,
+            "col": node.value.col_offset,
+            "valid": _valid_anded(region, bound),
+        })
+    return sites
+
+
+def _region_after(mod: ModInfo, fn: ast.FunctionDef,
+                  stmt: ast.stmt) -> List[ast.stmt]:
+    """The statement list containing `stmt`, from `stmt` onward — the
+    site's block scope (masking applied in an unrelated earlier branch
+    must not vouch for this one)."""
+    # find the ancestor statement whose parent holds a stmt list with it
+    target = stmt
+    parent = mod.parent(target)
+    while parent is not None and not isinstance(parent, (
+            ast.FunctionDef, ast.AsyncFunctionDef, ast.If, ast.For,
+            ast.While, ast.With, ast.Try, ast.Module)):
+        target = parent
+        parent = mod.parent(target)
+    if parent is None:
+        return [stmt]
+    for blockname in ("body", "orelse", "finalbody"):
+        block = getattr(parent, blockname, None)
+        if isinstance(block, list) and target in block:
+            i = block.index(target)
+            return block[i:]
+    return [stmt]
+
+
+def _valid_anded(region: List[ast.stmt], bound: Set[str]) -> bool:
+    for stmt in region:
+        for node in ast.walk(stmt):
+            expr = None
+            if isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                          ast.BitAnd):
+                expr = node
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.op, ast.BitAnd):
+                expr = node
+            if expr is None:
+                continue
+            names = _names_in(expr)
+            if not (names & bound):
+                continue
+            if _has_valid_token(expr):
+                return True
+    return False
+
+
+def _has_valid_token(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        ident = None
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        if ident is not None and _VALID_WORD in ident.lower():
+            return True
+    return False
+
+
+ALL_RULES = {
+    "GT01": gt01, "GT02": gt02, "GT03": gt03,
+    "GT04": gt04, "GT05": gt05, "GT06": gt06,
+}

@@ -1,0 +1,203 @@
+"""Opt-in runtime guards: recompile counters + transfer guards.
+
+The static linter catches what the AST shows; these guards catch what
+only shows up live — a retrace storm from an unhashable config object,
+or a silent host round-trip on the query hot path. Both surface through
+`geomesa_tpu.utils.metrics` (gauges `analysis.recompiles.<name>`,
+counter `analysis.recompiles`), so the existing JSON/Prometheus
+exporters pick them up with no extra wiring.
+
+`JitTracker.wrap` wraps a single jitted callable; `guard_engine` sweeps
+the engine modules and wraps every jitted callable in place (reversible
+with `.unwrap()`); `transfer_guard` is a thin, version-tolerant wrapper
+over `jax.transfer_guard`. All of it is pay-when-used: importing this
+module does not import jax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+TRANSFER_MODES = ("allow", "log", "disallow")
+
+
+def is_jitted(obj) -> bool:
+    """A jax.jit product exposes a per-callable compile-cache size; that
+    is also exactly the hook the recompile counter needs."""
+    return callable(obj) and hasattr(obj, "_cache_size")
+
+
+class JitTracker:
+    """Counts compile-cache growth per wrapped jitted callable.
+
+    Every wrapped call compares `fn._cache_size()` before/after; growth
+    means this call traced+compiled instead of hitting the cache. The
+    counts publish to the metrics registry on every recompile and are
+    queryable via `report()`. `warn_after` (per callable) invokes
+    `on_storm` once when a callable exceeds it — the runtime analog of
+    lint rule GT01.
+    """
+
+    def __init__(self, registry=None, warn_after: Optional[int] = None,
+                 on_storm: Optional[Callable[[str, int], None]] = None):
+        if registry is None:
+            from geomesa_tpu.utils.metrics import metrics as registry
+        self.registry = registry
+        self.warn_after = warn_after
+        self.on_storm = on_storm
+        self._lock = threading.Lock()
+        self.recompiles: Dict[str, int] = {}
+        self.calls: Dict[str, int] = {}
+        self._warned: set = set()
+        self._installed: List[tuple] = []  # (module, attr, original)
+
+    def wrap(self, fn, name: Optional[str] = None):
+        if not is_jitted(fn):
+            raise TypeError(
+                f"JitTracker.wrap expects a jax.jit callable, got {fn!r}")
+        label = name or getattr(fn, "__name__", repr(fn))
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            before = fn._cache_size()
+            out = fn(*args, **kwargs)
+            grew = fn._cache_size() - before
+            with self._lock:
+                self.calls[label] = self.calls.get(label, 0) + 1
+                if grew > 0:
+                    n = self.recompiles.get(label, 0) + grew
+                    self.recompiles[label] = n
+                    self.registry.counter("analysis.recompiles", grew)
+                    self.registry.gauge(
+                        f"analysis.recompiles.{label}", float(n))
+                    if (self.warn_after is not None
+                            and n > self.warn_after
+                            and label not in self._warned):
+                        self._warned.add(label)
+                        storm = self.on_storm
+                    else:
+                        storm = None
+                else:
+                    storm = None
+            if storm is not None:
+                storm(label, self.recompiles[label])
+            return out
+
+        wrapper._gt_tracked = fn  # type: ignore[attr-defined]
+        return wrapper
+
+    # -- in-place module instrumentation ----------------------------------
+
+    def install(self, module, names: Optional[List[str]] = None) -> int:
+        """Wrap every jitted top-level callable of `module` in place.
+        Returns how many were wrapped. Idempotent per module attr."""
+        wrapped = 0
+        for attr in names or sorted(vars(module)):
+            obj = getattr(module, attr, None)
+            if not is_jitted(obj) or hasattr(obj, "_gt_tracked"):
+                continue
+            label = f"{module.__name__.rsplit('.', 1)[-1]}.{attr}"
+            setattr(module, attr, self.wrap(obj, name=label))
+            self._installed.append((module, attr, obj))
+            wrapped += 1
+        return wrapped
+
+    def unwrap(self) -> None:
+        for module, attr, original in reversed(self._installed):
+            setattr(module, attr, original)
+        self._installed.clear()
+
+    def report(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                name: {"calls": self.calls.get(name, 0),
+                       "recompiles": self.recompiles.get(name, 0)}
+                for name in sorted(set(self.calls) | set(self.recompiles))
+            }
+
+
+_ENGINE_MODULES = (
+    "geomesa_tpu.engine.bin",
+    "geomesa_tpu.engine.density",
+    "geomesa_tpu.engine.density_zsparse",
+    "geomesa_tpu.engine.grid_index",
+    "geomesa_tpu.engine.knn",
+    "geomesa_tpu.engine.knn_scan",
+    "geomesa_tpu.engine.pip_pallas",
+    "geomesa_tpu.engine.pip_sparse",
+    "geomesa_tpu.engine.raster",
+    "geomesa_tpu.engine.stats",
+    "geomesa_tpu.engine.tube",
+)
+
+
+def guard_engine(registry=None, warn_after: Optional[int] = None,
+                 on_storm: Optional[Callable[[str, int], None]] = None,
+                 modules=None) -> JitTracker:
+    """Wrap every jitted callable across the engine modules with one
+    shared tracker (the engine's jit caches, guarded). Call `.unwrap()`
+    to restore."""
+    import importlib
+
+    tracker = JitTracker(registry=registry, warn_after=warn_after,
+                         on_storm=on_storm)
+    for modname in modules or _ENGINE_MODULES:
+        try:
+            mod = importlib.import_module(modname)
+        except ImportError:
+            continue
+        tracker.install(mod)
+    return tracker
+
+
+@contextlib.contextmanager
+def transfer_guard(mode: str = "disallow"):
+    """`jax.transfer_guard` with a version-tolerant fallback: "log"
+    logs every implicit host<->device transfer, "disallow" raises on
+    them — the runtime teeth behind lint rule GT02."""
+    if mode not in TRANSFER_MODES:
+        raise ValueError(
+            f"transfer mode must be one of {TRANSFER_MODES}, got {mode!r}")
+    import jax
+
+    guard = getattr(jax, "transfer_guard", None)
+    if guard is None:  # very old jax: guard unavailable, run unguarded
+        yield
+        return
+    with guard(mode):
+        yield
+
+
+def run_guarded(path: str, argv: Optional[List[str]] = None,
+                transfer: str = "allow",
+                warn_after: Optional[int] = None,
+                on_storm: Optional[Callable[[str, int], None]] = None,
+                registry=None) -> Tuple[Dict[str, dict], int]:
+    """Execute a Python script under the runtime guards (the `gmtpu
+    guard` command): engine jit caches tracked, optional transfer
+    guard. Returns (tracker report, script exit status) — a script
+    ending in the standard `sys.exit(main())` idiom must not swallow
+    the report, so SystemExit is caught and surfaced as the status."""
+    import runpy
+    import sys
+
+    tracker = guard_engine(registry=registry, warn_after=warn_after,
+                           on_storm=on_storm)
+    old_argv = sys.argv
+    sys.argv = [path] + list(argv or ())
+    status = 0
+    try:
+        with transfer_guard(transfer) if transfer != "allow" \
+                else contextlib.nullcontext():
+            runpy.run_path(path, run_name="__main__")
+    except SystemExit as e:
+        code = e.code
+        status = code if isinstance(code, int) else (
+            0 if code is None else 1)
+    finally:
+        sys.argv = old_argv
+        tracker.unwrap()
+    return tracker.report(), status

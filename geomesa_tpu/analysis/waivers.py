@@ -1,0 +1,85 @@
+"""Waiver-file support for gmtpu-lint.
+
+Two waiver channels exist:
+
+1. Inline comments, parsed per-module by `ModInfo`:
+       x = y.astype(np.float64)  # gt: f64-refine
+       some_call(...)            # gt: waive GT01
+   A directive on a comment-only line also covers the next code line.
+
+2. A committed waiver file (default: `.gmtpu-waivers` at the repo root,
+   or --waivers PATH), one entry per line:
+
+       # comment
+       <path-glob> <RULE|*> [line]
+
+   Paths are matched against the finding's repo-relative posix path with
+   `fnmatch` (so `geomesa_tpu/engine/*.py` works). A bare rule of `*`
+   waives every rule for the glob; an optional line number pins the
+   waiver to one site so it goes stale loudly when the code moves.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+from dataclasses import dataclass
+from typing import List, Optional
+
+from geomesa_tpu.analysis.model import Finding
+
+DEFAULT_WAIVER_FILENAME = ".gmtpu-waivers"
+
+
+@dataclass(frozen=True)
+class WaiverEntry:
+    glob: str
+    rule: str          # "GT03" or "*"
+    line: Optional[int]
+    origin: str        # "file:lineno" for reporting
+
+    def matches(self, f: Finding) -> bool:
+        if self.rule != "*" and self.rule != f.rule:
+            return False
+        if self.line is not None and self.line != f.line:
+            return False
+        path = f.path.replace(os.sep, "/")
+        return (fnmatch.fnmatch(path, self.glob)
+                or fnmatch.fnmatch(os.path.basename(path), self.glob))
+
+
+def load_waiver_file(path: str) -> List[WaiverEntry]:
+    entries: List[WaiverEntry] = []
+    with open(path, encoding="utf-8") as fh:
+        for i, raw in enumerate(fh, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) not in (2, 3):
+                raise ValueError(
+                    f"{path}:{i}: expected '<glob> <RULE|*> [line]', "
+                    f"got {line!r}")
+            ln: Optional[int] = None
+            if len(parts) == 3:
+                try:
+                    ln = int(parts[2])
+                except ValueError:
+                    raise ValueError(
+                        f"{path}:{i}: line must be an integer, "
+                        f"got {parts[2]!r}") from None
+            entries.append(WaiverEntry(glob=parts[0], rule=parts[1],
+                                       line=ln, origin=f"{path}:{i}"))
+    return entries
+
+
+def apply_file_waivers(findings: List[Finding],
+                       entries: List[WaiverEntry]) -> None:
+    for f in findings:
+        if f.waived:
+            continue
+        for e in entries:
+            if e.matches(f):
+                f.waived = True
+                f.waived_by = e.origin
+                break

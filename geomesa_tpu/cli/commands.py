@@ -132,6 +132,55 @@ def register(sub: "argparse._SubParsersAction") -> None:
          (["--n"], {"type": int, "default": None, "help": "points"})],
     )
 
+    # analysis subsystem (docs/ANALYSIS.md): gmtpu-lint + runtime guards
+    from geomesa_tpu.analysis.linter import add_lint_arguments
+
+    lint_p = sub.add_parser(
+        "lint", help="JAX-aware static analysis (rules GT01..GT06)")
+    add_lint_arguments(lint_p)
+    lint_p.set_defaults(func=_lint)
+    guard_p = sub.add_parser(
+        "guard", help="run a script under runtime guards "
+                      "(recompile counters, transfer guard)")
+    guard_p.add_argument("script", help="python script to run")
+    guard_p.add_argument("script_args", nargs=argparse.REMAINDER,
+                         help="arguments passed to the script")
+    guard_p.add_argument("--transfer", default="allow",
+                         choices=["allow", "log", "disallow"],
+                         help="jax.transfer_guard mode while the script "
+                              "runs (default: allow)")
+    guard_p.add_argument("--recompile-warn", type=int, default=None,
+                         help="warn on stderr when one jitted callable "
+                              "recompiles more than N times")
+    guard_p.set_defaults(func=_guard)
+
+
+def _lint(args) -> int:
+    from geomesa_tpu.analysis.linter import run_cli
+
+    return run_cli(args)
+
+
+def _guard(args) -> int:
+    from geomesa_tpu.analysis.runtime import run_guarded
+
+    def storm(name, count):
+        print(f"gmtpu guard: retrace storm: {name} recompiled "
+              f"{count} times", file=sys.stderr)
+
+    report, status = run_guarded(
+        args.script, argv=list(args.script_args),
+        transfer=args.transfer, warn_after=args.recompile_warn,
+        on_storm=storm)
+    tracked = {k: v for k, v in report.items() if v["calls"]}
+    print("gmtpu guard report:", file=sys.stderr)
+    if not tracked:
+        print("  (no tracked engine jit calls)", file=sys.stderr)
+    for name, rec in sorted(tracked.items()):
+        print(f"  {name}: calls={rec['calls']} "
+              f"recompiles={rec['recompiles']}", file=sys.stderr)
+    return status
+
 
 def _version(args) -> int:
     import geomesa_tpu

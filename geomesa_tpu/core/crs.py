@@ -231,6 +231,10 @@ def _from_polar(x, y, lon0: float, lat_ts: float, south: bool):
     lat = np.degrees(phi)
     if south:
         lon, lat = -lon, -lat
+    # lon0 offsets push lon outside [-180,180] (3413's lon0=-45 yields
+    # (-225,135]); downstream consumers (bbox predicates, Z-curve keys,
+    # chained transforms) assume the canonical branch
+    lon = (lon + 180.0) % 360.0 - 180.0
     return lon, lat
 
 

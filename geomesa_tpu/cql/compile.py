@@ -170,18 +170,14 @@ class CompiledFilter:
             k *= 4
 
     def _ensure_band_jits(self):
-        """Fused (count, fixed-size-compaction) jits over the band,
-        shared by band_count_correction and band_corrections."""
-        if hasattr(self, "_cx_nb"):
+        """The fused fixed-size-compaction jit over the band, shared by
+        band_count_correction and band_corrections (both go through
+        _band_rows' grow loop; the separate count jit it once paired
+        with was dead after that rewrite — lint rule GT05's seed)."""
+        if hasattr(self, "_cx_gather"):
             return
         band_fn = self._band_fn
         mask_fn = self._fn
-
-        def _nb(params, dev, extra):
-            b = band_fn(params, dev)
-            if extra is not None:
-                b = b & extra
-            return jnp.sum(b, dtype=jnp.int32)
 
         def _gather(params, dev, extra, k):
             b = band_fn(params, dev)
@@ -219,7 +215,6 @@ class CompiledFilter:
                 mm[jnp.minimum(idx, n - 1)] & live, dtype=jnp.int32)
             return idx, approx
 
-        self._cx_nb = jax.jit(_nb, static_argnames=())
         self._cx_gather = jax.jit(_gather, static_argnames=("k",))
 
     def band_corrections(self, dev: DeviceBatch, batch: FeatureBatch):

@@ -266,7 +266,8 @@ def _fold_counts(counts, dicts, width: int, height: int):
 )
 def _expected_mass(x, y, w, mask, bbox: BBox, width: int, height: int):
     _, ok = _bin_cells(x, y, mask, bbox, width, height)
-    return jnp.sum(jnp.where(ok, w.astype(jnp.float64), 0.0))
+    # deliberate f64 accumulation: the mass check is the recall oracle
+    return jnp.sum(jnp.where(ok, w.astype(jnp.float64), 0.0))  # gt: f64-refine
 
 
 def density_zsparse(

@@ -761,7 +761,8 @@ def pip_layer_assign(
                 pin = np.concatenate(
                     [pin, np.zeros((tc_pad, cap_c), np.int32)])
             jid = _jnp.asarray(ids)
-            aa, nn, bb = _pip_assign_call(
+            # cap_c is pow2-bucketed: one trace per bucket, bounded
+            aa, nn, bb = _pip_assign_call(  # gt: waive GT01
                 _jnp.take(pxt, jid, axis=0), _jnp.take(pyt, jid, axis=0),
                 ax1, ay1, ax2, ay2,
                 _jnp.asarray(tab), _jnp.asarray(pin),
@@ -1425,9 +1426,12 @@ def pip_layer(
     pl_ = prep.pairs
 
     if len(pl_.pair_pt) == 0:
+        # same info keys as the normal return: callers index 'flagged'
+        # and 'refine_s' unconditionally
         return np.zeros(n, bool), {"pairs": 0, "refined": 0,
                                    "n_ptiles": n_ptiles,
-                                   "n_etiles": n_etiles}
+                                   "n_etiles": n_etiles,
+                                   "flagged": 0, "refine_s": 0.0}
 
     if points_device is not None:
         pxp, pyp = points_device  # padded, already device-resident
@@ -1496,9 +1500,11 @@ def pip_layer_sharded(
     ex1, ey1, ex2, ey2 = prep.ex1, prep.ey1, prep.ex2, prep.ey2
     n_etiles = prep.n_etiles
     if len(pl_.pair_pt) == 0:
+        # same info keys as the normal return below
         return np.zeros(n, bool), {
             "pairs": 0, "refined": 0, "n_ptiles": prep.n_ptiles,
-            "n_etiles": n_etiles, "flagged": 0,
+            "n_etiles": n_etiles, "flagged": 0, "cap": 0,
+            "shards": int(np.prod(mesh.devices.shape)),
         }
 
     D = int(np.prod(mesh.devices.shape))

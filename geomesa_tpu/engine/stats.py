@@ -27,8 +27,8 @@ def masked_count(mask: jax.Array) -> jax.Array:
 
 @jax.jit
 def masked_minmax(v: jax.Array, mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    big = jnp.asarray(jnp.inf, jnp.float64)
-    vf = v.astype(jnp.float64)
+    big = jnp.asarray(jnp.inf, jnp.float64)  # gt: f64-refine
+    vf = v.astype(jnp.float64)  # gt: f64-refine
     return (
         jnp.min(jnp.where(mask, vf, big)),
         jnp.max(jnp.where(mask, vf, -big)),
@@ -39,7 +39,7 @@ def masked_minmax(v: jax.Array, mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
 def masked_moments(v: jax.Array, mask: jax.Array):
     """(count, sum, sum-of-squares) in f64 — exact merge across shards by
     adding components (DescriptiveStats parity)."""
-    vf = jnp.where(mask, v.astype(jnp.float64), 0.0)
+    vf = jnp.where(mask, v.astype(jnp.float64), 0.0)  # gt: f64-refine
     return (
         jnp.sum(mask.astype(jnp.int64)),
         jnp.sum(vf),
@@ -201,7 +201,7 @@ def grouped_count(gids: jax.Array, mask: jax.Array, num_groups: int) -> jax.Arra
 def grouped_sum(
     v: jax.Array, gids: jax.Array, mask: jax.Array, num_groups: int
 ) -> jax.Array:
-    vf = jnp.where(mask, v.astype(jnp.float64), 0.0)
+    vf = jnp.where(mask, v.astype(jnp.float64), 0.0)  # gt: f64-refine
     return jax.ops.segment_sum(vf, gids, num_segments=num_groups)
 
 
@@ -209,7 +209,7 @@ def grouped_sum(
 def grouped_min(
     v: jax.Array, gids: jax.Array, mask: jax.Array, num_groups: int
 ) -> jax.Array:
-    vf = jnp.where(mask, v.astype(jnp.float64), jnp.inf)
+    vf = jnp.where(mask, v.astype(jnp.float64), jnp.inf)  # gt: f64-refine
     return jax.ops.segment_min(vf, gids, num_segments=num_groups)
 
 
@@ -217,7 +217,7 @@ def grouped_min(
 def grouped_max(
     v: jax.Array, gids: jax.Array, mask: jax.Array, num_groups: int
 ) -> jax.Array:
-    vf = jnp.where(mask, v.astype(jnp.float64), -jnp.inf)
+    vf = jnp.where(mask, v.astype(jnp.float64), -jnp.inf)  # gt: f64-refine
     return jax.ops.segment_max(vf, gids, num_segments=num_groups)
 
 

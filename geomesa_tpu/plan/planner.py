@@ -561,6 +561,12 @@ class QueryPlanner:
 
                     pid_at = _jax.device_get(
                         sb.pids[jnp.asarray(bidx)])
+                    # row validity must survive the scatter here exactly
+                    # as on the scan branch and in knn_scan: without it
+                    # an invalid superbatch row inside the f32 band is
+                    # resurrected with its f64 filter value
+                    if batch.valid is not None:
+                        bexact = bexact & batch.valid[bidx]
                     mask = mask.at[jnp.asarray(bidx)].set(
                         jnp.asarray(bexact & allowed[pid_at]))
         else:

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""CI gate: `gmtpu lint --fail-on warn` over geomesa_tpu/.
+
+Exits nonzero on any unwaived finding, printing each with file:line and
+rule code. Rides the tier-1 pytest run via tests/test_lint_gate.py and
+is runnable standalone:
+
+    python scripts/lint_gate.py [--format json]
+
+Rule catalog + waiver syntax: docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:  # standalone invocation from anywhere
+    sys.path.insert(0, REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    from geomesa_tpu.analysis.linter import (
+        exit_code, lint_paths, render_json, render_text)
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--format", default="text", choices=["text", "json"])
+    args = p.parse_args(argv)
+    findings = lint_paths([os.path.join(REPO_ROOT, "geomesa_tpu")])
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings))
+    return exit_code(findings, "warn")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

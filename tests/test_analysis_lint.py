@@ -1,0 +1,556 @@
+"""gmtpu-lint rule tests: for every rule GT01..GT06 a fixture module
+with known violations (asserting exact rule codes and line numbers) and
+a clean counterpart, the waiver channels, the two seeded advisor bugs
+replayed against faithful pre-fix excerpts, and the self-lint check that
+the shipped package is violation-free modulo committed waivers."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from geomesa_tpu.analysis import lint_paths
+from geomesa_tpu.analysis.linter import exit_code, render_json
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_src(tmp_path, source, name="mod.py", rules=None, **kw):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    # extra_ref_paths=[]: fixture universes are self-contained
+    return lint_paths([str(tmp_path)], rules=rules,
+                      extra_ref_paths=[], **kw)
+
+
+def active(findings):
+    return [f for f in findings if not f.waived]
+
+
+def codes_lines(findings):
+    return {(f.rule, f.line) for f in active(findings)}
+
+
+# -- GT01 -------------------------------------------------------------------
+
+
+class TestGT01Retrace:
+    def test_loop_var_and_unhashable_static(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def kern(x, k):
+                return x * k
+
+            def run(xs):
+                out = []
+                for i in range(10):
+                    out.append(kern(xs, k=i))
+                bad = kern(xs, k=[1, 2])
+                return out, bad
+        """)
+        assert ("GT01", 11) in codes_lines(fs)   # loop var into static
+        assert ("GT01", 12) in codes_lines(fs)   # unhashable list literal
+        assert all(f.rule == "GT01" for f in active(fs))
+
+    def test_clean_constant_static_and_traced_loop_arg(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def kern(x, k):
+                return x * k
+
+            def run(xs):
+                out = []
+                for i in range(10):
+                    out.append(kern(xs[i], k=4))
+                return out
+        """)
+        assert not [f for f in active(fs) if f.rule == "GT01"]
+
+
+# -- GT02 -------------------------------------------------------------------
+
+
+class TestGT02HostTransfer:
+    def test_host_ops_on_tracers(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def bad(x):
+                y = np.asarray(x)
+                z = float(x)
+                w = x.item()
+                for v in x:
+                    z = z + 1.0
+                return y, z, w
+        """)
+        got = codes_lines(fs)
+        assert ("GT02", 6) in got    # np.asarray on tracer
+        assert ("GT02", 7) in got    # float() on tracer
+        assert ("GT02", 8) in got    # .item() on tracer
+        assert ("GT02", 9) in got    # host for-loop over tracer
+        assert len([f for f in active(fs) if f.rule == "GT02"]) == 4
+
+    def test_clean_jnp_and_static_args(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import functools
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def good(x, n):
+                consts = np.asarray([1.0, 2.0])
+                acc = jnp.asarray(x)
+                for i in range(n):
+                    acc = acc + consts[0]
+                return acc
+        """)
+        assert not [f for f in active(fs) if f.rule == "GT02"]
+
+
+# -- GT03 -------------------------------------------------------------------
+
+
+class TestGT03DtypeDrift:
+    def test_f64_in_kernel_and_transitive_helper(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def kernel(x):
+                y = x.astype(jnp.float64)
+                z = x.astype("float64")
+                return helper(y) + z
+
+            def helper(v):
+                return v + jnp.float64(1.0)
+        """)
+        got = codes_lines(fs)
+        assert ("GT03", 6) in got    # jnp.float64 attr in kernel
+        assert ("GT03", 7) in got    # 'float64' string dtype
+        assert ("GT03", 11) in got   # transitively reachable helper
+
+    def test_waiver_comment_suppresses(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def kernel(x):
+                y = x.astype(jnp.float64)  # gt: f64-refine
+                # gt: f64-refine
+                z = x.astype(jnp.float64)
+                return y + z
+        """)
+        gt03 = [f for f in fs if f.rule == "GT03"]
+        assert gt03 and all(f.waived for f in gt03)
+        assert not [f for f in active(fs) if f.rule == "GT03"]
+
+    def test_f64_outside_kernel_paths_is_clean(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            @jax.jit
+            def kernel(x):
+                return x + 1
+
+            def host_refine(v):
+                return np.asarray(v, np.float64)
+        """)
+        assert not [f for f in active(fs) if f.rule == "GT03"]
+
+
+# -- GT04 -------------------------------------------------------------------
+
+
+class TestGT04UnsyncedTiming:
+    def test_unsynced_device_call_between_timestamps(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import time
+            import jax
+
+            @jax.jit
+            def kern(x):
+                return x + 1
+
+            def timed(x):
+                t0 = time.perf_counter()
+                y = kern(x)
+                dt = time.perf_counter() - t0
+                return y, dt
+        """)
+        assert ("GT04", 11) in codes_lines(fs)
+
+    def test_block_until_ready_syncs(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import time
+            import jax
+
+            @jax.jit
+            def kern(x):
+                return x + 1
+
+            def timed(x):
+                t0 = time.perf_counter()
+                y = kern(x)
+                y.block_until_ready()
+                dt = time.perf_counter() - t0
+                return y, dt
+        """)
+        assert not [f for f in active(fs) if f.rule == "GT04"]
+
+    def test_np_asarray_counts_as_sync(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import time
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def kern(x):
+                return x + 1
+
+            def timed(x):
+                t0 = time.perf_counter()
+                y = np.asarray(kern(x))
+                dt = time.perf_counter() - t0
+                return y, dt
+        """)
+        assert not [f for f in active(fs) if f.rule == "GT04"]
+
+
+# -- GT05 -------------------------------------------------------------------
+
+
+class TestGT05DeadJit:
+    def test_dead_vs_live(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import jax
+
+            @jax.jit
+            def dead_kernel(x):
+                return x + 1
+
+            @jax.jit
+            def live_kernel(x):
+                return x * 2
+
+            def use(x):
+                return live_kernel(x)
+        """)
+        got = codes_lines(fs)
+        assert ("GT05", 4) in got
+        assert not any(r == "GT05" and ln != 4 for r, ln in got)
+
+    def test_cross_module_reference_keeps_alive(self, tmp_path):
+        (tmp_path / "kern.py").write_text(textwrap.dedent("""\
+            import jax
+
+            @jax.jit
+            def exported_kernel(x):
+                return x + 1
+        """))
+        (tmp_path / "caller.py").write_text(textwrap.dedent("""\
+            from kern import exported_kernel
+
+            def go(x):
+                return exported_kernel(x)
+        """))
+        fs = lint_paths([str(tmp_path)], extra_ref_paths=[])
+        assert not [f for f in active(fs) if f.rule == "GT05"]
+
+
+# -- GT06 -------------------------------------------------------------------
+
+
+class TestGT06MaskPlumbing:
+    def test_sibling_sites_disagree(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            def scatter(mask, batch, allowed, compiled, dev, cached):
+                if cached:
+                    bidx, bexact = compiled.band_corrections(dev, batch)
+                    mask = mask.at[bidx].set(bexact & allowed[bidx])
+                else:
+                    bidx, bexact = compiled.band_corrections(dev, batch)
+                    bexact = bexact & batch.valid[bidx]
+                    mask = mask.at[bidx].set(bexact)
+                return mask
+        """)
+        assert ("GT06", 3) in codes_lines(fs)
+        assert len([f for f in active(fs) if f.rule == "GT06"]) == 1
+
+    def test_consistent_siblings_clean(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            def scatter(mask, batch, allowed, compiled, dev, cached):
+                if cached:
+                    bidx, bexact = compiled.band_corrections(dev, batch)
+                    bexact = bexact & batch.valid[bidx]
+                    mask = mask.at[bidx].set(bexact & allowed[bidx])
+                else:
+                    bidx, bexact = compiled.band_corrections(dev, batch)
+                    bexact = bexact & batch.valid[bidx]
+                    mask = mask.at[bidx].set(bexact)
+                return mask
+        """)
+        assert not [f for f in active(fs) if f.rule == "GT06"]
+
+
+# -- seeded advisor bugs, replayed ------------------------------------------
+
+
+class TestSeededBugs:
+    """Faithful pre-fix excerpts of the two advisor findings this PR
+    fixed: the linter must catch both (they are the seed true positives
+    for GT05 and GT06)."""
+
+    def test_gt05_catches_dead_cx_nb(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import jax
+            import jax.numpy as jnp
+
+            class CompiledFilter:
+                def _ensure_band_jits(self):
+                    if hasattr(self, "_cx_nb"):
+                        return
+                    band_fn = self._band_fn
+                    mask_fn = self._fn
+
+                    def _nb(params, dev, extra):
+                        b = band_fn(params, dev)
+                        if extra is not None:
+                            b = b & extra
+                        return jnp.sum(b, dtype=jnp.int32)
+
+                    def _gather(params, dev, extra, k):
+                        b = band_fn(params, dev)
+                        mm = mask_fn(params, dev)
+                        return b, mm
+
+                    self._cx_nb = jax.jit(_nb, static_argnames=())
+                    self._cx_gather = jax.jit(_gather, static_argnames=("k",))
+
+                def _band_rows(self, params, dev, extra):
+                    return jax.device_get(
+                        self._cx_gather(params, dev, extra, k=64))
+        """)
+        gt05 = [f for f in active(fs) if f.rule == "GT05"]
+        assert len(gt05) == 1
+        assert gt05[0].line == 22
+        assert "_cx_nb" in gt05[0].message
+
+    def test_gt06_catches_planner_cache_branch(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import jax.numpy as jnp
+
+            def knn(self, plan, sb, batch, dev, mask, allowed, use_cache):
+                if use_cache:
+                    if plan.compiled is not None and plan.compiled.has_band:
+                        bidx, bexact = plan.compiled.band_corrections(dev, batch)
+                        if len(bidx):
+                            import jax as _jax
+
+                            pid_at = _jax.device_get(sb.pids[jnp.asarray(bidx)])
+                            mask = mask.at[jnp.asarray(bidx)].set(
+                                jnp.asarray(bexact & allowed[pid_at]))
+                else:
+                    if plan.compiled is not None and plan.compiled.has_band:
+                        bidx, bexact = plan.compiled.band_corrections(dev, batch)
+                        if len(bidx):
+                            if batch.valid is not None:
+                                bexact = bexact & batch.valid[bidx]
+                            mask = mask.at[jnp.asarray(bidx)].set(
+                                jnp.asarray(bexact))
+                return mask
+        """)
+        gt06 = [f for f in active(fs) if f.rule == "GT06"]
+        assert len(gt06) == 1
+        assert gt06[0].line == 6
+        assert "band_corrections" in gt06[0].message
+
+
+# -- waiver file ------------------------------------------------------------
+
+
+class TestWaiverFile:
+    def test_file_waiver_by_glob_rule_and_line(self, tmp_path):
+        src = """\
+            import jax
+
+            @jax.jit
+            def dead_kernel(x):
+                return x + 1
+        """
+        (tmp_path / "mod.py").write_text(textwrap.dedent(src))
+        wf = tmp_path / "waivers.txt"
+        wf.write_text("# seed waiver\nmod.py GT05 4\n")
+        fs = lint_paths([str(tmp_path)], extra_ref_paths=[],
+                        waiver_file=str(wf))
+        gt05 = [f for f in fs if f.rule == "GT05"]
+        assert gt05 and all(f.waived for f in gt05)
+        assert not active(fs)
+
+    def test_stale_line_pin_does_not_waive(self, tmp_path):
+        (tmp_path / "mod.py").write_text(textwrap.dedent("""\
+            import jax
+
+            @jax.jit
+            def dead_kernel(x):
+                return x + 1
+        """))
+        wf = tmp_path / "waivers.txt"
+        wf.write_text("mod.py GT05 99\n")
+        fs = lint_paths([str(tmp_path)], extra_ref_paths=[],
+                        waiver_file=str(wf))
+        assert [f for f in active(fs) if f.rule == "GT05"]
+
+    def test_malformed_waiver_file_raises(self, tmp_path):
+        (tmp_path / "mod.py").write_text("x = 1\n")
+        wf = tmp_path / "waivers.txt"
+        wf.write_text("only-one-field\n")
+        with pytest.raises(ValueError):
+            lint_paths([str(tmp_path)], extra_ref_paths=[],
+                       waiver_file=str(wf))
+
+
+# -- output + exit codes ----------------------------------------------------
+
+
+class TestOutputs:
+    def test_exit_code_thresholds(self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import jax
+
+            @jax.jit
+            def dead_kernel(x):
+                return x + 1
+        """)
+        assert exit_code(fs, "warn") == 1
+        assert exit_code(fs, "error") == 0   # warns don't trip error
+        assert exit_code(fs, "never") == 0
+
+    def test_json_render_roundtrips(self, tmp_path):
+        import json
+
+        fs = lint_src(tmp_path, """\
+            import jax
+
+            @jax.jit
+            def dead_kernel(x):
+                return x + 1
+        """)
+        doc = json.loads(render_json(fs))
+        assert doc["active"] == len(active(fs))
+        assert any(f["rule"] == "GT05" for f in doc["findings"])
+
+    def test_cli_fails_on_violation_and_passes_clean(self, tmp_path):
+        (tmp_path / "bad.py").write_text(textwrap.dedent("""\
+            import jax
+
+            @jax.jit
+            def dead_kernel(x):
+                return x + 1
+        """))
+        r = subprocess.run(
+            [sys.executable, "-m", "geomesa_tpu.analysis",
+             str(tmp_path), "--fail-on", "warn"],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+        assert r.returncode == 1
+        assert "GT05" in r.stdout
+        (tmp_path / "bad.py").write_text("x = 1\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "geomesa_tpu.analysis",
+             str(tmp_path), "--fail-on", "warn"],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+        assert r.returncode == 0
+
+    def test_empty_scan_set_is_an_error_not_a_clean_pass(self, tmp_path):
+        # default CWD-relative path from the wrong directory: zero
+        # coverage must not read as a green gate
+        r = subprocess.run(
+            [sys.executable, "-m", "geomesa_tpu.analysis"],
+            capture_output=True, text=True, cwd=str(tmp_path),
+            env={**os.environ, "PYTHONPATH": REPO_ROOT})
+        assert r.returncode == 2
+        assert "no .py files" in r.stderr
+
+
+class TestWaiverCascade:
+    def test_directive_cascades_past_plain_comments_and_blanks(
+            self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import jax
+
+            # gt: waive GT05
+            # explanation of why this entry point must stay
+
+            @jax.jit
+            def dead_kernel(x):
+                return x + 1
+        """)
+        gt05 = [f for f in fs if f.rule == "GT05"]
+        assert gt05 and all(f.waived for f in gt05)
+        assert not active(fs)
+
+    def test_directive_does_not_leak_past_the_next_code_line(
+            self, tmp_path):
+        fs = lint_src(tmp_path, """\
+            import jax
+
+            # gt: waive GT05
+            x = 1
+
+            @jax.jit
+            def dead_kernel(x):
+                return x + 1
+        """)
+        assert [f for f in active(fs) if f.rule == "GT05"]
+
+
+class TestTextOutput:
+    def test_summary_discloses_waived_count(self, tmp_path):
+        from geomesa_tpu.analysis.linter import render_text
+
+        fs = lint_src(tmp_path, """\
+            import jax
+
+            @jax.jit
+            def dead_kernel(x):  # gt: waive GT05
+                return x + 1
+        """)
+        out = render_text(fs)
+        assert "0 finding(s), 1 waived" in out
+        assert "dead_kernel" not in out          # waived line hidden...
+        assert "dead_kernel" in render_text(fs, show_waived=True)
+
+
+# -- self-lint --------------------------------------------------------------
+
+
+class TestSelfLint:
+    def test_shipped_package_is_clean_modulo_waivers(self):
+        fs = lint_paths([os.path.join(REPO_ROOT, "geomesa_tpu")])
+        bad = active(fs)
+        assert not bad, "\n".join(f.render() for f in bad)
+        # the deliberate f64 stats accumulations ride on inline waivers,
+        # so the waiver channel itself is exercised by the shipped tree
+
+    def test_subset_scan_sees_callers_outside_the_subset(self):
+        # GT05 liveness: linting one engine file alone must not flag
+        # kernels whose call sites live elsewhere in the package
+        fs = lint_paths(
+            [os.path.join(REPO_ROOT, "geomesa_tpu", "engine", "stats.py")])
+        gt05 = [f for f in active(fs) if f.rule == "GT05"]
+        assert not gt05, "\n".join(f.render() for f in gt05)
+        assert any(f.waived and f.rule == "GT03" for f in fs)

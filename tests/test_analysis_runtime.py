@@ -1,0 +1,143 @@
+"""Runtime-guard tests: recompile counters around jit caches, engine
+sweep instrumentation, transfer-guard context, the `gmtpu guard` CLI,
+and the metrics surfacing."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from geomesa_tpu.analysis.runtime import (
+    JitTracker, guard_engine, is_jitted, run_guarded, transfer_guard)
+from geomesa_tpu.utils.metrics import MetricsRegistry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestJitTracker:
+    def test_counts_recompiles_per_shape(self):
+        reg = MetricsRegistry()
+        tracker = JitTracker(registry=reg)
+        f = tracker.wrap(jax.jit(lambda x: x + 1), name="f")
+        f(jnp.ones(4))
+        f(jnp.ones(4))        # cache hit: no growth
+        f(jnp.ones(8))        # new shape: recompile
+        rep = tracker.report()
+        assert rep["f"]["calls"] == 3
+        assert rep["f"]["recompiles"] == 2
+        assert reg.counters["analysis.recompiles"] == 2
+        assert reg.gauges["analysis.recompiles.f"] == 2.0
+
+    def test_storm_callback_fires_once(self):
+        seen = []
+        tracker = JitTracker(registry=MetricsRegistry(), warn_after=1,
+                             on_storm=lambda n, c: seen.append((n, c)))
+        f = tracker.wrap(jax.jit(lambda x: x * 2), name="g")
+        for n in (2, 3, 4, 5):
+            f(jnp.ones(n))
+        assert len(seen) == 1
+        assert seen[0][0] == "g" and seen[0][1] >= 2
+
+    def test_wrap_rejects_plain_function(self):
+        tracker = JitTracker(registry=MetricsRegistry())
+        with pytest.raises(TypeError):
+            tracker.wrap(lambda x: x)
+
+    def test_results_unchanged(self):
+        tracker = JitTracker(registry=MetricsRegistry())
+        base = jax.jit(lambda x: x * 3)
+        f = tracker.wrap(base, name="h")
+        x = jnp.arange(5.0)
+        assert jnp.array_equal(f(x), base(x))
+
+
+class TestGuardEngine:
+    def test_install_and_unwrap_stats_module(self):
+        from geomesa_tpu.engine import stats as stats_mod
+
+        orig = stats_mod.masked_count
+        assert is_jitted(orig)
+        tracker = guard_engine(registry=MetricsRegistry(),
+                               modules=["geomesa_tpu.engine.stats"])
+        try:
+            assert stats_mod.masked_count is not orig
+            n = int(stats_mod.masked_count(jnp.ones(8, bool)))
+            assert n == 8
+            rep = tracker.report()
+            assert rep["stats.masked_count"]["calls"] == 1
+        finally:
+            tracker.unwrap()
+        assert stats_mod.masked_count is orig
+
+    def test_missing_module_skipped(self):
+        tracker = guard_engine(registry=MetricsRegistry(),
+                               modules=["geomesa_tpu.engine.nonexistent"])
+        assert tracker.report() == {}
+
+
+class TestTransferGuard:
+    def test_modes_validate(self):
+        with pytest.raises(ValueError):
+            with transfer_guard("bogus"):
+                pass
+
+    def test_log_mode_is_noninvasive(self):
+        with transfer_guard("log"):
+            assert float(jnp.sum(jnp.ones(4))) == 4.0
+
+
+class TestRunGuarded:
+    def test_runs_script_with_tracking(self, tmp_path):
+        script = tmp_path / "job.py"
+        script.write_text(textwrap.dedent("""\
+            import sys
+            import jax.numpy as jnp
+            from geomesa_tpu.engine.stats import masked_count
+
+            n = int(sys.argv[1])
+            print(int(masked_count(jnp.ones(n, bool))))
+        """))
+        reg = MetricsRegistry()
+        report, status = run_guarded(str(script), argv=["641"],
+                                     registry=reg)
+        assert status == 0
+        assert report["stats.masked_count"]["calls"] == 1
+        assert report["stats.masked_count"]["recompiles"] == 1
+
+    def test_cli_guard_reports(self, tmp_path):
+        script = tmp_path / "job.py"
+        script.write_text(textwrap.dedent("""\
+            import jax.numpy as jnp
+            from geomesa_tpu.engine.stats import masked_count
+
+            print(int(masked_count(jnp.ones(4, bool))))
+            print(int(masked_count(jnp.ones(9, bool))))
+        """))
+        r = subprocess.run(
+            [sys.executable, "-m", "geomesa_tpu.cli.main", "guard",
+             "--recompile-warn", "1", str(script)],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stderr
+        assert "stats.masked_count: calls=2 recompiles=2" in r.stderr
+        assert "retrace storm" in r.stderr
+
+    def test_sys_exit_script_still_reports(self, tmp_path):
+        # the standard `sys.exit(main())` idiom must not swallow the
+        # report; the script's exit status propagates
+        script = tmp_path / "job.py"
+        script.write_text(textwrap.dedent("""\
+            import sys
+            import jax.numpy as jnp
+            from geomesa_tpu.engine.stats import masked_count
+
+            print(int(masked_count(jnp.ones(8, bool))))
+            sys.exit(3)
+        """))
+        report, status = run_guarded(str(script))
+        assert status == 3
+        assert report["stats.masked_count"]["calls"] == 1

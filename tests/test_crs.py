@@ -239,10 +239,13 @@ class TestPolarLAEA:
             lon = rng.uniform(-180, 180, 500)
             ex, ny = transform(lon, lat, 4326, srid)
             lo, la = transform(ex, ny, srid, 4326)
-            # 1e-8 deg ~ 1 mm
-            dl = (np.abs(lo - lon) + 360) % 360
-            dl = np.minimum(dl, 360 - dl)
-            assert dl.max() < 1e-8 and np.abs(la - lat).max() < 1e-8
+            # direct comparison, no modulo-360 masking: _from_polar must
+            # return the canonical [-180,180] branch itself (a wrapped
+            # longitude like -190 for a true 170 is a bug, not a
+            # representation choice). 1e-8 deg ~ 1 mm.
+            assert np.all(lo >= -180.0) and np.all(lo <= 180.0)
+            assert np.abs(lo - lon).max() < 1e-8
+            assert np.abs(la - lat).max() < 1e-8
 
     def test_polar_pole_and_meridian_geometry(self):
         from geomesa_tpu.core.crs import transform
