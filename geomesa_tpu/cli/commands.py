@@ -132,6 +132,67 @@ def register(sub: "argparse._SubParsersAction") -> None:
          (["--n"], {"type": int, "default": None, "help": "points"})],
     )
 
+    # serve subsystem (docs/SERVING.md): concurrent query serving with
+    # admission control + request coalescing
+    serve_p = sub.add_parser(
+        "serve", help="concurrent query serving: JSON-lines requests on "
+                      "stdin (or --input), responses on stdout")
+    serve_p.add_argument("--catalog", "-c", default=None,
+                         help="catalog directory (required unless "
+                              "--self-check)")
+    serve_p.add_argument("--input", default="-",
+                         help="JSON-lines request file (- = stdin)")
+    serve_p.add_argument("--self-check", action="store_true",
+                         help="run the end-to-end serving smoke against "
+                              "a throwaway store and exit")
+    serve_p.add_argument("--max-queue", type=int, default=128,
+                         help="admission queue bound (backpressure)")
+    serve_p.add_argument("--max-batch", type=int, default=64,
+                         help="coalescing cap per device dispatch")
+    serve_p.add_argument("--max-wait-ms", type=float, default=2.0,
+                         help="coalescing window (added-latency ceiling)")
+    serve_p.add_argument("--timeout-ms", type=int, default=None,
+                         help="default per-request deadline")
+    serve_p.add_argument("--tenant-rate", type=float, default=None,
+                         help="per-tenant rate limit in qps")
+    serve_p.add_argument("--degrade", action="store_true",
+                         help="enable the overload degradation ladder")
+    serve_p.add_argument("--no-device-cache", action="store_true",
+                         help="serve from the scan path instead of "
+                              "HBM-resident partitions")
+    serve_p.add_argument("--metrics", action="store_true",
+                         help="print Prometheus metrics to stderr on exit")
+    serve_p.set_defaults(func=_serve)
+
+    bserve_p = sub.add_parser(
+        "bench-serve", help="serving load generator: open/closed-loop "
+                            "workloads, p50/p95/p99 + coalescing report")
+    bserve_p.add_argument("--catalog", "-c", default=None,
+                          help="existing catalog (default: synthesize a "
+                               "throwaway store)")
+    bserve_p.add_argument("--feature-name", "-f", default=None,
+                          help="feature type (required with --catalog)")
+    bserve_p.add_argument("--n", type=int, default=20000,
+                          help="synthetic store size (no --catalog)")
+    bserve_p.add_argument("--kind", default="knn",
+                          choices=["knn", "count"], help="workload kind")
+    bserve_p.add_argument("--k", type=int, default=8, help="kNN k")
+    bserve_p.add_argument("--mode", default="closed",
+                          choices=["closed", "open"])
+    bserve_p.add_argument("--clients", type=int, default=16,
+                          help="closed-loop client count")
+    bserve_p.add_argument("--rate", type=float, default=200.0,
+                          help="open-loop offered rate (qps)")
+    bserve_p.add_argument("--duration", type=float, default=5.0,
+                          help="seconds per measured run")
+    bserve_p.add_argument("--max-wait-ms", type=float, default=2.0)
+    bserve_p.add_argument("--max-batch", type=int, default=64)
+    bserve_p.add_argument("--no-compare", action="store_true",
+                          help="skip the serial (coalescing-off) baseline")
+    bserve_p.add_argument("--smoke", action="store_true",
+                          help="small sizes for CI")
+    bserve_p.set_defaults(func=_bench_serve)
+
     # analysis subsystem (docs/ANALYSIS.md): gmtpu-lint + runtime guards
     from geomesa_tpu.analysis.linter import add_lint_arguments
 
@@ -153,6 +214,138 @@ def register(sub: "argparse._SubParsersAction") -> None:
                          help="warn on stderr when one jitted callable "
                               "recompiles more than N times")
     guard_p.set_defaults(func=_guard)
+
+
+def _serve(args) -> int:
+    from geomesa_tpu.serve.service import ServeConfig, self_check
+
+    if args.self_check:
+        return self_check()
+    if not args.catalog:
+        print("error: serve needs --catalog (or --self-check)",
+              file=sys.stderr)
+        return 2
+    from geomesa_tpu.plan import DataStore
+    from geomesa_tpu.serve.protocol import serve_lines
+
+    store = DataStore(args.catalog,
+                      use_device_cache=not args.no_device_cache)
+    config = ServeConfig(
+        max_queue=args.max_queue,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        default_timeout_ms=args.timeout_ms,
+        tenant_rate=args.tenant_rate,
+        degrade=args.degrade,
+    )
+    def write_line(s: str) -> None:
+        # flush per response: with stdout piped (the normal programmatic
+        # client), block buffering would deadlock a request/response
+        # client against the server's blocking read of the next line
+        sys.stdout.write(s)
+        sys.stdout.flush()
+
+    if args.input == "-":
+        n = serve_lines(store, sys.stdin, write_line, config)
+    else:
+        with open(args.input) as f:
+            n = serve_lines(store, f, write_line, config)
+    print(f"served {n} request(s)", file=sys.stderr)
+    if args.metrics:
+        from geomesa_tpu.utils.metrics import metrics
+
+        print(metrics.to_prometheus(), file=sys.stderr)
+    return 0
+
+
+def _bench_serve(args) -> int:
+    import contextlib
+    import tempfile
+
+    import numpy as np
+
+    from geomesa_tpu.plan import DataStore
+    from geomesa_tpu.serve.loadgen import (
+        count_request_factory, knn_request_factory, run_closed_loop,
+        run_open_loop)
+    from geomesa_tpu.serve.service import QueryService, ServeConfig
+
+    if args.smoke:
+        args.n = min(args.n, 2000)
+        args.duration = min(args.duration, 2.0)
+        args.clients = min(args.clients, 8)
+    with contextlib.ExitStack() as stack:
+        if args.catalog:
+            if not args.feature_name:
+                print("error: --catalog needs --feature-name",
+                      file=sys.stderr)
+                return 2
+            store = DataStore(args.catalog, use_device_cache=True)
+            type_name = args.feature_name
+        else:
+            from geomesa_tpu.core.columnar import FeatureBatch
+            from geomesa_tpu.core.sft import SimpleFeatureType
+
+            tmp = stack.enter_context(tempfile.TemporaryDirectory())
+            rng = np.random.default_rng(11)
+            sft = SimpleFeatureType.from_spec(
+                "bench", "name:String,score:Double,dtg:Date,*geom:Point")
+            store = DataStore(tmp, use_device_cache=True)
+            src = store.create_schema(sft)
+            src.write(FeatureBatch.from_pydict(sft, {
+                "name": rng.choice(["a", "b", "c"], args.n).tolist(),
+                "score": rng.uniform(-10, 10, args.n),
+                "dtg": rng.integers(
+                    1_590_000_000_000, 1_600_000_000_000, args.n),
+                "geom": np.stack([rng.uniform(-170, 170, args.n),
+                                  rng.uniform(-80, 80, args.n)], 1),
+            }))
+            type_name = "bench"
+        cql = "BBOX(geom, -180, -90, 180, 90)"
+        if args.kind == "knn":
+            factory = knn_request_factory(type_name, cql, k=args.k)
+        else:
+            factory = count_request_factory(type_name, [
+                cql, "BBOX(geom, -60, -30, 60, 30)",
+                "BBOX(geom, 0, 0, 90, 45)"])
+        # warm the jit caches + device cache outside the measured window
+        warm = QueryService(store, ServeConfig(max_wait_ms=0.0))
+        warm.submit(factory(0)).result(timeout=300)
+        warm.close()
+
+        def run(label: str, config: ServeConfig):
+            svc = QueryService(store, config)
+            try:
+                if args.mode == "closed":
+                    rep = run_closed_loop(
+                        svc, factory, concurrency=args.clients,
+                        duration_s=args.duration)
+                else:
+                    rep = run_open_loop(
+                        svc, factory, rate_qps=args.rate,
+                        duration_s=args.duration)
+            finally:
+                svc.close(drain=True)
+            doc = {"run": label, **rep.to_json()}
+            print(json.dumps(doc))
+            return rep
+
+        coalesced = run("coalesced", ServeConfig(
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms))
+        if not args.no_compare:
+            serial = run("serial", ServeConfig(max_batch=1,
+                                               max_wait_ms=0.0))
+            if serial.throughput_qps > 0:
+                print(json.dumps({
+                    "run": "comparison",
+                    "throughput_speedup": round(
+                        coalesced.throughput_qps / serial.throughput_qps,
+                        3),
+                    "p99_ratio": round(
+                        coalesced.p99_ms / serial.p99_ms, 3)
+                    if serial.p99_ms else None,
+                }))
+    return 0
 
 
 def _lint(args) -> int:

@@ -20,6 +20,8 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from geomesa_tpu.utils.jaxcompat import shard_map as _shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from geomesa_tpu.parallel.mesh import SHARD_AXIS
@@ -179,7 +181,7 @@ def density_sharded(
     [height, width] grid, replicated."""
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=P(),

@@ -47,6 +47,8 @@ from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from geomesa_tpu.utils.jaxcompat import enable_x64 as _enable_x64
 import numpy as np
 
 BBox = Tuple[float, float, float, float]
@@ -65,8 +67,11 @@ def _bin_cells(x, y, mask, bbox: BBox, width: int, height: int):
     col = jnp.floor((x - xmin) / dx).astype(jnp.int32)
     row = jnp.floor((y - ymin) / dy).astype(jnp.int32)
     inb = (col >= 0) & (col < width) & (row >= 0) & (row < height) & mask
-    col = jnp.clip(col, 0, width - 1)
-    row = jnp.clip(row, 0, height - 1)
+    # i32-pinned clip bounds: bare Python ints trace as weak i64 when
+    # the interpret-mode kernel trace is deferred past the
+    # enable_x64(False) window, and the while-loop lowering rejects it
+    col = jnp.clip(col, jnp.int32(0), jnp.int32(width - 1))
+    row = jnp.clip(row, jnp.int32(0), jnp.int32(height - 1))
     return row * width + col, inb
 
 
@@ -166,11 +171,14 @@ def _make_kernel(data_tile: int, chunk: int, capd: int, bbox: BBox,
                     bbox, width, height,
                 )
                 # out-of-bounds zeroing folds into the f32 weights, NOT
-                # a bool reshape: Mosaic rejects minor-dim insertion on i1
-                lw = jnp.where(ok, w_ref[0, sl], 0.0).reshape(chunk, 1)
+                # a bool reshape: Mosaic rejects minor-dim insertion on i1.
+                # f32-pinned zeros: bare 0.0 traces as weak f64 when the
+                # interpret-mode kernel trace runs under global x64 mode
+                zero = jnp.zeros((), jnp.float32)
+                lw = jnp.where(ok, w_ref[0, sl], zero).reshape(chunk, 1)
                 match = cells.reshape(chunk, 1) == drow
                 acc = acc + jnp.sum(
-                    jnp.where(match, lw, 0.0), axis=0,
+                    jnp.where(match, lw, zero), axis=0,
                 ).reshape(1, capd)
             rows.append(acc)
         out_ref[...] = jnp.concatenate(rows, axis=0).reshape(out_ref.shape)
@@ -233,7 +241,7 @@ def _zsparse_call(
     for e in range(tpp):
         data_specs.extend([data_block(e)] * 3)
         data_args.extend([xr, yr, wr])
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         counts = pl.pallas_call(
             _make_kernel(data_tile, chunk, capd, bbox, width, height, tpp),
             grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -405,8 +413,9 @@ def density_zsparse_sharded(
     Returns the REPLICATED [height, width] grid (same contract as
     density_sharded)."""
     import jax.lax as lax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from geomesa_tpu.utils.jaxcompat import shard_map
 
     from geomesa_tpu.engine.density import density_grid
     from geomesa_tpu.parallel.mesh import SHARD_AXIS

@@ -41,6 +41,8 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from geomesa_tpu.utils.jaxcompat import shard_map as _shard_map
+
 from geomesa_tpu.engine.geodesy import EARTH_RADIUS_M, haversine_m
 
 INF = jnp.float32(jnp.inf)
@@ -232,7 +234,7 @@ def knn_indexed_sharded(
     shard_n = dx.shape[0] // d_count
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=(P(), P(), P()),

@@ -32,6 +32,9 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from geomesa_tpu.utils.jaxcompat import pcast as _pcast
+from geomesa_tpu.utils.jaxcompat import shard_map as _shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from geomesa_tpu.engine.geodesy import haversine_m
@@ -502,7 +505,7 @@ def knn_sharded(
     shard_n = dx.shape[0] // d_count
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=(P(), P(), P()) if debug_check else (P(), P()),
@@ -568,7 +571,7 @@ def knn_compact_sharded(
     shard_n = dx.shape[0] // d_count
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=(P(), P(), P()),
@@ -621,13 +624,16 @@ def knn_ring(
     shard_n = dx.shape[0] // d_count
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(
             P(SHARD_AXIS), P(SHARD_AXIS),
             P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
         ),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,  # fori_loop carry turns varying after step 1;
+        # the 0.4.x shard_map path relies on this (pcast shims to a
+        # no-op there — see jaxcompat.pcast)
     )
     def run(qx, qy, dx, dy, mask):
         me = jax.lax.axis_index(SHARD_AXIS)
@@ -662,10 +668,10 @@ def knn_ring(
         q = qx.shape[0]
         dist_dtype = jnp.promote_types(jnp.promote_types(qx.dtype, dx.dtype), jnp.float32)
         # mark the init carry as device-varying (it becomes so after step 1)
-        best_d = jax.lax.pcast(
+        best_d = _pcast(
             jnp.full((q, k), jnp.inf, dist_dtype), SHARD_AXIS, to="varying"
         )
-        best_i = jax.lax.pcast(jnp.zeros((q, k), jnp.int32), SHARD_AXIS, to="varying")
+        best_i = _pcast(jnp.zeros((q, k), jnp.int32), SHARD_AXIS, to="varying")
         best_d, best_i, *_ = jax.lax.fori_loop(
             0, d_count, step, (best_d, best_i, dx, dy, mask)
         )

@@ -62,6 +62,9 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+
+from geomesa_tpu.utils.jaxcompat import enable_x64 as _enable_x64
+from geomesa_tpu.utils.jaxcompat import shard_map as _shard_map
 import numpy as np
 
 from geomesa_tpu.engine.geodesy import haversine_m
@@ -146,7 +149,7 @@ def chord_blockmin(
     out_lanes = data_tile // blk
     # Mosaic rejects 64-bit types; trace with x64 off so index-map and
     # in-kernel literals stay i32/f32 under the repo's global x64 mode
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         minima = pl.pallas_call(
             _make_kernel(data_tile, chunk, blk),
             grid=grid,
@@ -225,7 +228,7 @@ def chord_blockmin_sparse(
     carr = jnp.zeros((1, 128), jnp.float32).at[0, :3].set(c)
     out_lanes = data_tile // blk
 
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,  # tile_ids, n_sel
             grid=(cap,),
@@ -572,7 +575,7 @@ def knn_sparse_sharded(
     shard_n = dx.shape[0] // d_count
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=(P(), P(), P()),

@@ -31,6 +31,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from geomesa_tpu.utils.jaxcompat import enable_x64 as _enable_x64
 import numpy as np
 
 POINT_TILE = 512
@@ -55,7 +57,10 @@ def _pip_kernel(px_ref, py_ref, x1_ref, y1_ref, x2_ref, y2_ref, out_ref):
 
     # half-open rule: exactly one endpoint strictly above py
     cond = (y1 <= py) != (y2 <= py)          # [E, P] native broadcast
-    t = (py - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
+    # dtype-pinned literal: a bare 1.0 traces as weak f64 when the
+    # interpret-mode kernel trace runs under the process-wide x64 mode
+    # (the enable_x64(False) window only covers the outer trace entry)
+    t = (py - y1) / jnp.where(y2 == y1, jnp.ones((), y1.dtype), y2 - y1)
     xc = x1 + t * (x2 - x1)
     partial = jnp.sum((cond & (xc > px)).astype(jnp.int32), axis=0)  # [P]
     out_ref[...] += partial.reshape(out_ref.shape)
@@ -91,7 +96,7 @@ def points_in_polygon_pallas(px, py, x1, y1, x2, y2, interpret: bool = False):
 
     # Mosaic rejects 64-bit types; trace the kernel with x64 off so index-map
     # and in-kernel literals stay i32/f32 even when the host runs x64 mode.
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         counts = pl.pallas_call(
             _pip_kernel,
             grid=(gp, ge),
@@ -129,7 +134,10 @@ def _pip_band_kernel(
                  & (px >= jnp.minimum(x1, x2) - eps)
                  & (px <= jnp.maximum(x1, x2) + eps))
     cond = (y1 <= py) != (y2 <= py)
-    t = (py - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
+    # dtype-pinned literal: a bare 1.0 traces as weak f64 when the
+    # interpret-mode kernel trace runs under the process-wide x64 mode
+    # (the enable_x64(False) window only covers the outer trace entry)
+    t = (py - y1) / jnp.where(y2 == y1, jnp.ones((), y1.dtype), y2 - y1)
     xc = x1 + t * (x2 - x1)
     err = eps * (1.0 + jnp.abs(x2 - x1) / jnp.maximum(jnp.abs(y2 - y1), eps))
     flag = jnp.sum((near_flat | (cond & (jnp.abs(xc - px) <= err))).astype(jnp.int32), axis=0)
@@ -169,7 +177,7 @@ def points_in_polygon_band_pallas(
     point_block = pl.BlockSpec((1, 1, POINT_TILE), lambda i, j: (i, 0, 0))
     edge_block = pl.BlockSpec((1, EDGE_TILE, 1), lambda i, j: (j, 0, 0))
 
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         counts = pl.pallas_call(
             functools.partial(_pip_band_kernel, eps=float(eps)),
             grid=(gp, ge),

@@ -38,6 +38,8 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from geomesa_tpu.utils.jaxcompat import enable_x64 as _enable_x64
 import numpy as np
 
 POINT_TILE = 512
@@ -282,7 +284,10 @@ def _crossing_and_band(px, py, x1, y1, x2, y2, eps: float):
     Points outside both bands provably match the f64 oracle; flagged
     points are re-evaluated in f64 by _refine_band_f64."""
     cond = (y1 <= py) != (y2 <= py)
-    t = (py - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
+    # dtype-pinned literal: a bare 1.0 traces as weak f64 when the
+    # interpret-mode kernel trace is deferred past the enable_x64(False)
+    # window, and the while-loop lowering rejects the f64/f32 mix
+    t = (py - y1) / jnp.where(y2 == y1, jnp.ones((), y1.dtype), y2 - y1)
     xc = x1 + t * (x2 - x1)
     err = eps * (1.0 + jnp.abs(x2 - x1)
                  / jnp.maximum(jnp.abs(y2 - y1), eps))
@@ -426,7 +431,7 @@ def _pip_grouped_call(
         edge_specs.extend([edge_block(e)] * 4)
         edge_args.extend([e1, f1, e2, f2])
 
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         counts, band = pl.pallas_call(
             _make_multi_kernel(e_per, eps),
             grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -638,7 +643,7 @@ def _pip_assign_call(
         edge_specs.extend([edge_block(e)] * 4)
         edge_args.extend([e1, f1, e2, f2])
 
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         assign, count, band, _cur = pl.pallas_call(
             _make_assign_kernel(e_per, eps),
             grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -978,7 +983,7 @@ def _pip_sparse_call(
         (n_ptiles + 1, 1, POINT_TILE), jnp.int32
     )
 
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         counts = pl.pallas_call(
             _sparse_kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -1489,10 +1494,10 @@ def pip_layer_sharded(
     bucketing matters for 10k-polygon skew, not at mesh-dryrun shapes),
     then the SAME host-side parity finish + f64 band refinement as
     pip_layer. Returns (inside bool [N], info dict)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from geomesa_tpu.parallel.mesh import SHARD_AXIS
+    from geomesa_tpu.utils.jaxcompat import shard_map
 
     n = len(px_np)
     prep = prepare_layer(px_np, py_np, x1, y1, x2, y2, poly_of_edge)

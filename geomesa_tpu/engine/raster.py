@@ -47,6 +47,8 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+
+from geomesa_tpu.utils.jaxcompat import shard_map as _shard_map
 import numpy as np
 
 BBox = Tuple[float, float, float, float]
@@ -356,7 +358,7 @@ def polygon_density_sharded(
     from geomesa_tpu.parallel.mesh import SHARD_AXIS
 
     @_ft.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS),) * 6,
         out_specs=P(),

@@ -15,6 +15,8 @@ from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from geomesa_tpu.utils.jaxcompat import shard_map as _shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from geomesa_tpu.parallel.mesh import SHARD_AXIS
@@ -258,7 +260,7 @@ def stats_sharded(mesh: Mesh, fn, *arrays):
     """
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=tuple(P(SHARD_AXIS) for _ in arrays),
         out_specs=P(),

@@ -21,6 +21,8 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+
+from geomesa_tpu.utils.jaxcompat import shard_map as _shard_map
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -318,7 +320,7 @@ def tube_select_pruned_sharded(
     window_b = jnp.broadcast_to(jnp.asarray(half_window_ms, jnp.int64), (T,))
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(
             P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
@@ -347,7 +349,7 @@ def tube_select_sharded(
     mask stays sharded like the data — no collective needed (pure map)."""
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(
             P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),

@@ -10,12 +10,14 @@ replace the executor").
 
 from geomesa_tpu.plan.hints import QueryHints
 from geomesa_tpu.plan.query import Query
-from geomesa_tpu.plan.planner import QueryPlanner, QueryPlan, QueryResult
+from geomesa_tpu.plan.planner import (
+    QueryPlanner, QueryPlan, QueryResult, QueryTimeout)
 from geomesa_tpu.plan.datastore import DataStore, FeatureSource
 from geomesa_tpu.plan.explain import Explainer
-from geomesa_tpu.plan.audit import AuditWriter, QueryEvent
+from geomesa_tpu.plan.audit import AuditWriter, QueryEvent, ServeEvent
 
 __all__ = [
     "Query", "QueryHints", "QueryPlanner", "QueryPlan", "QueryResult",
-    "DataStore", "FeatureSource", "Explainer", "AuditWriter", "QueryEvent",
+    "QueryTimeout", "DataStore", "FeatureSource", "Explainer",
+    "AuditWriter", "QueryEvent", "ServeEvent",
 ]

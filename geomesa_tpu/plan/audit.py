@@ -32,16 +32,47 @@ class QueryEvent:
         return dataclasses.asdict(self)
 
 
-class AuditWriter:
-    """Collects QueryEvents; optionally appends JSONL to a path."""
+@dataclasses.dataclass
+class ServeEvent:
+    """One serving-layer request record (the serve subsystem's analog of
+    QueryEvent): queue wait vs device time, the coalesced batch size it
+    rode in, and how it ended — the numbers a tail-latency investigation
+    starts from. Written by serve.service.QueryService per request."""
 
-    def __init__(self, path: Optional[str] = None):
+    type_name: str
+    kind: str  # execute | count | knn
+    tenant: str
+    priority: str  # interactive | normal | batch
+    queue_ms: float
+    exec_ms: float
+    batch_size: int  # members sharing this device dispatch (1 = alone)
+    status: str  # ok | error | timeout
+    degraded: bool = False
+    user: str = ""
+    timestamp: float = 0.0
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class AuditWriter:
+    """Collects QueryEvents (and serve-layer ServeEvents); optionally
+    appends JSONL to a path. The in-memory list keeps only the most
+    recent `max_events`: the serve layer writes one event per request,
+    so a long-lived server would otherwise grow it without bound — the
+    durable record is the JSONL path, not this buffer."""
+
+    def __init__(self, path: Optional[str] = None,
+                 max_events: int = 100_000):
         self.path = path
+        self.max_events = max_events
         self.events: List[QueryEvent] = []
 
-    def write(self, event: QueryEvent) -> None:
+    def write(self, event: "QueryEvent | ServeEvent") -> None:
         event.timestamp = time.time()
         self.events.append(event)
+        if len(self.events) > self.max_events:
+            del self.events[: len(self.events) - self.max_events]
         if self.path:
             with open(self.path, "a") as f:
                 f.write(json.dumps(event.to_json()) + "\n")

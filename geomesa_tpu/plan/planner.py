@@ -36,6 +36,23 @@ from geomesa_tpu.utils.padding import next_pow2 as _next_pow2
 from geomesa_tpu.store.fs import FileSystemStorage
 
 
+class QueryTimeout(TimeoutError):
+    """Typed deadline expiry carrying the phase that blew the budget and
+    the elapsed wall time. Subclasses TimeoutError so every existing
+    caller that catches the bare type keeps working; the serve scheduler
+    needs the distinction between deadline expiry, shed load
+    (serve.scheduler.QueryRejected), and real errors."""
+
+    def __init__(self, phase: str, elapsed_ms: float, timeout_ms: float):
+        super().__init__(
+            f"query exceeded timeout={timeout_ms:.0f}ms during {phase} "
+            f"(elapsed {elapsed_ms:.0f}ms)"
+        )
+        self.phase = phase
+        self.elapsed_ms = elapsed_ms
+        self.timeout_ms = timeout_ms
+
+
 @dataclasses.dataclass
 class QueryPlan:
     query: Query
@@ -170,18 +187,26 @@ class QueryPlanner:
 
     # -- execution ---------------------------------------------------------
 
-    def execute(self, query: Query, explain: Optional[Explainer] = None) -> QueryResult:
+    def execute(
+        self,
+        query: Query,
+        explain: Optional[Explainer] = None,
+        timeout_ms: Optional[int] = None,
+    ) -> QueryResult:
+        """Plan and run one query. `timeout_ms` overrides the
+        geomesa.query.timeout system property for THIS query — the serve
+        scheduler propagates each request's remaining deadline budget here
+        so the planner's cooperative checks enforce it (0 = no timeout)."""
         from geomesa_tpu.utils.config import SystemProperties
 
-        timeout_ms = int(SystemProperties.QUERY_TIMEOUT_MS.get())
+        if timeout_ms is None:
+            timeout_ms = int(SystemProperties.QUERY_TIMEOUT_MS.get())
         t0 = time.perf_counter()
 
         def check_timeout(phase: str) -> None:
-            if timeout_ms and (time.perf_counter() - t0) * 1000 > timeout_ms:
-                raise TimeoutError(
-                    f"query exceeded geomesa.query.timeout={timeout_ms}ms "
-                    f"during {phase}"
-                )
+            elapsed_ms = (time.perf_counter() - t0) * 1000
+            if timeout_ms and elapsed_ms > timeout_ms:
+                raise QueryTimeout(phase, elapsed_ms, timeout_ms)
 
         from geomesa_tpu.utils.profiling import device_trace
 
@@ -480,6 +505,7 @@ class QueryPlanner:
         qy,
         k: int = 10,
         impl: str = "sparse",
+        timeout_ms: Optional[int] = None,
     ):
         """KNN aggregation push-down over the store scan (SURVEY.md §3.4
         KNN process stack): plan → prune → device predicate mask → fused
@@ -511,7 +537,17 @@ class QueryPlanner:
 
         if isinstance(query, str):
             query = Query(self.storage.sft.name, query)
+        t0 = time.perf_counter()
+
+        def check_timeout(phase: str) -> None:
+            # same cooperative deadline contract as execute(): the serve
+            # scheduler propagates each request's remaining budget here
+            elapsed_ms = (time.perf_counter() - t0) * 1000
+            if timeout_ms and elapsed_ms > timeout_ms:
+                raise QueryTimeout(phase, elapsed_ms, timeout_ms)
+
         plan = self.plan(query)
+        check_timeout("planning")
         query = plan.query
         g = self.storage.sft.default_geometry
         if g is None or g.type != "Point":
@@ -594,6 +630,7 @@ class QueryPlanner:
                         bexact = bexact & batch.valid[bidx]
                     mask = mask.at[jnp.asarray(bidx)].set(
                         jnp.asarray(bexact))
+        check_timeout("scan")
         vm = visibility_mask(self.storage.sft, batch, query.hints)
         if vm is not None:
             mask = mask & jnp.asarray(vm)
@@ -700,10 +737,11 @@ class QueryPlanner:
                 return True
         return False
 
-    def count(self, query: Query) -> int:
+    def count(self, query: Query, timeout_ms: Optional[int] = None) -> int:
         """EXACT_COUNT path; with exact_count=False and INCLUDE, serve the
         manifest count (the stats-estimate analog). geomesa.force.count
-        makes every count exact regardless of hints."""
+        makes every count exact regardless of hints. `timeout_ms`
+        propagates a serve-layer deadline into the nested execute."""
         from geomesa_tpu.utils.config import SystemProperties
 
         from geomesa_tpu.plan.interceptor import run_interceptors
@@ -725,7 +763,7 @@ class QueryPlanner:
         counting = dataclasses.replace(
             query, hints=dataclasses.replace(query.hints, count_only=True)
         )
-        r = self.execute(counting)
+        r = self.execute(counting, timeout_ms=timeout_ms)
         if r.kind == "features":
             n = len(r.features) if r.features is not None else 0
         else:

@@ -10,8 +10,10 @@ selectivity) to the planner's cost model without scanning.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -31,11 +33,26 @@ from geomesa_tpu.store.fs import FileSystemStorage
 STATS_FILE = "stats.json"
 
 
+def _locked(fn):
+    """Serialize StatsManager state transitions: the serve layer makes a
+    write-path update() (ingest thread) concurrent with refresh()/
+    estimate_count() (dispatch thread) the NORMAL case, and both mutate
+    self.stats + the persisted file."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
+
+
 class StatsManager:
     def __init__(self, storage: FileSystemStorage):
         self.storage = storage
         self.stats: Dict[str, Stat] = {}
         self._loaded_mtime: float = -1.0
+        self._lock = threading.RLock()  # reentrant: update -> analyze
         self._load()
 
     @property
@@ -61,6 +78,7 @@ class StatsManager:
                         "dropping persisted stat %r: %s", k, e
                     )
 
+    @_locked
     def refresh(self) -> None:
         """Reload stats.json if it changed on disk since the last load, so a
         long-lived planner sees stats analyzed after it was constructed
@@ -80,8 +98,12 @@ class StatsManager:
             self._load()
 
     def _save(self) -> None:
-        with open(self.path, "w") as f:
+        # atomic replace: a concurrent _load must never json-parse a
+        # half-written file (same discipline as the device-cache manifest)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({k: s.to_json() for k, s in self.stats.items()}, f)
+        os.replace(tmp, self.path)
         self._loaded_mtime = os.path.getmtime(self.path)
 
     def _init_stats(self) -> Dict[str, Stat]:
@@ -152,6 +174,7 @@ class StatsManager:
             z2.observe_grid(0, np.bincount(
                 cy * b16 + cx, minlength=b16 * b16).reshape(b16, b16))
 
+    @_locked
     def invalidate(self) -> None:
         """Drop persisted sketches (mergeable sketches cannot UN-observe,
         so deletes make them stale — the planner falls back to heuristics
@@ -163,6 +186,7 @@ class StatsManager:
             pass
         self._loaded_mtime = -1.0
 
+    @_locked
     def analyze(self) -> dict:
         """Full-store sketch computation (the stats-analyze command)."""
         stats = self._init_stats()
@@ -172,6 +196,7 @@ class StatsManager:
         self._save()
         return self.summary()
 
+    @_locked
     def update(self, batch) -> None:
         """Write-path StatUpdater (SURVEY.md:199-200, upstream
         o.l.g.index.stats StatUpdater): fold ONE written batch into the
@@ -207,6 +232,7 @@ class StatsManager:
         self._observe_batch(self.stats, batch)
         self._save()
 
+    @_locked
     def summary(self) -> dict:
         out = {}
         for k, s in self.stats.items():
@@ -230,6 +256,7 @@ class StatsManager:
         s = self.stats.get("count")
         return int(s.count) if s is not None else None
 
+    @_locked
     def estimate_count(self, bbox: BBox, interval: Interval) -> Optional[int]:
         """Spatio-temporal selectivity from the Z3 histogram sketch (or the
         single-bin Z2 sketch for non-temporal types); None if stats were
@@ -251,10 +278,12 @@ class StatsManager:
             bins = [int(k) for k in z3.counts.keys()]
         return z3.estimate(bbox.xmin, bbox.ymin, bbox.xmax, bbox.ymax, bins)
 
+    @_locked
     def minmax(self, attr: str):
         s = self.stats.get(f"minmax:{attr}")
         return s.result() if s is not None else None
 
+    @_locked
     def topk(self, attr: str):
         s = self.stats.get(f"topk:{attr}")
         return s.result() if s is not None else None

@@ -1,0 +1,28 @@
+"""Concurrent query serving: admission control, request coalescing,
+tail-latency observability (docs/SERVING.md).
+
+No reference-module parity here — upstream GeoMesa delegates concurrency
+to GeoServer/the client; a device-resident store needs its own serving
+discipline because one accelerator runs one program at a time. The
+design borrows from inference serving (Clipper-style adaptive batching
+with latency knobs; Orca-style continuous batching — see PAPERS.md):
+coalesce compatible requests into shared device dispatches, bound the
+queue, shed explicitly.
+"""
+
+from geomesa_tpu.serve.scheduler import (
+    PRIORITIES, AdmissionQueue, QueryRejected, RateLimiter, ServeRequest,
+    TokenBucket)
+from geomesa_tpu.serve.batcher import compat_key, execute_batch
+from geomesa_tpu.serve.service import QueryService, ServeConfig, self_check
+from geomesa_tpu.serve.loadgen import (
+    LoadReport, count_request_factory, knn_request_factory,
+    run_closed_loop, run_open_loop)
+
+__all__ = [
+    "PRIORITIES", "AdmissionQueue", "QueryRejected", "RateLimiter",
+    "ServeRequest", "TokenBucket", "compat_key", "execute_batch",
+    "QueryService", "ServeConfig", "self_check", "LoadReport",
+    "knn_request_factory", "count_request_factory",
+    "run_closed_loop", "run_open_loop",
+]

@@ -1,0 +1,159 @@
+"""Request coalescing: compatible in-flight queries share one device
+execution.
+
+The engine kernels are already batched over query sets — `knn_sparse_scan`
+/ `knn_fullscan_tiled` take [Q] query-point arrays and compute every row
+independently — so N concurrent kNN requests with the same store, filter,
+k and kernel choice stack their query points into ONE kernel launch
+instead of N. That is the continuous-batching lever (Orca/Clipper shape,
+PAPERS.md): under concurrent load, throughput-per-chip is bounded by
+dispatches, not by rows.
+
+Compatibility rules (see docs/SERVING.md):
+- knn:   same (type, canonical CQL, hints, k, impl) — query points are
+         the batched axis; results split back per request. Stacked Q pads
+         to a pow2 (floor 8) so the pallas jit cache sees a handful of
+         shapes, not one per batch size.
+- count / execute: same (type, canonical CQL, hints, projection, sort,
+         limit, crs) — byte-identical queries, executed ONCE with the
+         result shared (dedup). QueryResult is treated as immutable by
+         every consumer, so sharing the object is safe.
+
+Anything else returns key None and never coalesces. Correctness first:
+keys include the full hint string, so auths/visibility, sampling and
+aggregation hints can never alias across tenants.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.cql import ast
+from geomesa_tpu.plan.planner import QueryTimeout
+from geomesa_tpu.serve.scheduler import ServeRequest
+from geomesa_tpu.utils.padding import next_pow2 as _next_pow2
+
+# floor for the padded stacked-query axis: keeps the kernel shape set
+# tiny ({8, 16, 32, ...}) across ragged batch sizes
+MIN_KNN_BATCH = 8
+
+
+def compat_key(req: ServeRequest) -> Optional[tuple]:
+    """Coalescing key, or None when the request must run alone. The
+    filter canonicalizes through the AST so textual variants ("a=1 AND
+    b=2" vs "a = 1 AND b = 2") still coalesce."""
+    q = req.query
+    try:
+        cql = ast.to_cql(q.filter_ast)
+    except Exception:
+        return None
+    hints = str(q.hints)
+    if req.kind == "knn":
+        return ("knn", q.type_name, cql, hints, req.k, req.impl)
+    if req.kind == "count":
+        return ("count", q.type_name, cql, hints, q.max_features)
+    # execute: only byte-identical result specs dedup
+    attrs = tuple(q.attributes) if q.attributes is not None else None
+    sort = tuple(q.sort_by) if q.sort_by else None
+    return ("execute", q.type_name, cql, hints, attrs, sort,
+            q.max_features, q.crs)
+
+
+def batch_timeout_ms(reqs: List[ServeRequest]) -> Optional[int]:
+    """Deadline for a shared dispatch: the LONGEST remaining budget among
+    members (a short-deadline rider must not kill work others still
+    want). None if any member is deadline-free. Floored at 1ms so a
+    nearly-expired straggler doesn't disable the check entirely."""
+    remaining = []
+    for r in reqs:
+        ms = r.remaining_ms
+        if ms is None:
+            return None
+        remaining.append(ms)
+    return max(1, int(max(remaining)))
+
+
+def split_expired(
+    reqs: List[ServeRequest],
+) -> Tuple[List[ServeRequest], List[ServeRequest]]:
+    """Requests whose deadline passed while queued never reach the
+    device; their futures get a typed QueryTimeout(phase="queued")."""
+    live, dead = [], []
+    for r in reqs:
+        (dead if r.expired else live).append(r)
+    return live, dead
+
+
+def fail_expired(reqs: List[ServeRequest]) -> None:
+    now = time.monotonic()
+    for r in reqs:
+        if r.future.set_running_or_notify_cancel():
+            waited_ms = (now - r.enqueued_at) * 1000.0
+            # the original budget = wait so far + (negative) remaining
+            budget_ms = waited_ms + (r.remaining_ms or 0.0)
+            r.future.set_exception(
+                QueryTimeout("queued", waited_ms, budget_ms)
+            )
+
+
+def execute_batch(source, reqs: List[ServeRequest]) -> None:
+    """Run one coalesced group against its FeatureSource and resolve
+    every member future. `reqs` share a compat key (or are a singleton).
+    Exceptions fan out to every member — a failed shared dispatch fails
+    all riders identically, like N serial runs of the same query would.
+    """
+    running = [r for r in reqs if r.future.set_running_or_notify_cancel()]
+    if not running:
+        return
+    timeout_ms = batch_timeout_ms(running)
+    try:
+        if running[0].kind == "knn":
+            _execute_knn(source, running, timeout_ms)
+        else:
+            _execute_shared(source, running, timeout_ms)
+    except BaseException as e:  # noqa: BLE001 — fan the failure out
+        for r in running:
+            r.future.set_exception(e)
+
+
+def _execute_shared(source, reqs: List[ServeRequest],
+                    timeout_ms: Optional[int]) -> None:
+    """count/execute dedup: one planner run, every rider gets the same
+    (immutable) result object."""
+    lead = reqs[0]
+    if lead.kind == "count":
+        out = source.planner.count(lead.query, timeout_ms=timeout_ms)
+    else:
+        out = source.planner.execute(lead.query, timeout_ms=timeout_ms)
+    for r in reqs:
+        r.future.set_result(out)
+
+
+def _execute_knn(source, reqs: List[ServeRequest],
+                 timeout_ms: Optional[int] = None) -> None:
+    """Stack member query points into one [Q] kernel launch and split
+    the [Q, k] result rows back out. Rows are computed independently by
+    the kernels, so per-request results are identical to serial runs of
+    the same kernel — asserted in tests/test_serve.py."""
+    xs = [np.asarray(r.qx, np.float64).ravel() for r in reqs]
+    ys = [np.asarray(r.qy, np.float64).ravel() for r in reqs]
+    offsets = np.cumsum([0] + [len(x) for x in xs])
+    qx = np.concatenate(xs)
+    qy = np.concatenate(ys)
+    total = len(qx)
+    padded = max(MIN_KNN_BATCH, _next_pow2(total))
+    if padded > total:
+        # repeat the first point: cheap, in-bounds, discarded on split
+        qx = np.concatenate([qx, np.full(padded - total, qx[0])])
+        qy = np.concatenate([qy, np.full(padded - total, qy[0])])
+    lead = reqs[0]
+    dists, idx, batch = source.planner.knn(
+        lead.query, qx, qy, k=lead.k, impl=lead.impl,
+        timeout_ms=timeout_ms,
+    )
+    for i, r in enumerate(reqs):
+        a, b = offsets[i], offsets[i + 1]
+        r.future.set_result((dists[a:b], idx[a:b], batch))

@@ -1,0 +1,201 @@
+"""`gmtpu serve` wire protocol: JSON-lines request/response.
+
+One JSON object per input line; one JSON response line per request,
+written IN COMPLETION ORDER (a coalesced batch completes together; a
+shed request answers immediately) — the id field is the correlation
+key, exactly like a pipelined wire protocol:
+
+    {"id": "r1", "op": "count", "typeName": "gdelt",
+     "cql": "BBOX(geom,-10,-10,10,10)"}
+    {"id": "r2", "op": "knn", "typeName": "gdelt", "cql": "INCLUDE",
+     "x": [1.5], "y": [2.5], "k": 8}
+    {"id": "r3", "op": "query", "typeName": "gdelt", "cql": "...",
+     "maxFeatures": 100}
+
+Optional request fields: tenant, priority (interactive|normal|batch),
+timeoutMs, allowDegraded. Responses: {"id", "ok": true, ...} with
+op-specific payload, or {"id", "ok": false, "error":
+rejected|timeout|error, "reason", "message"}.
+
+Errors are per-request, never fatal to the stream: a malformed line
+yields an ok=false response and the loop continues — one bad client
+request must not drop everyone else's connection.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from typing import Iterable, Optional
+
+import numpy as np
+
+from geomesa_tpu.plan.planner import QueryTimeout
+from geomesa_tpu.plan.query import Query
+from geomesa_tpu.serve.scheduler import (
+    PRIORITIES, QueryRejected, ServeRequest)
+from geomesa_tpu.serve.service import QueryService, ServeConfig
+
+MAX_FEATURE_ROWS = 10_000  # response-size guard for op=query
+
+
+def _finite(v: float):
+    return None if (isinstance(v, float) and not math.isfinite(v)) else v
+
+
+def _rows_json(batch, limit: int):
+    """Feature rows as JSON dicts (geometry as WKT), capped at `limit`."""
+    from geomesa_tpu.core.columnar import DictColumn, GeometryColumn
+    from geomesa_tpu.core.wkt import to_wkt
+
+    if batch is None or len(batch) == 0:
+        return []
+    n = min(len(batch), limit)
+    names = batch.sft.attribute_names
+    cols = {}
+    for name in names:
+        col = batch.columns[name]
+        if isinstance(col, GeometryColumn):
+            cols[name] = col
+        elif isinstance(col, DictColumn):
+            cols[name] = col.decode()
+        else:
+            cols[name] = np.asarray(col)
+    rows = []
+    for i in range(n):
+        row = {}
+        for name in names:
+            col = batch.columns[name]
+            m = cols[name]
+            if isinstance(col, GeometryColumn):
+                row[name] = (f"POINT ({m.x[i]} {m.y[i]})" if m.is_point
+                             else to_wkt(m.geometry(i)))
+            elif isinstance(col, DictColumn):
+                row[name] = m[i]
+            else:
+                v = m[i].item()
+                row[name] = _finite(v) if isinstance(v, float) else v
+        rows.append(row)
+    return rows
+
+
+def _payload(kind: str, result, limit: int) -> dict:
+    if kind == "count":
+        return {"count": int(result)}
+    if kind == "knn":
+        dists, idx, _batch = result
+        return {
+            "dists": [[_finite(float(d)) for d in row] for row in dists],
+            "indices": [[int(j) for j in row] for row in idx],
+        }
+    out = {"kind": result.kind, "count": int(result.count)}
+    if result.kind == "features":
+        feats = result.features
+        out["count"] = len(feats) if feats is not None else 0
+        out["features"] = _rows_json(feats, limit)
+    elif result.kind == "density" and result.grid is not None:
+        out["shape"] = list(result.grid.shape)
+        out["total"] = float(result.grid.sum())
+    elif result.kind == "stats":
+        out["stats"] = str(result.stats)
+    return out
+
+
+def parse_request(doc: dict) -> ServeRequest:
+    op = doc.get("op", "query")
+    kind = {"query": "execute", "execute": "execute",
+            "count": "count", "knn": "knn"}.get(op)
+    if kind is None:
+        raise ValueError(f"unknown op {op!r}")
+    type_name = doc["typeName"]
+    query = Query(type_name, doc.get("cql", "INCLUDE"),
+                  max_features=doc.get("maxFeatures"))
+    priority = doc.get("priority", "normal")
+    if isinstance(priority, str):
+        priority = PRIORITIES.index(priority)
+    req = ServeRequest(
+        kind=kind, query=query, tenant=doc.get("tenant", ""),
+        priority=priority,
+        allow_degraded=bool(doc.get("allowDegraded", False)),
+    )
+    timeout_ms = doc.get("timeoutMs")
+    if timeout_ms:
+        import time
+
+        req.deadline = time.monotonic() + float(timeout_ms) / 1000.0
+    if kind == "knn":
+        req.qx = np.asarray(doc["x"], np.float64)
+        req.qy = np.asarray(doc["y"], np.float64)
+        if req.qx.shape != req.qy.shape or req.qx.ndim != 1:
+            raise ValueError("knn x/y must be equal-length 1-d arrays")
+        req.k = int(doc.get("k", 10))
+        req.impl = doc.get("impl", "sparse")
+    return req
+
+
+def _error_response(rid, exc) -> dict:
+    if isinstance(exc, QueryRejected):
+        return {"id": rid, "ok": False, "error": "rejected",
+                "reason": exc.reason, "message": str(exc)}
+    if isinstance(exc, QueryTimeout):
+        return {"id": rid, "ok": False, "error": "timeout",
+                "phase": exc.phase, "message": str(exc)}
+    return {"id": rid, "ok": False, "error": "error", "message": str(exc)}
+
+
+def serve_lines(
+    store,
+    lines: Iterable[str],
+    write,
+    config: Optional[ServeConfig] = None,
+) -> int:
+    """Run the JSON-lines loop: submit every request line to a
+    QueryService over `store`, write one response line per request via
+    `write(str)` as each completes, drain gracefully at end of input.
+    Returns the number of requests processed."""
+    svc = QueryService(store, config)
+    out_lock = threading.Lock()
+    processed = 0
+
+    def respond(doc: dict) -> None:
+        with out_lock:
+            write(json.dumps(doc) + "\n")
+
+    def on_done(rid, req):
+        def cb(fut):
+            exc = fut.exception() if not fut.cancelled() else None
+            if fut.cancelled():
+                respond({"id": rid, "ok": False, "error": "rejected",
+                         "reason": "cancelled", "message": "cancelled"})
+            elif exc is not None:
+                respond(_error_response(rid, exc))
+            else:
+                limit = req.query.max_features or MAX_FEATURE_ROWS
+                doc = {"id": rid, "ok": True}
+                doc.update(_payload(req.kind, fut.result(), limit))
+                if req.degraded:
+                    doc["degraded"] = True
+                respond(doc)
+
+        return cb
+
+    try:
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            processed += 1
+            rid = None
+            try:
+                doc = json.loads(line)
+                rid = doc.get("id", processed)
+                req = parse_request(doc)
+                fut = svc.submit(req)
+                fut.add_done_callback(on_done(rid, req))
+            except Exception as e:  # noqa: BLE001 — per-request isolation
+                respond(_error_response(rid if rid is not None
+                                        else processed, e))
+    finally:
+        svc.close(drain=True)
+    return processed

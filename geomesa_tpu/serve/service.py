@@ -1,0 +1,425 @@
+"""QueryService: the concurrent serving front end.
+
+Wires the admission scheduler (bounded queue, priority classes, tenant
+rate limits, typed shedding) to the request batcher (coalesced device
+dispatches) over a DataStore. One dispatch thread drives the device —
+the accelerator runs one program at a time, so more dispatch threads
+would only interleave launches, not add throughput; concurrency buys
+throughput here through COALESCING, not parallel dispatch.
+
+Lifecycle:
+
+    svc = QueryService(store)                 # starts the dispatcher
+    fut = svc.knn("gdelt", CQL, qx, qy, k=8)  # -> Future
+    dists, idx, batch = fut.result()
+    svc.close(drain=True)                     # graceful: finish queue
+
+Degradation ladder (opt-in per request via allow_degraded, master switch
+ServeConfig.degrade): as queue occupancy crosses the watermarks the
+service first downgrades hints (level 1: loose bbox — skip the exact
+residual re-check of the spatial primary; level 2: + 1-in-4 sampling),
+then sheds batch-class work, and the bounded queue rejects the rest.
+Responses from downgraded queries carry request.degraded = True.
+
+Observability: per-request ServeEvents into the store's audit writer,
+queue-wait and end-to-end latency histograms (p50/p95/p99 via the
+Prometheus export), dispatch/coalesce/shed counters — all through
+`geomesa_tpu.utils.metrics` plus a per-instance `stats()` snapshot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from geomesa_tpu.plan.audit import ServeEvent
+from geomesa_tpu.plan.planner import QueryTimeout
+from geomesa_tpu.plan.query import Query
+from geomesa_tpu.serve.batcher import (
+    compat_key, execute_batch, fail_expired, split_expired)
+from geomesa_tpu.serve.scheduler import (
+    PRIORITIES, AdmissionQueue, QueryRejected, RateLimiter, ServeRequest)
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    max_queue: int = 128        # admission bound (backpressure, not buffer)
+    max_batch: int = 64         # coalescing cap per dispatch
+    max_wait_ms: float = 2.0    # coalescing window: added latency ceiling
+    default_timeout_ms: Optional[int] = None  # per-request deadline default
+    tenant_rate: Optional[float] = None  # qps per tenant; None = unlimited
+    tenant_burst: float = 8.0
+    degrade: bool = False       # master switch for the degradation ladder
+    degrade_watermark: float = 0.75  # queue occupancy -> hint downgrades
+    shed_watermark: float = 0.90     # queue occupancy -> shed batch class
+    drain_timeout_s: float = 30.0
+
+
+class QueryService:
+    """In-process serving API over a DataStore (or any store exposing
+    get_feature_source). Thread-safe: submit from any thread."""
+
+    def __init__(self, store, config: Optional[ServeConfig] = None,
+                 autostart: bool = True):
+        self.store = store
+        self.config = config or ServeConfig()
+        self.queue = AdmissionQueue(self.config.max_queue)
+        self.limiter = RateLimiter(
+            self.config.tenant_rate, self.config.tenant_burst)
+        self.audit = getattr(store, "audit", None)
+        self._closed = False
+        self._stop = threading.Event()
+        self._inflight = 0
+        self._state_lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._worker: Optional[threading.Thread] = None
+        if autostart:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._stop.clear()
+        self._worker = threading.Thread(
+            target=self._loop, name="gmtpu-serve-dispatch", daemon=True)
+        self._worker.start()
+
+    def close(self, drain: bool = True,
+              timeout_s: Optional[float] = None) -> None:
+        """Stop the service. drain=True (graceful): admissions stop with
+        QueryRejected(shutting_down) while every already-admitted request
+        still executes; drain=False: queued requests are rejected."""
+        self._closed = True
+        if not drain:
+            for r in self.queue.drain_all():
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(
+                        QueryRejected("shutting_down", "service closed"))
+        deadline = time.monotonic() + (
+            timeout_s if timeout_s is not None
+            else self.config.drain_timeout_s)
+        while time.monotonic() < deadline:
+            with self._state_lock:
+                idle = self._inflight == 0
+            if idle and len(self.queue) == 0:
+                break
+            time.sleep(0.005)
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
+
+    # -- submission API ----------------------------------------------------
+
+    def submit(self, req: ServeRequest) -> Future:
+        """Admission control, then enqueue. Raises the typed
+        QueryRejected (never queues unboundedly) on shed/limit/closed."""
+        self._bump("submitted")
+        if self._closed:
+            self._bump("rejected")
+            raise QueryRejected("shutting_down", "service closed")
+        try:
+            self.limiter.admit(req.tenant)
+        except QueryRejected:
+            self._bump("rejected")
+            raise
+        if req.deadline is None and self.config.default_timeout_ms:
+            req.deadline = (time.monotonic()
+                            + self.config.default_timeout_ms / 1000.0)
+        level = self.degrade_level()
+        if level >= 2 and req.priority >= PRIORITIES.index("batch"):
+            self._bump("rejected")
+            self._bump("shed")
+            raise QueryRejected(
+                "shed", "sustained overload: batch class shed")
+        if level >= 1 and self.config.degrade and req.allow_degraded:
+            self._degrade(req, level)
+        try:
+            self.queue.put(req)
+        except QueryRejected:
+            self._bump("rejected")
+            raise
+        from geomesa_tpu.utils.metrics import metrics
+
+        metrics.gauge("serve.queue.depth", float(len(self.queue)))
+        return req.future
+
+    def query(self, type_name: str, cql: str = "INCLUDE",
+              hints=None, **kw) -> Future:
+        q = Query(type_name, cql, hints=hints) if hints is not None \
+            else Query(type_name, cql)
+        return self.submit(self._request("execute", q, **kw))
+
+    def count(self, type_name: str, cql: str = "INCLUDE", **kw) -> Future:
+        return self.submit(self._request("count", Query(type_name, cql), **kw))
+
+    def knn(self, type_name: str, cql: str, qx, qy, k: int = 10,
+            impl: str = "sparse", **kw) -> Future:
+        req = self._request("knn", Query(type_name, cql), **kw)
+        req.qx, req.qy, req.k, req.impl = qx, qy, k, impl
+        return self.submit(req)
+
+    def _request(self, kind: str, query: Query, tenant: str = "",
+                 priority: "int | str" = "normal",
+                 timeout_ms: Optional[int] = None,
+                 allow_degraded: bool = False) -> ServeRequest:
+        if isinstance(priority, str):
+            priority = PRIORITIES.index(priority)
+        deadline = (time.monotonic() + timeout_ms / 1000.0
+                    if timeout_ms else None)
+        return ServeRequest(kind=kind, query=query, tenant=tenant,
+                            priority=priority, deadline=deadline,
+                            allow_degraded=allow_degraded)
+
+    # -- degradation ladder ------------------------------------------------
+
+    def degrade_level(self) -> int:
+        """0 = nominal; 1 = hint downgrades; 2 = + shed batch class. A
+        pure function of queue occupancy, so the ladder releases the
+        moment the backlog drains."""
+        if not self.config.degrade:
+            return 0
+        occ = len(self.queue) / self.config.max_queue
+        if occ >= self.config.shed_watermark:
+            return 2
+        if occ >= self.config.degrade_watermark:
+            return 1
+        return 0
+
+    def _degrade(self, req: ServeRequest, level: int) -> None:
+        """Rewrite hints toward cheaper execution. Only plain feature /
+        count requests degrade — aggregations (density/stats/bin/arrow)
+        have result shapes a hint rewrite would corrupt."""
+        h = req.query.hints
+        if h.is_density or h.is_stats or h.is_bin or h.is_arrow:
+            return
+        changes = {"loose_bbox": True}
+        if level >= 2 and h.sampling is None:
+            changes["sampling"] = 4
+        req.query = dataclasses.replace(
+            req.query, hints=dataclasses.replace(h, **changes))
+        req.degraded = True
+        self._bump("degraded")
+        from geomesa_tpu.utils.metrics import metrics
+
+        metrics.counter("serve.degraded")
+
+    # -- dispatch loop -----------------------------------------------------
+
+    def _mark_inflight(self, _req: ServeRequest) -> None:
+        # runs under the queue lock (pop's on_pop hook): removal and the
+        # in-flight mark are one atomic step, so close(drain=True) can
+        # never observe "queue empty, nothing in flight" while a popped
+        # request is still on its way into _dispatch
+        with self._state_lock:
+            self._inflight += 1
+
+    def _loop(self) -> None:
+        import logging
+
+        while True:
+            req = self.queue.pop(timeout=0.05, on_pop=self._mark_inflight)
+            if req is None:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                self._dispatch(req)
+            except Exception:  # noqa: BLE001 — the dispatcher must live
+                # _dispatch resolves member futures before anything that
+                # can throw here (audit/metrics); log and keep serving
+                logging.getLogger(__name__).exception(
+                    "serve dispatch loop error")
+            finally:
+                with self._state_lock:
+                    self._inflight -= 1
+
+    def _gather(self, first: ServeRequest) -> List[ServeRequest]:
+        """Coalescing window: collect queued requests compatible with
+        `first` for up to max_wait_ms (bounded added latency), then go."""
+        reqs = [first]
+        key = compat_key(first)
+        cap = self.config.max_batch
+        if key is None or cap <= 1:
+            return reqs
+        deadline = time.monotonic() + self.config.max_wait_ms / 1000.0
+        while len(reqs) < cap:
+            got = self.queue.drain_compatible(
+                key, compat_key, cap - len(reqs))
+            reqs.extend(got)
+            if len(reqs) >= cap:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            time.sleep(min(0.0005, remaining))
+        return reqs
+
+    def _dispatch(self, first: ServeRequest) -> None:
+        from geomesa_tpu.utils.metrics import metrics
+
+        reqs = self._gather(first)
+        live, dead = split_expired(reqs)
+        fail_expired(dead)
+        for _ in dead:
+            self._bump("timeout")
+            metrics.counter("serve.timeout")
+        if not live:
+            return
+        t0 = time.monotonic()
+        for r in live:
+            metrics.histogram("serve.queue.wait").update(t0 - r.enqueued_at)
+        try:
+            # an unknown type name raises HERE, not in execute_batch's
+            # guarded body — it must fail these futures, not the
+            # dispatcher thread (one bad request would hang the service)
+            source = self.store.get_feature_source(live[0].query.type_name)
+        except BaseException as e:  # noqa: BLE001 — fan out like a dispatch
+            for r in live:
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(e)
+        else:
+            execute_batch(source, live)
+        t1 = time.monotonic()
+        self._bump("dispatches")
+        self._bump("coalesced", len(live) - 1)
+        metrics.counter("serve.dispatch")
+        if len(live) > 1:
+            metrics.counter("serve.coalesced", len(live) - 1)
+        metrics.gauge("serve.queue.depth", float(len(self.queue)))
+        for r in live:
+            if r.future.cancelled():
+                # cancelled between queue pop and execute: .exception()
+                # would raise CancelledError and kill the dispatcher
+                continue
+            metrics.histogram("serve.latency").update(t1 - r.enqueued_at)
+            status = "ok"
+            exc = r.future.exception()
+            if exc is not None:
+                status = ("timeout" if isinstance(exc, QueryTimeout)
+                          else "error")
+                self._bump("failed")
+            else:
+                self._bump("completed")
+            if self.audit is not None:
+                self.audit.write(ServeEvent(
+                    type_name=r.query.type_name,
+                    kind=r.kind,
+                    tenant=r.tenant,
+                    priority=PRIORITIES[r.priority],
+                    queue_ms=(t0 - r.enqueued_at) * 1000.0,
+                    exec_ms=(t1 - t0) * 1000.0,
+                    batch_size=len(live),
+                    status=status,
+                    degraded=r.degraded,
+                ))
+
+    # -- introspection -----------------------------------------------------
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        with self._state_lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def stats(self) -> Dict[str, int]:
+        with self._state_lock:
+            out = dict(self._counters)
+        out.setdefault("dispatches", 0)
+        out.setdefault("coalesced", 0)
+        out["queue_depth"] = len(self.queue)
+        out["degrade_level"] = self.degrade_level()
+        return out
+
+
+def self_check(verbose: bool = True) -> int:
+    """`gmtpu serve --self-check`: an end-to-end smoke against a
+    throwaway store — coalescing happens (fewer dispatches than
+    requests), coalesced kNN results match serial execution, the bounded
+    queue sheds with a typed QueryRejected, and latency histograms
+    export. Returns 0 on pass, 1 on failure; runs in-process in a few
+    seconds on CPU (used by the non-slow test suite)."""
+    import tempfile
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.plan.datastore import DataStore
+
+    def say(msg):
+        if verbose:
+            print(f"serve self-check: {msg}")
+
+    rng = np.random.default_rng(7)
+    n = 512
+    sft = SimpleFeatureType.from_spec(
+        "selfcheck", "name:String,score:Double,dtg:Date,*geom:Point")
+    batch = FeatureBatch.from_pydict(sft, {
+        "name": rng.choice(["a", "b", "c"], n).tolist(),
+        "score": rng.uniform(-10, 10, n),
+        "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+        "geom": np.stack(
+            [rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)], 1),
+    })
+    cql = "BBOX(geom, -180, -90, 180, 90)"
+    failures = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        store = DataStore(tmp, use_device_cache=True)
+        src = store.create_schema(sft)
+        src.write(batch)
+
+        qpts = rng.uniform(-60, 60, (8, 2))
+        serial = [src.knn(cql, qpts[i:i + 1, 0], qpts[i:i + 1, 1], k=5)
+                  for i in range(8)]
+
+        svc = QueryService(store, ServeConfig(max_wait_ms=20.0),
+                           autostart=False)
+        futs = [svc.knn("selfcheck", cql, qpts[i:i + 1, 0],
+                        qpts[i:i + 1, 1], k=5) for i in range(8)]
+        cfuts = [svc.count("selfcheck", cql) for _ in range(3)]
+        svc.start()
+        results = [f.result(timeout=60) for f in futs]
+        counts = [f.result(timeout=60) for f in cfuts]
+        svc.close(drain=True)
+        st = svc.stats()
+        say(f"dispatches={st['dispatches']} for 11 requests "
+            f"(coalesced {st['coalesced']})")
+        if st["dispatches"] >= 11:
+            say("FAIL: no coalescing happened")
+            failures += 1
+        for i, ((d, ix, _), (sd, six, _)) in enumerate(zip(results, serial)):
+            if not (np.allclose(d, sd) and np.array_equal(ix, six)):
+                say(f"FAIL: coalesced kNN result {i} != serial")
+                failures += 1
+        if len(set(counts)) != 1 or counts[0] != n:
+            say(f"FAIL: coalesced counts wrong: {counts}")
+            failures += 1
+
+        svc2 = QueryService(store, ServeConfig(max_queue=2),
+                            autostart=False)
+        svc2.count("selfcheck", cql)
+        svc2.count("selfcheck", "BBOX(geom, 0, 0, 10, 10)")
+        try:
+            svc2.count("selfcheck", "BBOX(geom, -10, -10, 0, 0)")
+            say("FAIL: bounded queue did not shed")
+            failures += 1
+        except QueryRejected as e:
+            say(f"bounded queue shed with reason={e.reason!r}")
+            if e.reason != "queue_full":
+                failures += 1
+        svc2.start()
+        svc2.close(drain=True)
+
+        from geomesa_tpu.utils.metrics import metrics
+
+        prom = metrics.to_prometheus()
+        for needle in ("serve_latency_seconds_bucket",
+                       "serve_latency_seconds_p99"):
+            if needle not in prom:
+                say(f"FAIL: {needle} missing from Prometheus export")
+                failures += 1
+    say("FAIL" if failures else "OK")
+    return 1 if failures else 0

@@ -21,8 +21,10 @@ Layout notes:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 import os
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -33,6 +35,19 @@ from geomesa_tpu.utils.padding import next_pow2 as _next_pow2
 
 LAYOUT_VERSION = 1
 MANIFEST = ".device_cache.json"
+
+
+def _locked(fn):
+    """Serialize a DeviceCacheManager method on the instance RLock —
+    ensure/refresh/invalidate/superbatch are compound read-modify-write
+    sequences that tear under concurrent queries without it."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
 
 
 @dataclasses.dataclass
@@ -79,6 +94,11 @@ class DeviceCacheManager:
     def __init__(self, storage: FileSystemStorage, coord_dtype=None):
         self.storage = storage
         self.coord_dtype = coord_dtype
+        # reentrant: compound ops (refresh -> ensure, resume -> _load)
+        # re-enter; guards every mutation/compound read so concurrent
+        # queries (the serve dispatch thread) never observe a half-swapped
+        # superbatch or race an invalidating writer
+        self._lock = threading.RLock()
         self._entries: Dict[str, CacheEntry] = {}
         self._super: Optional[SuperBatch] = None
         self._version = 0
@@ -145,6 +165,7 @@ class DeviceCacheManager:
             dev=dev,
         )
 
+    @_locked
     def ensure(self, partitions: Optional[List[str]] = None) -> List[str]:
         """Make the named partitions (default: all) resident; returns the
         list actually (re)loaded. Already-resident, unchanged partitions are
@@ -173,6 +194,7 @@ class DeviceCacheManager:
             self._version += 1
         return loaded
 
+    @_locked
     def refresh(self) -> List[str]:
         """Re-sync with the storage manifest: load new/changed partitions,
         drop removed ones. Returns changed partition names."""
@@ -185,6 +207,7 @@ class DeviceCacheManager:
             self._version += 1
         return self.ensure() + dropped
 
+    @_locked
     def invalidate(self, partition: Optional[str] = None) -> None:
         if partition is None:
             self._entries.clear()
@@ -193,9 +216,11 @@ class DeviceCacheManager:
         self._super = None
         self._version += 1
 
+    @_locked
     def get(self, partition: str) -> Optional[CacheEntry]:
         return self._entries.get(partition)
 
+    @_locked
     def superbatch(self) -> Optional[SuperBatch]:
         """The concatenated device view of every resident partition (None
         when nothing is resident). Built lazily and re-uploaded only when
@@ -242,9 +267,11 @@ class DeviceCacheManager:
         )
         return self._super
 
+    @_locked
     def resident(self) -> List[str]:
         return sorted(self._entries)
 
+    @_locked
     def stats(self) -> dict:
         return {
             "partitions": len(self._entries),
@@ -259,6 +286,7 @@ class DeviceCacheManager:
     def manifest_path(self) -> str:
         return os.path.join(self.storage.root, MANIFEST)
 
+    @_locked
     def save_manifest(self) -> None:
         doc = {
             "layout_version": LAYOUT_VERSION,
@@ -275,6 +303,7 @@ class DeviceCacheManager:
             json.dump(doc, f, indent=1, sort_keys=True)
         os.replace(tmp, self.manifest_path)
 
+    @_locked
     def resume(self) -> Tuple[List[str], List[str]]:
         """Rebuild device state from the saved manifest: reload every
         partition it names whose files still match; report (restored,

@@ -1,0 +1,57 @@
+"""JAX API compatibility shims.
+
+The engine kernels trace under `enable_x64(False)` so a process-wide
+x64 default (engine.device turns it on for f64 coordinate columns)
+cannot leak 64-bit types into Mosaic kernels. The context manager moved
+namespaces across JAX releases — `jax.experimental.enable_x64` on the
+pinned 0.4.x line, promoted to `jax.enable_x64` later — so every call
+site routes through here instead of betting on one location.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              **kwargs):
+    """`jax.shard_map` where available, else the 0.4.x
+    `jax.experimental.shard_map` (whose replication check is spelled
+    `check_rep`, renamed `check_vma` at promotion). Keyword-only after
+    `f` so `functools.partial(shard_map, mesh=..., ...)` works as a
+    decorator at every engine call site."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma, **kwargs)
+
+
+def pcast(x, axis_name, *, to: str):
+    """`jax.lax.pcast` where available, identity otherwise. The varying/
+    replicated mesh-axis typing it manipulates only exists alongside the
+    promoted `jax.shard_map`; the 0.4.x `jax.experimental.shard_map`
+    path runs these callers with check_rep=False, where the marker is
+    unnecessary."""
+    import jax
+
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axis_name, to=to)
+
+
+def enable_x64(new_val: bool = True):
+    """Context manager forcing the thread-local x64 state, wherever this
+    JAX version keeps it."""
+    import jax
+
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is not None:
+        return ctx(new_val)
+    from jax.experimental import enable_x64 as _enable_x64
+
+    return _enable_x64(new_val)

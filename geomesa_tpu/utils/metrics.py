@@ -1,16 +1,19 @@
-"""Tiny metrics registry: counters, gauges, timers.
+"""Tiny metrics registry: counters, gauges, timers, histograms.
 
 Parity: geomesa-metrics (Dropwizard/Micrometer registries + reporters)
-[upstream, unverified], reduced to counters/gauges/timers with JSON and
-Prometheus-text export — used by converters/ingest and the query path.
+[upstream, unverified], reduced to counters/gauges/timers/histograms with
+JSON and Prometheus-text export — used by converters/ingest, the query
+path, and the serve subsystem (queue-wait + end-to-end latency).
 """
 
 from __future__ import annotations
 
+import bisect
 import json
+import math
 import threading
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional, Sequence, Tuple
 
 
 class Timer:
@@ -27,6 +30,84 @@ class Timer:
     @property
     def mean_s(self) -> float:
         return self.total_s / self.count if self.count else 0.0
+
+
+# log-spaced latency bounds in SECONDS: 0.5ms .. ~65s, doubling — wide
+# enough for a coalescer's sub-ms queue waits and a cold multi-second
+# parquet->device scan in the same family. Fixed (not per-instance) so
+# every histogram is mergeable across threads/shards by construction.
+DEFAULT_BUCKETS: Tuple[float, ...] = tuple(
+    0.0005 * (2.0 ** i) for i in range(18)
+)
+
+
+class Histogram:
+    """Fixed-bucket latency histogram: thread-safe, mergeable, with
+    bucket-interpolated quantiles. Values are observed in seconds (the
+    Prometheus convention); the +Inf bucket is implicit (last slot)."""
+
+    def __init__(self, buckets: Optional[Sequence[float]] = None):
+        self.bounds: Tuple[float, ...] = tuple(buckets or DEFAULT_BUCKETS)
+        if list(self.bounds) != sorted(self.bounds) or len(self.bounds) < 1:
+            raise ValueError("histogram buckets must be sorted and non-empty")
+        self._lock = threading.Lock()
+        self.counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self.count = 0
+        self.sum = 0.0
+
+    def update(self, seconds: float) -> None:
+        i = bisect.bisect_left(self.bounds, seconds)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += seconds
+
+    def merge(self, other: "Histogram") -> None:
+        if other.bounds != self.bounds:
+            raise ValueError("cannot merge histograms with different buckets")
+        with other._lock:
+            counts, count, total = list(other.counts), other.count, other.sum
+        with self._lock:
+            for i, c in enumerate(counts):
+                self.counts[i] += c
+            self.count += count
+            self.sum += total
+
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile (the Prometheus histogram_quantile
+        estimate): linear within the winning bucket; values beyond the
+        last finite bound clamp to it."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            total = self.count
+            counts = list(self.counts)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        seen = 0.0
+        for i, c in enumerate(counts):
+            if seen + c >= rank and c > 0:
+                if i >= len(self.bounds):  # +Inf bucket: clamp
+                    return self.bounds[-1]
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                frac = (rank - seen) / c
+                return lo + (hi - lo) * frac
+            seen += c
+        return self.bounds[-1]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            count, total = self.count, self.sum
+        return {
+            "count": count,
+            "sum_s": total,
+            "mean_s": total / count if count else 0.0,
+            "p50_s": self.quantile(0.50),
+            "p95_s": self.quantile(0.95),
+            "p99_s": self.quantile(0.99),
+        }
 
 
 class _TimerContext:
@@ -48,6 +129,7 @@ class MetricsRegistry:
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, float] = {}
         self.timers: Dict[str, Timer] = {}
+        self.histograms: Dict[str, Histogram] = {}
 
     def counter(self, name: str, inc: float = 1.0) -> None:
         with self._lock:
@@ -62,6 +144,10 @@ class MetricsRegistry:
             t = self.timers.setdefault(name, Timer())
         return _TimerContext(t)
 
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            return self.histograms.setdefault(name, Histogram())
+
     def to_json(self) -> str:
         with self._lock:
             return json.dumps(
@@ -73,11 +159,17 @@ class MetricsRegistry:
                             "mean_s": t.mean_s, "max_s": t.max_s}
                         for k, t in self.timers.items()
                     },
+                    "histograms": {
+                        k: h.snapshot() for k, h in self.histograms.items()
+                    },
                 }
             )
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format. Histograms export the
+        standard cumulative `_bucket{le=...}` series plus `_p50/_p95/_p99`
+        gauge families, so dashboards get quantiles without running
+        histogram_quantile() themselves."""
         out: List[str] = []
         with self._lock:
             for k, v in self.counters.items():
@@ -93,11 +185,31 @@ class MetricsRegistry:
                 out.append(f"# TYPE {name}_seconds summary")
                 out.append(f"{name}_seconds_count {t.count}")
                 out.append(f"{name}_seconds_sum {t.total_s}")
+            hists = list(self.histograms.items())
+        for k, h in hists:
+            name = _prom(k) + "_seconds"
+            out.append(f"# TYPE {name} histogram")
+            with h._lock:
+                counts, count, total = list(h.counts), h.count, h.sum
+            cum = 0
+            for bound, c in zip(h.bounds, counts):
+                cum += c
+                out.append(f'{name}_bucket{{le="{_le(bound)}"}} {cum}')
+            out.append(f'{name}_bucket{{le="+Inf"}} {count}')
+            out.append(f"{name}_sum {total}")
+            out.append(f"{name}_count {count}")
+            for q, label in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+                out.append(f"# TYPE {name}_{label} gauge")
+                out.append(f"{name}_{label} {h.quantile(q)}")
         return "\n".join(out) + "\n"
 
 
 def _prom(name: str) -> str:
     return name.replace(".", "_").replace("-", "_")
+
+
+def _le(bound: float) -> str:
+    return "+Inf" if math.isinf(bound) else f"{bound:.10g}"
 
 
 metrics = MetricsRegistry()
