@@ -1,16 +1,21 @@
 """geomesa_tpu.analysis — JAX-aware static analysis + runtime guards.
 
 `gmtpu-lint` walks the package AST (never importing it) and reports
-JAX-specific hazards GT01..GT06; `runtime` adds opt-in recompile
-counters and transfer guards around the engine's jit caches. See
-docs/ANALYSIS.md for the rule catalog and waiver syntax.
+JAX-specific hazards GT01..GT06 plus the lock-discipline rules
+GT07..GT12 (`concurrency`); `runtime` adds opt-in recompile counters
+and transfer guards around the engine's jit caches, and `locksets` is
+the Eraser-style runtime race harness behind `gmtpu guard --races`.
+See docs/ANALYSIS.md for the rule catalog and waiver syntax.
 """
 
 from geomesa_tpu.analysis.model import RULES, Finding
 from geomesa_tpu.analysis.linter import (
-    exit_code, lint_paths, render_json, render_text)
+    exit_code, lint_paths, render_json, render_sarif, render_text)
+from geomesa_tpu.analysis.locksets import (
+    note_access, trace_locks, tracked_lock)
 
 __all__ = [
     "RULES", "Finding", "lint_paths", "render_text", "render_json",
-    "exit_code",
+    "render_sarif", "exit_code", "trace_locks", "tracked_lock",
+    "note_access",
 ]

@@ -13,7 +13,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="gmtpu-lint",
         description="JAX-aware static analysis for geomesa-tpu "
-                    "(rules GT01..GT06)")
+                    "(rules GT01..GT06 + concurrency GT07..GT12)")
     add_lint_arguments(p)
     return run_cli(p.parse_args(argv))
 

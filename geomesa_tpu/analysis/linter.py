@@ -17,11 +17,12 @@ import os
 import sys
 from typing import Dict, Iterable, List, Optional, Set
 
-from geomesa_tpu.analysis.model import SEVERITIES, Finding
+from geomesa_tpu.analysis.model import RULES, SEVERITIES, Finding
 from geomesa_tpu.analysis.modinfo import JitDef, ModInfo
 from geomesa_tpu.analysis.rules import ALL_RULES
 from geomesa_tpu.analysis.waivers import (
-    DEFAULT_WAIVER_FILENAME, apply_file_waivers, load_waiver_file)
+    DEFAULT_WAIVER_FILENAME, apply_file_waivers, check_rule_code,
+    load_waiver_file)
 
 
 class Project:
@@ -167,25 +168,40 @@ def lint_paths(paths: List[str],
     selected = rules or sorted(ALL_RULES)
     findings: List[Finding] = []
     for mod in project.modules:
+        _check_inline_waiver_tokens(mod)
         for code in selected:
             for f in ALL_RULES[code](mod, project):
                 if mod.is_waived(f.rule, f.line):
                     f.waived = True
                     f.waived_by = f"inline:{mod.relpath}:{f.line}"
                 findings.append(f)
-    entries = []
+    entries, severities = [], {}
     if waiver_file is None:
         root = find_repo_root(paths[0]) if paths else None
         cand = os.path.join(root, DEFAULT_WAIVER_FILENAME) if root else None
         if cand and os.path.exists(cand):
             waiver_file = cand
     if waiver_file:
-        entries = load_waiver_file(waiver_file)
+        entries, severities = load_waiver_file(waiver_file)
     apply_file_waivers(findings, entries)
+    for f in findings:
+        f.severity = severities.get(
+            f.rule, RULES[f.rule].severity if f.rule in RULES else f.severity)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     if not include_waived:
         findings = [f for f in findings if not f.waived]
     return findings
+
+
+def _check_inline_waiver_tokens(mod: ModInfo) -> None:
+    """A `# gt: waive GT99` typo must error, not silently never match."""
+    for line, tokens in sorted(mod.waivers.items()):
+        for tok in tokens:
+            if tok.startswith("waive "):
+                code = tok.split(None, 1)[1].strip()
+                if code == "all":
+                    continue
+                check_rule_code(code, f"{mod.relpath}:{line}")
 
 
 def render_text(findings: List[Finding], show_waived: bool = False) -> str:
@@ -207,6 +223,60 @@ def render_json(findings: List[Finding]) -> str:
         "active": sum(1 for f in findings if not f.waived),
         "waived": sum(1 for f in findings if f.waived),
     }, indent=2)
+
+
+_SARIF_LEVEL = {"info": "note", "warn": "warning", "error": "error"}
+
+
+def render_sarif(findings: List[Finding]) -> str:
+    """SARIF 2.1.0 for CI annotation surfaces (GitHub code scanning
+    etc.). Waived findings are emitted with an inSource suppression so
+    dashboards show the audit trail without failing the run."""
+    rules = [
+        {
+            "id": code,
+            "name": code,
+            "shortDescription": {"text": RULES[code].title},
+            "defaultConfiguration": {
+                "level": _SARIF_LEVEL[RULES[code].severity]},
+        }
+        for code in sorted(RULES)
+    ]
+    results = []
+    for f in findings:
+        r = {
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": f.col + 1},
+                },
+            }],
+        }
+        if f.waived:
+            r["suppressions"] = [{
+                "kind": "inSource",
+                "justification": f.waived_by,
+            }]
+        results.append(r)
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "gmtpu-lint",
+                "informationUri":
+                    "https://example.invalid/geomesa-tpu/docs/ANALYSIS.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)
 
 
 def exit_code(findings: List[Finding], fail_on: str) -> int:
@@ -236,11 +306,17 @@ def run_cli(args) -> int:
             rules=rules,
             waiver_file=getattr(args, "waivers", None),
         )
-    except FileNotFoundError as e:
+    except (FileNotFoundError, ValueError) as e:
+        # ValueError: malformed waiver file or a waiver naming an
+        # unknown rule code — configuration errors exit 2, not a
+        # silent green (or a traceback)
         print(e, file=sys.stderr)
         return 2
-    if getattr(args, "format", "text") == "json":
+    fmt = getattr(args, "format", "text")
+    if fmt == "json":
         print(render_json(findings))
+    elif fmt == "sarif":
+        print(render_sarif(findings))
     else:
         print(render_text(findings,
                           show_waived=getattr(args, "show_waived", False)))
@@ -261,7 +337,8 @@ def add_lint_arguments(p) -> None:
     p.add_argument("--waivers", default=None,
                    help=f"waiver file (default: {DEFAULT_WAIVER_FILENAME} "
                         f"at the repo root, if present)")
-    p.add_argument("--format", default="text", choices=["text", "json"],
-                   help="output format")
+    p.add_argument("--format", default="text",
+                   choices=["text", "json", "sarif"],
+                   help="output format (sarif: CI annotation surfaces)")
     p.add_argument("--show-waived", action="store_true",
                    help="include waived findings in text output")

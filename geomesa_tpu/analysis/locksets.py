@@ -1,0 +1,320 @@
+"""Runtime lockset harness: Eraser-style race detection for the serve path.
+
+The static pass (rules GT07..GT12) reasons about what the AST shows;
+this module watches what actually happens at runtime:
+
+- `TrackedLock` wraps a real `threading.Lock`/`RLock` and records every
+  acquisition into a per-thread held-stack plus a global lock-ORDER
+  graph (lock A held while acquiring lock B adds edge A->B, keyed by
+  each lock's creation site). An edge pair (A->B, B->A) is a lock-order
+  inversion — the runtime analog of rule GT08.
+- `note_access(key, write=)` implements the Eraser lockset refinement
+  (Savage et al. 1997): the candidate lockset of `key` is the
+  intersection of tracked locks held across all accesses; a key touched
+  by >= 2 threads with >= 1 write whose candidate set is empty is a
+  data-race report — the runtime analog of GT07/GT12.
+- `trace_locks()` patches `threading.Lock`/`RLock` so every lock
+  CREATED inside the context is tracked (existing locks are not).
+  `gmtpu guard --races script.py` runs a whole script under it and
+  exits nonzero on violations; the serve soak tests run their
+  QueryService/DataStore construction inside the context so all serving
+  locks are watched.
+
+Caveats (documented in docs/ANALYSIS.md): locks are aggregated by
+creation site, so two instances of one class share a graph node — a
+site-level inversion can in principle be two disjoint instances; read
+the stacks in the report before acting. Same-site self-edges are
+ignored for that reason.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+_SKIP_BASENAMES = ("locksets.py", "threading.py")
+
+
+def _creation_site() -> str:
+    """file:line of the frame that created the lock, skipping this
+    module and threading.py by exact BASENAME (a substring match would
+    also skip e.g. test_analysis_locksets.py and collapse every lock in
+    it onto one graph node)."""
+    import os
+
+    for frame in reversed(traceback.extract_stack()[:-2]):
+        if os.path.basename(frame.filename) not in _SKIP_BASENAMES:
+            return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+@dataclass
+class OrderEdge:
+    held: str
+    acquired: str
+    thread: str
+    stack: str
+
+
+@dataclass
+class AccessState:
+    threads: Set[str] = field(default_factory=set)
+    writes: int = 0
+    lockset: Optional[Set[str]] = None  # None until first access
+    first_empty_stack: Optional[str] = None
+
+
+class LockWatch:
+    """Registry shared by every TrackedLock of one tracing session."""
+
+    def __init__(self):
+        self._reglock = _REAL_LOCK()
+        self._held = threading.local()
+        self._tid_counter = 0
+        self.edges: Dict[Tuple[str, str], OrderEdge] = {}
+        self.accesses: Dict[object, AccessState] = {}
+        self.created: int = 0
+
+    def _tid(self) -> str:
+        """Stable per-thread label. NOT threading.get_ident(): the OS
+        recycles idents, so two sequential threads would alias into one
+        and hide a two-thread race; NOT current_thread().name either —
+        that can allocate a _DummyThread during thread bootstrap whose
+        Event is built from the PATCHED lock class and recurses here."""
+        tid = getattr(self._held, "tid", None)
+        if tid is None:
+            with self._reglock:
+                self._tid_counter += 1
+                tid = self._held.tid = f"t{self._tid_counter}"
+        return tid
+
+    # -- held-stack bookkeeping (called by TrackedLock) --------------------
+
+    def _stack(self) -> List[Tuple["TrackedLock", int]]:
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = self._held.stack = []
+        return st
+
+    def push(self, lock: "TrackedLock") -> None:
+        st = self._stack()
+        for i, (held, depth) in enumerate(st):
+            if held is lock:  # reentrant re-acquire: no new edges
+                st[i] = (held, depth + 1)
+                return
+        tname = self._tid()
+        with self._reglock:
+            for held, _depth in st:
+                if held.name != lock.name:
+                    self.edges.setdefault(
+                        (held.name, lock.name),
+                        OrderEdge(held.name, lock.name, tname,
+                                  "".join(traceback.format_stack(limit=8))))
+        st.append((lock, 1))
+
+    def pop(self, lock: "TrackedLock") -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            held, depth = st[i]
+            if held is lock:
+                if depth > 1:
+                    st[i] = (held, depth - 1)
+                else:
+                    del st[i]
+                return
+
+    def held_names(self) -> Set[str]:
+        return {lock.name for lock, _d in self._stack()}
+
+    # -- Eraser lockset state machine --------------------------------------
+
+    def note_access(self, key: object, write: bool = True) -> None:
+        held = self.held_names()
+        tname = self._tid()
+        with self._reglock:
+            st = self.accesses.setdefault(key, AccessState())
+            st.threads.add(tname)
+            if write:
+                st.writes += 1
+            if st.lockset is None:
+                st.lockset = set(held)
+            else:
+                st.lockset &= held
+            if not st.lockset and st.first_empty_stack is None \
+                    and len(st.threads) >= 2:
+                st.first_empty_stack = "".join(
+                    traceback.format_stack(limit=8))
+
+    # -- reporting ----------------------------------------------------------
+
+    def inversions(self, path_filter: Optional[str] = None
+                   ) -> List[Tuple[OrderEdge, OrderEdge]]:
+        with self._reglock:
+            edges = dict(self.edges)
+        out = []
+        for (a, b), e in sorted(edges.items()):
+            if a < b and (b, a) in edges:
+                rev = edges[(b, a)]
+                if path_filter and not (
+                        path_filter in a and path_filter in b):
+                    continue
+                out.append((e, rev))
+        return out
+
+    def races(self) -> List[Tuple[object, AccessState]]:
+        with self._reglock:
+            items = list(self.accesses.items())
+        return [(k, st) for k, st in items
+                if st.lockset is not None and not st.lockset
+                and len(st.threads) >= 2 and st.writes > 0]
+
+    def report(self, path_filter: Optional[str] = None) -> dict:
+        inv = self.inversions(path_filter)
+        races = self.races()
+        return {
+            "locks_created": self.created,
+            "order_edges": len(self.edges),
+            "inversions": [
+                {"first": f"{e.held} -> {e.acquired} [{e.thread}]",
+                 "second": f"{r.held} -> {r.acquired} [{r.thread}]",
+                 "stack_first": e.stack, "stack_second": r.stack}
+                for e, r in inv
+            ],
+            "races": [
+                {"key": repr(k), "threads": sorted(st.threads),
+                 "writes": st.writes,
+                 "stack": st.first_empty_stack or ""}
+                for k, st in races
+            ],
+            "violations": len(inv) + len(races),
+        }
+
+
+class TrackedLock:
+    """A threading.Lock/RLock wrapper that reports to a LockWatch. Works
+    as a `with` target, inside `threading.Condition`, and via the
+    `_release_save`/`_acquire_restore` protocol for RLocks (so a
+    Condition built on an RLock keeps the held-stack balanced through
+    `wait()`)."""
+
+    def __init__(self, inner, watch: LockWatch,
+                 name: Optional[str] = None):
+        self._inner = inner
+        self._watch = watch
+        self.name = name or _creation_site()
+        with watch._reglock:
+            watch.created += 1
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._watch.push(self)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._watch.pop(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        locked = getattr(self._inner, "locked", None)
+        return locked() if locked is not None else False
+
+    # Condition protocol (_release_save/_acquire_restore/_is_owned):
+    # resolved via __getattr__ so `hasattr` mirrors the INNER lock —
+    # threading.Condition feature-detects these, and advertising them
+    # over a plain Lock (which lacks them) would break Event/Condition
+    # fallback paths. The RLock variants keep the held-stack balanced
+    # when wait() temporarily releases a reentrant lock.
+    def __getattr__(self, name: str):
+        if name in ("_inner", "_watch"):
+            raise AttributeError(name)
+        if name == "_release_save":
+            inner_fn = getattr(self._inner, "_release_save")
+
+            def _release_save():
+                state = inner_fn()
+                self._watch.pop(self)
+                return state
+
+            return _release_save
+        if name == "_acquire_restore":
+            inner_fn = getattr(self._inner, "_acquire_restore")
+
+            def _acquire_restore(state):
+                inner_fn(state)
+                self._watch.push(self)
+
+            return _acquire_restore
+        if name == "_is_owned":
+            return getattr(self._inner, "_is_owned")
+        # anything else (e.g. _at_fork_reinit after an os.fork) resolves
+        # against the inner lock, so hasattr() mirrors its capabilities
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"TrackedLock({self.name})"
+
+
+_active_watch: Optional[LockWatch] = None
+
+
+def tracked_lock(name: Optional[str] = None,
+                 reentrant: bool = False,
+                 watch: Optional[LockWatch] = None) -> TrackedLock:
+    """An explicitly-instrumented lock for code that opts in directly
+    (fixtures, tests). Outside a trace_locks() context it reports into a
+    fresh private watch."""
+    w = watch or _active_watch or LockWatch()
+    inner = _REAL_RLOCK() if reentrant else _REAL_LOCK()
+    return TrackedLock(inner, w, name=name)
+
+
+def note_access(key: object, write: bool = True) -> None:
+    """Record an access to shared state `key` under the currently-held
+    tracked locks (no-op outside a tracing context)."""
+    if _active_watch is not None:
+        _active_watch.note_access(key, write=write)
+
+
+@contextlib.contextmanager
+def trace_locks():
+    """Patch threading.Lock/RLock so locks created inside the context
+    are tracked; yields the LockWatch. Locks created BEFORE entry stay
+    untracked — construct the objects under test inside the context."""
+    global _active_watch
+    if _active_watch is not None:
+        # nested tracing shares the outer watch (idempotent)
+        yield _active_watch
+        return
+    watch = LockWatch()
+
+    def make_lock():
+        return TrackedLock(_REAL_LOCK(), watch)
+
+    def make_rlock():
+        return TrackedLock(_REAL_RLOCK(), watch)
+
+    _active_watch = watch
+    threading.Lock = make_lock          # type: ignore[assignment]
+    threading.RLock = make_rlock        # type: ignore[assignment]
+    try:
+        yield watch
+    finally:
+        threading.Lock = _REAL_LOCK     # type: ignore[assignment]
+        threading.RLock = _REAL_RLOCK   # type: ignore[assignment]
+        _active_watch = None

@@ -36,6 +36,19 @@ RULES: Dict[str, Rule] = {
                      "remaining call sites"),
         Rule("GT06", "inconsistent mask plumbing: sibling call sites of "
                      "the same kernel disagree on validity masking"),
+        Rule("GT07", "inconsistent lock discipline: field guarded by the "
+                     "class lock in one method, accessed bare in another"),
+        Rule("GT08", "lock-order cycle in the project-wide lock "
+                     "acquisition graph (deadlock risk)"),
+        Rule("GT09", "blocking call (file I/O, device dispatch, sleep, "
+                     "future.result, queue get/put) while holding a lock"),
+        Rule("GT10", "per-call lock: created as a function local, guards "
+                     "nothing"),
+        Rule("GT11", "callback or future set_result invoked while "
+                     "holding a lock its consumer may also take"),
+        Rule("GT12", "shared mutable state (mutable default, module "
+                     "global, lock-free class field) mutated from "
+                     "thread-reachable code without a guard"),
     )
 }
 

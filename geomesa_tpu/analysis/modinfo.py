@@ -26,6 +26,24 @@ _GT_DIRECTIVE = re.compile(r"#\s*gt:\s*(?P<body>.+)$")
 
 
 @dataclass
+class ClassInfo:
+    """Per-class concurrency index for the GT07..GT12 rules: which
+    attributes are locks, which conditions wrap which lock, what type
+    each `self.x = ClassName(...)` field has, the method table, and the
+    thread entry points (`threading.Thread(target=self.m)`) the class
+    itself creates."""
+
+    name: str
+    node: "ast.ClassDef"
+    line: int
+    lock_attrs: Set[str] = field(default_factory=set)
+    cond_attrs: Dict[str, str] = field(default_factory=dict)  # cond -> lock
+    field_types: Dict[str, str] = field(default_factory=dict)
+    methods: Dict[str, "ast.FunctionDef"] = field(default_factory=dict)
+    thread_targets: Set[str] = field(default_factory=set)  # own methods
+
+
+@dataclass
 class JitDef:
     """A jitted callable defined in this module."""
 
@@ -64,11 +82,22 @@ class ModInfo:
         self.jnp_aliases: Set[str] = set()       # jax.numpy (device-safe)
         self.time_aliases: Set[str] = set()
         self.time_fn_aliases: Set[str] = set()   # bare perf_counter/time refs
+        self.threading_aliases: Set[str] = set()
+        self.lock_fn_aliases: Set[str] = set()   # bare Lock/RLock refs
+        self.cond_fn_aliases: Set[str] = set()   # bare Condition refs
+        self.thread_fn_aliases: Set[str] = set()  # bare Thread refs
         self._collect_aliases()
         self.functions: Dict[str, ast.FunctionDef] = {}
         self._collect_functions()
         self.jit_defs: List[JitDef] = []
         self._collect_jit_defs()
+        # concurrency indexes (rules GT07..GT12)
+        self.classes: Dict[str, ClassInfo] = {}
+        self._collect_classes()
+        self.locking_decorators: Dict[str, str] = {}  # name -> lock attr
+        self._collect_locking_decorators()
+        self.thread_targets: List[Tuple[Optional[str], str]] = []
+        self._collect_thread_targets()
         self.waivers: Dict[int, Set[str]] = {}
         self._collect_waivers()
 
@@ -112,6 +141,8 @@ class ModInfo:
                         self.functools_aliases.add(bound)
                     elif a.name == "time":
                         self.time_aliases.add(bound)
+                    elif a.name == "threading":
+                        self.threading_aliases.add(bound)
             elif isinstance(node, ast.ImportFrom):
                 mod = node.module or ""
                 for a in node.names:
@@ -125,6 +156,12 @@ class ModInfo:
                     elif mod == "time" and a.name in ("perf_counter", "time",
                                                       "monotonic"):
                         self.time_fn_aliases.add(bound)
+                    elif mod == "threading" and a.name in ("Lock", "RLock"):
+                        self.lock_fn_aliases.add(bound)
+                    elif mod == "threading" and a.name == "Condition":
+                        self.cond_fn_aliases.add(bound)
+                    elif mod == "threading" and a.name == "Thread":
+                        self.thread_fn_aliases.add(bound)
 
     # -- expression classifiers -------------------------------------------
 
@@ -160,6 +197,33 @@ class ModInfo:
                     and isinstance(f.value, ast.Name)
                     and f.value.id in self.time_aliases)
         return False
+
+    def _threading_attr(self, node: ast.AST, names: Tuple[str, ...],
+                        bare: Set[str]) -> bool:
+        """True when `node` is `threading.<name>` (via any alias) or a
+        bare imported name from `bare`."""
+        if isinstance(node, ast.Name):
+            return node.id in bare
+        if isinstance(node, ast.Attribute) and node.attr in names:
+            return (isinstance(node.value, ast.Name)
+                    and node.value.id in self.threading_aliases)
+        return False
+
+    def is_lock_ctor(self, node: ast.AST) -> bool:
+        """`threading.Lock()` / `threading.RLock()` (or imported names)."""
+        return (isinstance(node, ast.Call)
+                and self._threading_attr(node.func, ("Lock", "RLock"),
+                                         self.lock_fn_aliases))
+
+    def is_condition_ctor(self, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and self._threading_attr(node.func, ("Condition",),
+                                         self.cond_fn_aliases))
+
+    def is_thread_ctor(self, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and self._threading_attr(node.func, ("Thread",),
+                                         self.thread_fn_aliases))
 
     # -- functions ---------------------------------------------------------
 
@@ -270,6 +334,109 @@ class ModInfo:
                     static_names=names, static_nums=nums, func=func,
                     params=self.func_params(func) if func else ())
         self.jit_defs.append(jd)
+
+    # -- concurrency indexes (GT07..GT12) ----------------------------------
+
+    @staticmethod
+    def _self_attr_name(node: ast.AST) -> Optional[str]:
+        """`self.X` -> "X"."""
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def _collect_classes(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = ClassInfo(name=node.name, node=node, line=node.lineno)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    ci.methods[item.name] = item
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    attr = self._self_attr_name(sub.targets[0])
+                    if attr is None:
+                        continue
+                    if self.is_lock_ctor(sub.value):
+                        ci.lock_attrs.add(attr)
+                    elif self.is_condition_ctor(sub.value):
+                        # Condition(self._lock) shares the lock's identity;
+                        # bare Condition() owns a fresh (R)Lock
+                        args = sub.value.args
+                        tied = (self._self_attr_name(args[0])
+                                if args else None)
+                        ci.cond_attrs[attr] = tied or attr
+                        if tied is None:
+                            ci.lock_attrs.add(attr)
+                    elif (isinstance(sub.value, ast.Call)
+                          and isinstance(sub.value.func, ast.Name)
+                          and sub.value.func.id not in (
+                              "dict", "list", "set", "tuple", "deque",
+                              "defaultdict", "OrderedDict", "Counter")):
+                        ci.field_types[attr] = sub.value.func.id
+                elif isinstance(sub, ast.Call) and self.is_thread_ctor(sub):
+                    for kw in sub.keywords:
+                        if kw.arg == "target":
+                            t = self._self_attr_name(kw.value)
+                            if t is not None:
+                                ci.thread_targets.add(t)
+            # conditions tied to an owned lock also guard it when held
+            self.classes[node.name] = ci
+
+    def _collect_locking_decorators(self) -> None:
+        """A module-level `def _locked(fn)` whose nested wrapper body is
+        `with self.<attr>: ...` is a locking decorator: methods carrying
+        it are fully guarded by that lock attribute (the stats-manager /
+        device-cache idiom)."""
+        for node in self.tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.With):
+                    continue
+                for item in sub.items:
+                    attr = self._self_attr_name(item.context_expr)
+                    if attr is not None:
+                        self.locking_decorators[node.name] = attr
+                        break
+
+    def _collect_thread_targets(self) -> None:
+        """(owning class or None, callable name) for every thread entry
+        point created in this module: `Thread(target=f)`, Thread(target=
+        self.m), and `pool.submit(f, ...)` / `pool.map(f, ...)` on
+        executor-ish receivers."""
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            owner = None
+            for anc in self.ancestors(node):
+                if isinstance(anc, ast.ClassDef):
+                    owner = anc.name
+                    break
+            if self.is_thread_ctor(node):
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    t = self._self_attr_name(kw.value)
+                    if t is None and isinstance(kw.value, ast.Name):
+                        t = kw.value.id
+                    if t is not None:
+                        self.thread_targets.append((owner, t))
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in ("submit", "map")
+                  and isinstance(node.func.value, ast.Name)
+                  and (node.func.value.id == "ex"
+                       or any(s in node.func.value.id.lower()
+                              for s in ("pool", "executor")))
+                  and node.args):
+                a = node.args[0]
+                t = self._self_attr_name(a)
+                if t is None and isinstance(a, ast.Name):
+                    t = a.id
+                if t is not None:
+                    self.thread_targets.append((owner, t))
 
     # -- waiver comments ---------------------------------------------------
 

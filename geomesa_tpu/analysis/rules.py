@@ -512,7 +512,11 @@ def _has_valid_token(node: ast.AST) -> bool:
     return False
 
 
+from geomesa_tpu.analysis.concurrency import (  # noqa: E402
+    CONCURRENCY_RULES)
+
 ALL_RULES = {
     "GT01": gt01, "GT02": gt02, "GT03": gt03,
     "GT04": gt04, "GT05": gt05, "GT06": gt06,
+    **CONCURRENCY_RULES,
 }

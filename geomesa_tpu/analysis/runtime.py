@@ -65,6 +65,7 @@ class JitTracker:
             before = fn._cache_size()
             out = fn(*args, **kwargs)
             grew = fn._cache_size() - before
+            storm_count = 0
             with self._lock:
                 self.calls[label] = self.calls.get(label, 0) + 1
                 if grew > 0:
@@ -78,12 +79,13 @@ class JitTracker:
                             and label not in self._warned):
                         self._warned.add(label)
                         storm = self.on_storm
+                        storm_count = n  # captured under the lock (GT07)
                     else:
                         storm = None
                 else:
                     storm = None
             if storm is not None:
-                storm(label, self.recompiles[label])
+                storm(label, storm_count)
             return out
 
         wrapper._gt_tracked = fn  # type: ignore[attr-defined]
@@ -101,14 +103,16 @@ class JitTracker:
                 continue
             label = f"{module.__name__.rsplit('.', 1)[-1]}.{attr}"
             setattr(module, attr, self.wrap(obj, name=label))
-            self._installed.append((module, attr, obj))
+            with self._lock:
+                self._installed.append((module, attr, obj))
             wrapped += 1
         return wrapped
 
     def unwrap(self) -> None:
-        for module, attr, original in reversed(self._installed):
+        with self._lock:
+            installed, self._installed = self._installed, []
+        for module, attr, original in reversed(installed):
             setattr(module, attr, original)
-        self._installed.clear()
 
     def report(self) -> Dict[str, dict]:
         with self._lock:
@@ -175,12 +179,16 @@ def run_guarded(path: str, argv: Optional[List[str]] = None,
                 transfer: str = "allow",
                 warn_after: Optional[int] = None,
                 on_storm: Optional[Callable[[str, int], None]] = None,
-                registry=None) -> Tuple[Dict[str, dict], int]:
+                registry=None,
+                races: bool = False) -> Tuple[Dict[str, dict], int]:
     """Execute a Python script under the runtime guards (the `gmtpu
     guard` command): engine jit caches tracked, optional transfer
-    guard. Returns (tracker report, script exit status) — a script
-    ending in the standard `sys.exit(main())` idiom must not swallow
-    the report, so SystemExit is caught and surfaced as the status."""
+    guard, optional lockset race harness (`races=True`: every lock the
+    script CREATES is tracked; lock-order inversions and empty-lockset
+    accesses land in the report under "locksets"). Returns (report,
+    script exit status) — a script ending in the standard
+    `sys.exit(main())` idiom must not swallow the report, so SystemExit
+    is caught and surfaced as the status."""
     import runpy
     import sys
 
@@ -189,15 +197,28 @@ def run_guarded(path: str, argv: Optional[List[str]] = None,
     old_argv = sys.argv
     sys.argv = [path] + list(argv or ())
     status = 0
+    lock_report = None
     try:
-        with transfer_guard(transfer) if transfer != "allow" \
-                else contextlib.nullcontext():
-            runpy.run_path(path, run_name="__main__")
-    except SystemExit as e:
-        code = e.code
-        status = code if isinstance(code, int) else (
-            0 if code is None else 1)
+        with contextlib.ExitStack() as stack:
+            if transfer != "allow":
+                stack.enter_context(transfer_guard(transfer))
+            watch = None
+            if races:
+                from geomesa_tpu.analysis.locksets import trace_locks
+
+                watch = stack.enter_context(trace_locks())
+            try:
+                runpy.run_path(path, run_name="__main__")
+            except SystemExit as e:
+                code = e.code
+                status = code if isinstance(code, int) else (
+                    0 if code is None else 1)
+            if watch is not None:
+                lock_report = watch.report()
     finally:
         sys.argv = old_argv
         tracker.unwrap()
-    return tracker.report(), status
+    report = tracker.report()
+    if lock_report is not None:
+        report["locksets"] = lock_report
+    return report, status

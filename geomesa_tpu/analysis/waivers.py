@@ -12,11 +12,19 @@ Two waiver channels exist:
 
        # comment
        <path-glob> <RULE|*> [line]
+       severity <RULE> <info|warn|error>
 
    Paths are matched against the finding's repo-relative posix path with
    `fnmatch` (so `geomesa_tpu/engine/*.py` works). A bare rule of `*`
    waives every rule for the glob; an optional line number pins the
    waiver to one site so it goes stale loudly when the code moves.
+   `severity` lines re-classify a rule for the whole run (e.g. land a
+   new advisory rule as `info` so `--fail-on warn` ignores it until the
+   tree is clean).
+
+Waivers (file or inline) naming a rule code that does not exist raise a
+ValueError instead of silently never matching — a typo must not read as
+"waived".
 """
 
 from __future__ import annotations
@@ -24,11 +32,19 @@ from __future__ import annotations
 import fnmatch
 import os
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
-from geomesa_tpu.analysis.model import Finding
+from geomesa_tpu.analysis.model import SEVERITIES, RULES, Finding
 
 DEFAULT_WAIVER_FILENAME = ".gmtpu-waivers"
+
+
+def check_rule_code(code: str, origin: str) -> None:
+    """Unknown rule codes in waivers are an error, not a silent skip."""
+    if code != "*" and code not in RULES:
+        raise ValueError(
+            f"{origin}: unknown rule code {code!r} "
+            f"(have {', '.join(sorted(RULES))})")
 
 
 @dataclass(frozen=True)
@@ -48,18 +64,34 @@ class WaiverEntry:
                 or fnmatch.fnmatch(os.path.basename(path), self.glob))
 
 
-def load_waiver_file(path: str) -> List[WaiverEntry]:
+def load_waiver_file(
+    path: str,
+) -> Tuple[List[WaiverEntry], Dict[str, str]]:
+    """Parse a waiver file into (entries, severity overrides)."""
     entries: List[WaiverEntry] = []
+    severities: Dict[str, str] = {}
     with open(path, encoding="utf-8") as fh:
         for i, raw in enumerate(fh, 1):
             line = raw.strip()
             if not line or line.startswith("#"):
                 continue
             parts = line.split()
+            if parts[0] == "severity":
+                if len(parts) != 3 or parts[2] not in SEVERITIES:
+                    raise ValueError(
+                        f"{path}:{i}: expected 'severity <RULE> "
+                        f"<{'|'.join(SEVERITIES)}>', got {line!r}")
+                check_rule_code(parts[1], f"{path}:{i}")
+                if parts[1] == "*":
+                    raise ValueError(
+                        f"{path}:{i}: severity needs a concrete rule code")
+                severities[parts[1]] = parts[2]
+                continue
             if len(parts) not in (2, 3):
                 raise ValueError(
                     f"{path}:{i}: expected '<glob> <RULE|*> [line]', "
                     f"got {line!r}")
+            check_rule_code(parts[1], f"{path}:{i}")
             ln: Optional[int] = None
             if len(parts) == 3:
                 try:
@@ -70,7 +102,7 @@ def load_waiver_file(path: str) -> List[WaiverEntry]:
                         f"got {parts[2]!r}") from None
             entries.append(WaiverEntry(glob=parts[0], rule=parts[1],
                                        line=ln, origin=f"{path}:{i}"))
-    return entries
+    return entries, severities
 
 
 def apply_file_waivers(findings: List[Finding],

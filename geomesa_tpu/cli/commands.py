@@ -197,7 +197,8 @@ def register(sub: "argparse._SubParsersAction") -> None:
     from geomesa_tpu.analysis.linter import add_lint_arguments
 
     lint_p = sub.add_parser(
-        "lint", help="JAX-aware static analysis (rules GT01..GT06)")
+        "lint", help="JAX-aware static analysis (rules GT01..GT06 + "
+                     "concurrency GT07..GT12)")
     add_lint_arguments(lint_p)
     lint_p.set_defaults(func=_lint)
     guard_p = sub.add_parser(
@@ -213,6 +214,11 @@ def register(sub: "argparse._SubParsersAction") -> None:
     guard_p.add_argument("--recompile-warn", type=int, default=None,
                          help="warn on stderr when one jitted callable "
                               "recompiles more than N times")
+    guard_p.add_argument("--races", action="store_true",
+                         help="lockset race harness: track every lock "
+                              "the script creates; exit nonzero on "
+                              "lock-order inversions or empty-lockset "
+                              "accesses (docs/ANALYSIS.md)")
     guard_p.set_defaults(func=_guard)
 
 
@@ -364,14 +370,31 @@ def _guard(args) -> int:
     report, status = run_guarded(
         args.script, argv=list(args.script_args),
         transfer=args.transfer, warn_after=args.recompile_warn,
-        on_storm=storm)
-    tracked = {k: v for k, v in report.items() if v["calls"]}
+        on_storm=storm, races=getattr(args, "races", False))
+    locksets = report.pop("locksets", None)
+    tracked = {k: v for k, v in report.items() if v.get("calls")}
     print("gmtpu guard report:", file=sys.stderr)
     if not tracked:
         print("  (no tracked engine jit calls)", file=sys.stderr)
     for name, rec in sorted(tracked.items()):
         print(f"  {name}: calls={rec['calls']} "
               f"recompiles={rec['recompiles']}", file=sys.stderr)
+    if locksets is not None:
+        print(f"  locksets: {locksets['locks_created']} lock(s) tracked, "
+              f"{locksets['order_edges']} order edge(s), "
+              f"{len(locksets['inversions'])} inversion(s), "
+              f"{len(locksets['races'])} race(s)", file=sys.stderr)
+        for inv in locksets["inversions"]:
+            print(f"    INVERSION {inv['first']} vs {inv['second']}",
+                  file=sys.stderr)
+        for race in locksets["races"]:
+            print(f"    RACE key={race['key']} "
+                  f"threads={race['threads']} writes={race['writes']}",
+                  file=sys.stderr)
+        if locksets["violations"] and status == 0:
+            # the harness's whole point: a racy-but-green script must
+            # not exit 0 under --races
+            status = 1
     return status
 
 

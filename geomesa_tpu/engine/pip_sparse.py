@@ -34,6 +34,7 @@ the SAME pair list, so its candidate set is identical.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import NamedTuple, Tuple
 
 import jax
@@ -1233,15 +1234,13 @@ _PREP_MEM_MAX = 4
 # copies for the process lifetime (review finding); the entry just built
 # is always admitted — eviction only sheds OLDER entries
 _PREP_MEM_MAX_BYTES = 512 << 20
-_PREP_LOCK = None
+# created eagerly at import: the old lazy `if _PREP_LOCK is None:
+# _PREP_LOCK = Lock()` double-check was itself the race it guarded
+# against — two warm-up threads could mint two locks (GT12)
+_PREP_LOCK = threading.Lock()
 
 
 def _prep_lock():
-    global _PREP_LOCK
-    if _PREP_LOCK is None:
-        import threading
-
-        _PREP_LOCK = threading.Lock()
     return _PREP_LOCK
 
 

@@ -209,11 +209,17 @@ class KafkaFeatureCache:
     # -- events ------------------------------------------------------------
 
     def add_listener(self, fn: Listener) -> None:
-        self._listeners.append(fn)
+        with self._lock:
+            self._listeners.append(fn)
 
     def remove_listener(self, fn: Listener) -> None:
-        self._listeners.remove(fn)
+        with self._lock:
+            self._listeners.remove(fn)
 
     def _emit(self, event: FeatureEvent) -> None:
-        for fn in list(self._listeners):
+        # snapshot under the lock; INVOKE outside it (GT11): a listener
+        # that queries the cache re-enters without self-deadlocking
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
             fn(event)

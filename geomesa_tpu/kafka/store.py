@@ -254,33 +254,43 @@ class KafkaDataStore:
         self.mesh = mesh
         self.expiry_ms = expiry_ms
         self._state: Dict[str, dict] = {}
+        # reentrant: schema registration and poll (consume -> cache fold
+        # -> offset advance, one atomic unit per topic) run from query
+        # threads AND the serve dispatch thread; a feature listener
+        # calling back into the store must not self-deadlock
+        self._lock = threading.RLock()
 
     # -- schema ------------------------------------------------------------
 
     def create_schema(self, sft: SimpleFeatureType) -> KafkaFeatureSource:
         cache = KafkaFeatureCache(sft, expiry_ms=self.expiry_ms)
-        self._state[sft.name] = {
-            "sft": sft,
-            "serializer": GeoMessageSerializer(sft),
-            "cache": cache,
-            "storage": MemoryStorage(sft, cache),
-            "offset": 0,
-        }
+        with self._lock:
+            self._state[sft.name] = {
+                "sft": sft,
+                "serializer": GeoMessageSerializer(sft),
+                "cache": cache,
+                "storage": MemoryStorage(sft, cache),
+                "offset": 0,
+            }
         return KafkaFeatureSource(self, sft.name)
 
     def get_type_names(self) -> List[str]:
-        return sorted(self._state)
+        with self._lock:
+            return sorted(self._state)
 
     def get_schema(self, name: str) -> SimpleFeatureType:
-        return self._state[name]["sft"]
+        with self._lock:
+            return self._state[name]["sft"]
 
     def get_feature_source(self, name: str) -> KafkaFeatureSource:
-        if name not in self._state:
-            raise KeyError(f"no live schema {name!r}")
+        with self._lock:
+            if name not in self._state:
+                raise KeyError(f"no live schema {name!r}")
         return KafkaFeatureSource(self, name)
 
     def cache(self, name: str) -> KafkaFeatureCache:
-        return self._state[name]["cache"]
+        with self._lock:
+            return self._state[name]["cache"]
 
     # -- layer views -------------------------------------------------------
 
@@ -295,46 +305,56 @@ class KafkaDataStore:
         stream with a standing filter and/or projection (upstream: Kafka
         layer views, SURVEY.md C12). Views share the base cache — no data
         is duplicated; the view filter ANDs into every query."""
-        if base_name not in self._state:
-            raise KeyError(f"no live schema {base_name!r}")
+        with self._lock:
+            if base_name not in self._state:
+                raise KeyError(f"no live schema {base_name!r}")
         view = KafkaLayerView(self, base_name, view_name, cql, attributes)
-        self._state[base_name].setdefault("views", {})[view_name] = view
+        with self._lock:
+            self._state[base_name].setdefault("views", {})[view_name] = view
         return view
 
     def get_layer_view(self, base_name: str, view_name: str) -> "KafkaLayerView":
-        return self._state[base_name]["views"][view_name]
+        with self._lock:
+            return self._state[base_name]["views"][view_name]
 
     # -- producer side -----------------------------------------------------
 
     def write(self, name: str, batch: FeatureBatch) -> None:
         """Produce one Change per feature (latest-wins upsert semantics)."""
-        st = self._state[name]
-        ser: GeoMessageSerializer = st["serializer"]
+        with self._lock:
+            ser: GeoMessageSerializer = self._state[name]["serializer"]
         for fid, attrs in _batch_rows(batch):
             self.broker.produce(name, ser.serialize(Change(fid, attrs)))
 
     def delete(self, name: str, fid: str) -> None:
-        st = self._state[name]
-        self.broker.produce(name, st["serializer"].serialize(Delete(fid)))
+        with self._lock:
+            ser = self._state[name]["serializer"]
+        self.broker.produce(name, ser.serialize(Delete(fid)))
 
     def clear(self, name: str) -> None:
-        st = self._state[name]
-        self.broker.produce(name, st["serializer"].serialize(Clear()))
+        with self._lock:
+            ser = self._state[name]["serializer"]
+        self.broker.produce(name, ser.serialize(Clear()))
 
     # -- consumer side -----------------------------------------------------
 
     def poll(self, name: str) -> int:
-        """Consume new messages into the cache; returns messages applied."""
-        st = self._state[name]
-        msgs = self.broker.consume(name, st["offset"])
-        ser: GeoMessageSerializer = st["serializer"]
-        cache: KafkaFeatureCache = st["cache"]
-        for payload in msgs:
-            cache.apply(ser.deserialize(payload))
-        st["offset"] += len(msgs)
-        if self.expiry_ms is not None:
-            cache.expire()
-        return len(msgs)
+        """Consume new messages into the cache; returns messages applied.
+        One atomic consume -> fold -> offset advance per topic: two query
+        threads polling concurrently must not double-apply a message
+        window (latest-wins would hide it for Change, not for Clear+
+        replay interleavings) or skip one by racing the offset bump."""
+        with self._lock:
+            st = self._state[name]
+            msgs = self.broker.consume(name, st["offset"])
+            ser: GeoMessageSerializer = st["serializer"]
+            cache: KafkaFeatureCache = st["cache"]
+            for payload in msgs:
+                cache.apply(ser.deserialize(payload))
+            st["offset"] += len(msgs)
+            if self.expiry_ms is not None:
+                cache.expire()
+            return len(msgs)
 
 
 def _batch_rows(batch: FeatureBatch) -> Iterator[Tuple[str, Dict[str, object]]]:

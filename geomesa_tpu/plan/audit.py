@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 import time
 from typing import List, Optional
 
@@ -67,12 +68,26 @@ class AuditWriter:
         self.path = path
         self.max_events = max_events
         self.events: List[QueryEvent] = []
+        # the serve dispatch thread, client threads resolving live-layer
+        # fast paths and ingest writers all write() concurrently — the
+        # buffer append + trim is a compound mutation (GT12)
+        self._lock = threading.Lock()
 
     def write(self, event: "QueryEvent | ServeEvent") -> None:
         event.timestamp = time.time()
-        self.events.append(event)
-        if len(self.events) > self.max_events:
-            del self.events[: len(self.events) - self.max_events]
-        if self.path:
+        with self._lock:
+            self.events.append(event)
+            if len(self.events) > self.max_events:
+                del self.events[: len(self.events) - self.max_events]
+            line = json.dumps(event.to_json()) + "\n" if self.path else None
+        if line is not None:
+            # file append OUTSIDE the lock (GT09): one full line per
+            # write() — O_APPEND keeps concurrent lines whole, though
+            # their order may differ from buffer order by a few events
             with open(self.path, "a") as f:
-                f.write(json.dumps(event.to_json()) + "\n")
+                f.write(line)
+
+    def snapshot(self) -> "List[QueryEvent | ServeEvent]":
+        """Copy of the in-memory buffer, consistent under writers."""
+        with self._lock:
+            return list(self.events)

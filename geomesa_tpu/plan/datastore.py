@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import threading
 from typing import Dict, List, Optional
 
 from geomesa_tpu.core.columnar import FeatureBatch
@@ -104,6 +105,10 @@ class DataStore:
         self.use_device_cache = use_device_cache
         os.makedirs(catalog, exist_ok=True)
         self._sources: Dict[str, FeatureSource] = {}
+        # serve dispatch + client threads resolve sources concurrently;
+        # without this, two threads can build two planners (and two
+        # device caches) for one type and leak half of the HBM residency
+        self._lock = threading.Lock()
 
     def _planner(self, storage) -> QueryPlanner:
         from geomesa_tpu.plan.interceptor import load_interceptors
@@ -143,20 +148,28 @@ class DataStore:
             os.path.join(self.catalog, sft.name), sft, scheme, encoding
         )
         src = FeatureSource(storage, self._planner(storage))
-        self._sources[sft.name] = src
+        with self._lock:
+            self._sources[sft.name] = src
         return src
 
     def get_feature_source(self, name: str) -> FeatureSource:
-        if name not in self._sources:
-            storage = FileSystemStorage.load(os.path.join(self.catalog, name))
-            self._sources[name] = FeatureSource(storage, self._planner(storage))
-        return self._sources[name]
+        with self._lock:
+            src = self._sources.get(name)
+        if src is not None:
+            return src
+        storage = FileSystemStorage.load(os.path.join(self.catalog, name))
+        src = FeatureSource(storage, self._planner(storage))
+        with self._lock:
+            # first builder wins: a racing thread's source is dropped so
+            # every caller shares ONE planner + device cache per type
+            return self._sources.setdefault(name, src)
 
     def get_schema(self, name: str) -> SimpleFeatureType:
         return self.get_feature_source(name).sft
 
     def remove_schema(self, name: str) -> None:
-        self._sources.pop(name, None)
+        with self._lock:
+            self._sources.pop(name, None)
         path = os.path.join(self.catalog, name)
         if not os.path.exists(os.path.join(path, METADATA)):
             raise FileNotFoundError(f"no schema {name!r} in catalog")

@@ -18,6 +18,7 @@ Every phase is timed into the audit record; `explain` narrates the plan.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import List, Optional
 
@@ -62,6 +63,11 @@ class QueryPlan:
     partitions: List[str]
     total_partitions: int
     compiled: Optional[CompiledFilter]
+    # plan-time manifest snapshot (partition -> entry list): execution
+    # pins residency loads to the same committed write version the
+    # pruning saw, so a concurrent batch-atomic write is all-or-nothing
+    # for this query (None for storages without snapshot support)
+    manifest: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -91,6 +97,11 @@ class QueryPlanner:
         # QueryInterceptor SPI: callables Query -> Query run before
         # planning; see plan/interceptor.py
         self.interceptors: List = []
+        # one planner serves the dispatch thread AND direct callers
+        # concurrently (serve makes that the normal mode); this guards
+        # the lazily-built shared state: the compiled-filter cache, the
+        # kNN capacity cache and the stats-manager singleton (GT12)
+        self._mutex = threading.Lock()
         if coord_dtype is None:
             import jax.numpy as jnp
 
@@ -119,8 +130,15 @@ class QueryPlanner:
         interval = extract_intervals(f, d.name) if d else Interval(None, None)
         e(f"Primary bbox: ({bbox.xmin}, {bbox.ymin}, {bbox.xmax}, {bbox.ymax})")
         e(f"Primary interval: [{interval.start}, {interval.end}]")
-        partitions = self.storage.prune_partitions(bbox, interval)
-        total = len(self.storage.partitions())
+        snapshot_fn = getattr(self.storage, "manifest_snapshot", None)
+        manifest = snapshot_fn() if snapshot_fn is not None else None
+        if manifest is not None:
+            partitions = self.storage.prune_partitions(
+                bbox, interval, manifest=manifest)
+            total = len(manifest)
+        else:
+            partitions = self.storage.prune_partitions(bbox, interval)
+            total = len(self.storage.partitions())
         e(f"Partitions: {len(partitions)} of {total} after pruning")
         est = self._stats_estimate(bbox, interval)
         if est is not None:
@@ -147,7 +165,8 @@ class QueryPlanner:
         elif query.hints.is_bin:
             e(f"Aggregation: bin track={query.hints.bin_track}")
         e.pop()
-        return QueryPlan(query, f, bbox, interval, partitions, total, compiled)
+        return QueryPlan(query, f, bbox, interval, partitions, total,
+                         compiled, manifest=manifest)
 
     def _compile_cached(self, residual: ast.Filter, sft) -> CompiledFilter:
         """Reuse CompiledFilter across queries keyed on canonical CQL: a
@@ -155,21 +174,31 @@ class QueryPlanner:
         forcing an XLA recompile of the predicate kernel on EVERY query
         (~0.65s) even for textually identical repeat filters."""
         key = ast.to_cql(residual)
-        cached = getattr(self, "_compiled_filters", None)
-        if cached is None:
-            cached = self._compiled_filters = {}
-        if key not in cached:
-            if len(cached) > 256:  # bound memory on adversarial query streams
+        with self._mutex:
+            cached = getattr(self, "_compiled_filters", None)
+            if cached is None:
+                cached = self._compiled_filters = {}
+            got = cached.get(key)
+        if got is not None:
+            return got
+        # compile OUTSIDE the mutex: it costs ~0.65s and the lock also
+        # serves _knn_caps / stats-manager lookups — holding it here
+        # would stall every concurrent query behind one cache miss. Two
+        # threads may compile the same filter once each; setdefault
+        # keeps a single winner
+        compiled = compile_filter(residual, sft)
+        with self._mutex:
+            if len(cached) > 256:  # bound memory on adversarial streams
                 cached.clear()
-            cached[key] = compile_filter(residual, sft)
-        return cached[key]
+            return cached.setdefault(key, compiled)
 
     def stats_manager(self):
-        if not hasattr(self, "_stats_mgr"):
-            from geomesa_tpu.plan.stats_manager import StatsManager
+        with self._mutex:
+            if not hasattr(self, "_stats_mgr"):
+                from geomesa_tpu.plan.stats_manager import StatsManager
 
-            self._stats_mgr = StatsManager(self.storage)
-        return self._stats_mgr
+                self._stats_mgr = StatsManager(self.storage)
+            return self._stats_mgr
 
     def _stats_estimate(self, bbox: BBox, interval: Interval):
         """Sketch-based selectivity (StatsBasedEstimator analog); None when
@@ -422,7 +451,7 @@ class QueryPlanner:
         import jax.numpy as jnp
 
         hints = query.hints
-        self.cache.ensure(plan.partitions)
+        self.cache.ensure(plan.partitions, manifest=plan.manifest)
         t_scan = time.perf_counter()
 
         sb = self.cache.superbatch()
@@ -566,7 +595,7 @@ class QueryPlanner:
             )
 
         if self.cache is not None:
-            self.cache.ensure(plan.partitions)
+            self.cache.ensure(plan.partitions, manifest=plan.manifest)
             sb = self.cache.superbatch()
             if sb is None:
                 return empty()
@@ -642,9 +671,10 @@ class QueryPlanner:
         kk = min(k, x.shape[0])
         mb = max(64, kk)
         interp = default_interpret()
-        caps = getattr(self, "_knn_caps", None)
-        if caps is None:
-            caps = self._knn_caps = {}
+        with self._mutex:
+            caps = getattr(self, "_knn_caps", None)
+            if caps is None:
+                caps = self._knn_caps = {}
         if impl == "auto":
             impl = self._knn_impl_from_stats(plan)
         if impl == "sparse":
@@ -653,16 +683,19 @@ class QueryPlanner:
             # bbox and simply recalibrate — a stale cap is never wrong,
             # only overflow-fallback slow or dead-program wasteful
             key = (ast.to_cql(plan.filter), kk)
-            if key not in caps and len(caps) > 256:
-                caps.clear()  # bound memory on adversarial query streams
+            with self._mutex:
+                if key not in caps and len(caps) > 256:
+                    caps.clear()  # bound memory on adversarial streams
+                seed_cap = caps.get(key)
             fd, fi, cap = knn_sparse_auto(
                 jqx, jqy, x, y, mask, k=kk,
-                tile_capacity=caps.get(key), m_blocks=mb, interpret=interp,
+                tile_capacity=seed_cap, m_blocks=mb, interpret=interp,
             )
-            if cap > 0:
-                caps[key] = cap
-            else:
-                caps.pop(key, None)
+            with self._mutex:
+                if cap > 0:
+                    caps[key] = cap
+                else:
+                    caps.pop(key, None)
         else:
             fd, fi = knn_fullscan_tiled(
                 jqx, jqy, x, y, mask, k=kk, m_blocks=mb, interpret=interp,

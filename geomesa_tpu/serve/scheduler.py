@@ -186,6 +186,11 @@ class AdmissionQueue:
                         if req.future.cancelled():
                             continue
                         if on_pop is not None:
+                            # gt: waive GT11
+                            # (deliberate: the callback is the atomic
+                            # pop+mark-inflight step, see docstring; its
+                            # only consumer is _mark_inflight, which
+                            # takes _state_lock, never this queue lock)
                             on_pop(req)
                         return req
                 if deadline is not None:

@@ -96,7 +96,8 @@ class QueryService:
         """Stop the service. drain=True (graceful): admissions stop with
         QueryRejected(shutting_down) while every already-admitted request
         still executes; drain=False: queued requests are rejected."""
-        self._closed = True
+        with self._state_lock:
+            self._closed = True
         if not drain:
             for r in self.queue.drain_all():
                 if r.future.set_running_or_notify_cancel():
@@ -121,7 +122,9 @@ class QueryService:
         """Admission control, then enqueue. Raises the typed
         QueryRejected (never queues unboundedly) on shed/limit/closed."""
         self._bump("submitted")
-        if self._closed:
+        with self._state_lock:
+            closed = self._closed
+        if closed:
             self._bump("rejected")
             raise QueryRejected("shutting_down", "service closed")
         try:

@@ -102,6 +102,7 @@ class DeviceCacheManager:
         self._entries: Dict[str, CacheEntry] = {}
         self._super: Optional[SuperBatch] = None
         self._version = 0
+        self._applied_mversion = -1  # storage commit version last applied
         # store-level grow-only vocabularies (per dict column) so device
         # code segments from different partitions remain comparable
         self._vocab: Dict[str, list] = {}
@@ -113,8 +114,10 @@ class DeviceCacheManager:
 
     # -- residency ---------------------------------------------------------
 
-    def _partition_files(self, name: str) -> List[str]:
-        return sorted(e["file"] for e in self.storage.manifest.get(name, []))
+    def _partition_files(self, name: str,
+                         manifest: Optional[dict] = None) -> List[str]:
+        src = manifest if manifest is not None else self.storage.manifest
+        return sorted(e["file"] for e in src.get(name, []))
 
     def _shared_vocab_recode(self, batch: FeatureBatch) -> FeatureBatch:
         """Re-encode dict columns against the store-level vocabularies
@@ -142,8 +145,11 @@ class DeviceCacheManager:
             return batch
         return FeatureBatch(batch.sft, cols, batch.fids, batch.valid)
 
-    def _load_partition(self, name: str) -> Optional[CacheEntry]:
-        batches = list(self.storage.scan_partitions([name]))
+    def _load_partition(self, name: str,
+                        manifest: Optional[dict] = None,
+                        ) -> Optional[CacheEntry]:
+        batches = list(self.storage.scan_partitions([name],
+                                                    manifest=manifest))
         if not batches:
             return None
         batch = FeatureBatch.concat(batches)
@@ -155,10 +161,14 @@ class DeviceCacheManager:
 
             padded = self._shared_vocab_recode(padded)
             kw = {"coord_dtype": self.coord_dtype} if self.coord_dtype else {}
+            # gt: waive GT09
+            # (deliberate: the upload IS the guarded residency swap;
+            # queries blocked here would otherwise read a half-registered
+            # partition — double-buffer under the lock)
             dev = to_device(padded, **kw)
             self.upload_count += 1
         return CacheEntry(
-            files=self._partition_files(name),
+            files=self._partition_files(name, manifest),
             count=n,
             padded=len(padded),
             batch=padded,
@@ -166,19 +176,35 @@ class DeviceCacheManager:
         )
 
     @_locked
-    def ensure(self, partitions: Optional[List[str]] = None) -> List[str]:
+    def ensure(self, partitions: Optional[List[str]] = None,
+               manifest: Optional[dict] = None) -> List[str]:
         """Make the named partitions (default: all) resident; returns the
         list actually (re)loaded. Already-resident, unchanged partitions are
         untouched — the double-buffer: a changed partition's new entry is
-        fully built before the old one is dropped."""
-        names = partitions if partitions is not None else self.storage.partitions()
+        fully built before the old one is dropped. `manifest` pins the
+        whole ensure to one committed write version (the planner passes
+        its plan-time snapshot so pruning and residency agree — without
+        it, a concurrent batch-atomic write could be half-visible:
+        reloaded files in old partitions, missing new partitions)."""
+        mv = getattr(manifest, "version", None)
+        if manifest is None or (mv is not None
+                                and mv < self._applied_mversion):
+            # a STALE plan snapshot (another query already applied a
+            # newer commit) must not roll residency backward / thrash
+            # re-uploads: take a fresh snapshot instead — it is at least
+            # as new as anything applied
+            manifest = self.storage.manifest_snapshot()
+            mv = getattr(manifest, "version", None)
+        if mv is not None:
+            self._applied_mversion = max(self._applied_mversion, mv)
+        names = partitions if partitions is not None else sorted(manifest)
         loaded = []
         for name in names:
-            files = self._partition_files(name)
+            files = self._partition_files(name, manifest)
             cur = self._entries.get(name)
             if cur is not None and cur.files == files:
                 continue
-            entry = self._load_partition(name)
+            entry = self._load_partition(name, manifest)
             changed = True
             if entry is None:
                 # only a real removal changes residency — a partition that
@@ -198,14 +224,14 @@ class DeviceCacheManager:
     def refresh(self) -> List[str]:
         """Re-sync with the storage manifest: load new/changed partitions,
         drop removed ones. Returns changed partition names."""
-        current = set(self.storage.partitions())
-        dropped = [n for n in self._entries if n not in current]
+        manifest = self.storage.manifest_snapshot()
+        dropped = [n for n in self._entries if n not in manifest]
         for n in dropped:
             del self._entries[n]
         if dropped:
             self._super = None
             self._version += 1
-        return self.ensure() + dropped
+        return self.ensure(manifest=manifest) + dropped
 
     @_locked
     def invalidate(self, partition: Optional[str] = None) -> None:
@@ -256,6 +282,10 @@ class DeviceCacheManager:
             }
         else:
             kw = {"coord_dtype": self.coord_dtype} if self.coord_dtype else {}
+            # gt: waive GT09
+            # (deliberate: full re-upload path of the superbatch rebuild;
+            # the lock is what makes the swap atomic for concurrent
+            # queries — see class docstring)
             dev = to_device(batch, **kw)
             self.upload_count += 1
         self._super = SuperBatch(
@@ -299,6 +329,9 @@ class DeviceCacheManager:
             },
         }
         tmp = self.manifest_path + ".tmp"
+        # gt: waive GT09
+        # (deliberate: manifest persistence under the lock keeps the
+        # snapshot consistent with residency; the file swap is atomic)
         with open(tmp, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
         os.replace(tmp, self.manifest_path)
@@ -311,16 +344,20 @@ class DeviceCacheManager:
         via ensure() by the caller if wanted."""
         if not os.path.exists(self.manifest_path):
             return [], []
+        # gt: waive GT09
+        # (deliberate: restart-time rebuild — determinism of the restored
+        # device state depends on the lock excluding queries)
         with open(self.manifest_path) as f:
             doc = json.load(f)
         restored, stale = [], []
         if doc.get("layout_version") != LAYOUT_VERSION:
             return [], sorted(doc.get("partitions", {}))
+        snap = self.storage.manifest_snapshot()
         for name, meta in sorted(doc.get("partitions", {}).items()):
-            if self._partition_files(name) != meta["files"]:
+            if self._partition_files(name, snap) != meta["files"]:
                 stale.append(name)
                 continue
-            entry = self._load_partition(name)
+            entry = self._load_partition(name, snap)
             if entry is None:
                 stale.append(name)
                 continue

@@ -22,6 +22,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import uuid
 from typing import Dict, Iterator, List, Optional, Sequence, Set
 
@@ -38,6 +39,15 @@ from geomesa_tpu.store.partition import PartitionScheme, scheme_from_config
 
 METADATA = "metadata.json"
 FID = "__fid__"
+
+
+class ManifestSnapshot(Dict[str, List[dict]]):
+    """A plain partition->entries dict plus the commit version it was
+    taken at (monotonic per storage instance). Every dict consumer works
+    unchanged; version-aware consumers use `.version` to refuse applying
+    an older snapshot over newer state."""
+
+    version: int = 0
 
 
 def _batch_to_table(batch: FeatureBatch) -> pa.Table:
@@ -144,6 +154,15 @@ class FileSystemStorage:
         self.encoding = encoding
         # manifest: partition -> list of {"file", "count"}
         self.manifest: Dict[str, List[dict]] = {}
+        # serve made writer-vs-scan concurrency the normal mode: without
+        # this, _save_metadata can crash iterating the manifest mid-append
+        # ("dictionary changed size") and readers see torn entry lists.
+        # Data files are immutable once written, so only manifest state
+        # needs the lock — file I/O stays outside it. The version bumps
+        # on every committed mutation so consumers can order snapshots
+        # (DeviceCacheManager refuses to roll residency backward).
+        self._lock = threading.Lock()
+        self._mversion = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -177,6 +196,10 @@ class FileSystemStorage:
         return store
 
     def _save_metadata(self):
+        """Persist metadata + manifest. Callers on the mutation paths
+        hold self._lock so the json serialization sees one consistent
+        manifest (a concurrent append would otherwise blow up the dict
+        iteration); `create` runs before the store is shared."""
         meta = {
             "version": 1,
             "name": self.sft.name,
@@ -186,13 +209,19 @@ class FileSystemStorage:
             "manifest": self.manifest,
         }
         tmp = os.path.join(self.root, METADATA + ".tmp")
+        # gt: waive GT09
+        # (deliberate: persisting under the manifest lock is the point —
+        # the snapshot must not move while it serializes; the final
+        # os.replace swap is atomic for readers of the file)
         with open(tmp, "w") as f:
             json.dump(meta, f, indent=1)
         os.replace(tmp, os.path.join(self.root, METADATA))
 
     @property
     def count(self) -> int:
-        return sum(f["count"] for files in self.manifest.values() for f in files)
+        with self._lock:
+            return sum(f["count"]
+                       for files in self.manifest.values() for f in files)
 
     # -- write -------------------------------------------------------------
 
@@ -203,6 +232,12 @@ class FileSystemStorage:
         if batch.valid is not None and not batch.valid.all():
             batch = batch.select(batch.valid)
         names = np.asarray(self.scheme.partitions_for(batch))
+        # stage every partition file FIRST (outside the lock), then
+        # commit the whole batch to the manifest in ONE lock acquisition:
+        # a concurrent reader snapshot sees all of this write or none of
+        # it, so counts only ever move at batch boundaries (the serve
+        # torn-read contract, tests/test_serve_concurrency.py)
+        staged = []
         for name in np.unique(names):
             sub = batch.select(names == name)
             pdir = os.path.join(self.root, name)
@@ -223,18 +258,25 @@ class FileSystemStorage:
                     compression="zstd",
                     row_group_size=64 * 1024,
                 )
-            self.manifest.setdefault(name, []).append(
-                {"file": fname, "count": len(sub)}
-            )
-        self._save_metadata()
+            staged.append((str(name), fname, len(sub)))
+        with self._lock:
+            for name, fname, count in staged:
+                self.manifest.setdefault(name, []).append(
+                    {"file": fname, "count": count}
+                )
+            self._mversion += 1
+            self._save_metadata()
 
     def compact(self, partition: Optional[str] = None) -> int:
         """Merge each touched partition's files into one (the FS store's
         compact command). Returns how many files were removed."""
-        targets = [partition] if partition is not None else list(self.manifest)
+        with self._lock:
+            targets = [partition] if partition is not None \
+                else list(self.manifest)
         removed = 0
         for name in targets:
-            entries = self.manifest.get(name, [])
+            with self._lock:
+                entries = list(self.manifest.get(name, []))
             if len(entries) <= 1:
                 continue
             tables = []
@@ -257,8 +299,15 @@ class FileSystemStorage:
             # at it, persist — only then delete the old files. A crash
             # leaves either the old manifest (old files intact) or the new
             # one (merged file intact); never a manifest of missing files.
-            self.manifest[name] = [{"file": fname, "count": count}]
-            self._save_metadata()
+            with self._lock:
+                # writes only APPEND, so the snapshot is a prefix of the
+                # live list: keep any entry a concurrent write() added
+                # since (wholesale replace would orphan its file/rows)
+                tail = self.manifest.get(name, [])[len(entries):]
+                self.manifest[name] = [{"file": fname,
+                                        "count": count}] + tail
+                self._mversion += 1
+                self._save_metadata()
             for entry in entries:
                 os.remove(os.path.join(self.root, name, entry["file"]))
                 removed += 1
@@ -282,22 +331,28 @@ class FileSystemStorage:
             # (orphaned files, harmless), never references to missing
             # files.
             total = self.count
-            paths = [
-                os.path.join(self.root, name, entry["file"])
-                for name, entries in self.manifest.items()
-                for entry in entries
-            ]
-            self.manifest = {}
-            self._save_metadata()
+            with self._lock:
+                paths = [
+                    os.path.join(self.root, name, entry["file"])
+                    for name, entries in self.manifest.items()
+                    for entry in entries
+                ]
+                self.manifest = {}
+                self._mversion += 1
+                self._save_metadata()
             for p in paths:
                 os.remove(p)
             return total
         deleted = 0
-        for name in list(self.manifest):
+        with self._lock:
+            names = list(self.manifest)
+        for name in names:
             new_entries = []
             removals = []
             changed = False
-            for entry in self.manifest[name]:
+            with self._lock:
+                entries = list(self.manifest.get(name, []))
+            for entry in entries:
                 path = os.path.join(self.root, name, entry["file"])
                 batch = _table_to_batch(
                     self._read_file(path, None, None), self.sft)
@@ -325,11 +380,17 @@ class FileSystemStorage:
                             row_group_size=64 * 1024)
                     new_entries.append({"file": fname, "count": len(keep)})
             if changed:
-                if new_entries:
-                    self.manifest[name] = new_entries
-                else:
-                    del self.manifest[name]
-                self._save_metadata()
+                with self._lock:
+                    # preserve entries a concurrent write() appended
+                    # after our snapshot (appends-only: snapshot is a
+                    # prefix of the live list)
+                    tail = self.manifest.get(name, [])[len(entries):]
+                    if new_entries or tail:
+                        self.manifest[name] = new_entries + tail
+                    else:
+                        del self.manifest[name]
+                    self._mversion += 1
+                    self._save_metadata()
                 for fname in removals:
                     os.remove(os.path.join(self.root, name, fname))
         return deleted
@@ -351,15 +412,34 @@ class FileSystemStorage:
 
     # -- read --------------------------------------------------------------
 
-    def partitions(self) -> List[str]:
-        return sorted(self.manifest)
+    def manifest_snapshot(self) -> "ManifestSnapshot":
+        """One consistent view of partition -> entry list, taken in a
+        single lock acquisition, stamped with the commit version.
+        Queries that enumerate partitions and then read their files must
+        do BOTH against the same snapshot, or a concurrent batch-atomic
+        write tears across the two reads (new rows visible in old
+        partitions, new partitions missing)."""
+        with self._lock:
+            snap = ManifestSnapshot(
+                (name, list(entries))
+                for name, entries in self.manifest.items())
+            snap.version = self._mversion
+            return snap
 
-    def prune_partitions(self, bbox: BBox, interval: Interval) -> List[str]:
+    def partitions(self) -> List[str]:
+        with self._lock:
+            return sorted(self.manifest)
+
+    def prune_partitions(self, bbox: BBox, interval: Interval,
+                         manifest: Optional[Dict[str, List[dict]]] = None,
+                         ) -> List[str]:
+        names = (sorted(manifest) if manifest is not None
+                 else self.partitions())
         pruned = self.scheme.prune(bbox, interval)
         if pruned is None:
-            return self.partitions()
+            return names
         out = []
-        for name in self.manifest:
+        for name in names:
             for p in pruned:
                 if name == p or name.startswith(p + "/") or p == "":
                     out.append(name)
@@ -424,8 +504,11 @@ class FileSystemStorage:
                     phys_cols += [c, f"{c}__xmin", f"{c}__ymin", f"{c}__xmax", f"{c}__ymax"]
                 else:
                     phys_cols.append(c)
-        for name in self.prune_partitions(bbox, interval):
-            for entry in self.manifest.get(name, []):
+        # one snapshot for BOTH pruning and entry reads: a batch-atomic
+        # concurrent write is either fully visible or not at all
+        snap = self.manifest_snapshot()
+        for name in self.prune_partitions(bbox, interval, manifest=snap):
+            for entry in snap.get(name, []):
                 path = os.path.join(self.root, name, entry["file"])
                 cols = phys_cols
                 if phys_cols is not None:
@@ -444,12 +527,18 @@ class FileSystemStorage:
                     if len(t):
                         yield _table_to_batch(t, self.sft)
 
-    def scan_partitions(self, names: Sequence[str]) -> Iterator[FeatureBatch]:
+    def scan_partitions(
+        self,
+        names: Sequence[str],
+        manifest: Optional[Dict[str, List[dict]]] = None,
+    ) -> Iterator[FeatureBatch]:
         """Yield every row (all columns) of the named partitions, no
         pushdown — the device-cache residency read (store.cache and the
-        export jobs load whole partitions)."""
+        export jobs load whole partitions). Passing a `manifest`
+        snapshot pins the read to one committed write version."""
+        snap = manifest if manifest is not None else self.manifest_snapshot()
         for name in names:
-            for entry in self.manifest.get(name, []):
+            for entry in snap.get(name, []):
                 path = os.path.join(self.root, name, entry["file"])
                 t = self._read_file(path, None, None)
                 if len(t):

@@ -17,19 +17,26 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 
 class Timer:
+    """Thread-safe like Histogram: one registry Timer is shared by every
+    thread timing the same name, and `count += 1` is a read-modify-write
+    that drops updates without the lock (GT12)."""
+
     def __init__(self):
+        self._lock = threading.Lock()
         self.count = 0
         self.total_s = 0.0
         self.max_s = 0.0
 
     def update(self, seconds: float):
-        self.count += 1
-        self.total_s += seconds
-        self.max_s = max(self.max_s, seconds)
+        with self._lock:
+            self.count += 1
+            self.total_s += seconds
+            self.max_s = max(self.max_s, seconds)
 
     @property
     def mean_s(self) -> float:
-        return self.total_s / self.count if self.count else 0.0
+        with self._lock:
+            return self.total_s / self.count if self.count else 0.0
 
 
 # log-spaced latency bounds in SECONDS: 0.5ms .. ~65s, doubling — wide

@@ -166,7 +166,8 @@ class SizeSeparatedBucketIndex(Generic[T]):
             return e[1] if e else None
 
     def get(self, key: str) -> Optional[T]:
-        t = self._where.get(key)
+        with self._lock:
+            t = self._where.get(key)
         if t is None:
             return None
         e = self._tiers[t].get(key)
@@ -197,4 +198,5 @@ class SizeSeparatedBucketIndex(Generic[T]):
             self._where.clear()
 
     def __len__(self) -> int:
-        return len(self._where)
+        with self._lock:
+            return len(self._where)

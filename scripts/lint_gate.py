@@ -1,11 +1,14 @@
 #!/usr/bin/env python
 """CI gate: `gmtpu lint --fail-on warn` over geomesa_tpu/.
 
-Exits nonzero on any unwaived finding, printing each with file:line and
-rule code. Rides the tier-1 pytest run via tests/test_lint_gate.py and
-is runnable standalone:
+Runs EVERY registered rule — the JAX hazards GT01..GT06 and the
+concurrency pass GT07..GT12 (lock discipline, lock-order cycles,
+blocking-under-lock, per-call locks, callback-under-lock, unguarded
+shared state) — and exits nonzero on any unwaived finding, printing
+each with file:line and rule code. Rides the tier-1 pytest run via
+tests/test_lint_gate.py and is runnable standalone:
 
-    python scripts/lint_gate.py [--format json]
+    python scripts/lint_gate.py [--format json|sarif]
 
 Rule catalog + waiver syntax: docs/ANALYSIS.md.
 """
@@ -23,14 +26,17 @@ if REPO_ROOT not in sys.path:  # standalone invocation from anywhere
 
 def main(argv=None) -> int:
     from geomesa_tpu.analysis.linter import (
-        exit_code, lint_paths, render_json, render_text)
+        exit_code, lint_paths, render_json, render_sarif, render_text)
 
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("--format", default="text", choices=["text", "json"])
+    p.add_argument("--format", default="text",
+                   choices=["text", "json", "sarif"])
     args = p.parse_args(argv)
     findings = lint_paths([os.path.join(REPO_ROOT, "geomesa_tpu")])
     if args.format == "json":
         print(render_json(findings))
+    elif args.format == "sarif":
+        print(render_sarif(findings))
     else:
         print(render_text(findings))
     return exit_code(findings, "warn")

@@ -1,0 +1,312 @@
+"""Runtime lockset harness tests: the Eraser-style detector catches an
+injected two-thread race and a lock-order inversion, stays quiet on the
+clean twins, runs the serve concurrency workload clean, and `gmtpu
+guard --races` exits nonzero on violations."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+
+from geomesa_tpu.analysis.locksets import (
+    note_access, trace_locks, tracked_lock)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_two(fn_a, fn_b):
+    ts = [threading.Thread(target=fn_a), threading.Thread(target=fn_b)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+class TestEraserLocksets:
+    def test_injected_race_two_threads_two_locks(self):
+        with trace_locks() as watch:
+            shared = {"n": 0}
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def writer(lock):
+                for _ in range(50):
+                    with lock:
+                        note_access("shared.n", write=True)
+                        shared["n"] += 1
+
+            run_two(lambda: writer(lock_a), lambda: writer(lock_b))
+            rep = watch.report()
+        assert len(rep["races"]) == 1
+        assert rep["races"][0]["key"] == "'shared.n'"
+        assert len(rep["races"][0]["threads"]) == 2
+        assert rep["violations"] >= 1
+
+    def test_clean_twin_shared_lock(self):
+        with trace_locks() as watch:
+            shared = {"n": 0}
+            lock = threading.Lock()
+
+            def writer():
+                for _ in range(50):
+                    with lock:
+                        note_access("shared.n", write=True)
+                        shared["n"] += 1
+
+            run_two(writer, writer)
+            rep = watch.report()
+        assert rep["races"] == []
+        assert shared["n"] == 100
+
+    def test_read_only_sharing_is_not_a_race(self):
+        with trace_locks() as watch:
+            def reader():
+                for _ in range(10):
+                    note_access("config", write=False)
+
+            run_two(reader, reader)
+            rep = watch.report()
+        assert rep["races"] == []
+
+    def test_single_thread_unlocked_is_not_a_race(self):
+        with trace_locks() as watch:
+            for _ in range(10):
+                note_access("local.state", write=True)
+            rep = watch.report()
+        assert rep["races"] == []
+
+
+class TestOrderInversions:
+    def test_inversion_detected(self):
+        with trace_locks() as watch:
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def ab():
+                with lock_a:
+                    with lock_b:
+                        pass
+
+            def ba():
+                with lock_b:
+                    with lock_a:
+                        pass
+
+            # sequential on purpose: the detector works from the order
+            # graph, no deadlock needs to actually happen
+            ab()
+            ba()
+            rep = watch.report()
+        assert len(rep["inversions"]) == 1
+        assert rep["violations"] == 1
+
+    def test_consistent_order_clean(self):
+        with trace_locks() as watch:
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+            for _ in range(3):
+                with lock_a:
+                    with lock_b:
+                        pass
+            rep = watch.report()
+        assert rep["inversions"] == []
+        assert rep["order_edges"] == 1
+
+    def test_reentrant_rlock_is_not_an_edge(self):
+        with trace_locks() as watch:
+            lk = threading.RLock()
+            with lk:
+                with lk:
+                    pass
+            rep = watch.report()
+        assert rep["order_edges"] == 0
+
+    def test_condition_on_lock_balances_through_wait(self):
+        with trace_locks() as watch:
+            lk = threading.Lock()
+            cond = threading.Condition(lk)
+            hits = []
+
+            def waiter():
+                with cond:
+                    cond.wait(timeout=2.0)
+                    hits.append(1)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cond:
+                cond.notify()
+            t.join()
+            rep = watch.report()
+        assert hits == [1]
+        assert rep["inversions"] == []
+
+    def test_tracked_lock_explicit_api(self):
+        lk = tracked_lock("fixture.lock")
+        with lk:
+            assert lk.name == "fixture.lock"
+
+
+class TestServeWorkloadClean:
+    def test_serve_concurrency_workload_has_no_inversions(self, tmp_path):
+        """The tests/test_serve_concurrency.py shape (mixed queries +
+        writer over one store through QueryService) with every serving
+        lock tracked: no lock-order inversions among geomesa_tpu locks
+        and no Eraser violations."""
+        from geomesa_tpu.core.columnar import FeatureBatch
+        from geomesa_tpu.core.sft import SimpleFeatureType
+
+        rng = np.random.default_rng(3)
+        n = 256
+        sft = SimpleFeatureType.from_spec(
+            "soak", "name:String,score:Double,dtg:Date,*geom:Point")
+
+        def batch(n, seed):
+            r = np.random.default_rng(seed)
+            return FeatureBatch.from_pydict(sft, {
+                "name": r.choice(["a", "b", "c"], n).tolist(),
+                "score": r.uniform(-10, 10, n),
+                "dtg": r.integers(1_590_000_000_000, 1_600_000_000_000, n),
+                "geom": np.stack([r.uniform(-170, 170, n),
+                                  r.uniform(-80, 80, n)], 1),
+            })
+
+        with trace_locks() as watch:
+            # construct INSIDE the trace so every serving lock (store
+            # manifest, stats manager, device cache, audit, scheduler,
+            # service state) is tracked
+            from geomesa_tpu.plan.datastore import DataStore
+            from geomesa_tpu.serve import QueryService, ServeConfig
+
+            ds = DataStore(str(tmp_path), use_device_cache=True)
+            src = ds.create_schema(sft)
+            src.write(batch(n, seed=4))
+            svc = QueryService(ds, ServeConfig(max_wait_ms=1.0))
+            errors = []
+            stop = threading.Event()
+
+            def querier(i):
+                r = np.random.default_rng(10 + i)
+                try:
+                    while not stop.is_set():
+                        if i % 2 == 0:
+                            svc.count(
+                                "soak", "BBOX(geom, -170, -80, 170, 80)"
+                            ).result(timeout=60)
+                        else:
+                            svc.knn("soak", "INCLUDE",
+                                    r.uniform(-50, 50, 1),
+                                    r.uniform(-50, 50, 1),
+                                    k=4).result(timeout=60)
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+
+            def writer():
+                try:
+                    for i in range(3):
+                        src.write(batch(10, seed=40 + i))
+                        time.sleep(0.01)
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+
+            qs = [threading.Thread(target=querier, args=(i,))
+                  for i in range(3)]
+            wt = threading.Thread(target=writer)
+            for t in qs:
+                t.start()
+            wt.start()
+            wt.join()
+            time.sleep(0.05)
+            stop.set()
+            for t in qs:
+                t.join()
+            svc.close(drain=True)
+            rep = watch.report(path_filter="geomesa_tpu")
+
+        assert not errors, errors
+        assert rep["locks_created"] > 0
+        assert rep["inversions"] == [], rep["inversions"]
+        assert rep["races"] == []
+
+
+class TestGuardRacesCLI:
+    def _run_guard(self, tmp_path, source, name):
+        script = tmp_path / name
+        script.write_text(textwrap.dedent(source))
+        return subprocess.run(
+            [sys.executable, "-m", "geomesa_tpu.cli", "guard",
+             "--races", str(script)],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+
+    def test_racy_script_exits_nonzero(self, tmp_path):
+        r = self._run_guard(tmp_path, """\
+            import threading
+
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def ab():
+                with lock_a:
+                    with lock_b:
+                        pass
+
+            def ba():
+                with lock_b:
+                    with lock_a:
+                        pass
+
+            ab()
+            ba()
+        """, "racy.py")
+        assert r.returncode == 1, r.stderr
+        assert "INVERSION" in r.stderr
+
+    def test_clean_script_exits_zero(self, tmp_path):
+        r = self._run_guard(tmp_path, """\
+            import threading
+
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def ab():
+                with lock_a:
+                    with lock_b:
+                        pass
+
+            ab()
+            ab()
+        """, "clean.py")
+        assert r.returncode == 0, r.stderr
+        assert "locksets:" in r.stderr
+        assert "0 inversion(s)" in r.stderr
+
+    def test_empty_lockset_access_reported(self, tmp_path):
+        r = self._run_guard(tmp_path, """\
+            import threading
+
+            from geomesa_tpu.analysis.locksets import note_access
+
+            shared = {"n": 0}
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def writer(lock):
+                for _ in range(20):
+                    with lock:
+                        note_access("shared.n", write=True)
+                        shared["n"] += 1
+
+            ts = [threading.Thread(target=writer, args=(lk,))
+                  for lk in (lock_a, lock_b)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        """, "eraser.py")
+        assert r.returncode == 1, r.stderr
+        assert "RACE" in r.stderr
