@@ -50,6 +50,7 @@ def log(msg):
 
 
 BUDGET_DEFAULT_S = 360.0
+_BUDGET_CREDIT_S = 0.0
 
 
 def budget_total_s():
@@ -61,24 +62,90 @@ def budget_remaining_s():
     """Seconds left of the internal wall-clock budget. Phases that are not
     needed for the headline line degrade (fewer repeats) or skip entirely
     when this runs low — a slow tunnel day must shrink the run, not kill
-    it silently (VERDICT r4 weak #1)."""
-    return budget_total_s() - (time.time() - START)
+    it silently (VERDICT r4 weak #1). Warm-compile-cache runs earn the
+    saved warmup time back as credit (credit_budget) instead of
+    forfeiting it to "extras trimmed (budget -0s left)"."""
+    return budget_total_s() - (time.time() - START) + _BUDGET_CREDIT_S
+
+
+def credit_budget(seconds, reason):
+    """Extend the extras budget by time a cache saved us (warm persistent
+    compile cache, warm prep cache). The credit is bounded by what a cold
+    run actually measured, so it can never invent time."""
+    global _BUDGET_CREDIT_S
+    if seconds > 0:
+        _BUDGET_CREDIT_S += seconds
+        log(f"budget credit +{seconds:.1f}s ({reason}); "
+            f"remaining {budget_remaining_s():.0f}s")
+
+
+_CACHE_PREPOPULATED = False  # did the persistent cache hold entries at start?
 
 
 def enable_compile_cache():
-    """Persistent XLA compilation cache shared across bench runs (and with
-    the driver's run). Verified working through the axon tunnel: a 2048^2
-    matmul compile drops 3.7 s -> 1.2 s; the Mosaic kernels are the ones
-    that cost 60-120 s cold."""
+    """Persistent XLA compilation cache shared across bench runs, the
+    driver's run, AND the serving/planner stack (the shared helper in
+    geomesa_tpu.compilecache — lifted out of this file in the zero-
+    recompile-serving round). Verified working through the axon tunnel:
+    a 2048^2 matmul compile drops 3.7 s -> 1.2 s; the Mosaic kernels are
+    the ones that cost 60-120 s cold. The bench keeps its repo-local
+    directory so cache artifacts travel with the checkout; the helper
+    adds a per-backend subdir, which also makes --smoke (forced-CPU)
+    runs safe alongside TPU artifacts."""
+    global _CACHE_PREPOPULATED
     try:
-        import jax
+        from geomesa_tpu.compilecache.persist import enable_persistent_cache
 
-        jax.config.update(
-            "jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        got = enable_persistent_cache(
+            os.path.join(_REPO, ".jax_cache"),
+            min_entry_bytes=-1, min_compile_secs=0.0, force=True)
+        if got is None:
+            log("compile cache disabled/unavailable")
+        else:
+            # warmth evidence for warm_compile_credit: only a run that
+            # STARTED with cached executables may claim saved-time credit
+            try:
+                _CACHE_PREPOPULATED = bool(os.listdir(got))
+            except OSError:
+                _CACHE_PREPOPULATED = False
     except Exception as e:  # cache is an optimization, never a failure
         log(f"compile cache unavailable: {e}")
+
+
+def warm_compile_credit(key, compile_t):
+    """Credit persistent-cache-saved warmup time back to the extras
+    budget (the "extras trimmed (budget -0s left)" starvation fix): a
+    run whose compile cache spared it N seconds of warmup has N more
+    seconds of real budget than the cold run the defaults assume.
+
+    Guards that keep the credit honest: (1) credit needs warmth
+    evidence — the cache dir held entries at startup
+    (_CACHE_PREPOPULATED); a fast run without it is variance, and only
+    RATCHETS the baseline down; (2) the baseline is the SMALLEST
+    observation for this key (first observation seeds it, even on a
+    warm run — a warm first baseline is small, keeping every later
+    credit conservative; a slow-tunnel day can never inflate it)."""
+    path = os.path.join(_REPO, ".bench_cache", f"warmmeta_{key}.json")
+    cold = None
+    try:
+        with open(path) as f:
+            cold = float(json.load(f)["cold_compile_s"])
+    except Exception:
+        pass
+    if cold is not None and compile_t < cold and _CACHE_PREPOPULATED:
+        credit_budget(cold - compile_t, "warm compile cache")
+        return  # warm run: never tightens the cold baseline
+    if cold is None or compile_t < cold:
+        # first observation for this key, or a cheaper cold run:
+        # record/tighten the baseline
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"cold_compile_s": round(compile_t, 3)}, f)
+            os.replace(tmp, path)
+        except Exception as e:
+            log(f"warm meta write failed: {e}")
 
 
 def cached_cpu_baseline(key: str, compute):
@@ -1672,11 +1739,10 @@ def main(argv=None) -> int:
         xb._backend_factories.pop("axon", None)
         jax.config.update("jax_platforms", "cpu")
 
-    if not args.smoke:
-        # the cache stores host-feature-tagged CPU AOT results too; smoke
-        # (forced-CPU) runs sharing the TPU run's dir trip XLA's machine-
-        # feature mismatch warnings, so only device runs use it
-        enable_compile_cache()
+    # per-backend cache subdirs (compilecache.persist) ended the old
+    # smoke-vs-device machine-feature mismatch: CPU smoke runs now cache
+    # safely alongside the TPU artifacts, so every mode enables it
+    enable_compile_cache()
     log(f"bench start: argv={argv if argv is not None else sys.argv[1:]}, "
         f"budget={budget_total_s():.0f}s")
 
@@ -1944,9 +2010,11 @@ def main(argv=None) -> int:
             args.impl, device_step
         )
     log("compiling + warming device pipeline")
+    _warm_s = time.perf_counter()
     count, dists = step(dx, dy, dt, dspeed, dqx, dqy)
     _sync(dists)  # compile + warm
-    log("device pipeline warm; timing")
+    warm_t = time.perf_counter() - _warm_s
+    log(f"device pipeline warm in {warm_t:.1f}s; timing")
     reps = 2 if args.smoke else (5 if budget_remaining_s() > 60 else 2)
     best = np.inf
     for _ in range(reps):
@@ -1955,6 +2023,17 @@ def main(argv=None) -> int:
         _sync(dists)
         best = min(best, time.perf_counter() - s)
     tpu_pps = n / best
+    # compile vs execute split for BENCH_r*.json (previously only the log
+    # tail saw the ~134s warmup): compile_time_s is the first-call wall
+    # minus one steady-state pass — the inline XLA cost a cold process
+    # pays and a warm persistent cache mostly eliminates
+    compile_t = max(warm_t - best, 0.0)
+    # baseline key includes the platform: a CPU --smoke interpret
+    # compile (~2s) and a TPU Mosaic compile (~120s) must never share
+    # (or overwrite) one cold baseline
+    warm_compile_credit(
+        f"c3_{jax.devices()[0].platform}_{args.impl}_n{n}_q{q}_k{k}",
+        compile_t)
     log(f"device best-of-{reps}: {best:.4f}s ({tpu_pps / 1e6:.0f}M pts/s)")
 
     # --- f64-exact match count (VERDICT r3 #5), host-side (round 5) --------
@@ -2122,6 +2201,8 @@ def main(argv=None) -> int:
         "order": args.order,
         "device": jax.devices()[0].platform,
         "device_time_s": round(best, 5),
+        "compile_time_s": round(compile_t, 4),
+        "execute_time_s": round(best, 5),
         "cpu_time_s": round(cpu_time, 5),
         "cpu_points_per_sec": round(cpu_pps, 1),
         "cpu32_points_per_sec": round(cpu32_pps, 1),

@@ -49,6 +49,9 @@ RULES: Dict[str, Rule] = {
         Rule("GT12", "shared mutable state (mutable default, module "
                      "global, lock-free class field) mutated from "
                      "thread-reachable code without a guard"),
+        Rule("GT13", "serve/plan hot-path jax.jit site bypasses the "
+                     "compilecache ExecutableRegistry (invisible to "
+                     "warmup manifests; compiles inline under traffic)"),
     )
 }
 

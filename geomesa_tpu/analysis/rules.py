@@ -512,11 +512,56 @@ def _has_valid_token(node: ast.AST) -> bool:
     return False
 
 
+# GT13 scope: the serving and planning layers — the paths a live request
+# rides. Kernel modules (engine/) define their jits once at module level
+# where the ExecutableRegistry's default sweep and the warmup manifests
+# see them; a jax.jit created inside serve/ or plan/ is invisible to
+# both, so its compile happens inline under traffic.
+_GT13_PREFIXES = ("geomesa_tpu/serve/", "geomesa_tpu/plan/")
+
+
+def gt13(mod: ModInfo, project) -> Iterator[Finding]:
+    """GT13: jax.jit call sites on the serve/plan hot path.
+
+    Flags every `jax.jit` use (decorator, `functools.partial(jax.jit,
+    ...)` decorator, or direct call) in modules under the serve/plan
+    prefixes. Precision: the rule is path-scoped, so engine kernels and
+    the compilecache's own registry wrapper never fire; deliberate
+    sites waive inline (`# gt: waive GT13`) like every other rule."""
+    path = mod.relpath.replace("\\", "/")
+    if not any(p in path for p in _GT13_PREFIXES):
+        return
+    seen: Set[int] = set()
+    for node in ast.walk(mod.tree):
+        hit = None
+        if isinstance(node, ast.Call) and mod.is_jit_ref(node.func):
+            hit = node
+        elif (isinstance(node, ast.Call) and mod.is_partial_ref(node.func)
+              and node.args and mod.is_jit_ref(node.args[0])):
+            hit = node
+        elif isinstance(node, ast.Attribute) and mod.is_jit_ref(node):
+            hit = node  # @jax.jit decorator / bare jax.jit reference
+        elif (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+              and mod.is_jit_ref(node)):
+            hit = node  # @jit decorator via a from-import alias
+        if hit is None or hit.lineno in seen:
+            continue
+        seen.add(hit.lineno)
+        yield _finding(
+            "GT13", mod, hit,
+            "jax.jit on the serve/plan hot path bypasses the "
+            "compilecache ExecutableRegistry: warmup manifests cannot "
+            "pre-compile it, so it stalls a live request. Define the "
+            "kernel in engine/ (the registry's default sweep) or "
+            "register it explicitly; waive deliberate sites.")
+
+
 from geomesa_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES)
 
 ALL_RULES = {
     "GT01": gt01, "GT02": gt02, "GT03": gt03,
     "GT04": gt04, "GT05": gt05, "GT06": gt06,
+    "GT13": gt13,
     **CONCURRENCY_RULES,
 }

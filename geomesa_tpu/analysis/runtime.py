@@ -19,15 +19,17 @@ from __future__ import annotations
 import contextlib
 import functools
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+# the canonical module list + jit predicate + sweep live in
+# compilecache.kernels (stdlib-only import) so this tracker, the
+# ExecutableRegistry default sweep and warmup check() can never drift
+# apart about what the hot-kernel universe IS
+from geomesa_tpu.compilecache.kernels import (  # noqa: F401 (re-export)
+    ENGINE_MODULES as _ENGINE_MODULES, is_jitted, iter_jitted)
+
 TRANSFER_MODES = ("allow", "log", "disallow")
-
-
-def is_jitted(obj) -> bool:
-    """A jax.jit product exposes a per-callable compile-cache size; that
-    is also exactly the hook the recompile counter needs."""
-    return callable(obj) and hasattr(obj, "_cache_size")
 
 
 class JitTracker:
@@ -39,22 +41,39 @@ class JitTracker:
     queryable via `report()`. `warn_after` (per callable) invokes
     `on_storm` once when a callable exceeds it — the runtime analog of
     lint rule GT01.
+
+    Warmup plumbing (docs/SERVING.md "Cold start"): a compiling call's
+    wall time is noted into the process-wide compile-stall meter
+    (`compilecache.stall.STALLS`, feeding ServeEvent attribution and the
+    `compile.stall` histogram), and when a `recorder`
+    (`compilecache.manifest.WarmupRecorder`) is attached, the observed
+    (kernel, shapes, dtypes, static-args) tuple is recorded into the
+    warmup manifest — the tuples `gmtpu warmup` later replays.
     """
 
     def __init__(self, registry=None, warn_after: Optional[int] = None,
-                 on_storm: Optional[Callable[[str, int], None]] = None):
+                 on_storm: Optional[Callable[[str, int], None]] = None,
+                 recorder=None):
         if registry is None:
             from geomesa_tpu.utils.metrics import metrics as registry
         self.registry = registry
         self.warn_after = warn_after
         self.on_storm = on_storm
+        self.recorder = recorder  # read per call: attachable post-install
         self._lock = threading.Lock()
         self.recompiles: Dict[str, int] = {}
         self.calls: Dict[str, int] = {}
         self._warned: set = set()
         self._installed: List[tuple] = []  # (module, attr, original)
 
-    def wrap(self, fn, name: Optional[str] = None):
+    def total_recompiles(self) -> int:
+        with self._lock:
+            return sum(self.recompiles.values())
+
+    def wrap(self, fn, name: Optional[str] = None,
+             origin: Optional[Tuple[str, str]] = None):
+        """`origin` is the (full module name, attr) pair install() saw —
+        the manifest needs the importable path, not just the label."""
         if not is_jitted(fn):
             raise TypeError(
                 f"JitTracker.wrap expects a jax.jit callable, got {fn!r}")
@@ -63,7 +82,9 @@ class JitTracker:
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             before = fn._cache_size()
+            t0 = time.perf_counter()
             out = fn(*args, **kwargs)
+            elapsed = time.perf_counter() - t0
             grew = fn._cache_size() - before
             storm_count = 0
             with self._lock:
@@ -84,11 +105,28 @@ class JitTracker:
                         storm = None
                 else:
                     storm = None
+                recorder = self.recorder if grew > 0 else None
+            if grew > 0:
+                # the elapsed wall of a compiling call IS the inline
+                # stall a request saw (trace + compile + one execute)
+                try:
+                    from geomesa_tpu.compilecache.stall import STALLS
+
+                    STALLS.note(label, elapsed)
+                except Exception:
+                    pass
+            if recorder is not None and origin is not None:
+                try:
+                    recorder.record_kernel(
+                        origin[0], origin[1], args, kwargs, elapsed)
+                except Exception:
+                    pass  # recording must never break the live call
             if storm is not None:
                 storm(label, storm_count)
             return out
 
         wrapper._gt_tracked = fn  # type: ignore[attr-defined]
+        wrapper._gt_tracker = self  # type: ignore[attr-defined]
         return wrapper
 
     # -- in-place module instrumentation ----------------------------------
@@ -102,7 +140,8 @@ class JitTracker:
             if not is_jitted(obj) or hasattr(obj, "_gt_tracked"):
                 continue
             label = f"{module.__name__.rsplit('.', 1)[-1]}.{attr}"
-            setattr(module, attr, self.wrap(obj, name=label))
+            setattr(module, attr, self.wrap(
+                obj, name=label, origin=(module.__name__, attr)))
             with self._lock:
                 self._installed.append((module, attr, obj))
             wrapped += 1
@@ -114,6 +153,10 @@ class JitTracker:
         for module, attr, original in reversed(installed):
             setattr(module, attr, original)
 
+    def is_installed(self) -> bool:
+        with self._lock:
+            return bool(self._installed)
+
     def report(self) -> Dict[str, dict]:
         with self._lock:
             return {
@@ -123,38 +166,146 @@ class JitTracker:
             }
 
 
-_ENGINE_MODULES = (
-    "geomesa_tpu.engine.bin",
-    "geomesa_tpu.engine.density",
-    "geomesa_tpu.engine.density_zsparse",
-    "geomesa_tpu.engine.grid_index",
-    "geomesa_tpu.engine.knn",
-    "geomesa_tpu.engine.knn_scan",
-    "geomesa_tpu.engine.pip_pallas",
-    "geomesa_tpu.engine.pip_sparse",
-    "geomesa_tpu.engine.raster",
-    "geomesa_tpu.engine.stats",
-    "geomesa_tpu.engine.tube",
-)
-
-
 def guard_engine(registry=None, warn_after: Optional[int] = None,
                  on_storm: Optional[Callable[[str, int], None]] = None,
-                 modules=None) -> JitTracker:
+                 modules=None, recorder=None) -> JitTracker:
     """Wrap every jitted callable across the engine modules with one
     shared tracker (the engine's jit caches, guarded). Call `.unwrap()`
-    to restore."""
+    to restore. `recorder` (a WarmupRecorder) additionally records every
+    compiling signature into a warmup manifest. A tracker that actually
+    wrapped something claims the process-wide active slot (see
+    acquire_engine_tracker), so later sharers can find it."""
     import importlib
 
+    global _active_tracker, _active_refs, _active_owned
     tracker = JitTracker(registry=registry, warn_after=warn_after,
-                         on_storm=on_storm)
+                         on_storm=on_storm, recorder=recorder)
+    with _active_lock:
+        for modname in modules or _ENGINE_MODULES:
+            try:
+                mod = importlib.import_module(modname)
+            except ImportError:
+                continue
+            tracker.install(mod)
+        if tracker.is_installed():
+            # direct callers (gmtpu guard) own their wrappers and unwrap
+            # them themselves; acquirers sharing this epoch refcount
+            # from zero and never unwrap it (see release_engine_tracker)
+            _active_tracker = tracker
+            _active_refs = 0
+            _active_owned = False
+    return tracker
+
+
+# The engine jits are MODULE GLOBALS, so only one tracker's wrappers can
+# be installed at a time — a second guard_engine() finds everything
+# already wrapped and silently counts nothing. Long-lived consumers
+# (QueryService) therefore acquire the process-wide tracker instead of
+# installing their own. Acquisition is REFCOUNTED: every acquire pairs
+# with a release, and the wrappers come off only when the last acquirer
+# releases — closing the first of two live services must not disable
+# tracking for the survivor. The RLock makes check-then-install atomic
+# (guard_engine re-enters it), and _find_installed_tracker recovers a
+# tracker installed OUTSIDE this protocol (e.g. `gmtpu guard` calling
+# guard_engine directly) via the back-pointer every wrapper carries —
+# such adopted trackers are shared but never unwrapped by release (their
+# installer owns the wrappers).
+_active_lock = threading.RLock()
+_active_tracker: Optional[JitTracker] = None
+_active_refs = 0
+_active_owned = False  # True iff acquire's own install put the wrappers on
+
+
+def _find_installed_tracker(modules=None) -> Optional[JitTracker]:
+    """The tracker whose wrappers currently sit on the engine modules
+    (every wrapper back-points to its tracker), or None."""
+    import importlib
+
     for modname in modules or _ENGINE_MODULES:
         try:
             mod = importlib.import_module(modname)
         except ImportError:
             continue
-        tracker.install(mod)
-    return tracker
+        for attr in sorted(vars(mod)):
+            tracker = getattr(getattr(mod, attr, None), "_gt_tracker", None)
+            if tracker is not None:
+                return tracker
+    return None
+
+
+def acquire_engine_tracker(recorder=None, **kwargs
+                           ) -> "Tuple[JitTracker, bool]":
+    """Returns (tracker, installed_by_me). EVERY acquire must be paired
+    with release_engine_tracker(tracker); the wrappers come off when the
+    last acquirer releases (and only if an acquire installed them)."""
+    global _active_tracker, _active_refs, _active_owned
+    with _active_lock:
+        active = _active_tracker
+        if active is not None and active.is_installed():
+            if recorder is not None:
+                active.recorder = recorder
+            _active_refs += 1
+            return active, False
+        tracker = guard_engine(recorder=recorder, **kwargs)
+        if tracker.is_installed():
+            # guard_engine claimed the slot; this epoch is acquire-owned
+            _active_refs = 1
+            _active_owned = True
+            return tracker, True
+        # nothing wrapped: either a foreign tracker already owns the
+        # modules (adopt + share it, never count-nothing silently) or no
+        # engine module is importable (degenerate; the tracker is inert)
+        foreign = _find_installed_tracker(kwargs.get("modules"))
+        if foreign is not None:
+            if recorder is not None:
+                foreign.recorder = recorder
+            # publish so later acquires skip the install + module scan
+            _active_tracker = foreign
+            _active_refs += 1
+            _active_owned = False  # its installer unwraps it, not us
+            return foreign, False
+        return tracker, True
+
+
+def release_engine_tracker(tracker: JitTracker) -> None:
+    """Counterpart to acquire: drop one reference; the LAST release of
+    an acquire-installed epoch restores the bare engine jits (adopted
+    foreign trackers are left for their installer to unwrap). The
+    tracker object and its counters remain readable. Unwrap happens
+    UNDER the slot lock: restoring module attrs while a concurrent
+    acquire installs a fresh tracker would interleave the two setattr
+    sweeps and leave some kernels untracked. Lock order is always
+    _active_lock -> tracker._lock (install/unwrap take the tracker
+    lock; JitTracker never takes the slot lock)."""
+    global _active_tracker, _active_refs, _active_owned
+    with _active_lock:
+        if tracker is not _active_tracker:
+            # stale epoch (the slot moved on): restoring is this
+            # tracker's own business; unwrap is a no-op if already bare
+            tracker.unwrap()
+            return
+        _active_refs = max(_active_refs - 1, 0)
+        if _active_refs == 0:
+            if _active_owned:
+                tracker.unwrap()
+            _active_tracker = None
+            _active_owned = False
+
+
+def clear_engine_jit_caches(modules=None) -> int:
+    """Drop every engine jit's dispatch cache (unwrapping any tracker
+    wrapper). Returns how many caches were cleared — 0 when this jax
+    version has no `clear_cache` on jit products. Used by the warmup
+    regression tests to simulate a fresh process without spawning one."""
+    cleared = 0
+    for _mod, _tail, _attr, obj in iter_jitted(modules):
+        if hasattr(obj, "clear_cache"):
+            try:
+                obj.clear_cache()
+                cleared += 1
+            except Exception:
+                pass
+    return cleared
 
 
 @contextlib.contextmanager

@@ -162,7 +162,29 @@ def register(sub: "argparse._SubParsersAction") -> None:
                               "HBM-resident partitions")
     serve_p.add_argument("--metrics", action="store_true",
                          help="print Prometheus metrics to stderr on exit")
+    serve_p.add_argument("--warmup", default=None, metavar="MANIFEST",
+                         help="warmup manifest to replay before accepting "
+                              "traffic (docs/SERVING.md cold start)")
+    serve_p.add_argument("--track-compiles", action="store_true",
+                         help="count engine recompiles and attribute "
+                              "inline compile stalls in ServeEvents")
     serve_p.set_defaults(func=_serve)
+
+    warm_p = sub.add_parser(
+        "warmup", help="replay a warmup manifest: pre-compile every "
+                       "recorded kernel/query shape (and persist the "
+                       "executables) before serving")
+    warm_p.add_argument("--manifest", "-m", required=True,
+                        help="warmup manifest JSON "
+                             "(recorded via QueryService.record_warmup)")
+    warm_p.add_argument("--catalog", "-c", default=None,
+                        help="catalog directory for query entries "
+                             "(kernel entries replay without one)")
+    warm_p.add_argument("--check", action="store_true",
+                        help="after replaying, prove a second pass "
+                             "compiles NOTHING; exit nonzero if serving "
+                             "would still compile anything")
+    warm_p.set_defaults(func=_warmup)
 
     bserve_p = sub.add_parser(
         "bench-serve", help="serving load generator: open/closed-loop "
@@ -243,6 +265,8 @@ def _serve(args) -> int:
         default_timeout_ms=args.timeout_ms,
         tenant_rate=args.tenant_rate,
         degrade=args.degrade,
+        warmup_manifest=getattr(args, "warmup", None),
+        track_compiles=getattr(args, "track_compiles", False),
     )
     def write_line(s: str) -> None:
         # flush per response: with stdout piped (the normal programmatic
@@ -352,6 +376,33 @@ def _bench_serve(args) -> int:
                     if serial.p99_ms else None,
                 }))
     return 0
+
+
+def _warmup(args) -> int:
+    from geomesa_tpu.compilecache import warmup as _w
+    from geomesa_tpu.compilecache.manifest import WarmupManifest
+    from geomesa_tpu.compilecache.persist import enable_persistent_cache
+
+    enable_persistent_cache()
+    manifest = WarmupManifest.load(args.manifest)
+    store = None
+    if args.catalog:
+        from geomesa_tpu.plan import DataStore
+
+        store = DataStore(args.catalog, use_device_cache=True)
+    run = _w.check if args.check else _w.replay
+    report = run(manifest, store=store)
+    for msg in report.errors:
+        print(f"warmup: {msg}", file=sys.stderr)
+    print(json.dumps(report.to_json()))
+    if args.check and report.queries_skipped:
+        # skipped entries mean the check proved nothing about them: a
+        # green exit here would read as "serving compiles nothing" when
+        # the query paths were never replayed
+        print("warmup --check: query entries present but no --catalog "
+              "given; cannot verify the serving path", file=sys.stderr)
+        return 1
+    return 0 if report.ok else 1
 
 
 def _lint(args) -> int:

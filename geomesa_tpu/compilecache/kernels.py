@@ -1,0 +1,56 @@
+"""The canonical hot-kernel universe: which modules, what counts as a jit.
+
+One list and one sweep, shared by every consumer — the JitTracker
+recompile counters (`analysis/runtime.py`), the ExecutableRegistry
+default sweep, and the warmup `check()` cache-size ground truth. They
+MUST agree: a module present in one sweep but not another lets warmup
+manifests record kernels that `gmtpu warmup --check` never verifies,
+silently voiding the zero-recompile contract. Pure stdlib — importing
+this module never imports jax.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence, Tuple
+
+ENGINE_MODULES: Tuple[str, ...] = (
+    "geomesa_tpu.engine.bin",
+    "geomesa_tpu.engine.density",
+    "geomesa_tpu.engine.density_zsparse",
+    "geomesa_tpu.engine.grid_index",
+    "geomesa_tpu.engine.knn",
+    "geomesa_tpu.engine.knn_scan",
+    "geomesa_tpu.engine.pip_pallas",
+    "geomesa_tpu.engine.pip_sparse",
+    "geomesa_tpu.engine.raster",
+    "geomesa_tpu.engine.stats",
+    "geomesa_tpu.engine.tube",
+)
+
+
+def is_jitted(obj) -> bool:
+    """A jax.jit product exposes a per-callable compile-cache size; that
+    is also exactly the hook the recompile counter needs."""
+    return callable(obj) and hasattr(obj, "_cache_size")
+
+
+def iter_jitted(
+    modules: Optional[Sequence[str]] = None,
+) -> Iterator[Tuple[object, str, str, object]]:
+    """Yield (module, module_tail, attr, jit_product) for every
+    module-level jitted callable across the engine modules, unwrapping
+    any JitTracker wrapper back to the underlying jit product. Label
+    convention everywhere: ``f"{module_tail}.{attr}"``."""
+    import importlib
+
+    for modname in modules or ENGINE_MODULES:
+        try:
+            mod = importlib.import_module(modname)
+        except ImportError:
+            continue
+        tail = modname.rsplit(".", 1)[-1]
+        for attr in sorted(vars(mod)):
+            obj = getattr(mod, attr, None)
+            obj = getattr(obj, "_gt_tracked", obj)
+            if is_jitted(obj):
+                yield mod, tail, attr, obj

@@ -1,0 +1,233 @@
+"""Warmup manifests: record what compiled, replay it before traffic.
+
+A manifest is the serialized answer to "what would this serving process
+compile inline?": the (kernel, shape bucket, dtype, static-args) tuples
+JitTracker observed compiling, plus the (kind, type, CQL, batch-bucket)
+query shapes the serve layer dispatched. `gmtpu warmup` and the
+`QueryService` startup hook replay it (compilecache/warmup.py) so every
+executable is built — and persisted via the compilation cache — before
+the first real request arrives.
+
+Format (JSON, versioned):
+
+    {"version": 1, "entries": [
+      {"kind": "kernel", "module": "geomesa_tpu.engine.knn_scan",
+       "attr": "knn_sparse_scan",
+       "args": [{"shape": [8], "dtype": "float32"}, ...],
+       "kwargs": {"k": {"static": 8},
+                  "tile_capacity": {"static": 64},
+                  "interpret": {"static": true}},
+       "count": 3, "compile_s": 1.72},
+      {"kind": "query", "op": "knn", "type_name": "gdelt",
+       "cql": "BBOX(geom, -60, 20, 60, 70)", "q": 8, "k": 8,
+       "impl": "sparse", "count": 12}
+    ]}
+
+Array arguments are recorded as shape+dtype only (replayed as zeros —
+compilation depends on the abstract signature, never the values);
+static arguments are recorded literally. Anything unencodable (pytrees,
+closures) skips the entry and bumps `skipped` rather than failing the
+live call that was being recorded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple, Union
+
+MANIFEST_VERSION = 1
+
+
+class UnrecordableArg(TypeError):
+    pass
+
+
+def encode_arg(v) -> dict:
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        return {"shape": [int(s) for s in v.shape], "dtype": str(v.dtype)}
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return {"static": v}
+    raise UnrecordableArg(f"cannot record argument of type {type(v)!r}")
+
+
+def decode_arg(d: dict):
+    if "shape" in d:
+        import jax.numpy as jnp
+
+        return jnp.zeros(tuple(d["shape"]), jnp.dtype(d["dtype"]))
+    return d["static"]
+
+
+@dataclasses.dataclass
+class KernelEntry:
+    module: str
+    attr: str
+    args: List[dict]
+    kwargs: Dict[str, dict]
+    count: int = 1
+    compile_s: float = 0.0
+
+    @property
+    def label(self) -> str:
+        return f"{self.module.rsplit('.', 1)[-1]}.{self.attr}"
+
+    def key(self) -> tuple:
+        return ("kernel", self.module, self.attr,
+                json.dumps(self.args, sort_keys=True),
+                json.dumps(self.kwargs, sort_keys=True))
+
+    def to_json(self) -> dict:
+        return {"kind": "kernel", **dataclasses.asdict(self)}
+
+
+@dataclasses.dataclass
+class QueryEntry:
+    op: str  # count | execute | knn
+    type_name: str
+    cql: str
+    q: int = 0         # padded stacked-query bucket (knn only)
+    k: int = 0         # knn only
+    impl: str = ""     # knn only
+    count: int = 1
+
+    @property
+    def label(self) -> str:
+        return f"query:{self.op}:{self.type_name}"
+
+    def key(self) -> tuple:
+        return ("query", self.op, self.type_name, self.cql,
+                self.q, self.k, self.impl)
+
+    def to_json(self) -> dict:
+        return {"kind": "query", **dataclasses.asdict(self)}
+
+
+Entry = Union[KernelEntry, QueryEntry]
+
+
+class WarmupManifest:
+    def __init__(self, entries: Optional[List[Entry]] = None):
+        self.entries: List[Entry] = list(entries or ())
+
+    @property
+    def kernel_entries(self) -> List[KernelEntry]:
+        return [e for e in self.entries if isinstance(e, KernelEntry)]
+
+    @property
+    def query_entries(self) -> List[QueryEntry]:
+        return [e for e in self.entries if isinstance(e, QueryEntry)]
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def to_json(self) -> dict:
+        return {"version": MANIFEST_VERSION,
+                "entries": [e.to_json() for e in self.entries]}
+
+    def save(self, path: str) -> None:
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.to_json(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)  # atomic: a reader never sees a torn file
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "WarmupManifest":
+        version = doc.get("version")
+        if version != MANIFEST_VERSION:
+            raise ValueError(
+                f"unsupported warmup manifest version {version!r} "
+                f"(this build reads version {MANIFEST_VERSION})")
+        entries: List[Entry] = []
+        for raw in doc.get("entries", []):
+            kind = raw.get("kind")
+            body = {k: v for k, v in raw.items() if k != "kind"}
+            if kind == "kernel":
+                entries.append(KernelEntry(**body))
+            elif kind == "query":
+                entries.append(QueryEntry(**body))
+            else:
+                raise ValueError(f"unknown manifest entry kind {kind!r}")
+        return cls(entries)
+
+    @classmethod
+    def load(cls, path: str) -> "WarmupManifest":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_json(json.load(f))
+
+
+# distinct-entry cap for a live recorder: high-cardinality CQL (per-
+# request literals) must bound memory like AuditWriter's event buffer
+# does — new keys past the cap count as skipped, existing keys still
+# bump their counts
+MAX_RECORDED_ENTRIES = 4096
+
+
+class WarmupRecorder:
+    """Accumulates deduplicated manifest entries from live traffic.
+
+    Attached to a `JitTracker` (kernel tuples: called on every dispatch-
+    cache growth) and to `QueryService._dispatch` (query shapes). Both
+    callers are hot paths, so recording failures are counted, never
+    raised, and the entry map is bounded (`max_entries`): a recorder
+    left attached under unique-filter traffic must not grow without
+    bound.
+    """
+
+    def __init__(self, max_entries: int = MAX_RECORDED_ENTRIES):
+        self._lock = threading.Lock()
+        self._entries: Dict[tuple, Entry] = {}
+        self.max_entries = max_entries
+        self.skipped = 0
+
+    def _put(self, entry: Entry) -> None:
+        """Dedup-or-insert under the cap (callers hold no lock)."""
+        with self._lock:
+            have = self._entries.get(entry.key())
+            if have is not None:
+                have.count += 1
+                if isinstance(have, KernelEntry):
+                    have.compile_s = max(have.compile_s, entry.compile_s)
+            elif len(self._entries) < self.max_entries:
+                self._entries[entry.key()] = entry
+            else:
+                self.skipped += 1
+
+    def record_kernel(self, module: str, attr: str, args, kwargs,
+                      seconds: float = 0.0) -> None:
+        try:
+            entry = KernelEntry(
+                module=module, attr=attr,
+                args=[encode_arg(a) for a in args],
+                kwargs={k: encode_arg(v) for k, v in kwargs.items()},
+                compile_s=float(seconds),
+            )
+        except UnrecordableArg:
+            with self._lock:
+                self.skipped += 1
+            return
+        self._put(entry)
+
+    def record_query(self, op: str, type_name: str, cql: str,
+                     q: int = 0, k: int = 0, impl: str = "") -> None:
+        self._put(QueryEntry(op=op, type_name=type_name, cql=cql,
+                             q=int(q), k=int(k), impl=impl))
+
+    def manifest(self) -> WarmupManifest:
+        with self._lock:
+            return WarmupManifest(list(self._entries.values()))
+
+
+def sig_key(args: Tuple, kwargs: Dict) -> tuple:
+    """Hashable signature key over encoded args — shared by the
+    ExecutableRegistry's AOT cache and the manifest dedup so the two
+    layers bucket identically."""
+    return (
+        tuple(json.dumps(encode_arg(a), sort_keys=True) for a in args),
+        tuple(sorted(
+            (k, json.dumps(encode_arg(v), sort_keys=True))
+            for k, v in kwargs.items())),
+    )

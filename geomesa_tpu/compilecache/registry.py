@@ -1,0 +1,228 @@
+"""ExecutableRegistry: ahead-of-time compiled kernels, keyed by bucket.
+
+The planner's shape discipline (`pad_to(next_pow2(...))`, pow2 capacity
+buckets, pow2 stacked-query axes from the serve batcher) means the hot
+kernels see a SMALL, enumerable set of abstract signatures. The registry
+makes each one a managed resource: `jit(...).lower(abstract).compile()`
+per (kernel, shape bucket, dtype, static-args) key, with the compiled
+executable cached in-process and — through the persistent compilation
+cache (persist.py) — on disk across restarts.
+
+Two uses:
+
+1. Warmup (compilecache/warmup.py): AOT-compile every manifest entry
+   before traffic. The AOT compile seeds the persistent cache, so the
+   live jit wrapper's first dispatch pays a trace + disk hit, not an
+   XLA compile. (The live wrappers keep their own dispatch caches — the
+   warmup replay also heats those with a real call; see warmup.py.)
+
+2. Direct execution: `handle = registry.compile(name, *sig)` then
+   `handle.call(*arrays)` runs the AOT executable, optionally with
+   buffer donation. Donation is OPT-IN per registration: the default
+   engine sweep donates nothing, because the engine's documented
+   overflow fallbacks (`knn_sparse_auto` re-running `knn_fullscan` on
+   the same mask/query buffers) reuse caller buffers after the call —
+   donating there would hand XLA freed HBM the fallback still reads.
+   Pipelines that own their buffers register donating variants
+   explicitly.
+
+Hit/miss counters and AOT compile-time histograms land in
+`utils/metrics` (`compilecache.aot.*`, histogram `compile.aot`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+from geomesa_tpu.compilecache.kernels import (
+    ENGINE_MODULES as DEFAULT_MODULES, is_jitted as _is_jitted,
+    iter_jitted)
+from geomesa_tpu.compilecache.manifest import KernelEntry, sig_key
+
+
+def _abstract(v):
+    """Concrete arrays become ShapeDtypeStructs (lowering needs only the
+    aval — never force an upload); statics pass through."""
+    import jax
+
+    if isinstance(v, jax.ShapeDtypeStruct):
+        return v
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+    return v
+
+
+class CompiledHandle:
+    """One AOT-compiled executable plus its provenance."""
+
+    def __init__(self, name: str, lowered, compiled, compile_s: float):
+        self.name = name
+        self.lowered = lowered
+        self.compiled = compiled
+        self.compile_s = compile_s
+
+    def call(self, *args, **kwargs):
+        """Execute the AOT executable. Per the jax AOT contract the
+        compiled object takes only the non-static arguments (statics
+        were baked in at lowering time)."""
+        return self.compiled(*args, **kwargs)
+
+    def memory_analysis(self):
+        try:
+            return self.compiled.memory_analysis()
+        except Exception:
+            return None
+
+    def cost_analysis(self):
+        try:
+            return self.compiled.cost_analysis()
+        except Exception:
+            return None
+
+
+class _RegisteredKernel:
+    def __init__(self, name: str, fn, static_argnames: Sequence[str] = (),
+                 donate_argnums: Sequence[int] = ()):
+        self.name = name
+        self.static_argnames = tuple(static_argnames)
+        self.donate_argnums = tuple(donate_argnums)
+        if _is_jitted(fn) and not donate_argnums:
+            # already a jit product: lower it directly so the AOT HLO is
+            # byte-identical to what the live wrapper traces (same
+            # persistent-cache key)
+            self.jitted = fn
+        else:
+            import jax
+
+            raw = getattr(fn, "__wrapped__", fn)
+            self.jitted = jax.jit(
+                raw,
+                static_argnames=self.static_argnames or None,
+                donate_argnums=self.donate_argnums or (),
+            )
+
+
+class ExecutableRegistry:
+    """Thread-safe get-or-compile cache of AOT executables."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._kernels: Dict[str, _RegisteredKernel] = {}
+        self._compiled: Dict[tuple, CompiledHandle] = {}
+        self.hits = 0
+        self.misses = 0
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, name: str, fn, static_argnames: Sequence[str] = (),
+                 donate_argnums: Sequence[int] = ()) -> None:
+        kernel = _RegisteredKernel(name, fn, static_argnames,
+                                   donate_argnums)
+        with self._lock:
+            self._kernels[name] = kernel
+
+    def install_defaults(self, modules: Optional[Sequence[str]] = None
+                         ) -> int:
+        """Register every module-level jitted callable across the engine
+        (the hot-kernel sweep: `knn_sparse_*`, `pip_layer*`'s jitted
+        internals, `density*`, tube/raster/stats). Names follow the
+        JitTracker label convention `<module_tail>.<attr>` so warmup
+        manifests and recompile counters key compatibly. Returns how
+        many kernels are registered."""
+        n = 0
+        for _mod, tail, attr, obj in iter_jitted(modules):
+            self.register(f"{tail}.{attr}", obj)
+            n += 1
+        return n
+
+    def names(self):
+        with self._lock:
+            return sorted(self._kernels)
+
+    # -- compilation -------------------------------------------------------
+
+    def compile(self, name: str, *args, **kwargs) -> CompiledHandle:
+        """Get-or-AOT-compile `name` for the given abstract signature.
+        Array arguments may be concrete arrays or ShapeDtypeStructs;
+        static arguments are concrete values. Raises KeyError for an
+        unregistered kernel."""
+        with self._lock:
+            kernel = self._kernels.get(name)
+            have = len(self._kernels)
+        if kernel is None:
+            raise KeyError(
+                f"kernel {name!r} is not registered "
+                f"(have {have}; see install_defaults())")
+        key = (name,) + sig_key(tuple(map(_abstract, args)),
+                                {k: _abstract(v) for k, v in kwargs.items()})
+        with self._lock:
+            got = self._compiled.get(key)
+            if got is not None:
+                self.hits += 1
+        from geomesa_tpu.utils.metrics import metrics
+
+        if got is not None:
+            metrics.counter("compilecache.aot.hit")
+            return got
+        # compile OUTSIDE the lock (same discipline as the planner's
+        # compiled-filter cache): an AOT compile can take seconds and
+        # must not serialize unrelated lookups. Two racing compiles of
+        # the same key keep a single winner via setdefault.
+        t0 = time.perf_counter()
+        lowered = kernel.jitted.lower(
+            *[_abstract(a) for a in args],
+            **{k: _abstract(v) for k, v in kwargs.items()})
+        compiled = lowered.compile()
+        dt = time.perf_counter() - t0
+        handle = CompiledHandle(name, lowered, compiled, dt)
+        metrics.counter("compilecache.aot.miss")
+        metrics.histogram("compile.aot").update(dt)
+        with self._lock:
+            self.misses += 1
+            return self._compiled.setdefault(key, handle)
+
+    def compile_entry(self, entry: KernelEntry) -> CompiledHandle:
+        """AOT-compile a warmup-manifest kernel entry. The kernel is
+        registered on demand from the entry's module/attr if the sweep
+        has not seen it."""
+        import importlib
+        import jax
+
+        name = entry.label
+        with self._lock:
+            missing = name not in self._kernels
+        if missing:
+            mod = importlib.import_module(entry.module)
+            obj = getattr(mod, entry.attr)
+            obj = getattr(obj, "_gt_tracked", obj)
+            self.register(name, obj)
+
+        def arg(d):
+            if "shape" in d:
+                return jax.ShapeDtypeStruct(
+                    tuple(d["shape"]), jax.numpy.dtype(d["dtype"]))
+            return d["static"]
+
+        return self.compile(
+            name, *[arg(a) for a in entry.args],
+            **{k: arg(v) for k, v in entry.kwargs.items()})
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            total_s = sum(h.compile_s for h in self._compiled.values())
+            return {
+                "kernels": len(self._kernels),
+                "executables": len(self._compiled),
+                "hits": self.hits,
+                "misses": self.misses,
+                "compile_time_s": round(total_s, 4),
+            }
+
+
+# the shared process-wide registry (warmup + serve use this one; tests
+# construct their own)
+registry = ExecutableRegistry()

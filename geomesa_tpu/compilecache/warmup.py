@@ -1,0 +1,198 @@
+"""Manifest replay: pre-compile everything before the server takes traffic.
+
+`replay()` walks a WarmupManifest and, per kernel entry, (1) AOT-compiles
+it through the ExecutableRegistry — `jit(...).lower(abstract).compile()`,
+which also seeds the persistent compilation cache — and (2) makes one
+real call with zero-filled arrays of the recorded shapes/dtypes, heating
+the live jit wrapper's own dispatch cache (an AOT compile alone does not
+populate it, and the zero-recompile serving contract is measured against
+those wrappers by JitTracker). Query entries replay through the store's
+planner — the same path a live request takes — warming the compiled-
+filter cache, the residual-mask reductions, and the kNN kernels at the
+store's actual superbatch shapes.
+
+`check()` answers "would serving still compile anything?": replay, then
+run every entry a second time and count dispatch-cache growth across the
+engine jits. A nonzero residual means the manifest replay is not
+idempotent (something compiles per-call — a retrace storm or an
+unrecorded shape) and `gmtpu warmup --check` exits nonzero.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import time
+from typing import Dict, List, Optional, Tuple
+
+from geomesa_tpu.compilecache.kernels import is_jitted as _is_jitted
+from geomesa_tpu.compilecache.kernels import iter_jitted
+from geomesa_tpu.compilecache.manifest import (
+    KernelEntry, QueryEntry, WarmupManifest, decode_arg)
+from geomesa_tpu.compilecache.registry import ExecutableRegistry
+from geomesa_tpu.compilecache.registry import registry as _default_registry
+
+MAX_ERRORS = 32
+
+
+@dataclasses.dataclass
+class WarmupReport:
+    kernels_total: int = 0
+    kernels_compiled: int = 0   # paid a dispatch-cache fill (trace+compile)
+    kernels_cached: int = 0     # already hot in this process
+    kernels_failed: int = 0
+    queries_total: int = 0
+    queries_run: int = 0
+    queries_failed: int = 0
+    queries_skipped: int = 0    # query entries with no store to run against
+    compile_time_s: float = 0.0
+    residual_recompiles: Optional[int] = None  # set by check()
+    errors: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return (self.kernels_failed == 0 and self.queries_failed == 0
+                and (self.residual_recompiles in (None, 0)))
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _note_error(report: WarmupReport, msg: str) -> None:
+    if len(report.errors) < MAX_ERRORS:
+        report.errors.append(msg)
+
+
+def engine_cache_sizes(modules=None) -> Dict[str, int]:
+    """Dispatch-cache size per engine jit (unwrapping any JitTracker
+    wrapper) — the ground truth `check()` diffs; robust whether or not a
+    tracker is installed. Uses the canonical kernels.iter_jitted sweep,
+    so it can never disagree with the recorder about what exists."""
+    sizes: Dict[str, int] = {}
+    for _mod, tail, attr, obj in iter_jitted(modules):
+        try:
+            sizes[f"{tail}.{attr}"] = int(obj._cache_size())
+        except Exception:
+            pass
+    return sizes
+
+
+def _replay_kernel(entry: KernelEntry, report: WarmupReport,
+                   registry: ExecutableRegistry, aot: bool) -> None:
+    import jax
+
+    from geomesa_tpu.utils.metrics import metrics
+
+    report.kernels_total += 1
+    t0 = time.perf_counter()
+    try:
+        if aot:
+            registry.compile_entry(entry)
+        mod = importlib.import_module(entry.module)
+        fn = getattr(mod, entry.attr)
+        underlying = getattr(fn, "_gt_tracked", fn)
+        before = (underlying._cache_size()
+                  if _is_jitted(underlying) else 0)
+        args = [decode_arg(a) for a in entry.args]
+        kwargs = {k: decode_arg(v) for k, v in entry.kwargs.items()}
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        grew = ((underlying._cache_size() - before)
+                if _is_jitted(underlying) else 1)
+    except Exception as e:  # noqa: BLE001 — one bad entry must not
+        report.kernels_failed += 1     # abort the rest of the warmup
+        _note_error(report, f"kernel {entry.label}: "
+                            f"{type(e).__name__}: {e}")
+        metrics.counter("compilecache.warm.failed")
+        return
+    dt = time.perf_counter() - t0
+    report.compile_time_s += dt
+    metrics.histogram("compile.warmup").update(dt)
+    if grew > 0:
+        report.kernels_compiled += 1
+        metrics.counter("compilecache.warm.compiled")
+    else:
+        report.kernels_cached += 1
+        metrics.counter("compilecache.warm.cached")
+
+
+def _replay_query(entry: QueryEntry, report: WarmupReport,
+                  store) -> None:
+    import numpy as np
+
+    from geomesa_tpu.plan.query import Query
+
+    report.queries_total += 1
+    if store is None:
+        report.queries_skipped += 1
+        return
+    t0 = time.perf_counter()
+    try:
+        source = store.get_feature_source(entry.type_name)
+        query = Query(entry.type_name, entry.cql)
+        if entry.op == "knn":
+            q = max(int(entry.q), 1)
+            # (0, 0) is a valid lon/lat; compilation depends only on the
+            # padded [q] bucket and the store's superbatch shapes
+            source.planner.knn(query, np.zeros(q), np.zeros(q),
+                               k=max(int(entry.k), 1),
+                               impl=entry.impl or "sparse")
+        elif entry.op == "count":
+            source.planner.count(query)
+        else:
+            source.planner.execute(query)
+    except Exception as e:  # noqa: BLE001
+        report.queries_failed += 1
+        _note_error(report, f"query {entry.label}: "
+                            f"{type(e).__name__}: {e}")
+        return
+    report.queries_run += 1
+    report.compile_time_s += time.perf_counter() - t0
+
+
+def replay(manifest: WarmupManifest, store=None,
+           registry: Optional[ExecutableRegistry] = None,
+           aot: bool = True) -> WarmupReport:
+    """Warm every manifest entry. `store` (a DataStore) is required for
+    query entries — without one they are counted as skipped. `aot=False`
+    skips the registry lower/compile step and only heats dispatch caches
+    (used by the second pass of check())."""
+    from geomesa_tpu.compilecache.persist import enable_persistent_cache
+    from geomesa_tpu.compilecache.stall import STALLS
+
+    enable_persistent_cache()
+    report = WarmupReport()
+    reg = registry if registry is not None else _default_registry
+    # warmup compiles are ahead-of-time by definition: mute the inline
+    # stall meter for this thread so the compile.stalls alarms (and any
+    # concurrent dispatch's ServeEvent attribution window) never see
+    # them — warmup has its own compile.warmup histogram
+    with STALLS.suppressed():
+        for entry in manifest.entries:
+            if isinstance(entry, KernelEntry):
+                _replay_kernel(entry, report, reg, aot)
+            else:
+                _replay_query(entry, report, store)
+    return report
+
+
+def check(manifest: WarmupManifest, store=None,
+          registry: Optional[ExecutableRegistry] = None
+          ) -> WarmupReport:
+    """Replay, then prove the replay covers itself: a second pass over
+    every entry must grow NO engine dispatch cache. The returned
+    report's `residual_recompiles` is the total growth (0 = serving a
+    workload shaped like this manifest compiles nothing inline)."""
+    report = replay(manifest, store=store, registry=registry)
+    before = engine_cache_sizes()
+    second = replay(manifest, store=store, registry=registry, aot=False)
+    after = engine_cache_sizes()
+    residual = sum(
+        max(after.get(name, 0) - before.get(name, 0), 0)
+        for name in after)
+    report.residual_recompiles = residual
+    report.kernels_failed += second.kernels_failed
+    report.queries_failed += second.queries_failed
+    for msg in second.errors:
+        _note_error(report, msg)
+    return report

@@ -26,6 +26,7 @@ Float NaN is treated as null for IS NULL on numeric columns.
 from __future__ import annotations
 
 import re
+import time
 from typing import Callable, Dict, List
 
 import jax
@@ -76,8 +77,32 @@ class CompiledFilter:
     def params(self, batch: FeatureBatch) -> Dict[str, np.ndarray]:
         return {k: b(batch) for k, b in self.builders.items()}
 
+    def _metered(self, jit_fn, which: str, *args) -> jax.Array:
+        """Dispatch through `jit_fn`, metering the inline compile stall:
+        compile_filter() only builds closures — the ~0.65s XLA compile
+        happens HERE, at the first call per shape bucket, and that call
+        blocks through trace+compile. Non-compiling calls discard the
+        timestamps (async dispatch returns immediately, so the wall
+        would measure dispatch, not execution — deliberately unsynced,
+        we only keep it when the cache grew)."""
+        before = (jit_fn._cache_size()
+                  if hasattr(jit_fn, "_cache_size") else -1)
+        t0 = time.perf_counter()
+        out = jit_fn(*args)
+        if before >= 0 and jit_fn._cache_size() > before:
+            dt = time.perf_counter() - t0
+            try:
+                from geomesa_tpu.compilecache.stall import STALLS
+                from geomesa_tpu.utils.metrics import metrics
+
+                metrics.histogram("plan.filter.compile").update(dt)
+                STALLS.note(f"filter:{which}:{self.cql[:64]}", dt)
+            except Exception:
+                pass  # observability must never fail the query
+        return out
+
     def mask(self, dev: DeviceBatch, batch: FeatureBatch) -> jax.Array:
-        return self._jit(self.params(batch), dev)
+        return self._metered(self._jit, "mask", self.params(batch), dev)
 
     @property
     def has_band(self) -> bool:
@@ -88,7 +113,8 @@ class CompiledFilter:
         has no polygon predicate)."""
         if self._band_jit is None:
             raise ValueError("filter has no boundary band")
-        return self._band_jit(self.params(batch), dev)
+        return self._metered(self._band_jit, "band",
+                             self.params(batch), dev)
 
     def refine(
         self, mask: np.ndarray, dev: DeviceBatch, batch: FeatureBatch

@@ -49,6 +49,12 @@ class ServeEvent:
     batch_size: int  # members sharing this device dispatch (1 = alone)
     status: str  # ok | error | timeout
     degraded: bool = False
+    # compile-stall attribution (docs/SERVING.md "Cold start"): wall ms
+    # this dispatch spent inside inline XLA compiles, and which kernels/
+    # filters compiled — a p99 spike traces to the exact kernel+bucket
+    # that should have been in the warmup manifest
+    compile_ms: float = 0.0
+    compiled: str = ""  # comma-joined stall labels (bounded)
     user: str = ""
     timestamp: float = 0.0
 

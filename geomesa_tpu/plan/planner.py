@@ -114,6 +114,22 @@ class QueryPlanner:
             )
         self.coord_dtype = coord_dtype
 
+    def _enable_compile_cache(self) -> None:
+        """Library-level persistent compilation cache (compilecache/):
+        idempotent and never-failing, so compiled predicate masks and
+        kernels survive process restarts for every planner consumer, not
+        just bench.py. Called from the EXECUTION entry points, not the
+        constructor — resolving the per-backend cache subdir initializes
+        the jax backend (seconds on TPU), which metadata-only paths like
+        `gmtpu explain` must never pay."""
+        try:
+            from geomesa_tpu.compilecache.persist import (
+                enable_persistent_cache)
+
+            enable_persistent_cache()
+        except Exception:
+            pass
+
     # -- planning ----------------------------------------------------------
 
     def plan(self, query: Query, explain: Optional[Explainer] = None) -> QueryPlan:
@@ -185,7 +201,10 @@ class QueryPlanner:
         # serves _knn_caps / stats-manager lookups — holding it here
         # would stall every concurrent query behind one cache miss. Two
         # threads may compile the same filter once each; setdefault
-        # keeps a single winner
+        # keeps a single winner. (The inline compile-stall metering for
+        # ServeEvent attribution lives in CompiledFilter._metered — the
+        # XLA compile happens lazily at the first mask()/band() call,
+        # not here: compile_filter only builds closures.)
         compiled = compile_filter(residual, sft)
         with self._mutex:
             if len(cached) > 256:  # bound memory on adversarial streams
@@ -230,6 +249,7 @@ class QueryPlanner:
 
         if timeout_ms is None:
             timeout_ms = int(SystemProperties.QUERY_TIMEOUT_MS.get())
+        self._enable_compile_cache()
         t0 = time.perf_counter()
 
         def check_timeout(phase: str) -> None:
@@ -566,6 +586,7 @@ class QueryPlanner:
 
         if isinstance(query, str):
             query = Query(self.storage.sft.name, query)
+        self._enable_compile_cache()
         t0 = time.perf_counter()
 
         def check_timeout(phase: str) -> None:

@@ -37,13 +37,15 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from geomesa_tpu.compilecache.stall import STALLS
 from geomesa_tpu.plan.audit import ServeEvent
 from geomesa_tpu.plan.planner import QueryTimeout
 from geomesa_tpu.plan.query import Query
 from geomesa_tpu.serve.batcher import (
-    compat_key, execute_batch, fail_expired, split_expired)
+    MIN_KNN_BATCH, compat_key, execute_batch, fail_expired, split_expired)
 from geomesa_tpu.serve.scheduler import (
     PRIORITIES, AdmissionQueue, QueryRejected, RateLimiter, ServeRequest)
+from geomesa_tpu.utils.padding import next_pow2 as _next_pow2
 
 
 @dataclasses.dataclass
@@ -58,6 +60,13 @@ class ServeConfig:
     degrade_watermark: float = 0.75  # queue occupancy -> hint downgrades
     shed_watermark: float = 0.90     # queue occupancy -> shed batch class
     drain_timeout_s: float = 30.0
+    # cold-start management (docs/SERVING.md "Cold start"): a manifest
+    # path replays BEFORE the dispatcher starts accepting traffic;
+    # track_compiles installs a JitTracker over the engine jits so
+    # recompiles are counted and ServeEvents carry kernel compile-stall
+    # attribution (warmup()/record_warmup() install it on demand too)
+    warmup_manifest: Optional[str] = None
+    track_compiles: bool = False
 
 
 class QueryService:
@@ -78,6 +87,31 @@ class QueryService:
         self._state_lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._worker: Optional[threading.Thread] = None
+        # compilation management: compiled executables must survive
+        # restarts (the cache is idempotent/never-failing to enable)
+        try:
+            from geomesa_tpu.compilecache.persist import (
+                enable_persistent_cache)
+
+            enable_persistent_cache()
+        except Exception:
+            pass
+        self.tracker = None          # JitTracker over the engine jits
+        self._tracker_acquired = False
+        self._recorder = None        # WarmupRecorder, when recording
+        try:
+            if self.config.track_compiles:
+                self._ensure_tracker()
+            if self.config.warmup_manifest:
+                # startup hook: replay before the dispatcher takes
+                # traffic
+                self.warmup(self.config.warmup_manifest)
+        except BaseException:
+            # a failed constructor (e.g. missing manifest) must not
+            # leak the process-global engine wrappers: close() is
+            # unreachable for a never-constructed service
+            self._release_tracker()
+            raise
         if autostart:
             self.start()
 
@@ -115,6 +149,69 @@ class QueryService:
         self._stop.set()
         if self._worker is not None:
             self._worker.join(timeout=5.0)
+        # restore the bare engine jits (owner only); the tracker object
+        # (and its counters) stays readable after close
+        self._release_tracker()
+
+    # -- warmup / compile management ---------------------------------------
+
+    def _ensure_tracker(self):
+        """Attach the process-wide engine JitTracker to this service
+        (the engine jits are module globals — services share ONE tracker
+        via refcounted acquisition, and the wrappers come off when the
+        LAST service releases; see
+        analysis.runtime.acquire_engine_tracker). Counting recompiles is
+        also what makes ServeEvent compile-stall attribution see kernel
+        compiles."""
+        if self.tracker is None:
+            from geomesa_tpu.analysis.runtime import acquire_engine_tracker
+
+            self.tracker, _ = acquire_engine_tracker(
+                recorder=self._recorder)
+            self._tracker_acquired = True
+        return self.tracker
+
+    def _release_tracker(self) -> None:
+        if self._tracker_acquired and self.tracker is not None:
+            from geomesa_tpu.analysis.runtime import release_engine_tracker
+
+            release_engine_tracker(self.tracker)
+            self._tracker_acquired = False
+
+    def record_warmup(self):
+        """Start recording a warmup manifest from live traffic: every
+        compiling kernel signature (via JitTracker) and every dispatched
+        query shape lands in the returned WarmupRecorder. Call
+        `.manifest().save(path)` on it when the workload is
+        representative."""
+        from geomesa_tpu.compilecache.manifest import WarmupRecorder
+
+        self._recorder = WarmupRecorder()
+        tracker = self._ensure_tracker()
+        tracker.recorder = self._recorder
+        return self._recorder
+
+    def warmup(self, manifest, check: bool = False):
+        """Replay a warmup manifest (path, or a WarmupManifest) through
+        the compilecache so every kernel/filter this service will need is
+        compiled — and persisted — before traffic. With `check=True` the
+        replay is followed by a second pass that must compile NOTHING
+        (`report.residual_recompiles == 0`), the programmatic equivalent
+        of `gmtpu warmup --check`. Returns the WarmupReport."""
+        from geomesa_tpu.compilecache import warmup as _warmup
+        from geomesa_tpu.compilecache.manifest import WarmupManifest
+        from geomesa_tpu.utils.metrics import metrics
+
+        if isinstance(manifest, str):
+            manifest = WarmupManifest.load(manifest)
+        self._ensure_tracker()
+        t0 = time.monotonic()
+        run = _warmup.check if check else _warmup.replay
+        report = run(manifest, store=self.store)
+        metrics.gauge("serve.warmup.seconds", time.monotonic() - t0)
+        metrics.gauge("serve.warmup.ok", 1.0 if report.ok else 0.0)
+        self._bump("warmups")
+        return report
 
     # -- submission API ----------------------------------------------------
 
@@ -278,6 +375,9 @@ class QueryService:
         t0 = time.monotonic()
         for r in live:
             metrics.histogram("serve.queue.wait").update(t0 - r.enqueued_at)
+        if self._recorder is not None:
+            self._record_queries(live)
+        stall_token = STALLS.token()
         try:
             # an unknown type name raises HERE, not in execute_batch's
             # guarded body — it must fail these futures, not the
@@ -290,6 +390,23 @@ class QueryService:
         else:
             execute_batch(source, live)
         t1 = time.monotonic()
+        # per-dispatch compile-stall attribution: everything THIS THREAD
+        # noted into the stall meter during the window (tracked kernel
+        # compiles + filter compiles — the dispatch's own work runs
+        # synchronously on the dispatch thread) is charged to the
+        # requests that rode the dispatch; scoping by thread keeps the
+        # window exact even when other services/planner callers share
+        # the process-wide meter
+        stalls = STALLS.since(stall_token,
+                              thread_ident=threading.get_ident())
+        compile_ms = sum(s for _, s in stalls) * 1000.0
+        labels = list(dict.fromkeys(lbl for lbl, _ in stalls))
+        compiled = ",".join(labels[:5])
+        if len(labels) > 5:
+            compiled += f",+{len(labels) - 5}"
+        if stalls:
+            self._bump("compile_stalled_dispatches")
+            metrics.counter("serve.compile.stalled")
         self._bump("dispatches")
         self._bump("coalesced", len(live) - 1)
         metrics.counter("serve.dispatch")
@@ -321,7 +438,41 @@ class QueryService:
                     batch_size=len(live),
                     status=status,
                     degraded=r.degraded,
+                    compile_ms=compile_ms,
+                    compiled=compiled,
                 ))
+
+    def _record_queries(self, live: List[ServeRequest]) -> None:
+        """Record this dispatch's query shape into the warmup recorder.
+        Members share a compat key, so one entry per dispatch; the kNN
+        bucket is the PADDED stacked-query axis the batcher will build,
+        which is what the kernel actually compiles for."""
+        lead = live[0]
+        try:
+            from geomesa_tpu.cql import ast
+
+            cql = ast.to_cql(lead.query.filter_ast)
+        except Exception:
+            return
+        from geomesa_tpu.plan.hints import QueryHints
+
+        # replay runs with default hints: only default-hint queries are
+        # recorded faithfully. This guards ALL kinds — a degraded kNN
+        # (loose_bbox/sampling rewritten by the ladder) or a hinted
+        # aggregation would replay as a DIFFERENT program, pre-compiling
+        # something serving never runs while the real one still compiles
+        # inline
+        if lead.query.hints != QueryHints():
+            return
+        if lead.kind == "knn":
+            total = sum(len(np.asarray(r.qx).ravel()) for r in live)
+            padded = max(MIN_KNN_BATCH, _next_pow2(max(total, 1)))
+            self._recorder.record_query(
+                "knn", lead.query.type_name, cql,
+                q=padded, k=lead.k, impl=lead.impl)
+        else:
+            self._recorder.record_query(
+                lead.kind, lead.query.type_name, cql)
 
     # -- introspection -----------------------------------------------------
 
@@ -336,6 +487,8 @@ class QueryService:
         out.setdefault("coalesced", 0)
         out["queue_depth"] = len(self.queue)
         out["degrade_level"] = self.degrade_level()
+        if self.tracker is not None:
+            out["recompiles"] = self.tracker.total_recompiles()
         return out
 
 

@@ -98,6 +98,13 @@ class SystemProperties:
         "driven StrategyDecider analog; sparse pruning cannot win when "
         "nearly every data tile bears a match)",
     )
+    COMPILE_CACHE_DIR = SystemProperty(
+        "geomesa.compile.cache.dir", "", str,
+        "persistent XLA compilation-cache directory shared by the "
+        "planner, QueryService, gmtpu serve and bench (empty = "
+        "~/.cache/geomesa_tpu/jax_cache, with a per-backend subdir; "
+        "'off' disables)",
+    )
     LOAD_INTERCEPTORS = SystemProperty(
         "geomesa.query.interceptors.load", False,
         lambda s: s.lower() in ("1", "true"),

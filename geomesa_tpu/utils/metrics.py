@@ -39,11 +39,16 @@ class Timer:
             return self.total_s / self.count if self.count else 0.0
 
 
-# log-spaced latency bounds in SECONDS: 0.5ms .. ~65s, doubling — wide
-# enough for a coalescer's sub-ms queue waits and a cold multi-second
-# parquet->device scan in the same family. Fixed (not per-instance) so
-# every histogram is mergeable across threads/shards by construction.
-DEFAULT_BUCKETS: Tuple[float, ...] = tuple(
+# latency bounds in SECONDS: a 1-2-5 sub-millisecond decade (10µs ..
+# 200µs) followed by the log-spaced 0.5ms .. ~65s doubling series — the
+# sub-ms buckets exist so compile-stall and device-dispatch timings
+# resolve instead of all landing in the bottom bucket, while a cold
+# multi-second parquet->device scan still fits the same family. Fixed
+# (not per-instance) so every histogram is mergeable across
+# threads/shards by construction.
+_SUB_MS_BUCKETS: Tuple[float, ...] = (
+    0.00001, 0.00002, 0.00005, 0.0001, 0.0002)
+DEFAULT_BUCKETS: Tuple[float, ...] = _SUB_MS_BUCKETS + tuple(
     0.0005 * (2.0 ** i) for i in range(18)
 )
 

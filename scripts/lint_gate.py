@@ -1,14 +1,18 @@
 #!/usr/bin/env python
-"""CI gate: `gmtpu lint --fail-on warn` over geomesa_tpu/.
+"""CI gate: `gmtpu lint --fail-on warn` over geomesa_tpu/ + warmup smoke.
 
-Runs EVERY registered rule — the JAX hazards GT01..GT06 and the
-concurrency pass GT07..GT12 (lock discipline, lock-order cycles,
-blocking-under-lock, per-call locks, callback-under-lock, unguarded
-shared state) — and exits nonzero on any unwaived finding, printing
-each with file:line and rule code. Rides the tier-1 pytest run via
-tests/test_lint_gate.py and is runnable standalone:
+Runs EVERY registered rule — the JAX hazards GT01..GT06, the concurrency
+pass GT07..GT12 (lock discipline, lock-order cycles, blocking-under-lock,
+per-call locks, callback-under-lock, unguarded shared state) and the
+serving-hot-path rule GT13 — and exits nonzero on any unwaived finding,
+printing each with file:line and rule code. In text mode a clean lint is
+followed by the warmup smoke: `gmtpu warmup --check` semantics against
+the committed fixture manifest on CPU (tiny interpret-mode kernel
+shapes), proving the manifest record→replay→check loop stays green.
+Rides the tier-1 pytest run via tests/test_lint_gate.py and is runnable
+standalone:
 
-    python scripts/lint_gate.py [--format json|sarif]
+    python scripts/lint_gate.py [--format json|sarif] [--no-warmup-smoke]
 
 Rule catalog + waiver syntax: docs/ANALYSIS.md.
 """
@@ -23,6 +27,48 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:  # standalone invocation from anywhere
     sys.path.insert(0, REPO_ROOT)
 
+SMOKE_MANIFEST = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "warmup_smoke_manifest.json")
+
+
+def warmup_smoke(manifest_path: str = SMOKE_MANIFEST) -> int:
+    """`gmtpu warmup --check` against the fixture manifest, pinned to
+    CPU (the fixture records interpret-mode kernels; this gate must run
+    on hardware-less CI). Output goes to stderr only — stdout stays
+    machine-parseable for the lint formats. Returns 0 on pass."""
+    # same backend pinning as bench.py --smoke: the env var alone does
+    # not stick (the axon site pins jax_platforms at register time), and
+    # the "tpu" factory must stay registered for pallas lowering imports
+    os.environ.setdefault("XLA_FLAGS", "")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    from jax._src import xla_bridge as xb
+
+    xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+
+    from geomesa_tpu.compilecache.manifest import WarmupManifest
+    from geomesa_tpu.compilecache.warmup import check
+
+    report = check(WarmupManifest.load(manifest_path))
+    for msg in report.errors:
+        print(f"warmup smoke: {msg}", file=sys.stderr)
+    print(
+        f"warmup smoke: {report.kernels_compiled} compiled, "
+        f"{report.kernels_cached} cached, {report.kernels_failed} failed, "
+        f"residual recompiles {report.residual_recompiles}",
+        file=sys.stderr)
+    if report.queries_skipped:
+        # same refusal as `gmtpu warmup --check` without a catalog: a
+        # skipped query entry was never verified, so a green exit would
+        # read as "serving compiles nothing" when the check proved
+        # nothing about it — the smoke manifest must stay kernel-only
+        print("warmup smoke: manifest contains query entries this "
+              "store-less smoke cannot replay; FAIL", file=sys.stderr)
+        return 1
+    return 0 if report.ok else 1
+
 
 def main(argv=None) -> int:
     from geomesa_tpu.analysis.linter import (
@@ -31,6 +77,9 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--format", default="text",
                    choices=["text", "json", "sarif"])
+    p.add_argument("--no-warmup-smoke", action="store_true",
+                   help="skip the warmup-manifest smoke (it runs only "
+                        "in text mode; json/sarif stdout stays pure)")
     args = p.parse_args(argv)
     findings = lint_paths([os.path.join(REPO_ROOT, "geomesa_tpu")])
     if args.format == "json":
@@ -39,7 +88,10 @@ def main(argv=None) -> int:
         print(render_sarif(findings))
     else:
         print(render_text(findings))
-    return exit_code(findings, "warn")
+    rc = exit_code(findings, "warn")
+    if args.format == "text" and not args.no_warmup_smoke and rc == 0:
+        rc = warmup_smoke()
+    return rc
 
 
 if __name__ == "__main__":

@@ -19,6 +19,14 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# keep the suite free of persistent-compilation-cache I/O: the planner
+# and QueryService enable it by default (compilecache/persist.py), and
+# with the serve-grade thresholds every tiny test compile would be
+# serialized to ~/.cache — pure overhead against the tier-1 wall-clock
+# budget. Tests that exercise the cache itself pass explicit dirs with
+# force=True, which overrides this. setdefault: a dev can still opt in.
+os.environ.setdefault("GEOMESA_TPU_COMPILE_CACHE_DIR", "off")
+
 import jax
 import jax.experimental.pallas  # noqa: F401  (register TPU lowering rules
 # while the tpu platform is still a known backend — popping the factories
