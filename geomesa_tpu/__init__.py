@@ -27,6 +27,13 @@ JAX/XLA/Pallas on TPU:
 - ``stats``   — mergeable stat sketches + Stat DSL (parity with geomesa-utils
                 org.locationtech.geomesa.utils.stats).
 - ``security``— visibility expressions (parity with geomesa-security).
+- ``faults``  — fault-injection harness (named sites at every dependency
+                boundary, seeded replayable FaultPlans) + the recovery
+                fabric: typed error taxonomy, deadline-aware retry with
+                full-jitter backoff, per-dependency circuit breakers,
+                device-OOM host-eval fallback, poison-query quarantine,
+                and the ``gmtpu chaos`` invariant gate (no upstream
+                analog; docs/ROBUSTNESS.md).
 - ``cli``     — command-line tools (parity with geomesa-tools).
 
 Parallelism: feature batches shard over a ``jax.sharding.Mesh`` axis "shard";

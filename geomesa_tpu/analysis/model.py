@@ -52,6 +52,11 @@ RULES: Dict[str, Rule] = {
         Rule("GT13", "serve/plan hot-path jax.jit site bypasses the "
                      "compilecache ExecutableRegistry (invisible to "
                      "warmup manifests; compiles inline under traffic)"),
+        Rule("GT14", "error-swallowing I/O: bare/broad except that "
+                     "discards the failure, or an unbounded while-True "
+                     "retry loop around an I/O call site (use the "
+                     "faults/ retry fabric: bounded, typed, "
+                     "deadline-aware)"),
     )
 }
 

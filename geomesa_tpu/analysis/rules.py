@@ -556,12 +556,148 @@ def gt13(mod: ModInfo, project) -> Iterator[Finding]:
             "register it explicitly; waive deliberate sites.")
 
 
+# GT14 scope: the dependency-boundary layers the recovery fabric
+# (geomesa_tpu.faults) covers. A swallowed exception there hides a
+# failure the retry/breaker/quarantine machinery should have typed; an
+# unbounded retry loop is the exact shape faults.retry_call exists to
+# replace (bounded attempts, full-jitter backoff, deadline-aware).
+_GT14_PREFIXES = ("geomesa_tpu/store/", "geomesa_tpu/kafka/",
+                  "geomesa_tpu/serve/")
+
+_GT14_BROAD = {"Exception", "BaseException"}
+
+
+def _gt14_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body discards the error: only pass /
+    ellipsis statements. A body that logs, responds, returns a value or
+    re-raises is handling, not swallowing; `continue` is the retry
+    shape — the while-True branch owns that (flagging it here too would
+    double-report every retry loop)."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Constant) and stmt.value.value is Ellipsis:
+            continue
+        return False
+    return True
+
+
+def _gt14_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e for e in t.elts]
+    else:
+        names = [t]
+    for n in names:
+        ident = n.id if isinstance(n, ast.Name) else (
+            n.attr if isinstance(n, ast.Attribute) else None)
+        if ident in _GT14_BROAD:
+            return True
+    return False
+
+
+def gt14(mod: ModInfo, project) -> Iterator[Finding]:
+    """GT14: silent error swallows + unbounded retry loops at the
+    storage/Kafka/serve dependency boundaries.
+
+    (a) `except:` / `except Exception:` whose body only passes —
+    the failure vanishes instead of surfacing typed (or feeding the
+    breaker/quarantine fabric). (b) a `while True:` loop with NO
+    break/return anywhere in its body and no raise on its exception
+    paths, wrapping a try whose handler swallows around at least one
+    call — the retry-forever shape that ignores deadlines and retries
+    permanent errors. Both waivable inline for the documented deliberate
+    cases (the shipped tree is clean modulo those)."""
+    path = mod.relpath.replace("\\", "/")
+    if not any(p in path for p in _GT14_PREFIXES):
+        return
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ExceptHandler):
+            if _gt14_broad(node) and _gt14_swallows(node):
+                yield _finding(
+                    "GT14", mod, node,
+                    "broad except swallows the error (body is only "
+                    "pass): failures at a dependency boundary must "
+                    "surface typed or feed the recovery fabric; waive "
+                    "deliberate degrade paths inline")
+        elif isinstance(node, ast.While):
+            if not (isinstance(node.test, ast.Constant)
+                    and bool(node.test.value)):
+                continue
+            if _gt14_has_exit(node):
+                continue  # the loop has a non-exceptional exit
+            body_nodes = list(_gt14_loop_nodes(node))
+            for t in (n for n in body_nodes if isinstance(n, ast.Try)):
+                has_io_call = any(isinstance(n, ast.Call)
+                                  for s in t.body for n in ast.walk(s))
+                swallowing = [h for h in t.handlers
+                              if not any(isinstance(n, ast.Raise)
+                                         for s in h.body
+                                         for n in ast.walk(s))]
+                if has_io_call and swallowing:
+                    yield _finding(
+                        "GT14", mod, node,
+                        "unbounded `while True` retry loop: no "
+                        "break/return and the except path swallows — "
+                        "this retries forever past any deadline; use "
+                        "faults.retry_call (bounded, jittered, "
+                        "deadline-aware)")
+                    break
+
+
+def _gt14_loop_nodes(loop: ast.While):
+    """Walk the loop body, not descending into nested function defs
+    (their control flow is not the loop's)."""
+    stack = list(loop.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        for child in ast.iter_child_nodes(n):
+            stack.append(child)
+
+
+def _gt14_has_exit(loop: ast.While) -> bool:
+    """True when the loop can exit non-exceptionally: a `return`
+    anywhere in its body (returns leave the whole function, nested
+    loops included), or a `break` belonging to THIS loop — a break
+    inside a nested while/for's BODY exits only that inner loop and
+    must not vouch for the outer one, but a break in a nested loop's
+    `else:` clause targets the ENCLOSING loop (Python's for/else) and
+    counts. Nested function defs are skipped."""
+    stack = [(n, False) for n in loop.body]
+    while stack:
+        n, nested = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, ast.Return):
+            return True
+        if isinstance(n, ast.Break) and not nested:
+            return True
+        if isinstance(n, (ast.While, ast.For, ast.AsyncFor)):
+            for child in n.body:
+                stack.append((child, True))
+            for child in n.orelse:  # for/else break targets OUR loop
+                stack.append((child, nested))
+            continue
+        for child in ast.iter_child_nodes(n):
+            stack.append((child, nested))
+    return False
+
+
 from geomesa_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES)
 
 ALL_RULES = {
     "GT01": gt01, "GT02": gt02, "GT03": gt03,
     "GT04": gt04, "GT05": gt05, "GT06": gt06,
-    "GT13": gt13,
+    "GT13": gt13, "GT14": gt14,
     **CONCURRENCY_RULES,
 }

@@ -215,6 +215,31 @@ def register(sub: "argparse._SubParsersAction") -> None:
                           help="small sizes for CI")
     bserve_p.set_defaults(func=_bench_serve)
 
+    # fault injection + recovery fabric (docs/ROBUSTNESS.md)
+    chaos_p = sub.add_parser(
+        "chaos", help="run a serve workload under a fault plan and "
+                      "check the recovery invariants (no torn "
+                      "manifests, typed errors only, breaker cycles "
+                      "visible, deterministic replay)")
+    chaos_p.add_argument("--plan", "-p", default=None,
+                         help="fault plan JSON (see docs/ROBUSTNESS.md; "
+                              "required unless --list-sites)")
+    chaos_p.add_argument("--requests", type=int, default=32,
+                         help="workload length (mixed count/knn/"
+                              "features/Kafka, writers interleaved)")
+    chaos_p.add_argument("--seed", type=int, default=None,
+                         help="override the plan's RNG seed")
+    chaos_p.add_argument("--check", action="store_true",
+                         help="exit nonzero unless every invariant "
+                              "holds (the acceptance gate)")
+    chaos_p.add_argument("--no-replay", action="store_true",
+                         help="skip the determinism replay "
+                              "(second seeded run + fire-log diff)")
+    chaos_p.add_argument("--list-sites", action="store_true",
+                         help="print the registered fault-site catalog "
+                              "and exit")
+    chaos_p.set_defaults(func=_chaos)
+
     # analysis subsystem (docs/ANALYSIS.md): gmtpu-lint + runtime guards
     from geomesa_tpu.analysis.linter import add_lint_arguments
 
@@ -403,6 +428,16 @@ def _warmup(args) -> int:
               "given; cannot verify the serving path", file=sys.stderr)
         return 1
     return 0 if report.ok else 1
+
+
+def _chaos(args) -> int:
+    from geomesa_tpu.faults.chaos import run_cli
+
+    if not args.list_sites and not args.plan:
+        print("error: chaos needs --plan (or --list-sites)",
+              file=sys.stderr)
+        return 2
+    return run_cli(args)
 
 
 def _lint(args) -> int:

@@ -25,10 +25,18 @@ import os
 import threading
 from typing import Optional
 
+from geomesa_tpu.faults import harness as _faults
+
 _lock = threading.Lock()
 _enabled_dir: Optional[str] = None
 
 DISABLE_TOKENS = ("off", "0", "false", "none")
+
+# compile-cache boundary site: an injected failure here exercises the
+# documented degrade path (the cache is an optimization, never a
+# failure — enable returns None and serving continues uncached)
+_PERSIST_SITE = _faults.site(
+    "compilecache.persist", "persistent XLA cache dir setup/config")
 
 
 def default_cache_dir() -> str:
@@ -73,6 +81,7 @@ def enable_persistent_cache(
         if str(base).lower() in DISABLE_TOKENS:
             return None
         try:
+            _PERSIST_SITE.fire()
             import jax
 
             path = base
@@ -102,3 +111,20 @@ def persistent_cache_dir() -> Optional[str]:
     effect this process, or None."""
     with _lock:
         return _enabled_dir
+
+
+def disable_persistent_cache() -> None:
+    """Detach jax from the persistent cache directory and forget the
+    enabled state (so a later enable_persistent_cache() re-resolves).
+    Used by the chaos runner to restore a pristine state after pointing
+    the cache at a throwaway directory; same never-fails contract as
+    enable."""
+    global _enabled_dir
+    with _lock:
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:
+            pass
+        _enabled_dir = None

@@ -32,10 +32,26 @@ import jax.numpy as jnp
 import numpy as np
 
 from geomesa_tpu.core.columnar import DictColumn, FeatureBatch, GeometryColumn
+from geomesa_tpu.faults import BREAKERS, RetryPolicy, retry_call
+from geomesa_tpu.faults import harness as _faults
 
 DeviceBatch = Dict[str, jax.Array]
 
 VALID = "__valid__"
+
+# host->device transfers are the remote-tunnel boundary: a dropped
+# tunnel surfaces as an I/O-ish error worth a couple of fast retries;
+# RESOURCE_EXHAUSTED (OOM) is NOT retried here — the same transfer would
+# fail identically, so it propagates for the serve layer's bucket-halving
+# + host-eval fallback (faults/fallback.py). The backoff is deliberately
+# TINY (worst case ~37ms of sleep total): some callers — the
+# DeviceCacheManager residency swaps — invoke to_device under their
+# instance lock (the GT09-waived double-buffer uploads), and while the
+# multi-second upload itself is the accepted cost there, the retry
+# fabric must not add meaningful lock-held sleep on top of it.
+_TRANSFER_SITE = _faults.site(
+    "device.transfer", "host->device batch transfer (engine.device)")
+_DEVICE_RETRY = RetryPolicy(max_attempts=3, base_ms=2.0, cap_ms=25.0)
 
 
 def to_device(
@@ -43,7 +59,22 @@ def to_device(
     coord_dtype=jnp.float32,
     device=None,
 ) -> DeviceBatch:
-    """Transfer a FeatureBatch to device arrays (see module docstring)."""
+    """Transfer a FeatureBatch to device arrays (see module docstring).
+    Runs under the recovery fabric: transient transfer failures retry
+    with backoff against the "device" circuit breaker; OOM propagates
+    typed (see _TRANSFER_SITE note above)."""
+    return retry_call(
+        _to_device_impl, batch, coord_dtype, device,
+        policy=_DEVICE_RETRY, label="device",
+        breaker=BREAKERS.get("device"))
+
+
+def _to_device_impl(
+    batch: FeatureBatch,
+    coord_dtype=jnp.float32,
+    device=None,
+) -> DeviceBatch:
+    _TRANSFER_SITE.fire()
     out: Dict[str, jax.Array] = {}
     put = lambda a: jax.device_put(a, device)
     for attr in batch.sft.attributes:

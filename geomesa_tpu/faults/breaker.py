@@ -1,0 +1,195 @@
+"""Per-dependency circuit breakers (closed / open / half-open).
+
+One breaker per dependency (storage, kafka, device, ...) shared by
+every call site of that dependency in the process. Semantics:
+
+  closed     — calls flow; consecutive transient failures count up.
+  open       — after `failure_threshold` consecutive failures, calls
+               are rejected immediately with the typed `BreakerOpen`
+               (no queue time wasted on a dead dependency). After
+               `reset_timeout_s` the next `allow()` transitions to
+               half-open.
+  half-open  — up to `half_open_max` probe calls pass; one success
+               closes the breaker, one failure re-opens it (and
+               restarts the reset clock).
+
+Every transition is metrics-visible: gauge `fault.breaker.<name>`
+(0=closed, 1=half-open, 2=open) plus counters
+`fault.breaker.<name>.open|half_open|close` — the chaos checker asserts
+open AND half-open transitions appeared under an outage plan.
+
+The clock is injectable so the state machine is testable without
+sleeps (tests/test_faults.py drives it with a fake clock).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+_STATE_NUM = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+
+class BreakerOpen(RuntimeError):
+    """Typed fail-fast rejection: the dependency's breaker is open.
+    Carries `reason="breaker_open"` so protocol layers render it like
+    the scheduler's QueryRejected family."""
+
+    def __init__(self, dependency: str, retry_after_s: float):
+        super().__init__(
+            f"circuit breaker for {dependency!r} is open "
+            f"(retry after ~{max(retry_after_s, 0.0):.2f}s)")
+        self.dependency = dependency
+        self.reason = "breaker_open"
+        self.retry_after_s = max(retry_after_s, 0.0)
+
+
+class CircuitBreaker:
+    def __init__(self, name: str, failure_threshold: int = 5,
+                 reset_timeout_s: float = 30.0, half_open_max: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self.half_open_max = half_open_max
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probes = 0
+        self._probe_at = 0.0  # when the last half-open probe was granted
+
+    # -- state machine -----------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _transition(self, state: str) -> None:
+        # callers hold self._lock
+        self._state = state
+        try:
+            from geomesa_tpu.utils.metrics import metrics
+
+            metrics.gauge(f"fault.breaker.{self.name}", _STATE_NUM[state])
+            metrics.counter(
+                f"fault.breaker.{self.name}."
+                + ("close" if state == "closed" else state))
+        except Exception:
+            pass  # observability must never wedge the breaker
+
+    def allow(self) -> None:
+        """Gate a call: raises BreakerOpen when the dependency is open
+        (or half-open with its probe budget spent)."""
+        with self._lock:
+            if self._state == "open":
+                elapsed = self.clock() - self._opened_at
+                if elapsed < self.reset_timeout_s:
+                    raise BreakerOpen(
+                        self.name, self.reset_timeout_s - elapsed)
+                self._probes = 0
+                self._transition("half_open")
+            if self._state == "half_open":
+                if self._probes >= self.half_open_max:
+                    # a probe that never reported back (its failure was
+                    # non-transient, so the retry fabric recorded
+                    # neither success nor failure) must not wedge the
+                    # breaker half-open forever: its slot goes stale
+                    # after reset_timeout_s and a new probe round opens
+                    since_probe = self.clock() - self._probe_at
+                    if since_probe < self.reset_timeout_s:
+                        raise BreakerOpen(
+                            self.name,
+                            self.reset_timeout_s - since_probe)
+                    self._probes = 0
+                self._probes += 1
+                self._probe_at = self.clock()
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            if self._state != "closed":
+                self._transition("closed")
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._state == "half_open" or (
+                    self._state == "closed"
+                    and self._failures >= self.failure_threshold):
+                self._opened_at = self.clock()
+                self._probes = 0
+                if self._state != "open":
+                    self._transition("open")
+                else:  # pragma: no cover - defensive
+                    self._opened_at = self.clock()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probes = 0
+            if self._state != "closed":
+                self._transition("closed")
+
+
+class BreakerRegistry:
+    """Lazy per-dependency breakers; `configure` (before first use or
+    any time after) overrides thresholds — the chaos runner shrinks the
+    reset timeout so open -> half-open -> closed plays out in-process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._config: Dict[str, dict] = {}
+
+    def get(self, name: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(name)
+            if b is None:
+                b = self._breakers[name] = CircuitBreaker(
+                    name, **self._config.get(name, {}))
+            return b
+
+    def configure(self, name: str, **kw) -> CircuitBreaker:
+        with self._lock:
+            self._config[name] = kw
+            b = self._breakers[name] = CircuitBreaker(name, **kw)
+            return b
+
+    def current_config(self, name: str) -> Optional[dict]:
+        """The kwargs a prior `configure(name, ...)` installed, or None
+        when the breaker runs on constructor defaults — pair with
+        `restore_config` to scope a temporary override (the chaos
+        runner must hand back whatever tuning the process had)."""
+        with self._lock:
+            cfg = self._config.get(name)
+            return dict(cfg) if cfg is not None else None
+
+    def restore_config(self, name: str, config: Optional[dict]) -> None:
+        if config is not None:
+            self.configure(name, **config)
+            return
+        with self._lock:
+            self._config.pop(name, None)
+            self._breakers[name] = CircuitBreaker(name)
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            items = list(self._breakers.items())
+        return {name: b.state for name, b in items}
+
+    def reset(self, name: Optional[str] = None) -> None:
+        with self._lock:
+            items = ([self._breakers[name]] if name in self._breakers
+                     else list(self._breakers.values())
+                     if name is None else [])
+        for b in items:
+            b.reset()
+
+
+BREAKERS = BreakerRegistry()

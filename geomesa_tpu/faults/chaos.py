@@ -1,0 +1,404 @@
+"""`gmtpu chaos`: run a serve workload under a fault plan and prove the
+recovery invariants hold.
+
+The runner synthesizes (or opens) a store, starts a QueryService, and
+drives a DETERMINISTIC sequential workload — FS counts/kNN/feature
+fetches, FS writes, Kafka live-layer writes and polls, a compile-cache
+enable — with the given FaultPlan installed. Sequential submission plus
+coalescing-off config keeps every site's call sequence reproducible, so
+the same plan+seed injects the same faults at the same calls; `--check`
+replays the run and diffs the fire logs to prove it.
+
+Invariants asserted (the acceptance contract, docs/ROBUSTNESS.md):
+
+  1. zero un-typed escapes: every request resolves with a result or an
+     error the taxonomy recognizes (QueryRejected / QueryTimeout /
+     BreakerOpen / OSError-family / FaultInjected ...);
+  2. zero torn manifests: after the run, metadata.json parses and every
+     entry references an existing data file with a matching row count;
+  3. injected coverage: every deterministic rule (nth_call / every) in
+     the plan actually fired;
+  4. breaker visibility: each dependency the plan names in
+     `expect_breakers` shows open AND half-open transitions in metrics
+     (the runner shrinks reset timeouts so the full closed -> open ->
+     half-open -> closed cycle plays out in-process);
+  5. graceful drain still completes and the dispatch thread survives;
+  6. disabled-harness overhead: the no-op site check stays sub-µs-ish
+     (bounded loosely so CI noise cannot flake it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from geomesa_tpu.faults import errors as _errors
+from geomesa_tpu.faults import harness as _harness
+from geomesa_tpu.faults.breaker import BREAKERS
+from geomesa_tpu.faults.plan import FaultPlan
+
+# dependencies whose breakers the runner re-configures for fast
+# in-process open -> half-open -> close cycles. reset_timeout_s=0 makes
+# every open -> half-open transition happen on the NEXT gate instead of
+# after a wall-clock wait: the full cycle still exercises all three
+# states AND the fire sequence stays independent of run timing (run 1
+# pays jit compiles, the replay doesn't — a real timeout would make the
+# two runs' probe schedules diverge and break replay determinism)
+_DEPS = ("storage", "kafka", "device")
+_CHAOS_BREAKER = dict(failure_threshold=3, reset_timeout_s=0.0,
+                      half_open_max=1)
+_NOOP_CALLS = 200_000
+_NOOP_BUDGET_US = 5.0  # per-call bound; a no-op attr check is ~0.1µs
+
+
+@dataclasses.dataclass
+class ChaosReport:
+    requests: int = 0
+    ok: int = 0
+    typed_errors: Dict[str, int] = dataclasses.field(default_factory=dict)
+    untyped_errors: List[str] = dataclasses.field(default_factory=list)
+    writes_ok: int = 0
+    writes_failed: int = 0
+    fires: int = 0
+    fired_sites: List[str] = dataclasses.field(default_factory=list)
+    breaker_counters: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    replay_match: Optional[bool] = None
+    noop_us_per_call: float = 0.0
+    invariant_failures: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok_overall(self) -> bool:
+        return not self.invariant_failures
+
+    def to_json(self) -> dict:
+        doc = dataclasses.asdict(self)
+        # `ok` in the JSON is the invariant VERDICT (what --check exits
+        # on); the per-request success count moves to `requests_ok` so
+        # the two never shadow each other
+        doc["requests_ok"] = doc.pop("ok")
+        doc["ok"] = self.ok_overall
+        return doc
+
+
+def _synth_store(root: str, n: int = 384, seed: int = 5):
+    """A small FS store on the SCAN path (no device cache): every query
+    re-reads partition files, so storage faults keep biting."""
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.plan.datastore import DataStore
+
+    rng = np.random.default_rng(seed)
+    sft = SimpleFeatureType.from_spec(
+        "chaos", "name:String,score:Double,dtg:Date,*geom:Point")
+    store = DataStore(root, use_device_cache=False)
+    src = store.create_schema(sft)
+    src.write(_synth_batch(sft, rng, n))
+    return store, sft
+
+
+def _synth_batch(sft, rng, n):
+    from geomesa_tpu.core.columnar import FeatureBatch
+
+    # one-day dtg window -> one date partition (a handful of files, not
+    # one per day: the workload's read sequence stays small and exact)
+    return FeatureBatch.from_pydict(sft, {
+        "name": rng.choice(["a", "b", "c"], n).tolist(),
+        "score": rng.uniform(-10, 10, n),
+        "dtg": rng.integers(1_590_000_000_000, 1_590_080_000_000, n),
+        "geom": np.stack(
+            [rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)], 1),
+    })
+
+
+def _check_manifest(root: str, type_name: str, failures: List[str]) -> None:
+    import pyarrow.parquet as pq
+
+    meta_path = os.path.join(root, type_name, "metadata.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except Exception as e:  # torn / unparseable manifest IS the failure
+        failures.append(f"manifest unreadable: {e}")
+        return
+    for pname, entries in meta.get("manifest", {}).items():
+        for entry in entries:
+            path = os.path.join(root, type_name, pname, entry["file"])
+            if not os.path.exists(path):
+                failures.append(
+                    f"manifest references missing file {path}")
+                continue
+            try:
+                rows = pq.read_metadata(path).num_rows
+            except Exception as e:
+                failures.append(f"unreadable data file {path}: {e}")
+                continue
+            if rows != entry["count"]:
+                failures.append(
+                    f"manifest count {entry['count']} != file rows "
+                    f"{rows} for {path}")
+
+
+def _run_workload(plan: FaultPlan, root: str, requests: int,
+                  report: ChaosReport, say) -> List[tuple]:
+    """One seeded pass: build stores, serve the request mix under the
+    installed harness, close, validate the manifest. Returns the fire
+    log (the replay-determinism artifact)."""
+    from geomesa_tpu.compilecache.persist import persistent_cache_dir
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.kafka.store import KafkaDataStore
+    from geomesa_tpu.serve.service import QueryService, ServeConfig
+
+    store, sft = _synth_store(os.path.join(root, "cat"))
+    live_sft = SimpleFeatureType.from_spec(
+        "chaos_live", "name:String,*geom:Point")
+    kstore = KafkaDataStore()
+    ksrc = kstore.create_schema(live_sft)
+    rng = np.random.default_rng(plan.seed + 17)
+    qpts = rng.uniform(-60, 60, (requests, 2))
+    cql = "BBOX(geom, -170, -80, 170, 80)"
+    prior_cache = persistent_cache_dir()
+
+    prior_breakers = {name: BREAKERS.current_config(name)
+                      for name in _DEPS}
+    svc = None
+
+    def outcome(fn):
+        report.requests += 1
+        try:
+            fn()
+            report.ok += 1
+        except Exception as e:  # noqa: BLE001 — the taxonomy decides
+            if _errors.is_typed(e):
+                key = type(e).__name__
+                report.typed_errors[key] = (
+                    report.typed_errors.get(key, 0) + 1)
+            else:
+                report.untyped_errors.append(f"{type(e).__name__}: {e}")
+
+    # everything that mutates process-wide state (breaker tuning, the
+    # service's dispatch thread, the harness) happens INSIDE this try:
+    # a setup failure — e.g. another harness already installed — must
+    # not leak chaos breakers or an orphaned dispatcher into the process
+    try:
+        for name in _DEPS:
+            BREAKERS.configure(name, **_CHAOS_BREAKER)
+        svc = QueryService(store, ServeConfig(
+            max_wait_ms=0.0, max_batch=1, drain_timeout_s=30.0))
+        log = _drive(plan, root, requests, report, svc, store, sft,
+                     kstore, ksrc, qpts, cql, rng, outcome)
+    finally:
+        if svc is not None:
+            try:
+                svc.close(drain=False)
+            except Exception:
+                pass
+        for name in _DEPS:
+            # hand back whatever tuning the process had, not the
+            # constructor defaults
+            BREAKERS.restore_config(name, prior_breakers[name])
+        # cache restore runs HERE — after _drive's harness context has
+        # exited — so a plan injecting at compilecache.persist cannot
+        # swallow the restore (enable degrades to None under injection
+        # by contract). prior_cache came from persistent_cache_dir(),
+        # which is ALREADY platform-suffixed: per_platform=False, or
+        # the restore would point jax at <dir>/<backend>/<backend> and
+        # orphan every previously persisted executable.
+        from geomesa_tpu.compilecache.persist import (
+            disable_persistent_cache, enable_persistent_cache)
+
+        disable_persistent_cache()
+        if prior_cache is not None:
+            enable_persistent_cache(cache_dir=prior_cache,
+                                    per_platform=False, force=True)
+    _check_manifest(os.path.join(root, "cat"), "chaos",
+                    report.invariant_failures)
+    say(f"workload: {report.ok}/{report.requests} ok, "
+        f"typed={sum(report.typed_errors.values())}, "
+        f"untyped={len(report.untyped_errors)}, "
+        f"fires={len(log)}")
+    return log
+
+
+def _drive(plan, root, requests, report, svc, store, sft, kstore, ksrc,
+           qpts, cql, rng, outcome) -> List[tuple]:
+    """The harness-scoped middle of one chaos pass: enable the compile
+    cache under injection, serve the request mix, interleave writers,
+    drain; returns the fire log. Cache/breaker restoration is the
+    CALLER's job, outside the harness scope."""
+    from geomesa_tpu.compilecache.persist import enable_persistent_cache
+
+    with _harness.active(plan) as h:
+        try:
+            # compile-cache boundary: an injected failure must DEGRADE
+            # (enable returns None), never raise
+            cache_dir = os.path.join(root, "jaxcache")
+            try:
+                enable_persistent_cache(cache_dir=cache_dir, force=True)
+                enable_persistent_cache(cache_dir=cache_dir, force=True)
+            except Exception as e:  # noqa: BLE001 — contract violation
+                report.untyped_errors.append(
+                    f"compile-cache enable raised: {type(e).__name__}")
+            for i in range(requests):
+                op = i % 4
+                if op == 0:
+                    outcome(lambda: svc.count(
+                        "chaos", cql, timeout_ms=30_000).result(60))
+                elif op == 1:
+                    outcome(lambda i=i: svc.knn(
+                        "chaos", cql, qpts[i:i + 1, 0], qpts[i:i + 1, 1],
+                        k=5, timeout_ms=30_000).result(60))
+                elif op == 2:
+                    outcome(lambda: svc.query(
+                        "chaos", cql, timeout_ms=30_000).result(60))
+                else:
+                    outcome(lambda: ksrc.get_count("INCLUDE"))
+                if i % 5 == 4:
+                    # interleaved writers: FS batch-atomic appends and
+                    # Kafka produces, both under injection
+                    try:
+                        store.get_feature_source("chaos").write(
+                            _synth_batch(sft, rng, 16))
+                        report.writes_ok += 1
+                    except Exception as e:  # noqa: BLE001
+                        if _errors.is_typed(e):
+                            report.writes_failed += 1
+                        else:
+                            report.untyped_errors.append(
+                                f"write: {type(e).__name__}: {e}")
+                    try:
+                        kstore.write("chaos_live", _synth_batch(
+                            ksrc.sft, rng, 4))
+                        report.writes_ok += 1
+                    except Exception as e:  # noqa: BLE001
+                        if _errors.is_typed(e):
+                            report.writes_failed += 1
+                        else:
+                            report.untyped_errors.append(
+                                f"kafka write: {type(e).__name__}: {e}")
+            svc.close(drain=True)
+            if svc._worker is not None and svc._worker.is_alive():
+                report.invariant_failures.append(
+                    "dispatch thread still alive after drain")
+            if len(svc.queue) != 0:
+                report.invariant_failures.append(
+                    "queue not empty after graceful drain")
+        finally:
+            try:
+                svc.close(drain=False)
+            except Exception:
+                pass
+        return h.fire_log()
+
+
+def _counter_snapshot() -> Dict[str, float]:
+    from geomesa_tpu.utils.metrics import metrics
+
+    with metrics._lock:
+        return dict(metrics.counters)
+
+
+def run_chaos(plan: FaultPlan, requests: int = 32, replay: bool = True,
+              out=None) -> ChaosReport:
+    """Programmatic `gmtpu chaos`: returns a ChaosReport whose
+    `ok_overall` reflects every invariant (the CLI exit code)."""
+    out = out if out is not None else sys.stderr
+
+    def say(msg):
+        print(f"chaos: {msg}", file=out)
+
+    report = ChaosReport()
+    before = _counter_snapshot()
+    with tempfile.TemporaryDirectory() as tmp:
+        log = _run_workload(plan, os.path.join(tmp, "run1"),
+                            requests, report, say)
+        if replay:
+            replay_report = ChaosReport()
+            log2 = _run_workload(plan, os.path.join(tmp, "run2"),
+                                 requests, replay_report, say)
+            report.replay_match = log == log2
+            if not report.replay_match:
+                report.invariant_failures.append(
+                    f"replay diverged: {len(log)} vs {len(log2)} fires "
+                    f"(first diff at "
+                    f"{next((i for i, (a, b) in enumerate(zip(log, log2)) if a != b), min(len(log), len(log2)))})")
+            report.invariant_failures.extend(
+                f"replay: {f}" for f in replay_report.invariant_failures)
+            report.untyped_errors.extend(
+                f"replay: {u}" for u in replay_report.untyped_errors)
+    report.fires = len(log)
+    report.fired_sites = sorted({s for s, _, _ in log})
+
+    # invariant 1: zero un-typed escapes
+    for u in report.untyped_errors:
+        report.invariant_failures.append(f"un-typed escape: {u}")
+    # invariant 3: every deterministic rule fired
+    import fnmatch
+
+    for rule in plan.rules:
+        if rule.nth_call is None and rule.every is None:
+            continue  # probabilistic rules may legitimately stay quiet
+        hit = any(
+            (site == rule.site or fnmatch.fnmatchcase(site, rule.site))
+            and err == rule.error
+            for site, _, err in log)
+        if not hit:
+            report.invariant_failures.append(
+                f"rule for {rule.site!r} ({rule.error}) never fired — "
+                f"the workload does not exercise that site")
+    # invariant 4: breaker transitions visible in metrics
+    after = _counter_snapshot()
+    for name in plan.expect_breakers:
+        for phase in ("open", "half_open"):
+            key = f"fault.breaker.{name}.{phase}"
+            delta = after.get(key, 0.0) - before.get(key, 0.0)
+            report.breaker_counters[key] = delta
+            if delta < 1:
+                report.invariant_failures.append(
+                    f"breaker {name!r} never transitioned to {phase} "
+                    f"(metrics counter {key} unchanged)")
+    # invariant 6: the disabled harness must cost ~nothing
+    site = _harness.site("chaos.noop.probe")
+    t0 = time.perf_counter()
+    for _ in range(_NOOP_CALLS):
+        site.fire()
+    per_call_us = (time.perf_counter() - t0) / _NOOP_CALLS * 1e6
+    report.noop_us_per_call = round(per_call_us, 4)
+    if per_call_us > _NOOP_BUDGET_US:
+        report.invariant_failures.append(
+            f"no-op site check costs {per_call_us:.2f}µs/call "
+            f"(budget {_NOOP_BUDGET_US}µs): the inactive fast path "
+            "is doing work")
+    say("OK" if report.ok_overall else
+        f"FAIL: {'; '.join(report.invariant_failures)}")
+    return report
+
+
+def run_cli(args) -> int:
+    if getattr(args, "list_sites", False):
+        # import the boundary modules so their sites register
+        import geomesa_tpu.compilecache.manifest  # noqa: F401
+        import geomesa_tpu.compilecache.persist  # noqa: F401
+        import geomesa_tpu.engine.device  # noqa: F401
+        import geomesa_tpu.index.kvstore  # noqa: F401
+        import geomesa_tpu.kafka.store  # noqa: F401
+        import geomesa_tpu.store.fs  # noqa: F401
+
+        for name, doc in sorted(_harness.SITES.items()):
+            print(f"{name:<32} {doc}")
+        return 0
+    plan = FaultPlan.load(args.plan)
+    if getattr(args, "seed", None) is not None:
+        plan.seed = args.seed
+    report = run_chaos(plan, requests=args.requests,
+                       replay=not getattr(args, "no_replay", False))
+    print(json.dumps(report.to_json(), indent=1))
+    if args.check:
+        return 0 if report.ok_overall else 1
+    return 0

@@ -23,12 +23,28 @@ import numpy as np
 from geomesa_tpu.core.columnar import DictColumn, FeatureBatch
 from geomesa_tpu.core.sft import SimpleFeatureType
 from geomesa_tpu.cql import ast, compile_filter
+from geomesa_tpu.faults import BREAKERS, RetryPolicy, retry_call
+from geomesa_tpu.faults import harness as _faults
 from geomesa_tpu.index.adapter import IndexAdapter, MemoryIndexAdapter
 from geomesa_tpu.index.keyspace import IndexKeySpace, default_indices
 from geomesa_tpu.index.splitter import FilterSplitter, StrategyDecider
 from geomesa_tpu.plan.explain import Explainer
 from geomesa_tpu.plan.query import Query
 from geomesa_tpu.utils.padding import next_pow2 as _next_pow2
+
+
+# KV boundary fault sites (docs/ROBUSTNESS.md). Range scans are
+# idempotent reads and retry against the storage breaker; the write
+# transaction is DELIBERATELY non-retryable — on a durable adapter the
+# failed transaction rolls back atomically, and the documented contract
+# is "discard the source and reopen" (docstring below), which a blind
+# replay inside half-advanced in-memory bookkeeping would violate
+# (.gmtpu-waivers records this).
+_KV_SCAN_SITE = _faults.site(
+    "kvstore.scan", "index range scan (IndexAdapter.scan)")
+_KV_WRITE_SITE = _faults.site(
+    "kvstore.write", "index write transaction (fan-out + row store)")
+_KV_RETRY = RetryPolicy(max_attempts=4, base_ms=5.0, cap_ms=250.0)
 
 
 class KVFeatureSource:
@@ -117,6 +133,7 @@ class KVFeatureSource:
             else contextlib.nullcontext()
         )
         with txn:
+            _KV_WRITE_SITE.fire()
             # replace-by-id: tombstone + de-index any previous row per fid
             stale = [self._fid_row[f] for f in fids if f in self._fid_row]
             if stale:
@@ -266,10 +283,16 @@ class KVFeatureSource:
         query, f, chosen = self.plan(query)
         if chosen is not None:
             name = chosen.name
-            rows = [
-                r for r in self.adapter.scan(name, chosen.ranges)
-                if r not in self._dead
-            ]
+
+            def _scan():
+                _KV_SCAN_SITE.fire()
+                return [
+                    r for r in self.adapter.scan(name, chosen.ranges)
+                    if r not in self._dead
+                ]
+
+            rows = retry_call(_scan, policy=_KV_RETRY, label="storage",
+                              breaker=BREAKERS.get("storage"))
         else:
             rows = self._all_rows()
         if not rows:

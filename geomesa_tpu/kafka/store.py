@@ -25,6 +25,8 @@ from geomesa_tpu.core.sft import SimpleFeatureType
 from geomesa_tpu.core.wkt import Geometry, point
 from geomesa_tpu.cql import ast, parse_cql
 from geomesa_tpu.cql.extract import BBox, Interval
+from geomesa_tpu.faults import BREAKERS, RetryPolicy, retry_call
+from geomesa_tpu.faults import harness as _faults
 from geomesa_tpu.kafka.cache import KafkaFeatureCache
 from geomesa_tpu.kafka.messages import (
     Change,
@@ -37,6 +39,19 @@ from geomesa_tpu.plan.audit import AuditWriter
 from geomesa_tpu.plan.datastore import FeatureSource
 from geomesa_tpu.plan.planner import QueryPlanner
 from geomesa_tpu.plan.query import Query
+
+
+# broker-boundary fault sites + retry policy (docs/ROBUSTNESS.md): a
+# real Kafka client drops connections and rebalances; the in-process
+# broker never does — the harness makes those failure modes injectable
+# on the exact code path a real client would take. Retries run OUTSIDE
+# the store lock (see poll) so a flapping broker never stalls other
+# topics' consumers behind a backoff sleep.
+_POLL_SITE = _faults.site(
+    "kafka.poll", "broker consume (offset window read)")
+_PRODUCE_SITE = _faults.site(
+    "kafka.produce", "broker produce (one GeoMessage)")
+_KAFKA_RETRY = RetryPolicy(max_attempts=4, base_ms=5.0, cap_ms=200.0)
 
 
 class InProcessBroker:
@@ -319,36 +334,69 @@ class KafkaDataStore:
 
     # -- producer side -----------------------------------------------------
 
+    def _produce(self, name: str, payload: bytes) -> int:
+        """One broker produce under the recovery fabric: transient
+        broker failures retry with backoff against the "kafka" breaker.
+        Produces are latest-wins upserts keyed by fid, so a duplicate
+        from an ambiguous failure (produced, then the ack was lost) is
+        absorbed by the fold — retrying is safe."""
+
+        def attempt():
+            _PRODUCE_SITE.fire()
+            return self.broker.produce(name, payload)
+
+        return retry_call(attempt, policy=_KAFKA_RETRY, label="kafka",
+                          breaker=BREAKERS.get("kafka"))
+
     def write(self, name: str, batch: FeatureBatch) -> None:
         """Produce one Change per feature (latest-wins upsert semantics)."""
         with self._lock:
             ser: GeoMessageSerializer = self._state[name]["serializer"]
         for fid, attrs in _batch_rows(batch):
-            self.broker.produce(name, ser.serialize(Change(fid, attrs)))
+            self._produce(name, ser.serialize(Change(fid, attrs)))
 
     def delete(self, name: str, fid: str) -> None:
         with self._lock:
             ser = self._state[name]["serializer"]
-        self.broker.produce(name, ser.serialize(Delete(fid)))
+        self._produce(name, ser.serialize(Delete(fid)))
 
     def clear(self, name: str) -> None:
         with self._lock:
             ser = self._state[name]["serializer"]
-        self.broker.produce(name, ser.serialize(Clear()))
+        self._produce(name, ser.serialize(Clear()))
 
     # -- consumer side -----------------------------------------------------
 
     def poll(self, name: str) -> int:
         """Consume new messages into the cache; returns messages applied.
-        One atomic consume -> fold -> offset advance per topic: two query
-        threads polling concurrently must not double-apply a message
-        window (latest-wins would hide it for Change, not for Clear+
-        replay interleavings) or skip one by racing the offset bump."""
+        The fold -> offset advance stays one atomic unit per topic: two
+        query threads polling concurrently must not double-apply a
+        message window (latest-wins would hide it for Change, not for
+        Clear+replay interleavings) or skip one by racing the offset
+        bump. The broker CONSUME (the part that can fail and back off)
+        runs outside the lock against the pinned start offset; before
+        folding, the offset is re-checked — if another poller applied a
+        window meanwhile, this one discards its (now superseded) read
+        instead of double-applying."""
         with self._lock:
             st = self._state[name]
-            msgs = self.broker.consume(name, st["offset"])
+            start = st["offset"]
             ser: GeoMessageSerializer = st["serializer"]
             cache: KafkaFeatureCache = st["cache"]
+
+        def attempt():
+            _POLL_SITE.fire()
+            return self.broker.consume(name, start)
+
+        msgs = retry_call(attempt, policy=_KAFKA_RETRY, label="kafka",
+                          breaker=BREAKERS.get("kafka"))
+        with self._lock:
+            if st["offset"] != start:
+                # a concurrent poll won the race and advanced the
+                # offset; its fold covered log[start:its_end] — ours
+                # would re-apply that prefix. The messages past its end
+                # are picked up by the next poll (offset is authority).
+                return 0
             for payload in msgs:
                 cache.apply(ser.deserialize(payload))
             st["offset"] += len(msgs)

@@ -55,6 +55,16 @@ class ServeEvent:
     # that should have been in the warmup manifest
     compile_ms: float = 0.0
     compiled: str = ""  # comma-joined stall labels (bounded)
+    # recovery attribution (docs/ROBUSTNESS.md, mirrors the compile_ms
+    # pattern): how much of this request's latency went to the retry/
+    # breaker fabric. `retries` = backoff attempts spent at dependency
+    # boundaries during the dispatch window; `fault_injected` = injected
+    # faults observed in the window (0 outside chaos runs);
+    # `breaker_state` = non-closed breakers at completion, e.g.
+    # "storage=open" ("" when all dependencies are healthy).
+    retries: int = 0
+    fault_injected: int = 0
+    breaker_state: str = ""
     user: str = ""
     timestamp: float = 0.0
 

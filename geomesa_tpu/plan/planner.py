@@ -244,11 +244,26 @@ class QueryPlanner:
         """Plan and run one query. `timeout_ms` overrides the
         geomesa.query.timeout system property for THIS query — the serve
         scheduler propagates each request's remaining deadline budget here
-        so the planner's cooperative checks enforce it (0 = no timeout)."""
+        so the planner's cooperative checks enforce it (0 = no timeout).
+        The deadline also scopes the dependency retry fabric (faults/):
+        a storage/Kafka/device retry loop deep in the stack never sleeps
+        past this request's remaining budget."""
+        from geomesa_tpu.faults import deadline_scope
         from geomesa_tpu.utils.config import SystemProperties
 
         if timeout_ms is None:
             timeout_ms = int(SystemProperties.QUERY_TIMEOUT_MS.get())
+        deadline = (time.monotonic() + timeout_ms / 1000.0
+                    if timeout_ms else None)
+        with deadline_scope(deadline):
+            return self._execute_deadlined(query, explain, timeout_ms)
+
+    def _execute_deadlined(
+        self,
+        query: Query,
+        explain: Optional[Explainer],
+        timeout_ms: Optional[int],
+    ) -> QueryResult:
         self._enable_compile_cache()
         t0 = time.perf_counter()
 
@@ -548,6 +563,25 @@ class QueryPlanner:
         return result, total, t_scan
 
     def knn(
+        self,
+        query: "Query | str",
+        qx,
+        qy,
+        k: int = 10,
+        impl: str = "sparse",
+        timeout_ms: Optional[int] = None,
+    ):
+        """Deadline-scoped wrapper over `_knn` (same contract as
+        `execute`: the request budget bounds boundary retries too)."""
+        from geomesa_tpu.faults import deadline_scope
+
+        deadline = (time.monotonic() + timeout_ms / 1000.0
+                    if timeout_ms else None)
+        with deadline_scope(deadline):
+            return self._knn(query, qx, qy, k=k, impl=impl,
+                             timeout_ms=timeout_ms)
+
+    def _knn(
         self,
         query: "Query | str",
         qx,

@@ -34,6 +34,21 @@ class KnnResult:
     indices: np.ndarray  # [Q, k] into `features`
     distances_m: np.ndarray  # [Q, k] (inf where fewer than k within range)
     features: FeatureBatch  # the candidate set the indices refer to
+    # True when the widen-and-retry loop hit its iteration cap before
+    # every query's recall condition held: the neighbors returned are
+    # the best found within the searched radius, but a closer point MAY
+    # exist between the last searched radius and max_search_distance_m.
+    # Callers needing guaranteed recall should raise estimated_distance
+    # or lower max_search_distance instead of looping forever.
+    partial_recall: bool = False
+
+
+# Bound on the widen-and-retry rounds: radius doubles per round, so 48
+# rounds cover >14 decimal orders of magnitude from any sane estimate —
+# hitting the cap means the window can never fill (e.g. an infinite
+# max_search_distance over a region with < k points), and the honest
+# answer is a partial_recall result, not an unbounded loop.
+MAX_WIDEN_ROUNDS = 48
 
 
 class KNearestNeighborSearchProcess:
@@ -104,6 +119,7 @@ class KNearestNeighborSearchProcess:
                 and getattr(data_features.storage, "count", 0) >= (1 << 20)
             )
         )
+        rounds = 0
         while True:
             bbox = BBox(
                 float(qx.min()), float(qy.min()), float(qx.max()), float(qy.max())
@@ -120,8 +136,9 @@ class KNearestNeighborSearchProcess:
             else:
                 candidates = window_query(data_features, bbox, cql_filter)
                 if candidates is None or len(candidates) == 0:
-                    if radius >= max_search_distance_m:
-                        return self._solve(
+                    if (radius >= max_search_distance_m
+                            or rounds >= MAX_WIDEN_ROUNDS):
+                        empty = self._solve(
                             qx, qy,
                             candidates
                             if candidates is not None
@@ -129,6 +146,10 @@ class KNearestNeighborSearchProcess:
                             num_desired, max_search_distance_m, query_tile,
                             impl,
                         )
+                        if rounds >= MAX_WIDEN_ROUNDS:
+                            empty.partial_recall = True
+                        return empty
+                    rounds += 1
                     radius = min(radius * 2, max_search_distance_m)
                     continue
                 result = self._solve(
@@ -142,6 +163,13 @@ class KNearestNeighborSearchProcess:
             unsafe = (kth > radius) & np.isfinite(kth)
             short = ~np.isfinite(kth)
             if (unsafe.any() or short.any()) and radius < max_search_distance_m:
+                if rounds >= MAX_WIDEN_ROUNDS:
+                    # the window never fills (see MAX_WIDEN_ROUNDS):
+                    # surface what was found, flagged, instead of
+                    # doubling the radius forever
+                    result.partial_recall = True
+                    return result
+                rounds += 1
                 radius = min(radius * 2, max_search_distance_m)
                 continue
             return result

@@ -104,19 +104,66 @@ def execute_batch(source, reqs: List[ServeRequest]) -> None:
     every member future. `reqs` share a compat key (or are a singleton).
     Exceptions fan out to every member — a failed shared dispatch fails
     all riders identically, like N serial runs of the same query would.
-    """
+
+    Device OOM is the exception to the fan-out: a batch that exhausts
+    device memory HALVES its bucket (the padded stacked-query axis
+    shrinks with it) and retries each half; a request that still OOMs
+    alone falls back to exact host evaluation (faults/fallback.py), so
+    a memory-squeezed accelerator degrades to slower answers instead of
+    failed ones."""
     running = [r for r in reqs if r.future.set_running_or_notify_cancel()]
     if not running:
         return
-    timeout_ms = batch_timeout_ms(running)
+    _run_group(source, running)
+
+
+def _run_group(source, reqs: List[ServeRequest]) -> None:
+    from geomesa_tpu.faults import classify
+
+    timeout_ms = batch_timeout_ms(reqs)
     try:
-        if running[0].kind == "knn":
-            _execute_knn(source, running, timeout_ms)
+        if reqs[0].kind == "knn":
+            _execute_knn(source, reqs, timeout_ms)
         else:
-            _execute_shared(source, running, timeout_ms)
+            _execute_shared(source, reqs, timeout_ms)
     except BaseException as e:  # noqa: BLE001 — fan the failure out
-        for r in running:
+        if isinstance(e, Exception) and classify(e) == "oom":
+            _oom_fallback(source, reqs, e)
+            return
+        for r in reqs:
             r.future.set_exception(e)
+
+
+def _oom_fallback(source, reqs: List[ServeRequest],
+                  oom: BaseException) -> None:
+    from geomesa_tpu.utils.metrics import metrics
+
+    if reqs[0].kind == "knn" and len(reqs) > 1:
+        # halve the batch bucket: each kNN half pads to a smaller pow2
+        # stacked-query axis, so the retried program is genuinely
+        # smaller — not the same allocation failing twice. Only kNN
+        # qualifies: count/execute groups DEDUP to one planner run
+        # whose program size is independent of rider count, so halving
+        # them would just re-fail the identical allocation
+        metrics.counter("serve.oom.halved")
+        mid = len(reqs) // 2
+        _run_group(source, reqs[:mid])
+        _run_group(source, reqs[mid:])
+        return
+    # host evaluation, ONCE per group: shared count/execute riders get
+    # the same (immutable) result object, exactly like _execute_shared
+    try:
+        from geomesa_tpu.faults.fallback import host_fallback
+
+        out = host_fallback(source, reqs[0])
+    except BaseException as e:  # noqa: BLE001 — surface typed, not raw
+        exc = e if isinstance(e, Exception) else oom
+        for r in reqs:
+            r.future.set_exception(exc)
+        return
+    metrics.counter("serve.oom.hosteval")
+    for r in reqs:
+        r.future.set_result(out)
 
 
 def _execute_shared(source, reqs: List[ServeRequest],

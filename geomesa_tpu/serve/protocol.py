@@ -135,9 +135,19 @@ def parse_request(doc: dict) -> ServeRequest:
 
 
 def _error_response(rid, exc) -> dict:
+    from geomesa_tpu.faults import BreakerOpen
+
     if isinstance(exc, QueryRejected):
         return {"id": rid, "ok": False, "error": "rejected",
                 "reason": exc.reason, "message": str(exc)}
+    if isinstance(exc, BreakerOpen):
+        # fail-fast dependency outage: tell the client WHEN to retry —
+        # the three-way rejected/timeout/error split gains a fourth leg
+        # for "not you, not your query: the backend is resting"
+        return {"id": rid, "ok": False, "error": "unavailable",
+                "reason": exc.reason,
+                "retryAfterS": round(exc.retry_after_s, 3),
+                "message": str(exc)}
     if isinstance(exc, QueryTimeout):
         return {"id": rid, "ok": False, "error": "timeout",
                 "phase": exc.phase, "message": str(exc)}

@@ -50,6 +50,9 @@ class QueryRejected(RuntimeError):
       shed          — degradation ladder dropping low-priority work
       shutting_down — service draining; no new admissions
       cancelled     — caller cancelled while queued
+      quarantined   — poison-query quarantine: this fingerprint crashed
+                      repeatedly and is blocked for the quarantine TTL
+                      (faults/quarantine.py, docs/ROBUSTNESS.md)
     """
 
     def __init__(self, reason: str, detail: str = ""):
@@ -81,6 +84,12 @@ class ServeRequest:
     future: Future = dataclasses.field(default_factory=Future)
     enqueued_at: float = 0.0
     degraded: bool = False  # set by the service when the ladder rewrote hints
+    # pre-degrade poison fingerprint, stashed by the service's ladder
+    # BEFORE it rewrites hints: the coalescing key includes the hint
+    # string, so striking the post-degrade key would never match the
+    # key admission checks (quarantine would silently never trip for
+    # degraded requests)
+    quarantine_key: object = None
 
     def __post_init__(self):
         if self.kind not in ("execute", "count", "knn"):

@@ -56,6 +56,12 @@ class ServeConfig:
     default_timeout_ms: Optional[int] = None  # per-request deadline default
     tenant_rate: Optional[float] = None  # qps per tenant; None = unlimited
     tenant_burst: float = 8.0
+    # poison-query quarantine (docs/ROBUSTNESS.md): a fingerprint that
+    # crashes `quarantine_after` dispatches within the TTL is rejected
+    # at admission with QueryRejected("quarantined") instead of
+    # re-entering the dispatcher; 0 disables
+    quarantine_after: int = 3
+    quarantine_ttl_s: float = 600.0
     degrade: bool = False       # master switch for the degradation ladder
     degrade_watermark: float = 0.75  # queue occupancy -> hint downgrades
     shed_watermark: float = 0.90     # queue occupancy -> shed batch class
@@ -69,6 +75,13 @@ class ServeConfig:
     track_compiles: bool = False
 
 
+def _quarantine_key(req: ServeRequest):
+    """Poison fingerprint: the coalescing key (canonical CQL + kind +
+    kernel choice — exactly what would share the crashing dispatch), or
+    a coarse (kind, type) key for requests that never coalesce."""
+    return compat_key(req) or ("solo", req.kind, req.query.type_name)
+
+
 class QueryService:
     """In-process serving API over a DataStore (or any store exposing
     get_feature_source). Thread-safe: submit from any thread."""
@@ -80,6 +93,11 @@ class QueryService:
         self.queue = AdmissionQueue(self.config.max_queue)
         self.limiter = RateLimiter(
             self.config.tenant_rate, self.config.tenant_burst)
+        from geomesa_tpu.faults import QuarantineRegistry
+
+        self.quarantine = QuarantineRegistry(
+            strikes=max(self.config.quarantine_after, 1),
+            ttl_s=self.config.quarantine_ttl_s)
         self.audit = getattr(store, "audit", None)
         self._closed = False
         self._stop = threading.Event()
@@ -94,6 +112,10 @@ class QueryService:
                 enable_persistent_cache)
 
             enable_persistent_cache()
+        # gt: waive GT14
+        # (deliberate degrade: the persistent compile cache is an
+        # optimization that must never fail service construction —
+        # compilecache/persist.py documents the never-raises contract)
         except Exception:
             pass
         self.tracker = None          # JitTracker over the engine jits
@@ -224,6 +246,12 @@ class QueryService:
         if closed:
             self._bump("rejected")
             raise QueryRejected("shutting_down", "service closed")
+        if self.config.quarantine_after and not self.quarantine.empty():
+            detail = self.quarantine.blocked(_quarantine_key(req))
+            if detail is not None:
+                self._bump("rejected")
+                self._bump("quarantined")
+                raise QueryRejected("quarantined", detail)
         try:
             self.limiter.admit(req.tenant)
         except QueryRejected:
@@ -299,6 +327,10 @@ class QueryService:
         h = req.query.hints
         if h.is_density or h.is_stats or h.is_bin or h.is_arrow:
             return
+        # stash the PRE-degrade fingerprint: strikes must land on the
+        # same key admission checks (see ServeRequest.quarantine_key)
+        if self.config.quarantine_after and req.quarantine_key is None:
+            req.quarantine_key = _quarantine_key(req)
         changes = {"loose_bbox": True}
         if level >= 2 and h.sampling is None:
             changes["sampling"] = 4
@@ -377,7 +409,11 @@ class QueryService:
             metrics.histogram("serve.queue.wait").update(t0 - r.enqueued_at)
         if self._recorder is not None:
             self._record_queries(live)
+        from geomesa_tpu.faults import (
+            BREAKERS, RECOVERY, BreakerOpen, classify)
+
         stall_token = STALLS.token()
+        rec_token = RECOVERY.token()
         try:
             # an unknown type name raises HERE, not in execute_batch's
             # guarded body — it must fail these futures, not the
@@ -399,6 +435,19 @@ class QueryService:
         # the process-wide meter
         stalls = STALLS.since(stall_token,
                               thread_ident=threading.get_ident())
+        # recovery attribution, same thread-scoped window discipline as
+        # the compile stalls: retries/faults noted by this dispatch
+        # thread are charged to the requests that rode the dispatch
+        # (boundary work on helper threads — the streaming-count decode-
+        # ahead — is metered globally but not attributed per-request)
+        recovery = RECOVERY.since(rec_token,
+                                  thread_ident=threading.get_ident())
+        retries = sum(1 for kind, _ in recovery if kind == "retry")
+        faults_seen = sum(1 for kind, _ in recovery if kind == "fault")
+        breaker_state = ",".join(
+            f"{name}={state}"
+            for name, state in sorted(BREAKERS.states().items())
+            if state != "closed")
         compile_ms = sum(s for _, s in stalls) * 1000.0
         labels = list(dict.fromkeys(lbl for lbl, _ in stalls))
         compiled = ",".join(labels[:5])
@@ -413,6 +462,7 @@ class QueryService:
         if len(live) > 1:
             metrics.counter("serve.coalesced", len(live) - 1)
         metrics.gauge("serve.queue.depth", float(len(self.queue)))
+        struck: set = set()
         for r in live:
             if r.future.cancelled():
                 # cancelled between queue pop and execute: .exception()
@@ -425,6 +475,35 @@ class QueryService:
                 status = ("timeout" if isinstance(exc, QueryTimeout)
                           else "error")
                 self._bump("failed")
+                # poison-query accounting: a crash (permanent/OOM after
+                # every recovery layer gave up) strikes the request's
+                # coalescing fingerprint; shed/timeout/transient and
+                # breaker-open rejections say nothing about the QUERY
+                # being poisonous — they are load/dependency signals.
+                # The OSError family is exempt even when classified
+                # permanent (FileNotFoundError from a compaction race,
+                # PermissionError): infrastructure answers, not kernel
+                # crashes — a healthy hot query must not get itself
+                # quarantined by three raced reads.
+                if (self.config.quarantine_after
+                        and not isinstance(exc, (QueryRejected,
+                                                 QueryTimeout,
+                                                 BreakerOpen,
+                                                 OSError))
+                        and classify(exc) != "transient"):
+                    # ONE strike per crashing dispatch, not one per
+                    # coalesced rider: N riders share the fingerprint
+                    # by construction, and striking each would let a
+                    # single crash of a >=quarantine_after batch
+                    # quarantine the query immediately. Degraded
+                    # requests strike their PRE-degrade fingerprint —
+                    # the one admission checks.
+                    key = (r.quarantine_key
+                           if r.quarantine_key is not None
+                           else _quarantine_key(r))
+                    if key not in struck:
+                        struck.add(key)
+                        self.quarantine.strike(key)
             else:
                 self._bump("completed")
             if self.audit is not None:
@@ -440,6 +519,9 @@ class QueryService:
                     degraded=r.degraded,
                     compile_ms=compile_ms,
                     compiled=compiled,
+                    retries=retries,
+                    fault_injected=faults_seen,
+                    breaker_state=breaker_state,
                 ))
 
     def _record_queries(self, live: List[ServeRequest]) -> None:
@@ -487,6 +569,7 @@ class QueryService:
         out.setdefault("coalesced", 0)
         out["queue_depth"] = len(self.queue)
         out["degrade_level"] = self.degrade_level()
+        out["quarantine"] = self.quarantine.stats()
         if self.tracker is not None:
             out["recompiles"] = self.tracker.total_recompiles()
         return out

@@ -35,10 +35,27 @@ from geomesa_tpu.core.columnar import DictColumn, FeatureBatch, GeometryColumn
 from geomesa_tpu.core.sft import SimpleFeatureType
 from geomesa_tpu.core.wkt import parse_wkt, to_wkt
 from geomesa_tpu.cql.extract import BBox, Interval
+from geomesa_tpu.faults import BREAKERS, RetryPolicy, retry_call
+from geomesa_tpu.faults import harness as _faults
 from geomesa_tpu.store.partition import PartitionScheme, scheme_from_config
 
 METADATA = "metadata.json"
 FID = "__fid__"
+
+# fault-injection sites + retry policy for the storage boundary
+# (docs/ROBUSTNESS.md). Reads and partition-file writes retry transient
+# I/O against the "storage" breaker. The manifest commit is DELIBERATELY
+# non-retryable: it runs under the manifest lock (sleeping there stalls
+# every reader/writer) and the tmp+os.replace swap is already
+# all-or-nothing — a failed commit leaves the previous manifest intact,
+# never a torn one (.gmtpu-waivers documents this contract).
+_READ_SITE = _faults.site(
+    "fs.read_partition", "partition data file read (parquet/orc)")
+_WRITE_SITE = _faults.site(
+    "fs.write_partition", "partition data file write (staging)")
+_MANIFEST_SITE = _faults.site(
+    "fs.write_manifest", "metadata.json manifest commit (atomic swap)")
+_STORAGE_RETRY = RetryPolicy(max_attempts=4, base_ms=5.0, cap_ms=250.0)
 
 
 class ManifestSnapshot(Dict[str, List[dict]]):
@@ -209,6 +226,10 @@ class FileSystemStorage:
             "manifest": self.manifest,
         }
         tmp = os.path.join(self.root, METADATA + ".tmp")
+        # injection point for the chaos harness: a failure HERE (before
+        # or during the tmp write) must leave the previous manifest
+        # untouched — the no-torn-manifest invariant gmtpu chaos checks
+        _MANIFEST_SITE.fire()
         # gt: waive GT09
         # (deliberate: persisting under the manifest lock is the point —
         # the snapshot must not move while it serializes; the final
@@ -243,29 +264,38 @@ class FileSystemStorage:
             pdir = os.path.join(self.root, name)
             os.makedirs(pdir, exist_ok=True)
             fname = f"{uuid.uuid4().hex}.{self.encoding}"
-            if self.encoding == "orc":
-                from pyarrow import orc
-
-                orc.write_table(
-                    self._decode_dictionaries(_batch_to_table(sub)),
-                    os.path.join(pdir, fname),
-                    compression="zstd",
-                )
-            else:
-                pq.write_table(
-                    _batch_to_table(sub),
-                    os.path.join(pdir, fname),
-                    compression="zstd",
-                    row_group_size=64 * 1024,
-                )
+            # retryable: the file is not in the manifest yet, so a
+            # partial write from a failed attempt is an invisible
+            # orphan the successful attempt simply overwrites
+            retry_call(
+                self._write_data_file, sub, os.path.join(pdir, fname),
+                policy=_STORAGE_RETRY, label="storage",
+                breaker=BREAKERS.get("storage"))
             staged.append((str(name), fname, len(sub)))
         with self._lock:
             for name, fname, count in staged:
                 self.manifest.setdefault(name, []).append(
                     {"file": fname, "count": count}
                 )
+            try:
+                self._save_metadata()
+            except BaseException:
+                # the durable commit failed: ROLL BACK the in-memory
+                # append so memory never runs ahead of disk — otherwise
+                # this "failed" batch would keep serving from memory, a
+                # client retry would duplicate every row, and the next
+                # unrelated write would silently commit it. We hold the
+                # lock for the whole append+save, so our entries are
+                # still the tail of each partition list; the staged
+                # files become unreferenced orphans (harmless).
+                for name, fname, count in staged:
+                    entries = self.manifest.get(name, [])
+                    if entries and entries[-1].get("file") == fname:
+                        entries.pop()
+                    if not entries:
+                        self.manifest.pop(name, None)
+                raise
             self._mversion += 1
-            self._save_metadata()
 
     def compact(self, partition: Optional[str] = None) -> int:
         """Merge each touched partition's files into one (the FS store's
@@ -287,14 +317,9 @@ class FileSystemStorage:
             count = sum(e["count"] for e in entries)
             fname = f"{uuid.uuid4().hex}.{self.encoding}"
             out = os.path.join(self.root, name, fname)
-            if self.encoding == "orc":
-                from pyarrow import orc
-
-                orc.write_table(self._decode_dictionaries(merged), out,
-                               compression="zstd")
-            else:
-                pq.write_table(merged, out, compression="zstd",
-                               row_group_size=64 * 1024)
+            retry_call(self._write_table, merged, out,
+                       policy=_STORAGE_RETRY, label="storage",
+                       breaker=BREAKERS.get("storage"))
             # crash-safety ordering: write merged file, point the manifest
             # at it, persist — only then delete the old files. A crash
             # leaves either the old manifest (old files intact) or the new
@@ -303,11 +328,24 @@ class FileSystemStorage:
                 # writes only APPEND, so the snapshot is a prefix of the
                 # live list: keep any entry a concurrent write() added
                 # since (wholesale replace would orphan its file/rows)
+                prev = self.manifest.get(name)
                 tail = self.manifest.get(name, [])[len(entries):]
                 self.manifest[name] = [{"file": fname,
                                         "count": count}] + tail
+                try:
+                    self._save_metadata()
+                except BaseException:
+                    # memory must never run ahead of the durable
+                    # manifest (same rollback as write/delete): restore
+                    # the live pre-compact list — the merged file
+                    # becomes an unreferenced orphan, the old files
+                    # stay live and are NOT removed below
+                    if prev is not None:
+                        self.manifest[name] = prev
+                    else:  # pragma: no cover - entries implied a list
+                        self.manifest.pop(name, None)
+                    raise
                 self._mversion += 1
-                self._save_metadata()
             for entry in entries:
                 os.remove(os.path.join(self.root, name, entry["file"]))
                 removed += 1
@@ -337,9 +375,16 @@ class FileSystemStorage:
                     for name, entries in self.manifest.items()
                     for entry in entries
                 ]
+                prev = self.manifest
                 self.manifest = {}
+                try:
+                    self._save_metadata()
+                except BaseException:
+                    # memory must never run ahead of the durable
+                    # manifest (same invariant as write()'s rollback)
+                    self.manifest = prev
+                    raise
                 self._mversion += 1
-                self._save_metadata()
             for p in paths:
                 os.remove(p)
             return total
@@ -368,29 +413,33 @@ class FileSystemStorage:
                 if len(keep):
                     fname = f"{uuid.uuid4().hex}.{self.encoding}"
                     out = os.path.join(self.root, name, fname)
-                    if self.encoding == "orc":
-                        from pyarrow import orc
-
-                        orc.write_table(
-                            self._decode_dictionaries(_batch_to_table(keep)),
-                            out, compression="zstd")
-                    else:
-                        pq.write_table(
-                            _batch_to_table(keep), out, compression="zstd",
-                            row_group_size=64 * 1024)
+                    retry_call(self._write_data_file, keep, out,
+                               policy=_STORAGE_RETRY, label="storage",
+                               breaker=BREAKERS.get("storage"))
                     new_entries.append({"file": fname, "count": len(keep)})
             if changed:
                 with self._lock:
                     # preserve entries a concurrent write() appended
                     # after our snapshot (appends-only: snapshot is a
                     # prefix of the live list)
+                    prev = self.manifest.get(name)
                     tail = self.manifest.get(name, [])[len(entries):]
                     if new_entries or tail:
                         self.manifest[name] = new_entries + tail
                     else:
                         del self.manifest[name]
+                    try:
+                        self._save_metadata()
+                    except BaseException:
+                        # roll back: a failed durable commit must not
+                        # leave the deletion visible in memory (phantom
+                        # deletes that a restart would resurrect)
+                        if prev is not None:
+                            self.manifest[name] = prev
+                        else:
+                            self.manifest.pop(name, None)
+                        raise
                     self._mversion += 1
-                    self._save_metadata()
                 for fname in removals:
                     os.remove(os.path.join(self.root, name, fname))
         return deleted
@@ -544,6 +593,23 @@ class FileSystemStorage:
                 if len(t):
                     yield _table_to_batch(t, self.sft)
 
+    def _write_data_file(self, sub: FeatureBatch, path: str) -> None:
+        """Encode + write one partition data file (the staged half of a
+        batch-atomic write). A distinct method so the retry fabric can
+        re-attempt the WHOLE encode+write as one idempotent unit."""
+        self._write_table(_batch_to_table(sub), path)
+
+    def _write_table(self, table: pa.Table, path: str) -> None:
+        _WRITE_SITE.fire()
+        if self.encoding == "orc":
+            from pyarrow import orc
+
+            orc.write_table(self._decode_dictionaries(table), path,
+                            compression="zstd")
+        else:
+            pq.write_table(table, path, compression="zstd",
+                           row_group_size=64 * 1024)
+
     @staticmethod
     def _decode_dictionaries(table: pa.Table) -> pa.Table:
         """ORC has no dictionary type: cast dict columns to their value
@@ -569,7 +635,17 @@ class FileSystemStorage:
     def _read_file(self, path: str, expr, cols):
         """Read one data file with predicate + column pushdown. Parquet uses
         row-group statistics natively; ORC goes through pyarrow.dataset for
-        stripe-level filtering (the geomesa-fs-storage-orc analog)."""
+        stripe-level filtering (the geomesa-fs-storage-orc analog).
+        Transient read failures retry against the storage breaker —
+        data files are immutable once committed, so a re-read is
+        trivially idempotent."""
+        return retry_call(
+            self._read_file_once, path, expr, cols,
+            policy=_STORAGE_RETRY, label="storage",
+            breaker=BREAKERS.get("storage"))
+
+    def _read_file_once(self, path: str, expr, cols):
+        _READ_SITE.fire()
         if self.encoding == "orc":
             import pyarrow.dataset as pads
 
@@ -580,18 +656,28 @@ class FileSystemStorage:
     def _stream_file(self, path: str, expr, cols, target: int):
         """Yield ~target-row pyarrow Tables from one file incrementally.
         Parquet decodes row-group-wise with predicate+column pushdown;
-        ORC falls back to a whole-file read chunked afterwards."""
+        ORC falls back to a whole-file read chunked afterwards. Only the
+        dataset/scanner OPEN retries: a failure mid-stream surfaces
+        typed instead of replaying already-yielded rows (documented
+        non-retryable case, docs/ROBUSTNESS.md)."""
         if self.encoding == "orc":
             t = self._read_file(path, expr, cols)
             for off in range(0, max(len(t), 1), target):
                 yield t.slice(off, target)
             return
         import pyarrow as pa
-        import pyarrow.dataset as pads
 
-        scanner = pads.dataset(path, format="parquet").scanner(
-            filter=expr, columns=cols, batch_size=target
-        )
+        def _open():
+            import pyarrow.dataset as pads
+
+            _READ_SITE.fire()
+            return pads.dataset(path, format="parquet").scanner(
+                filter=expr, columns=cols, batch_size=target
+            )
+
+        scanner = retry_call(
+            _open, policy=_STORAGE_RETRY, label="storage",
+            breaker=BREAKERS.get("storage"))
         pending = []
         rows = 0
         for rb in scanner.to_batches():

@@ -3,16 +3,21 @@
 
 Runs EVERY registered rule — the JAX hazards GT01..GT06, the concurrency
 pass GT07..GT12 (lock discipline, lock-order cycles, blocking-under-lock,
-per-call locks, callback-under-lock, unguarded shared state) and the
-serving-hot-path rule GT13 — and exits nonzero on any unwaived finding,
+per-call locks, callback-under-lock, unguarded shared state), the
+serving-hot-path rule GT13 and the robustness rule GT14 (swallowed
+errors / unbounded retry loops at the store/kafka/serve boundaries) —
+and exits nonzero on any unwaived finding,
 printing each with file:line and rule code. In text mode a clean lint is
-followed by the warmup smoke: `gmtpu warmup --check` semantics against
-the committed fixture manifest on CPU (tiny interpret-mode kernel
-shapes), proving the manifest record→replay→check loop stays green.
-Rides the tier-1 pytest run via tests/test_lint_gate.py and is runnable
-standalone:
+followed by two smokes: the warmup smoke (`gmtpu warmup --check`
+semantics against the committed fixture manifest on CPU, proving the
+manifest record→replay→check loop stays green) and the chaos smoke
+(`gmtpu chaos --check` semantics replaying scripts/chaos_smoke_plan.json
+against a tiny serve workload, proving the fault-injection + recovery
+fabric invariants — docs/ROBUSTNESS.md). Rides the tier-1 pytest run via
+tests/test_lint_gate.py and is runnable standalone:
 
-    python scripts/lint_gate.py [--format json|sarif] [--no-warmup-smoke]
+    python scripts/lint_gate.py [--format json|sarif]
+        [--no-warmup-smoke] [--no-chaos-smoke]
 
 Rule catalog + waiver syntax: docs/ANALYSIS.md.
 """
@@ -30,16 +35,15 @@ if REPO_ROOT not in sys.path:  # standalone invocation from anywhere
 SMOKE_MANIFEST = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "warmup_smoke_manifest.json")
+CHAOS_PLAN = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "chaos_smoke_plan.json")
 
 
-def warmup_smoke(manifest_path: str = SMOKE_MANIFEST) -> int:
-    """`gmtpu warmup --check` against the fixture manifest, pinned to
-    CPU (the fixture records interpret-mode kernels; this gate must run
-    on hardware-less CI). Output goes to stderr only — stdout stays
-    machine-parseable for the lint formats. Returns 0 on pass."""
-    # same backend pinning as bench.py --smoke: the env var alone does
-    # not stick (the axon site pins jax_platforms at register time), and
-    # the "tpu" factory must stay registered for pallas lowering imports
+def _pin_cpu() -> None:
+    """Pin jax to CPU for the smokes (shared with warmup_smoke; the env
+    var alone does not stick — the axon site pins jax_platforms at
+    register time). Idempotent."""
     os.environ.setdefault("XLA_FLAGS", "")
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -47,6 +51,40 @@ def warmup_smoke(manifest_path: str = SMOKE_MANIFEST) -> int:
 
     xb._backend_factories.pop("axon", None)
     jax.config.update("jax_platforms", "cpu")
+
+
+def chaos_smoke(plan_path: str = CHAOS_PLAN) -> int:
+    """`gmtpu chaos --check` semantics against the committed smoke plan
+    on CPU: faults injected at every registered site class, the serve
+    workload survives with typed errors only, breakers cycle visibly,
+    and a seeded replay reproduces the exact fire log. Stderr-only like
+    the warmup smoke — stdout stays machine-parseable."""
+    _pin_cpu()
+    from geomesa_tpu.faults.chaos import run_chaos
+    from geomesa_tpu.faults.plan import FaultPlan
+
+    report = run_chaos(FaultPlan.load(plan_path), requests=32,
+                       replay=True, out=sys.stderr)
+    print(
+        f"chaos smoke: {report.ok}/{report.requests} ok, "
+        f"{sum(report.typed_errors.values())} typed error(s), "
+        f"{report.fires} fault(s) fired at "
+        f"{len(report.fired_sites)} site(s), replay_match="
+        f"{report.replay_match}, noop={report.noop_us_per_call}us",
+        file=sys.stderr)
+    for f in report.invariant_failures:
+        print(f"chaos smoke: FAIL {f}", file=sys.stderr)
+    return 0 if report.ok_overall else 1
+
+
+def warmup_smoke(manifest_path: str = SMOKE_MANIFEST) -> int:
+    """`gmtpu warmup --check` against the fixture manifest, pinned to
+    CPU (the fixture records interpret-mode kernels; this gate must run
+    on hardware-less CI). Output goes to stderr only — stdout stays
+    machine-parseable for the lint formats. Returns 0 on pass."""
+    # same backend pinning as bench.py --smoke; the "tpu" factory must
+    # stay registered for pallas lowering imports
+    _pin_cpu()
 
     from geomesa_tpu.compilecache.manifest import WarmupManifest
     from geomesa_tpu.compilecache.warmup import check
@@ -80,6 +118,9 @@ def main(argv=None) -> int:
     p.add_argument("--no-warmup-smoke", action="store_true",
                    help="skip the warmup-manifest smoke (it runs only "
                         "in text mode; json/sarif stdout stays pure)")
+    p.add_argument("--no-chaos-smoke", action="store_true",
+                   help="skip the chaos-plan smoke (text mode only, "
+                        "like the warmup smoke)")
     args = p.parse_args(argv)
     findings = lint_paths([os.path.join(REPO_ROOT, "geomesa_tpu")])
     if args.format == "json":
@@ -91,6 +132,8 @@ def main(argv=None) -> int:
     rc = exit_code(findings, "warn")
     if args.format == "text" and not args.no_warmup_smoke and rc == 0:
         rc = warmup_smoke()
+    if args.format == "text" and not args.no_chaos_smoke and rc == 0:
+        rc = chaos_smoke()
     return rc
 
 
