@@ -57,6 +57,11 @@ RULES: Dict[str, Rule] = {
                      "retry loop around an I/O call site (use the "
                      "faults/ retry fabric: bounded, typed, "
                      "deadline-aware)"),
+        Rule("GT15", "telemetry discipline: time.time() measuring a "
+                     "duration (wall clock is not monotonic — spans and "
+                     "latencies must use perf_counter/monotonic), or a "
+                     "tracer .span() opened outside a `with` block "
+                     "(leaks an unbalanced open span)"),
     )
 }
 

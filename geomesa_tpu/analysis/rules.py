@@ -692,12 +692,130 @@ def _gt14_has_exit(loop: ast.While) -> bool:
     return False
 
 
+# GT15 scope: the layers whose timings feed spans, ServeEvents and the
+# latency histograms — serve/, engine/ and the telemetry package
+# itself. `time.time()` is wall clock: NTP steps it backward and slews
+# it, so a duration measured with it can be negative or skewed; every
+# span/latency in these layers must use perf_counter/monotonic. The
+# second hazard is a tracer span opened without `with`: _LiveSpan only
+# records (and pops the parent stack) on __exit__, so a bare
+# `TRACER.span(...)` call leaks an unbalanced open span.
+_GT15_PREFIXES = ("geomesa_tpu/serve/", "geomesa_tpu/engine/",
+                  "geomesa_tpu/telemetry/")
+
+
+def _gt15_is_time_time(mod: ModInfo, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if (isinstance(f, ast.Attribute) and f.attr == "time"
+            and isinstance(f.value, ast.Name) and f.value.id == "time"):
+        return True  # time.time()
+    if isinstance(f, ast.Name) and f.id == "time":
+        # bare time() — only when `from time import time` is in scope
+        for imp in ast.walk(mod.tree):
+            if isinstance(imp, ast.ImportFrom) and imp.module == "time":
+                if any(a.name == "time" for a in imp.names):
+                    return True
+    return False
+
+
+def _gt15_scopes(mod: ModInfo):
+    """(scope_node, body_nodes) per function plus the module level, each
+    excluding nested function bodies — a name assigned in one function
+    never aliases the same-named local of another."""
+    fns = list(_all_functions(mod))
+    own = {id(f) for f in fns}
+    for scope in [mod.tree] + fns:
+        nodes = []
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            n = stack.pop()
+            if id(n) in own:
+                # a (possibly directly-seeded) nested/top-level def is
+                # its OWN scope: never leak its body into this one — a
+                # module-level `t0 = time.time()` timestamp must not
+                # pair with an unrelated `x - t0` in some function
+                continue
+            nodes.append(n)
+            for child in ast.iter_child_nodes(n):
+                stack.append(child)
+        yield scope, nodes
+
+
+def gt15(mod: ModInfo, project) -> Iterator[Finding]:
+    """GT15: wall-clock durations + un-scoped spans (telemetry layers).
+
+    (a) `time.time()` whose result feeds a subtraction — directly
+    (`time.time() - t0`) or via a name later used as a `-` operand in
+    the same scope. Plain timestamping (`event.ts = time.time()`) is
+    fine: the wall clock is the right clock for *when*, never for *how
+    long*. (b) a `.span(...)` call that is not the context expression
+    of a `with` item (or an `enter_context(...)` argument)."""
+    path = mod.relpath.replace("\\", "/")
+    if not any(p in path for p in _GT15_PREFIXES):
+        return
+    flagged: Set[int] = set()
+    for _scope, nodes in _gt15_scopes(mod):
+        timed_names: dict = {}  # name -> time.time() call line
+        subs = []
+        for n in nodes:
+            if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and _gt15_is_time_time(mod, n.value)):
+                timed_names[n.targets[0].id] = n.value.lineno
+            elif isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub):
+                subs.append(n)
+        for sub in subs:
+            for operand in (sub.left, sub.right):
+                for c in ast.walk(operand):
+                    if _gt15_is_time_time(mod, c) and \
+                            c.lineno not in flagged:
+                        flagged.add(c.lineno)
+                        yield _finding(
+                            "GT15", mod, c,
+                            "time.time() used in a subtraction: wall "
+                            "clock measures *when*, not *how long* — "
+                            "use time.perf_counter()/monotonic() for "
+                            "durations")
+            names = _names_in(sub)
+            for name in sorted(names & set(timed_names)):
+                line = timed_names[name]
+                if line in flagged:
+                    continue
+                flagged.add(line)
+                yield Finding(
+                    rule="GT15", path=mod.relpath, line=line, col=0,
+                    message=(f"time.time() result {name!r} measures a "
+                             f"duration (subtracted at line "
+                             f"{sub.lineno}): wall clock is not "
+                             f"monotonic — use perf_counter/monotonic"))
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "span"):
+            continue
+        parent = mod.parent(node)
+        if isinstance(parent, ast.withitem) and parent.context_expr is node:
+            continue
+        if (isinstance(parent, ast.Call)
+                and isinstance(parent.func, ast.Attribute)
+                and parent.func.attr == "enter_context"):
+            continue
+        yield _finding(
+            "GT15", mod, node,
+            "tracer span opened outside a `with` block: spans record "
+            "only on __exit__, so this leaks an unbalanced open span "
+            "(wrap in `with TRACER.span(...)`, or waive a deliberate "
+            "manual open)")
+
+
 from geomesa_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES)
 
 ALL_RULES = {
     "GT01": gt01, "GT02": gt02, "GT03": gt03,
     "GT04": gt04, "GT05": gt05, "GT06": gt06,
-    "GT13": gt13, "GT14": gt14,
+    "GT13": gt13, "GT14": gt14, "GT15": gt15,
     **CONCURRENCY_RULES,
 }

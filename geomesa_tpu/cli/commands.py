@@ -162,6 +162,24 @@ def register(sub: "argparse._SubParsersAction") -> None:
                               "HBM-resident partitions")
     serve_p.add_argument("--metrics", action="store_true",
                          help="print Prometheus metrics to stderr on exit")
+    serve_p.add_argument("--metrics-port", type=int, default=None,
+                         metavar="PORT",
+                         help="serve live /metrics + /healthz + "
+                              "/debug/traces|stats|gap on this port "
+                              "(0 = OS-assigned; docs/OBSERVABILITY.md)")
+    serve_p.add_argument("--metrics-interval", type=float, default=None,
+                         metavar="SECONDS",
+                         help="print a Prometheus snapshot to stderr "
+                              "every N seconds (long-running serves "
+                              "without --metrics-port aren't blind)")
+    serve_p.add_argument("--trace", action="store_true",
+                         help="enable per-query span tracing into the "
+                              "flight recorder (read via "
+                              "/debug/traces or gmtpu trace)")
+    serve_p.add_argument("--flight-dump", default=None, metavar="PATH",
+                         help="flight-recorder crash-dump path (default: "
+                              "$GEOMESA_TPU_FLIGHT_DUMP or a pid file "
+                              "in the temp dir)")
     serve_p.add_argument("--warmup", default=None, metavar="MANIFEST",
                          help="warmup manifest to replay before accepting "
                               "traffic (docs/SERVING.md cold start)")
@@ -213,7 +231,51 @@ def register(sub: "argparse._SubParsersAction") -> None:
                           help="skip the serial (coalescing-off) baseline")
     bserve_p.add_argument("--smoke", action="store_true",
                           help="small sizes for CI")
+    bserve_p.add_argument("--trace", default=None, metavar="OUT.json",
+                          help="trace the measured runs and write a "
+                               "Perfetto trace_event JSON here; also "
+                               "prints the dispatch-gap report line "
+                               "(docs/OBSERVABILITY.md)")
     bserve_p.set_defaults(func=_bench_serve)
+
+    # telemetry surface (docs/OBSERVABILITY.md)
+    top_p = sub.add_parser(
+        "top", help="live serving dashboard: poll a --metrics-port "
+                    "endpoint and render qps/p99/queue/breakers to the "
+                    "terminal (no curses; plain text refresh)")
+    top_p.add_argument("--url", default=None,
+                       help="endpoint base URL (default: "
+                            "http://HOST:PORT from --host/--port)")
+    top_p.add_argument("--host", default="127.0.0.1")
+    top_p.add_argument("--port", type=int, default=9090)
+    top_p.add_argument("--interval", type=float, default=2.0,
+                       help="poll interval seconds")
+    top_p.add_argument("--count", type=int, default=None,
+                       help="number of polls, then exit (default: "
+                            "until interrupted)")
+    top_p.add_argument("--no-clear", action="store_true",
+                       help="append frames instead of clearing the "
+                            "screen (logs, pipes)")
+    top_p.set_defaults(func=_top)
+
+    trace_p = sub.add_parser(
+        "trace", help="inspect a trace dump (flight-recorder JSON or "
+                      "Perfetto trace_event JSON): per-trace summary, "
+                      "or the dispatch-gap report with --gap")
+    trace_p.add_argument("--input", "-i", required=True,
+                         help="trace file: a flight-recorder dump "
+                              "(gmtpu serve --flight-dump, /debug/* "
+                              "saved to disk) or Perfetto JSON "
+                              "(bench-serve --trace)")
+    trace_p.add_argument("--gap", action="store_true",
+                         help="print the dispatch-gap report (host-gap "
+                              "vs kernel-time attribution)")
+    trace_p.add_argument("--json", action="store_true",
+                         help="machine output instead of text")
+    trace_p.add_argument("--perfetto", default=None, metavar="OUT.json",
+                         help="also convert to Perfetto trace_event "
+                              "JSON at this path")
+    trace_p.set_defaults(func=_trace)
 
     # fault injection + recovery fabric (docs/ROBUSTNESS.md)
     chaos_p = sub.add_parser(
@@ -292,6 +354,8 @@ def _serve(args) -> int:
         degrade=args.degrade,
         warmup_manifest=getattr(args, "warmup", None),
         track_compiles=getattr(args, "track_compiles", False),
+        trace=getattr(args, "trace", False),
+        flight_dump=getattr(args, "flight_dump", None),
     )
     def write_line(s: str) -> None:
         # flush per response: with stdout piped (the normal programmatic
@@ -300,11 +364,52 @@ def _serve(args) -> int:
         sys.stdout.write(s)
         sys.stdout.flush()
 
-    if args.input == "-":
-        n = serve_lines(store, sys.stdin, write_line, config)
-    else:
-        with open(args.input) as f:
-            n = serve_lines(store, f, write_line, config)
+    import threading
+
+    from geomesa_tpu.serve.service import QueryService
+
+    svc = QueryService(store, config)
+    server = None
+    stop_snap = threading.Event()
+    snap_thread = None
+    if getattr(args, "metrics_port", None) is not None:
+        from geomesa_tpu.telemetry.export import MetricsServer
+
+        server = MetricsServer(port=args.metrics_port,
+                               stats_fn=svc.stats,
+                               pre_scrape=svc.export_gauges)
+        port = server.start()
+        print(f"metrics: {server.url}/metrics (also /healthz, "
+              f"/debug/traces, /debug/stats, /debug/gap) — "
+              f"gmtpu top --port {port}", file=sys.stderr)
+    if getattr(args, "metrics_interval", None):
+        from geomesa_tpu.utils.metrics import metrics
+
+        def snapshot_loop():
+            # periodic stderr visibility for long-running serves
+            # without a scrape endpoint; stops with the serve loop
+            while not stop_snap.wait(args.metrics_interval):
+                svc.export_gauges()
+                print(f"--- metrics snapshot ---\n"
+                      f"{metrics.to_prometheus()}", file=sys.stderr)
+
+        snap_thread = threading.Thread(
+            target=snapshot_loop, name="gmtpu-metrics-snapshot",
+            daemon=True)
+        snap_thread.start()
+    try:
+        if args.input == "-":
+            n = serve_lines(store, sys.stdin, write_line, config,
+                            service=svc)
+        else:
+            with open(args.input) as f:
+                n = serve_lines(store, f, write_line, config, service=svc)
+    finally:
+        stop_snap.set()
+        if snap_thread is not None:
+            snap_thread.join(timeout=5.0)
+        if server is not None:
+            server.stop()
     print(f"served {n} request(s)", file=sys.stderr)
     if args.metrics:
         from geomesa_tpu.utils.metrics import metrics
@@ -368,6 +473,15 @@ def _bench_serve(args) -> int:
         warm.submit(factory(0)).result(timeout=300)
         warm.close()
 
+        tracing = getattr(args, "trace", None)
+        if tracing:
+            # trace only the measured runs (warmup spans would pollute
+            # the gap attribution with deliberate cold-path compiles)
+            from geomesa_tpu.telemetry import RECORDER, TRACER
+
+            RECORDER.clear()
+            TRACER.enable()
+
         def run(label: str, config: ServeConfig):
             svc = QueryService(store, config)
             try:
@@ -400,6 +514,155 @@ def _bench_serve(args) -> int:
                         coalesced.p99_ms / serial.p99_ms, 3)
                     if serial.p99_ms else None,
                 }))
+        if tracing:
+            # BENCH r06+ carries the dispatch-gap attribution: one JSON
+            # line next to the throughput lines, plus a Perfetto file
+            # for the flame view (ui.perfetto.dev)
+            from geomesa_tpu.telemetry import (
+                RECORDER, TRACER, gap_report, to_perfetto)
+
+            TRACER.disable()
+            traces = RECORDER.traces()
+            with open(tracing, "w") as f:
+                json.dump(to_perfetto(traces), f)
+            rec = RECORDER.stats()
+            print(json.dumps({
+                "run": "gap", "perfetto": tracing,
+                "traces_recorded": rec["trace_count"],
+                **gap_report(traces)}))
+    return 0
+
+
+def _top(args) -> int:
+    """Curses-free polling dashboard over a `--metrics-port` endpoint:
+    qps (from completed-request deltas between polls), latency
+    quantiles, queue depth, degrade level, breaker states, compile
+    stalls, quarantine — the docs/OBSERVABILITY.md terminal view."""
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    base = args.url or f"http://{args.host}:{args.port}"
+    base = base.rstrip("/")
+    prev = None
+    prev_at = None
+    polls = 0
+    while True:
+        try:
+            with urllib.request.urlopen(f"{base}/debug/stats",
+                                        timeout=5) as r:
+                doc = json.loads(r.read().decode())
+        except KeyboardInterrupt:
+            # ^C lands in the blocking poll as often as in the sleep —
+            # both are a clean exit, not a traceback
+            return 0
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"gmtpu top: cannot poll {base}/debug/stats: {e}",
+                  file=sys.stderr)
+            return 1
+        now = _time.monotonic()
+        frame = _top_frame(doc, prev, now - prev_at if prev_at else None)
+        if not args.no_clear:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write(frame + "\n")
+        sys.stdout.flush()
+        prev, prev_at = doc, now
+        polls += 1
+        if args.count is not None and polls >= args.count:
+            return 0
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+def _top_frame(doc: dict, prev, dt) -> str:
+    m = doc.get("metrics", {})
+    hists = m.get("histograms", {})
+    counters = m.get("counters", {})
+    gauges = m.get("gauges", {})
+    lat = hists.get("serve.latency", {})
+    done = lat.get("count", 0)
+    qps = None
+    if prev is not None and dt:
+        prev_done = prev.get("metrics", {}).get(
+            "histograms", {}).get("serve.latency", {}).get("count", 0)
+        qps = max(done - prev_done, 0) / dt
+    serve = doc.get("serve", {})
+    rec = doc.get("recorder", {})
+    lines = [
+        "gmtpu top — serve telemetry",
+        f"  qps        {qps:10.1f}" if qps is not None
+        else "  qps        (first poll)",
+        f"  served     {done:10d}   p50 {lat.get('p50_s', 0) * 1e3:8.2f} ms"
+        f"   p95 {lat.get('p95_s', 0) * 1e3:8.2f} ms"
+        f"   p99 {lat.get('p99_s', 0) * 1e3:8.2f} ms",
+        f"  queue      {gauges.get('serve.queue.depth', 0):10.0f}"
+        f"   inflight {gauges.get('serve.inflight', 0):.0f}"
+        f"   degrade L{serve.get('degrade_level', 0)}",
+        f"  dispatches {serve.get('dispatches', 0):10d}"
+        f"   coalesced {serve.get('coalesced', 0)}"
+        f"   rejected {serve.get('rejected', 0)}"
+        f"   failed {serve.get('failed', 0)}",
+        f"  compile    stalls {int(counters.get('compile.stalls', 0)):5d}"
+        f"   stalled dispatches "
+        f"{serve.get('compile_stalled_dispatches', 0)}",
+    ]
+    breakers = doc.get("breakers", {})
+    open_b = {k: v for k, v in sorted(breakers.items()) if v != "closed"}
+    lines.append(
+        "  breakers   " + (", ".join(f"{k}={v}" for k, v in open_b.items())
+                           if open_b else
+                           f"all closed ({len(breakers)} deps)"))
+    quar = serve.get("quarantine", {})
+    lines.append(
+        f"  quarantine {quar.get('quarantined', 0)} blocked, "
+        f"{quar.get('striking', 0)} striking"
+        f"   flightrec {rec.get('traces_held', 0)} trace(s), "
+        f"{rec.get('events_held', 0)} event(s)")
+    return "\n".join(lines)
+
+
+def _trace(args) -> int:
+    """Inspect a trace dump: flight-recorder JSON (`{"traces": ...}`),
+    a bare trace list, or Perfetto trace_event JSON round-trips back
+    through telemetry.export.from_perfetto."""
+    from geomesa_tpu.telemetry import (
+        from_perfetto, gap_report, render_gap, to_perfetto)
+
+    with open(args.input) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        traces = from_perfetto(doc)
+    elif isinstance(doc, dict) and "traces" in doc:
+        traces = doc["traces"]
+    elif isinstance(doc, list):
+        traces = doc
+    else:
+        print(f"error: {args.input} is neither a flight-recorder dump "
+              "nor a Perfetto trace", file=sys.stderr)
+        return 2
+    if args.perfetto:
+        with open(args.perfetto, "w") as f:
+            json.dump(to_perfetto(traces), f)
+        print(f"wrote {args.perfetto}", file=sys.stderr)
+    if args.gap:
+        rep = gap_report(traces)
+        print(json.dumps(rep) if args.json else render_gap(rep))
+        return 0
+    if args.json:
+        print(json.dumps(traces))
+        return 0
+    for t in traces:
+        root = t.get("root") or {}
+        dur_ms = max(root.get("t1_ns", 0) - root.get("t0_ns", 0), 0) / 1e6
+        attrs = dict(root.get("attrs") or ())
+        status = attrs.get("status", "?")
+        print(f"{t.get('trace_id', '?'):<16} {t.get('name', ''):<8} "
+              f"{dur_ms:10.2f} ms  status={status:<9} "
+              f"spans={len(t.get('spans', ()))} "
+              f"kind={attrs.get('kind', '')}")
+    print(f"{len(traces)} trace(s)", file=sys.stderr)
     return 0
 
 

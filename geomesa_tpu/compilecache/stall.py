@@ -65,6 +65,11 @@ class StallMeter:
             from geomesa_tpu.utils.metrics import metrics
 
             metrics.counter("compile.stalls")
+            # per-kernel series via a proper Prometheus label; bounded
+            # cardinality: kernel names pass through, filter labels
+            # ("filter:count:<cql>") drop their CQL tail
+            metrics.counter("compile.stalls.by_kernel",
+                            kernel=":".join(label.split(":")[:2]))
             metrics.histogram("compile.stall").update(seconds)
         except Exception:
             pass  # observability must never break the dispatch path

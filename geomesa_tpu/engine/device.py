@@ -34,6 +34,7 @@ import numpy as np
 from geomesa_tpu.core.columnar import DictColumn, FeatureBatch, GeometryColumn
 from geomesa_tpu.faults import BREAKERS, RetryPolicy, retry_call
 from geomesa_tpu.faults import harness as _faults
+from geomesa_tpu.telemetry.trace import TRACER
 
 DeviceBatch = Dict[str, jax.Array]
 
@@ -63,10 +64,11 @@ def to_device(
     Runs under the recovery fabric: transient transfer failures retry
     with backoff against the "device" circuit breaker; OOM propagates
     typed (see _TRANSFER_SITE note above)."""
-    return retry_call(
-        _to_device_impl, batch, coord_dtype, device,
-        policy=_DEVICE_RETRY, label="device",
-        breaker=BREAKERS.get("device"))
+    with TRACER.span("device.transfer", rows=len(batch)):
+        return retry_call(
+            _to_device_impl, batch, coord_dtype, device,
+            policy=_DEVICE_RETRY, label="device",
+            breaker=BREAKERS.get("device"))
 
 
 def _to_device_impl(

@@ -74,12 +74,18 @@ class CircuitBreaker:
         # callers hold self._lock
         self._state = state
         try:
+            from geomesa_tpu.telemetry.recorder import RECORDER
             from geomesa_tpu.utils.metrics import metrics
 
             metrics.gauge(f"fault.breaker.{self.name}", _STATE_NUM[state])
             metrics.counter(
                 f"fault.breaker.{self.name}."
                 + ("close" if state == "closed" else state))
+            # flight-recorder event: a breaker flip is exactly the kind
+            # of context a last-N-queries postmortem needs alongside the
+            # traces (a bounded deque append — not a blocking call)
+            RECORDER.note_event("breaker", dependency=self.name,
+                                state=state)
         except Exception:
             pass  # observability must never wedge the breaker
 

@@ -142,11 +142,14 @@ class FaultHarness:
                 self._log.append((name, count, rule.error))
         try:
             from geomesa_tpu.faults.context import RECOVERY
+            from geomesa_tpu.telemetry.recorder import RECORDER
             from geomesa_tpu.utils.metrics import metrics
 
             metrics.counter("fault.injected")
             metrics.counter(f"fault.injected.{name}")
             RECOVERY.note("fault", name)
+            RECORDER.note_event("fault", site=name, call=count,
+                                error=rule.error)
         except Exception:
             pass  # observability must never change injection behavior
         if rule.latency_ms:

@@ -97,13 +97,19 @@ class QuarantineRegistry:
                     self._strikes.clear()  # bound adversarial streams
                 self._strikes[key] = (min(count, self.strikes), now)
                 tripped = False
-        if tripped:
-            try:
-                from geomesa_tpu.utils.metrics import metrics
+            blocked_n = len(self._blocked)
+        try:
+            from geomesa_tpu.telemetry.recorder import RECORDER
+            from geomesa_tpu.utils.metrics import metrics
 
+            if tripped:
                 metrics.counter("fault.quarantined")
-            except Exception:
-                pass
+            metrics.gauge("fault.quarantine.active", blocked_n)
+            RECORDER.note_event(
+                "quarantine", action="trip" if tripped else "strike",
+                key=repr(key), strikes=count)
+        except Exception:
+            pass
         return tripped
 
     def stats(self) -> dict:

@@ -65,6 +65,11 @@ class ServeEvent:
     retries: int = 0
     fault_injected: int = 0
     breaker_state: str = ""
+    # telemetry correlation (docs/OBSERVABILITY.md): the id of the span
+    # trace this request produced, "" when tracing was off. The
+    # ServeEvent is the root span's summary — an audit-log latency
+    # outlier joins its flight-recorder flame view on this key.
+    trace_id: str = ""
     user: str = ""
     timestamp: float = 0.0
 

@@ -33,6 +33,7 @@ from geomesa_tpu.plan.explain import Explainer
 from geomesa_tpu.plan.hints import QueryHints
 from geomesa_tpu.plan.query import Query
 from geomesa_tpu.plan.runner import sample_mask as _sample_mask
+from geomesa_tpu.telemetry.trace import TRACER
 from geomesa_tpu.utils.padding import next_pow2 as _next_pow2
 from geomesa_tpu.store.fs import FileSystemStorage
 
@@ -133,6 +134,13 @@ class QueryPlanner:
     # -- planning ----------------------------------------------------------
 
     def plan(self, query: Query, explain: Optional[Explainer] = None) -> QueryPlan:
+        # telemetry seam: planning (interceptors, bounds extraction,
+        # pruning, residual filter compile closure) as one span — the
+        # no-op path costs one attribute read for unscoped callers
+        with TRACER.span("plan"):
+            return self._plan(query, explain)
+
+    def _plan(self, query: Query, explain: Optional[Explainer] = None) -> QueryPlan:
         from geomesa_tpu.plan.interceptor import run_interceptors
 
         e = explain or Explainer()
@@ -356,32 +364,39 @@ class QueryPlanner:
                         dev, padded, m)
                 pending, pending_rows = [], 0
 
-            with ThreadPoolExecutor(max_workers=1) as ex:
-                fut = ex.submit(lambda: next(scan_iter, None))
-                while True:
-                    chunk = fut.result()
-                    if chunk is None:
-                        break
+            # one span for the fused pipeline: decode-ahead + upload +
+            # mask overlap by design, so finer phases would double-count
+            with TRACER.span("scan", streaming=True):
+                with ThreadPoolExecutor(max_workers=1) as ex:
                     fut = ex.submit(lambda: next(scan_iter, None))
-                    # flush BEFORE overshooting: a unit that crosses the
-                    # bound pow2-pads to DOUBLE the bytes on the wire
-                    if pending_rows and pending_rows + len(chunk) > UPLOAD_ROWS:
-                        flush()
-                    pending.append(chunk)
-                    pending_rows += len(chunk)
-                    if pending_rows >= UPLOAD_ROWS:
-                        flush()
-                flush()
+                    while True:
+                        chunk = fut.result()
+                        if chunk is None:
+                            break
+                        fut = ex.submit(lambda: next(scan_iter, None))
+                        # flush BEFORE overshooting: a unit that crosses
+                        # the bound pow2-pads to DOUBLE the bytes on the
+                        # wire
+                        if pending_rows and \
+                                pending_rows + len(chunk) > UPLOAD_ROWS:
+                            flush()
+                        pending.append(chunk)
+                        pending_rows += len(chunk)
+                        if pending_rows >= UPLOAD_ROWS:
+                            flush()
+                    flush()
             t_scan = time.perf_counter()
             check_timeout("scan")
-            mask_count = int(
-                sum(int(np.asarray(c)) for c in counts)) + corrections[0]
+            with TRACER.span("device.sync"):
+                mask_count = int(
+                    sum(int(np.asarray(c)) for c in counts)) + corrections[0]
             t_done = time.perf_counter()
             self._record(query, plan, hints, mask_count,
                          t0, t_plan, t_scan, t_done)
             return QueryResult("count", count=mask_count)
 
-        batches = list(scan_iter)
+        with TRACER.span("scan"):
+            batches = list(scan_iter)
         t_scan = time.perf_counter()
         check_timeout("scan")
 
@@ -394,11 +409,12 @@ class QueryPlanner:
             # pow2 padding stabilizes jit cache shapes across scans
             padded = batch.pad_to(_next_pow2(len(batch)))
             dev = to_device(padded, coord_dtype=self.coord_dtype)
-            dev_mask = (
-                plan.compiled.mask(dev, padded)
-                if plan.compiled is not None
-                else dev["__valid__"]
-            )
+            with TRACER.span("kernel.dispatch", kernel="filter.mask"):
+                dev_mask = (
+                    plan.compiled.mask(dev, padded)
+                    if plan.compiled is not None
+                    else dev["__valid__"]
+                )
             from geomesa_tpu.plan.runner import visibility_mask
 
             has_band = plan.compiled is not None and plan.compiled.has_band
@@ -410,7 +426,9 @@ class QueryPlanner:
                 m = dev_mask
                 if vm is not None:
                     m = m & jnp.asarray(vm)
-                mask_count = int(np.asarray(jnp.sum(m, dtype=jnp.int64)))
+                with TRACER.span("device.sync"):
+                    mask_count = int(
+                        np.asarray(jnp.sum(m, dtype=jnp.int64)))
                 if has_band:
                     mask_count += plan.compiled.band_count_correction(
                         dev, padded, m,
@@ -420,7 +438,8 @@ class QueryPlanner:
                 self._record(query, plan, hints, mask_count,
                              t0, t_plan, t_scan, t_done)
                 return QueryResult("count", count=mask_count)
-            mask = np.asarray(dev_mask)
+            with TRACER.span("device.sync"):
+                mask = np.asarray(dev_mask)
             if has_band:
                 # f64 re-check of rows inside the f32 boundary band
                 # (SURVEY.md:824-827); density paths keep the device mask —
@@ -441,7 +460,8 @@ class QueryPlanner:
                     )
                 mask = _sample_mask(mask, hints.sampling, groups)
             mask_count = int(mask.sum())
-            result = self._aggregate(padded, dev, mask, query)
+            with TRACER.span("aggregate"):
+                result = self._aggregate(padded, dev, mask, query)
         t_done = time.perf_counter()
         self._record(query, plan, hints, mask_count, t0, t_plan, t_scan, t_done)
         return result
@@ -486,7 +506,8 @@ class QueryPlanner:
         import jax.numpy as jnp
 
         hints = query.hints
-        self.cache.ensure(plan.partitions, manifest=plan.manifest)
+        with TRACER.span("residency"):
+            self.cache.ensure(plan.partitions, manifest=plan.manifest)
         t_scan = time.perf_counter()
 
         sb = self.cache.superbatch()
@@ -500,12 +521,13 @@ class QueryPlanner:
         if not allowed.any():
             return self._empty_result(hints, query), 0, t_scan
 
-        dev_mask = (
-            plan.compiled.mask(sb.dev, sb.batch)
-            if plan.compiled is not None
-            else sb.dev["__valid__"]
-        )
-        dev_mask = dev_mask & jnp.asarray(allowed)[sb.pids]
+        with TRACER.span("kernel.dispatch", kernel="filter.mask"):
+            dev_mask = (
+                plan.compiled.mask(sb.dev, sb.batch)
+                if plan.compiled is not None
+                else sb.dev["__valid__"]
+            )
+            dev_mask = dev_mask & jnp.asarray(allowed)[sb.pids]
         has_band = plan.compiled is not None and plan.compiled.has_band
         from geomesa_tpu.plan.runner import visibility_mask
 
@@ -514,7 +536,8 @@ class QueryPlanner:
             dev_mask = dev_mask & jnp.asarray(vm)
 
         if hints.count_only and not hints.sampling:
-            total = int(np.asarray(jnp.sum(dev_mask, dtype=jnp.int64)))
+            with TRACER.span("device.sync"):
+                total = int(np.asarray(jnp.sum(dev_mask, dtype=jnp.int64)))
             if has_band:
                 extra = jnp.asarray(allowed)[sb.pids]
                 if vm is not None:
@@ -545,7 +568,8 @@ class QueryPlanner:
 
         # host-mask paths (stats/bin/features): one transfer, then the same
         # single-batch aggregation the scan path uses
-        mask = np.asarray(dev_mask)
+        with TRACER.span("device.sync"):
+            mask = np.asarray(dev_mask)
         if has_band:
             # refine patches band rows with the pure-filter f64 value, so
             # re-AND the partition-allowed + visibility components it
@@ -559,7 +583,8 @@ class QueryPlanner:
         total = int(mask.sum())
         if total == 0:
             return self._empty_result(hints, query), 0, t_scan
-        result = self._aggregate(sb.batch, sb.dev, mask, query)
+        with TRACER.span("aggregate"):
+            result = self._aggregate(sb.batch, sb.dev, mask, query)
         return result, total, t_scan
 
     def knn(
@@ -650,8 +675,9 @@ class QueryPlanner:
             )
 
         if self.cache is not None:
-            self.cache.ensure(plan.partitions, manifest=plan.manifest)
-            sb = self.cache.superbatch()
+            with TRACER.span("residency"):
+                self.cache.ensure(plan.partitions, manifest=plan.manifest)
+                sb = self.cache.superbatch()
             if sb is None:
                 return empty()
             allowed = np.zeros(max(len(sb.ids), 1), bool)
@@ -662,12 +688,13 @@ class QueryPlanner:
             if not allowed.any():
                 return empty()
             batch, dev = sb.batch, sb.dev
-            mask = (
-                plan.compiled.mask(dev, batch)
-                if plan.compiled is not None
-                else dev["__valid__"]
-            )
-            mask = mask & jnp.asarray(allowed)[sb.pids]
+            with TRACER.span("kernel.dispatch", kernel="filter.mask"):
+                mask = (
+                    plan.compiled.mask(dev, batch)
+                    if plan.compiled is not None
+                    else dev["__valid__"]
+                )
+                mask = mask & jnp.asarray(allowed)[sb.pids]
             if plan.compiled is not None and plan.compiled.has_band:
                 # f64 band refinement, device-resident: exact values
                 # scatter into the mask at their indices, ANDed with the
@@ -690,23 +717,26 @@ class QueryPlanner:
                     mask = mask.at[jnp.asarray(bidx)].set(
                         jnp.asarray(bexact & allowed[pid_at]))
         else:
-            batches = list(
-                self.storage.scan(
-                    plan.bbox, plan.interval,
-                    columns=_needed_columns(query, plan, self.storage.sft),
+            with TRACER.span("scan"):
+                batches = list(
+                    self.storage.scan(
+                        plan.bbox, plan.interval,
+                        columns=_needed_columns(
+                            query, plan, self.storage.sft),
+                    )
                 )
-            )
             if not batches:
                 return empty()
             batch = FeatureBatch.concat(batches)
             batch = batch.pad_to(_next_pow2(len(batch)))
             dev = to_device(batch, coord_dtype=self.coord_dtype)
-            mask = (
-                plan.compiled.mask(dev, batch)
-                if plan.compiled is not None
-                else dev["__valid__"]
-            )
-            mask = mask & dev["__valid__"]
+            with TRACER.span("kernel.dispatch", kernel="filter.mask"):
+                mask = (
+                    plan.compiled.mask(dev, batch)
+                    if plan.compiled is not None
+                    else dev["__valid__"]
+                )
+                mask = mask & dev["__valid__"]
             if plan.compiled is not None and plan.compiled.has_band:
                 bidx, bexact = plan.compiled.band_corrections(dev, batch)
                 if len(bidx):
@@ -742,20 +772,26 @@ class QueryPlanner:
                 if key not in caps and len(caps) > 256:
                     caps.clear()  # bound memory on adversarial streams
                 seed_cap = caps.get(key)
-            fd, fi, cap = knn_sparse_auto(
-                jqx, jqy, x, y, mask, k=kk,
-                tile_capacity=seed_cap, m_blocks=mb, interpret=interp,
-            )
+            with TRACER.span("kernel.dispatch", kernel="knn_sparse",
+                             q=int(jqx.shape[0]), k=kk):
+                fd, fi, cap = knn_sparse_auto(
+                    jqx, jqy, x, y, mask, k=kk,
+                    tile_capacity=seed_cap, m_blocks=mb, interpret=interp,
+                )
             with self._mutex:
                 if cap > 0:
                     caps[key] = cap
                 else:
                     caps.pop(key, None)
         else:
-            fd, fi = knn_fullscan_tiled(
-                jqx, jqy, x, y, mask, k=kk, m_blocks=mb, interpret=interp,
-            )
-        dists, idx = _pad_to_k(np.asarray(fd), np.asarray(fi), k)
+            with TRACER.span("kernel.dispatch", kernel="knn_fullscan",
+                             q=int(jqx.shape[0]), k=kk):
+                fd, fi = knn_fullscan_tiled(
+                    jqx, jqy, x, y, mask, k=kk, m_blocks=mb,
+                    interpret=interp,
+                )
+        with TRACER.span("device.sync"):
+            dists, idx = _pad_to_k(np.asarray(fd), np.asarray(fi), k)
         return dists, idx, batch
 
     def _knn_impl_from_stats(self, plan: "QueryPlan") -> str:

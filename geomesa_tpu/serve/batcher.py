@@ -34,6 +34,7 @@ import numpy as np
 from geomesa_tpu.cql import ast
 from geomesa_tpu.plan.planner import QueryTimeout
 from geomesa_tpu.serve.scheduler import ServeRequest
+from geomesa_tpu.telemetry.trace import TRACER
 from geomesa_tpu.utils.padding import next_pow2 as _next_pow2
 
 # floor for the padded stacked-query axis: keeps the kernel shape set
@@ -175,8 +176,9 @@ def _execute_shared(source, reqs: List[ServeRequest],
         out = source.planner.count(lead.query, timeout_ms=timeout_ms)
     else:
         out = source.planner.execute(lead.query, timeout_ms=timeout_ms)
-    for r in reqs:
-        r.future.set_result(out)
+    with TRACER.span("merge", members=len(reqs)):
+        for r in reqs:
+            r.future.set_result(out)
 
 
 def _execute_knn(source, reqs: List[ServeRequest],
@@ -185,22 +187,26 @@ def _execute_knn(source, reqs: List[ServeRequest],
     the [Q, k] result rows back out. Rows are computed independently by
     the kernels, so per-request results are identical to serial runs of
     the same kernel — asserted in tests/test_serve.py."""
-    xs = [np.asarray(r.qx, np.float64).ravel() for r in reqs]
-    ys = [np.asarray(r.qy, np.float64).ravel() for r in reqs]
-    offsets = np.cumsum([0] + [len(x) for x in xs])
-    qx = np.concatenate(xs)
-    qy = np.concatenate(ys)
-    total = len(qx)
-    padded = max(MIN_KNN_BATCH, _next_pow2(total))
-    if padded > total:
-        # repeat the first point: cheap, in-bounds, discarded on split
-        qx = np.concatenate([qx, np.full(padded - total, qx[0])])
-        qy = np.concatenate([qy, np.full(padded - total, qy[0])])
+    with TRACER.span("knn.stack", members=len(reqs)):
+        xs = [np.asarray(r.qx, np.float64).ravel() for r in reqs]
+        ys = [np.asarray(r.qy, np.float64).ravel() for r in reqs]
+        offsets = np.cumsum([0] + [len(x) for x in xs])
+        qx = np.concatenate(xs)
+        qy = np.concatenate(ys)
+        total = len(qx)
+        padded = max(MIN_KNN_BATCH, _next_pow2(total))
+        if padded > total:
+            # repeat the first point: cheap, in-bounds, discarded on split
+            qx = np.concatenate([qx, np.full(padded - total, qx[0])])
+            qy = np.concatenate([qy, np.full(padded - total, qy[0])])
     lead = reqs[0]
     dists, idx, batch = source.planner.knn(
         lead.query, qx, qy, k=lead.k, impl=lead.impl,
         timeout_ms=timeout_ms,
     )
-    for i, r in enumerate(reqs):
-        a, b = offsets[i], offsets[i + 1]
-        r.future.set_result((dists[a:b], idx[a:b], batch))
+    # "merge" = splitting the [Q, k] result rows back per request AND
+    # resolving futures (set_result runs protocol callbacks inline)
+    with TRACER.span("merge", members=len(reqs)):
+        for i, r in enumerate(reqs):
+            a, b = offsets[i], offsets[i + 1]
+            r.future.set_result((dists[a:b], idx[a:b], batch))

@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import math
 import threading
+from time import perf_counter_ns
 from typing import Iterable, Optional
 
 import numpy as np
@@ -159,12 +160,16 @@ def serve_lines(
     lines: Iterable[str],
     write,
     config: Optional[ServeConfig] = None,
+    service: Optional[QueryService] = None,
 ) -> int:
     """Run the JSON-lines loop: submit every request line to a
     QueryService over `store`, write one response line per request via
     `write(str)` as each completes, drain gracefully at end of input.
-    Returns the number of requests processed."""
-    svc = QueryService(store, config)
+    Returns the number of requests processed. A caller that needs the
+    service before the loop starts (the `--metrics-port` endpoint binds
+    its stats provider to it) passes one in; ownership transfers — the
+    loop drains and closes it either way."""
+    svc = service if service is not None else QueryService(store, config)
     out_lock = threading.Lock()
     processed = 0
 
@@ -174,19 +179,30 @@ def serve_lines(
 
     def on_done(rid, req):
         def cb(fut):
-            exc = fut.exception() if not fut.cancelled() else None
-            if fut.cancelled():
-                respond({"id": rid, "ok": False, "error": "rejected",
-                         "reason": "cancelled", "message": "cancelled"})
-            elif exc is not None:
-                respond(_error_response(rid, exc))
-            else:
-                limit = req.query.max_features or MAX_FEATURE_ROWS
-                doc = {"id": rid, "ok": True}
-                doc.update(_payload(req.kind, fut.result(), limit))
-                if req.degraded:
-                    doc["degraded"] = True
-                respond(doc)
+            # clock reads only when this request is traced: with
+            # tracing off the response path stays stamp-free
+            r0_ns = (perf_counter_ns()
+                     if req.trace is not None else 0)
+            try:
+                exc = fut.exception() if not fut.cancelled() else None
+                if fut.cancelled():
+                    respond({"id": rid, "ok": False, "error": "rejected",
+                             "reason": "cancelled", "message": "cancelled"})
+                elif exc is not None:
+                    respond(_error_response(rid, exc))
+                else:
+                    limit = req.query.max_features or MAX_FEATURE_ROWS
+                    doc = {"id": rid, "ok": True}
+                    doc.update(_payload(req.kind, fut.result(), limit))
+                    if req.degraded:
+                        doc["degraded"] = True
+                    respond(doc)
+            finally:
+                if req.trace is not None:
+                    # serialization + line write, per rider (callbacks
+                    # run on the dispatch thread inside set_result, so
+                    # this lands within the dispatch window)
+                    req.trace.record("respond", r0_ns, perf_counter_ns())
 
         return cb
 

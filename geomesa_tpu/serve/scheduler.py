@@ -83,6 +83,13 @@ class ServeRequest:
     id: int = dataclasses.field(default_factory=lambda: next(_ids))
     future: Future = dataclasses.field(default_factory=Future)
     enqueued_at: float = 0.0
+    # telemetry (docs/OBSERVABILITY.md): the per-query Trace opened at
+    # submit (None when tracing is off — every downstream telemetry
+    # call no-ops on None), plus the perf_counter_ns enqueue stamp the
+    # dispatch loop uses to record the cross-thread queue.wait span
+    # (enqueued_at is time.monotonic seconds: a different clock)
+    trace: object = None
+    enqueued_ns: int = 0
     degraded: bool = False  # set by the service when the ladder rewrote hints
     # pre-degrade poison fingerprint, stashed by the service's ladder
     # BEFORE it rewrites hints: the coalescing key includes the hint
@@ -171,6 +178,7 @@ class AdmissionQueue:
                     f"admission queue at capacity ({self.max_depth})",
                 )
             req.enqueued_at = time.monotonic()
+            req.enqueued_ns = time.perf_counter_ns()
             self._classes[req.priority].append(req)
             self._not_empty.notify()
 

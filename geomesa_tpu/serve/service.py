@@ -45,6 +45,8 @@ from geomesa_tpu.serve.batcher import (
     MIN_KNN_BATCH, compat_key, execute_batch, fail_expired, split_expired)
 from geomesa_tpu.serve.scheduler import (
     PRIORITIES, AdmissionQueue, QueryRejected, RateLimiter, ServeRequest)
+from geomesa_tpu.telemetry.recorder import RECORDER
+from geomesa_tpu.telemetry.trace import TRACER
 from geomesa_tpu.utils.padding import next_pow2 as _next_pow2
 
 
@@ -73,6 +75,12 @@ class ServeConfig:
     # attribution (warmup()/record_warmup() install it on demand too)
     warmup_manifest: Optional[str] = None
     track_compiles: bool = False
+    # telemetry (docs/OBSERVABILITY.md): trace=True enables the
+    # PROCESS-WIDE span tracer at construction (TRACER is global — one
+    # switch per process, like the stall meter); flight_dump sets the
+    # flight recorder's crash-dump path for this process
+    trace: bool = False
+    flight_dump: Optional[str] = None
 
 
 def _quarantine_key(req: ServeRequest):
@@ -99,6 +107,10 @@ class QueryService:
             strikes=max(self.config.quarantine_after, 1),
             ttl_s=self.config.quarantine_ttl_s)
         self.audit = getattr(store, "audit", None)
+        if self.config.trace:
+            TRACER.enable()
+        if self.config.flight_dump:
+            RECORDER.auto_dump_path = self.config.flight_dump
         self._closed = False
         self._stop = threading.Event()
         self._inflight = 0
@@ -239,7 +251,35 @@ class QueryService:
 
     def submit(self, req: ServeRequest) -> Future:
         """Admission control, then enqueue. Raises the typed
-        QueryRejected (never queues unboundedly) on shed/limit/closed."""
+        QueryRejected (never queues unboundedly) on shed/limit/closed.
+        With tracing on, opens the request's Trace (root span "query")
+        and the "admit" child span; a rejected request finishes its
+        trace here and still lands in the flight recorder — overload
+        postmortems need the shed requests, not just the served ones."""
+        trace = TRACER.start_trace(
+            "query", kind=req.kind, type=req.query.type_name,
+            tenant=req.tenant)
+        if trace is None:
+            self._admit(req)
+            return self._enqueue(req)
+        req.trace = trace
+        try:
+            # the admit span must CLOSE before the request becomes
+            # visible to the dispatcher (queue.put): the span's append
+            # happens at __exit__, and a dispatcher racing ahead of it
+            # could snapshot/finish the trace admit-less (or leak the
+            # admit span into riders' adopted window slice)
+            with TRACER.scope(trace):
+                with TRACER.span("admit"):
+                    self._admit(req)
+            return self._enqueue(req)
+        except BaseException as e:
+            trace.finish(status="rejected", error=type(e).__name__)
+            RECORDER.record(trace)
+            raise
+
+    def _admit(self, req: ServeRequest) -> None:
+        """Admission checks up to — but excluding — the queue put."""
         self._bump("submitted")
         with self._state_lock:
             closed = self._closed
@@ -268,6 +308,8 @@ class QueryService:
                 "shed", "sustained overload: batch class shed")
         if level >= 1 and self.config.degrade and req.allow_degraded:
             self._degrade(req, level)
+
+    def _enqueue(self, req: ServeRequest) -> Future:
         try:
             self.queue.put(req)
         except QueryRejected:
@@ -363,11 +405,15 @@ class QueryService:
                 continue
             try:
                 self._dispatch(req)
-            except Exception:  # noqa: BLE001 — the dispatcher must live
+            except Exception as e:  # noqa: BLE001 — the dispatcher must live
                 # _dispatch resolves member futures before anything that
-                # can throw here (audit/metrics); log and keep serving
+                # can throw here (audit/metrics); log and keep serving.
+                # An un-typed error escaping to here is exactly the
+                # postmortem case the flight recorder exists for: dump
+                # the last-N-queries window before continuing.
                 logging.getLogger(__name__).exception(
                     "serve dispatch loop error")
+                RECORDER.crash_dump("serve dispatch loop error", e)
             finally:
                 with self._state_lock:
                     self._inflight -= 1
@@ -393,27 +439,9 @@ class QueryService:
             time.sleep(min(0.0005, remaining))
         return reqs
 
-    def _dispatch(self, first: ServeRequest) -> None:
-        from geomesa_tpu.utils.metrics import metrics
-
-        reqs = self._gather(first)
-        live, dead = split_expired(reqs)
-        fail_expired(dead)
-        for _ in dead:
-            self._bump("timeout")
-            metrics.counter("serve.timeout")
-        if not live:
-            return
-        t0 = time.monotonic()
-        for r in live:
-            metrics.histogram("serve.queue.wait").update(t0 - r.enqueued_at)
-        if self._recorder is not None:
-            self._record_queries(live)
-        from geomesa_tpu.faults import (
-            BREAKERS, RECOVERY, BreakerOpen, classify)
-
-        stall_token = STALLS.token()
-        rec_token = RECOVERY.token()
+    def _run_window(self, live: List[ServeRequest]) -> None:
+        """The device-facing part of one dispatch: source lookup +
+        coalesced execution, futures resolved for every member."""
         try:
             # an unknown type name raises HERE, not in execute_batch's
             # guarded body — it must fail these futures, not the
@@ -425,6 +453,60 @@ class QueryService:
                     r.future.set_exception(e)
         else:
             execute_batch(source, live)
+
+    def _dispatch(self, first: ServeRequest) -> None:
+        from geomesa_tpu.utils.metrics import metrics
+
+        g0_ns = time.perf_counter_ns()
+        reqs = self._gather(first)
+        g1_ns = time.perf_counter_ns()
+        live, dead = split_expired(reqs)
+        fail_expired(dead)
+        for r in dead:
+            self._bump("timeout")
+            metrics.counter("serve.timeout")
+            if r.trace is not None:
+                r.trace.record("queue.wait", r.enqueued_ns, g1_ns)
+                RECORDER.record(r.trace.finish(status="timeout"))
+        if not live:
+            return
+        t0 = time.monotonic()
+        now_ns = time.perf_counter_ns()
+        lead = live[0]
+        for r in live:
+            metrics.histogram("serve.queue.wait").update(t0 - r.enqueued_at)
+            if r.trace is not None:
+                # cross-thread phase: opened (implicitly) at enqueue on
+                # the submitting thread, closed here — recorded with
+                # explicit stamps rather than a with-block
+                r.trace.record("queue.wait", r.enqueued_ns, now_ns)
+        # everything recorded into the LEAD trace from here on is the
+        # shared dispatch window; riders adopt a copy at completion
+        adopt_from = (lead.trace.span_count()
+                      if lead.trace is not None else 0)
+        if lead.trace is not None:
+            lead.trace.record("coalesce", g0_ns, g1_ns, gathered=len(reqs))
+        if self._recorder is not None:
+            self._record_queries(live)
+        from geomesa_tpu.faults import (
+            BREAKERS, RECOVERY, BreakerOpen, classify)
+
+        stall_token = STALLS.token()
+        rec_token = RECOVERY.token()
+        dispatch_span_id = None
+        dispatch_end_ns = 0
+        if lead.trace is not None:
+            with TRACER.scope(lead.trace):
+                with TRACER.span("dispatch", batch=len(live)) as dsp:
+                    self._run_window(live)
+                # read the handle RIGHT after the block closes (the
+                # scope's shared handle holds the just-closed span);
+                # None if tracing flipped off between admit and here
+                dispatch_span_id = getattr(dsp, "span_id", None)
+                dispatch_start_ns = getattr(dsp, "start_ns", 0)
+                dispatch_end_ns = getattr(dsp, "end_ns", 0)
+        else:
+            self._run_window(live)
         t1 = time.monotonic()
         # per-dispatch compile-stall attribution: everything THIS THREAD
         # noted into the stall meter during the window (tracked kernel
@@ -453,6 +535,22 @@ class QueryService:
         compiled = ",".join(labels[:5])
         if len(labels) > 5:
             compiled += f",+{len(labels) - 5}"
+        if lead.trace is not None and dispatch_span_id is not None:
+            # stall/recovery attribution as child spans of the dispatch
+            # window (the meters only know durations, not start times:
+            # stalls render right-aligned at the window end, marked
+            # synthetic; retry/fault notes render as instants)
+            for label, secs in stalls:
+                dur_ns = int(secs * 1e9)
+                lead.trace.record(
+                    "compile.stall",
+                    max(dispatch_end_ns - dur_ns, dispatch_start_ns),
+                    dispatch_end_ns, parent_id=dispatch_span_id,
+                    label=label, synthetic_ts=True)
+            for kind, label in recovery:
+                lead.trace.record(
+                    kind, dispatch_end_ns, dispatch_end_ns,
+                    parent_id=dispatch_span_id, label=label)
         if stalls:
             self._bump("compile_stalled_dispatches")
             metrics.counter("serve.compile.stalled")
@@ -463,12 +561,19 @@ class QueryService:
             metrics.counter("serve.coalesced", len(live) - 1)
         metrics.gauge("serve.queue.depth", float(len(self.queue)))
         struck: set = set()
+        adopted: Optional[list] = None
         for r in live:
             if r.future.cancelled():
                 # cancelled between queue pop and execute: .exception()
                 # would raise CancelledError and kill the dispatcher
+                if r.trace is not None:
+                    RECORDER.record(r.trace.finish(status="cancelled"))
                 continue
             metrics.histogram("serve.latency").update(t1 - r.enqueued_at)
+            # labeled series: per-kind/status and per-tenant request
+            # counts export as proper Prometheus labels (one
+            # serve_requests family), so dashboards slice without
+            # name-mangled metric explosions
             status = "ok"
             exc = r.future.exception()
             if exc is not None:
@@ -506,8 +611,32 @@ class QueryService:
                         self.quarantine.strike(key)
             else:
                 self._bump("completed")
+            metrics.counter("serve.requests", kind=r.kind, status=status)
+            if r.tenant:
+                metrics.counter("serve.tenant.requests", tenant=r.tenant)
+                metrics.histogram(
+                    "serve.tenant.latency",
+                    tenant=r.tenant).update(t1 - r.enqueued_at)
+            if r.trace is not None:
+                if r is not lead and lead.trace is not None:
+                    # riders adopt a copy of the shared dispatch-window
+                    # spans (coalesce + dispatch subtree). Span ids are
+                    # preserved so the gap report can dedup the shared
+                    # window; the lead's own respond span stays out —
+                    # riders record their own via the protocol callback
+                    if adopted is None:
+                        adopted = [
+                            s for s in
+                            lead.trace.snapshot_spans()[adopt_from:]
+                            if s.name != "respond"]
+                    r.trace.adopt(
+                        adopted, clamp_start_ns=r.trace.root.start_ns)
+                RECORDER.record(r.trace.finish(
+                    status=status, batch=len(live), degraded=r.degraded))
             if self.audit is not None:
                 self.audit.write(ServeEvent(
+                    trace_id=(r.trace.trace_id
+                              if r.trace is not None else ""),
                     type_name=r.query.type_name,
                     kind=r.kind,
                     tenant=r.tenant,
@@ -573,6 +702,36 @@ class QueryService:
         if self.tracker is not None:
             out["recompiles"] = self.tracker.total_recompiles()
         return out
+
+    def export_gauges(self) -> None:
+        """Push point-in-time gauges (queue depth, degrade level,
+        in-flight count, quarantine size, breaker states) into the
+        shared metrics registry. The `--metrics-port` endpoint calls
+        this before every /metrics render so a scrape sees NOW, not the
+        last time a request happened to update a gauge — an idle,
+        fully-drained server must scrape as idle."""
+        from geomesa_tpu.utils.metrics import metrics
+
+        metrics.gauge("serve.queue.depth", float(len(self.queue)))
+        metrics.gauge("serve.degrade.level", float(self.degrade_level()))
+        with self._state_lock:
+            inflight = self._inflight
+        metrics.gauge("serve.inflight", float(inflight))
+        q = self.quarantine.stats()
+        metrics.gauge("fault.quarantine.active", float(q["quarantined"]))
+        metrics.gauge("fault.quarantine.striking", float(q["striking"]))
+        try:
+            from geomesa_tpu.faults import BREAKERS
+            from geomesa_tpu.faults.breaker import _STATE_NUM
+
+            for name, state in BREAKERS.states().items():
+                metrics.gauge(f"fault.breaker.{name}", _STATE_NUM[state])
+        # gt: waive GT14
+        # (deliberate degrade: gauge freshness is best-effort — a scrape
+        # must render whatever IS fresh rather than 500 because one
+        # breaker-registry read raced a reconfigure)
+        except Exception:
+            pass
 
 
 def self_check(verbose: bool = True) -> int:

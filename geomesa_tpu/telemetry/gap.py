@@ -1,0 +1,159 @@
+"""Dispatch-gap report: host-gap vs kernel-time attribution from spans.
+
+ROADMAP item 2's finding — dispatch RTT (0.101s) exceeding net kernel
+time (0.066s) — came from one hand-instrumented bench run. This module
+derives the same attribution from any set of query traces, so every
+traced `bench-serve` run (and `gmtpu trace --gap` over a flight-recorder
+dump) reports exactly where the serve path's wall time went:
+
+- **per-phase attribution**: total/mean/share for every span name
+  (admit, queue.wait, dispatch, plan, residency, device.transfer,
+  kernel.dispatch, device.sync, merge, respond, compile.stall, ...);
+- **coverage**: how much of each query's wall time the direct root
+  phases explain (the acceptance bar: ≥95% — unexplained time means an
+  un-instrumented seam);
+- **dispatch gap**: within the dispatch windows themselves, time spent
+  in device-facing spans (kernel dispatch + sync + transfer) vs host
+  work between them — the number the item-2 pipelining work must drive
+  toward zero. Coalesced riders adopt *copies* of the shared window
+  spans (same span ids), so dispatch-window aggregation dedups by
+  span id: N riders never count one kernel N times.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple
+
+__all__ = ["gap_report", "render_gap", "DEVICE_PHASES"]
+
+# span names that represent the device-facing part of a dispatch window;
+# everything else inside the window is host work (the "gap")
+DEVICE_PHASES = ("kernel.dispatch", "device.sync", "device.transfer")
+
+
+def _doc(trace) -> dict:
+    return trace if isinstance(trace, dict) else trace.to_json()
+
+
+def _union_ns(intervals: List[Tuple[int, int]]) -> int:
+    """Total covered length of possibly-overlapping [t0, t1) intervals."""
+    if not intervals:
+        return 0
+    intervals.sort()
+    total = 0
+    cur0, cur1 = intervals[0]
+    for t0, t1 in intervals[1:]:
+        if t0 > cur1:
+            total += cur1 - cur0
+            cur0, cur1 = t0, t1
+        else:
+            cur1 = max(cur1, t1)
+    total += cur1 - cur0
+    return total
+
+
+def gap_report(traces: Iterable) -> dict:
+    docs = [_doc(t) for t in traces]
+    docs = [d for d in docs if d.get("root")]
+    phases: Dict[str, Dict[str, float]] = {}
+    wall_ns = 0
+    covered_ns = 0
+    # dispatch-window aggregation, deduped by (process, span id):
+    # riders adopt the lead's window spans with ids PRESERVED, so the
+    # same (process, id) appearing in several traces is one span. Span
+    # ids alone are per-process counters — trace ids are pid-qualified
+    # precisely so merged multi-process dumps (replica fleets) stay
+    # distinguishable, and the dedup key must follow suit.
+    windows: Dict[tuple, dict] = {}    # (proc, dispatch span id) -> span
+    window_children: Dict[tuple, List[dict]] = {}
+    seen_span_ids = set()
+    for d in docs:
+        proc = str(d.get("trace_id", "")).split("-", 1)[0]
+        root = d["root"]
+        root_dur = max(root["t1_ns"] - root["t0_ns"], 0)
+        wall_ns += root_dur
+        spans = list(d.get("spans", ()))
+        by_id = {s["id"]: s for s in spans}
+        root_children = [s for s in spans
+                         if s.get("parent") == root["id"]]
+        covered_ns += _union_ns(
+            [(s["t0_ns"], s["t1_ns"]) for s in root_children])
+        for s in spans:
+            if (proc, s["id"]) in seen_span_ids:
+                continue  # adopted copy of a shared dispatch span
+            seen_span_ids.add((proc, s["id"]))
+            dur_ms = max(s["t1_ns"] - s["t0_ns"], 0) / 1e6
+            p = phases.setdefault(
+                s["name"], {"count": 0, "total_ms": 0.0})
+            p["count"] += 1
+            p["total_ms"] += dur_ms
+            if s["name"] == "dispatch":
+                windows[(proc, s["id"])] = s
+        for s in spans:
+            parent = by_id.get(s.get("parent"))
+            while parent is not None:
+                if parent["name"] == "dispatch":
+                    window_children.setdefault(
+                        (proc, parent["id"]), []).append(s)
+                    break
+                parent = by_id.get(parent.get("parent"))
+    # dedupe window children (riders adopt copies with the same ids)
+    exec_ns = sum(max(w["t1_ns"] - w["t0_ns"], 0)
+                  for w in windows.values())
+    device_ns = 0
+    host_work_ns = 0
+    for wid, w in windows.items():
+        kids = {s["id"]: s for s in window_children.get(wid, ())}
+        device_ns += _union_ns(
+            [(s["t0_ns"], s["t1_ns"]) for s in kids.values()
+             if s["name"] in DEVICE_PHASES])
+        host_work_ns += _union_ns(
+            [(s["t0_ns"], s["t1_ns"]) for s in kids.values()
+             if s["name"] not in DEVICE_PHASES])
+    gap_ns = max(exec_ns - device_ns, 0)
+    for name, p in phases.items():
+        p["mean_ms"] = p["total_ms"] / p["count"] if p["count"] else 0.0
+        p["share"] = (p["total_ms"] * 1e6 / wall_ns) if wall_ns else 0.0
+        p["total_ms"] = round(p["total_ms"], 3)
+        p["mean_ms"] = round(p["mean_ms"], 4)
+        p["share"] = round(p["share"], 4)
+    return {
+        "traces": len(docs),
+        "wall_ms": round(wall_ns / 1e6, 3),
+        "coverage": round(covered_ns / wall_ns, 4) if wall_ns else 0.0,
+        "phases": dict(sorted(phases.items())),
+        "dispatch_gap": {
+            "windows": len(windows),
+            "exec_ms": round(exec_ns / 1e6, 3),
+            "device_ms": round(device_ns / 1e6, 3),
+            "host_instrumented_ms": round(host_work_ns / 1e6, 3),
+            "host_gap_ms": round(gap_ns / 1e6, 3),
+            "gap_fraction": round(gap_ns / exec_ns, 4) if exec_ns else 0.0,
+        },
+    }
+
+
+def render_gap(report: dict) -> str:
+    """Human-readable gap report (`gmtpu trace --gap` default output)."""
+    lines = [
+        f"dispatch-gap report over {report['traces']} trace(s), "
+        f"wall {report['wall_ms']:.1f} ms "
+        f"(root-phase coverage {report['coverage'] * 100:.1f}%)",
+        f"{'phase':<18}{'count':>7}{'total ms':>12}{'mean ms':>11}"
+        f"{'share':>8}",
+    ]
+    for name, p in report["phases"].items():
+        lines.append(
+            f"{name:<18}{p['count']:>7}{p['total_ms']:>12.2f}"
+            f"{p['mean_ms']:>11.3f}{p['share'] * 100:>7.1f}%")
+    g = report["dispatch_gap"]
+    lines.append(
+        f"dispatch windows: {g['windows']} — exec {g['exec_ms']:.1f} ms, "
+        f"device {g['device_ms']:.1f} ms, "
+        f"host gap {g['host_gap_ms']:.1f} ms "
+        f"({g['gap_fraction'] * 100:.1f}% of window time)")
+    if g["windows"] and g["gap_fraction"] > 0.5:
+        lines.append(
+            "  NOTE: >50% of dispatch-window time is host gap — the "
+            "path is dispatch-bound (ROADMAP item 2), not kernel-bound")
+    return "\n".join(lines)

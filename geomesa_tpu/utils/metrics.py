@@ -135,30 +135,78 @@ class _TimerContext:
         return False
 
 
+def _esc_label(value: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_str(labels: Dict[str, object]) -> str:
+    return ",".join(
+        f'{k}="{_esc_label(str(v))}"' for k, v in sorted(labels.items()))
+
+
 class MetricsRegistry:
+    """Series are keyed by name alone (the common case, unchanged) or by
+    name + sorted labels — `counter("serve.dispatch", tenant="acme")`
+    creates series key `serve.dispatch{tenant="acme"}`. Labeled series
+    export as proper Prometheus labels (one TYPE declaration per family,
+    one sample line per label set) instead of name-mangled metric names;
+    labeled histograms are ordinary `Histogram` objects sharing the
+    fixed default buckets, so `merge()` keeps working across them."""
+
     def __init__(self):
         self._lock = threading.Lock()
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, float] = {}
         self.timers: Dict[str, Timer] = {}
         self.histograms: Dict[str, Histogram] = {}
+        # series key -> (base family name, rendered label string);
+        # unlabeled series never appear here (key IS the family)
+        self._series: Dict[str, Tuple[str, str]] = {}
+        self._family_counts: Dict[str, int] = {}
 
-    def counter(self, name: str, inc: float = 1.0) -> None:
-        with self._lock:
-            self.counters[name] = self.counters.get(name, 0.0) + inc
+    # label values can be client-controlled (the serve layer labels
+    # per-tenant series straight off the request's tenant field), so a
+    # family's distinct label sets are BOUNDED: past the cap, new label
+    # sets fold into the unlabeled aggregate series instead of growing
+    # the registry (and every /metrics scrape) without limit — the same
+    # adversarial-stream stance as the planner's filter cache and the
+    # quarantine table
+    MAX_LABELED_SERIES_PER_FAMILY = 512
 
-    def gauge(self, name: str, value: float) -> None:
-        with self._lock:
-            self.gauges[name] = float(value)
+    def _key(self, name: str, labels: Dict[str, object]) -> str:
+        # callers hold self._lock
+        if not labels:
+            return name
+        ls = _label_str(labels)
+        key = f"{name}{{{ls}}}"
+        if key not in self._series:
+            count = self._family_counts.get(name, 0)
+            if count >= self.MAX_LABELED_SERIES_PER_FAMILY:
+                return name  # overflow: fold into the aggregate
+            self._family_counts[name] = count + 1
+            self._series[key] = (name, ls)
+        return key
 
-    def timer(self, name: str) -> _TimerContext:
+    def counter(self, name: str, inc: float = 1.0, **labels) -> None:
         with self._lock:
-            t = self.timers.setdefault(name, Timer())
+            key = self._key(name, labels)
+            self.counters[key] = self.counters.get(key, 0.0) + inc
+
+    def gauge(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self.gauges[self._key(name, labels)] = float(value)
+
+    def timer(self, name: str, **labels) -> _TimerContext:
+        with self._lock:
+            t = self.timers.setdefault(self._key(name, labels), Timer())
         return _TimerContext(t)
 
-    def histogram(self, name: str) -> Histogram:
+    def histogram(self, name: str, **labels) -> Histogram:
         with self._lock:
-            return self.histograms.setdefault(name, Histogram())
+            return self.histograms.setdefault(
+                self._key(name, labels), Histogram())
 
     def to_json(self) -> str:
         with self._lock:
@@ -181,38 +229,76 @@ class MetricsRegistry:
         """Prometheus text exposition format. Histograms export the
         standard cumulative `_bucket{le=...}` series plus `_p50/_p95/_p99`
         gauge families, so dashboards get quantiles without running
-        histogram_quantile() themselves."""
+        histogram_quantile() themselves. Labeled series render as
+        `family{label="value"} v` with ONE `# TYPE` declaration per
+        family (the text format's contract), not one per label set."""
         out: List[str] = []
         with self._lock:
-            for k, v in self.counters.items():
-                name = _prom(k)
-                out.append(f"# TYPE {name} counter")
-                out.append(f"{name} {v}")
-            for k, v in self.gauges.items():
-                name = _prom(k)
-                out.append(f"# TYPE {name} gauge")
-                out.append(f"{name} {v}")
-            for k, t in self.timers.items():
-                name = _prom(k)
-                out.append(f"# TYPE {name}_seconds summary")
-                out.append(f"{name}_seconds_count {t.count}")
-                out.append(f"{name}_seconds_sum {t.total_s}")
+            counters = list(self.counters.items())
+            gauges = list(self.gauges.items())
+            timers = list(self.timers.items())
             hists = list(self.histograms.items())
-        for k, h in hists:
-            name = _prom(k) + "_seconds"
+            families = dict(self._series)
+
+        def family_of(key: str) -> Tuple[str, str]:
+            return families.get(key, (key, ""))
+
+        def grouped(items):
+            # the text format requires every sample of a family to be
+            # CONTIGUOUS (strict parsers/promtool reject interleaving),
+            # and insertion order interleaves the moment two families'
+            # label sets appear alternately — group per family first,
+            # preserving first-seen family order and per-family
+            # insertion order
+            by_family: Dict[str, list] = {}
+            for k, v in items:
+                base, ls = family_of(k)
+                by_family.setdefault(base, []).append((ls, v))
+            return by_family.items()
+
+        for base, series in grouped(counters):
+            name = _prom(base)
+            out.append(f"# TYPE {name} counter")
+            for ls, v in series:
+                out.append(f"{name}{{{ls}}} {v}" if ls else f"{name} {v}")
+        for base, series in grouped(gauges):
+            name = _prom(base)
+            out.append(f"# TYPE {name} gauge")
+            for ls, v in series:
+                out.append(f"{name}{{{ls}}} {v}" if ls else f"{name} {v}")
+        for base, series in grouped(timers):
+            name = _prom(base)
+            out.append(f"# TYPE {name}_seconds summary")
+            for ls, t in series:
+                suffix = f"{{{ls}}}" if ls else ""
+                out.append(f"{name}_seconds_count{suffix} {t.count}")
+                out.append(f"{name}_seconds_sum{suffix} {t.total_s}")
+        for base, series in grouped(hists):
+            name = _prom(base) + "_seconds"
             out.append(f"# TYPE {name} histogram")
-            with h._lock:
-                counts, count, total = list(h.counts), h.count, h.sum
-            cum = 0
-            for bound, c in zip(h.bounds, counts):
-                cum += c
-                out.append(f'{name}_bucket{{le="{_le(bound)}"}} {cum}')
-            out.append(f'{name}_bucket{{le="+Inf"}} {count}')
-            out.append(f"{name}_sum {total}")
-            out.append(f"{name}_count {count}")
-            for q, label in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+            quantile_lines: Dict[str, List[str]] = {}
+            for ls, h in series:
+                with h._lock:
+                    counts, count, total = list(h.counts), h.count, h.sum
+                cum = 0
+                prefix = f"{ls}," if ls else ""
+                suffix = f"{{{ls}}}" if ls else ""
+                for bound, c in zip(h.bounds, counts):
+                    cum += c
+                    out.append(
+                        f'{name}_bucket{{{prefix}le="{_le(bound)}"}} {cum}')
+                out.append(f'{name}_bucket{{{prefix}le="+Inf"}} {count}')
+                out.append(f"{name}_sum{suffix} {total}")
+                out.append(f"{name}_count{suffix} {count}")
+                for q, label in ((0.50, "p50"), (0.95, "p95"),
+                                 (0.99, "p99")):
+                    quantile_lines.setdefault(label, []).append(
+                        f"{name}_{label}{suffix} {h.quantile(q)}")
+            # the derived _p50/_p95/_p99 gauge families follow their
+            # histogram family, each contiguous across its label sets
+            for label, lines in quantile_lines.items():
                 out.append(f"# TYPE {name}_{label} gauge")
-                out.append(f"{name}_{label} {h.quantile(q)}")
+                out.extend(lines)
         return "\n".join(out) + "\n"
 
 

@@ -8,16 +8,19 @@ serving-hot-path rule GT13 and the robustness rule GT14 (swallowed
 errors / unbounded retry loops at the store/kafka/serve boundaries) —
 and exits nonzero on any unwaived finding,
 printing each with file:line and rule code. In text mode a clean lint is
-followed by two smokes: the warmup smoke (`gmtpu warmup --check`
+followed by three smokes: the warmup smoke (`gmtpu warmup --check`
 semantics against the committed fixture manifest on CPU, proving the
-manifest record→replay→check loop stays green) and the chaos smoke
+manifest record→replay→check loop stays green), the chaos smoke
 (`gmtpu chaos --check` semantics replaying scripts/chaos_smoke_plan.json
 against a tiny serve workload, proving the fault-injection + recovery
-fabric invariants — docs/ROBUSTNESS.md). Rides the tier-1 pytest run via
-tests/test_lint_gate.py and is runnable standalone:
+fabric invariants — docs/ROBUSTNESS.md), and the telemetry smoke (a
+traced serve workload whose /metrics scrape must parse and whose
+dispatch-gap report must be non-empty — docs/OBSERVABILITY.md). Rides
+the tier-1 pytest run via tests/test_lint_gate.py and is runnable
+standalone:
 
     python scripts/lint_gate.py [--format json|sarif]
-        [--no-warmup-smoke] [--no-chaos-smoke]
+        [--no-warmup-smoke] [--no-chaos-smoke] [--no-telemetry-smoke]
 
 Rule catalog + waiver syntax: docs/ANALYSIS.md.
 """
@@ -77,6 +80,108 @@ def chaos_smoke(plan_path: str = CHAOS_PLAN) -> int:
     return 0 if report.ok_overall else 1
 
 
+def telemetry_smoke() -> int:
+    """Serve a tiny traced workload, then prove the observability layer
+    end to end: the /metrics scrape parses as Prometheus text (and
+    carries the serving + breaker families), and the dispatch-gap
+    report over the recorded traces is non-empty with sane coverage.
+    Stderr-only like the other smokes — stdout stays machine-parseable
+    for the lint formats."""
+    _pin_cpu()
+    import json
+    import re
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.plan.datastore import DataStore
+    from geomesa_tpu.serve.service import QueryService, ServeConfig
+    from geomesa_tpu.telemetry import (
+        RECORDER, TRACER, MetricsServer, gap_report)
+
+    failures = []
+    RECORDER.clear()
+    TRACER.enable()
+    try:
+        rng = np.random.default_rng(5)
+        n = 256
+        sft = SimpleFeatureType.from_spec(
+            "telesmoke", "name:String,dtg:Date,*geom:Point")
+        with tempfile.TemporaryDirectory() as tmp:
+            store = DataStore(tmp, use_device_cache=True)
+            src = store.create_schema(sft)
+            src.write(FeatureBatch.from_pydict(sft, {
+                "name": rng.choice(["a", "b"], n).tolist(),
+                "dtg": rng.integers(
+                    1_590_000_000_000, 1_600_000_000_000, n),
+                "geom": np.stack([rng.uniform(-170, 170, n),
+                                  rng.uniform(-80, 80, n)], 1),
+            }))
+            cql = "BBOX(geom, -180, -90, 180, 90)"
+            svc = QueryService(store, ServeConfig(max_wait_ms=20.0),
+                               autostart=False)
+            qp = rng.uniform(-60, 60, (6, 2))
+            futs = [svc.knn("telesmoke", cql, qp[i:i + 1, 0],
+                            qp[i:i + 1, 1], k=4) for i in range(6)]
+            futs += [svc.count("telesmoke", cql) for _ in range(2)]
+            svc.start()
+            for f in futs:
+                f.result(timeout=180)
+            # drain BEFORE scraping: futures resolve inside the dispatch
+            # window, but traces land in the recorder slightly later in
+            # the completion loop — close() joins the dispatch thread,
+            # so the scrape and the in-process report see the same set
+            svc.close(drain=True)
+            server = MetricsServer(port=0, stats_fn=svc.stats,
+                                   pre_scrape=svc.export_gauges)
+            port = server.start()
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=10) as r:
+                    body = r.read().decode()
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/debug/gap",
+                        timeout=10) as r:
+                    http_gap = json.loads(r.read().decode())
+            finally:
+                server.stop()
+    finally:
+        TRACER.disable()
+    # the scrape must PARSE: every non-comment line is
+    # `name[{labels}] <float>`
+    sample = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$')
+    bad = [ln for ln in body.splitlines()
+           if ln and not ln.startswith("#") and not sample.match(ln)]
+    if bad:
+        failures.append(f"unparseable /metrics line(s): {bad[:3]}")
+    for needle in ("serve_latency_seconds_bucket", "serve_queue_depth",
+                   "fault_breaker_", "fault_quarantine_active"):
+        if needle not in body:
+            failures.append(f"/metrics missing {needle}")
+    rep = gap_report(RECORDER.traces())
+    if not rep["phases"] or rep["dispatch_gap"]["windows"] < 1:
+        failures.append(f"gap report empty: {rep}")
+    elif rep["coverage"] < 0.90:
+        failures.append(
+            f"gap coverage {rep['coverage']} < 0.90 (un-instrumented "
+            f"serve seam?)")
+    if http_gap.get("traces") != rep["traces"]:
+        failures.append("/debug/gap disagrees with in-process report")
+    print(
+        f"telemetry smoke: {rep['traces']} trace(s), coverage "
+        f"{rep['coverage']}, {rep['dispatch_gap']['windows']} dispatch "
+        f"window(s), /metrics {len(body.splitlines())} line(s)",
+        file=sys.stderr)
+    for f in failures:
+        print(f"telemetry smoke: FAIL {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def warmup_smoke(manifest_path: str = SMOKE_MANIFEST) -> int:
     """`gmtpu warmup --check` against the fixture manifest, pinned to
     CPU (the fixture records interpret-mode kernels; this gate must run
@@ -121,6 +226,10 @@ def main(argv=None) -> int:
     p.add_argument("--no-chaos-smoke", action="store_true",
                    help="skip the chaos-plan smoke (text mode only, "
                         "like the warmup smoke)")
+    p.add_argument("--no-telemetry-smoke", action="store_true",
+                   help="skip the telemetry smoke (traced serve "
+                        "workload + /metrics parse + gap report; text "
+                        "mode only)")
     args = p.parse_args(argv)
     findings = lint_paths([os.path.join(REPO_ROOT, "geomesa_tpu")])
     if args.format == "json":
@@ -134,6 +243,8 @@ def main(argv=None) -> int:
         rc = warmup_smoke()
     if args.format == "text" and not args.no_chaos_smoke and rc == 0:
         rc = chaos_smoke()
+    if args.format == "text" and not args.no_telemetry_smoke and rc == 0:
+        rc = telemetry_smoke()
     return rc
 
 

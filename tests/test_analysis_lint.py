@@ -535,6 +535,138 @@ class TestTextOutput:
         assert "dead_kernel" in render_text(fs, show_waived=True)
 
 
+# -- GT15 -------------------------------------------------------------------
+
+
+class TestGT15TelemetryDiscipline:
+    """Wall-clock durations + un-scoped spans in serve/engine/telemetry
+    (docs/OBSERVABILITY.md): time.time() feeding a subtraction, and a
+    tracer .span() opened outside a `with` block."""
+
+    def _findings(self, src, relpath="geomesa_tpu/serve/mod.py"):
+        from geomesa_tpu.analysis.modinfo import ModInfo
+        from geomesa_tpu.analysis.rules import gt15
+
+        mod = ModInfo("/x.py", textwrap.dedent(src), relpath=relpath)
+        return list(gt15(mod, None))
+
+    DIRTY = """
+        import time
+
+        def latency():
+            t0 = time.time()
+            work()
+            return time.time() - t0
+
+        def direct():
+            return time.time() - started
+    """
+
+    def test_duration_measurement_flagged(self):
+        found = self._findings(self.DIRTY)
+        assert found and all(f.rule == "GT15" for f in found)
+        lines = {f.line for f in found}
+        assert 5 in lines   # t0 = time.time() later subtracted
+        assert 7 in lines   # time.time() as a direct Sub operand
+        assert 10 in lines  # direct() body
+
+    def test_clean_counterparts(self):
+        clean = """
+            import time
+
+            def latency():
+                t0 = time.perf_counter()
+                work()
+                return time.perf_counter() - t0
+
+            def stamp(event):
+                event.timestamp = time.time()   # a WHEN, not a duration
+
+            def arithmetic():
+                return a - b
+        """
+        assert self._findings(clean) == []
+
+    def test_bare_time_import_flagged(self):
+        src = """
+            from time import time
+
+            def f():
+                t0 = time()
+                return time() - t0
+        """
+        assert self._findings(src)
+
+    def test_scope_is_path_limited(self):
+        # plan/ keeps its perf_counter discipline via other means; the
+        # wall-clock audit timestamps there are deliberate
+        assert self._findings(self.DIRTY, "geomesa_tpu/plan/mod.py") == []
+        assert self._findings(self.DIRTY, "bench.py") == []
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/engine/mod.py")
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/telemetry/mod.py")
+
+    def test_function_bodies_do_not_leak_scopes(self):
+        """A timestamp in one function must not pair with an unrelated
+        subtraction in another (or at module level): each def is its
+        own scope, including defs seeded directly from the module."""
+        src = """
+            import time
+
+            def stamp(ev):
+                t0 = time.time()
+                ev.ts = t0
+
+            def width(a, t0):
+                return a - t0
+        """
+        assert self._findings(src) == []
+
+    def test_span_without_with_flagged(self):
+        src = """
+            def bad(tracer):
+                s = tracer.span("phase")
+                work()
+
+            def good(tracer):
+                with tracer.span("phase"):
+                    work()
+
+            def also_good(tracer, stack):
+                stack.enter_context(tracer.span("phase"))
+        """
+        found = self._findings(src)
+        assert [(f.rule, f.line) for f in found] == [("GT15", 3)]
+
+    def test_waiver_and_registration(self):
+        from geomesa_tpu.analysis.model import RULES
+        from geomesa_tpu.analysis.rules import ALL_RULES
+
+        assert "GT15" in RULES and "GT15" in ALL_RULES
+        # inline waiver channel, through the full linter (the fixture
+        # must live under a geomesa_tpu/serve/ path for GT15 scope)
+        import pathlib
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            sub = pathlib.Path(td) / "geomesa_tpu" / "serve"
+            sub.mkdir(parents=True)
+            (sub / "mod.py").write_text(textwrap.dedent("""
+                import time
+
+                def f():
+                    # gt: waive GT15
+                    t0 = time.time()
+                    return time.time() - t0
+            """))
+            fs = lint_paths([td], rules=["GT15"], extra_ref_paths=[])
+            flagged = active(fs)
+            # the waived assignment is suppressed; the direct operand
+            # on the return line still flags
+            assert all(f.line != 6 for f in flagged)
+
+
 # -- self-lint --------------------------------------------------------------
 
 
