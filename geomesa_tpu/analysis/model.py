@@ -62,6 +62,12 @@ RULES: Dict[str, Rule] = {
                      "latencies must use perf_counter/monotonic), or a "
                      "tracer .span() opened outside a `with` block "
                      "(leaks an unbalanced open span)"),
+        Rule("GT16", "blocking call (block_until_ready / future "
+                     ".result() / jax.device_get) inside a pipeline "
+                     "prepare/transfer/launch stage: the stage must "
+                     "return before the device finishes or window "
+                     "overlap silently dies (sync belongs on the "
+                     "completer)"),
     )
 }
 

@@ -810,12 +810,80 @@ def gt15(mod: ModInfo, project) -> Iterator[Finding]:
             "manual open)")
 
 
+# GT16 scope: the pipelined dispatch path (serve/pipeline.py). The
+# pipeline's whole point is that prepare/transfer/launch return before
+# the device finishes — window N+1's host work overlaps window N's
+# kernel. A blocking call inside those stages (block_until_ready, a
+# future .result(), an explicit jax.device_get host read) re-serializes
+# exactly the host gap the pipeline exists to remove, and it does so
+# silently: results stay correct, only the overlap dies. Blocking is
+# the COMPLETER's job (the sync stage). Waivable inline for documented
+# deliberate syncs; the shipped tree is clean.
+_GT16_PATH = "geomesa_tpu/serve/pipeline.py"
+_GT16_STAGE_MARKERS = ("prepare", "transfer", "launch")
+_GT16_STAGE_NAMES = {"submit"}
+_GT16_BLOCKING = {
+    "block_until_ready": "device sync",
+    "result": "future wait",
+    "device_get": "host read",
+}
+
+
+def _gt16_stage_functions(mod: ModInfo):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        name = node.name.lstrip("_")
+        if name in _GT16_STAGE_NAMES or any(
+                m in name for m in _GT16_STAGE_MARKERS):
+            yield node
+
+
+def gt16(mod: ModInfo, project) -> Iterator[Finding]:
+    """GT16: blocking calls inside pipeline prepare/transfer/launch
+    stages.
+
+    Flags `.block_until_ready()`, `.result()` (futures; `set_result` is
+    a resolve, not a wait, and is not matched) and `jax.device_get` /
+    bare `device_get` calls lexically inside the stage functions of
+    serve/pipeline.py (names containing prepare/transfer/launch, plus
+    `submit`). `np.asarray` on a device array blocks too but is
+    indistinguishable statically from legitimate host stacking — use
+    the explicit `jax.device_get` spelling for intentional reads so
+    this rule can see them (and waive)."""
+    path = mod.relpath.replace("\\", "/")
+    if _GT16_PATH not in path:
+        return
+    seen: Set[int] = set()
+    for fn in _gt16_stage_functions(mod):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            ident = None
+            if isinstance(f, ast.Attribute):
+                ident = f.attr
+            elif isinstance(f, ast.Name):
+                ident = f.id if f.id == "device_get" else None
+            what = _GT16_BLOCKING.get(ident or "")
+            if what is None or node.lineno in seen:
+                continue
+            seen.add(node.lineno)
+            yield _finding(
+                "GT16", mod, node,
+                f"blocking call ({ident}: {what}) inside pipeline stage "
+                f"{fn.name!r}: prepare/transfer/launch must return "
+                f"before the device finishes — window overlap dies "
+                f"silently otherwise. Move the wait to the completer's "
+                f"sync stage, or waive a documented deliberate sync")
+
+
 from geomesa_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES)
 
 ALL_RULES = {
     "GT01": gt01, "GT02": gt02, "GT03": gt03,
     "GT04": gt04, "GT05": gt05, "GT06": gt06,
-    "GT13": gt13, "GT14": gt14, "GT15": gt15,
+    "GT13": gt13, "GT14": gt14, "GT15": gt15, "GT16": gt16,
     **CONCURRENCY_RULES,
 }

@@ -218,11 +218,18 @@ def register(sub: "argparse._SubParsersAction") -> None:
                           choices=["knn", "count"], help="workload kind")
     bserve_p.add_argument("--k", type=int, default=8, help="kNN k")
     bserve_p.add_argument("--mode", default="closed",
-                          choices=["closed", "open"])
+                          choices=["closed", "open", "sustained"])
     bserve_p.add_argument("--clients", type=int, default=16,
                           help="closed-loop client count")
     bserve_p.add_argument("--rate", type=float, default=200.0,
                           help="open-loop offered rate (qps)")
+    bserve_p.add_argument("--outstanding", type=int, default=32,
+                          help="sustained-mode in-flight request cap "
+                               "(semaphore-gated closed loop reporting "
+                               "pts/s + windows-in-flight)")
+    bserve_p.add_argument("--no-pipeline", action="store_true",
+                          help="serial dispatch (pipelined is the "
+                               "default for kNN windows)")
     bserve_p.add_argument("--duration", type=float, default=5.0,
                           help="seconds per measured run")
     bserve_p.add_argument("--max-wait-ms", type=float, default=2.0)
@@ -427,7 +434,7 @@ def _bench_serve(args) -> int:
     from geomesa_tpu.plan import DataStore
     from geomesa_tpu.serve.loadgen import (
         count_request_factory, knn_request_factory, run_closed_loop,
-        run_open_loop)
+        run_open_loop, run_sustained)
     from geomesa_tpu.serve.service import QueryService, ServeConfig
 
     if args.smoke:
@@ -482,6 +489,14 @@ def _bench_serve(args) -> int:
             RECORDER.clear()
             TRACER.enable()
 
+        try:
+            store_points = store.get_feature_source(
+                type_name).storage.count
+        except Exception:
+            store_points = args.n if not args.catalog else 0
+
+        pipe = not getattr(args, "no_pipeline", False)
+
         def run(label: str, config: ServeConfig):
             svc = QueryService(store, config)
             try:
@@ -489,10 +504,15 @@ def _bench_serve(args) -> int:
                     rep = run_closed_loop(
                         svc, factory, concurrency=args.clients,
                         duration_s=args.duration)
-                else:
+                elif args.mode == "open":
                     rep = run_open_loop(
                         svc, factory, rate_qps=args.rate,
                         duration_s=args.duration)
+                else:
+                    rep = run_sustained(
+                        svc, factory, duration_s=args.duration,
+                        max_outstanding=args.outstanding,
+                        points_per_query=store_points)
             finally:
                 svc.close(drain=True)
             doc = {"run": label, **rep.to_json()}
@@ -500,12 +520,16 @@ def _bench_serve(args) -> int:
             return rep
 
         coalesced = run("coalesced", ServeConfig(
-            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms))
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            pipeline=pipe))
         if not args.no_compare:
+            # the baseline drops BOTH levers (coalescing and the
+            # pipeline) so the comparison is serve-stack vs serial
             serial = run("serial", ServeConfig(max_batch=1,
-                                               max_wait_ms=0.0))
+                                               max_wait_ms=0.0,
+                                               pipeline=False))
             if serial.throughput_qps > 0:
-                print(json.dumps({
+                doc = {
                     "run": "comparison",
                     "throughput_speedup": round(
                         coalesced.throughput_qps / serial.throughput_qps,
@@ -513,7 +537,13 @@ def _bench_serve(args) -> int:
                     "p99_ratio": round(
                         coalesced.p99_ms / serial.p99_ms, 3)
                     if serial.p99_ms else None,
-                }))
+                }
+                if args.mode == "sustained":
+                    doc["sustained_pts_per_s"] = round(
+                        coalesced.pts_per_s, 1)
+                    doc["windows_in_flight_max"] = \
+                        coalesced.windows_in_flight_max
+                print(json.dumps(doc))
         if tracing:
             # BENCH r06+ carries the dispatch-gap attribution: one JSON
             # line next to the throughput lines, plus a Perfetto file

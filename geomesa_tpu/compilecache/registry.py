@@ -86,6 +86,7 @@ class _RegisteredKernel:
     def __init__(self, name: str, fn, static_argnames: Sequence[str] = (),
                  donate_argnums: Sequence[int] = ()):
         self.name = name
+        self.fn = fn  # as registered (serve_variant re-derives from it)
         self.static_argnames = tuple(static_argnames)
         self.donate_argnums = tuple(donate_argnums)
         if _is_jitted(fn) and not donate_argnums:
@@ -140,6 +141,53 @@ class ExecutableRegistry:
     def names(self):
         with self._lock:
             return sorted(self._kernels)
+
+    # -- serve donation tier -----------------------------------------------
+
+    SERVE_SUFFIX = "@serve"
+
+    def serve_variant(self, name: str, donate_argnums: Sequence[int],
+                      fn=None, static_argnames: Sequence[str] = ()) -> str:
+        """Register (idempotently) the donating serve-tier variant of
+        `name` and return its registry key (`<name>@serve`).
+
+        The default engine sweep donates NOTHING — the documented
+        overflow fallbacks (`knn_sparse_auto` re-running `knn_fullscan`
+        on the same mask/query buffers) re-read caller buffers after the
+        call. The serve pipeline is the caller that OWNS its buffers:
+        query points are staged per window through
+        `engine.device.QueryStager`, the host copy is kept on the
+        request (so the OOM-halving fallback re-stages from host), and
+        nothing re-reads a staged buffer after the launch — so its
+        variants donate the query argnums and XLA reuses that HBM
+        across windows instead of allocating per dispatch. Keyed apart
+        from the base kernel: a donating executable must never answer a
+        non-donating lookup.
+
+        `fn` defaults to the base registration's function; passing it
+        explicitly lets the serve path register kernels the default
+        sweep has not seen. Raises KeyError when neither is available.
+        Donation is a no-op (with a JAX warning) on backends that do not
+        implement it (CPU) — callers gate on `jax.default_backend()`."""
+        vname = name + self.SERVE_SUFFIX
+        with self._lock:
+            if vname in self._kernels:
+                return vname
+            base = self._kernels.get(name)
+        if fn is None:
+            if base is None:
+                raise KeyError(
+                    f"serve_variant({name!r}): kernel not registered and "
+                    f"no fn given (see install_defaults())")
+            fn = base.fn
+            if not static_argnames:
+                static_argnames = base.static_argnames
+        from geomesa_tpu.utils.metrics import metrics
+
+        self.register(vname, fn, static_argnames=static_argnames,
+                      donate_argnums=donate_argnums)
+        metrics.counter("compilecache.serve.variants")
+        return vname
 
     # -- compilation -------------------------------------------------------
 

@@ -21,6 +21,7 @@ are explicit, so nothing else silently widens.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict
 
 import jax
@@ -116,6 +117,88 @@ def _to_device_impl(
 # edge tables are built by GeometryColumn.edge_table() (vectorized,
 # memoized, ring-orientation-normalized for polygon kinds) — see
 # core.columnar.EdgeTable.
+
+
+# -- double-buffered query staging (serve pipeline) -------------------------
+
+
+class QueryStager:
+    """Double-buffered host→device staging slots for the serve
+    pipeline's query streams (docs/SERVING.md "Pipelined dispatch").
+
+    Each pipelined window stages its (padded, f32) stacked query points
+    through `stage()` before the kernel launch, so the transfer overlaps
+    the PREVIOUS window's kernel instead of serializing in front of this
+    window's. Per (kernel, bucket) key the stager keeps `depth` slots
+    rotated per window; the slot reference is what bounds live staging
+    HBM to `depth` buffers per key and — under the registry's serve
+    donation tier — guarantees the pair handed to window N is never the
+    pair window N+1 is transferring into (a donated buffer is consumed
+    by its window's program; the rotation means the stager re-offers
+    that slot only after the depth-bounded pipeline has synced the
+    window that consumed it).
+
+    The dtype discipline matches the serial path exactly
+    (`jnp.asarray(np.asarray(qx), jnp.float32)`): host f64 → f32 cast on
+    host, then device_put — so pipelined results are bit-identical.
+    Transfers run under the same recovery fabric as `to_device`
+    (device.transfer fault site, tiny-backoff retries, device breaker).
+    Thread-safe, though the serve pipeline calls it from the single
+    dispatch thread."""
+
+    # bound on distinct (kernel, bucket) keys: beyond it the
+    # least-recently-staged key is evicted so a long-lived multi-tenant
+    # service never pins more than MAX_KEYS * depth stale device pairs
+    # (an evicted key's buffers free once its in-flight windows sync —
+    # the kernels hold their own references)
+    MAX_KEYS = 64
+
+    def __init__(self, depth: int = 2):
+        if depth < 2:
+            raise ValueError("stager depth must be >= 2 (double buffer)")
+        self.depth = depth
+        self._lock = threading.Lock()
+        # key -> [seq, slot0, slot1, ...]; slot = (qx_dev, qy_dev).
+        # Insertion-ordered; stage() re-inserts on touch, so iteration
+        # order is least-recently-staged first (the eviction order)
+        self._slots: Dict[object, list] = {}
+        self._staged_total = 0
+
+    def stage(self, key, qx, qy, device=None):
+        """Transfer one window's stacked query points; returns the
+        device (qx, qy) pair. `qx`/`qy` are host arrays (the caller
+        keeps them — the OOM ladder re-stages from host)."""
+        qx32 = np.asarray(qx, np.float32)
+        qy32 = np.asarray(qy, np.float32)
+
+        def _put():
+            _TRANSFER_SITE.fire()
+            return (jax.device_put(jnp.asarray(qx32), device),
+                    jax.device_put(jnp.asarray(qy32), device))
+
+        with TRACER.span("device.transfer", rows=int(qx32.shape[0]),
+                         staged=True):
+            pair = retry_call(
+                _put, policy=_DEVICE_RETRY, label="device",
+                breaker=BREAKERS.get("device"))
+        with self._lock:
+            slot = self._slots.pop(key, None)
+            if slot is None:
+                slot = [0] + [None] * self.depth
+                while len(self._slots) >= self.MAX_KEYS:
+                    # least-recently-staged key goes first
+                    self._slots.pop(next(iter(self._slots)))
+            self._slots[key] = slot  # re-insert = LRU touch
+            seq = slot[0]
+            slot[1 + seq % self.depth] = pair
+            slot[0] = seq + 1
+            self._staged_total += 1
+        return pair
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"keys": len(self._slots),
+                    "staged": self._staged_total}
 
 
 # -- batch-identity device cache --------------------------------------------

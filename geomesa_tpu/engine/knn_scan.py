@@ -510,6 +510,64 @@ def capacity_bucket(tiles_hit: int, slack: float = 1.25,
     return max(floor, 1 << int(np.ceil(np.log2(need))))
 
 
+def knn_sparse_launch(
+    qx: jax.Array,
+    qy: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    k: int,
+    tile_capacity: "int | None" = None,
+    m_blocks: int = 64,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, int]:
+    """Async half of `knn_sparse_auto`: calibrate capacity if the caller
+    has no estimate (one device scalar fetch — the only sync here), then
+    DISPATCH the sparse scan and return device-resident
+    (dists, idx, overflow, tile_capacity) without reading anything back.
+    JAX's async dispatch means the kernel executes while the caller's
+    host thread moves on — the serve pipeline launches window N+1's
+    transfer behind this. `knn_sparse_finish` completes the contract."""
+    if tile_capacity is None:
+        tile_capacity = capacity_bucket(int(np.asarray(
+            count_match_tiles(mask))))
+    fd, fi, ov = knn_sparse_scan(
+        qx, qy, x, y, mask, k=k, tile_capacity=tile_capacity,
+        m_blocks=m_blocks, interpret=interpret,
+    )
+    return fd, fi, ov, tile_capacity
+
+
+def knn_sparse_finish(
+    fd, fi, ov,
+    qx: jax.Array,
+    qy: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    k: int,
+    tile_capacity: int,
+    m_blocks: int = 64,
+    interpret: bool = False,
+    extra=(),
+) -> tuple:
+    """Sync half: ONE transfer for results + overflow flag (+ any
+    `extra` device values riding the same fetch — the serve path's fused
+    count scalar), falling back to the dense fullscan on overflow
+    exactly like `knn_sparse_auto`. Returns
+    (dists np, idx np, capacity_used, extra_host tuple)."""
+    # ONE transfer: fetching ov alone first would serialize a second
+    # tunnel round trip (~110 ms on the remote platform) before the
+    # caller's own result fetch
+    fd, fi, ov, *extra_host = jax.device_get((fd, fi, ov) + tuple(extra))
+    if bool(ov):
+        fd, fi = jax.device_get(knn_fullscan(
+            qx, qy, x, y, mask, k=k, m_blocks=m_blocks,
+            interpret=interpret))
+        return fd, fi, -1, tuple(extra_host)
+    return fd, fi, tile_capacity, tuple(extra_host)
+
+
 def knn_sparse_auto(
     qx: jax.Array,
     qy: jax.Array,
@@ -528,24 +586,18 @@ def knn_sparse_auto(
     idx as HOST numpy arrays (results and the overflow flag come back in
     one transfer). Callers cache capacity_used across queries and only
     pay calibration again after an overflow (capacity_used == -1 signals
-    the fallback ran, so the next query recalibrates)."""
-    if tile_capacity is None:
-        tile_capacity = capacity_bucket(int(np.asarray(
-            count_match_tiles(mask))))
-    fd, fi, ov = knn_sparse_scan(
+    the fallback ran, so the next query recalibrates). Composed from the
+    launch/finish halves so the serial path and the serve pipeline run
+    byte-identical kernel sequences."""
+    fd, fi, ov, tile_capacity = knn_sparse_launch(
         qx, qy, x, y, mask, k=k, tile_capacity=tile_capacity,
         m_blocks=m_blocks, interpret=interpret,
     )
-    # ONE transfer for results + overflow flag: fetching ov alone first
-    # would serialize a second tunnel round trip (~110 ms on the remote
-    # platform) before the caller's own result fetch
-    fd, fi, ov = jax.device_get((fd, fi, ov))
-    if bool(ov):
-        fd, fi = jax.device_get(knn_fullscan(
-            qx, qy, x, y, mask, k=k, m_blocks=m_blocks,
-            interpret=interpret))
-        return fd, fi, -1
-    return fd, fi, tile_capacity
+    fd, fi, cap, _ = knn_sparse_finish(
+        fd, fi, ov, qx, qy, x, y, mask, k=k, tile_capacity=tile_capacity,
+        m_blocks=m_blocks, interpret=interpret,
+    )
+    return fd, fi, cap
 
 
 def knn_sparse_sharded(

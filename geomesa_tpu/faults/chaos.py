@@ -219,11 +219,101 @@ def _run_workload(plan: FaultPlan, root: str, requests: int,
                                     per_platform=False, force=True)
     _check_manifest(os.path.join(root, "cat"), "chaos",
                     report.invariant_failures)
+    # pipeline-drain phase: a device.transfer fault fired MID-pipeline
+    # (other windows in flight) must fail only its own window — typed —
+    # while every other in-flight window drains cleanly. Runs in its
+    # own harness scope (per-activation site counters keep it
+    # deterministic regardless of the legacy phase's call counts); its
+    # fires append to the returned log so the replay diff covers it.
+    log += _pipeline_burst(plan, root, report, say)
     say(f"workload: {report.ok}/{report.requests} ok, "
         f"typed={sum(report.typed_errors.values())}, "
         f"untyped={len(report.untyped_errors)}, "
         f"fires={len(log)}")
     return log
+
+
+# burst shape: 6 single-request kNN windows through the pipeline
+# (max_batch=1 keeps windows singleton => the stager's device.transfer
+# fires land at deterministic call indices), with window 3's transfer
+# failed through ALL retry attempts (the device RetryPolicy makes 3) —
+# calls 5, 6, 7 at the site: windows 1-2 fire stage+scan-upload (2
+# calls each), window 3's stage then retries twice more
+_BURST_REQUESTS = 6
+_BURST_FAULT_CALLS = (5, 6, 7)
+
+
+def _pipeline_burst(plan: FaultPlan, root: str, report: ChaosReport,
+                    say) -> List[tuple]:
+    from geomesa_tpu.faults.plan import FaultRule
+    from geomesa_tpu.serve.service import QueryService, ServeConfig
+
+    # same row count as the legacy phase's store: the padded batch hits
+    # the SAME pow2 kernel bucket, so the burst re-uses warm compiles
+    # instead of adding a shape to every seeded run's wall time
+    store, sft = _synth_store(os.path.join(root, "burst"), n=384,
+                              seed=plan.seed + 29)
+    rng = np.random.default_rng(plan.seed + 31)
+    qpts = rng.uniform(-60, 60, (_BURST_REQUESTS, 2))
+    cql = "BBOX(geom, -170, -80, 170, 80)"
+    svc = QueryService(store, ServeConfig(
+        max_wait_ms=0.0, max_batch=1, drain_timeout_s=30.0))
+    burst_plan = FaultPlan(
+        seed=plan.seed + 37,
+        rules=[FaultRule(site="device.transfer", error="unavailable",
+                         nth_call=c) for c in _BURST_FAULT_CALLS])
+    try:
+        # warm OUTSIDE the harness: compiles and first-read I/O must not
+        # consume injected calls (run 2's warm in-process caches would
+        # otherwise shift the fire schedule and break replay)
+        svc.knn("chaos", cql, qpts[0:1, 0], qpts[0:1, 1],
+                k=5, timeout_ms=60_000).result(120)
+        ok = typed = 0
+        with _harness.active(burst_plan) as h:
+            futs = [svc.knn("chaos", cql, qpts[i:i + 1, 0],
+                            qpts[i:i + 1, 1], k=5, timeout_ms=60_000)
+                    for i in range(_BURST_REQUESTS)]
+            for f in futs:
+                report.requests += 1
+                try:
+                    f.result(timeout=120)
+                    ok += 1
+                    report.ok += 1
+                except Exception as e:  # noqa: BLE001 — taxonomy decides
+                    if _errors.is_typed(e):
+                        typed += 1
+                        key = type(e).__name__
+                        report.typed_errors[key] = (
+                            report.typed_errors.get(key, 0) + 1)
+                    else:
+                        report.untyped_errors.append(
+                            f"burst: {type(e).__name__}: {e}")
+            svc.close(drain=True)
+            blog = h.fire_log()
+        pstats = (svc.stats().get("pipeline") or {})
+        if len(blog) != len(_BURST_FAULT_CALLS):
+            report.invariant_failures.append(
+                f"pipeline burst: expected {len(_BURST_FAULT_CALLS)} "
+                f"device.transfer fires, saw {len(blog)}")
+        if typed != 1 or ok != _BURST_REQUESTS - 1:
+            report.invariant_failures.append(
+                f"pipeline burst: faulted window must fail alone and "
+                f"typed (ok={ok}, typed={typed} of {_BURST_REQUESTS})")
+        if pstats.get("inflight", 0) != 0:
+            report.invariant_failures.append(
+                "pipeline burst: windows still in flight after drain")
+        if svc._worker is not None and svc._worker.is_alive():
+            report.invariant_failures.append(
+                "pipeline burst: dispatch thread alive after drain")
+        say(f"pipeline burst: {ok} ok / {typed} typed, "
+            f"max_inflight={pstats.get('max_inflight')}, "
+            f"fires={len(blog)}")
+        return blog
+    finally:
+        try:
+            svc.close(drain=False)
+        except Exception:
+            pass
 
 
 def _drive(plan, root, requests, report, svc, store, sft, kstore, ksrc,

@@ -65,6 +65,11 @@ class ServeEvent:
     retries: int = 0
     fault_injected: int = 0
     breaker_state: str = ""
+    # pipelined dispatch (docs/SERVING.md "Pipelined dispatch"): True
+    # when this request rode a pipelined window — exec_ms then spans
+    # launch→deferred-sync, and count requests may have been fused onto
+    # a kNN window's mask reduction
+    pipelined: bool = False
     # telemetry correlation (docs/OBSERVABILITY.md): the id of the span
     # trace this request produced, "" when tracing was off. The
     # ServeEvent is the root span's summary — an audit-log latency

@@ -606,6 +606,54 @@ class QueryPlanner:
             return self._knn(query, qx, qy, k=k, impl=impl,
                              timeout_ms=timeout_ms)
 
+    def knn_launch(
+        self,
+        query: "Query | str",
+        qx,
+        qy,
+        k: int = 10,
+        impl: str = "sparse",
+        timeout_ms: Optional[int] = None,
+        staged=None,
+        want_mask_count: bool = False,
+        donate: bool = False,
+    ) -> "KnnLaunch":
+        """Async half of `knn`: plan → prune → mask → kernel DISPATCH,
+        returning a `KnnLaunch` handle without reading any result back.
+        JAX dispatch is asynchronous, so the kernel executes while the
+        caller overlaps the next window's host prep and transfer — the
+        serve pipeline's entry point (docs/SERVING.md "Pipelined
+        dispatch"). `launch.sync()` completes the contract with the same
+        single combined transfer (and overflow fallback) the serial
+        path pays, so `knn_launch(...).sync() == knn(...)` bit-for-bit.
+
+        `staged`: pre-staged device (qx, qy) from the pipeline's
+        transfer stage (engine.device.QueryStager); `qx`/`qy` must still
+        be the HOST copies — the OOM ladder re-stages from them.
+        `want_mask_count`: also launch a count reduction over the final
+        filter mask (the cross-kind count+kNN fusion); available after
+        sync as `launch.mask_count` when `launch.fused_ok`. The mask at
+        reduction time is f64-exact — band corrections are scattered in
+        and visibility is folded — so the fusion holds for banded and
+        band-free filters alike (parity-asserted in
+        tests/test_pipeline.py); `fused_ok` stays in the contract so a
+        future gate can decline, and callers must handle False by
+        dispatching the count serially.
+        `donate`: route the kernel through the ExecutableRegistry's
+        serve donation tier so the staged query buffers are donated to
+        XLA (no-op on backends without donation support, i.e. CPU)."""
+        from geomesa_tpu.faults import deadline_scope
+
+        deadline = (time.monotonic() + timeout_ms / 1000.0
+                    if timeout_ms else None)
+        with deadline_scope(deadline):
+            launch = self._knn_launch(
+                query, qx, qy, k=k, impl=impl, timeout_ms=timeout_ms,
+                staged=staged, want_mask_count=want_mask_count,
+                donate=donate)
+        launch.deadline = deadline
+        return launch
+
     def _knn(
         self,
         query: "Query | str",
@@ -615,6 +663,24 @@ class QueryPlanner:
         impl: str = "sparse",
         timeout_ms: Optional[int] = None,
     ):
+        """Serial kNN = launch + sync back to back (the launch/sync
+        seam exists for the serve pipeline; composing it here keeps the
+        two paths byte-identical by construction)."""
+        return self._knn_launch(
+            query, qx, qy, k=k, impl=impl, timeout_ms=timeout_ms).sync()
+
+    def _knn_launch(
+        self,
+        query: "Query | str",
+        qx,
+        qy,
+        k: int = 10,
+        impl: str = "sparse",
+        timeout_ms: Optional[int] = None,
+        staged=None,
+        want_mask_count: bool = False,
+        donate: bool = False,
+    ) -> "KnnLaunch":
         """KNN aggregation push-down over the store scan (SURVEY.md §3.4
         KNN process stack): plan → prune → device predicate mask → fused
         Pallas scan over match-bearing tiles only (engine.knn_scan — the
@@ -640,7 +706,8 @@ class QueryPlanner:
 
         from geomesa_tpu.engine.device import to_device
         from geomesa_tpu.engine.knn_scan import (
-            default_interpret, knn_fullscan_tiled, knn_sparse_auto)
+            capacity_bucket, count_match_tiles, default_interpret,
+            knn_fullscan_tiled, knn_sparse_launch)
         from geomesa_tpu.plan.runner import visibility_mask
 
         if isinstance(query, str):
@@ -664,14 +731,20 @@ class QueryPlanner:
 
         def empty():
             # a real empty batch, not None: callers select() against the
-            # returned features (legacy window path guaranteed the same)
+            # returned features (legacy window path guaranteed the same).
+            # Returned as an already-synced launch so the serial and
+            # pipelined paths share one early-out shape (fused count 0).
             sft = self.storage.sft
-            return (
-                np.full((len(qx), k), np.inf),
-                np.zeros((len(qx), k), np.int32),
-                FeatureBatch.from_pydict(
-                    sft, {a.name: [] for a in sft.attributes}
+            return KnnLaunch.ready(
+                self,
+                (
+                    np.full((len(qx), k), np.inf),
+                    np.zeros((len(qx), k), np.int32),
+                    FeatureBatch.from_pydict(
+                        sft, {a.name: [] for a in sft.attributes}
+                    ),
                 ),
+                fused=want_mask_count,
             )
 
         if self.cache is not None:
@@ -751,17 +824,38 @@ class QueryPlanner:
 
         x = dev[f"{g.name}__x"]
         y = dev[f"{g.name}__y"]
-        jqx = jnp.asarray(np.asarray(qx), jnp.float32)
-        jqy = jnp.asarray(np.asarray(qy), jnp.float32)
+        if staged is not None:
+            # pipeline transfer stage already put the (padded, f32)
+            # query arrays on device — the values are identical to the
+            # serial conversion below (QueryStager casts the same way)
+            jqx, jqy = staged
+        else:
+            jqx = jnp.asarray(np.asarray(qx), jnp.float32)
+            jqy = jnp.asarray(np.asarray(qy), jnp.float32)
         kk = min(k, x.shape[0])
         mb = max(64, kk)
         interp = default_interpret()
+        count_dev = None
+        if want_mask_count:
+            # cross-kind fusion: a count against the same (type, CQL,
+            # hints) is ONE reduction over the mask this launch already
+            # computed — it rides the kernel's result transfer instead
+            # of paying its own dispatch RTT. The mask at this point is
+            # f64-exact: the band-correction scatter above patched every
+            # f32-boundary row with its exact value (the same correction
+            # the count paths apply via band_count_correction), and
+            # visibility is folded in — parity with planner.count is
+            # asserted in tests/test_pipeline.py for banded and
+            # band-free filters alike.
+            count_dev = jnp.sum(mask, dtype=jnp.int64)
+        launch = KnnLaunch(self, k=k, kk=kk, impl=impl, batch=batch,
+                           count_dev=count_dev)
         with self._mutex:
             caps = getattr(self, "_knn_caps", None)
             if caps is None:
                 caps = self._knn_caps = {}
         if impl == "auto":
-            impl = self._knn_impl_from_stats(plan)
+            impl = launch.impl = self._knn_impl_from_stats(plan)
         if impl == "sparse":
             # capacity reuse hits on REPEATED identical queries (the
             # steady-state server shape); radius-growth loops re-key per
@@ -774,25 +868,72 @@ class QueryPlanner:
                 seed_cap = caps.get(key)
             with TRACER.span("kernel.dispatch", kernel="knn_sparse",
                              q=int(jqx.shape[0]), k=kk):
-                fd, fi, cap = knn_sparse_auto(
-                    jqx, jqy, x, y, mask, k=kk,
-                    tile_capacity=seed_cap, m_blocks=mb, interpret=interp,
-                )
-            with self._mutex:
-                if cap > 0:
-                    caps[key] = cap
+                if seed_cap is None:
+                    # calibration: the one (small, scalar) sync a cold
+                    # (filter, k) pays at launch; repeats hit the cache
+                    seed_cap = capacity_bucket(int(np.asarray(
+                        count_match_tiles(mask))))
+                if donate:
+                    fd, fi, ov = self._knn_serve_kernel(
+                        "knn_scan.knn_sparse_scan", (0, 1),
+                        jqx, jqy, x, y, mask,
+                        k=kk, tile_capacity=seed_cap, m_blocks=mb,
+                        interpret=interp)
+                    # the staged jqx/jqy were DONATED to the kernel —
+                    # the overflow fallback must never re-read them, so
+                    # the handle keeps host copies instead (same f32
+                    # values; knn_fullscan converts on entry)
+                    fb_qx = np.asarray(qx, np.float32)
+                    fb_qy = np.asarray(qy, np.float32)
                 else:
-                    caps.pop(key, None)
+                    fd, fi, ov, seed_cap = knn_sparse_launch(
+                        jqx, jqy, x, y, mask, k=kk,
+                        tile_capacity=seed_cap, m_blocks=mb,
+                        interpret=interp,
+                    )
+                    fb_qx, fb_qy = jqx, jqy
+            launch.arm_sparse(fd, fi, ov, fb_qx, fb_qy, x, y, mask,
+                              cap=seed_cap, caps_key=key, mb=mb,
+                              interp=interp)
         else:
             with TRACER.span("kernel.dispatch", kernel="knn_fullscan",
                              q=int(jqx.shape[0]), k=kk):
-                fd, fi = knn_fullscan_tiled(
-                    jqx, jqy, x, y, mask, k=kk, m_blocks=mb,
-                    interpret=interp,
-                )
-        with TRACER.span("device.sync"):
-            dists, idx = _pad_to_k(np.asarray(fd), np.asarray(fi), k)
-        return dists, idx, batch
+                if donate:
+                    fd, fi = self._knn_serve_kernel(
+                        "knn_scan.knn_fullscan_tiled", (0, 1),
+                        jqx, jqy, x, y, mask,
+                        k=kk, m_blocks=mb, interpret=interp)
+                else:
+                    fd, fi = knn_fullscan_tiled(
+                        jqx, jqy, x, y, mask, k=kk, m_blocks=mb,
+                        interpret=interp,
+                    )
+            launch.arm_dense(fd, fi)
+        return launch
+
+    def _knn_serve_kernel(self, name: str, donate_argnums, *args,
+                          **statics):
+        """Dispatch a kNN kernel through the ExecutableRegistry's serve
+        donation tier (registry.serve_variant): the staged query buffers
+        (argnums 0, 1) are serve-owned — nothing re-reads them after the
+        launch and the host copies stay on the requests for the OOM
+        re-staging fallback — so XLA may reuse their HBM across windows.
+        The AOT handle also means a warm serve process never traces
+        here. Donation itself is ignored (with a JAX warning) on
+        backends without support (CPU); the pipeline gates on backend
+        before asking for it."""
+        import importlib
+
+        from geomesa_tpu.compilecache.registry import registry
+
+        tail, attr = name.rsplit(".", 1)
+        fn = getattr(importlib.import_module(
+            f"geomesa_tpu.engine.{tail}"), attr)
+        vname = registry.serve_variant(
+            name, donate_argnums=donate_argnums, fn=fn,
+            static_argnames=tuple(statics))
+        handle = registry.compile(vname, *args, **statics)
+        return handle.call(*args)
 
     def _knn_impl_from_stats(self, plan: "QueryPlan") -> str:
         """Stats-typed sparse-vs-fullscan decision (VERDICT r4 task 6).
@@ -964,6 +1105,115 @@ def _pad_to_k(dists: np.ndarray, idx: np.ndarray, k: int):
         dists = np.pad(dists, ((0, 0), (0, pad)), constant_values=np.inf)
         idx = np.pad(idx, ((0, 0), (0, pad)))
     return dists, idx
+
+
+class KnnLaunch:
+    """One dispatched-but-unsynced kNN window (planner.knn_launch).
+
+    The launch did: plan → residency/scan → filter mask → kernel
+    dispatch, all ASYNC from the device's point of view — holding this
+    object means device work may still be running. `sync()` performs the
+    single combined device read (results + sparse-overflow flag + any
+    fused count scalar, ONE transfer — the knn_sparse_auto discipline),
+    runs the documented overflow→fullscan fallback, writes the planner's
+    capacity cache back, and returns exactly what `planner.knn` returns.
+    The serial path IS launch+sync back to back, so the pipelined and
+    serial results are bit-identical by construction (regression-tested
+    in tests/test_pipeline.py).
+
+    After a fused-count sync, `mask_count` holds the host int (the
+    count+kNN cross-kind fusion); `fused_ok` says whether the launch
+    accepted the fusion request (it declines under f32 band
+    refinement)."""
+
+    __slots__ = ("planner", "k", "kk", "impl", "batch", "deadline",
+                 "mask_count", "fused_ok", "_ready", "_fd", "_fi", "_ov",
+                 "_cap", "_caps_key", "_jqx", "_jqy", "_x", "_y",
+                 "_mask", "_mb", "_interp", "_count_dev")
+
+    def __init__(self, planner, k, kk, impl, batch, count_dev=None):
+        self.planner = planner
+        self.k = k
+        self.kk = kk
+        self.impl = impl
+        self.batch = batch
+        self.deadline = None
+        self.mask_count = None
+        self.fused_ok = count_dev is not None
+        self._count_dev = count_dev
+        self._ready = None
+        self._fd = self._fi = self._ov = None
+        self._jqx = self._jqy = self._x = self._y = self._mask = None
+        self._cap = self._caps_key = None
+        self._mb = self._interp = None
+
+    @classmethod
+    def ready(cls, planner, result, fused: bool = False) -> "KnnLaunch":
+        """An already-resolved launch (the empty-store early-out): sync
+        returns `result` immediately; a fused count resolves to 0."""
+        launch = cls(planner, k=0, kk=0, impl="none", batch=result[2])
+        launch._ready = result
+        launch.fused_ok = fused
+        launch.mask_count = 0 if fused else None
+        return launch
+
+    def arm_sparse(self, fd, fi, ov, jqx, jqy, x, y, mask, cap,
+                   caps_key, mb, interp) -> None:
+        self._fd, self._fi, self._ov = fd, fi, ov
+        self._jqx, self._jqy, self._x, self._y = jqx, jqy, x, y
+        self._mask = mask
+        self._cap, self._caps_key = cap, caps_key
+        self._mb, self._interp = mb, interp
+
+    def arm_dense(self, fd, fi) -> None:
+        self._fd, self._fi = fd, fi
+
+    def sync(self):
+        """Block until the window's device work is done and return
+        (dists [Q,k] np, idx [Q,k] np, batch). Runs under the request's
+        deadline scope when `knn_launch` installed one, so the overflow
+        fallback's boundary retries stay budget-bounded."""
+        if self.deadline is None:
+            return self._sync()
+        from geomesa_tpu.faults import deadline_scope
+
+        with deadline_scope(self.deadline):
+            return self._sync()
+
+    def _sync(self):
+        if self._ready is not None:
+            return self._ready
+        import jax
+
+        from geomesa_tpu.engine.knn_scan import knn_sparse_finish
+
+        extra = (self._count_dev,) if self._count_dev is not None else ()
+        with TRACER.span("device.sync"):
+            if self._ov is not None:
+                fd, fi, cap, extra_host = knn_sparse_finish(
+                    self._fd, self._fi, self._ov,
+                    self._jqx, self._jqy, self._x, self._y, self._mask,
+                    k=self.kk, tile_capacity=self._cap, m_blocks=self._mb,
+                    interpret=self._interp, extra=extra)
+                with self.planner._mutex:
+                    caps = self.planner._knn_caps
+                    if cap > 0:
+                        caps[self._caps_key] = cap
+                    else:
+                        caps.pop(self._caps_key, None)
+            else:
+                got = jax.device_get((self._fd, self._fi) + extra)
+                fd, fi, extra_host = got[0], got[1], tuple(got[2:])
+            dists, idx = _pad_to_k(np.asarray(fd), np.asarray(fi), self.k)
+        if extra_host:
+            self.mask_count = int(extra_host[0])
+        # drop the device refs promptly: the pipeline may hold the
+        # launch object past completion for bookkeeping, and these
+        # buffers are the window's HBM footprint
+        self._fd = self._fi = self._ov = self._count_dev = None
+        self._jqx = self._jqy = self._x = self._y = self._mask = None
+        self._ready = (dists, idx, self.batch)
+        return self._ready
 
 
 def _loosen_bbox(f: ast.Filter, geom_name: str) -> ast.Filter:

@@ -63,6 +63,74 @@ def compat_key(req: ServeRequest) -> Optional[tuple]:
             q.max_features, q.crs)
 
 
+def fused_count_key(req: ServeRequest) -> Optional[tuple]:
+    """Cross-kind fusion (docs/SERVING.md "Pipelined dispatch"): the
+    compat key of a COUNT request that may ride this kNN request's
+    dispatch window, or None when fusion is unsafe. A count against the
+    same (type, canonical CQL, hints) is one reduction over the filter
+    mask the kNN launch computes anyway — fusing it eliminates the
+    count's entire dispatch RTT.
+
+    Gates (each one is a case where the fused mask count could diverge
+    from `planner.count`):
+    - INCLUDE filters: `count` answers them from the manifest without
+      device work — nothing to fuse, and manifest vs mask semantics
+      may differ mid-write;
+    - sampling / loose_bbox hints: the count path samples or re-checks
+      the mask differently from the kNN mask;
+    - the fused key pins max_features=None: a bounded count clamps.
+    The launch-side contract (KnnLaunch.fused_ok) lets the planner
+    decline too; today it never does — the reduction runs over the
+    f64-exact mask, band corrections included — but callers must treat
+    a decline as "dispatch the count serially"."""
+    if req.kind != "knn":
+        return None
+    q = req.query
+    try:
+        from geomesa_tpu.cql import ast as _ast
+
+        if isinstance(q.filter_ast, _ast.Include):
+            return None
+        cql = ast.to_cql(q.filter_ast)
+    except Exception:
+        return None
+    h = q.hints
+    if h.sampling or h.loose_bbox or h.is_density or h.is_stats \
+            or h.is_bin or h.is_arrow:
+        return None
+    return ("count", q.type_name, cql, str(h), None)
+
+
+def stack_queries(reqs: List[ServeRequest]):
+    """Host prep for one kNN window: stack member query points into one
+    [Q] array pair padded to a pow2 (floor MIN_KNN_BATCH). Shared by the
+    serial path and the pipeline's prepare stage so the two build
+    byte-identical kernel inputs. Returns (qx, qy, offsets) with qx/qy
+    already padded (repeat of the first point: cheap, in-bounds,
+    discarded on split)."""
+    xs = [np.asarray(r.qx, np.float64).ravel() for r in reqs]
+    ys = [np.asarray(r.qy, np.float64).ravel() for r in reqs]
+    offsets = np.cumsum([0] + [len(x) for x in xs])
+    qx = np.concatenate(xs)
+    qy = np.concatenate(ys)
+    total = len(qx)
+    padded = max(MIN_KNN_BATCH, _next_pow2(total))
+    if padded > total:
+        qx = np.concatenate([qx, np.full(padded - total, qx[0])])
+        qy = np.concatenate([qy, np.full(padded - total, qy[0])])
+    return qx, qy, offsets
+
+
+def split_knn_results(reqs: List[ServeRequest], offsets, dists, idx,
+                      batch) -> None:
+    """Resolve one kNN window's member futures from the stacked [Q, k]
+    result rows ("merge": set_result runs protocol callbacks inline)."""
+    with TRACER.span("merge", members=len(reqs)):
+        for i, r in enumerate(reqs):
+            a, b = offsets[i], offsets[i + 1]
+            r.future.set_result((dists[a:b], idx[a:b], batch))
+
+
 def batch_timeout_ms(reqs: List[ServeRequest]) -> Optional[int]:
     """Deadline for a shared dispatch: the LONGEST remaining budget among
     members (a short-deadline rider must not kill work others still
@@ -188,25 +256,10 @@ def _execute_knn(source, reqs: List[ServeRequest],
     the kernels, so per-request results are identical to serial runs of
     the same kernel — asserted in tests/test_serve.py."""
     with TRACER.span("knn.stack", members=len(reqs)):
-        xs = [np.asarray(r.qx, np.float64).ravel() for r in reqs]
-        ys = [np.asarray(r.qy, np.float64).ravel() for r in reqs]
-        offsets = np.cumsum([0] + [len(x) for x in xs])
-        qx = np.concatenate(xs)
-        qy = np.concatenate(ys)
-        total = len(qx)
-        padded = max(MIN_KNN_BATCH, _next_pow2(total))
-        if padded > total:
-            # repeat the first point: cheap, in-bounds, discarded on split
-            qx = np.concatenate([qx, np.full(padded - total, qx[0])])
-            qy = np.concatenate([qy, np.full(padded - total, qy[0])])
+        qx, qy, offsets = stack_queries(reqs)
     lead = reqs[0]
     dists, idx, batch = source.planner.knn(
         lead.query, qx, qy, k=lead.k, impl=lead.impl,
         timeout_ms=timeout_ms,
     )
-    # "merge" = splitting the [Q, k] result rows back per request AND
-    # resolving futures (set_result runs protocol callbacks inline)
-    with TRACER.span("merge", members=len(reqs)):
-        for i, r in enumerate(reqs):
-            a, b = offsets[i], offsets[i + 1]
-            r.future.set_result((dists[a:b], idx[a:b], batch))
+    split_knn_results(reqs, offsets, dists, idx, batch)

@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+from concurrent.futures import wait as futures_wait
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -49,6 +50,14 @@ class LoadReport:
     max_ms: float
     dispatches: int
     coalesced: int
+    # sustained mode (docs/SERVING.md "Pipelined dispatch"): the
+    # headline pts/s (store points scanned x served queries / wall) and
+    # how deep the dispatch pipeline actually ran — the numbers the
+    # 523M→700M sustained claim is reproduced from
+    pts_per_s: float = 0.0
+    windows_in_flight_max: int = 0
+    pipelined_windows: int = 0
+    fused_counts: int = 0
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -198,6 +207,105 @@ def run_open_loop(
              for k in ("dispatches", "coalesced")}
     return _report("open", wall, tally.lat_s, tally.sent,
                    tally.rejected, tally.timeouts, tally.errors, delta)
+
+
+def run_sustained(
+    service: QueryService,
+    make_request: Callable[[int], ServeRequest],
+    duration_s: float = 5.0,
+    max_outstanding: int = 32,
+    points_per_query: int = 0,
+    requests: Optional[int] = None,
+) -> LoadReport:
+    """Sustained-throughput mode (`gmtpu bench-serve --mode sustained`):
+    a fixed-duration closed loop that keeps exactly `max_outstanding`
+    requests in flight — submissions are gated by a semaphore released
+    from future callbacks, not by per-client turnarounds — and reports
+    points/sec plus the pipeline's windows-in-flight, not just latency
+    percentiles. This is the loop that reproduces the BENCH sustained
+    pts/s headline from the CLI: `pts_per_s = points_per_query *
+    served_qps` (each served query scans the whole resident store).
+    `requests` caps total submissions for deterministic test runs."""
+    tally = _Tally()
+    base = service.stats()
+    pipe = getattr(service, "pipeline", None)
+    if pipe is not None:
+        # the in-flight high-water must be THIS run's, not the service
+        # lifetime's (a warmup pass on the same service would otherwise
+        # donate its peak)
+        pipe.reset_max_inflight()
+    gate = threading.Semaphore(max_outstanding)
+    deadline = time.monotonic() + duration_s
+    inflight = []
+    t_start = time.monotonic()
+
+    def on_done(t0):
+        # latency stamps at RESOLUTION time (the callback runs on the
+        # resolving thread), not when the harvest loop gets around to
+        # the future — with K outstanding the two differ by up to the
+        # whole run
+        def cb(fut):
+            dt = time.monotonic() - t0
+            try:
+                fut.result()
+            except QueryTimeout:
+                with tally.lock:
+                    tally.timeouts += 1
+            except QueryRejected:
+                with tally.lock:
+                    tally.rejected += 1
+            except BaseException:  # noqa: BLE001 — tally, never raise
+                with tally.lock:
+                    tally.errors += 1
+            else:
+                with tally.lock:
+                    tally.lat_s.append(dt)
+            gate.release()
+
+        return cb
+
+    i = 0
+    while time.monotonic() < deadline:
+        if requests is not None and i >= requests:
+            break
+        if not gate.acquire(timeout=0.1):
+            continue
+        with tally.lock:
+            tally.sent += 1
+        t0 = time.monotonic()
+        try:
+            fut = service.submit(make_request(i))
+        except QueryRejected:
+            with tally.lock:
+                tally.rejected += 1
+            gate.release()
+            i += 1
+            continue
+        i += 1
+        inflight.append(fut)
+        fut.add_done_callback(on_done(t0))
+    # completion barrier only — outcomes were tallied in the callbacks
+    # (wait() reports, never raises; a straggler past the bound is
+    # abandoned rather than blocking the report)
+    futures_wait(inflight, timeout=120)
+    wall = time.monotonic() - t_start
+    stats = service.stats()
+    delta = {k: stats.get(k, 0) - base.get(k, 0)
+             for k in ("dispatches", "coalesced")}
+    rep = _report("sustained", wall, tally.lat_s, tally.sent,
+                  tally.rejected, tally.timeouts, tally.errors, delta)
+    rep.pts_per_s = rep.throughput_qps * points_per_query
+    p = stats.get("pipeline") or {}
+    pbase = base.get("pipeline") or {}
+    rep.windows_in_flight_max = int(p.get("max_inflight", 0))
+    rep.pipelined_windows = (
+        stats.get("pipelined_windows", 0)
+        - base.get("pipelined_windows", 0))
+    # delta against the pre-run snapshot, like dispatches/coalesced —
+    # lifetime totals would credit a warmup pass to the measured run
+    rep.fused_counts = int(p.get("fused_counts", 0)
+                           - pbase.get("fused_counts", 0))
+    return rep
 
 
 # -- request factories -----------------------------------------------------

@@ -81,6 +81,18 @@ class ServeConfig:
     # flight recorder's crash-dump path for this process
     trace: bool = False
     flight_dump: Optional[str] = None
+    # pipelined dispatch (docs/SERVING.md "Pipelined dispatch"): kNN
+    # windows run prepare/transfer/launch on the dispatch thread and
+    # defer the device sync to a completer thread, keeping up to
+    # `pipeline_depth` windows in flight (transfer overlaps compute —
+    # the ROADMAP item-2 host-gap work). pipeline=False restores the
+    # fully serial dispatch (chaos determinism runs use it).
+    # pipeline_donate: None = auto (donate staged query buffers via the
+    # registry serve tier on backends that support donation; CPU does
+    # not), True/False forces.
+    pipeline: bool = True
+    pipeline_depth: int = 2
+    pipeline_donate: Optional[bool] = None
 
 
 def _quarantine_key(req: ServeRequest):
@@ -117,6 +129,16 @@ class QueryService:
         self._state_lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._worker: Optional[threading.Thread] = None
+        # pipelined dispatch path (serve/pipeline.py): the default for
+        # kNN windows; its completer thread starts lazily on the first
+        # pipelined window
+        self.pipeline = None
+        if self.config.pipeline:
+            from geomesa_tpu.serve.pipeline import DispatchPipeline
+
+            self.pipeline = DispatchPipeline(
+                self, depth=self.config.pipeline_depth,
+                donate=self.config.pipeline_donate)
         # compilation management: compiled executables must survive
         # restarts (the cache is idempotent/never-failing to enable)
         try:
@@ -183,6 +205,10 @@ class QueryService:
         self._stop.set()
         if self._worker is not None:
             self._worker.join(timeout=5.0)
+        if self.pipeline is not None:
+            # windows already launched still sync (no torn responses);
+            # runs after the dispatch thread stopped submitting
+            self.pipeline.close()
         # restore the bare engine jits (owner only); the tracker object
         # (and its counters) stays readable after close
         self._release_tracker()
@@ -455,12 +481,27 @@ class QueryService:
             execute_batch(source, live)
 
     def _dispatch(self, first: ServeRequest) -> None:
+        from geomesa_tpu.serve.batcher import fused_count_key
         from geomesa_tpu.utils.metrics import metrics
 
         g0_ns = time.perf_counter_ns()
         reqs = self._gather(first)
         g1_ns = time.perf_counter_ns()
         live, dead = split_expired(reqs)
+        lead = live[0] if live else None
+        pipelined = (self.pipeline is not None and lead is not None
+                     and lead.kind == "knn")
+        counts: List[ServeRequest] = []
+        if pipelined:
+            # cross-kind fusion: COUNT requests against the same
+            # (type, CQL, hints) resolve from this window's filter-mask
+            # reduction instead of their own dispatch RTT
+            fkey = fused_count_key(lead)
+            if fkey is not None:
+                got = self.queue.drain_compatible(
+                    fkey, compat_key, self.config.max_batch)
+                counts, cdead = split_expired(got)
+                dead = dead + cdead
         fail_expired(dead)
         for r in dead:
             self._bump("timeout")
@@ -472,8 +513,7 @@ class QueryService:
             return
         t0 = time.monotonic()
         now_ns = time.perf_counter_ns()
-        lead = live[0]
-        for r in live:
+        for r in live + counts:
             metrics.histogram("serve.queue.wait").update(t0 - r.enqueued_at)
             if r.trace is not None:
                 # cross-thread phase: opened (implicitly) at enqueue on
@@ -485,15 +525,51 @@ class QueryService:
         adopt_from = (lead.trace.span_count()
                       if lead.trace is not None else 0)
         if lead.trace is not None:
-            lead.trace.record("coalesce", g0_ns, g1_ns, gathered=len(reqs))
+            lead.trace.record("coalesce", g0_ns, g1_ns,
+                              gathered=len(reqs), fused=len(counts))
         if self._recorder is not None:
-            self._record_queries(live)
-        from geomesa_tpu.faults import (
-            BREAKERS, RECOVERY, BreakerOpen, classify)
+            self._record_queries(live, counts)
+        from geomesa_tpu.faults import RECOVERY
 
+        if pipelined:
+            # pipelined route: the source lookup error fans out HERE
+            # (the serial path does it inside _run_window)
+            try:
+                source = self.store.get_feature_source(
+                    lead.query.type_name)
+            except BaseException as e:  # noqa: BLE001 — fan out typed
+                for r in live + counts:
+                    if r.future.set_running_or_notify_cancel():
+                        r.future.set_exception(e)
+                self._finish_window(live, counts, lead, t0,
+                                    time.monotonic(), adopt_from,
+                                    None, 0, 0, [], [], pipelined=True)
+                return
+            # the window stays in flight past this method: it owns one
+            # inflight token until _window_complete releases it, so
+            # close(drain=True) waits for the completer too
+            with self._state_lock:
+                self._inflight += 1
+            try:
+                self.pipeline.submit(source, live, counts, lead, t0,
+                                     g0_ns, adopt_from)
+            except BaseException as e:
+                # submit resolves all futures on its internal failure
+                # paths; an exception HERE means the window never got a
+                # slot (completer dead) — fail whatever is still
+                # pending so no client hangs, then let _loop log it
+                with self._state_lock:
+                    self._inflight -= 1
+                for r in live + counts:
+                    if not r.future.done() and \
+                            r.future.set_running_or_notify_cancel():
+                        r.future.set_exception(e)
+                raise
+            return
         stall_token = STALLS.token()
         rec_token = RECOVERY.token()
         dispatch_span_id = None
+        dispatch_start_ns = 0
         dispatch_end_ns = 0
         if lead.trace is not None:
             with TRACER.scope(lead.trace):
@@ -524,6 +600,34 @@ class QueryService:
         # ahead — is metered globally but not attributed per-request)
         recovery = RECOVERY.since(rec_token,
                                   thread_ident=threading.get_ident())
+        self._finish_window(live, [], lead, t0, t1, adopt_from,
+                            dispatch_span_id, dispatch_start_ns,
+                            dispatch_end_ns, stalls, recovery)
+
+    def _window_complete(self, win, t1: float, end_ns: int) -> None:
+        """Pipeline completion callback (completer thread): shared
+        finish bookkeeping, then release the window's inflight token."""
+        try:
+            self._finish_window(
+                win.live, win.counts, win.lead, win.t0, t1,
+                win.adopt_from, win.wid, win.g0_ns, end_ns,
+                win.stalls, win.recovery, pipelined=True)
+        finally:
+            with self._state_lock:
+                self._inflight -= 1
+
+    def _finish_window(self, live, counts, lead, t0, t1, adopt_from,
+                       dispatch_span_id, dispatch_start_ns,
+                       dispatch_end_ns, stalls, recovery,
+                       pipelined: bool = False) -> None:
+        """Everything that happens after a window's futures are
+        resolved: stall/recovery attribution spans, counters, metrics,
+        quarantine accounting, rider trace adoption, audit events.
+        Shared verbatim by the serial path (dispatch thread) and the
+        pipeline (completer thread)."""
+        from geomesa_tpu.faults import BREAKERS, BreakerOpen, classify
+        from geomesa_tpu.utils.metrics import metrics
+
         retries = sum(1 for kind, _ in recovery if kind == "retry")
         faults_seen = sum(1 for kind, _ in recovery if kind == "fault")
         breaker_state = ",".join(
@@ -555,14 +659,18 @@ class QueryService:
             self._bump("compile_stalled_dispatches")
             metrics.counter("serve.compile.stalled")
         self._bump("dispatches")
-        self._bump("coalesced", len(live) - 1)
+        members = len(live) + len(counts)
+        self._bump("coalesced", members - 1)
         metrics.counter("serve.dispatch")
-        if len(live) > 1:
-            metrics.counter("serve.coalesced", len(live) - 1)
+        if pipelined:
+            self._bump("pipelined_windows")
+            metrics.counter("serve.pipeline.windows")
+        if members > 1:
+            metrics.counter("serve.coalesced", members - 1)
         metrics.gauge("serve.queue.depth", float(len(self.queue)))
         struck: set = set()
         adopted: Optional[list] = None
-        for r in live:
+        for r in live + counts:
             if r.future.cancelled():
                 # cancelled between queue pop and execute: .exception()
                 # would raise CancelledError and kill the dispatcher
@@ -632,7 +740,7 @@ class QueryService:
                     r.trace.adopt(
                         adopted, clamp_start_ns=r.trace.root.start_ns)
                 RECORDER.record(r.trace.finish(
-                    status=status, batch=len(live), degraded=r.degraded))
+                    status=status, batch=members, degraded=r.degraded))
             if self.audit is not None:
                 self.audit.write(ServeEvent(
                     trace_id=(r.trace.trace_id
@@ -643,7 +751,8 @@ class QueryService:
                     priority=PRIORITIES[r.priority],
                     queue_ms=(t0 - r.enqueued_at) * 1000.0,
                     exec_ms=(t1 - t0) * 1000.0,
-                    batch_size=len(live),
+                    batch_size=members,
+                    pipelined=pipelined,
                     status=status,
                     degraded=r.degraded,
                     compile_ms=compile_ms,
@@ -653,11 +762,16 @@ class QueryService:
                     breaker_state=breaker_state,
                 ))
 
-    def _record_queries(self, live: List[ServeRequest]) -> None:
+    def _record_queries(self, live: List[ServeRequest],
+                        counts: List[ServeRequest] = ()) -> None:
         """Record this dispatch's query shape into the warmup recorder.
         Members share a compat key, so one entry per dispatch; the kNN
         bucket is the PADDED stacked-query axis the batcher will build,
-        which is what the kernel actually compiles for."""
+        which is what the kernel actually compiles for. Fused count
+        riders record their own count entry — the warmup replay runs
+        counts through the real planner, and a count that happened to
+        fuse onto a kNN window live must still pre-compile its serial
+        program (the fusion is opportunistic, not guaranteed)."""
         lead = live[0]
         try:
             from geomesa_tpu.cql import ast
@@ -684,6 +798,12 @@ class QueryService:
         else:
             self._recorder.record_query(
                 lead.kind, lead.query.type_name, cql)
+        if counts:
+            # fused riders share the lead's (type, CQL, hints) by
+            # construction, and the fusion key pins default-compatible
+            # hints — record the count shape they would run serially
+            self._recorder.record_query(
+                "count", lead.query.type_name, cql)
 
     # -- introspection -----------------------------------------------------
 
@@ -699,6 +819,8 @@ class QueryService:
         out["queue_depth"] = len(self.queue)
         out["degrade_level"] = self.degrade_level()
         out["quarantine"] = self.quarantine.stats()
+        if self.pipeline is not None:
+            out["pipeline"] = self.pipeline.stats()
         if self.tracker is not None:
             out["recompiles"] = self.tracker.total_recompiles()
         return out
@@ -717,6 +839,11 @@ class QueryService:
         with self._state_lock:
             inflight = self._inflight
         metrics.gauge("serve.inflight", float(inflight))
+        if self.pipeline is not None:
+            p = self.pipeline.stats()
+            metrics.gauge("serve.pipeline.inflight", float(p["inflight"]))
+            metrics.gauge("serve.pipeline.max_inflight",
+                          float(p["max_inflight"]))
         q = self.quarantine.stats()
         metrics.gauge("fault.quarantine.active", float(q["quarantined"]))
         metrics.gauge("fault.quarantine.striking", float(q["striking"]))

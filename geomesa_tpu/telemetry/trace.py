@@ -48,7 +48,8 @@ import threading
 from time import perf_counter_ns
 from typing import List, Optional
 
-__all__ = ["Span", "Trace", "Tracer", "TRACER", "NOOP_SPAN"]
+__all__ = ["Span", "Trace", "Tracer", "TRACER", "NOOP_SPAN",
+           "new_span_id"]
 
 _span_ids = itertools.count(1)
 _trace_ids = itertools.count(1)
@@ -61,6 +62,16 @@ def _new_trace_id() -> str:
     # pid-qualified so dumps merged across processes (replica fleets,
     # chaos runs) never collide
     return f"{os.getpid():x}-{next(_trace_ids):x}"
+
+
+def new_span_id() -> int:
+    """Pre-allocate a span id from the process-wide counter. The
+    pipelined dispatch path records its window span only at completion
+    (the window's extent is not known until the deferred device sync),
+    but its stage spans need the window as parent while it is still
+    open — so the id is allocated up front and passed to `Trace.record`
+    / `Tracer.scope(parent_id=...)` until the closing record."""
+    return next(_span_ids)
 
 
 class Span:
@@ -131,10 +142,13 @@ class Trace:
                          threading.get_ident(), dict(attrs) or None)
 
     def record(self, name: str, start_ns: int, end_ns: int,
-               parent_id: Optional[int] = None, **attrs) -> Span:
+               parent_id: Optional[int] = None,
+               span_id: Optional[int] = None, **attrs) -> Span:
         """Record an already-measured phase (queue wait, respond): the
-        caller holds both timestamps; parent defaults to the root."""
-        t = (name, next(_span_ids),
+        caller holds both timestamps; parent defaults to the root.
+        `span_id` lets a caller close a span whose id was pre-allocated
+        via `new_span_id()` (the pipelined dispatch window)."""
+        t = (name, span_id if span_id is not None else next(_span_ids),
              parent_id if parent_id is not None else self.root.span_id,
              start_ns, end_ns, threading.get_ident(), attrs or None)
         # gt: waive GT07
@@ -280,11 +294,13 @@ class _SpanHandle:
 
 
 class _Scope:
-    __slots__ = ("_tracer", "_trace", "_prev")
+    __slots__ = ("_tracer", "_trace", "_prev", "_parent_id")
 
-    def __init__(self, tracer: "Tracer", trace: Optional[Trace]):
+    def __init__(self, tracer: "Tracer", trace: Optional[Trace],
+                 parent_id: Optional[int] = None):
         self._tracer = tracer
         self._trace = trace
+        self._parent_id = parent_id
 
     def __enter__(self) -> Optional[Trace]:
         tls = self._tracer._tls
@@ -293,12 +309,17 @@ class _Scope:
         if trace is None:
             tls.ctx = None  # explicit silence (warmup replay)
         else:
-            # the per-scope span context: (spans list, root span id,
+            # the per-scope span context: (spans list, base parent id,
             # open-frame stack, thread ident, trace, shared handle) —
             # ONE tls read per span instead of separate lookups. The
             # handle closes over the ctx, so build it in two steps.
+            # The base parent defaults to the root; the pipelined
+            # dispatch passes its pre-allocated window span id so stage
+            # spans nest under the (not-yet-recorded) window.
             handle = _SpanHandle.__new__(_SpanHandle)
-            ctx = (trace.spans, trace.root.span_id, [],
+            base = (self._parent_id if self._parent_id is not None
+                    else trace.root.span_id)
+            ctx = (trace.spans, base, [],
                    threading.get_ident(), trace, handle)
             handle._ctx = ctx
             tls.ctx = ctx
@@ -334,12 +355,15 @@ class Tracer:
             return None
         return Trace(name, **attrs)
 
-    def scope(self, trace: Optional[Trace]) -> _Scope:
+    def scope(self, trace: Optional[Trace],
+              parent_id: Optional[int] = None) -> _Scope:
         """Bind `trace` as this thread's active trace for the duration
         (`with TRACER.scope(trace): ...`). Spans opened by ANY code on
         this thread inside the scope land in it; scoping None explicitly
-        silences spans (used by warmup replay)."""
-        return _Scope(self, trace)
+        silences spans (used by warmup replay). `parent_id` re-bases the
+        scope: top-level spans parent to that span instead of the root
+        (the pipelined dispatch window's pre-allocated id)."""
+        return _Scope(self, trace, parent_id)
 
     def current_trace(self) -> Optional[Trace]:
         if not self.enabled:

@@ -667,6 +667,87 @@ class TestGT15TelemetryDiscipline:
             assert all(f.line != 6 for f in flagged)
 
 
+# -- GT16 -------------------------------------------------------------------
+
+
+class TestGT16PipelineStageBlocking:
+    """Blocking calls inside serve/pipeline.py prepare/transfer/launch
+    stages (docs/SERVING.md "Pipelined dispatch"): a sync there
+    silently re-serializes the window overlap."""
+
+    def _findings(self, src,
+                  relpath="geomesa_tpu/serve/pipeline.py"):
+        from geomesa_tpu.analysis.modinfo import ModInfo
+        from geomesa_tpu.analysis.rules import gt16
+
+        mod = ModInfo("/x.py", textwrap.dedent(src), relpath=relpath)
+        return list(gt16(mod, None))
+
+    DIRTY = """
+        import jax
+
+        def _launch(self, win):
+            fd = kernel(win.qx)
+            fd.block_until_ready()
+
+        def _transfer(self, win):
+            return jax.device_get(win.staged)
+
+        def submit(self, source, live):
+            return live[0].future.result()
+    """
+
+    def test_blocking_in_stages_flagged(self):
+        found = self._findings(self.DIRTY)
+        assert sorted((f.rule, f.line) for f in found) == [
+            ("GT16", 6), ("GT16", 9), ("GT16", 12)]
+
+    def test_clean_counterparts(self):
+        clean = """
+            def _launch(self, win):
+                win.launch = planner.knn_launch(win.qx)   # async
+
+            def _prepare(self, win):
+                win.running = [r for r in win.live
+                               if r.future.set_running_or_notify_cancel()]
+
+            def _sync(self, win):
+                # the completer's job: blocking is CORRECT here
+                win.launch.sync()
+                win.fd.block_until_ready()
+
+            def _complete_loop(self):
+                fut.result()
+        """
+        assert self._findings(clean) == []
+
+    def test_scope_is_path_limited(self):
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/serve/batcher.py") == []
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/plan/planner.py") == []
+
+    def test_waiver_and_registration(self):
+        from geomesa_tpu.analysis.model import RULES
+        from geomesa_tpu.analysis.rules import ALL_RULES
+
+        assert "GT16" in RULES and "GT16" in ALL_RULES
+        import pathlib
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            sub = pathlib.Path(td) / "geomesa_tpu" / "serve"
+            sub.mkdir(parents=True)
+            (sub / "pipeline.py").write_text(textwrap.dedent("""
+                def _launch(self, win):
+                    # gt: waive GT16
+                    win.fd.block_until_ready()
+            """))
+            fs = lint_paths([td], rules=["GT16"], extra_ref_paths=[])
+            assert any(f.rule == "GT16" and f.waived for f in fs)
+            assert not active([f for f in fs if f.rule == "GT16"])
+
+
 # -- self-lint --------------------------------------------------------------
 
 

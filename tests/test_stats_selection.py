@@ -35,7 +35,10 @@ def _store(tmp_path, n=20_000, seed=3):
 class TestKnnAutoSelectivity:
     def _spy(self, monkeypatch):
         calls = []
-        real_sparse = knn_scan_mod.knn_sparse_auto
+        # the sparse choke point is knn_sparse_launch: the planner's
+        # async launch/sync seam calls it directly, and knn_sparse_auto
+        # (the process stack's entry) composes it — one spy sees both
+        real_sparse = knn_scan_mod.knn_sparse_launch
         real_full = knn_scan_mod.knn_fullscan_tiled
 
         def sparse(*a, **kw):
@@ -46,7 +49,7 @@ class TestKnnAutoSelectivity:
             calls.append("fullscan")
             return real_full(*a, **kw)
 
-        monkeypatch.setattr(knn_scan_mod, "knn_sparse_auto", sparse)
+        monkeypatch.setattr(knn_scan_mod, "knn_sparse_launch", sparse)
         monkeypatch.setattr(knn_scan_mod, "knn_fullscan_tiled", full)
         return calls
 
