@@ -383,6 +383,12 @@ class ConcurrencyIndex:
         self._lock_graph()
         self.cyclic_edges: Set[Tuple[str, str]] = self._cycles()
         self._confined: Dict[str, bool] = {}
+        # name -> [(mod, Call)] for every Name-called constructor site,
+        # built lazily on the first class_confined query: the per-name
+        # full-project walk this replaces dominated whole-repo lint
+        # wall time (one walk per queried class vs one walk total)
+        self._ctor_sites: Optional[
+            Dict[str, List[Tuple[ModInfo, ast.Call]]]] = None
 
     # -- thread-entry reachability ----------------------------------------
 
@@ -438,13 +444,15 @@ class ConcurrencyIndex:
         classes — and cannot be shared across threads."""
         if name in self._confined:
             return self._confined[name]
-        sites = []
-        for mod in self.modules:
-            for node in ast.walk(mod.tree):
-                if (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Name)
-                        and node.func.id == name):
-                    sites.append((mod, node))
+        if self._ctor_sites is None:
+            self._ctor_sites = {}
+            for mod in self.modules:
+                for node in ast.walk(mod.tree):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Name)):
+                        self._ctor_sites.setdefault(
+                            node.func.id, []).append((mod, node))
+        sites = self._ctor_sites.get(name, [])
         ok = bool(sites)
         for mod, call in sites:
             if not self._ctor_confined(mod, call):

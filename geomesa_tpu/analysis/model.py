@@ -68,6 +68,12 @@ RULES: Dict[str, Rule] = {
                      "return before the device finishes or window "
                      "overlap silently dies (sync belongs on the "
                      "completer)"),
+        Rule("GT17", "blocking call (I/O, future .result(), device "
+                     "sync/transfer, sleep) inside a subscription "
+                     "listener/callback body: feature-event listeners "
+                     "run inside the Kafka fold (store lock held) — "
+                     "they must only buffer; evaluation belongs in the "
+                     "post-fold pump (subscribe/evaluator.py)"),
     )
 }
 

@@ -878,6 +878,99 @@ def gt16(mod: ModInfo, project) -> Iterator[Finding]:
                 f"sync stage, or waive a documented deliberate sync")
 
 
+# GT17 scope: subscription listener/callback bodies under subscribe/
+# and kafka/. KafkaFeatureCache listeners are invoked during the
+# store's poll fold — with the store RLock held — and the subscribe
+# evaluator's delta listener runs on EVERY folded message. A blocking
+# call there (file I/O, a future .result(), a device sync/transfer, a
+# sleep) stalls the fold for every topic consumer behind the lock and
+# re-introduces exactly the emit-under-lock hazard the _emit snapshot
+# discipline removed. Listeners BUFFER; the post-fold pump (outside
+# the lock) evaluates. Two detection axes: functions whose names mark
+# them as listener/callback bodies (contains "listener"/"callback",
+# or an `on_*` prefix), and local functions passed by name to
+# add_listener(...)/add_fold_hook(...).
+_GT17_PREFIXES = ("geomesa_tpu/subscribe/", "geomesa_tpu/kafka/")
+_GT17_NAME_MARKERS = ("listener", "callback")
+_GT17_NAME_PREFIXES = ("on_",)
+_GT17_REGISTER_CALLS = {"add_listener", "add_fold_hook"}
+_GT17_BLOCKING = {
+    "open": "file I/O",
+    "result": "future wait",
+    "block_until_ready": "device sync",
+    "device_get": "host read",
+    "device_put": "device transfer",
+    "to_device": "device transfer",
+    "sleep": "sleep",
+    "poll": "broker poll (re-entrant fold)",
+}
+
+
+def _gt17_listener_functions(mod: ModInfo):
+    """Functions that are listener/callback bodies: marker-named defs
+    plus defs whose NAME is passed to a listener-registration call."""
+    registered: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _GT17_REGISTER_CALLS):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    registered.add(arg.id)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        name = node.name.lstrip("_")
+        if (name in registered or node.name in registered
+                or any(m in name for m in _GT17_NAME_MARKERS)
+                or any(name.startswith(p) for p in _GT17_NAME_PREFIXES)):
+            yield node
+
+
+def gt17(mod: ModInfo, project) -> Iterator[Finding]:
+    """GT17: blocking calls inside subscription listener/callback
+    bodies (subscribe//kafka/ scope).
+
+    Flags `open(...)`, `.result()` (future wait), `.block_until_ready()`,
+    `jax.device_get`/`device_put`, `to_device(...)`, `.sleep(...)` and
+    `.poll(...)` (a listener re-entering the fold) lexically inside
+    listener-shaped functions: names containing listener/callback, an
+    `on_*` prefix, or local defs registered via `add_listener`/
+    `add_fold_hook`. The listener contract is buffer-only — evaluation
+    and device work belong in the post-fold pump, which the store runs
+    OUTSIDE its lock. Waivable inline (`# gt: waive GT17`) for a
+    documented deliberate block."""
+    path = mod.relpath.replace("\\", "/")
+    if not any(p in path for p in _GT17_PREFIXES):
+        return
+    seen: Set[int] = set()
+    for fn in _gt17_listener_functions(mod):
+        # the registration-site walk sees nested defs too, so a
+        # listener factory's inner closure is covered either way
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                ident = f.attr
+            elif isinstance(f, ast.Name):
+                ident = f.id
+            else:
+                continue
+            what = _GT17_BLOCKING.get(ident)
+            if what is None or node.lineno in seen:
+                continue
+            seen.add(node.lineno)
+            yield _finding(
+                "GT17", mod, node,
+                f"blocking call ({ident}: {what}) inside subscription "
+                f"listener/callback {fn.name!r}: listeners run inside "
+                f"the Kafka fold (store lock held) and must only "
+                f"buffer — move the work to the post-fold pump "
+                f"(subscribe/evaluator.py), or waive a documented "
+                f"deliberate block")
+
+
 from geomesa_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES)
 
@@ -885,5 +978,6 @@ ALL_RULES = {
     "GT01": gt01, "GT02": gt02, "GT03": gt03,
     "GT04": gt04, "GT05": gt05, "GT06": gt06,
     "GT13": gt13, "GT14": gt14, "GT15": gt15, "GT16": gt16,
+    "GT17": gt17,
     **CONCURRENCY_RULES,
 }

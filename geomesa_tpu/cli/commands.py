@@ -186,6 +186,15 @@ def register(sub: "argparse._SubParsersAction") -> None:
     serve_p.add_argument("--track-compiles", action="store_true",
                          help="count engine recompiles and attribute "
                               "inline compile stalls in ServeEvents")
+    serve_p.add_argument("--live-poll-ms", type=float, default=None,
+                         metavar="MS",
+                         help="standing queries: auto-poll the live "
+                              "store every MS milliseconds while "
+                              "subscriptions are active (push frames "
+                              "arrive without explicit poll verbs; "
+                              "docs/SERVING.md \"Standing queries\")")
+    serve_p.add_argument("--max-subscriptions", type=int, default=256,
+                         help="standing-query table bound")
     serve_p.set_defaults(func=_serve)
 
     warm_p = sub.add_parser(
@@ -218,7 +227,16 @@ def register(sub: "argparse._SubParsersAction") -> None:
                           choices=["knn", "count"], help="workload kind")
     bserve_p.add_argument("--k", type=int, default=8, help="kNN k")
     bserve_p.add_argument("--mode", default="closed",
-                          choices=["closed", "open", "sustained"])
+                          choices=["closed", "open", "sustained",
+                                   "subscribe"])
+    bserve_p.add_argument("--subs", type=int, default=8,
+                          help="subscribe mode: standing subscriptions "
+                               "(bbox/dwithin geofences + density "
+                               "windows, cycling)")
+    bserve_p.add_argument("--batches", type=int, default=20,
+                          help="subscribe mode: kafka batches folded")
+    bserve_p.add_argument("--rows", type=int, default=64,
+                          help="subscribe mode: rows per kafka batch")
     bserve_p.add_argument("--clients", type=int, default=16,
                           help="closed-loop client count")
     bserve_p.add_argument("--rate", type=float, default=200.0,
@@ -363,6 +381,8 @@ def _serve(args) -> int:
         track_compiles=getattr(args, "track_compiles", False),
         trace=getattr(args, "trace", False),
         flight_dump=getattr(args, "flight_dump", None),
+        subscribe_poll_ms=getattr(args, "live_poll_ms", None),
+        subscribe_max=getattr(args, "max_subscriptions", 256),
     )
     def write_line(s: str) -> None:
         # flush per response: with stdout piped (the normal programmatic
@@ -441,6 +461,11 @@ def _bench_serve(args) -> int:
         args.n = min(args.n, 2000)
         args.duration = min(args.duration, 2.0)
         args.clients = min(args.clients, 8)
+        args.subs = min(args.subs, 4)
+        args.batches = min(args.batches, 6)
+        args.rows = min(args.rows, 32)
+    if args.mode == "subscribe":
+        return _bench_subscribe(args)
     with contextlib.ExitStack() as stack:
         if args.catalog:
             if not args.feature_name:
@@ -560,6 +585,46 @@ def _bench_serve(args) -> int:
                 "run": "gap", "perfetto": tracing,
                 "traces_recorded": rec["trace_count"],
                 **gap_report(traces)}))
+    return 0
+
+
+def _bench_subscribe(args) -> int:
+    """`gmtpu bench-serve --mode subscribe`: N standing subscriptions
+    folded over M synthetic kafka batches; reports events/s and the
+    per-batch eval+push latency distribution (p50/p95/p99)."""
+    import numpy as np
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.kafka.store import KafkaDataStore
+    from geomesa_tpu.serve.loadgen import run_subscribe
+
+    sft = SimpleFeatureType.from_spec(
+        "bench_live", "name:String,score:Double,dtg:Date,*geom:Point")
+    store = KafkaDataStore()
+    store.create_schema(sft)
+    n = args.rows
+
+    def make_batch(i: int) -> FeatureBatch:
+        # moving fleet: the same fid population drifts each batch, so
+        # geofence enter/exit churn is steady instead of append-only
+        rng = np.random.default_rng(997 * i + 13)
+        return FeatureBatch.from_pydict(sft, {
+            "name": rng.choice(["a", "b", "c"], n).tolist(),
+            "score": rng.uniform(-10, 10, n),
+            "dtg": rng.integers(
+                1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack([rng.uniform(-60, 60, n),
+                              rng.uniform(-30, 30, n)], 1),
+        }, fids=[f"v{j}" for j in range(n)])
+
+    # seed the live layer; run_subscribe does its own warm fold (the
+    # fused-kernel AOT key is per evaluator+version, so only THIS
+    # manager's warm fold keeps the compile out of the measured window)
+    store.write("bench_live", make_batch(10_001))
+    rep = run_subscribe(store, "bench_live", make_batch,
+                        subscriptions=args.subs, batches=args.batches)
+    print(json.dumps({"run": "subscribe", **rep.to_json()}))
     return 0
 
 

@@ -138,6 +138,17 @@ class ExecutableRegistry:
             n += 1
         return n
 
+    def unregister(self, name: str) -> None:
+        """Drop a registered kernel and every executable compiled under
+        it. For dynamically minted kernels (the fused standing-query
+        evaluators re-register per membership version): the stale
+        version's executables must not outlive it, or subscription
+        churn grows the registry for the process lifetime."""
+        with self._lock:
+            self._kernels.pop(name, None)
+            for key in [k for k in self._compiled if k[0] == name]:
+                del self._compiled[key]
+
     def names(self):
         with self._lock:
             return sorted(self._kernels)
@@ -229,6 +240,12 @@ class ExecutableRegistry:
         metrics.histogram("compile.aot").update(dt)
         with self._lock:
             self.misses += 1
+            if name not in self._kernels:
+                # unregister() raced the lock-free build: caching the
+                # handle under the dead name would orphan it for the
+                # process lifetime (nothing unregisters a nonce-unique
+                # name twice). Serve this call, cache nothing.
+                return handle
             return self._compiled.setdefault(key, handle)
 
     def compile_entry(self, entry: KernelEntry) -> CompiledHandle:

@@ -226,6 +226,13 @@ def _run_workload(plan: FaultPlan, root: str, requests: int,
     # deterministic regardless of the legacy phase's call counts); its
     # fires append to the returned log so the replay diff covers it.
     log += _pipeline_burst(plan, root, report, say)
+    # standing-query phase: an injected kafka.poll outage must surface
+    # TYPED from the poll, and the subscription event streams must show
+    # zero missed / zero double-applied events across the outage — the
+    # failed window's messages arrive exactly once when the broker
+    # heals (offset-pinned fold + retained delta buffer). Own harness
+    # scope; fires append to the replay-diffed log.
+    log += _subscribe_phase(plan, report, say)
     say(f"workload: {report.ok}/{report.requests} ok, "
         f"typed={sum(report.typed_errors.values())}, "
         f"untyped={len(report.untyped_errors)}, "
@@ -314,6 +321,162 @@ def _pipeline_burst(plan: FaultPlan, root: str, report: ChaosReport,
             svc.close(drain=False)
         except Exception:
             pass
+
+
+# standing-query phase shape: 2 subscriptions (a bbox geofence + a tiny
+# density window) over a 6-feature moving fleet. The kafka retry policy
+# makes 4 attempts, so every=1 + max_fires=4 exhausts the FIRST poll's
+# retries (typed error, no fold) and leaves the second poll clean — it
+# folds the outage window's messages exactly once.
+_SUB_ROWS = 6
+_SUB_FAULT_FIRES = 4
+
+
+def _subscribe_phase(plan: FaultPlan, report: ChaosReport,
+                     say) -> List[tuple]:
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.faults.plan import FaultRule
+    from geomesa_tpu.kafka.store import KafkaDataStore
+    from geomesa_tpu.subscribe import DensityWindow, SubscriptionManager
+
+    sft = SimpleFeatureType.from_spec("chaos_sub", "name:String,*geom:Point")
+    store = KafkaDataStore()
+    store.create_schema(sft)
+    mgr = SubscriptionManager(store)
+    bbox = (-20.0, -20.0, 20.0, 20.0)
+
+    def make_batch(i: int) -> FeatureBatch:
+        rng = np.random.default_rng(plan.seed + 53 + i)
+        return FeatureBatch.from_pydict(sft, {
+            "name": rng.choice(["a", "b"], _SUB_ROWS).tolist(),
+            "geom": np.stack([rng.uniform(-50, 50, _SUB_ROWS),
+                              rng.uniform(-30, 30, _SUB_ROWS)], 1),
+        }, fids=[f"v{j}" for j in range(_SUB_ROWS)])
+
+    rows: Dict[str, tuple] = {}  # fid -> (x, y): the host oracle
+
+    def note_rows(batch):
+        xs = batch.columns["geom"].x
+        ys = batch.columns["geom"].y
+        for j, fid in enumerate(batch.fids.decode()):
+            rows[str(fid)] = (float(xs[j]), float(ys[j]))
+
+    def oracle_matched():
+        return {fid for fid, (x, y) in rows.items()
+                if bbox[0] <= x <= bbox[2] and bbox[1] <= y <= bbox[3]}
+
+    frames: List[dict] = []
+    geo = mgr.subscribe("chaos_sub", f"BBOX(geom, {bbox[0]}, {bbox[1]}, "
+                                     f"{bbox[2]}, {bbox[3]})",
+                        initial_state=False)
+    mgr.subscribe("chaos_sub",
+                  density=DensityWindow((-60.0, -30.0, 60.0, 30.0), 8, 4),
+                  initial_state=False)
+
+    def replayed_matched() -> set:
+        """Fold the pushed enter/exit stream in seq order — the event
+        log must reconstruct the matched set exactly (zero missed /
+        duplicate / phantom transitions)."""
+        state: set = set()
+        for f in sorted((f for f in frames
+                         if f.get("subscription") == geo.sub_id
+                         and f["event"] in ("enter", "exit")),
+                        key=lambda f: f["seq"]):
+            fids = set(f["fids"])
+            if f["event"] == "enter":
+                if fids & state:
+                    report.invariant_failures.append(
+                        f"subscribe phase: duplicate enter {fids & state}")
+                state |= fids
+            else:
+                if fids - state:
+                    report.invariant_failures.append(
+                        f"subscribe phase: phantom exit {fids - state}")
+                state -= fids
+        return state
+
+    # warm fold OUTSIDE the harness (fused-kernel compile must not
+    # consume injected calls — replay determinism, as in the burst)
+    b0 = make_batch(0)
+    store.write("chaos_sub", b0)
+    note_rows(b0)
+    store.poll("chaos_sub")
+    mgr.flush(frames.append)
+    if replayed_matched() != oracle_matched():
+        report.invariant_failures.append(
+            "subscribe phase: warm fold diverged from the host oracle")
+    sub_plan = FaultPlan(
+        seed=plan.seed + 59,
+        rules=[FaultRule(site="kafka.poll", error="unavailable",
+                         every=1, max_fires=_SUB_FAULT_FIRES)])
+    base_ev = mgr.evaluator.stats()
+    # pin the kafka breaker to the chaos tuning for the injected
+    # outage (same as the main workload — which RESTORED the
+    # process's prior config before this phase runs): an ambient
+    # threshold <= the 4 injected failures would open mid-retry,
+    # yielding BreakerOpen instead of the expected typed poll error
+    # and a fire-count short-fall
+    prior_kafka = BREAKERS.current_config("kafka")
+    BREAKERS.configure("kafka", **_CHAOS_BREAKER)
+    try:
+        with _harness.active(sub_plan) as h:
+            b1 = make_batch(1)
+            store.write("chaos_sub", b1)
+            report.requests += 1
+            try:
+                store.poll("chaos_sub")  # all 4 retry attempts injected
+                report.invariant_failures.append(
+                    "subscribe phase: injected kafka.poll outage did not "
+                    "surface from the poll")
+            except Exception as e:  # noqa: BLE001 — the taxonomy decides
+                # typed errors are recorded but NOT counted ok — same
+                # accounting as outcome() and the pipeline burst
+                if _errors.is_typed(e):
+                    key = type(e).__name__
+                    report.typed_errors[key] = (
+                        report.typed_errors.get(key, 0) + 1)
+                else:
+                    report.untyped_errors.append(
+                        f"subscribe poll: {type(e).__name__}: {e}")
+            mgr.flush(frames.append)
+            if replayed_matched() != oracle_matched():
+                # the failed poll must not have half-applied the window
+                report.invariant_failures.append(
+                    "subscribe phase: failed poll leaked events")
+            note_rows(b1)
+            b2 = make_batch(2)
+            store.write("chaos_sub", b2)
+            note_rows(b2)
+            store.poll("chaos_sub")  # heals: folds BOTH windows, once
+            mgr.flush(frames.append)
+            blog = h.fire_log()
+    finally:
+        BREAKERS.restore_config("kafka", prior_kafka)
+        # the injected outage must not outlive the phase
+        BREAKERS.reset("kafka")
+    ev = mgr.evaluator.stats()
+    if replayed_matched() != oracle_matched():
+        report.invariant_failures.append(
+            "subscribe phase: post-outage matched set diverged "
+            "(missed or double-applied events)")
+    # one coalesced device dispatch per committed fold: the warm fold
+    # plus the healing fold (the faulted poll never folded)
+    folds = ev["folds"] - base_ev["folds"]
+    dispatches = ev["dispatches"] - base_ev["dispatches"]
+    if folds != 1 or dispatches != 1:
+        report.invariant_failures.append(
+            f"subscribe phase: expected 1 in-harness fold/dispatch "
+            f"(the healed poll), saw folds={folds} "
+            f"dispatches={dispatches}")
+    if len(blog) != _SUB_FAULT_FIRES:
+        report.invariant_failures.append(
+            f"subscribe phase: expected {_SUB_FAULT_FIRES} kafka.poll "
+            f"fires, saw {len(blog)}")
+    mgr.close()
+    say(f"subscribe phase: {len(frames)} frames, matched oracle ok, "
+        f"fires={len(blog)}")
+    return blog
 
 
 def _drive(plan, root, requests, report, svc, store, sft, kstore, ksrc,
@@ -479,6 +642,7 @@ def run_cli(args) -> int:
         import geomesa_tpu.index.kvstore  # noqa: F401
         import geomesa_tpu.kafka.store  # noqa: F401
         import geomesa_tpu.store.fs  # noqa: F401
+        import geomesa_tpu.subscribe.evaluator  # noqa: F401
 
         for name, doc in sorted(_harness.SITES.items()):
             print(f"{name:<32} {doc}")

@@ -136,16 +136,37 @@ class KafkaFeatureCache:
 
     def expire(self, now: Optional[float] = None) -> int:
         """Drop features older than expiry_ms; returns the evicted count.
-        Called by the store's maintenance tick (upstream: Caffeine expiry)."""
+        Called by the store's maintenance tick (upstream: Caffeine expiry).
+
+        Expiry-driven removals emit `removed` FeatureEvents exactly like
+        explicit deletes — a geofence subscription must see the EXIT
+        when a feature ages out, not just when a Delete message arrives
+        (geomesa_tpu.subscribe). Selection and removal happen under ONE
+        lock acquisition (the old collect-then-re-lock shape let a
+        racing upsert refresh a fid between the scan and its delete,
+        dropping a fresh row); events emit OUTSIDE the lock against a
+        listener snapshot — the `_emit` discipline (GT11)."""
         if self.expiry_ms is None:
             return 0
         now = now if now is not None else time.time()
         cutoff = now - self.expiry_ms / 1000.0
+        events = []
         with self._lock:
-            stale = [fid for fid, ts in self._stamps.items() if ts < cutoff]
-        for fid in stale:
-            self._delete(fid)
-        return len(stale)
+            stale = [fid for fid, ts in self._stamps.items()
+                     if ts < cutoff]
+            for fid in stale:
+                self._unindex_attrs(fid)
+                self._rows.pop(fid, None)
+                self._stamps.pop(fid, None)
+                self._index.remove(fid)
+                events.append(FeatureEvent("removed", fid))
+            if stale:
+                self._snapshot_dirty = True
+            listeners = list(self._listeners)
+        for event in events:
+            for fn in listeners:
+                fn(event)
+        return len(events)
 
     # -- reads -------------------------------------------------------------
 

@@ -274,6 +274,23 @@ class KafkaDataStore:
         # threads AND the serve dispatch thread; a feature listener
         # calling back into the store must not self-deadlock
         self._lock = threading.RLock()
+        # post-fold hooks (geomesa_tpu.subscribe): invoked with the
+        # type name after a poll commits its window, OUTSIDE the store
+        # lock — the standing-query evaluator dispatches device kernels
+        # from here, which must never run under this lock (GT09)
+        self._fold_hooks: List = []
+
+    def add_fold_hook(self, fn) -> None:
+        """Register `fn(type_name)` to run after every committed poll
+        fold (and after expiry sweeps), outside the store lock."""
+        with self._lock:
+            self._fold_hooks.append(fn)
+
+    def remove_fold_hook(self, fn) -> None:
+        """Detach a fold hook (a closed SubscriptionManager must stop
+        costing every future poll). Raises ValueError if absent."""
+        with self._lock:
+            self._fold_hooks.remove(fn)
 
     # -- schema ------------------------------------------------------------
 
@@ -402,7 +419,14 @@ class KafkaDataStore:
             st["offset"] += len(msgs)
             if self.expiry_ms is not None:
                 cache.expire()
-            return len(msgs)
+            hooks = list(self._fold_hooks)
+        # post-fold hooks OUTSIDE the lock: the standing-query
+        # evaluator pumps its delta buffer here (device dispatch); the
+        # winner of the offset race is the only caller that reaches
+        # this point, so one committed window pumps exactly once
+        for hook in hooks:
+            hook(name)
+        return len(msgs)
 
 
 def _batch_rows(batch: FeatureBatch) -> Iterator[Tuple[str, Dict[str, object]]]:

@@ -58,6 +58,15 @@ class LoadReport:
     windows_in_flight_max: int = 0
     pipelined_windows: int = 0
     fused_counts: int = 0
+    # subscribe mode (docs/SERVING.md "Standing queries"): N standing
+    # subscriptions folded over M kafka batches — throughput is pushed
+    # events/s, latency is the per-batch poll->eval->push cycle, and
+    # `dispatches` is the evaluator's fused-kernel count (the
+    # one-dispatch-per-poll invariant makes it == batches when warm)
+    subscriptions: int = 0
+    batches: int = 0
+    events_total: int = 0
+    events_per_s: float = 0.0
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -305,6 +314,102 @@ def run_sustained(
     # lifetime totals would credit a warmup pass to the measured run
     rep.fused_counts = int(p.get("fused_counts", 0)
                            - pbase.get("fused_counts", 0))
+    return rep
+
+
+def run_subscribe(
+    store,
+    type_name: str,
+    make_batch: Callable[[int], object],
+    subscriptions: int = 8,
+    batches: int = 20,
+    extent=(-60.0, 60.0),
+    density_shape=(64, 32),
+    seed: int = 0,
+    manager=None,
+) -> LoadReport:
+    """Standing-query load mode (`gmtpu bench-serve --mode subscribe`):
+    register N subscriptions (bbox geofences, dwithin geofences and a
+    density window, cycling) over a live Kafka store, produce + poll M
+    batches from `make_batch(i)`, and report pushed events/s plus the
+    per-batch eval+push latency distribution (p99 is the line the
+    ISSUE's standing-query workload is judged on). The evaluator's
+    one-dispatch-per-poll invariant is visible in the report:
+    `dispatches` ≈ `batches` once the fused kernel is warm."""
+    from geomesa_tpu.subscribe import DensityWindow, SubscriptionManager
+
+    mgr = manager if manager is not None else SubscriptionManager(store)
+    rng = np.random.default_rng(seed)
+    geom = store.get_schema(type_name).default_geometry.name
+    lo, hi = extent
+    registered = []
+    for i in range(subscriptions):
+        kind = i % 3
+        if kind == 0:
+            x0 = float(rng.uniform(lo, hi - 30))
+            y0 = float(rng.uniform(lo / 2, hi / 2 - 20))
+            registered.append(mgr.subscribe(
+                type_name,
+                f"BBOX({geom}, {x0}, {y0}, {x0 + 30}, {y0 + 20})"))
+        elif kind == 1:
+            px = float(rng.uniform(lo / 2, hi / 2))
+            py = float(rng.uniform(lo / 4, hi / 4))
+            registered.append(mgr.subscribe(
+                type_name,
+                f"DWITHIN({geom}, POINT({px} {py}), 1500000, meters)"))
+        else:
+            w, h = density_shape
+            registered.append(mgr.subscribe(type_name, density=DensityWindow(
+                (lo, lo / 2, hi, hi / 2), w, h)))
+    # warm fold OUTSIDE the measured window: THIS manager's fused
+    # kernel (the AOT key includes the evaluator nonce + version, so a
+    # throwaway warm manager would compile a different entry and leave
+    # batch 0 paying the trace+compile), plus the registration-time
+    # `state` snapshot frames — the benchmark reports INCREMENTAL push
+    # throughput, not baseline transfer or compile time
+    store.write(type_name, make_batch(batches))
+    mgr.poll_now()
+    mgr.flush(lambda _f: None)
+    frames: List[dict] = []
+    lat_s: List[float] = []
+    base = mgr.evaluator.stats()
+    t_start = time.monotonic()
+    for i in range(batches):
+        store.write(type_name, make_batch(i))
+        t0 = time.monotonic()
+        mgr.poll_now()
+        mgr.flush(frames.append)
+        lat_s.append(time.monotonic() - t0)
+    wall = time.monotonic() - t_start
+    ev = mgr.evaluator.stats()
+    # incremental events only: geofence transitions count per fid,
+    # density folds per frame; lifecycle frames (state/lagged/...)
+    # are bookkeeping, not workload output
+    events = 0
+    for f in frames:
+        if f.get("event") in ("enter", "exit"):
+            events += len(f.get("fids", ()))
+        elif f.get("event") == "density":
+            events += 1
+    rep = _report("subscribe", wall, lat_s, batches, 0, 0, 0,
+                  {"dispatches": ev.get("dispatches", 0)
+                   - base.get("dispatches", 0), "coalesced": 0})
+    rep.subscriptions = subscriptions
+    rep.batches = batches
+    rep.events_total = events
+    rep.events_per_s = events / wall if wall > 0 else 0.0
+    if manager is None:
+        mgr.close()
+    else:
+        # caller-owned manager: cancel what THIS call registered, or
+        # repeated runs accumulate 8 stale subs each — every
+        # intervening poll pays fused evaluation for them until the
+        # table bound rejects run ~32 with subscription_limit
+        for s in registered:
+            try:
+                mgr.unsubscribe(s.sub_id)
+            except KeyError:
+                pass  # TTL-expired mid-run
     return rep
 
 

@@ -17,6 +17,26 @@ timeoutMs, allowDegraded. Responses: {"id", "ok": true, ...} with
 op-specific payload, or {"id", "ok": false, "error":
 rejected|timeout|error, "reason", "message"}.
 
+Standing queries (docs/SERVING.md "Standing queries") ride the same
+stream against a live (Kafka) store:
+
+    {"id": "s1", "op": "subscribe", "typeName": "vessels",
+     "cql": "DWITHIN(geom, POINT(0 0), 50000, meters)", "ttlS": 600}
+    {"id": "s2", "op": "subscribe", "typeName": "vessels",
+     "density": {"bbox": [-180,-90,180,90], "width": 256, "height": 128}}
+    {"id": "p1", "op": "poll"}
+    {"id": "u1", "op": "unsubscribe", "subscription": "sub-1"}
+
+The subscribe response carries the subscription id; from then on the
+server interleaves PUSH FRAMES — JSON objects with an "event" field
+instead of an "id" — into the response stream as Kafka batches fold
+in: `enter`/`exit` (geofence transitions, fid lists), `density`
+(window totals), `state` (full re-sync after lag), and the typed
+`subscription_lagged` / `expired` / `quarantined` lifecycle frames.
+`poll` folds pending Kafka messages synchronously and flushes
+outboxes (deterministic clients; the `--live-poll-ms` pump does it on
+a cadence otherwise).
+
 Errors are per-request, never fatal to the stream: a malformed line
 yields an ok=false response and the loop continues — one bad client
 request must not drop everyone else's connection.
@@ -155,6 +175,176 @@ def _error_response(rid, exc) -> dict:
     return {"id": rid, "ok": False, "error": "error", "message": str(exc)}
 
 
+SUBSCRIBE_OPS = ("subscribe", "unsubscribe", "poll", "subscriptions")
+
+
+def _parse_density(doc: dict):
+    """The density window of a subscribe request, or None."""
+    d = doc.get("density")
+    if d is None:
+        return None
+    from geomesa_tpu.subscribe import DensityWindow
+
+    return DensityWindow(
+        bbox=tuple(float(v) for v in d["bbox"]),
+        width=int(d["width"]), height=int(d["height"]),
+        weight_attr=d.get("weight"), decay=d.get("decay"))
+
+
+class _SubscribeSession:
+    """Per-connection standing-query state: lazily creates the
+    SubscriptionManager on the first subscribe verb (sharing the
+    QueryService's tenant buckets and quarantine tuning), runs the
+    auto-poll pump when configured, and flushes outboxes into the
+    response stream."""
+
+    def __init__(self, store, svc: QueryService, respond):
+        self.store = store
+        self.svc = svc
+        self.respond = respond
+        self.manager = None
+        self._stop = threading.Event()
+        self._pump = None
+
+    def _ensure(self):
+        if self.manager is not None:
+            return self.manager
+        if not hasattr(self.store, "poll"):
+            raise ValueError(
+                "standing queries need a live (Kafka) store; this "
+                "catalog is durable-only")
+        from geomesa_tpu.subscribe import (
+            SubscribeConfig, SubscriptionManager)
+
+        cfg = self.svc.config
+        self.manager = SubscriptionManager(
+            self.store,
+            SubscribeConfig(
+                max_subscriptions=cfg.subscribe_max,
+                outbox_limit=cfg.subscribe_outbox,
+                rate=cfg.subscribe_rate,
+                quarantine_after=cfg.quarantine_after,
+                quarantine_ttl_s=cfg.quarantine_ttl_s,
+            ),
+            limiter=self.svc.limiter)
+        if self.svc.subscriptions is None:
+            # stats surface: first manager wins; close() clears it —
+            # a later connection must not shadow a live one, and a
+            # closed one must not keep reporting a dead registry
+            self.svc.subscriptions = self.manager
+        if cfg.subscribe_poll_ms:
+            self._pump = threading.Thread(
+                target=self._pump_loop, name="gmtpu-subscribe-pump",
+                daemon=True)
+            self._pump.start()
+        return self.manager
+
+    def _pump_loop(self):
+        interval = self.svc.config.subscribe_poll_ms / 1000.0
+        while not self._stop.wait(interval):
+            self.pump_once()
+
+    def pump_once(self) -> int:
+        """One poll + flush cycle. Typed broker errors surface as a
+        `poll_error` frame — the stream stays alive, the client knows
+        events are delayed, and the next cycle retries. The flush is
+        guarded too: one raising write must not silently kill the pump
+        thread and strand a live connection event-less."""
+        if self.manager is None:
+            return 0
+        try:
+            self.manager.poll_now()
+        except Exception as e:  # noqa: BLE001 — typed surface, stream lives
+            try:
+                self.respond({"event": "poll_error",
+                              "error": type(e).__name__,
+                              "message": str(e)})
+            except Exception:
+                return 0  # sink broken: frames stay queued, retry next tick
+        try:
+            return self.manager.flush(self.respond)
+        except Exception:  # noqa: BLE001 — pump thread must survive
+            # a raising sink loses the frame in flight (the connection
+            # is broken anyway); undrained frames stay in their bounded
+            # outboxes and the next cycle retries instead of the pump
+            # thread dying silently
+            return 0
+
+    def handle(self, rid, doc: dict) -> None:
+        op = doc["op"]
+        if self.manager is None and op != "subscribe":
+            # only `subscribe` instantiates the manager (and its
+            # auto-poll pump): a bare poll / introspection verb on a
+            # subscription-less connection answers cheaply, and works
+            # against durable-only catalogs too
+            if op == "poll":
+                self.respond({"id": rid, "ok": True, "applied": {},
+                              "frames": 0})
+            elif op == "subscriptions":
+                self.respond({"id": rid, "ok": True, "subscriptions": 0})
+            else:  # unsubscribe with nothing registered
+                self.respond({"id": rid, "ok": False, "error": "error",
+                              "message": "no such subscription"})
+            return
+        mgr = self._ensure()
+        if op == "subscribe":
+            # the manager runs `ack` under its flush lock, so the
+            # response (which tells the client the subscription id) is
+            # on the wire before any push frame referencing that id
+            mgr.subscribe(
+                doc["typeName"],
+                cql=doc.get("cql", "INCLUDE"),
+                density=_parse_density(doc),
+                tenant=doc.get("tenant", ""),
+                ttl_s=doc.get("ttlS"),
+                rate=doc.get("rate"),
+                outbox_limit=doc.get("outboxLimit"),
+                initial_state=bool(doc.get("initialState", True)),
+                ack=lambda s: self.respond(
+                    {"id": rid, "ok": True,
+                     "subscription": s.sub_id, "mode": s.mode}))
+            mgr.flush(self.respond)  # deliver the initial state frame
+        elif op == "unsubscribe":
+            try:
+                sub = mgr.unsubscribe(doc["subscription"])
+            except KeyError:
+                # same typed answer as the manager-less branch — an
+                # unknown (or concurrently TTL-expired) id must not
+                # leak a bare KeyError message
+                self.respond({"id": rid, "ok": False, "error": "error",
+                              "message": "no such subscription"})
+                return
+            mgr.flush(self.respond)  # parting frames
+            self.respond({"id": rid, "ok": True,
+                          "subscription": sub.sub_id,
+                          "status": sub.status})
+        elif op == "poll":
+            applied = mgr.poll_now()
+            frames = mgr.flush(self.respond)
+            self.respond({"id": rid, "ok": True, "applied": applied,
+                          "frames": frames})
+        else:  # subscriptions: introspection
+            self.respond({"id": rid, "ok": True, **mgr.stats()})
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._pump is not None:
+            self._pump.join(timeout=5.0)
+        if self.manager is not None:
+            # final flush so cancelled/expired frames are not lost
+            try:
+                self.manager.flush(self.respond)
+            # gt: waive GT14
+            # (deliberate degrade: the stream is closing — a broken
+            # write sink must not mask the manager close that releases
+            # subscriptions; frames at shutdown are best-effort)
+            except Exception:
+                pass
+            self.manager.close()
+            if self.svc.subscriptions is self.manager:
+                self.svc.subscriptions = None
+
+
 def serve_lines(
     store,
     lines: Iterable[str],
@@ -176,6 +366,8 @@ def serve_lines(
     def respond(doc: dict) -> None:
         with out_lock:
             write(json.dumps(doc) + "\n")
+
+    subs = _SubscribeSession(store, svc, respond)
 
     def on_done(rid, req):
         def cb(fut):
@@ -216,6 +408,9 @@ def serve_lines(
             try:
                 doc = json.loads(line)
                 rid = doc.get("id", processed)
+                if doc.get("op") in SUBSCRIBE_OPS:
+                    subs.handle(rid, doc)
+                    continue
                 req = parse_request(doc)
                 fut = svc.submit(req)
                 fut.add_done_callback(on_done(rid, req))
@@ -223,5 +418,6 @@ def serve_lines(
                 respond(_error_response(rid if rid is not None
                                         else processed, e))
     finally:
+        subs.close()
         svc.close(drain=True)
     return processed

@@ -93,6 +93,17 @@ class ServeConfig:
     pipeline: bool = True
     pipeline_depth: int = 2
     pipeline_donate: Optional[bool] = None
+    # standing queries (docs/SERVING.md "Standing queries"): bounds and
+    # rate limits for the subscribe/unsubscribe wire verbs; the
+    # SubscriptionManager shares this service's per-tenant token
+    # buckets, so queries and subscriptions draw one admission budget.
+    # subscribe_poll_ms drives the auto-poll pump while subscriptions
+    # are active (None = polls happen only on the `poll` verb or when
+    # queries fold the topic)
+    subscribe_max: int = 256
+    subscribe_outbox: int = 1024
+    subscribe_rate: Optional[float] = None
+    subscribe_poll_ms: Optional[float] = None
 
 
 def _quarantine_key(req: ServeRequest):
@@ -129,6 +140,10 @@ class QueryService:
         self._state_lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._worker: Optional[threading.Thread] = None
+        # standing-query manager (geomesa_tpu.subscribe): attached by
+        # the wire layer when the first subscribe verb arrives, so
+        # stats()/debug endpoints surface subscription state
+        self.subscriptions = None
         # pipelined dispatch path (serve/pipeline.py): the default for
         # kNN windows; its completer thread starts lazily on the first
         # pipelined window
@@ -819,6 +834,9 @@ class QueryService:
         out["queue_depth"] = len(self.queue)
         out["degrade_level"] = self.degrade_level()
         out["quarantine"] = self.quarantine.stats()
+        subs = self.subscriptions  # racing close() may null the attr
+        if subs is not None:
+            out["subscriptions"] = subs.stats()
         if self.pipeline is not None:
             out["pipeline"] = self.pipeline.stats()
         if self.tracker is not None:

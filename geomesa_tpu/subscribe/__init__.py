@@ -1,0 +1,31 @@
+"""geomesa_tpu.subscribe — standing queries over the Kafka live layer.
+
+A client registers a long-lived predicate (CQL / BBOX / DWITHIN
+geofence) or a density/heatmap window and receives incremental push
+updates — enter/exit events, density folds — as Kafka batches fold in.
+Every poll evaluates ALL registered standing queries in ONE fused
+device dispatch (docs/SERVING.md "Standing queries").
+
+    registry.py   Subscription state: matched-fid sets, decayed grids,
+                  bounded outboxes, rate limits, lifecycle + TTL
+    evaluator.py  delta-driven fused evaluation hooked on
+                  KafkaDataStore.poll (ExecutableRegistry-routed,
+                  exactly-once per batch, quarantine fallback)
+    manager.py    admission (tenant buckets, bounds, quarantine),
+                  poll/flush driving, wire-layer glue
+"""
+
+from geomesa_tpu.subscribe.evaluator import DeltaEvaluator
+from geomesa_tpu.subscribe.manager import (
+    SubscribeConfig, SubscriptionManager)
+from geomesa_tpu.subscribe.registry import (
+    DensityWindow, Subscription, SubscriptionRegistry)
+
+__all__ = [
+    "DeltaEvaluator",
+    "DensityWindow",
+    "SubscribeConfig",
+    "Subscription",
+    "SubscriptionManager",
+    "SubscriptionRegistry",
+]

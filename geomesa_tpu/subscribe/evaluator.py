@@ -1,0 +1,889 @@
+"""Delta-driven incremental evaluation for standing queries.
+
+The evaluation contract (docs/SERVING.md "Standing queries"):
+
+- The Kafka layer is the only writer of live state. Every
+  `KafkaDataStore.poll` folds a message window into the
+  KafkaFeatureCache ATOMICALLY (offset-pinned — kafka/store.py); the
+  cache's FeatureEvents for that window land in this module's per-type
+  delta buffer via a non-blocking listener (lint rule GT17 enforces
+  that listener bodies stay non-blocking), and the store's post-fold
+  hook pumps the evaluator OUTSIDE the store lock.
+
+- One poll = ONE coalesced device dispatch, independent of how many
+  subscriptions are registered: the window's changed rows stack into a
+  single columnar delta (pow2-padded, so shapes repeat), and a FUSED
+  kernel — every registered predicate's compiled mask + f32 boundary
+  band, plus every density window's cell binning — is built per
+  (type, registry version), registered with the compilecache
+  ExecutableRegistry, and AOT-compiled per shape bucket. A steady
+  subscription set therefore never recompiles per batch; membership
+  changes bump the version and rebuild exactly once.
+
+- Exactly-once: buffered events are consumed only after a successful
+  evaluation. An injected `kafka.poll` fault fails the poll BEFORE the
+  fold (no events buffered); an infrastructure failure inside the
+  evaluator (device transfer, injected `subscribe.eval` fault) leaves
+  the buffer intact for the next poll — no missed events, and the
+  diff-based state update (enter/exit = set difference against the
+  previous matched set) makes re-evaluation idempotent, so no
+  duplicates either.
+
+- Exactness matches the one-shot planner: predicates evaluate on the
+  same f32 device columns `to_device` builds, and rows flagged by the
+  compiled filter's f32 boundary band are re-evaluated in f64 on host
+  (cql/hosteval) before the matched-set diff — so the incremental
+  matched set is bit-identical to a fresh planner query's fids.
+
+- A predicate that CRASHES evaluation is struck against the faults/
+  quarantine registry (keyed by predicate fingerprint, not sub id) and
+  quarantined after the configured strikes — never retried forever.
+  The crashing fold degrades to per-subscription evaluation so healthy
+  subscriptions still get their events; a subscription that survives a
+  crash re-syncs from the live snapshot on its next clean fold.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.faults import harness as _faults
+from geomesa_tpu.subscribe.registry import (
+    DensityWindow, Subscription, SubscriptionRegistry)
+from geomesa_tpu.telemetry.recorder import RECORDER
+from geomesa_tpu.telemetry.trace import TRACER
+from geomesa_tpu.utils.padding import next_pow2
+
+# evaluation boundary fault site (docs/ROBUSTNESS.md site catalog):
+# fires once per fused evaluation, BEFORE any subscription state
+# mutates — an injected failure must leave the delta buffer intact for
+# the next poll (exactly-once), never half-apply a batch
+_EVAL_SITE = _faults.site(
+    "subscribe.eval", "standing-query fused delta evaluation")
+
+_PAD_MIN = 16          # smallest delta bucket (tiny deltas share one shape)
+_TABLE_PAD_MIN = 8     # smallest vocab-table bucket (string predicates)
+_MAX_BUFFER = 65_536   # per-type delta buffer bound (overflow => resync)
+_MAX_FILTERS = 256     # compiled-predicate cache bound (LRU-ish eviction)
+
+_eval_ids = itertools.count(1)
+
+
+def _infra_error(exc: BaseException) -> bool:
+    """Infrastructure answer vs predicate crash — the serving layer's
+    quarantine exemption (serve/service.py): the OSError family (even
+    when classified permanent — a compaction-raced read) and transient
+    failures say nothing about the PREDICATE being poisonous."""
+    from geomesa_tpu.faults import classify
+
+    return isinstance(exc, OSError) or classify(exc) == "transient"
+
+
+class _TypeState:
+    """Per-feature-type evaluator state. The eval lock serializes folds
+    (delta windows apply in offset order — the store's poll already
+    guarantees at-most-one fold per window); the buffer lock guards the
+    listener-side event appends, which must stay cheap (GT17)."""
+
+    def __init__(self, type_name: str):
+        self.type_name = type_name
+        self.eval_lock = threading.Lock()
+        self.buf_lock = threading.Lock()
+        self.buffer: List[tuple] = []   # (kind, fid, attrs-or-None)
+        self.overflowed = False
+        self.listening = False
+        self.listener_fn = None
+        # listener gate: True while the type plausibly has active
+        # subscriptions. A plain bool (GIL-atomic) because the
+        # listener runs per folded MESSAGE inside the store lock — a
+        # registry lock + list build there would contend with every
+        # subscribe/flush thread on the hottest path. Set on admit,
+        # refreshed by each pump; a stale True costs one bounded
+        # buffer until the next pump clears it.
+        self.armed = False
+        # fused-kernel cache: rebuilt when the registry version moves
+        self.version = -1
+        self.fused_name: Optional[str] = None
+        self.fused_fn = None
+        self.treedef = None
+        self.pred_subs: List[str] = []
+        self.dens_subs: List[str] = []
+
+
+class DeltaEvaluator:
+    """Incremental evaluator over one live store (KafkaDataStore duck
+    type: `get_schema`, `cache`, `add_fold_hook`)."""
+
+    def __init__(self, store, registry: SubscriptionRegistry,
+                 quarantine=None, quarantine_after: int = 3,
+                 quarantine_ttl_s: float = 600.0):
+        self.store = store
+        self.registry = registry
+        # quarantine_after=0 disables quarantine (the serve layer's
+        # contract): strikes are never counted, a crashing predicate
+        # just re-seeds and retries each fold
+        self._quarantine_enabled = (quarantine is not None
+                                    or quarantine_after > 0)
+        if quarantine is None:
+            from geomesa_tpu.faults import QuarantineRegistry
+
+            quarantine = QuarantineRegistry(
+                strikes=max(quarantine_after, 1), ttl_s=quarantine_ttl_s)
+        self.quarantine = quarantine
+        self._nonce = next(_eval_ids)
+        self._types: Dict[str, _TypeState] = {}
+        self._types_lock = threading.Lock()
+        # compiled predicate cache, keyed by (type, cql): compile once
+        # per predicate, shared across fused rebuilds
+        self._filters: Dict[Tuple[str, str], object] = {}
+        # serializes compile/insert/evict (subscribe-time validation on
+        # the reader thread vs the pump's fused rebuild); steady-state
+        # reads of live keys stay lock-free — eviction never removes a
+        # key a live subscription references
+        self._filters_lock = threading.Lock()
+        # bootstrap-path cell-binning executables, keyed by window
+        # geometry: per-instance so a closed manager's evaluator frees
+        # them with it (a process-wide dict would grow one entry per
+        # distinct window for the server's lifetime)
+        self._cells_cache: Dict[tuple, object] = {}
+        self._counters: Dict[str, int] = {}
+        self._counters_lock = threading.Lock()
+        store.add_fold_hook(self.pump)
+
+    # -- counters ----------------------------------------------------------
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        with self._counters_lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def stats(self) -> Dict[str, int]:
+        with self._counters_lock:
+            out = dict(self._counters)
+        for k in ("folds", "dispatches", "events", "fallbacks",
+                  "resyncs", "eval_errors"):
+            out.setdefault(k, 0)
+        return out
+
+    # -- wiring ------------------------------------------------------------
+
+    def _state(self, type_name: str) -> _TypeState:
+        with self._types_lock:
+            st = self._types.get(type_name)
+            if st is None:
+                st = self._types[type_name] = _TypeState(type_name)
+            return st
+
+    def watch(self, type_name: str) -> None:
+        """Attach the delta listener to the type's cache (idempotent).
+        Called by the manager when the first subscription for a type
+        registers; detach() removes it again — it no-ops (cheap check)
+        while no subscription is active."""
+        st = self._state(type_name)
+        with st.buf_lock:
+            if st.listening:
+                return
+            st.listening = True
+            st.listener_fn = self._listener(st)
+        self.store.cache(type_name).add_listener(st.listener_fn)
+
+    def admit(self, sub: Subscription) -> None:
+        """Bootstrap-then-register as one unit UNDER the per-type eval
+        lock: a concurrent fold (the --live-poll-ms pump) can neither
+        evaluate the subscription before its baseline state exists nor
+        overwrite a baseline mid-diff. Events buffered while the
+        bootstrap snapshot is read are re-evaluated by the next fold —
+        the diff-based state update makes that idempotent."""
+        st = self._state(sub.type_name)
+        with st.eval_lock:
+            st.armed = True  # before register: no event window is missed
+            self.bootstrap(sub)
+            self.registry.register(sub)
+
+    def resync(self, sub: Subscription) -> None:
+        """Eagerly re-seed a subscription from the live snapshot under
+        the per-type eval lock (resume path: folds applied while the
+        subscription was paused never reached its state, so the next
+        flush must hand the client CURRENT state, not pre-pause state)."""
+        st = self._state(sub.type_name)
+        with st.eval_lock:
+            if sub._resync_pending():
+                self._resync(sub)
+
+    def detach(self) -> None:
+        """Release every store-side hook this evaluator installed (the
+        fold hook and per-type cache listeners), so a closed manager —
+        one wire connection's worth of standing queries — stops costing
+        every future poll and becomes collectable."""
+        try:
+            self.store.remove_fold_hook(self.pump)
+        except (AttributeError, ValueError):
+            pass
+        with self._types_lock:
+            states = list(self._types.values())
+        from geomesa_tpu.compilecache.registry import registry as aot
+
+        for st in states:
+            st.armed = False
+            if st.fused_name is not None:
+                aot.unregister(st.fused_name)
+                st.fused_name = None
+            with st.buf_lock:
+                fn, st.listener_fn = st.listener_fn, None
+                st.listening = False
+                st.buffer.clear()
+            if fn is not None:
+                try:
+                    self.store.cache(st.type_name).remove_listener(fn)
+                except (KeyError, ValueError):
+                    pass
+
+    def _listener(self, st: _TypeState):
+        def on_feature_event(event) -> None:
+            # GT17: listener body — buffer append only, no blocking
+            # calls (no I/O, no device work, no future waits); the
+            # heavy lifting happens in pump(), after the store's fold
+            if not st.armed:
+                return
+            with st.buf_lock:
+                if len(st.buffer) >= _MAX_BUFFER:
+                    st.buffer.clear()
+                    st.overflowed = True
+                st.buffer.append((event.kind, event.fid,
+                                  event.attributes))
+
+        return on_feature_event
+
+    # -- registration-time state -------------------------------------------
+
+    def bootstrap(self, sub: Subscription) -> None:
+        """Seed a subscription's state from the CURRENT live snapshot
+        (one-shot semantics), so subsequent folds are pure increments.
+        Also the re-sync path after a crashed or overflowed fold."""
+        sft = self.store.get_schema(sub.type_name)
+        snap = self.store.cache(sub.type_name).snapshot()
+        if sub.density is not None:
+            cells = None
+            if snap is not None and len(snap):
+                rows, cols, inb = self._density_cells_host(
+                    sub.density, sft, snap)
+                w = self._weights(sub.density, snap)
+                cells = (rows, cols, inb, w, _batch_fids(snap))
+            # mutate under the subscription lock so a flush racing the
+            # re-seed never serializes a half-built grid (same
+            # discipline as _apply_density)
+            with sub._lock:
+                sub.grid[:] = 0.0
+                sub.contrib.clear()
+                if cells is not None:
+                    rows, cols, inb, w, fids = cells
+                    for j in range(len(fids)):
+                        if inb[j]:
+                            sub.grid[rows[j], cols[j]] += w[j]
+                            sub.contrib[fids[j]] = (
+                                int(rows[j]), int(cols[j]), float(w[j]))
+            return
+        compiled = self._filter_for(sub.type_name, sub.cql, sft)
+        matched: set = set()
+        if snap is not None and len(snap):
+            from geomesa_tpu.engine.device import to_device
+
+            padded = snap.pad_to(next_pow2(max(len(snap), _PAD_MIN)))
+            # gt: waive GT09
+            # (deliberate: bootstrap runs under the per-type eval lock
+            # by design — the fold serialization IS the consistency
+            # boundary; registration/resync cold path)
+            dev = to_device(padded)
+            mask = compiled.mask_refined(dev, padded)[: len(snap)]
+            fids = _batch_fids(snap)
+            matched = {fids[j] for j in range(len(snap)) if mask[j]}
+        sub.matched = matched
+
+    def _filter_for(self, type_name: str, cql: str, sft):
+        key = (type_name, cql)
+        got = self._filters.get(key)  # lock-free hot-path hit
+        if got is None:
+            from geomesa_tpu.cql import parse_cql
+            from geomesa_tpu.cql.compile import compile_filter
+
+            got = compile_filter(parse_cql(cql), sft)
+            with self._filters_lock:
+                if len(self._filters) >= _MAX_FILTERS:
+                    # a connection looping subscribe/unsubscribe over
+                    # distinct predicates (shifting geofences) must not
+                    # grow this monotonically: evict compiled filters no
+                    # live subscription references (insertion order —
+                    # oldest first; an evicted-but-needed one recompiles)
+                    live = {(s.type_name, s.cql)
+                            for s in self.registry.subs() if s.cql}
+                    for k in [k for k in self._filters if k not in live]:
+                        if len(self._filters) < _MAX_FILTERS:
+                            break
+                        del self._filters[k]
+                got = self._filters.setdefault(key, got)
+        return got
+
+    # -- density helpers ---------------------------------------------------
+
+    @staticmethod
+    def _cells_device(d: DensityWindow, x, y, valid):
+        """Grid-cell binning for one density window, INSIDE the fused
+        jit — the exact arithmetic of engine.density.density_grid (f32
+        coords, weak-typed bbox operands), so incremental folds land in
+        the same cells the one-shot density kernel would."""
+        import jax.numpy as jnp
+
+        xmin, ymin, xmax, ymax = d.bbox
+        dx = (xmax - xmin) / d.width
+        dy = (ymax - ymin) / d.height
+        col = jnp.floor((x - xmin) / dx).astype(jnp.int32)
+        row = jnp.floor((y - ymin) / dy).astype(jnp.int32)
+        inb = ((col >= 0) & (col < d.width)
+               & (row >= 0) & (row < d.height) & valid)
+        return (jnp.clip(row, 0, d.height - 1),
+                jnp.clip(col, 0, d.width - 1), inb)
+
+    def _density_cells_host(self, d: DensityWindow, sft, batch):
+        """Bootstrap-path binning: one jitted call over a snapshot
+        (cold path; the per-poll folds ride the fused kernel)."""
+        import jax
+
+        from geomesa_tpu.engine.device import VALID, to_device
+
+        padded = batch.pad_to(next_pow2(max(len(batch), _PAD_MIN)))
+        # gt: waive GT09
+        # (deliberate: runs under the per-type eval lock — fold
+        # serialization is the point; bootstrap/fallback cold path)
+        dev = to_device(padded)
+        g = _geom_name(sft)
+        # gt: waive GT09
+        # (deliberate: same eval-lock serialization as above)
+        rows, cols, inb = jax.device_get(self._cells_jit(
+            d, dev[f"{g}__x"], dev[f"{g}__y"], dev[VALID]))
+        n = len(batch)
+        return rows[:n], cols[:n], inb[:n]
+
+    def _cells_jit(self, d: DensityWindow, x, y, valid):
+        import jax
+
+        key = (d.bbox, d.width, d.height)
+        cells_exec = self._cells_cache.get(key)
+        if cells_exec is None:
+            cells_exec = jax.jit(
+                lambda x, y, v, _d=d: self._cells_device(_d, x, y, v))
+            self._cells_cache[key] = cells_exec
+        # gt: waive GT09
+        # (deliberate: the per-type eval lock EXISTS to serialize fold
+        # evaluation — device work is its whole body, same stance as
+        # the device-cache residency uploads; cold path, snapshots only)
+        return cells_exec(x, y, valid)
+
+    def _weights(self, d: DensityWindow, batch) -> np.ndarray:
+        if d.weight_attr is None:
+            return np.ones(len(batch), np.float64)
+        col = batch.columns[d.weight_attr]
+        return np.asarray(col, np.float64)
+
+    # -- the fused kernel --------------------------------------------------
+
+    def _fused_for(self, st: _TypeState, sft, subs: List[Subscription],
+                   version: int):
+        """(Re)build the fused evaluation kernel when the registry
+        version moved; otherwise return the cached registration. The
+        kernel closes over predicate structure and density geometry;
+        per-batch VALUES (vocab tables, device columns) arrive as
+        arguments, so repeated shapes are AOT-registry hits. `version`
+        and `subs` come from ONE atomic registry read — equal versions
+        imply identical membership, so a cached kernel is always built
+        from exactly this subscription list."""
+        if st.fused_name is not None and st.version == version:
+            return st.fused_name
+        if st.fused_name is not None:
+            # membership moved: the stale version's kernel and its AOT
+            # executables are unreachable — drop them, or subscription
+            # churn grows the process-global registry forever
+            from geomesa_tpu.compilecache.registry import registry as aot
+
+            aot.unregister(st.fused_name)
+        pred = [s for s in subs if s.density is None]
+        dens = [s for s in subs if s.density is not None]
+        filters = [self._filter_for(st.type_name, s.cql, sft) for s in pred]
+        windows = [s.density for s in dens]
+        geom = _geom_name(sft)
+        mask_fns = [f.mask_fn() for f in filters]
+        band_fns = [f._band_fn for f in filters]
+        cells_device = self._cells_device
+
+        def fused(params_list, dev):
+            import jax.numpy as jnp
+
+            from geomesa_tpu.engine.device import VALID
+
+            n = dev[VALID].shape[0]
+            if mask_fns:
+                masks = jnp.stack([fn(p, dev)
+                                   for fn, p in zip(mask_fns, params_list)])
+                bands = jnp.stack([
+                    bf(p, dev) if bf is not None
+                    else jnp.zeros(n, bool)
+                    for bf, p in zip(band_fns, params_list)])
+            else:
+                masks = jnp.zeros((0, n), bool)
+                bands = masks
+            cells = tuple(
+                cells_device(d, dev[f"{geom}__x"], dev[f"{geom}__y"],
+                             dev[VALID])
+                for d in windows)
+            return masks, bands, cells
+
+        st.fused_fn = fused
+        st.version = version
+        st.treedef = None  # re-derived at the first call
+        st.fused_name = (f"subscribe.eval.{st.type_name}"
+                         f".e{self._nonce}.v{version}")
+        st.pred_subs = [s.sub_id for s in pred]
+        st.dens_subs = [s.sub_id for s in dens]
+        return st.fused_name
+
+    def _eval_fused(self, st: _TypeState, sft, subs, version, delta, dev):
+        """ONE device dispatch for every registered standing query:
+        route the fused kernel through the ExecutableRegistry (AOT per
+        shape bucket — zero recompiles per batch for a steady
+        subscription set), then one combined device_get."""
+        import jax
+        from jax import tree_util
+
+        from geomesa_tpu.compilecache.registry import registry as aot
+
+        name = self._fused_for(st, sft, subs, version)
+        pred_ids = set(st.pred_subs)
+        pred = [s for s in subs if s.sub_id in pred_ids]
+        params_list = []
+        for s in pred:
+            f = self._filters[(st.type_name, s.cql)]
+            params_list.append(_pad_tables(f.params(delta)))
+        leaves, treedef = tree_util.tree_flatten((params_list, dev))
+        # register on the first call after a (re)build — _fused_for
+        # resets treedef to None — or if the params structure shifted
+        # (it cannot for a fixed version, but a re-register is safe)
+        if st.treedef is None or st.treedef != treedef:
+            st.treedef = treedef
+            fused = st.fused_fn
+
+            def fused_flat(*leaves, _td=treedef, _fn=fused):
+                p, d = tree_util.tree_unflatten(_td, leaves)
+                return _fn(p, d)
+
+            aot.register(name, fused_flat)
+        handle = aot.compile(name, *leaves)
+        self._bump("dispatches")
+        t0 = time.perf_counter()
+        # gt: waive GT09
+        # (deliberate: THE fused dispatch — one per poll — runs under
+        # the per-type eval lock because fold order is the exactly-once
+        # contract; contending pollers of other types take other locks)
+        out = jax.device_get(handle.call(*leaves))
+        try:
+            from geomesa_tpu.utils.metrics import metrics
+
+            metrics.histogram("subscribe.eval").update(
+                time.perf_counter() - t0)
+        except Exception:
+            pass
+        masks, bands, cells = out
+        return pred, masks, bands, cells
+
+    # -- pump: fold one delta window ---------------------------------------
+
+    def pump(self, type_name: str) -> int:
+        """Fold buffered FeatureEvents for `type_name` into every
+        registered subscription. Called by the store's post-fold hook
+        (outside the store lock) and by the manager's poll loop.
+        Returns the number of events consumed; 0 when the buffer is
+        empty or evaluation must be retried (buffer retained)."""
+        st = self._state(type_name)
+        self.registry.expire_tick()
+        with st.eval_lock:
+            return self._pump_locked(st)
+
+    def _pump_locked(self, st: _TypeState) -> int:
+        with st.buf_lock:
+            events = list(st.buffer)
+            n_ev = len(events)
+            overflowed = st.overflowed
+        version, subs = self.registry.active_snapshot(st.type_name)
+        st.armed = bool(subs)  # refresh the listener gate
+        if not subs:
+            with st.buf_lock:
+                del st.buffer[:n_ev]
+                st.overflowed = False
+            return n_ev
+        if overflowed:
+            # the delta buffer overflowed between pumps: incremental
+            # continuity is lost — re-seed every subscription from the
+            # live snapshot and tell clients via lagged/state frames.
+            # Consume the buffer and clear the flag BEFORE the (slow)
+            # re-seed: resetting after it would erase a SECOND overflow
+            # landing mid-re-seed (its fresh events deleted, its flag
+            # cleared — silent divergence). Everything cleared here is
+            # covered by the bootstrap snapshots (the listener fires
+            # after the cache mutation), and events landing after the
+            # clear stay queued for the next pump, whose diff-based
+            # application is idempotent against the fresh baseline.
+            with st.buf_lock:
+                st.buffer.clear()
+                st.overflowed = False
+            for sub in subs:
+                try:
+                    self.bootstrap(sub)
+                    with sub._lock:
+                        sub.lagged = True
+                except Exception as e:  # noqa: BLE001 — strike, don't spread
+                    # a crashing re-seed must not escape to the store's
+                    # poll (untyped error to every caller) or cost the
+                    # other subscriptions their re-seed
+                    self._strike(sub, e)
+            self._bump("resyncs", len(subs))
+            return n_ev
+        if not events:
+            return 0
+        changed, removed, cleared = _coalesce(events)
+        trace = TRACER.start_trace(
+            "subscribe.eval", type=st.type_name, subs=len(subs),
+            delta=len(changed) + len(removed))
+        status = "ok"
+        try:
+            if trace is not None:
+                with TRACER.scope(trace):
+                    with TRACER.span("subscribe.eval", type=st.type_name,
+                                     subs=len(subs)):
+                        consumed = self._fold(st, subs, version, changed,
+                                              removed, cleared)
+            else:
+                consumed = self._fold(st, subs, version, changed,
+                                      removed, cleared)
+        except Exception as e:  # noqa: BLE001 — taxonomy + retry contract
+            # infrastructure failure (device transfer, injected
+            # subscribe.eval fault): NOTHING was applied — keep the
+            # buffer so the next poll retries the whole window
+            # (exactly-once), surface through metrics + flight recorder
+            status = "error"
+            self._bump("eval_errors")
+            try:
+                from geomesa_tpu.utils.metrics import metrics
+
+                metrics.counter("subscribe.eval.errors")
+            except Exception:
+                pass
+            RECORDER.note_event("subscribe", action="eval_error",
+                                type=st.type_name,
+                                error=f"{type(e).__name__}: {e}")
+            return 0
+        finally:
+            if trace is not None:
+                RECORDER.record(trace.finish(status=status))
+        with st.buf_lock:
+            del st.buffer[:n_ev]
+        self._bump("folds")
+        return consumed
+
+    def _fold(self, st: _TypeState, subs, version, changed, removed,
+              cleared: bool) -> int:
+        sft = self.store.get_schema(st.type_name)
+        _EVAL_SITE.fire()
+        delta, dev, fids = self._delta_batch(sft, changed)
+        try:
+            pred, masks, bands, cells = (
+                self._eval_fused(st, sft, subs, version, delta, dev)
+                if delta is not None else (
+                    [s for s in subs if s.density is None], None, None,
+                    None))
+        except Exception as e:
+            if _infra_error(e):
+                # infrastructure answer (device transfer, raced read,
+                # injected transient), not a poisonous predicate: no
+                # state was applied — propagate so _pump_locked keeps
+                # the buffer and the next poll retries the window
+                raise
+            # a crashing fused kernel: degrade to per-subscription
+            # evaluation so the poisonous predicate is identified and
+            # struck while healthy subscriptions still fold this window
+            self._bump("fallbacks")
+            self._fold_fallback(st, sft, subs, delta, dev, fids,
+                                changed, removed, cleared)
+            return len(changed) + len(removed) + (1 if cleared else 0)
+        dens = [s for s in subs if s.density is not None]
+        # the per-subscription apply phase gets the same strike
+        # protection as the fallback path: a predicate that crashes
+        # only HERE (host-band refinement, density weights) must be
+        # struck and quarantined, not retried forever via the
+        # buffer-retaining outer except — and one crash must not cost
+        # the other subscriptions their window
+        for i, sub in enumerate(pred):
+            try:
+                if sub._resync_pending():
+                    self._resync(sub)
+                    continue
+                mask = self._refined_row(st, sub, masks, bands, i,
+                                         delta, fids)
+                self._apply_predicate(sub, fids, mask, removed, cleared)
+            except Exception as e:  # noqa: BLE001 — strike, don't spread
+                self._strike(sub, e)
+        for i, sub in enumerate(dens):
+            try:
+                if sub._resync_pending():
+                    self._resync(sub)
+                    continue
+                cell = None if cells is None else cells[i]
+                self._apply_density(sub, delta, fids, cell, removed,
+                                    cleared)
+            except Exception as e:  # noqa: BLE001 — strike, don't spread
+                self._strike(sub, e)
+        return len(changed) + len(removed) + (1 if cleared else 0)
+
+    def _refined_row(self, st, sub, masks, bands, i, delta, fids):
+        """One predicate's delta mask with f32 boundary-band rows
+        re-evaluated exactly in f64 on host (the planner's refinement
+        discipline, applied to just the delta)."""
+        if masks is None:
+            return np.zeros(0, bool)
+        n = len(fids)
+        mask = np.asarray(masks[i][:n]).copy()
+        band = np.asarray(bands[i][:n])
+        idx = np.nonzero(band)[0]
+        if len(idx):
+            from geomesa_tpu.cql.hosteval import eval_filter_host
+
+            sub_filter = self._filters[(st.type_name, sub.cql)]
+            mask[idx] = eval_filter_host(
+                sub_filter.filter_ast, delta.select(idx))
+        return mask
+
+    def _apply_predicate(self, sub: Subscription, fids, mask,
+                         removed, cleared: bool) -> None:
+        prev = sub.matched
+        new = set() if cleared else set(prev)
+        for fid in removed:
+            new.discard(fid)
+        for j, fid in enumerate(fids):
+            if mask[j]:
+                new.add(fid)
+            else:
+                new.discard(fid)
+        enters = sorted(new - prev)
+        exits = sorted(prev - new)
+        sub.matched = new
+        if enters:
+            sub.offer({"event": "enter", "fids": enters})
+            self._bump("events", len(enters))
+        if exits:
+            sub.offer({"event": "exit", "fids": exits})
+            self._bump("events", len(exits))
+
+    def _apply_density(self, sub: Subscription, delta, fids, cell,
+                       removed, cleared: bool) -> None:
+        d = sub.density
+        grid = sub.grid
+        changed_any = False
+        if cell is not None and len(fids):
+            rows, cols, inb = (np.asarray(c[: len(fids)]) for c in cell)
+            w = self._weights(d, delta)[: len(fids)]
+        exact = d.decay is None
+        # in-place grid/contrib mutation under the subscription lock:
+        # a racing flush (resync_frame after a lagged window) reads
+        # the grid under the same lock, so it never serializes a
+        # half-applied fold
+        with sub._lock:
+            if cleared:
+                if sub.contrib or grid.any():
+                    changed_any = True
+                grid[:] = 0.0
+                sub.contrib.clear()
+            if d.decay is not None and d.decay < 1.0:
+                grid *= d.decay
+                changed_any = changed_any or bool(grid.any())
+            for fid in removed:
+                old = sub.contrib.pop(fid, None)
+                if old is not None and exact:
+                    grid[old[0], old[1]] -= old[2]
+                    changed_any = True
+            if cell is not None and len(fids):
+                for j, fid in enumerate(fids):
+                    old = sub.contrib.pop(fid, None)
+                    if old is not None and exact:
+                        grid[old[0], old[1]] -= old[2]
+                        changed_any = True
+                    if inb[j]:
+                        grid[rows[j], cols[j]] += w[j]
+                        sub.contrib[fid] = (int(rows[j]), int(cols[j]),
+                                            float(w[j]))
+                        changed_any = True
+        if changed_any:
+            sub.offer({
+                "event": "density",
+                "total": float(grid.sum()),
+                "cells": int(np.count_nonzero(grid)),
+            })
+            self._bump("events")
+
+    # -- degraded per-subscription path ------------------------------------
+
+    def _fold_fallback(self, st, sft, subs, delta, dev, fids,
+                       changed, removed, cleared) -> None:
+        """Per-subscription evaluation after a fused-kernel crash: the
+        poisonous predicate is struck (and quarantined after the
+        configured strikes — docs/ROBUSTNESS.md); everything healthy
+        still folds this window exactly once."""
+        for sub in subs:
+            try:
+                if sub._resync_pending():
+                    self._resync(sub)
+                    continue
+                if sub.density is not None:
+                    cell = None
+                    if delta is not None and len(fids):
+                        rows, cols, inb = self._density_cells_host(
+                            sub.density, sft, delta)
+                        cell = (rows, cols, inb)
+                    self._apply_density(sub, delta, fids, cell,
+                                        removed, cleared)
+                else:
+                    if delta is not None and len(fids):
+                        f = self._filter_for(st.type_name, sub.cql, sft)
+                        mask = f.mask_refined(dev, delta)[: len(fids)]
+                    else:
+                        mask = np.zeros(0, bool)
+                    self._apply_predicate(sub, fids, mask, removed,
+                                          cleared)
+            except Exception as e:  # noqa: BLE001 — strike, don't spread
+                self._strike(sub, e)
+
+    def _strike(self, sub: Subscription, exc: BaseException) -> None:
+        if not self._quarantine_enabled or _infra_error(exc):
+            # no strike: quarantine is disabled (quarantine_after=0),
+            # or — the serving layer's exemption (serve/service.py) —
+            # the OSError family and transient failures are
+            # infrastructure answers, not predicate crashes, and an
+            # infra blip must not quarantine every standing
+            # subscription. State for THIS sub may be partially
+            # applied, so re-seed from the snapshot instead.
+            self._bump("eval_errors")
+            with sub._lock:
+                sub._resync = True
+            return
+        self._bump("strikes")
+        tripped = self.quarantine.strike(sub.fingerprint())
+        with sub._lock:
+            sub._resync = True  # survived strikes re-seed on next fold
+        RECORDER.note_event(
+            "subscribe", action="strike", subscription=sub.sub_id,
+            error=f"{type(exc).__name__}: {exc}")
+        if tripped:
+            self.registry.quarantine(sub.sub_id)
+            # quarantined subscriptions keep their state out of the
+            # evaluation set but stay in the table; stamp the
+            # quarantine TTL so an abandoned one is swept by
+            # expire_tick instead of leaking forever
+            with sub._lock:
+                ttl_at = sub.clock() + self.quarantine.ttl_s
+                sub.expires_at = (ttl_at if sub.expires_at is None
+                                  else min(sub.expires_at, ttl_at))
+            sub.offer({
+                "event": "quarantined",
+                "message": (f"predicate crashed evaluation "
+                            f"{self.quarantine.strikes}+ times: "
+                            f"{type(exc).__name__}"),
+            })
+            try:
+                from geomesa_tpu.utils.metrics import metrics
+
+                metrics.counter("subscribe.quarantined")
+            except Exception:
+                pass
+
+    def _resync(self, sub: Subscription) -> None:
+        """Re-seed a subscription that missed a fold (post-crash): the
+        buffered window was consumed for the healthy set, so this sub
+        rebuilds from the live snapshot and flags the client with a
+        lagged/state hand-off instead of silently diverging."""
+        self.bootstrap(sub)
+        with sub._lock:
+            sub._resync = False
+            sub.lagged = True
+        self._bump("resyncs")
+
+    # -- delta construction ------------------------------------------------
+
+    def _delta_batch(self, sft, changed: "dict[str, dict]"):
+        """Columnar delta: the window's changed rows as one pow2-padded
+        FeatureBatch + DeviceBatch (f32 coords — the serving dtype)."""
+        if not changed:
+            return None, None, []
+        from geomesa_tpu.core.columnar import FeatureBatch
+        from geomesa_tpu.engine.device import to_device
+
+        fids = list(changed)
+        data = {a.name: [changed[f].get(a.name) for f in fids]
+                for a in sft.attributes}
+        batch = FeatureBatch.from_pydict(sft, data, fids=fids)
+        padded = batch.pad_to(next_pow2(max(len(batch), _PAD_MIN)))
+        # gt: waive GT09
+        # (deliberate: delta upload under the per-type eval lock — the
+        # fold serialization boundary; see module docstring)
+        return padded, to_device(padded), fids
+
+
+def _coalesce(events: List[tuple]):
+    """Fold a window's FeatureEvents, in order, into (changed,
+    removed, cleared): latest-wins per fid, a Clear supersedes
+    everything before it (the cache state after the window is exactly
+    post-clear changes)."""
+    changed: Dict[str, dict] = {}
+    removed: Dict[str, None] = {}
+    cleared = False
+    for kind, fid, attrs in events:
+        if kind == "changed":
+            changed[fid] = attrs
+            removed.pop(fid, None)
+        elif kind == "removed":
+            changed.pop(fid, None)
+            removed[fid] = None
+        elif kind == "cleared":
+            changed.clear()
+            removed.clear()
+            cleared = True
+    return changed, list(removed), cleared
+
+
+def _pad_tables(params: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Pow2-pad the per-batch vocab tables (string-predicate allowed
+    tables) so their shapes repeat across deltas — padded entries are
+    False and unreachable (dictionary codes never index past the real
+    vocab)."""
+    out = {}
+    for k, v in params.items():
+        v = np.asarray(v)
+        if v.ndim == 1 and v.dtype == bool:
+            target = next_pow2(max(len(v), _TABLE_PAD_MIN))
+            if target > len(v):
+                v = np.concatenate(
+                    [v, np.zeros(target - len(v), bool)])
+        out[k] = v
+    return out
+
+
+def _geom_name(sft) -> str:
+    g = sft.default_geometry
+    if g is None:
+        raise ValueError(f"feature type {sft.name!r} has no geometry")
+    return g.name
+
+
+def _batch_fids(batch) -> List[str]:
+    if batch.fids is None:
+        return [str(i) for i in range(len(batch))]
+    return [str(f) for f in batch.fids.decode()]

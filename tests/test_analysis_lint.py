@@ -748,6 +748,96 @@ class TestGT16PipelineStageBlocking:
             assert not active([f for f in fs if f.rule == "GT16"])
 
 
+# -- GT17 -------------------------------------------------------------------
+
+
+class TestGT17ListenerBlocking:
+    """Blocking calls inside subscription listener/callback bodies
+    (docs/SERVING.md "Standing queries"): listeners run inside the
+    Kafka fold with the store lock held — they must only buffer."""
+
+    def _findings(self, src,
+                  relpath="geomesa_tpu/subscribe/evaluator.py"):
+        from geomesa_tpu.analysis.modinfo import ModInfo
+        from geomesa_tpu.analysis.rules import gt17
+
+        mod = ModInfo("/x.py", textwrap.dedent(src), relpath=relpath)
+        return list(gt17(mod, None))
+
+    DIRTY = """
+        import time
+
+        def on_feature_event(event):
+            with open("/tmp/log", "a") as f:
+                f.write(str(event))
+
+        def my_listener(event):
+            return fut.result()
+
+        def install(cache):
+            def hook(event):
+                time.sleep(0.1)
+                dev = to_device(event.batch)
+            cache.add_listener(hook)
+    """
+
+    def test_blocking_in_listeners_flagged(self):
+        found = self._findings(self.DIRTY)
+        assert sorted((f.rule, f.line) for f in found) == [
+            ("GT17", 5), ("GT17", 9), ("GT17", 13), ("GT17", 14)]
+
+    def test_clean_counterparts(self):
+        clean = """
+            def on_feature_event(event):
+                with buf_lock:
+                    buffer.append((event.kind, event.fid))
+
+            def pump(type_name):
+                # NOT a listener: the post-fold pump is where device
+                # work belongs
+                dev = to_device(batch)
+                out = jax.device_get(handle.call(dev))
+
+            def install(cache):
+                def hook(event):
+                    buffer.append(event)
+                cache.add_listener(hook)
+        """
+        assert self._findings(clean) == []
+
+    def test_scope_is_path_limited(self):
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/serve/service.py") == []
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/engine/device.py") == []
+
+    def test_kafka_scope_and_registration(self):
+        from geomesa_tpu.analysis.model import RULES
+        from geomesa_tpu.analysis.rules import ALL_RULES
+
+        assert "GT17" in RULES and "GT17" in ALL_RULES
+        # kafka/ is in scope: cache listener helpers are covered
+        found = self._findings(self.DIRTY,
+                               "geomesa_tpu/kafka/cache.py")
+        assert found
+
+    def test_waiver(self):
+        import pathlib
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            sub = pathlib.Path(td) / "geomesa_tpu" / "subscribe"
+            sub.mkdir(parents=True)
+            (sub / "x.py").write_text(textwrap.dedent("""
+                def on_event(e):
+                    # gt: waive GT17
+                    fut.result()
+            """))
+            fs = lint_paths([td], rules=["GT17"], extra_ref_paths=[])
+            assert any(f.rule == "GT17" and f.waived for f in fs)
+            assert not active([f for f in fs if f.rule == "GT17"])
+
+
 # -- self-lint --------------------------------------------------------------
 
 
