@@ -74,6 +74,13 @@ RULES: Dict[str, Rule] = {
                      "run inside the Kafka fold (store lock held) — "
                      "they must only buffer; evaluation belongs in the "
                      "post-fold pump (subscribe/evaluator.py)"),
+        Rule("GT18", "per-device placement bypassing NamedSharding: a "
+                     "device_put/to_device loop over jax.devices() (or "
+                     "an alias), or jax.devices()[i] indexing, in "
+                     "serve//plan/ scope — sharded serving places data "
+                     "ONCE via NamedSharding over the mesh; ad-hoc "
+                     "per-chip placement breaks tile ownership and "
+                     "forces per-dispatch reshards"),
     )
 }
 

@@ -971,6 +971,115 @@ def gt17(mod: ModInfo, project) -> Iterator[Finding]:
                 f"deliberate block")
 
 
+# GT18 scope: the serve and plan layers (serve/, plan/). The sharded
+# serving contract (docs/SERVING.md "Sharded serving") is that data
+# placement happens ONCE, declaratively, via NamedSharding — the mesh
+# superbatch upload, the stager's replicated slots, the planner's
+# row-sharding re-pins. Hand-rolled per-device placement — a loop
+# device_put-ing slices onto each chip, or `jax.devices()[i]` indexing
+# to pick a chip ad hoc — bypasses that: XLA can no longer fuse the
+# transfer, ownership stops matching the DeviceCacheManager's recorded
+# tile map, and the AOT executables' parameter shardings stop matching
+# the data (a silent per-dispatch reshard). The shard-affinity route
+# picks its chip from the superbatch's OWNERSHIP map (mesh.devices),
+# which this rule deliberately does not match. Waivable inline for a
+# documented deliberate placement; the shipped tree is clean.
+_GT18_PREFIXES = ("geomesa_tpu/serve/", "geomesa_tpu/plan/")
+_GT18_DEVICES_FNS = {"devices", "local_devices"}
+_GT18_TRANSFER_FNS = {"device_put", "to_device"}
+
+
+def _gt18_devices_call(node: ast.AST) -> bool:
+    """True for `jax.devices()` / `jax.local_devices()` (attribute or
+    bare-name call form — `from jax import devices` included)."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = _callee_name(node)
+    return name in _GT18_DEVICES_FNS
+
+
+def gt18(mod: ModInfo, project) -> Iterator[Finding]:
+    """GT18: per-device placement that bypasses NamedSharding
+    (serve//plan/ scope).
+
+    Two shapes: (a) a `for`/`while` loop over a device list (the
+    iterable mentions `jax.devices()`/`local_devices()` or an alias
+    assigned from one, or the loop target is named `dev`/`device`)
+    whose body calls `device_put`/`to_device` — the per-chip upload
+    loop `parallel.mesh.shard_device_batch` exists to replace; and
+    (b) subscripting a `jax.devices()`/`local_devices()` call or an
+    alias of one (`jax.devices()[0]`, `devs = jax.devices();
+    devs[i]`) — ad-hoc chip selection that ignores the mesh and the
+    cache's tile-ownership map. Both waivable inline
+    (`# gt: waive GT18`) for a documented deliberate placement."""
+    path = mod.relpath.replace("\\", "/")
+    if not any(p in path for p in _GT18_PREFIXES):
+        return
+    # alias forms: names assigned (anywhere in the module) from a
+    # devices() call — `devs = jax.devices()` — tracked by name; the
+    # serve/plan modules are small enough that scope-insensitive
+    # aliasing stays precise (no false positives on the shipped tree)
+    aliases: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Assign)
+                and _gt18_devices_call(node.value)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    aliases.add(t.id)
+        elif (isinstance(node, (ast.AnnAssign, ast.NamedExpr))
+                and node.value is not None
+                and _gt18_devices_call(node.value)):
+            t = node.target
+            if isinstance(t, ast.Name):
+                aliases.add(t.id)
+
+    def mentions_devices(expr: ast.AST) -> bool:
+        for n in ast.walk(expr):
+            if _gt18_devices_call(n):
+                return True
+            if isinstance(n, ast.Name) and n.id in aliases:
+                return True
+        return False
+
+    seen: Set[int] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.For):
+            targets = _names_in(node.target)
+            dev_loop = (mentions_devices(node.iter)
+                        or targets & {"dev", "device"})
+            if not dev_loop:
+                continue
+            for inner in ast.walk(node):
+                if (isinstance(inner, ast.Call)
+                        and _callee_name(inner) in _GT18_TRANSFER_FNS
+                        and inner.lineno not in seen):
+                    seen.add(inner.lineno)
+                    yield _finding(
+                        "GT18", mod, inner,
+                        f"per-device {_callee_name(inner)} loop: "
+                        f"placement belongs to ONE NamedSharding "
+                        f"device_put (parallel.mesh.shard_device_batch "
+                        f"/ store.cache mesh superbatch) — a per-chip "
+                        f"upload loop bypasses the recorded tile "
+                        f"ownership and cannot fuse; waive a "
+                        f"documented deliberate placement")
+        elif isinstance(node, ast.Subscript):
+            v = node.value
+            # jax.devices()[i] directly, or an alias devs[i]
+            direct = _gt18_devices_call(v)
+            aliased = isinstance(v, ast.Name) and v.id in aliases
+            if (direct or aliased) and node.lineno not in seen:
+                seen.add(node.lineno)
+                yield _finding(
+                    "GT18", mod, node,
+                    "jax.devices()[i] indexing: ad-hoc chip selection "
+                    "ignores the serving mesh and the device cache's "
+                    "tile-ownership map — place data with NamedSharding "
+                    "over parallel.mesh (shard-affinity routes read "
+                    "ownership from the superbatch); waive a documented "
+                    "deliberate selection")
+
+
 from geomesa_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES)
 
@@ -978,6 +1087,6 @@ ALL_RULES = {
     "GT01": gt01, "GT02": gt02, "GT03": gt03,
     "GT04": gt04, "GT05": gt05, "GT06": gt06,
     "GT13": gt13, "GT14": gt14, "GT15": gt15, "GT16": gt16,
-    "GT17": gt17,
+    "GT17": gt17, "GT18": gt18,
     **CONCURRENCY_RULES,
 }

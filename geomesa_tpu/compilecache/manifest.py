@@ -56,6 +56,12 @@ def encode_arg(v) -> dict:
         return {"shape": [int(s) for s in v.shape], "dtype": str(v.dtype)}
     if v is None or isinstance(v, (bool, int, float, str)):
         return {"static": v}
+    if isinstance(v, tuple) and all(
+            isinstance(e, (bool, int, float, str)) for e in v):
+        # scalar-tuple statics (the sharded density program's bbox):
+        # tagged so decode restores the tuple — jit static hashing
+        # distinguishes tuple from list
+        return {"static_tuple": list(v)}
     raise UnrecordableArg(f"cannot record argument of type {type(v)!r}")
 
 
@@ -64,6 +70,8 @@ def decode_arg(d: dict):
         import jax.numpy as jnp
 
         return jnp.zeros(tuple(d["shape"]), jnp.dtype(d["dtype"]))
+    if "static_tuple" in d:
+        return tuple(d["static_tuple"])
     return d["static"]
 
 

@@ -200,6 +200,33 @@ class ExecutableRegistry:
         metrics.counter("compilecache.serve.variants")
         return vname
 
+    # -- mesh tier (docs/SERVING.md "Sharded serving") ---------------------
+
+    MESH_PREFIX = "@mesh"
+
+    def mesh_variant(self, name: str, mesh, fn,
+                     static_argnames: Sequence[str] = ()) -> str:
+        """Register (idempotently) the mesh-sharded variant of `name`
+        and return its registry key (`<name>@mesh(D,)`).
+
+        Sharded programs close over their Mesh (shard_map), so the
+        executable is only valid for one device topology: the mesh
+        shape joins the registry KEY — `(kernel, bucket, dtype,
+        mesh_shape)` — and a single-chip lookup can never answer a
+        sharded dispatch (or vice versa). Warm sharded serving therefore
+        compiles nothing: `gmtpu warmup --check` sees the mesh-keyed
+        entries AOT-compiled exactly like the serial kernels."""
+        shape = tuple(int(s) for s in mesh.devices.shape)
+        vname = f"{name}{self.MESH_PREFIX}{shape}"
+        with self._lock:
+            if vname in self._kernels:
+                return vname
+        from geomesa_tpu.utils.metrics import metrics
+
+        self.register(vname, fn, static_argnames=static_argnames)
+        metrics.counter("compilecache.mesh.variants")
+        return vname
+
     # -- compilation -------------------------------------------------------
 
     def compile(self, name: str, *args, **kwargs) -> CompiledHandle:
@@ -266,9 +293,16 @@ class ExecutableRegistry:
 
         def arg(d):
             if "shape" in d:
+                # abstract, not decode_arg's jnp.zeros: lowering only
+                # needs the aval, never a real allocation
                 return jax.ShapeDtypeStruct(
                     tuple(d["shape"]), jax.numpy.dtype(d["dtype"]))
-            return d["static"]
+            from geomesa_tpu.compilecache.manifest import decode_arg
+
+            # statics (incl. static_tuple) share ONE decoder with the
+            # replay path — a new static encoding lands in both or
+            # neither
+            return decode_arg(d)
 
         return self.compile(
             name, *[arg(a) for a in entry.args],

@@ -193,6 +193,31 @@ def density_sharded(
     return run(x, y, weights, mask)
 
 
+def make_density_sharded(mesh: Mesh):
+    """Registry-compatible builder of the sharded density program
+    (docs/SERVING.md "Sharded serving"): per-shard scatter-add + one
+    psum over ICI, with bbox/width/height as static arguments so the
+    serve path AOT-compiles one executable per (grid, bucket,
+    mesh_shape) key instead of retracing the eager `density_sharded`
+    closure on every query."""
+
+    def run(x, y, weights, mask, bbox, width, height):
+        @functools.partial(
+            _shard_map,
+            mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                      P(SHARD_AXIS)),
+            out_specs=P(),
+        )
+        def body(x, y, w, m):
+            g = density_grid(x, y, w, m, bbox, width, height)
+            return jax.lax.psum(g, SHARD_AXIS)
+
+        return body(x, y, weights, mask)
+
+    return run
+
+
 @functools.partial(jax.jit, static_argnames=("radius_pixels",))
 def gaussian_blur(grid: jax.Array, radius_pixels: int) -> jax.Array:
     """Separable gaussian spread (DensityProcess radiusPixels analog)."""

@@ -652,6 +652,135 @@ def knn_sparse_sharded(
     return run(qx, qy, dx, dy, mask)
 
 
+def shard_match_tiles(mask: jax.Array, n_shards: int,
+                      data_tile: int = DATA_TILE) -> jax.Array:
+    """MAX over shards of the per-shard match-bearing tile count — the
+    serve mesh path's capacity calibration input (one i32 scalar crosses
+    the tunnel, exactly like `count_match_tiles` on the serial path).
+    Each shard pads its rows to `data_tile` independently inside
+    `knn_sparse_scan`, so the per-shard tiling here mirrors that."""
+    n = mask.shape[0]
+    s = n // n_shards
+    pad = (-s) % data_tile
+    m = mask.astype(jnp.int32).reshape(n_shards, s)
+    if pad:
+        m = jnp.pad(m, ((0, 0), (0, pad)))
+    per_shard = jnp.sum(
+        (m.reshape(n_shards, -1, data_tile).max(axis=2) > 0)
+        .astype(jnp.int32), axis=1)
+    return jnp.max(per_shard)
+
+
+def _shard_merge_topk(fd, fi, shard_n: int, k: int):
+    """The mesh-serving merge epilogue, shared by the sparse program
+    and its fullscan overflow fallback (a divergence here would break
+    the bit-identity contract exactly on the rarely-taken overflow
+    path): local indices lift to global (`local + shard * shard_n` —
+    the mesh superbatch keeps the serial layout, so the global index
+    IS the serial index), every shard's top-k pools via all_gather,
+    and one re-top-k picks the global k-smallest."""
+    import jax
+
+    from geomesa_tpu.engine.knn import _topk_smallest
+    from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+    shard = jax.lax.axis_index(SHARD_AXIS)
+    gidx = fi + shard * shard_n
+    all_d = jax.lax.all_gather(fd, SHARD_AXIS)
+    all_i = jax.lax.all_gather(gidx, SHARD_AXIS)
+    pool_d = jnp.moveaxis(all_d, 0, 1).reshape(fd.shape[0], -1)
+    pool_i = jnp.moveaxis(all_i, 0, 1).reshape(fd.shape[0], -1)
+    md, mi = _topk_smallest(pool_d, k)
+    gi = jnp.take_along_axis(pool_i, mi, axis=1)
+    return md, gi
+
+
+def make_knn_serve_sharded(mesh):
+    """Build the mesh-serving kNN program for `mesh` (docs/SERVING.md
+    "Sharded serving"): ONE shard_map program in which every chip runs
+    `knn_sparse_scan` over its own resident rows, per-shard top-ks merge
+    via all_gather + re-top-k, the overflow flags OR-reduce, and (when
+    `want_count` is set) the cross-kind fused COUNT psum-reduces over
+    ICI — the paper's "batched JAX reductions with psum over ICI"
+    shape. Global indices are `local + shard * shard_rows`, which under
+    the mesh superbatch's serial-layout contract makes results
+    bit-identical to the single-chip kernel (tests/test_mesh_serve.py).
+
+    Returns a plain callable suitable for ExecutableRegistry
+    registration (`registry.mesh_variant`); statics are keyword-only so
+    the AOT key covers (bucket, dtype, k, capacity, mesh shape)."""
+    from jax.sharding import PartitionSpec as P
+
+    from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+    d_count = int(mesh.devices.size)
+
+    def run(qx, qy, x, y, mask, k, tile_capacity, m_blocks,
+            want_count, interpret):
+        shard_n = x.shape[0] // d_count
+
+        @functools.partial(
+            _shard_map,
+            mesh=mesh,
+            in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS),
+                      P(SHARD_AXIS)),
+            out_specs=((P(), P(), P(), P()) if want_count
+                       else (P(), P(), P())),
+            check_vma=False,  # post-gather re-top-k replicated
+        )
+        def body(qx, qy, lx, ly, lm):
+            fd, fi, ov = knn_sparse_scan(
+                qx, qy, lx, ly, lm, k=k, tile_capacity=tile_capacity,
+                m_blocks=m_blocks, interpret=interpret,
+            )
+            md, gi = _shard_merge_topk(fd, fi, shard_n, k)
+            ov_any = jnp.any(jax.lax.all_gather(ov, SHARD_AXIS))
+            if want_count:
+                cnt = jax.lax.psum(
+                    jnp.sum(lm, dtype=jnp.int64), SHARD_AXIS)
+                return md, gi, ov_any, cnt
+            return md, gi, ov_any
+
+        return body(qx, qy, x, y, mask)
+
+    return run
+
+
+def make_knn_fullscan_sharded(mesh):
+    """Dense mesh fallback for `make_knn_serve_sharded`'s overflow
+    contract: each chip runs the exact `knn_fullscan` over its rows;
+    the merge is identical. Per-pair distances are the same f32
+    haversine the serial fallback computes, so the overflow path stays
+    bit-identical too."""
+    from jax.sharding import PartitionSpec as P
+
+    from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+    d_count = int(mesh.devices.size)
+
+    def run(qx, qy, x, y, mask, k, m_blocks, interpret):
+        shard_n = x.shape[0] // d_count
+
+        @functools.partial(
+            _shard_map,
+            mesh=mesh,
+            in_specs=(P(), P(), P(SHARD_AXIS), P(SHARD_AXIS),
+                      P(SHARD_AXIS)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        def body(qx, qy, lx, ly, lm):
+            fd, fi = knn_fullscan(
+                qx, qy, lx, ly, lm, k=k, m_blocks=m_blocks,
+                interpret=interpret,
+            )
+            return _shard_merge_topk(fd, fi, shard_n, k)
+
+        return body(qx, qy, x, y, mask)
+
+    return run
+
+
 def knn_fullscan_tiled(
     qx: jax.Array,
     qy: jax.Array,

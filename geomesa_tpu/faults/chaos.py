@@ -88,16 +88,19 @@ class ChaosReport:
         return doc
 
 
-def _synth_store(root: str, n: int = 384, seed: int = 5):
+def _synth_store(root: str, n: int = 384, seed: int = 5,
+                 use_device_cache: bool = False):
     """A small FS store on the SCAN path (no device cache): every query
-    re-reads partition files, so storage faults keep biting."""
+    re-reads partition files, so storage faults keep biting. The mesh
+    phase flips `use_device_cache` on — mesh residency is a device-cache
+    tier."""
     from geomesa_tpu.core.sft import SimpleFeatureType
     from geomesa_tpu.plan.datastore import DataStore
 
     rng = np.random.default_rng(seed)
     sft = SimpleFeatureType.from_spec(
         "chaos", "name:String,score:Double,dtg:Date,*geom:Point")
-    store = DataStore(root, use_device_cache=False)
+    store = DataStore(root, use_device_cache=use_device_cache)
     src = store.create_schema(sft)
     src.write(_synth_batch(sft, rng, n))
     return store, sft
@@ -233,6 +236,12 @@ def _run_workload(plan: FaultPlan, root: str, requests: int,
     # heals (offset-pinned fold + retained delta buffer). Own harness
     # scope; fires append to the replay-diffed log.
     log += _subscribe_phase(plan, report, say)
+    # sharded-serving phase: a single-shard device.transfer outage
+    # during a sharded window fails only that window — typed — while
+    # the mesh keeps dispatching ONE-program windows (the breaker/
+    # retry fabric is per-dependency, not a per-chip meltdown). Own
+    # harness scope; fires append to the replay-diffed log.
+    log += _mesh_phase(plan, root, report, say)
     say(f"workload: {report.ok}/{report.requests} ok, "
         f"typed={sum(report.typed_errors.values())}, "
         f"untyped={len(report.untyped_errors)}, "
@@ -477,6 +486,109 @@ def _subscribe_phase(plan: FaultPlan, report: ChaosReport,
     say(f"subscribe phase: {len(frames)} frames, matched oracle ok, "
         f"fires={len(blog)}")
     return blog
+
+
+# sharded-serving phase shape (docs/SERVING.md "Sharded serving"): 6
+# singleton kNN windows through the pipelined MESH service (auto mesh
+# over every local device, mesh residency on). Each window's only
+# device.transfer call is its staged query upload, so window 3's
+# transfer faulted through all 3 retry attempts = in-harness calls
+# 3, 4, 5 at the site — modelling one shard's host->device tunnel
+# dropping mid-window.
+_MESH_REQUESTS = 6
+_MESH_FAULT_CALLS = (3, 4, 5)
+
+
+def _mesh_phase(plan: FaultPlan, root: str, report: ChaosReport,
+                say) -> List[tuple]:
+    """A single-shard device.transfer outage during a SHARDED window
+    fails only that window — typed — and the mesh keeps serving: the
+    breaker/retry fabric applies per-dependency, never as a per-chip
+    meltdown (no degrade to single-chip, no dead dispatcher). Own
+    harness scope; fires append to the replay-diffed log."""
+    import jax
+
+    from geomesa_tpu.faults.plan import FaultRule
+    from geomesa_tpu.serve.loadgen import mesh_dispatch_count
+    from geomesa_tpu.serve.service import QueryService, ServeConfig
+
+    if len(jax.devices()) < 2:
+        say("mesh phase: skipped (single device — no mesh to shard)")
+        return []
+    store, sft = _synth_store(os.path.join(root, "mesh"), n=384,
+                              seed=plan.seed + 41, use_device_cache=True)
+    rng = np.random.default_rng(plan.seed + 43)
+    qpts = rng.uniform(-60, 60, (_MESH_REQUESTS, 2))
+    cql = "BBOX(geom, -170, -80, 170, 80)"
+    svc = QueryService(store, ServeConfig(
+        max_wait_ms=0.0, max_batch=1, drain_timeout_s=30.0,
+        mesh="auto"))
+    mesh_d = int(svc.mesh.devices.size) if svc.mesh is not None else 0
+    mesh_plan = FaultPlan(
+        seed=plan.seed + 47,
+        rules=[FaultRule(site="device.transfer", error="unavailable",
+                         nth_call=c) for c in _MESH_FAULT_CALLS])
+
+    try:
+        # warm OUTSIDE the harness: the mesh program compile, the
+        # sharded residency upload, and the stager's first slot must
+        # not consume injected calls (replay determinism)
+        svc.knn("chaos", cql, qpts[0:1, 0], qpts[0:1, 1],
+                k=5, timeout_ms=60_000).result(120)
+        base_mesh = mesh_dispatch_count()
+        ok = typed = 0
+        with _harness.active(mesh_plan) as h:
+            futs = [svc.knn("chaos", cql, qpts[i:i + 1, 0],
+                            qpts[i:i + 1, 1], k=5, timeout_ms=60_000)
+                    for i in range(_MESH_REQUESTS)]
+            for f in futs:
+                report.requests += 1
+                try:
+                    f.result(timeout=120)
+                    ok += 1
+                    report.ok += 1
+                except Exception as e:  # noqa: BLE001 — taxonomy decides
+                    if _errors.is_typed(e):
+                        typed += 1
+                        key = type(e).__name__
+                        report.typed_errors[key] = (
+                            report.typed_errors.get(key, 0) + 1)
+                    else:
+                        report.untyped_errors.append(
+                            f"mesh: {type(e).__name__}: {e}")
+            svc.close(drain=True)
+            blog = h.fire_log()
+        if len(blog) != len(_MESH_FAULT_CALLS):
+            report.invariant_failures.append(
+                f"mesh phase: expected {len(_MESH_FAULT_CALLS)} "
+                f"device.transfer fires, saw {len(blog)}")
+        if typed != 1 or ok != _MESH_REQUESTS - 1:
+            report.invariant_failures.append(
+                f"mesh phase: the faulted sharded window must fail "
+                f"alone and typed (ok={ok}, typed={typed} of "
+                f"{_MESH_REQUESTS})")
+        # no per-chip meltdown: every surviving window still ran the
+        # ONE-program mesh route (the outage neither wedged the mesh
+        # nor silently degraded the service to single-chip)
+        # the shared route counter (whole-mesh + shard-affinity
+        # local windows — loadgen reports topology off the same
+        # signal, so the two can never disagree)
+        survived = mesh_dispatch_count() - base_mesh
+        if survived != _MESH_REQUESTS - 1:
+            report.invariant_failures.append(
+                f"mesh phase: expected {_MESH_REQUESTS - 1} sharded "
+                f"dispatches around the outage, saw {survived:.0f}")
+        if svc._worker is not None and svc._worker.is_alive():
+            report.invariant_failures.append(
+                "mesh phase: dispatch thread alive after drain")
+        say(f"mesh phase: {ok} ok / {typed} typed over a {mesh_d}-chip "
+            f"mesh, fires={len(blog)}")
+        return blog
+    finally:
+        try:
+            svc.close(drain=False)
+        except Exception:
+            pass
 
 
 def _drive(plan, root, requests, report, svc, store, sft, kstore, ksrc,

@@ -27,8 +27,73 @@ def default_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (SHARD_AXIS,))
 
 
+def serve_mesh(spec="auto", devices=None) -> Optional[Mesh]:
+    """Resolve a `ServeConfig.mesh` spec to a serving mesh, or None for
+    the single-chip path.
+
+    spec: None/"off"/1 -> None (single-chip);
+          "auto"       -> all local devices when more than one exists,
+                          else None (the satellite default: single-chip
+                          on 1 device, sharded on >1);
+          N (int/str)  -> the first N devices (ValueError if fewer);
+          a Mesh       -> passed through.
+    """
+    if spec is None or isinstance(spec, Mesh):
+        return spec
+    if isinstance(spec, str):
+        s = spec.strip().lower()
+        if s in ("off", "none", "", "1"):
+            return None
+        if s == "auto":
+            devs = devices if devices is not None else jax.devices()
+            return default_mesh(devs) if len(devs) > 1 else None
+        try:
+            spec = int(s)
+        except ValueError:
+            raise ValueError(
+                f"mesh spec must be auto|N|off, got {spec!r}") from None
+    if spec <= 1:
+        return None
+    devs = list(devices if devices is not None else jax.devices())
+    if len(devs) < spec:
+        raise ValueError(
+            f"mesh={spec} requested but only {len(devs)} device(s) "
+            f"available")
+    return default_mesh(devs[:spec])
+
+
 def replicated(mesh: Mesh, x):
     return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def shard_view(arr, shard: int, shard_rows: int, device=None):
+    """The device-local view of one shard's rows of a mesh-sharded (or
+    replicated) array — zero-copy when a local shard on `device` covers
+    the row range (`addressable_shards` lookup), a gathered slice
+    otherwise. Used by the shard-affinity serve route: a window whose
+    tiles all live on one chip runs a single-device kernel against that
+    chip's resident rows instead of a whole-mesh program. For a
+    replicated array (P() placement) the `device` replica is returned
+    whole, so staged query buffers resolve to the owning chip's copy."""
+    lo = shard * shard_rows
+    try:
+        for s in arr.addressable_shards:
+            if device is not None and s.device != device:
+                continue
+            idx = s.index[0] if s.index else slice(None)
+            start = idx.start or 0
+            stop = idx.stop if idx.stop is not None else arr.shape[0]
+            if start <= lo and lo + shard_rows <= stop:
+                data = s.data
+                if start != lo or stop != lo + shard_rows:
+                    data = data[lo - start:lo - start + shard_rows]
+                return data
+    except Exception:
+        pass
+    # fallback (unexpected layout): a cross-device slice — slower,
+    # never wrong
+    out = arr[lo:lo + shard_rows]
+    return jax.device_put(out, device) if device is not None else out
 
 
 def shard_device_batch(dev: DeviceBatch, mesh: Mesh) -> DeviceBatch:

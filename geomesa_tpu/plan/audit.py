@@ -75,6 +75,14 @@ class ServeEvent:
     # ServeEvent is the root span's summary — an audit-log latency
     # outlier joins its flight-recorder flame view on this key.
     trace_id: str = ""
+    # sharded serving (docs/SERVING.md "Sharded serving"): the device
+    # topology the window executed on ("" = single-chip, "(4,)" = a
+    # 4-chip mesh) and which shards owned the window's tiles ("0,2" —
+    # a single id means the shard-affinity route ran the window on that
+    # chip alone). A per-shard latency regression slices the audit log
+    # on these.
+    mesh_shape: str = ""
+    shards: str = ""
     user: str = ""
     timestamp: float = 0.0
 

@@ -113,7 +113,9 @@ class DataStore:
     def _planner(self, storage) -> QueryPlanner:
         from geomesa_tpu.plan.interceptor import load_interceptors
 
-        planner = QueryPlanner(storage, self.audit, self.mesh)
+        with self._lock:
+            mesh = self.mesh
+        planner = QueryPlanner(storage, self.audit, mesh)
         planner.interceptors.extend(load_interceptors(storage.sft))
         if self.use_device_cache:
             from geomesa_tpu.store.cache import DeviceCacheManager
@@ -121,9 +123,23 @@ class DataStore:
             # same coord dtype as the scan path, else cached/scan results
             # diverge for points near predicate boundaries
             planner.cache = DeviceCacheManager(
-                storage, coord_dtype=planner.coord_dtype
+                storage, coord_dtype=planner.coord_dtype, mesh=mesh
             )
         return planner
+
+    def set_mesh(self, mesh) -> None:
+        """Install a serving mesh on this store: new sources pick it up
+        at planner construction, existing sources re-tier their device
+        cache on the next superbatch build (docs/SERVING.md "Sharded
+        serving"). QueryService calls this when ServeConfig.mesh
+        resolves to a mesh."""
+        with self._lock:
+            self.mesh = mesh
+            sources = list(self._sources.values())
+        for src in sources:
+            src.planner.mesh = mesh
+            if src.planner.cache is not None:
+                src.planner.cache.set_mesh(mesh)
 
     def get_type_names(self) -> List[str]:
         out = []

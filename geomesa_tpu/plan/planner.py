@@ -555,7 +555,7 @@ class QueryPlanner:
             token = query_mask_token(query) + (tuple(sorted(plan.partitions)),)
             grid = density_device_grid(
                 self.storage.sft, sb.batch, sb.dev, dev_mask, hints,
-                mask_token=token,
+                mask_token=token, mesh=getattr(sb, "mesh", None),
             )
             total = int(np.asarray(jnp.sum(dev_mask, dtype=jnp.int32)))
             if total == 0:
@@ -747,6 +747,7 @@ class QueryPlanner:
                 fused=want_mask_count,
             )
 
+        sb = None
         if self.cache is not None:
             with TRACER.span("residency"):
                 self.cache.ensure(plan.partitions, manifest=plan.manifest)
@@ -824,6 +825,18 @@ class QueryPlanner:
 
         x = dev[f"{g.name}__x"]
         y = dev[f"{g.name}__y"]
+        kk = min(k, x.shape[0])
+        mb = max(64, kk)
+        interp = default_interpret()
+        if sb is not None and getattr(sb, "mesh", None) is not None:
+            # mesh-resident serving route (docs/SERVING.md "Sharded
+            # serving"): the coalesced window executes as ONE sharded
+            # program across the mesh — or, when every allowed
+            # partition's rows live on a single chip (shard affinity),
+            # as a single-device kernel on that chip
+            return self._knn_launch_mesh(
+                plan, sb, qx, qy, k, kk, mb, interp, mask, batch,
+                staged=staged, want_mask_count=want_mask_count)
         if staged is not None:
             # pipeline transfer stage already put the (padded, f32)
             # query arrays on device — the values are identical to the
@@ -832,9 +845,6 @@ class QueryPlanner:
         else:
             jqx = jnp.asarray(np.asarray(qx), jnp.float32)
             jqy = jnp.asarray(np.asarray(qy), jnp.float32)
-        kk = min(k, x.shape[0])
-        mb = max(64, kk)
-        interp = default_interpret()
         count_dev = None
         if want_mask_count:
             # cross-kind fusion: a count against the same (type, CQL,
@@ -849,11 +859,7 @@ class QueryPlanner:
             # band-free filters alike.
             count_dev = jnp.sum(mask, dtype=jnp.int64)
         launch = KnnLaunch(self, k=k, kk=kk, impl=impl, batch=batch,
-                           count_dev=count_dev)
-        with self._mutex:
-            caps = getattr(self, "_knn_caps", None)
-            if caps is None:
-                caps = self._knn_caps = {}
+                           count_dev=count_dev, hq=_host_q(qx, qy))
         if impl == "auto":
             impl = launch.impl = self._knn_impl_from_stats(plan)
         if impl == "sparse":
@@ -862,10 +868,7 @@ class QueryPlanner:
             # bbox and simply recalibrate — a stale cap is never wrong,
             # only overflow-fallback slow or dead-program wasteful
             key = (ast.to_cql(plan.filter), kk)
-            with self._mutex:
-                if key not in caps and len(caps) > 256:
-                    caps.clear()  # bound memory on adversarial streams
-                seed_cap = caps.get(key)
+            seed_cap = self._caps_seed(key)
             with TRACER.span("kernel.dispatch", kernel="knn_sparse",
                              q=int(jqx.shape[0]), k=kk):
                 if seed_cap is None:
@@ -934,6 +937,186 @@ class QueryPlanner:
             static_argnames=tuple(statics))
         handle = registry.compile(vname, *args, **statics)
         return handle.call(*args)
+
+    def _caps_seed(self, key):
+        """Lazily create the sparse-capacity cache and return the
+        cached seed for `key` (None = cold, calibrate). One policy for
+        every dispatch route (serial / whole-mesh / shard-affinity):
+        a miss against an oversized cache clears it, bounding memory
+        on adversarial query streams — a dropped cap is never wrong,
+        only recalibration-slow. Write-back stays with the launches'
+        sync paths (same `_mutex`)."""
+        with self._mutex:
+            caps = getattr(self, "_knn_caps", None)
+            if caps is None:
+                caps = self._knn_caps = {}
+            if key not in caps and len(caps) > 256:
+                caps.clear()
+            return caps.get(key)
+
+    def _knn_launch_mesh(self, plan, sb, qx, qy, k, kk, mb, interp,
+                         mask, batch, staged=None,
+                         want_mask_count: bool = False) -> "KnnLaunch":
+        """Mesh dispatch seam: one pjit/shard_map program across every
+        chip of the superbatch's mesh — per-shard `knn_sparse_scan`,
+        all_gather top-k merge, psum'd fused count — AOT-managed under
+        a mesh-keyed ExecutableRegistry entry `(kernel, bucket, dtype,
+        mesh_shape)` so a warm sharded process compiles nothing.
+        Results are bit-identical to the single-chip path: the mesh
+        superbatch keeps the serial row layout (store/cache.py), the
+        per-pair f32 haversine is the same arithmetic, and the merged
+        top-k is the same ascending k-smallest set.
+
+        Shard affinity: when every allowed partition's rows live on ONE
+        chip, the window skips the collective program entirely and runs
+        the serial sparse kernel against that chip's resident rows —
+        the query lands where its tiles live."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from geomesa_tpu.compilecache.registry import registry
+        from geomesa_tpu.engine.knn_scan import (
+            capacity_bucket, make_knn_fullscan_sharded,
+            make_knn_serve_sharded, shard_match_tiles)
+        from geomesa_tpu.parallel.mesh import SHARD_AXIS
+        from geomesa_tpu.utils.metrics import metrics
+
+        mesh = sb.mesh
+        d = int(mesh.devices.size)
+        mesh_shape = tuple(int(s) for s in mesh.devices.shape)
+        shards = sb.shards_for(plan.partitions)
+        if len(shards) == 1:
+            return self._knn_launch_local(
+                plan, sb, qx, qy, k, kk, mb, interp, mask, batch,
+                shards[0], staged=staged,
+                want_mask_count=want_mask_count)
+        g = self.storage.sft.default_geometry
+        x = sb.dev[f"{g.name}__x"]
+        y = sb.dev[f"{g.name}__y"]
+        rep = NamedSharding(mesh, P())
+        row = NamedSharding(mesh, P(SHARD_AXIS))
+        if staged is not None:
+            # re-pin like the mask below: a no-op when the pipeline
+            # staged onto THIS mesh (the normal case), and the guard
+            # that keeps a window straddling a set_mesh() from feeding
+            # a stale placement to the mesh executable
+            jqx = jax.device_put(staged[0], rep)
+            jqy = jax.device_put(staged[1], rep)
+        else:
+            jqx = jax.device_put(
+                jnp.asarray(np.asarray(qx), jnp.float32), rep)
+            jqy = jax.device_put(
+                jnp.asarray(np.asarray(qy), jnp.float32), rep)
+        # the mask came out of SPMD elementwise/scatter ops — re-pin the
+        # row sharding so the AOT executable's parameter layout always
+        # matches (a no-op when XLA already kept it sharded)
+        mask = jax.device_put(mask, row)
+        key = (ast.to_cql(plan.filter), kk, ("mesh",) + mesh_shape)
+        seed_cap = self._caps_seed(key)
+        shard_list = ",".join(map(str, shards))
+        with TRACER.span("kernel.dispatch", kernel="knn_mesh",
+                         q=int(jqx.shape[0]), k=kk, mesh=d,
+                         shards=shard_list):
+            if seed_cap is None:
+                # calibration: MAX per-shard match tiles — one scalar
+                # sync on a cold (filter, k, mesh) key, cached after
+                seed_cap = capacity_bucket(int(np.asarray(
+                    shard_match_tiles(mask, d))))
+            vname = registry.mesh_variant(
+                "knn_scan.knn_serve_sharded", mesh,
+                fn=make_knn_serve_sharded(mesh),
+                static_argnames=("k", "tile_capacity", "m_blocks",
+                                 "want_count", "interpret"))
+            handle = registry.compile(
+                vname, jqx, jqy, x, y, mask, k=kk,
+                tile_capacity=seed_cap, m_blocks=mb,
+                want_count=want_mask_count, interpret=interp)
+            out = handle.call(jqx, jqy, x, y, mask)
+        fd, fi, ov = out[0], out[1], out[2]
+        count_dev = out[3] if want_mask_count else None
+        metrics.counter("knn.mesh.dispatches")
+        launch = KnnLaunch(self, k=k, kk=kk, impl="mesh", batch=batch,
+                           count_dev=count_dev, hq=_host_q(qx, qy))
+        launch.mesh_shape = mesh_shape
+        launch.shards = shards
+
+        def dense_fallback():
+            # overflow contract: the dense sharded fullscan — same
+            # per-pair arithmetic and merge as the serial fallback
+            dname = registry.mesh_variant(
+                "knn_scan.knn_fullscan_sharded", mesh,
+                fn=make_knn_fullscan_sharded(mesh),
+                static_argnames=("k", "m_blocks", "interpret"))
+            h = registry.compile(dname, jqx, jqy, x, y, mask, k=kk,
+                                 m_blocks=mb, interpret=interp)
+            return h.call(jqx, jqy, x, y, mask)
+
+        launch.arm_mesh(fd, fi, ov, dense_fallback, cap=seed_cap,
+                        caps_key=key)
+        return launch
+
+    def _knn_launch_local(self, plan, sb, qx, qy, k, kk, mb, interp,
+                          mask, batch, shard: int, staged=None,
+                          want_mask_count: bool = False) -> "KnnLaunch":
+        """Shard-affinity route: all allowed partitions' rows live on
+        `shard`, so the window runs the SERIAL sparse kernel against
+        that chip's device-local rows — no collectives, and different
+        windows occupy different chips. Global indices are
+        `local + shard * shard_rows`, which under the mesh layout
+        contract equals the serial index bit-for-bit. The fused count
+        reduces the local mask: every allowed row lives here, so the
+        local sum IS the global sum."""
+        import jax
+        import jax.numpy as jnp
+
+        from geomesa_tpu.engine.knn_scan import (
+            capacity_bucket, count_match_tiles, knn_sparse_launch)
+        from geomesa_tpu.parallel.mesh import shard_view
+        from geomesa_tpu.utils.metrics import metrics
+
+        mesh = sb.mesh
+        S = sb.shard_rows
+        dev_s = mesh.devices.flat[shard]
+        g = self.storage.sft.default_geometry
+        lx = shard_view(sb.dev[f"{g.name}__x"], shard, S, device=dev_s)
+        ly = shard_view(sb.dev[f"{g.name}__y"], shard, S, device=dev_s)
+        lm = shard_view(mask, shard, S, device=dev_s)
+        if staged is not None:
+            # staged pairs are mesh-replicated: take the owning chip's
+            # replica (whole array — shard 0 of the query axis)
+            sqx, sqy = staged
+            jqx = shard_view(sqx, 0, int(sqx.shape[0]), device=dev_s)
+            jqy = shard_view(sqy, 0, int(sqy.shape[0]), device=dev_s)
+        else:
+            jqx = jax.device_put(
+                jnp.asarray(np.asarray(qx), jnp.float32), dev_s)
+            jqy = jax.device_put(
+                jnp.asarray(np.asarray(qy), jnp.float32), dev_s)
+        count_dev = None
+        if want_mask_count:
+            count_dev = jnp.sum(lm, dtype=jnp.int64)
+        launch = KnnLaunch(self, k=k, kk=kk, impl="sparse", batch=batch,
+                           count_dev=count_dev, hq=_host_q(qx, qy))
+        launch.mesh_shape = tuple(int(s) for s in mesh.devices.shape)
+        launch.shards = (shard,)
+        launch.idx_offset = shard * S
+        key = (ast.to_cql(plan.filter), kk, ("shard", shard))
+        seed_cap = self._caps_seed(key)
+        metrics.counter("knn.mesh.local_dispatches")
+        with TRACER.span("kernel.dispatch", kernel="knn_sparse",
+                         q=int(jqx.shape[0]), k=kk,
+                         shards=str(shard)):
+            if seed_cap is None:
+                seed_cap = capacity_bucket(int(np.asarray(
+                    count_match_tiles(lm))))
+            fd, fi, ov, seed_cap = knn_sparse_launch(
+                jqx, jqy, lx, ly, lm, k=kk, tile_capacity=seed_cap,
+                m_blocks=mb, interpret=interp)
+        launch.arm_sparse(fd, fi, ov, jqx, jqy, lx, ly, lm,
+                          cap=seed_cap, caps_key=key, mb=mb,
+                          interp=interp)
+        return launch
 
     def _knn_impl_from_stats(self, plan: "QueryPlan") -> str:
         """Stats-typed sparse-vs-fullscan decision (VERDICT r4 task 6).
@@ -1107,6 +1290,43 @@ def _pad_to_k(dists: np.ndarray, idx: np.ndarray, k: int):
     return dists, idx
 
 
+def _host_q(qx, qy):
+    """Host f64 copies of the window's query points, kept on the launch
+    for sync's canonical meter recompute."""
+    return (np.asarray(qx, np.float64).ravel(),
+            np.asarray(qy, np.float64).ravel())
+
+
+def _canonical_dists(dists, idx, batch, hq):
+    """Canonical final meters (docs/SERVING.md "Sharded serving"): the
+    device kernels RANK — their f32 refine picks the neighbor set and
+    order — and the reported distances are recomputed here in f64 and
+    rounded ONCE to the result dtype. XLA fuses the in-kernel haversine
+    differently per compiled program (single-chip jit, the shard_map
+    mesh program, different [Q] buckets), so kernel-reported meters can
+    drift in final ulps across routes for the SAME neighbor pair. One
+    host recompute from one formula (`haversine_m_np`, the test
+    oracle's distance) makes every dispatch route — serial, pipelined,
+    shard-affinity, whole-mesh — report identical bits whenever the
+    neighbor sets agree, which is what makes sharded serving
+    bit-identical to the single-chip path (tests/test_mesh_serve.py)."""
+    if hq is None or dists.size == 0:
+        return dists
+    fin = np.isfinite(dists)
+    if not fin.any():
+        return dists
+    from geomesa_tpu.engine.geodesy import haversine_m_np
+
+    g = batch.sft.default_geometry
+    col = batch.columns[g.name]
+    cx = np.asarray(col.x, np.float64)
+    cy = np.asarray(col.y, np.float64)
+    qx, qy = hq
+    ii = np.clip(idx, 0, len(cx) - 1)
+    d64 = haversine_m_np(qx[:, None], qy[:, None], cx[ii], cy[ii])
+    return np.where(fin, d64, dists).astype(dists.dtype, copy=False)
+
+
 class KnnLaunch:
     """One dispatched-but-unsynced kNN window (planner.knn_launch).
 
@@ -1129,9 +1349,11 @@ class KnnLaunch:
     __slots__ = ("planner", "k", "kk", "impl", "batch", "deadline",
                  "mask_count", "fused_ok", "_ready", "_fd", "_fi", "_ov",
                  "_cap", "_caps_key", "_jqx", "_jqy", "_x", "_y",
-                 "_mask", "_mb", "_interp", "_count_dev")
+                 "_mask", "_mb", "_interp", "_count_dev", "_dense",
+                 "_hq", "idx_offset", "mesh_shape", "shards")
 
-    def __init__(self, planner, k, kk, impl, batch, count_dev=None):
+    def __init__(self, planner, k, kk, impl, batch, count_dev=None,
+                 hq=None):
         self.planner = planner
         self.k = k
         self.kk = kk
@@ -1146,6 +1368,14 @@ class KnnLaunch:
         self._jqx = self._jqy = self._x = self._y = self._mask = None
         self._cap = self._caps_key = None
         self._mb = self._interp = None
+        self._dense = None          # mesh overflow fallback (callable)
+        self._hq = hq               # host (qx, qy) f64 — sync's meters
+        # mesh attribution (docs/SERVING.md "Sharded serving"): the
+        # device topology the window ran on and which shards owned its
+        # tiles — ServeEvent.mesh_shape/shards carry these
+        self.idx_offset = 0         # shard-affinity global-index base
+        self.mesh_shape: tuple = ()
+        self.shards: tuple = ()
 
     @classmethod
     def ready(cls, planner, result, fused: bool = False) -> "KnnLaunch":
@@ -1168,6 +1398,14 @@ class KnnLaunch:
     def arm_dense(self, fd, fi) -> None:
         self._fd, self._fi = fd, fi
 
+    def arm_mesh(self, fd, fi, ov, dense_fallback, cap, caps_key) -> None:
+        """Arm a mesh-program launch: device-resident merged results +
+        the ANY-shard overflow flag; `dense_fallback` dispatches the
+        sharded fullscan when sync observes the overflow."""
+        self._fd, self._fi, self._ov = fd, fi, ov
+        self._dense = dense_fallback
+        self._cap, self._caps_key = cap, caps_key
+
     def sync(self):
         """Block until the window's device work is done and return
         (dists [Q,k] np, idx [Q,k] np, batch). Runs under the request's
@@ -1188,8 +1426,28 @@ class KnnLaunch:
         from geomesa_tpu.engine.knn_scan import knn_sparse_finish
 
         extra = (self._count_dev,) if self._count_dev is not None else ()
-        with TRACER.span("device.sync"):
-            if self._ov is not None:
+        with TRACER.span("device.sync",
+                         shards=",".join(map(str, self.shards))
+                         if self.shards else ""):
+            if self._dense is not None:
+                # mesh program: ONE combined read (results + any-shard
+                # overflow + fused count); overflow routes to the
+                # sharded fullscan, mirroring the serial contract
+                got = jax.device_get(
+                    (self._fd, self._fi, self._ov) + extra)
+                fd, fi, ov = got[0], got[1], got[2]
+                extra_host = tuple(got[3:])
+                cap = self._cap
+                if bool(np.asarray(ov)):
+                    fd, fi = jax.device_get(self._dense())
+                    cap = -1
+                with self.planner._mutex:
+                    caps = self.planner._knn_caps
+                    if cap > 0:
+                        caps[self._caps_key] = cap
+                    else:
+                        caps.pop(self._caps_key, None)
+            elif self._ov is not None:
                 fd, fi, cap, extra_host = knn_sparse_finish(
                     self._fd, self._fi, self._ov,
                     self._jqx, self._jqy, self._x, self._y, self._mask,
@@ -1204,7 +1462,14 @@ class KnnLaunch:
             else:
                 got = jax.device_get((self._fd, self._fi) + extra)
                 fd, fi, extra_host = got[0], got[1], tuple(got[2:])
-            dists, idx = _pad_to_k(np.asarray(fd), np.asarray(fi), self.k)
+            fi = np.asarray(fi)
+            if self.idx_offset:
+                # shard-affinity route: local row ids -> global (the
+                # mesh layout keeps serial indices, so this restores
+                # bit-identity with the single-chip path)
+                fi = fi + np.int32(self.idx_offset)
+            dists, idx = _pad_to_k(np.asarray(fd), fi, self.k)
+            dists = _canonical_dists(dists, idx, self.batch, self._hq)
         if extra_host:
             self.mask_count = int(extra_host[0])
         # drop the device refs promptly: the pipeline may hold the
@@ -1212,6 +1477,7 @@ class KnnLaunch:
         # buffers are the window's HBM footprint
         self._fd = self._fi = self._ov = self._count_dev = None
         self._jqx = self._jqy = self._x = self._y = self._mask = None
+        self._dense = None
         self._ready = (dists, idx, self.batch)
         return self._ready
 

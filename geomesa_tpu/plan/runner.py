@@ -82,7 +82,7 @@ def _zsparse_grid(xa, ya, w, dev_mask, bbox, width, height, interpret,
 
 
 def density_device_grid(sft: SimpleFeatureType, batch, dev, dev_mask, hints,
-                        mask_token=None):
+                        mask_token=None, mesh=None):
     """Device density grid for one batch (weight column or ones). Shared by
     the scan-path aggregate() and the planner's cached per-partition path so
     weighting semantics cannot diverge between them.
@@ -101,6 +101,38 @@ def density_device_grid(sft: SimpleFeatureType, batch, dev, dev_mask, hints,
         else jnp.ones(len(batch), jnp.float32)
     )
     geom_col = batch.columns[g.name]
+    if mesh is not None and geom_col.is_point:
+        # mesh-resident serving (docs/SERVING.md "Sharded serving"):
+        # the superbatch arrays are row-sharded over the mesh, where a
+        # Pallas zsparse pass cannot partition — route to the sharded
+        # scatter program: per-shard scatter-add + ONE psum over ICI,
+        # AOT-managed under a mesh-keyed registry entry so repeat
+        # density queries never retrace. Integer-weight grids (the
+        # default weightless density) sum exactly, so results stay
+        # bit-identical to the serial scatter.
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from geomesa_tpu.compilecache.registry import registry
+        from geomesa_tpu.engine.density import make_density_sharded
+        from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+        # pin row sharding on the weight/mask inputs (no-op when
+        # already mesh-laid-out) so the AOT executable's parameter
+        # shardings always match
+        row = NamedSharding(mesh, P(SHARD_AXIS))
+        w_sh = jax.device_put(w, row)
+        m_sh = jax.device_put(dev_mask, row)
+        vname = registry.mesh_variant(
+            "density.density_sharded", mesh,
+            fn=make_density_sharded(mesh),
+            static_argnames=("bbox", "width", "height"))
+        handle = registry.compile(
+            vname, dev[f"{g.name}__x"], dev[f"{g.name}__y"], w_sh, m_sh,
+            bbox=tuple(hints.density_bbox),
+            width=hints.density_width, height=hints.density_height)
+        return handle.call(
+            dev[f"{g.name}__x"], dev[f"{g.name}__y"], w_sh, m_sh)
     if not geom_col.is_point:
         from geomesa_tpu.engine.raster import density_grid_geometry
 

@@ -249,17 +249,40 @@ def _execute_shared(source, reqs: List[ServeRequest],
             r.future.set_result(out)
 
 
+def note_launch_route(reqs: List[ServeRequest], launch) -> None:
+    """Stamp the launch's routing attribution (mesh topology + owning
+    shards — docs/SERVING.md "Sharded serving") onto every member so
+    ServeEvents report where the window actually ran. The admission-time
+    affinity tag is a prediction; the launch's value is authoritative."""
+    mesh_shape = getattr(launch, "mesh_shape", ()) or ()
+    shards = getattr(launch, "shards", ()) or ()
+    if not mesh_shape and not shards:
+        return
+    ms = str(tuple(mesh_shape)) if mesh_shape else ""
+    sh = ",".join(map(str, shards))
+    for r in reqs:
+        r.mesh_shape = ms
+        r.shards = sh
+
+
 def _execute_knn(source, reqs: List[ServeRequest],
                  timeout_ms: Optional[int] = None) -> None:
     """Stack member query points into one [Q] kernel launch and split
     the [Q, k] result rows back out. Rows are computed independently by
     the kernels, so per-request results are identical to serial runs of
-    the same kernel — asserted in tests/test_serve.py."""
+    the same kernel — asserted in tests/test_serve.py.
+
+    The dispatch seam is launch + sync (planner.knn IS the same
+    composition), so the serial path shares the pipeline's route
+    selection — single-chip kernel, shard-affinity local kernel, or the
+    one-program mesh dispatch — and its attribution."""
     with TRACER.span("knn.stack", members=len(reqs)):
         qx, qy, offsets = stack_queries(reqs)
     lead = reqs[0]
-    dists, idx, batch = source.planner.knn(
+    launch = source.planner.knn_launch(
         lead.query, qx, qy, k=lead.k, impl=lead.impl,
         timeout_ms=timeout_ms,
     )
+    note_launch_route(reqs, launch)
+    dists, idx, batch = launch.sync()
     split_knn_results(reqs, offsets, dists, idx, batch)

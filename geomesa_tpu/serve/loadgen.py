@@ -58,6 +58,12 @@ class LoadReport:
     windows_in_flight_max: int = 0
     pipelined_windows: int = 0
     fused_counts: int = 0
+    # sharded serving (docs/SERVING.md "Sharded serving"): the mesh the
+    # service dispatched on (0 = single-chip) and the headline pts/s
+    # normalized per shard — the capacity-multiplier number the
+    # ROADMAP item-1 claim is judged on
+    mesh_devices: int = 0
+    per_shard_pts_per_s: float = 0.0
     # subscribe mode (docs/SERVING.md "Standing queries"): N standing
     # subscriptions folded over M kafka batches — throughput is pushed
     # events/s, latency is the per-batch poll->eval->push cycle, and
@@ -70,6 +76,20 @@ class LoadReport:
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
+
+
+def mesh_dispatch_count() -> float:
+    """Process-lifetime count of windows that actually ran a mesh
+    route (whole-mesh programs + shard-affinity local windows). The
+    delta across a measured run is the honest "did the mesh serve
+    this?" signal the topology reporting keys on (bench-serve uses it
+    too for closed/open modes)."""
+    from geomesa_tpu.utils.metrics import metrics
+
+    with metrics._lock:
+        c = metrics.counters
+        return float(c.get("knn.mesh.dispatches", 0.0)
+                     + c.get("knn.mesh.local_dispatches", 0.0))
 
 
 def _report(mode: str, duration: float, lat_s: List[float], sent: int,
@@ -237,6 +257,7 @@ def run_sustained(
     `requests` caps total submissions for deterministic test runs."""
     tally = _Tally()
     base = service.stats()
+    mesh_base = mesh_dispatch_count()
     pipe = getattr(service, "pipeline", None)
     if pipe is not None:
         # the in-flight high-water must be THIS run's, not the service
@@ -314,6 +335,16 @@ def run_sustained(
     # lifetime totals would credit a warmup pass to the measured run
     rep.fused_counts = int(p.get("fused_counts", 0)
                            - pbase.get("fused_counts", 0))
+    mesh = getattr(service, "mesh", None)
+    if mesh is not None and mesh_dispatch_count() > mesh_base:
+        # topology is reported from the LAUNCH route, not the resolved
+        # config: a store the residency tier cannot shard (extended
+        # geometry, cold/no device cache) serves single-chip even when
+        # ServeConfig.mesh names a mesh, and claiming mesh_devices for
+        # it would let bench-serve print a mesh_speedup computed from
+        # two identical single-chip runs
+        rep.mesh_devices = int(mesh.devices.size)
+        rep.per_shard_pts_per_s = rep.pts_per_s / rep.mesh_devices
     return rep
 
 

@@ -282,11 +282,30 @@ class DispatchPipeline:
     def _transfer(self, win: PipelinedWindow) -> None:
         """Stage the stacked queries into the double-buffered device
         slots (QueryStager): the transfer overlaps the previous window's
-        kernel instead of serializing in front of this one's."""
+        kernel instead of serializing in front of this one's. On a mesh
+        service the slots are PER-SHARD-REPLICATED — one NamedSharding
+        placement puts the window's queries on every chip (the sharded
+        program reads them replicated; the shard-affinity route takes
+        the owning chip's replica) — and the placement joins the slot
+        key so mesh and single-chip slots never alias."""
         lead = win.lead
+        # gate on the SOURCE's residency tier, not ServeConfig.mesh:
+        # the planner only takes the mesh route when the superbatch is
+        # mesh-resident, and a store the tier cannot shard (extended
+        # geometry, --no-device-cache) must stage single-device
+        # buffers for the single-chip kernel it will actually run
+        cache = getattr(win.source.planner, "cache", None)
+        mesh = cache.serving_mesh() if cache is not None else None
+        placement = None
         key = (lead.query.type_name, lead.k, lead.impl, len(win.qx))
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            placement = NamedSharding(mesh, P())
+            key = key + ("mesh", tuple(int(s) for s in mesh.devices.shape))
         with TRACER.scope(lead.trace, parent_id=win.wid):
-            win.staged = self._stager.stage(key, win.qx, win.qy)
+            win.staged = self._stager.stage(key, win.qx, win.qy,
+                                            device=placement)
 
     def _launch(self, win: PipelinedWindow) -> None:
         """planner.knn_launch: plan → mask → async kernel dispatch. The
@@ -299,6 +318,11 @@ class DispatchPipeline:
                 timeout_ms=timeout_ms, staged=win.staged,
                 want_mask_count=bool(win.running_counts),
                 donate=self.donate)
+        from geomesa_tpu.serve.batcher import note_launch_route
+
+        # routing attribution lands BEFORE the deferred sync, so the
+        # completer's ServeEvents carry it even when the window fails
+        note_launch_route(win.running + win.running_counts, win.launch)
 
     # -- completer thread --------------------------------------------------
 

@@ -97,6 +97,13 @@ class ServeRequest:
     # key admission checks (quarantine would silently never trip for
     # degraded requests)
     quarantine_key: object = None
+    # sharded serving (docs/SERVING.md "Sharded serving"): the shard-
+    # affinity tag computed at admission (`shard_affinity`) — which
+    # chips own the tiles this query's pruned partitions live on. The
+    # planner's dispatch seam recomputes it authoritatively from the
+    # plan and overrides; mesh_shape/shards end up on the ServeEvent.
+    shards: str = ""
+    mesh_shape: str = ""
 
     def __post_init__(self):
         if self.kind not in ("execute", "count", "knn"):
@@ -121,6 +128,45 @@ class ServeRequest:
     def expired(self) -> bool:
         r = self.remaining_ms
         return r is not None and r <= 0.0
+
+
+def shard_affinity(source, req: ServeRequest) -> tuple:
+    """Admission-time shard affinity: which mesh shards own the tiles
+    `req`'s query will touch, so a query LANDS where its tiles live
+    (docs/SERVING.md "Sharded serving").
+
+    Metadata-only and best-effort: bbox/interval extraction + manifest
+    partition pruning + the device cache's row-range ownership map — no
+    planning, no device work, and no residency build (a cold cache
+    answers () rather than paying an upload on the submit thread). The
+    planner's mesh dispatch recomputes the authoritative value from the
+    post-interceptor plan; this tag routes telemetry lanes and lets the
+    dispatcher group same-affinity windows."""
+    planner = getattr(source, "planner", None)
+    cache = getattr(planner, "cache", None)
+    if cache is None or getattr(cache, "mesh", None) is None:
+        return ()
+    try:
+        from geomesa_tpu.cql.extract import (
+            BBox, Interval, extract_bbox, extract_intervals)
+
+        sft = source.storage.sft
+        g = sft.default_geometry
+        d = sft.default_dtg
+        f = req.query.filter_ast
+        bbox = extract_bbox(f, g.name) if g else BBox(-180, -90, 180, 90)
+        interval = (extract_intervals(f, d.name) if d
+                    else Interval(None, None))
+        manifest = source.storage.manifest_snapshot()
+        parts = source.storage.prune_partitions(
+            bbox, interval, manifest=manifest)
+        return cache.shards_for(parts)
+    # gt: waive GT14
+    # (deliberate degrade: affinity is a routing HINT — admission must
+    # never fail a request because a metadata peek raced a write; the
+    # planner recomputes the authoritative value at dispatch)
+    except Exception:
+        return ()
 
 
 class TokenBucket:

@@ -93,6 +93,17 @@ class ServeConfig:
     pipeline: bool = True
     pipeline_depth: int = 2
     pipeline_donate: Optional[bool] = None
+    # sharded serving (docs/SERVING.md "Sharded serving"): route live
+    # traffic through the multi-chip engine. "auto" = single-chip on 1
+    # device, sharded over every device when >1 (the `gmtpu serve`
+    # default); N = first N devices; None/"off" = single-chip. When a
+    # mesh resolves, the store's device cache re-tiers to mesh
+    # residency (one NamedSharding upload per manifest snapshot, per-
+    # chip tile ownership), coalesced kNN windows dispatch as ONE
+    # pjit/shard_map program with psum/all_gather merge, and admission
+    # tags each query's shard affinity so single-owner windows run on
+    # the chip their tiles live on.
+    mesh: object = None
     # standing queries (docs/SERVING.md "Standing queries"): bounds and
     # rate limits for the subscribe/unsubscribe wire verbs; the
     # SubscriptionManager shares this service's per-tenant token
@@ -121,6 +132,21 @@ class QueryService:
                  autostart: bool = True):
         self.store = store
         self.config = config or ServeConfig()
+        # sharded serving: resolve the mesh spec once and install it on
+        # the store — existing sources re-tier their device cache, new
+        # sources inherit it (docs/SERVING.md "Sharded serving").
+        # None = inherit whatever the store already carries (a store
+        # constructed with DataStore(mesh=...) serves sharded
+        # regardless of the config spelling); "off" = force single-chip,
+        # clearing a previously installed mesh.
+        if self.config.mesh is not None:
+            from geomesa_tpu.parallel.mesh import serve_mesh
+
+            self.mesh = serve_mesh(self.config.mesh)
+            if hasattr(store, "set_mesh"):
+                store.set_mesh(self.mesh)
+        else:
+            self.mesh = getattr(store, "mesh", None)
         self.queue = AdmissionQueue(self.config.max_queue)
         self.limiter = RateLimiter(
             self.config.tenant_rate, self.config.tenant_burst)
@@ -349,6 +375,25 @@ class QueryService:
                 "shed", "sustained overload: batch class shed")
         if level >= 1 and self.config.degrade and req.allow_degraded:
             self._degrade(req, level)
+        if self.mesh is not None:
+            # shard-affinity admission (docs/SERVING.md "Sharded
+            # serving"): tag the query with the chips owning its tiles
+            # — metadata-only; the planner's dispatch seam recomputes
+            # the authoritative value and routes single-owner windows
+            # to their chip
+            from geomesa_tpu.serve.scheduler import shard_affinity
+            from geomesa_tpu.utils.metrics import metrics
+
+            try:
+                source = self.store.get_feature_source(
+                    req.query.type_name)
+            except Exception:
+                return  # the dispatch path raises the typed error
+            shards = shard_affinity(source, req)
+            if shards:
+                req.shards = ",".join(map(str, shards))
+                metrics.counter("serve.affinity.admitted",
+                                shards=req.shards)
 
     def _enqueue(self, req: ServeRequest) -> Future:
         try:
@@ -775,6 +820,11 @@ class QueryService:
                     retries=retries,
                     fault_injected=faults_seen,
                     breaker_state=breaker_state,
+                    # riders share the window's route; the lead carries
+                    # the authoritative launch attribution (fused
+                    # counts too — they resolved from the same program)
+                    mesh_shape=r.mesh_shape or lead.mesh_shape,
+                    shards=r.shards or lead.shards,
                 ))
 
     def _record_queries(self, live: List[ServeRequest],

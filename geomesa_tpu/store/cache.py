@@ -76,6 +76,26 @@ class SuperBatch:
     pids: object                 # device i32 [N] partition id per row
     ids: Dict[str, int]          # partition name -> id
     version: int
+    # mesh residency tier (docs/SERVING.md "Sharded serving"): when the
+    # cache carries a serving mesh, `dev` arrays are NamedSharding-placed
+    # over it (feature axis sharded, CSR/replicated keys replicated) and
+    # the layout is the SERIAL layout plus trailing invalid padding to a
+    # multiple of the mesh size — so global row indices (and therefore
+    # kNN results) are bit-identical to the single-chip path. `owners`
+    # records per-chip tile ownership: which shards hold each
+    # partition's rows — the shard-affinity signal admission and the
+    # planner's dispatch route consume.
+    mesh: object = None                       # jax.sharding.Mesh | None
+    shard_rows: int = 0                       # rows per shard (mesh only)
+    owners: Dict[str, tuple] = dataclasses.field(default_factory=dict)
+
+    def shards_for(self, partitions) -> tuple:
+        """Sorted shard ids owning any of `partitions`' rows (empty
+        tuple when the cache is single-chip or nothing matches)."""
+        out: set = set()
+        for name in partitions:
+            out.update(self.owners.get(name, ()))
+        return tuple(sorted(out))
 
     # Round-3: residency changes no longer re-upload unchanged segments
     # for FLAT stores (point geometry + numeric/date/dict columns): each
@@ -91,9 +111,18 @@ class SuperBatch:
 class DeviceCacheManager:
     """Keeps partitions of a FileSystemStorage resident on device."""
 
-    def __init__(self, storage: FileSystemStorage, coord_dtype=None):
+    def __init__(self, storage: FileSystemStorage, coord_dtype=None,
+                 mesh=None):
         self.storage = storage
         self.coord_dtype = coord_dtype
+        # serving mesh (docs/SERVING.md "Sharded serving"): when set,
+        # superbatch() builds the mesh-resident tier — one
+        # NamedSharding upload per residency change (per manifest
+        # snapshot, never per query) with per-chip row-range ownership.
+        # Extended-geometry stores stay single-chip: their CSR ring
+        # tables index per-feature arrays, which row sharding would
+        # misalign.
+        self.mesh = mesh
         # reentrant: compound ops (refresh -> ensure, resume -> _load)
         # re-enter; guards every mutation/compound read so concurrent
         # queries (the serve dispatch thread) never observe a half-swapped
@@ -111,6 +140,54 @@ class DeviceCacheManager:
             (not a.is_geometry) or a.type == "Point"
             for a in storage.sft.attributes
         )
+
+    # -- mesh residency (docs/SERVING.md "Sharded serving") ----------------
+
+    def _mesh_active(self) -> bool:
+        return self.mesh is not None and self._flat
+
+    @_locked
+    def serving_mesh(self):
+        """The mesh live dispatch will actually take: the installed
+        mesh when the mesh residency tier is active (flat store), else
+        None. The pipeline keys its staging placement on THIS — not on
+        `ServeConfig.mesh` — so a store the tier cannot shard
+        (extended geometry, or no device cache at all) stages
+        single-device buffers for the single-chip kernel it will
+        actually run."""
+        return self.mesh if self._mesh_active() else None
+
+    @_locked
+    def set_mesh(self, mesh) -> None:
+        """Install (or clear) the serving mesh. Residency is rebuilt on
+        the next superbatch(): entries keep their host copies; stale
+        single-device segments are dropped so the sharded upload does
+        not double HBM. No-op when the mesh is unchanged — by VALUE:
+        every QueryService construction resolves a fresh Mesh object
+        over the same devices (serve_mesh), and dropping residency on
+        an identical placement would re-upload the whole store through
+        the tunnel for nothing."""
+        if mesh is self.mesh or (
+                mesh is not None and self.mesh is not None
+                and mesh == self.mesh):
+            return
+        self.mesh = mesh
+        if self._mesh_active():
+            for e in self._entries.values():
+                e.dev = None
+        self._super = None
+        self._version += 1
+
+    @_locked
+    def shards_for(self, partitions) -> tuple:
+        """Shard-affinity lookup: the sorted shard ids owning the named
+        partitions' rows under the CURRENT mesh superbatch. PEEK-only —
+        a cold or stale cache answers () instead of paying a residency
+        build on the caller's (admission) thread; the planner's mesh
+        dispatch reads ownership off the superbatch it just ensured."""
+        if not self._mesh_active() or self._super is None:
+            return ()
+        return self._super.shards_for(partitions)
 
     # -- residency ---------------------------------------------------------
 
@@ -156,7 +233,14 @@ class DeviceCacheManager:
         n = len(batch)
         padded = batch.pad_to(_next_pow2(n))
         dev = None
-        if self._flat:
+        if self._flat and self._mesh_active():
+            # mesh tier: no per-partition single-device segments — the
+            # sharded superbatch is ONE NamedSharding upload of the host
+            # concat, so uploading each partition here would double HBM.
+            # The shared-vocab recode still runs so host/device code
+            # spaces stay comparable across refreshes.
+            padded = self._shared_vocab_recode(padded)
+        elif self._flat:
             from geomesa_tpu.engine.device import to_device
 
             padded = self._shared_vocab_recode(padded)
@@ -267,6 +351,8 @@ class DeviceCacheManager:
         pids_host = np.concatenate([
             np.full(e.padded, i, np.int32) for i, e in enumerate(entries)
         ])
+        if self._mesh_active():
+            return self._mesh_superbatch(names, entries, batch, pids_host)
         if self._flat and all(e.dev is not None for e in entries):
             # incremental path: DEVICE-side concat of the per-partition
             # segments — changed partitions were re-uploaded at load; the
@@ -294,6 +380,76 @@ class DeviceCacheManager:
             pids=jnp.asarray(pids_host),
             ids={n: i for i, n in enumerate(names)},
             version=self._version,
+        )
+        return self._super
+
+    def _mesh_superbatch(self, names, entries, batch, pids_host):
+        """Mesh-resident tier: the SERIAL layout (partitions in sorted
+        order, each pow2-padded) plus trailing invalid padding to a
+        multiple of the mesh size, uploaded ONCE via NamedSharding
+        placement (`parallel.mesh.shard_device_batch` — no per-device
+        device_put loops, the GT18 contract). Keeping the serial row
+        layout is what makes sharded kNN indices bit-identical to the
+        single-chip path; ownership is the row-range → shard map.
+
+        Known growth-phase cost: unlike the single-chip flat path's
+        per-partition segments + device-side concat, each residency
+        CHANGE here re-uploads the full host concat (row ownership
+        shifts with the total row count, so prior shard placements are
+        stale anyway). "One upload per manifest snapshot" holds at
+        steady state; a workload that grows residency one partition at
+        a time pays O(resident_rows) per newly-touched partition while
+        warming (`upload_count` meters it). The incremental rung —
+        shard-aligned segment placement so ownership survives appends —
+        is listed on ROADMAP item 1."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+        d = int(self.mesh.devices.size)
+        total = len(batch)
+        padded_total = ((total + d - 1) // d) * d
+        if padded_total > total:
+            batch = batch.pad_to(padded_total)
+            pids_host = np.concatenate([
+                pids_host,
+                # trailing pad rows carry the last pid; their validity
+                # mask is False, so they are inert in every kernel
+                np.full(padded_total - total, pids_host[-1], np.int32),
+            ])
+        # the GT09 waivers below are deliberate: the sharded upload IS
+        # the guarded residency swap — the same device-work-under-the-
+        # instance-lock contract the single-chip _load path carries
+        from geomesa_tpu.engine.device import to_device
+
+        kw = {"coord_dtype": self.coord_dtype} if self.coord_dtype else {}
+        # flat stores carry only [N]-leading arrays, so ONE row-sharded
+        # NamedSharding placement covers the whole batch — host rows go
+        # straight to their owning chip, no single-device staging hop
+        row = NamedSharding(self.mesh, P(SHARD_AXIS))
+        dev = to_device(batch, device=row, **kw)  # gt: waive GT09
+        self.upload_count += 1
+        shard_rows = padded_total // d
+        owners: Dict[str, tuple] = {}
+        off = 0
+        for name, e in zip(names, entries):
+            lo, hi = off, off + e.padded
+            owners[name] = tuple(
+                range(lo // shard_rows,
+                      min((hi - 1) // shard_rows + 1, d)))
+            off = hi
+        pids = jax.device_put(jnp.asarray(pids_host), row)  # gt: waive GT09
+        self._super = SuperBatch(
+            batch=batch,
+            dev=dev,
+            pids=pids,
+            ids={n: i for i, n in enumerate(names)},
+            version=self._version,
+            mesh=self.mesh,
+            shard_rows=shard_rows,
+            owners=owners,
         )
         return self._super
 

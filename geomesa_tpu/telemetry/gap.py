@@ -137,6 +137,12 @@ def gap_report(traces: Iterable) -> dict:
     windows: Dict[tuple, dict] = {}    # (proc, dispatch span id) -> span
     window_children: Dict[tuple, List[dict]] = {}
     seen_span_ids = set()
+    # per-shard lane (docs/SERVING.md "Sharded serving"): device-facing
+    # spans stamped with the owning shards (kernel.dispatch/device.sync
+    # on a mesh service) aggregate per shard id, so a slow chip shows up
+    # as ITS lane's total, not a fleet-wide average. Whole-mesh windows
+    # credit every owning shard; shard-affinity windows credit one.
+    shard_lanes: Dict[str, Dict[str, float]] = {}
     for d in docs:
         proc = str(d.get("trace_id", "")).split("-", 1)[0]
         root = d["root"]
@@ -162,6 +168,13 @@ def gap_report(traces: Iterable) -> dict:
                 s["name"], {"count": 0, "total_ms": 0.0})
             p["count"] += 1
             p["total_ms"] += dur_ms
+            ids = (s.get("attrs") or {}).get("shards", "")
+            if ids and s["name"] in DEVICE_PHASES:
+                for sid in str(ids).split(","):
+                    lane = shard_lanes.setdefault(
+                        sid.strip(), {"count": 0, "device_ms": 0.0})
+                    lane["count"] += 1
+                    lane["device_ms"] += dur_ms
             if s["name"] == "dispatch":
                 windows[(proc, s["id"])] = s
         for s in spans:
@@ -248,6 +261,11 @@ def gap_report(traces: Iterable) -> dict:
             "multi_window_ms": round(multi_window_ns / 1e6, 3),
             "transfer_overlap_ms": round(transfer_overlap_ns / 1e6, 3),
         },
+        "shards": {
+            sid: {"count": lane["count"],
+                  "device_ms": round(lane["device_ms"], 3)}
+            for sid, lane in sorted(shard_lanes.items())
+        },
     }
 
 
@@ -277,6 +295,12 @@ def render_gap(report: dict) -> str:
             f"flight ({p['multi_window_ms']:.1f} ms with >=2 open, "
             f"{p['transfer_overlap_ms']:.1f} ms of transfer overlapped "
             f"other windows)")
+    lanes = report.get("shards") or {}
+    if lanes:
+        parts = ", ".join(
+            f"shard {sid}: {lane['device_ms']:.1f} ms"
+            f"/{lane['count']}" for sid, lane in lanes.items())
+        lines.append(f"shard lanes: {parts}")
     if g["windows"] and g["gap_fraction"] > 0.5:
         lines.append(
             "  NOTE: >50% of dispatch-window time is host gap — the "

@@ -1,0 +1,188 @@
+"""Multichip harness, serve-path phase (docs/SERVING.md "Sharded serving").
+
+MULTICHIP_r01–r05 certified the RAW sharded kernels (`__graft_entry__.
+dryrun_multichip`: psum density, all_gather kNN merges, ring top-k, …).
+This phase certifies the SERVE PATH over the same mesh: a real
+`QueryService` with mesh residency on, proving
+
+  1. parity — kNN / count / density answers over the mesh are
+     BIT-identical to the single-chip serve path on the same store;
+  2. one-program dispatch — a coalesced kNN window executes as ONE
+     sharded program (the `knn.mesh.dispatches` counter moves by one
+     per window);
+  3. throughput — `run_sustained` pts/s over the mesh vs the same serve
+     stack single-chip (the ROADMAP item-1 capacity-multiplier number).
+
+Emits MULTICHIP_r06.json (shape mirrors the r05 artifact: n_devices,
+ok, tail) with the serve-phase numbers inlined.
+
+CPU dry run (any host):
+
+    python scripts/multichip_serve.py --devices 4 --n 2097152
+
+TPU (run per host; see MULTIHOST_MANUAL.log for the DCN variant):
+
+    python scripts/multichip_serve.py --devices 0 --n 33554432
+    # --devices 0 = use every local accelerator, no CPU forcing
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_store(root: str, n: int):
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.plan import DataStore
+
+    rng = np.random.default_rng(11)
+    sft = SimpleFeatureType.from_spec(
+        "bench", "name:String,score:Double,dtg:Date,*geom:Point")
+    store = DataStore(root, use_device_cache=True)
+    src = store.create_schema(sft)
+    src.write(FeatureBatch.from_pydict(sft, {
+        "name": rng.choice(["a", "b", "c"], n).tolist(),
+        "score": rng.uniform(-10, 10, n),
+        "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+        "geom": np.stack([rng.uniform(-170, 170, n),
+                          rng.uniform(-80, 80, n)], 1),
+    }))
+    return store
+
+
+def _counter(name: str) -> float:
+    from geomesa_tpu.utils.metrics import metrics
+
+    return json.loads(metrics.to_json())["counters"].get(name, 0.0)
+
+
+def serve_phase(n_devices: int, n: int, duration_s: float) -> dict:
+    """The serve-path certification over an n_devices mesh."""
+    from geomesa_tpu.plan.hints import QueryHints
+    from geomesa_tpu.serve.loadgen import knn_request_factory, run_sustained
+    from geomesa_tpu.serve.service import QueryService, ServeConfig
+
+    cql = "BBOX(geom, -170, -80, 170, 80) AND score > -5"
+    rng = np.random.default_rng(42)
+    qpts = rng.uniform(-60, 60, (8, 2))
+    hints = QueryHints(density_bbox=(-170, -80, 170, 80),
+                       density_width=64, density_height=64)
+    out: dict = {"n_devices": n_devices, "points": n}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        store = _build_store(tmp, n)
+
+        def answers(mesh_spec):
+            svc = QueryService(store, ServeConfig(
+                mesh=mesh_spec, max_wait_ms=20.0), autostart=False)
+            futs = [svc.knn("bench", cql, qpts[i:i + 1, 0],
+                            qpts[i:i + 1, 1], k=10) for i in range(8)]
+            svc.start()
+            try:
+                knn = [f.result(timeout=600) for f in futs]
+                cnt = svc.count("bench", cql).result(timeout=600)
+                dens = svc.query("bench", cql, hints=hints).result(
+                    timeout=600)
+            finally:
+                svc.close(drain=True)
+            return knn, cnt, np.asarray(dens.grid), svc.stats()
+
+        base_mesh = _counter("knn.mesh.dispatches")
+        mesh_ans = answers(n_devices)
+        out["one_program_windows"] = int(
+            _counter("knn.mesh.dispatches") - base_mesh)
+        out["coalesced_dispatches"] = mesh_ans[3]["dispatches"]
+        serial_ans = answers("off")
+
+        knn_parity = all(
+            np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+            for a, b in zip(mesh_ans[0], serial_ans[0]))
+        out["knn_bit_identical"] = bool(knn_parity)
+        out["count_equal"] = bool(mesh_ans[1] == serial_ans[1])
+        out["density_bit_identical"] = bool(
+            np.array_equal(mesh_ans[2], serial_ans[2]))
+
+        def sustained(mesh_spec):
+            svc = QueryService(store, ServeConfig(
+                mesh=mesh_spec, max_wait_ms=2.0))
+            try:
+                rep = run_sustained(
+                    svc, knn_request_factory("bench", cql, k=10),
+                    duration_s=duration_s, max_outstanding=16,
+                    points_per_query=n)
+            finally:
+                svc.close(drain=True)
+            return rep
+
+        sustained(n_devices)  # warm the measured route
+        rep_m = sustained(n_devices)
+        rep_s = sustained("off")
+        out["mesh_pts_per_s"] = round(rep_m.pts_per_s, 1)
+        out["per_shard_pts_per_s"] = round(rep_m.per_shard_pts_per_s, 1)
+        out["single_chip_pts_per_s"] = round(rep_s.pts_per_s, 1)
+        out["mesh_speedup"] = (
+            round(rep_m.pts_per_s / rep_s.pts_per_s, 3)
+            if rep_s.pts_per_s > 0 else None)
+    out["ok"] = bool(
+        knn_parity and out["count_equal"] and out["density_bit_identical"]
+        and out["one_program_windows"] >= 1)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--devices", type=int, default=4,
+                    help="force an N-device CPU platform; 0 = use the "
+                         "local accelerators as-is (TPU runs)")
+    ap.add_argument("--n", type=int, default=1 << 21,
+                    help="synthetic store size (points)")
+    ap.add_argument("--duration", type=float, default=6.0,
+                    help="sustained-phase measurement window (s)")
+    ap.add_argument("--out", default="MULTICHIP_r06.json",
+                    help="artifact path ('-' = stdout only)")
+    args = ap.parse_args()
+
+    if args.devices > 0:
+        from __graft_entry__ import _force_cpu_devices
+
+        _force_cpu_devices(args.devices)
+    import jax
+
+    n_devices = len(jax.devices()) if args.devices == 0 else args.devices
+    t0 = time.perf_counter()
+    phase = serve_phase(n_devices, args.n, args.duration)
+    phase["wall_s"] = round(time.perf_counter() - t0, 2)
+    tail = (
+        f"serve_phase({n_devices}): n={args.n} "
+        f"knn_bit_identical={phase['knn_bit_identical']} "
+        f"count_equal={phase['count_equal']} "
+        f"density_bit_identical={phase['density_bit_identical']} "
+        f"one_program_windows={phase['one_program_windows']} "
+        f"mesh={phase['mesh_pts_per_s']:.0f} pts/s "
+        f"({phase['per_shard_pts_per_s']:.0f}/shard) "
+        f"single_chip={phase['single_chip_pts_per_s']:.0f} pts/s "
+        f"speedup={phase['mesh_speedup']}"
+    )
+    doc = {"n_devices": n_devices, "rc": 0 if phase["ok"] else 1,
+           "ok": phase["ok"], "skipped": False, "phase": "serve",
+           "serve": phase, "tail": tail + "\n"}
+    print(tail)
+    if args.out != "-":
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"wrote {args.out}")
+    return 0 if phase["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -838,6 +838,99 @@ class TestGT17ListenerBlocking:
             assert not active([f for f in fs if f.rule == "GT17"])
 
 
+class TestGT18PerDevicePlacement:
+    """Per-device placement bypassing NamedSharding (docs/SERVING.md
+    "Sharded serving"): serve//plan/ place data ONCE via NamedSharding
+    over the mesh — per-chip device_put loops and jax.devices()[i]
+    indexing break the recorded tile ownership."""
+
+    def _findings(self, src, relpath="geomesa_tpu/serve/batcher.py"):
+        from geomesa_tpu.analysis.modinfo import ModInfo
+        from geomesa_tpu.analysis.rules import gt18
+
+        mod = ModInfo("/x.py", textwrap.dedent(src), relpath=relpath)
+        return list(gt18(mod, None))
+
+    DIRTY = """
+        import jax
+
+        def upload(batch):
+            out = []
+            for d in jax.devices():
+                out.append(jax.device_put(batch.slice_for(d), d))
+            return out
+
+        def upload_alias(batch):
+            devs = jax.devices()
+            first = devs[0]
+            return jax.device_put(batch, jax.devices()[1])
+
+        def upload_to_device_loop(parts):
+            for i, dev in enumerate(parts):
+                to_device(parts[i], device=dev)
+    """
+
+    def test_loops_and_indexing_flagged(self):
+        found = self._findings(self.DIRTY)
+        lines = sorted((f.rule, f.line) for f in found)
+        # loop device_put (7), alias subscript (12), direct
+        # jax.devices()[1] subscript (13), dev-named loop (17)
+        assert lines == [("GT18", 7), ("GT18", 12), ("GT18", 13),
+                         ("GT18", 17)], lines
+
+    def test_clean_counterparts(self):
+        clean = """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def upload(batch, mesh):
+                row = NamedSharding(mesh, P("shard"))
+                return to_device(batch, device=row)
+
+            def pin(mask, mesh):
+                return jax.device_put(mask, NamedSharding(mesh, P()))
+
+            def per_partition(parts):
+                # a loop over PARTITIONS with one shared placement is
+                # the single-chip residency path, not per-device
+                for name in sorted(parts):
+                    to_device(parts[name])
+        """
+        assert self._findings(clean) == []
+
+    def test_scope_is_path_limited(self):
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/engine/device.py") == []
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/parallel/mesh.py") == []
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/plan/planner.py") != []
+
+    def test_registration(self):
+        from geomesa_tpu.analysis.model import RULES
+        from geomesa_tpu.analysis.rules import ALL_RULES
+
+        assert "GT18" in RULES and "GT18" in ALL_RULES
+
+    def test_waiver(self):
+        import pathlib
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            sub = pathlib.Path(td) / "geomesa_tpu" / "serve"
+            sub.mkdir(parents=True)
+            (sub / "x.py").write_text(textwrap.dedent("""
+                import jax
+
+                def pick():
+                    # gt: waive GT18
+                    return jax.devices()[0]
+            """))
+            fs = lint_paths([td], rules=["GT18"], extra_ref_paths=[])
+            assert any(f.rule == "GT18" and f.waived for f in fs)
+            assert not active([f for f in fs if f.rule == "GT18"])
+
+
 # -- self-lint --------------------------------------------------------------
 
 
