@@ -81,6 +81,12 @@ RULES: Dict[str, Rule] = {
                      "ONCE via NamedSharding over the mesh; ad-hoc "
                      "per-chip placement breaks tile ownership and "
                      "forces per-dispatch reshards"),
+        Rule("GT19", "inconsistent metric label sets: the same metric "
+                     "family emitted with different label-key sets "
+                     "across call sites (serve//telemetry/ scope) — "
+                     "the series silently forks (one family, "
+                     "incompatible label schemas) and Prometheus "
+                     "scrapes/dashboard joins break"),
     )
 }
 

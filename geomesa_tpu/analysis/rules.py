@@ -1080,6 +1080,117 @@ def gt18(mod: ModInfo, project) -> Iterator[Finding]:
                     "deliberate selection")
 
 
+# GT19 scope: the serve and telemetry layers — the modules that emit
+# the Prometheus series dashboards and the SLO engine scrape. The
+# metrics registry keys series by name + sorted labels, so two call
+# sites emitting ONE family with DIFFERENT label-key sets silently
+# fork it: `serve.requests{kind,status}` here, `serve.requests{kind}`
+# there renders one family with incompatible schemas — strict scrapers
+# reject it, PromQL joins on the missing label silently drop samples,
+# and the unlabeled twin shadows the labeled series in sum() without
+# anyone deciding that. The fix is always to pick ONE label schema per
+# family (or a new family name); the rule points at every minority
+# call site.
+_GT19_PREFIXES = ("geomesa_tpu/serve/", "geomesa_tpu/telemetry/")
+_GT19_EMITTERS = {
+    # registry method -> keyword params that are NOT labels
+    "counter": {"inc"},
+    "gauge": {"value"},
+    "histogram": set(),
+    "timer": set(),
+}
+
+
+def _gt19_sites(mod: ModInfo):
+    """(family, label-key frozenset, call node) for every literal-name
+    metric emission in `mod`. Dynamic names (f-strings — the per-
+    breaker gauges) and **splat label dicts are skipped: their label
+    schema is not statically comparable."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in _GT19_EMITTERS
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "metrics"):
+            continue
+        if not node.args:
+            continue
+        name = node.args[0]
+        if not (isinstance(name, ast.Constant)
+                and isinstance(name.value, str)):
+            continue
+        non_labels = _GT19_EMITTERS[f.attr]
+        if any(kw.arg is None for kw in node.keywords):
+            continue  # **labels splat: schema unknowable statically
+        labels = frozenset(kw.arg for kw in node.keywords
+                           if kw.arg not in non_labels)
+        yield name.value, labels, node
+
+
+def gt19(mod: ModInfo, project) -> Iterator[Finding]:
+    """GT19: one metric family, different label-key sets across call
+    sites (serve//telemetry/ scope).
+
+    The family index is built once per lint run over every in-scope
+    scanned module (cached on the project; fixture runs with
+    project=None index just the module under test). For a family whose
+    sites disagree, the MAJORITY label set (ties: the first site in
+    path/line order) is taken as the schema and every other site is
+    flagged. Waivable inline (`# gt: waive GT19`) for a documented
+    deliberate fork."""
+    path = mod.relpath.replace("\\", "/")
+    if not any(p in path for p in _GT19_PREFIXES):
+        return
+    if project is not None:
+        index = getattr(project, "_gt19_index", None)
+        if index is None:
+            index = {}
+            for m in project.modules:
+                mp = m.relpath.replace("\\", "/")
+                if not any(p in mp for p in _GT19_PREFIXES):
+                    continue
+                for fam, labels, node in _gt19_sites(m):
+                    index.setdefault(fam, []).append(
+                        (mp, node.lineno, labels))
+            project._gt19_index = index  # type: ignore[attr-defined]
+    else:
+        index = {}
+        for fam, labels, node in _gt19_sites(mod):
+            index.setdefault(fam, []).append(
+                (path, node.lineno, labels))
+    for fam, labels, node in _gt19_sites(mod):
+        sites = index.get(fam, ())
+        schemas = {ls for _, _, ls in sites}
+        if len(schemas) <= 1:
+            continue
+        # majority schema; ties break to the first site in file order
+        counts: dict = {}
+        for _, _, ls in sites:
+            counts[ls] = counts.get(ls, 0) + 1
+        best = max(counts.values())
+        winners = [ls for ls in counts if counts[ls] == best]
+        if len(winners) == 1:
+            schema = winners[0]
+        else:
+            schema = next(ls for _, _, ls in sorted(sites)
+                          if ls in winners)
+        if labels == schema:
+            continue
+        others = sorted({f"{p}:{ln}" for p, ln, ls in sites
+                         if ls == schema})
+        yield _finding(
+            "GT19", mod, node,
+            f"metric family {fam!r} emitted with labels "
+            f"{{{', '.join(sorted(labels)) or 'none'}}} here but "
+            f"{{{', '.join(sorted(schema)) or 'none'}}} at "
+            f"{', '.join(others[:3])}: the series forks and "
+            f"scrapes/joins break — pick one label schema per family "
+            f"(or a distinct family name), or waive a documented "
+            f"deliberate fork")
+
+
 from geomesa_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES)
 
@@ -1087,6 +1198,6 @@ ALL_RULES = {
     "GT01": gt01, "GT02": gt02, "GT03": gt03,
     "GT04": gt04, "GT05": gt05, "GT06": gt06,
     "GT13": gt13, "GT14": gt14, "GT15": gt15, "GT16": gt16,
-    "GT17": gt17, "GT18": gt18,
+    "GT17": gt17, "GT18": gt18, "GT19": gt19,
     **CONCURRENCY_RULES,
 }

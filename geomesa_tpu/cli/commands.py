@@ -183,6 +183,18 @@ def register(sub: "argparse._SubParsersAction") -> None:
                          help="enable per-query span tracing into the "
                               "flight recorder (read via "
                               "/debug/traces or gmtpu trace)")
+    serve_p.add_argument("--slo", default=None, metavar="SPEC",
+                         help="SLO spec (.toml or .json, docs/"
+                              "OBSERVABILITY.md): evaluate declared "
+                              "objectives over sliding windows, export "
+                              "slo.* burn gauges + /debug/slo, and "
+                              "feed the --degrade ladder on budget "
+                              "exhaustion")
+    serve_p.add_argument("--profile", action="store_true",
+                         help="continuous profiler: fold every traced "
+                              "query into lifetime per-phase/kernel/"
+                              "shard distributions (/debug/prof, "
+                              "gmtpu prof; implies --trace)")
     serve_p.add_argument("--flight-dump", default=None, metavar="PATH",
                          help="flight-recorder crash-dump path (default: "
                               "$GEOMESA_TPU_FLIGHT_DUMP or a pid file "
@@ -284,7 +296,79 @@ def register(sub: "argparse._SubParsersAction") -> None:
                                "Perfetto trace_event JSON here; also "
                                "prints the dispatch-gap report line "
                                "(docs/OBSERVABILITY.md)")
+    bserve_p.add_argument("--record-baseline", default=None,
+                          metavar="PATH", nargs="?",
+                          const="BASELINE_SERVE.json",
+                          help="record the measured run's profile as a "
+                               "sentinel baseline (default path "
+                               "BASELINE_SERVE.json; docs/"
+                               "OBSERVABILITY.md \"Sentinel\")")
+    bserve_p.add_argument("--sentinel", default=None, metavar="PATH",
+                          nargs="?", const="BASELINE_SERVE.json",
+                          help="compare the measured run against a "
+                               "sentinel baseline; exit nonzero on a "
+                               "regressed verdict")
+    bserve_p.add_argument("--sentinel-threshold", type=float,
+                          default=None, metavar="RATIO",
+                          help="sentinel median-ratio threshold "
+                               "(default 1.5)")
     bserve_p.set_defaults(func=_bench_serve)
+
+    prof_p = sub.add_parser(
+        "prof", help="continuous serve profile: lifetime per-phase/"
+                     "per-kernel/per-shard distributions from a "
+                     "--metrics-port endpoint (/debug/prof) or a "
+                     "saved profile JSON")
+    prof_p.add_argument("--url", default=None,
+                        help="endpoint base URL (default: "
+                             "http://HOST:PORT from --host/--port)")
+    prof_p.add_argument("--host", default="127.0.0.1")
+    prof_p.add_argument("--port", type=int, default=9090)
+    prof_p.add_argument("--input", "-i", default=None, metavar="JSON",
+                        help="read a saved /debug/prof document "
+                             "instead of polling a live endpoint")
+    prof_p.add_argument("--json", action="store_true",
+                        help="machine output instead of text")
+    prof_p.set_defaults(func=_prof)
+
+    sentinel_p = sub.add_parser(
+        "sentinel", help="perf-regression sentinel: compare a serve "
+                         "profile against a committed baseline; typed "
+                         "per-metric verdicts (ok/regressed/improved/"
+                         "insufficient-data), nonzero exit on "
+                         "regression")
+    sentinel_p.add_argument("--baseline", "-b", required=True,
+                            help="baseline JSON (bench-serve "
+                                 "--record-baseline)")
+    sentinel_p.add_argument("--input", "-i", default=None,
+                            metavar="JSON",
+                            help="current profile: a saved /debug/prof "
+                                 "document (default: poll --url/"
+                                 "--host/--port live)")
+    sentinel_p.add_argument("--url", default=None,
+                            help="live endpoint base URL")
+    sentinel_p.add_argument("--host", default="127.0.0.1")
+    sentinel_p.add_argument("--port", type=int, default=9090)
+    sentinel_p.add_argument("--threshold", type=float, default=None,
+                            help="median-ratio regression threshold "
+                                 "(default 1.5)")
+    sentinel_p.add_argument("--min-overlap", type=float, default=None,
+                            help="distribution-overlap floor below "
+                                 "which a shifted median counts "
+                                 "(default 0.2)")
+    sentinel_p.add_argument("--min-n", type=int, default=None,
+                            help="samples required per side before any "
+                                 "verdict but insufficient-data "
+                                 "(default 8)")
+    sentinel_p.add_argument("--strict", action="store_true",
+                            help="also exit nonzero on any "
+                                 "insufficient-data verdict (a metric "
+                                 "that stopped being comparable — "
+                                 "renamed phase, lost instrumentation "
+                                 "— must not read as green)")
+    sentinel_p.add_argument("--json", action="store_true",
+                            help="machine output instead of text")
+    sentinel_p.set_defaults(func=_sentinel)
 
     # telemetry surface (docs/OBSERVABILITY.md)
     top_p = sub.add_parser(
@@ -393,6 +477,7 @@ def _serve(args) -> int:
 
     store = DataStore(args.catalog,
                       use_device_cache=not args.no_device_cache)
+    profile = getattr(args, "profile", False)
     config = ServeConfig(
         max_queue=args.max_queue,
         max_batch=args.max_batch,
@@ -402,11 +487,15 @@ def _serve(args) -> int:
         degrade=args.degrade,
         warmup_manifest=getattr(args, "warmup", None),
         track_compiles=getattr(args, "track_compiles", False),
-        trace=getattr(args, "trace", False),
+        # the profiler folds recorded traces: --profile without
+        # --trace would fold nothing, so it implies tracing
+        trace=getattr(args, "trace", False) or profile,
         flight_dump=getattr(args, "flight_dump", None),
         subscribe_poll_ms=getattr(args, "live_poll_ms", None),
         subscribe_max=getattr(args, "max_subscriptions", 256),
         mesh=getattr(args, "mesh", "auto"),
+        slo=getattr(args, "slo", None),
+        profile=profile,
     )
     def write_line(s: str) -> None:
         # flush per response: with stdout piped (the normal programmatic
@@ -426,13 +515,16 @@ def _serve(args) -> int:
     if getattr(args, "metrics_port", None) is not None:
         from geomesa_tpu.telemetry.export import MetricsServer
 
-        server = MetricsServer(port=args.metrics_port,
-                               stats_fn=svc.stats,
-                               pre_scrape=svc.export_gauges)
+        server = MetricsServer(
+            port=args.metrics_port,
+            stats_fn=svc.stats,
+            pre_scrape=svc.export_gauges,
+            slo_fn=(svc.slo.report if svc.slo is not None else None))
         port = server.start()
         print(f"metrics: {server.url}/metrics (also /healthz, "
-              f"/debug/traces, /debug/stats, /debug/gap) — "
-              f"gmtpu top --port {port}", file=sys.stderr)
+              f"/debug/traces, /debug/stats, /debug/gap, /debug/slo, "
+              f"/debug/prof) — gmtpu top --port {port}",
+              file=sys.stderr)
     if getattr(args, "metrics_interval", None):
         from geomesa_tpu.utils.metrics import metrics
 
@@ -530,13 +622,23 @@ def _bench_serve(args) -> int:
         warm.close()
 
         tracing = getattr(args, "trace", None)
-        if tracing:
+        record_baseline = getattr(args, "record_baseline", None)
+        sentinel_path = getattr(args, "sentinel", None)
+        profiling = record_baseline or sentinel_path
+        if tracing or profiling:
             # trace only the measured runs (warmup spans would pollute
-            # the gap attribution with deliberate cold-path compiles)
+            # the gap attribution with deliberate cold-path compiles);
+            # the sentinel paths additionally fold them into a fresh
+            # profiler window so the baseline is THIS run's
             from geomesa_tpu.telemetry import RECORDER, TRACER
 
             RECORDER.clear()
             TRACER.enable()
+        if profiling:
+            from geomesa_tpu.telemetry.prof import PROFILER
+
+            PROFILER.reset()
+            PROFILER.enable()
 
         try:
             store_points = store.get_feature_source(
@@ -582,6 +684,15 @@ def _bench_serve(args) -> int:
         coalesced = run("coalesced", ServeConfig(
             max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
             pipeline=pipe, mesh=mesh_spec))
+        profile_doc = None
+        if profiling:
+            # snapshot (and stop) the profiler NOW: the serial/single-
+            # chip comparison runs below are deliberately slower and
+            # must not fold into the measured profile
+            from geomesa_tpu.telemetry.prof import PROFILER
+
+            profile_doc = PROFILER.snapshot(include_samples=True)
+            PROFILER.disable()
         if not args.no_compare:
             single = None
             if coalesced.mesh_devices > 1:
@@ -647,6 +758,33 @@ def _bench_serve(args) -> int:
                 "run": "gap", "perfetto": tracing,
                 "traces_recorded": rec["trace_count"],
                 **gap_report(traces)}))
+        if profiling:
+            from geomesa_tpu.telemetry import TRACER
+            from geomesa_tpu.telemetry import sentinel as snt
+
+            if not tracing:
+                TRACER.disable()
+            doc = snt.baseline_from_profile(
+                profile_doc, latency_samples_ms=coalesced.samples_ms,
+                extra={"mode": args.mode, "n": args.n,
+                       "kind": args.kind,
+                       "throughput_qps": round(
+                           coalesced.throughput_qps, 2)})
+            if record_baseline:
+                path = snt.save_baseline(record_baseline, doc)
+                print(json.dumps({"run": "baseline", "path": path,
+                                  "metrics": len(doc["metrics"])}))
+            if sentinel_path:
+                baseline = snt.load_baseline(sentinel_path)
+                kw = {}
+                if getattr(args, "sentinel_threshold", None):
+                    kw["threshold"] = args.sentinel_threshold
+                report = snt.compare(baseline, doc, **kw)
+                print(json.dumps({"run": "sentinel",
+                                  "baseline": sentinel_path,
+                                  **report}))
+                print(snt.render_verdicts(report), file=sys.stderr)
+                return snt.exit_code(report)
     return 0
 
 
@@ -777,7 +915,135 @@ def _top_frame(doc: dict, prev, dt) -> str:
         f"{quar.get('striking', 0)} striking"
         f"   flightrec {rec.get('traces_held', 0)} trace(s), "
         f"{rec.get('events_held', 0)} event(s)")
+    mesh = serve.get("mesh")
+    if mesh:
+        md = int(counters.get("knn.mesh.dispatches", 0))
+        ml = int(counters.get("knn.mesh.local_dispatches", 0))
+        lanes = _lane_counts(counters)
+        lane_s = ""
+        if lanes:
+            prev_lanes = _lane_counts(
+                (prev or {}).get("metrics", {}).get("counters", {}))
+            if prev is not None and dt:
+                lane_s = "   lanes " + " ".join(
+                    f"{sid}:{max(c - prev_lanes.get(sid, 0), 0) / dt:.1f}/s"
+                    for sid, c in sorted(lanes.items()))
+            else:
+                lane_s = "   lanes " + " ".join(
+                    f"{sid}:{int(c)}" for sid, c in sorted(lanes.items()))
+        lines.append(
+            f"  mesh       shape {tuple(mesh.get('shape', ()))} "
+            f"({mesh.get('devices', 0)} dev)"
+            f"   windows {md} mesh / {ml} local{lane_s}")
+    subs = serve.get("subscriptions")
+    if subs:
+        by = subs.get("by_status", {})
+        lines.append(
+            f"  subs       {by.get('active', 0)} active, "
+            f"{subs.get('lagged', 0)} lagged, "
+            f"{by.get('quarantined', 0)} quarantined "
+            f"({subs.get('subscriptions', 0)} total)")
+    slo = serve.get("slo")
+    if slo and slo.get("enabled"):
+        breaching = slo.get("breaching", [])
+        budgets = [o.get("budget_remaining", 1.0)
+                   for o in slo.get("objectives", {}).values()]
+        lines.append(
+            f"  slo        {len(slo.get('objectives', {}))} objective(s)"
+            f"   min budget {min(budgets) * 100:.1f}%"
+            + (f"   BREACHING: {', '.join(breaching)}" if breaching
+               else "   all within budget"))
     return "\n".join(lines)
+
+
+def _lane_counts(counters: dict) -> dict:
+    """Per-shard admitted-query counts off the labeled
+    `serve.affinity.admitted{shards=...}` counter series (a multi-owner
+    window credits each owning shard)."""
+    out: dict = {}
+    prefix = "serve.affinity.admitted{"
+    for key, v in counters.items():
+        if not key.startswith(prefix):
+            continue
+        label = key[len(prefix):-1]
+        if label.startswith('shards="') and label.endswith('"'):
+            for sid in label[len('shards="'):-1].split(","):
+                sid = sid.strip()
+                if sid:
+                    out[sid] = out.get(sid, 0.0) + v
+    return out
+
+
+def _fetch_json(base: str, path: str):
+    import urllib.request
+
+    with urllib.request.urlopen(f"{base.rstrip('/')}{path}",
+                                timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def _prof(args) -> int:
+    """Render a continuous-profiler snapshot (docs/OBSERVABILITY.md
+    "Continuous profiling"): from a live /debug/prof endpoint, or from
+    a saved snapshot JSON."""
+    import urllib.error
+
+    from geomesa_tpu.telemetry.prof import render_prof
+
+    if args.input:
+        with open(args.input) as f:
+            doc = json.load(f)
+    else:
+        base = args.url or f"http://{args.host}:{args.port}"
+        try:
+            doc = _fetch_json(base, "/debug/prof")
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"gmtpu prof: cannot poll {base}/debug/prof: {e}",
+                  file=sys.stderr)
+            return 1
+    if not isinstance(doc, dict) or "phases" not in doc:
+        print("error: input is not a /debug/prof document",
+              file=sys.stderr)
+        return 2
+    print(json.dumps(doc) if args.json else render_prof(doc))
+    return 0
+
+
+def _sentinel(args) -> int:
+    """Compare a serve profile against a committed baseline; exit
+    nonzero on a regressed verdict (docs/OBSERVABILITY.md
+    "Sentinel")."""
+    import urllib.error
+
+    from geomesa_tpu.telemetry import sentinel as snt
+
+    baseline = snt.load_baseline(args.baseline)
+    if args.input:
+        with open(args.input) as f:
+            profile = json.load(f)
+    else:
+        base = args.url or f"http://{args.host}:{args.port}"
+        try:
+            profile = _fetch_json(base, "/debug/prof")
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"gmtpu sentinel: cannot poll {base}/debug/prof: {e}",
+                  file=sys.stderr)
+            return 1
+    if "metrics" in profile and "phases" not in profile:
+        current = profile  # already a baseline-shaped metric table
+    else:
+        current = snt.baseline_from_profile(profile)
+    kw = {}
+    if args.threshold is not None:
+        kw["threshold"] = args.threshold
+    if args.min_overlap is not None:
+        kw["min_overlap"] = args.min_overlap
+    if args.min_n is not None:
+        kw["min_n"] = args.min_n
+    report = snt.compare(baseline, current, **kw)
+    print(json.dumps(report) if args.json
+          else snt.render_verdicts(report))
+    return snt.exit_code(report, strict=getattr(args, "strict", False))
 
 
 def _trace(args) -> int:

@@ -205,6 +205,7 @@ def _run_group(source, reqs: List[ServeRequest]) -> None:
 
 def _oom_fallback(source, reqs: List[ServeRequest],
                   oom: BaseException) -> None:
+    from geomesa_tpu.telemetry.recorder import RECORDER
     from geomesa_tpu.utils.metrics import metrics
 
     if reqs[0].kind == "knn" and len(reqs) > 1:
@@ -215,12 +216,19 @@ def _oom_fallback(source, reqs: List[ServeRequest],
         # whose program size is independent of rider count, so halving
         # them would just re-fail the identical allocation
         metrics.counter("serve.oom.halved")
+        # flight-recorder lifecycle event: each ladder step records, so
+        # a crash dump shows the descent (64 -> 32 -> 16 -> host) that
+        # preceded an incident instead of one opaque OOM
+        RECORDER.note_event("oom", action="halved", batch=len(reqs),
+                            query_kind=reqs[0].kind)
         mid = len(reqs) // 2
         _run_group(source, reqs[:mid])
         _run_group(source, reqs[mid:])
         return
     # host evaluation, ONCE per group: shared count/execute riders get
     # the same (immutable) result object, exactly like _execute_shared
+    RECORDER.note_event("oom", action="hosteval", batch=len(reqs),
+                        query_kind=reqs[0].kind)
     try:
         from geomesa_tpu.faults.fallback import host_fallback
 

@@ -73,9 +73,18 @@ class LoadReport:
     batches: int = 0
     events_total: int = 0
     events_per_s: float = 0.0
+    # sentinel input (telemetry/sentinel.py): a bounded sample of the
+    # raw end-to-end latencies, so `bench-serve --record-baseline` can
+    # commit a DISTRIBUTION (median + overlap comparison) instead of
+    # the point percentiles above. Evenly strided from the sorted
+    # samples — order statistics, not a random subsample, so two runs
+    # of the same workload produce comparable vectors.
+    samples_ms: List[float] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> dict:
-        return dataclasses.asdict(self)
+        doc = dataclasses.asdict(self)
+        doc.pop("samples_ms", None)  # report lines stay readable
+        return doc
 
 
 def mesh_dispatch_count() -> float:
@@ -101,6 +110,8 @@ def _report(mode: str, duration: float, lat_s: List[float], sent: int,
     def q(p):
         return float(np.percentile(lat, p)) if ok else 0.0
 
+    sorted_lat = np.sort(lat)
+    stride = max(1, ok // 512)
     return LoadReport(
         mode=mode,
         duration_s=duration,
@@ -115,6 +126,7 @@ def _report(mode: str, duration: float, lat_s: List[float], sent: int,
         max_ms=float(lat.max()) if ok else 0.0,
         dispatches=stats.get("dispatches", 0),
         coalesced=stats.get("coalesced", 0),
+        samples_ms=[round(float(v), 4) for v in sorted_lat[::stride]],
     )
 
 

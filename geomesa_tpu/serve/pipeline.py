@@ -405,7 +405,20 @@ class DispatchPipeline:
         story must not depend on the kNN it happened to ride with."""
         from geomesa_tpu.faults import classify
         from geomesa_tpu.serve.batcher import _oom_fallback
+        from geomesa_tpu.telemetry.recorder import RECORDER
 
+        # flight-recorder lifecycle event: a pipelined window dying
+        # mid-flight is the multi-chip postmortem case — record WHICH
+        # shards the window was routed to (note_launch_route stamped
+        # the lead before the deferred sync) alongside the error, so a
+        # crash dump distinguishes "one chip's windows keep failing"
+        # from a fleet-wide fault
+        RECORDER.note_event(
+            "pipeline", action="window_failed", seq=win.seq,
+            members=len(win.running) + len(win.running_counts),
+            error=type(exc).__name__,
+            shards=win.lead.shards or None,
+            mesh_shape=win.lead.mesh_shape or None)
         # done-future guards throughout: a failure AFTER partial
         # resolution (e.g. the kNN split succeeded, then the fused-count
         # path threw) must only fail the still-pending members —

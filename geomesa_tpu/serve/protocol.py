@@ -37,6 +37,12 @@ in: `enter`/`exit` (geofence transitions, fid lists), `density`
 outboxes (deterministic clients; the `--live-poll-ms` pump does it on
 a cadence otherwise).
 
+Introspection: `{"id": "i1", "op": "stats"}` answers with the
+service's live counters (queue depth, dispatch/coalesce totals,
+quarantine, pipeline — and the SLO burn report when `--slo` loaded a
+spec), so a wire client can watch its own error budget without a
+separate metrics scrape.
+
 Errors are per-request, never fatal to the stream: a malformed line
 yields an ok=false response and the loop continues — one bad client
 request must not drop everyone else's connection.
@@ -410,6 +416,14 @@ def serve_lines(
                 rid = doc.get("id", processed)
                 if doc.get("op") in SUBSCRIBE_OPS:
                     subs.handle(rid, doc)
+                    continue
+                if doc.get("op") == "stats":
+                    # introspection verb: the service's live counters
+                    # (+ SLO burn report when a spec is loaded) without
+                    # a scrape endpoint — wire clients watch their own
+                    # error budget on the connection they already hold
+                    respond({"id": rid, "ok": True,
+                             "stats": svc.stats()})
                     continue
                 req = parse_request(doc)
                 fut = svc.submit(req)

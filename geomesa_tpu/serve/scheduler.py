@@ -216,6 +216,15 @@ class AdmissionQueue:
         with self._lock:
             return sum(len(d) for d in self._classes)
 
+    def depths(self) -> Dict[str, int]:
+        """Per-priority-class occupancy snapshot, keyed by class name
+        (`serve.queue.class_depth{priority=...}` gauges — a batch-class
+        backlog behind an empty interactive lane reads differently from
+        a uniformly full queue on a dashboard)."""
+        with self._lock:
+            return {PRIORITIES[i]: len(d)
+                    for i, d in enumerate(self._classes)}
+
     def put(self, req: ServeRequest) -> None:
         with self._lock:
             if sum(len(d) for d in self._classes) >= self.max_depth:

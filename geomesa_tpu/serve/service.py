@@ -81,6 +81,20 @@ class ServeConfig:
     # flight recorder's crash-dump path for this process
     trace: bool = False
     flight_dump: Optional[str] = None
+    # SLO engine (docs/OBSERVABILITY.md "SLOs"): a spec path (.toml/
+    # .json), a spec dict, an SloSpec, or a pre-built SloEngine (tests
+    # inject one with a fake clock). When set, every resolved request
+    # feeds the engine's sliding windows, `slo.*` gauges export at
+    # scrape time, /debug/slo renders the burn report, and — with
+    # `degrade` on — the ladder takes the engine's burn-rate boost as a
+    # second input alongside queue occupancy, so shedding engages on
+    # budget exhaustion too
+    slo: object = None
+    # continuous profiler (telemetry/prof.py): fold every recorded
+    # trace into the process-lifetime distributions (requires trace=
+    # True to have traces to fold; the fold itself is budgeted and the
+    # flag only flips the process-wide PROFILER switch)
+    profile: bool = False
     # pipelined dispatch (docs/SERVING.md "Pipelined dispatch"): kNN
     # windows run prepare/transfer/launch on the dispatch thread and
     # defer the device sync to a completer thread, keeping up to
@@ -160,6 +174,25 @@ class QueryService:
             TRACER.enable()
         if self.config.flight_dump:
             RECORDER.auto_dump_path = self.config.flight_dump
+        if self.config.profile:
+            from geomesa_tpu.telemetry.prof import PROFILER
+
+            PROFILER.enable()
+        # SLO engine: accept a path, dict, SloSpec or a ready engine
+        # (tests pass one with a fake clock)
+        self.slo = None
+        if self.config.slo is not None:
+            from geomesa_tpu.telemetry.slo import SloEngine, SloSpec
+
+            spec = self.config.slo
+            if isinstance(spec, SloEngine):
+                self.slo = spec
+            else:
+                if isinstance(spec, str):
+                    spec = SloSpec.load(spec)
+                elif isinstance(spec, dict):
+                    spec = SloSpec.from_dict(spec)
+                self.slo = SloEngine(spec)
         self._closed = False
         self._stop = threading.Event()
         self._inflight = 0
@@ -327,8 +360,12 @@ class QueryService:
             "query", kind=req.kind, type=req.query.type_name,
             tenant=req.tenant)
         if trace is None:
-            self._admit(req)
-            return self._enqueue(req)
+            try:
+                self._admit(req)
+                return self._enqueue(req)
+            except QueryRejected:
+                self._observe_slo(req, "rejected", 0.0)
+                raise
         req.trace = trace
         try:
             # the admit span must CLOSE before the request becomes
@@ -341,6 +378,8 @@ class QueryService:
                     self._admit(req)
             return self._enqueue(req)
         except BaseException as e:
+            if isinstance(e, QueryRejected):
+                self._observe_slo(req, "rejected", 0.0)
             trace.finish(status="rejected", error=type(e).__name__)
             RECORDER.record(trace)
             raise
@@ -436,17 +475,26 @@ class QueryService:
     # -- degradation ladder ------------------------------------------------
 
     def degrade_level(self) -> int:
-        """0 = nominal; 1 = hint downgrades; 2 = + shed batch class. A
-        pure function of queue occupancy, so the ladder releases the
-        moment the backlog drains."""
+        """0 = nominal; 1 = hint downgrades; 2 = + shed batch class.
+        Two inputs, max wins: queue occupancy (a pure function, so the
+        ladder releases the moment the backlog drains) and — when an
+        SLO engine is attached — the burn-rate boost
+        (docs/OBSERVABILITY.md "SLOs": a degrade-marked objective
+        breaching the multi-window burn threshold engages the ladder
+        even with an empty queue, because budget exhaustion means the
+        served latency itself is the overload signal; the boost
+        releases as the breach ages out of the fast window)."""
         if not self.config.degrade:
             return 0
         occ = len(self.queue) / self.config.max_queue
+        level = 0
         if occ >= self.config.shed_watermark:
-            return 2
-        if occ >= self.config.degrade_watermark:
-            return 1
-        return 0
+            level = 2
+        elif occ >= self.config.degrade_watermark:
+            level = 1
+        if self.slo is not None and level < 2:
+            level = max(level, self.slo.degrade_boost())
+        return level
 
     def _degrade(self, req: ServeRequest, level: int) -> None:
         """Rewrite hints toward cheaper execution. Only plain feature /
@@ -469,6 +517,14 @@ class QueryService:
         from geomesa_tpu.utils.metrics import metrics
 
         metrics.counter("serve.degraded")
+
+    def _observe_slo(self, req: ServeRequest, status: str,
+                     latency_s: float) -> None:
+        """Feed one resolved request into the SLO engine's sliding
+        windows (no-op without a spec; a tuple append with one)."""
+        if self.slo is not None:
+            self.slo.observe(req.kind, status, latency_s,
+                             degraded=req.degraded)
 
     # -- dispatch loop -----------------------------------------------------
 
@@ -566,6 +622,8 @@ class QueryService:
         for r in dead:
             self._bump("timeout")
             metrics.counter("serve.timeout")
+            self._observe_slo(r, "timeout",
+                              time.monotonic() - r.enqueued_at)
             if r.trace is not None:
                 r.trace.record("queue.wait", r.enqueued_ns, g1_ns)
                 RECORDER.record(r.trace.finish(status="timeout"))
@@ -779,6 +837,15 @@ class QueryService:
                         self.quarantine.strike(key)
             else:
                 self._bump("completed")
+            # SLO accounting distinguishes rejection from failure even
+            # where the wire status does not: a pipelined window failed
+            # by shutdown/drain fans QueryRejected out to its members
+            # (status "error" on the wire), but rejections must never
+            # burn the availability budget (telemetry/slo.py
+            # BAD_STATUSES — shedding protects the budget)
+            self._observe_slo(
+                r, "rejected" if isinstance(exc, QueryRejected)
+                else status, t1 - r.enqueued_at)
             metrics.counter("serve.requests", kind=r.kind, status=status)
             if r.tenant:
                 metrics.counter("serve.tenant.requests", tenant=r.tenant)
@@ -891,6 +958,16 @@ class QueryService:
             out["pipeline"] = self.pipeline.stats()
         if self.tracker is not None:
             out["recompiles"] = self.tracker.total_recompiles()
+        if self.mesh is not None:
+            # topology for /debug/stats consumers (`gmtpu top`): the
+            # launch-route counters live in the metrics snapshot; the
+            # shape belongs to the service
+            out["mesh"] = {
+                "shape": list(int(s) for s in self.mesh.devices.shape),
+                "devices": int(self.mesh.devices.size),
+            }
+        if self.slo is not None:
+            out["slo"] = self.slo.report()
         return out
 
     def export_gauges(self) -> None:
@@ -903,10 +980,15 @@ class QueryService:
         from geomesa_tpu.utils.metrics import metrics
 
         metrics.gauge("serve.queue.depth", float(len(self.queue)))
+        for cls, depth in self.queue.depths().items():
+            metrics.gauge("serve.queue.class_depth", float(depth),
+                          priority=cls)
         metrics.gauge("serve.degrade.level", float(self.degrade_level()))
         with self._state_lock:
             inflight = self._inflight
         metrics.gauge("serve.inflight", float(inflight))
+        if self.slo is not None:
+            self.slo.export_gauges()
         if self.pipeline is not None:
             p = self.pipeline.stats()
             metrics.gauge("serve.pipeline.inflight", float(p["inflight"]))

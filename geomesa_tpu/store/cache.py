@@ -177,6 +177,17 @@ class DeviceCacheManager:
                 e.dev = None
         self._super = None
         self._version += 1
+        # flight-recorder lifecycle event (docs/OBSERVABILITY.md): a
+        # re-tier drops residency and re-uploads on the next
+        # superbatch — a crash dump that shows one right before a
+        # latency cliff explains a multi-chip incident by itself
+        from geomesa_tpu.telemetry.recorder import RECORDER
+
+        RECORDER.note_event(
+            "mesh", action="retier",
+            shape=(list(int(s) for s in mesh.devices.shape)
+                   if mesh is not None else None),
+            entries=len(self._entries))
 
     @_locked
     def shards_for(self, partitions) -> tuple:

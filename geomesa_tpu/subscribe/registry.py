@@ -542,10 +542,17 @@ class SubscriptionRegistry:
             subs = list(self._subs.values())
             types = {n: len(ids) for n, ids in self._by_type.items() if ids}
         by_status: Dict[str, int] = {}
+        lagged = 0
         for s in subs:
             by_status[s.status] = by_status.get(s.status, 0) + 1
+            if s.lagged:
+                lagged += 1
         return {
             "subscriptions": len(subs),
             "by_status": by_status,
+            # latest-state-only mode count (outbox overflow): the
+            # `gmtpu top` subscriptions line reads this straight off
+            # /debug/stats
+            "lagged": lagged,
             "types": types,
         }

@@ -15,12 +15,25 @@ Pieces:
 - `gap.py`: the dispatch-gap report (`gmtpu trace --gap`) — host-gap vs
   kernel-time attribution aggregated from spans, the evidence ROADMAP
   item 2's pipelining work starts from.
+- `slo.py`: declared objectives + sliding-window error-budget burn
+  (`/debug/slo`, `slo.burn_rate`/`slo.budget_remaining` gauges, the
+  degradation ladder's burn-rate input).
+- `prof.py`: the always-on continuous profiler (`gmtpu prof`,
+  `/debug/prof`) — reservoir-sampled per-phase/per-kernel/per-shard
+  distributions folded from every recorded trace at bounded cost.
+- `sentinel.py`: the perf-regression sentinel (`gmtpu sentinel`,
+  `bench-serve --sentinel/--record-baseline`) — noise-tolerant
+  baseline comparison with typed per-metric verdicts and a nonzero
+  exit on regression.
 """
 
 from geomesa_tpu.telemetry.export import (MetricsServer, from_perfetto,
                                           to_perfetto, write_jsonl)
 from geomesa_tpu.telemetry.gap import gap_report, render_gap
+from geomesa_tpu.telemetry.prof import (PROFILER, ContinuousProfiler,
+                                        render_prof)
 from geomesa_tpu.telemetry.recorder import RECORDER, FlightRecorder
+from geomesa_tpu.telemetry.slo import SloEngine, SloSpec, render_slo
 from geomesa_tpu.telemetry.trace import NOOP_SPAN, Span, Trace, Tracer, TRACER
 
 __all__ = [
@@ -28,4 +41,6 @@ __all__ = [
     "RECORDER", "FlightRecorder",
     "MetricsServer", "to_perfetto", "from_perfetto", "write_jsonl",
     "gap_report", "render_gap",
+    "SloEngine", "SloSpec", "render_slo",
+    "PROFILER", "ContinuousProfiler", "render_prof",
 ]

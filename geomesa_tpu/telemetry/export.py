@@ -16,9 +16,12 @@ Three consumers, three formats:
 - **Live scrape**: `MetricsServer`, a stdlib `http.server` on a daemon
   thread serving `/metrics` (Prometheus text), `/healthz` (JSON
   liveness), `/debug/traces` (Perfetto JSON of the flight recorder),
-  `/debug/stats` (the JSON the `gmtpu top` terminal view polls) and
-  `/debug/gap` (the dispatch-gap report over recorded traces). No new
-  dependencies: ThreadingHTTPServer + the shared metrics registry.
+  `/debug/stats` (the JSON the `gmtpu top` terminal view polls),
+  `/debug/gap` (the dispatch-gap report over recorded traces),
+  `/debug/slo` (the SLO engine's objective/burn report — telemetry/
+  slo.py) and `/debug/prof` (the continuous profiler's lifetime
+  distributions — telemetry/prof.py). No new dependencies:
+  ThreadingHTTPServer + the shared metrics registry.
 """
 
 from __future__ import annotations
@@ -141,11 +144,16 @@ class MetricsServer:
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  stats_fn: Optional[Callable[[], dict]] = None,
                  pre_scrape: Optional[Callable[[], None]] = None,
-                 recorder=None):
+                 recorder=None,
+                 slo_fn: Optional[Callable[[], dict]] = None):
         self.host = host
         self.port = port
         self.stats_fn = stats_fn
         self.pre_scrape = pre_scrape
+        # /debug/slo provider (QueryService passes its engine's report;
+        # None renders a typed "no spec loaded" document instead of 404
+        # so dashboards can probe for SLO support)
+        self.slo_fn = slo_fn
         if recorder is None:
             from geomesa_tpu.telemetry.recorder import RECORDER
             recorder = RECORDER
@@ -192,6 +200,18 @@ class MetricsServer:
             from geomesa_tpu.telemetry.gap import gap_report
 
             doc = gap_report(self.recorder.traces())
+            return (200, "application/json", json.dumps(doc).encode())
+        if path == "/debug/slo":
+            doc = ({"enabled": False} if self.slo_fn is None
+                   else self.slo_fn())
+            return (200, "application/json", json.dumps(doc).encode())
+        if path == "/debug/prof":
+            from geomesa_tpu.telemetry.prof import PROFILER
+
+            # samples ride along (bounded: <= 256 per reservoir) so a
+            # saved /debug/prof document is directly comparable by the
+            # sentinel's distribution-overlap test
+            doc = PROFILER.snapshot(include_samples=True)
             return (200, "application/json", json.dumps(doc).encode())
         return (404, "text/plain", b"not found\n")
 

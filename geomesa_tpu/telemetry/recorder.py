@@ -33,6 +33,7 @@ import threading
 import time
 from typing import List, Optional
 
+from geomesa_tpu.telemetry.prof import PROFILER
 from geomesa_tpu.telemetry.trace import Trace
 
 __all__ = ["FlightRecorder", "RECORDER"]
@@ -66,6 +67,12 @@ class FlightRecorder:
         with self._lock:
             self._traces.append(doc)
             self._trace_count += 1
+        # continuous profiler (telemetry/prof.py): every recorded trace
+        # folds into the lifetime distributions when the profiler is on
+        # — one attribute read when off. Outside the ring lock: the
+        # fold takes the profiler's own lock and must not couple scrape
+        # readers of the ring to fold latency.
+        PROFILER.maybe_fold(doc)
 
     def note_event(self, kind: str, **detail) -> None:
         """Record one fault-fabric event (breaker transition, quarantine

@@ -931,6 +931,125 @@ class TestGT18PerDevicePlacement:
             assert not active([f for f in fs if f.rule == "GT18"])
 
 
+class TestGT19MetricLabelConsistency:
+    """One metric family, different label-key sets across call sites
+    (docs/OBSERVABILITY.md): the registry keys series by name+labels,
+    so a label-schema fork renders one Prometheus family with
+    incompatible schemas — strict scrapers reject it, joins drop
+    samples silently."""
+
+    def _findings(self, src, relpath="geomesa_tpu/serve/service.py"):
+        from geomesa_tpu.analysis.modinfo import ModInfo
+        from geomesa_tpu.analysis.rules import gt19
+
+        mod = ModInfo("/x.py", textwrap.dedent(src), relpath=relpath)
+        return list(gt19(mod, None))
+
+    DIRTY = """
+        from geomesa_tpu.utils.metrics import metrics
+
+        def on_ok(kind, status, tenant):
+            metrics.counter("serve.requests", kind=kind, status=status)
+            metrics.counter("serve.requests", kind=kind, status=status)
+
+        def on_shed(kind):
+            metrics.counter("serve.requests", kind=kind)
+
+        def scrape(depth):
+            metrics.gauge("serve.queue.depth", depth, shard="0")
+
+        def refresh(depth):
+            metrics.gauge("serve.queue.depth", float(depth))
+    """
+
+    def test_minority_sites_flagged(self):
+        found = self._findings(self.DIRTY)
+        lines = sorted((f.rule, f.line) for f in found)
+        # the {kind}-only counter site (9) forks serve.requests away
+        # from the majority {kind,status} schema; the two queue.depth
+        # gauge sites tie 1-1, so first-in-file-order ({shard}) wins
+        # and the unlabeled site (15) is flagged
+        assert lines == [("GT19", 9), ("GT19", 15)], lines
+        assert "serve.requests" in found[0].message
+
+    def test_clean_counterparts(self):
+        clean = """
+            from geomesa_tpu.utils.metrics import metrics
+
+            def on_ok(kind, status):
+                metrics.counter("serve.requests", kind=kind,
+                                status=status)
+
+            def on_shed(kind):
+                # same schema everywhere = one family, no fork
+                metrics.counter("serve.requests", kind=kind,
+                                status="shed")
+
+            def scrape(depth, name):
+                metrics.gauge("serve.queue.depth", float(depth))
+                # non-literal family names are not comparable: skipped
+                metrics.gauge(f"fault.breaker.{name}", 1.0)
+                # `inc` is the counter's amount param, not a label
+                metrics.counter("serve.coalesced", inc=3)
+                metrics.counter("serve.coalesced")
+        """
+        assert self._findings(clean) == []
+
+    def test_scope_is_path_limited(self):
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/subscribe/registry.py") == []
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/telemetry/slo.py") != []
+
+    def test_cross_module_via_project(self, tmp_path):
+        """The real gate path: two serve/ modules disagreeing on one
+        family — the minority module's site is flagged."""
+        import pathlib
+
+        sub = pathlib.Path(tmp_path) / "geomesa_tpu" / "serve"
+        sub.mkdir(parents=True)
+        (sub / "a.py").write_text(textwrap.dedent("""
+            def f(kind):
+                metrics.counter("serve.widgets", kind=kind)
+                metrics.counter("serve.widgets", kind=kind)
+        """))
+        (sub / "b.py").write_text(textwrap.dedent("""
+            def g():
+                metrics.counter("serve.widgets")
+        """))
+        fs = lint_paths([str(tmp_path)], rules=["GT19"],
+                        extra_ref_paths=[])
+        hits = {(f.path.replace("\\", "/"), f.line)
+                for f in active(fs)}
+        assert {(p.rsplit("geomesa_tpu/", 1)[-1], ln)
+                for p, ln in hits} == {("serve/b.py", 3)}, hits
+
+    def test_registration(self):
+        from geomesa_tpu.analysis.model import RULES
+        from geomesa_tpu.analysis.rules import ALL_RULES
+
+        assert "GT19" in RULES and "GT19" in ALL_RULES
+
+    def test_waiver(self, tmp_path):
+        import pathlib
+
+        sub = pathlib.Path(tmp_path) / "geomesa_tpu" / "serve"
+        sub.mkdir(parents=True)
+        (sub / "x.py").write_text(textwrap.dedent("""
+            def f(kind):
+                metrics.counter("serve.widgets", kind=kind)
+                metrics.counter("serve.widgets", kind=kind)
+
+            def g():
+                # gt: waive GT19
+                metrics.counter("serve.widgets")
+        """))
+        fs = lint_paths([str(tmp_path)], rules=["GT19"],
+                        extra_ref_paths=[])
+        assert any(f.rule == "GT19" and f.waived for f in fs)
+        assert not active([f for f in fs if f.rule == "GT19"])
+
+
 # -- self-lint --------------------------------------------------------------
 
 
