@@ -87,6 +87,12 @@ RULES: Dict[str, Rule] = {
                      "the series silently forks (one family, "
                      "incompatible label schemas) and Prometheus "
                      "scrapes/dashboard joins break"),
+        Rule("GT20", "unbounded socket call in the fleet tier: "
+                     "connect/recv/accept without settimeout (or "
+                     "create_connection without timeout=) in "
+                     "fleet//serve/protocol.py scope — one dead peer "
+                     "wedges the router's reader thread and with it "
+                     "every client's failover"),
     )
 }
 

@@ -1191,6 +1191,110 @@ def gt19(mod: ModInfo, project) -> Iterator[Finding]:
             f"deliberate fork")
 
 
+# GT20 scope: the fleet tier + the wire protocol it multiplexes. One
+# unbounded blocking socket call in the router wedges EVERY client
+# behind one dead replica (the reader thread never returns, pendings
+# never redistribute); in a replica it wedges drain. The fleet/wire.py
+# discipline is: every socket carries a timeout (settimeout, or
+# create_connection(timeout=...)), reads poll with a stop event.
+_GT20_PREFIXES = ("geomesa_tpu/fleet/", "geomesa_tpu/serve/protocol.py")
+_GT20_BLOCKING = {"connect", "recv", "recv_into", "accept"}
+
+
+def _gt20_recv_name(node: ast.AST) -> Optional[str]:
+    """Dotted receiver of an attribute chain (`self._sock` for
+    `self._sock.recv(...)`), or None when not statically nameable."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _gt20_create_connection(call: ast.Call) -> bool:
+    """True for socket.create_connection(...) / create_connection(...)."""
+    f = call.func
+    return ((isinstance(f, ast.Attribute)
+             and f.attr == "create_connection")
+            or (isinstance(f, ast.Name)
+                and f.id == "create_connection"))
+
+
+def gt20(mod: ModInfo, project) -> Iterator[Finding]:
+    """GT20: socket connect/recv without a timeout (fleet scope).
+
+    Flags (a) `X.connect(...)` / `X.recv(...)` / `X.recv_into` /
+    `X.accept(...)` where no `X.settimeout(...)` appears for the same
+    dotted receiver anywhere in the module (cross-method: a socket
+    configured in __init__ and read in a loop is fine), and (b)
+    `socket.create_connection(addr)` without a timeout (second
+    positional or `timeout=` keyword). A module that calls
+    `socket.setdefaulttimeout(...)` is exempt wholesale — the global
+    default bounds every socket it creates. Waivable inline
+    (`# gt: waive GT20`) for a documented deliberate block."""
+    path = mod.relpath.replace("\\", "/")
+    if not any(p in path for p in _GT20_PREFIXES):
+        return
+    safe: Set[str] = set()
+    default_timeout = False
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "settimeout":
+            name = _gt20_recv_name(f.value)
+            if name is not None:
+                safe.add(name)
+        elif (isinstance(f, ast.Attribute)
+                and f.attr == "setdefaulttimeout"):
+            default_timeout = True
+    if default_timeout:
+        return
+    # names bound from a bounded create_connection are safe receivers
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _gt20_create_connection(node.value)
+                and (len(node.value.args) >= 2
+                     or any(kw.arg == "timeout"
+                            for kw in node.value.keywords))):
+            for t in node.targets:
+                name = _gt20_recv_name(t)
+                if name is not None:
+                    safe.add(name)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _gt20_create_connection(node):
+            if (len(node.args) < 2
+                    and not any(kw.arg == "timeout"
+                                for kw in node.keywords)):
+                yield _finding(
+                    "GT20", mod, node,
+                    "socket.create_connection without a timeout: an "
+                    "unreachable replica blocks the caller forever — "
+                    "pass timeout= (fleet/wire.connect_json does)")
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in _GT20_BLOCKING):
+            continue
+        name = _gt20_recv_name(f.value)
+        if name is not None and name in safe:
+            continue
+        yield _finding(
+            "GT20", mod, node,
+            f"socket .{f.attr}() with no settimeout() on "
+            f"{name or 'its receiver'} anywhere in this module: an "
+            f"unbounded blocking call in the fleet tier wedges the "
+            f"whole router behind one dead peer — call settimeout() "
+            f"(poll + stop event, see fleet/wire.py), or waive a "
+            f"documented deliberate block")
+
+
 from geomesa_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES)
 
@@ -1198,6 +1302,6 @@ ALL_RULES = {
     "GT01": gt01, "GT02": gt02, "GT03": gt03,
     "GT04": gt04, "GT05": gt05, "GT06": gt06,
     "GT13": gt13, "GT14": gt14, "GT15": gt15, "GT16": gt16,
-    "GT17": gt17, "GT18": gt18, "GT19": gt19,
+    "GT17": gt17, "GT18": gt18, "GT19": gt19, "GT20": gt20,
     **CONCURRENCY_RULES,
 }

@@ -289,6 +289,19 @@ def register(sub: "argparse._SubParsersAction") -> None:
                                "comparison adds a same-stack single-"
                                "chip run and reports mesh_speedup + "
                                "per-shard pts/s")
+    bserve_p.add_argument("--fleet", type=int, default=None,
+                          metavar="N",
+                          help="serve through an N-replica fleet "
+                               "router instead of one service: "
+                               "closed-loop clients over the wire, "
+                               "one replica killed abruptly at "
+                               "half-time, report p99-during-kill + "
+                               "zero-drop accounting, and compare "
+                               "against a single-replica run "
+                               "(docs/SERVING.md \"Replica fleets\")")
+    bserve_p.add_argument("--no-kill", action="store_true",
+                          help="fleet mode: skip the scripted "
+                               "replica kill")
     bserve_p.add_argument("--smoke", action="store_true",
                           help="small sizes for CI")
     bserve_p.add_argument("--trace", default=None, metavar="OUT.json",
@@ -432,7 +445,60 @@ def register(sub: "argparse._SubParsersAction") -> None:
     chaos_p.add_argument("--list-sites", action="store_true",
                          help="print the registered fault-site catalog "
                               "and exit")
+    chaos_p.add_argument("--fleet", action="store_true",
+                         help="replica-kill certification "
+                              "(docs/ROBUSTNESS.md \"Replica "
+                              "fleets\"): a 2-replica fleet serves "
+                              "through an abrupt replica kill — zero "
+                              "un-typed client errors, zero dropped "
+                              "or double-delivered requests, every "
+                              "deterministic rule fired, replay-exact "
+                              "fire log, and a fresh replica refuses "
+                              "traffic until warmup --check is green. "
+                              "--plan overrides the built-in plan")
     chaos_p.set_defaults(func=_chaos)
+
+    # replica fleet (docs/SERVING.md "Replica fleets"): N QueryService
+    # replicas behind a fault-tolerant router
+    fleet_p = sub.add_parser(
+        "fleet", help="replica fleet: spawn N serve replicas behind a "
+                      "fault-tolerant router (shard-affinity + "
+                      "least-loaded + SLO-burn-aware routing, "
+                      "drain-then-redistribute failover)")
+    fleet_p.add_argument("action", nargs="?", default="serve",
+                         choices=["serve", "status", "restart"],
+                         help="serve = run a fleet; status = print a "
+                              "running fleet's membership; restart = "
+                              "rolling restart (drain one replica at "
+                              "a time, gated on the survivors' SLO "
+                              "budget)")
+    fleet_p.add_argument("--catalog", "-c", default=None,
+                         help="catalog directory (serve)")
+    fleet_p.add_argument("--replicas", "-n", type=int, default=2,
+                         help="replica count (serve)")
+    fleet_p.add_argument("--host", default="127.0.0.1")
+    fleet_p.add_argument("--port", type=int, default=0,
+                         help="router port (serve: 0 = ephemeral, "
+                              "printed on startup; status/restart: "
+                              "the running router's port)")
+    fleet_p.add_argument("--spawn", default="process",
+                         choices=["thread", "process"],
+                         help="replica isolation: separate OS "
+                              "processes (default; a crash takes one "
+                              "replica) or in-process threads (CI/"
+                              "smoke)")
+    fleet_p.add_argument("--warmup", default=None, metavar="MANIFEST",
+                         help="warmup manifest every replica must "
+                              "replay GREEN (gmtpu warmup --check "
+                              "semantics) before taking traffic")
+    fleet_p.add_argument("--metrics-port", type=int, default=None,
+                         help="per-replica metrics port; use 0 — "
+                              "ephemeral, reported per replica — N "
+                              "replicas on one host cannot share a "
+                              "fixed port")
+    fleet_p.add_argument("--force-cpu", action="store_true",
+                         help="pin replica workers to CPU (CI)")
+    fleet_p.set_defaults(func=_fleet)
 
     # analysis subsystem (docs/ANALYSIS.md): gmtpu-lint + runtime guards
     from geomesa_tpu.analysis.linter import add_lint_arguments
@@ -521,6 +587,10 @@ def _serve(args) -> int:
             pre_scrape=svc.export_gauges,
             slo_fn=(svc.slo.report if svc.slo is not None else None))
         port = server.start()
+        # the BOUND port, not the requested one: --metrics-port 0 asks
+        # the OS for an ephemeral port (fleet replicas sharing a host
+        # must), and stats()/this line are where it is reported
+        svc.metrics_port = port
         print(f"metrics: {server.url}/metrics (also /healthz, "
               f"/debug/traces, /debug/stats, /debug/gap, /debug/slo, "
               f"/debug/prof) — gmtpu top --port {port}",
@@ -582,6 +652,8 @@ def _bench_serve(args) -> int:
         args.rows = min(args.rows, 32)
     if args.mode == "subscribe":
         return _bench_subscribe(args)
+    if getattr(args, "fleet", None):
+        return _bench_fleet(args)
     with contextlib.ExitStack() as stack:
         if args.catalog:
             if not args.feature_name:
@@ -786,6 +858,77 @@ def _bench_serve(args) -> int:
                 print(snt.render_verdicts(report), file=sys.stderr)
                 return snt.exit_code(report)
     return 0
+
+
+def _bench_fleet(args) -> int:
+    """`gmtpu bench-serve --fleet N`: fleet-through-a-kill throughput
+    + p99, compared against a single replica (no kill). The headline
+    acceptance: the fleet keeps serving through the kill with p99
+    bounded and ZERO dropped requests."""
+    import contextlib
+    import tempfile
+
+    import numpy as np
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.plan import DataStore
+    from geomesa_tpu.serve.loadgen import run_fleet_bench
+
+    with contextlib.ExitStack() as stack:
+        if args.catalog:
+            if not args.feature_name:
+                print("error: --catalog needs --feature-name",
+                      file=sys.stderr)
+                return 2
+            catalog, type_name = args.catalog, args.feature_name
+        else:
+            catalog = stack.enter_context(tempfile.TemporaryDirectory())
+            rng = np.random.default_rng(11)
+            sft = SimpleFeatureType.from_spec(
+                "bench", "name:String,score:Double,dtg:Date,*geom:Point")
+            store = DataStore(catalog, use_device_cache=True)
+            store.create_schema(sft).write(FeatureBatch.from_pydict(sft, {
+                "name": rng.choice(["a", "b", "c"], args.n).tolist(),
+                "score": rng.uniform(-10, 10, args.n),
+                "dtg": rng.integers(
+                    1_590_000_000_000, 1_600_000_000_000, args.n),
+                "geom": np.stack([rng.uniform(-170, 170, args.n),
+                                  rng.uniform(-80, 80, args.n)], 1),
+            }))
+            del store
+            type_name = "bench"
+        kill = not getattr(args, "no_kill", False)
+        fleet = run_fleet_bench(
+            catalog, type_name, n_replicas=args.fleet,
+            duration_s=args.duration, clients=args.clients, k=args.k,
+            kill=kill)
+        print(json.dumps({"run": "fleet", **fleet}))
+        single = None
+        if not args.no_compare and args.fleet > 1:
+            single = run_fleet_bench(
+                catalog, type_name, n_replicas=1,
+                duration_s=args.duration, clients=args.clients,
+                k=args.k, kill=False)
+            print(json.dumps({"run": "single_replica", **single}))
+        comparison = {
+            "run": "comparison",
+            "dropped": fleet["dropped"],
+            "untyped": fleet["untyped"],
+            "served_through_kill": fleet.get("served_during_kill", 0),
+            "p99_during_kill_ms": fleet.get("p99_during_kill_ms"),
+        }
+        if single is not None and single["throughput_qps"] > 0:
+            comparison["fleet_speedup"] = round(
+                fleet["throughput_qps"] / single["throughput_qps"], 3)
+        print(json.dumps(comparison))
+        # the acceptance contract, machine-checkable: zero drops, zero
+        # un-typed errors, and — when a kill happened — the fleet
+        # demonstrably served inside the kill window
+        ok = (fleet["dropped"] == 0 and fleet["untyped"] == 0
+              and (not fleet["killed"]
+                   or fleet.get("served_during_kill", 0) > 0))
+        return 0 if ok else 1
 
 
 def _bench_subscribe(args) -> int:
@@ -1128,11 +1271,69 @@ def _warmup(args) -> int:
 def _chaos(args) -> int:
     from geomesa_tpu.faults.chaos import run_cli
 
-    if not args.list_sites and not args.plan:
-        print("error: chaos needs --plan (or --list-sites)",
+    if (not args.list_sites and not args.plan
+            and not getattr(args, "fleet", False)):
+        print("error: chaos needs --plan (or --fleet / --list-sites)",
               file=sys.stderr)
         return 2
     return run_cli(args)
+
+
+def _fleet(args) -> int:
+    import time
+
+    if args.action in ("status", "restart"):
+        if not args.port:
+            print("error: fleet status/restart needs --port "
+                  "(the running router's port)", file=sys.stderr)
+            return 2
+        from geomesa_tpu.fleet import FleetClient
+
+        cli = FleetClient(args.host, args.port)
+        try:
+            if args.action == "status":
+                doc = cli.request({"op": "fleet"})
+                print(json.dumps(doc, indent=1))
+                return 0 if doc.get("ok") else 1
+            cli.hello(role="admin")
+            # rolling restart can legitimately take minutes: each
+            # replica drains, respawns, and re-proves its warmup gate
+            doc = cli.request({"op": "restart"}, timeout_s=1800.0)
+            print(json.dumps(doc, indent=1))
+            return 0 if doc.get("ok") else 1
+        finally:
+            cli.close()
+    if not args.catalog:
+        print("error: fleet serve needs --catalog", file=sys.stderr)
+        return 2
+    from geomesa_tpu.fleet import FleetConfig, FleetSupervisor
+
+    sup = FleetSupervisor(FleetConfig(
+        n_replicas=args.replicas, catalog=args.catalog,
+        spawn=args.spawn, host=args.host, router_port=args.port,
+        warmup_manifest=args.warmup,
+        metrics_port=args.metrics_port,
+        force_cpu_workers=getattr(args, "force_cpu", False)))
+    try:
+        port = sup.start()
+        print(json.dumps({"event": "fleet_ready", "host": args.host,
+                          "port": port, "replicas": args.replicas,
+                          "spawn": args.spawn}), flush=True)
+        print(f"fleet: {args.replicas} replica(s) behind "
+              f"{args.host}:{port} — gmtpu fleet status --port {port}",
+              file=sys.stderr)
+        while True:
+            time.sleep(1.0)
+            states = [h.state for h in sup.membership.all()]
+            if all(s == "dead" for s in states):
+                print("fleet: every replica dead; exiting",
+                      file=sys.stderr)
+                return 1
+    except KeyboardInterrupt:
+        print("fleet: draining...", file=sys.stderr)
+        return 0
+    finally:
+        sup.close()
 
 
 def _lint(args) -> int:

@@ -745,7 +745,300 @@ def run_chaos(plan: FaultPlan, requests: int = 32, replay: bool = True,
     return report
 
 
+# -- fleet chaos (docs/ROBUSTNESS.md "Replica fleets") ----------------------
+#
+# `gmtpu chaos --fleet`: the replica-kill certification. A 2-replica
+# thread fleet (same process semantics as deployment: own stores, own
+# queues, the real wire protocol over real sockets) serves four phases:
+#
+#   1. route   — sequential mixed traffic; every answer ok; both
+#                replicas take traffic (rendezvous affinity spreads
+#                deterministic keys deterministically);
+#   2. faults  — the plan's deterministic rules fire under the harness
+#                (sequential submission keeps the site call sequence
+#                replayable) and the retry fabric absorbs them: every
+#                answer still ok, fire log exact;
+#   3. kill    — a burst pipelined on one client connection, replica
+#                killed abruptly (abort(): the kill -9 stand-in) while
+#                requests are in flight. EVERY request gets exactly one
+#                answer: ok, or typed retryable
+#                unavailable/rejected/timeout — zero un-typed errors,
+#                zero silent drops, zero duplicate responses (the wire
+#                has no write verbs and the router retries reads only,
+#                so zero double-executed writes by construction);
+#   4. warmup  — a FRESH replica with a manifest recorded from phase-1
+#                traffic demonstrably refuses traffic (typed,
+#                retryable `warming`) until `gmtpu warmup --check`
+#                semantics pass, and the router never routes to it
+#                before `ready`.
+#
+# The whole sequence runs twice with the same seed; the harness fire
+# logs must match exactly (invariant 3's replay discipline).
+
+_FLEET_ROUTE_REQUESTS = 12
+_FLEET_FAULT_REQUESTS = 6
+_FLEET_KILL_REQUESTS = 20
+
+
+def default_fleet_plan(seed: int = 23) -> FaultPlan:
+    """The built-in replica-kill plan: two deterministic storage
+    faults the retry fabric must absorb (fires below the retry
+    budget), asserted fired + replay-exact. The kill itself is
+    scripted by the runner, not a harness rule — process death is not
+    an injection site."""
+    from geomesa_tpu.faults.plan import FaultRule
+
+    # the fault phase makes 6 sequential scan-path counts -> one
+    # fs.read_partition call each, +1 per injected fire's retry:
+    # fires at calls 2 and 5 leave every request recovered (the retry
+    # budget absorbs single faults) while both rules provably fire
+    return FaultPlan(seed=seed, rules=[
+        FaultRule(site="fs.read_partition", error="io", nth_call=2),
+        FaultRule(site="fs.read_partition", error="io", nth_call=5),
+    ])
+
+
+def _fleet_request(i: int, qpts, cql: str,
+                   rid: Optional[str] = None) -> dict:
+    rid = rid if rid is not None else f"q{i}"
+    if i % 2 == 0:
+        return {"id": rid, "op": "count", "typeName": "chaos",
+                "cql": cql, "timeoutMs": 60_000}
+    return {"id": rid, "op": "knn", "typeName": "chaos",
+            "cql": cql, "x": [float(qpts[i, 0])],
+            "y": [float(qpts[i, 1])], "k": 5, "timeoutMs": 60_000}
+
+
+def _fleet_answer(report: ChaosReport, doc: dict, where: str) -> None:
+    report.requests += 1
+    if doc.get("ok"):
+        report.ok += 1
+    elif doc.get("error") in ("unavailable", "rejected", "timeout"):
+        key = doc.get("reason") or doc["error"]
+        report.typed_errors[key] = report.typed_errors.get(key, 0) + 1
+    else:
+        report.untyped_errors.append(
+            f"{where}: {doc.get('error')}: {doc.get('message')}")
+
+
+def _run_fleet_pass(plan: FaultPlan, root: str, report: ChaosReport,
+                    say) -> List[tuple]:
+    import threading
+
+    from geomesa_tpu.fleet import (
+        FleetConfig, FleetSupervisor, ReplicaServer)
+    from geomesa_tpu.fleet.wire import connect_json
+    from geomesa_tpu.plan.datastore import DataStore
+    from geomesa_tpu.serve.service import ServeConfig
+
+    catalog = os.path.join(root, "cat")
+    _synth_store(catalog, n=384, seed=plan.seed)
+    rng = np.random.default_rng(plan.seed + 61)
+    qpts = rng.uniform(-60, 60, (64, 2))
+    cql = "BBOX(geom, -170, -80, 170, 80)"
+
+    # scan-path stores so the plan's storage rules keep biting, and
+    # coalescing-off so the fault phase's site sequence is replayable
+    def store_factory():
+        return DataStore(catalog, use_device_cache=False)
+
+    sup = FleetSupervisor(FleetConfig(
+        n_replicas=2, catalog=catalog, store_factory=store_factory,
+        serve_config=ServeConfig(max_wait_ms=0.0, max_batch=1),
+        probe_interval_s=0.2))
+    extra = None
+    try:
+        port = sup.start()
+        # phase-4 prep: record a warmup manifest from live traffic on
+        # replica r0 (thread spawn exposes the service)
+        recorder = sup.membership.get("r0").server.svc.record_warmup()
+
+        cli = connect_json("127.0.0.1", port)
+        # phase 1: route — sequential, every answer ok, both replicas
+        # take traffic
+        for i in range(_FLEET_ROUTE_REQUESTS):
+            cli.send(_fleet_request(i, qpts, cql))
+            got = next(cli.docs())
+            _fleet_answer(report, got, "route")
+            if not got.get("ok"):
+                report.invariant_failures.append(
+                    f"fleet route phase: request {i} failed "
+                    f"{got.get('error')}/{got.get('reason')}")
+        routed = {r["replica"]: r["routed"]
+                  for r in sup.stats()["replicas"]}
+        if sorted(v > 0 for v in routed.values()) != [True, True]:
+            report.invariant_failures.append(
+                f"fleet route phase: traffic did not spread over both "
+                f"replicas ({routed})")
+
+        # phase 2: deterministic faults under the harness, absorbed by
+        # the retry fabric; sequential submission keeps the fire
+        # schedule exact
+        with _harness.active(plan) as h:
+            for i in range(_FLEET_FAULT_REQUESTS):
+                cli.send(_fleet_request(2 * i, qpts, cql))  # counts
+                got = next(cli.docs())
+                _fleet_answer(report, got, "fault")
+                if not got.get("ok"):
+                    report.invariant_failures.append(
+                        f"fleet fault phase: retry fabric did not "
+                        f"absorb an injected fault "
+                        f"({got.get('error')}/{got.get('reason')})")
+            log = list(h.fire_log())
+
+        manifest_path = os.path.join(root, "fleet_warmup.json")
+        recorder.manifest().save(manifest_path)
+
+        # phase 3: replica kill mid-burst. Pipeline the burst on one
+        # connection, kill r1 abruptly while requests are in flight.
+        for i in range(_FLEET_KILL_REQUESTS):
+            cli.send(_fleet_request(i % 16, qpts, cql, rid=f"k{i}"))
+        sup.kill_replica("r1", graceful=False)
+        answers: Dict[str, dict] = {}
+        stop = threading.Event()
+        timer = threading.Timer(120.0, stop.set)
+        timer.start()
+        for got in cli.docs(stop):
+            rid = got.get("id")
+            if rid in answers:
+                report.invariant_failures.append(
+                    f"fleet kill phase: duplicate response for {rid} "
+                    f"(double-delivery)")
+            answers[rid] = got
+            if len(answers) >= _FLEET_KILL_REQUESTS:
+                break
+        timer.cancel()
+        if len(answers) != _FLEET_KILL_REQUESTS:
+            report.invariant_failures.append(
+                f"fleet kill phase: {_FLEET_KILL_REQUESTS} requests, "
+                f"{len(answers)} answers — requests were silently "
+                f"dropped")
+        for rid, got in answers.items():
+            _fleet_answer(report, got, f"kill:{rid}")
+        st = sup.stats()["router"]
+        say(f"fleet kill phase: {len(answers)} answered, "
+            f"retried={st['retried']}, unavailable={st['unavailable']}")
+
+        # phase 4: a fresh replica refuses traffic until its warmup
+        # check is green, and the router never routes to it before
+        # ready
+        hold = threading.Event()
+        extra = ReplicaServer(
+            store_factory, ServeConfig(max_wait_ms=0.0, max_batch=1),
+            replica_id="r2", warmup_manifest=manifest_path,
+            warmup_hold=hold)
+        eport = extra.start()
+        from geomesa_tpu.fleet.membership import ReplicaHandle
+
+        handle = ReplicaHandle(replica_id="r2", host="127.0.0.1",
+                               port=eport, spawn="thread", server=extra)
+        sup.membership.add(handle)
+        sup.router.attach(handle)
+        probe = connect_json("127.0.0.1", eport)
+        got = probe.request(
+            {"id": "w1", "op": "count", "typeName": "chaos",
+             "cql": cql}, timeout_s=30.0)
+        if got.get("ok") or got.get("reason") != "warming" \
+                or not got.get("retryable"):
+            report.invariant_failures.append(
+                f"fleet warmup phase: warming replica did not refuse "
+                f"traffic typed+retryable (got {got})")
+        if any(h2.replica_id == "r2"
+               for h2 in sup.membership.routable()):
+            report.invariant_failures.append(
+                "fleet warmup phase: router considers a warming "
+                "replica routable")
+        hold.set()
+        state = extra.wait_state("ready", timeout=120.0)
+        if state != "ready" or (extra.warmup_report is not None
+                                and not extra.warmup_report.ok):
+            report.invariant_failures.append(
+                f"fleet warmup phase: fresh replica came up {state} "
+                f"({extra.error}) — warmup --check not green")
+        else:
+            got = probe.request(
+                {"id": "w2", "op": "count", "typeName": "chaos",
+                 "cql": cql}, timeout_s=60.0)
+            report.requests += 1
+            if got.get("ok"):
+                report.ok += 1
+            else:
+                report.invariant_failures.append(
+                    f"fleet warmup phase: ready replica refused "
+                    f"traffic ({got})")
+        probe.close()
+        cli.close()
+        return log
+    finally:
+        if extra is not None:
+            try:
+                extra.abort()
+            except Exception:
+                pass
+        sup.close()
+
+
+def run_fleet_chaos(plan: Optional[FaultPlan] = None,
+                    replay: bool = True, out=None) -> ChaosReport:
+    """Programmatic `gmtpu chaos --fleet`. Returns a ChaosReport whose
+    `ok_overall` is the certification verdict."""
+    out = out if out is not None else sys.stderr
+
+    def say(msg):
+        print(f"chaos --fleet: {msg}", file=out)
+
+    plan = plan if plan is not None else default_fleet_plan()
+    report = ChaosReport()
+    with tempfile.TemporaryDirectory() as tmp:
+        log = _run_fleet_pass(plan, os.path.join(tmp, "run1"),
+                              report, say)
+        if replay:
+            replay_report = ChaosReport()
+            log2 = _run_fleet_pass(plan, os.path.join(tmp, "run2"),
+                                   replay_report, say)
+            report.replay_match = log == log2
+            if not report.replay_match:
+                report.invariant_failures.append(
+                    f"fleet replay diverged: {len(log)} vs "
+                    f"{len(log2)} fires")
+            report.invariant_failures.extend(
+                f"replay: {f}" for f in replay_report.invariant_failures)
+            report.untyped_errors.extend(
+                f"replay: {u}" for u in replay_report.untyped_errors)
+    report.fires = len(log)
+    report.fired_sites = sorted({s for s, _, _ in log})
+    for u in report.untyped_errors:
+        report.invariant_failures.append(f"un-typed escape: {u}")
+    import fnmatch
+
+    for rule in plan.rules:
+        if rule.nth_call is None and rule.every is None:
+            continue
+        hit = any(
+            (site == rule.site or fnmatch.fnmatchcase(site, rule.site))
+            and err == rule.error
+            for site, _, err in log)
+        if not hit:
+            report.invariant_failures.append(
+                f"fleet rule for {rule.site!r} ({rule.error}) never "
+                f"fired")
+    say("OK" if report.ok_overall else
+        f"FAIL: {'; '.join(report.invariant_failures)}")
+    return report
+
+
 def run_cli(args) -> int:
+    if getattr(args, "fleet", False):
+        plan = (FaultPlan.load(args.plan)
+                if getattr(args, "plan", None) else None)
+        if plan is not None and getattr(args, "seed", None) is not None:
+            plan.seed = args.seed
+        report = run_fleet_chaos(
+            plan, replay=not getattr(args, "no_replay", False))
+        print(json.dumps(report.to_json(), indent=1))
+        if args.check:
+            return 0 if report.ok_overall else 1
+        return 0
     if getattr(args, "list_sites", False):
         # import the boundary modules so their sites register
         import geomesa_tpu.compilecache.manifest  # noqa: F401

@@ -1,0 +1,87 @@
+"""Replica health: the typed state machine every fleet layer shares.
+
+One replica is always in exactly one state, and only the transitions
+below are legal — an illegal transition raises `ReplicaStateError`
+instead of silently mislabeling a replica (a router that believes a
+dead replica is `ready` re-routes traffic into a black hole; a replica
+that jumps straight from `starting` to `ready` serves cold and
+recompiles under traffic):
+
+    starting  — process/thread spawned, service constructing
+    warming   — replaying its warmup manifest; refuses query traffic
+                (typed, retryable) until `gmtpu warmup --check`
+                semantics pass (zero residual recompiles)
+    ready     — serving
+    degraded  — serving, but its SLO fast+slow burn gates fire (the
+                PR-10 ladder's signal, read from the stats verb): the
+                router sheds NEW traffic to healthy peers while the
+                replica works off its budget
+    draining  — admin drain in progress: no new admissions, in-flight
+                requests finishing
+    dead      — gone (crashed, killed, or drain completed); terminal
+                until the supervisor respawns a fresh incarnation
+
+`degraded` is a ROUTER-side judgment (it comes from probing the
+replica's SLO report, not from the replica's own lifecycle), so it is
+reachable only from `ready` and always releases back to `ready`.
+"""
+
+from __future__ import annotations
+
+REPLICA_STATES = (
+    "starting", "warming", "ready", "degraded", "draining", "dead")
+
+# legal moves; anything else is a bug in the caller, not a judgment call
+_TRANSITIONS = {
+    "starting": ("warming", "ready", "dead"),
+    "warming": ("ready", "dead"),
+    "ready": ("degraded", "draining", "dead"),
+    "degraded": ("ready", "draining", "dead"),
+    "draining": ("dead",),
+    "dead": (),
+}
+
+# numeric encoding for the fleet.replica.state{replica=...} gauge
+_STATE_NUM = {s: i for i, s in enumerate(REPLICA_STATES)}
+
+
+class ReplicaStateError(RuntimeError):
+    """Illegal replica state transition (or unknown state)."""
+
+
+def state_number(state: str) -> int:
+    """Gauge encoding: starting=0 ... dead=5."""
+    try:
+        return _STATE_NUM[state]
+    except KeyError:
+        raise ReplicaStateError(f"unknown replica state {state!r}")
+
+
+def validate_transition(old: str, new: str) -> str:
+    """Return `new` if `old -> new` is legal; raise typed otherwise.
+    Self-transitions are no-ops (probe loops re-assert state)."""
+    if old not in _TRANSITIONS:
+        raise ReplicaStateError(f"unknown replica state {old!r}")
+    if new == old:
+        return new
+    if new not in _TRANSITIONS[old]:
+        raise ReplicaStateError(
+            f"illegal replica transition {old!r} -> {new!r} "
+            f"(legal: {', '.join(_TRANSITIONS[old]) or 'none'})")
+    return new
+
+
+def burn_gates_fired(slo_report: dict) -> bool:
+    """The routing-facing read of a replica's `/debug/slo`-equivalent
+    stats: True when any degrade-marked objective breaches the
+    multi-window burn gate (fast AND slow over threshold — exactly the
+    signal the replica's own degradation ladder engages on). The
+    router sheds new traffic to healthy peers while this holds."""
+    if not isinstance(slo_report, dict) or not slo_report.get("enabled"):
+        return False
+    if slo_report.get("degrade_boost", 0) >= 1:
+        return True
+    breaching = slo_report.get("breaching") or ()
+    objectives = slo_report.get("objectives") or {}
+    return any(objectives.get(name, {}).get("degrade")
+               for name in breaching)

@@ -1,0 +1,209 @@
+"""Fleet membership: the shared replica table + `fleet.*` telemetry.
+
+One `Membership` instance is shared by the supervisor (which adds and
+respawns replicas), the router (which routes over it and marks link
+death) and the health monitor (which overlays `degraded` from SLO burn
+probes). All state moves through `transition()`, so the typed state
+machine in health.py is enforced at the ONE choke point — and every
+transition lands in the flight recorder's event stream and the
+`fleet.replica.state{replica}` gauge, because a fleet postmortem is
+exactly "who believed what about whom, when".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+from geomesa_tpu.fleet.health import (
+    state_number, validate_transition)
+
+
+@dataclasses.dataclass
+class ReplicaHandle:
+    """One replica as the fleet sees it. `server`/`proc` is the spawn
+    handle (a ReplicaServer for thread replicas, a subprocess.Popen for
+    process replicas); `link` is the router's wire connection."""
+
+    replica_id: str
+    host: str
+    port: int
+    state: str = "starting"
+    pid: Optional[int] = None
+    metrics_port: Optional[int] = None
+    spawn: str = "thread"       # "thread" | "process"
+    server: object = None       # ReplicaServer (thread spawn)
+    proc: object = None         # subprocess.Popen (process spawn)
+    link: object = None         # router-side ReplicaLink
+    # routing counters (router-owned, read under the membership lock)
+    routed: int = 0
+    retried_onto: int = 0
+    shed: int = 0
+    # health-probe overlay
+    burn_gated: bool = False    # SLO fast+slow burn gates firing
+    probe_failures: int = 0
+    last_probe_s: float = 0.0
+    # lifecycle bookkeeping: incarnation counts respawns of one slot
+    slot: int = 0
+    incarnation: int = 0
+
+    @property
+    def routable(self) -> bool:
+        return self.state in ("ready", "degraded")
+
+
+class Membership:
+    """Thread-safe replica table. The router and supervisor share one;
+    `snapshot()` is the `gmtpu fleet status` / `{"op": "fleet"}`
+    document."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, ReplicaHandle] = {}
+
+    # -- table -------------------------------------------------------------
+
+    def add(self, handle: ReplicaHandle) -> ReplicaHandle:
+        with self._lock:
+            if handle.replica_id in self._replicas:
+                raise ValueError(
+                    f"replica id {handle.replica_id!r} already present")
+            self._replicas[handle.replica_id] = handle
+        self._export_state(handle)
+        return handle
+
+    def get(self, replica_id: str) -> Optional[ReplicaHandle]:
+        with self._lock:
+            return self._replicas.get(replica_id)
+
+    def all(self) -> List[ReplicaHandle]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def routable(self) -> List[ReplicaHandle]:
+        """Replicas eligible for NEW traffic, healthy first: `ready`
+        replicas; `degraded` ones ride along at the back so a fleet
+        whose every member is burning still serves (shedding to nowhere
+        is an outage, not protection)."""
+        with self._lock:
+            live = [h for h in self._replicas.values() if h.routable]
+        return sorted(live, key=lambda h: h.state != "ready")
+
+    # -- state machine -----------------------------------------------------
+
+    def transition(self, replica_id: str, new_state: str,
+                   reason: str = "") -> None:
+        """Move one replica through the typed state machine; exports
+        the gauge and a flight-recorder event. Unknown ids are ignored
+        (a probe may race a respawn that already replaced the slot)."""
+        with self._lock:
+            h = self._replicas.get(replica_id)
+            if h is None:
+                return
+            old = h.state
+            if new_state == old:
+                return
+            h.state = validate_transition(old, new_state)
+        self._export_state(h)
+        try:
+            from geomesa_tpu.telemetry.recorder import RECORDER
+
+            RECORDER.note_event(
+                "fleet.replica.state", replica=replica_id,
+                old=old, new=new_state, detail=reason)
+        # gt: waive GT14
+        # (deliberate degrade: postmortem breadcrumbs are best-effort —
+        # a recorder hiccup must not wedge the state machine the
+        # router's routing decisions depend on)
+        except Exception:
+            pass
+
+    def _export_state(self, h: ReplicaHandle) -> None:
+        from geomesa_tpu.utils.metrics import metrics
+
+        metrics.gauge("fleet.replica.state",
+                      float(state_number(h.state)),
+                      replica=h.replica_id)
+
+    # -- routing counters --------------------------------------------------
+
+    def note_routed(self, replica_id: str, retried: bool = False) -> None:
+        from geomesa_tpu.utils.metrics import metrics
+
+        with self._lock:
+            h = self._replicas.get(replica_id)
+            if h is not None:
+                h.routed += 1
+                if retried:
+                    h.retried_onto += 1
+        metrics.counter("fleet.routed", replica=replica_id)
+        if retried:
+            # the one retry counter: bumped where the retry LANDED, so
+            # the Prometheus series, the router stats and the
+            # membership table all read the same number
+            metrics.counter("fleet.retried")
+
+    def note_shed(self, replica_id: str) -> None:
+        """A burn-gated replica was skipped for one request."""
+        from geomesa_tpu.utils.metrics import metrics
+
+        with self._lock:
+            h = self._replicas.get(replica_id)
+            if h is not None:
+                h.shed += 1
+        metrics.counter("fleet.shed", replica=replica_id)
+
+    def note_probe(self, replica_id: str, ok: bool,
+                   burn_gated: bool = False) -> int:
+        """Record one health-probe outcome; returns the consecutive
+        failure count (the monitor declares death past its threshold).
+        A successful probe also applies the degraded/ready overlay."""
+        with self._lock:
+            h = self._replicas.get(replica_id)
+            if h is None:
+                return 0
+            h.last_probe_s = time.monotonic()
+            if ok:
+                h.probe_failures = 0
+                h.burn_gated = burn_gated
+            else:
+                h.probe_failures += 1
+            failures = h.probe_failures
+            state = h.state
+        if ok and state in ("ready", "degraded"):
+            self.transition(
+                replica_id, "degraded" if burn_gated else "ready",
+                reason="slo burn gates" if burn_gated else "probe ok")
+        return failures
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The `{"op": "fleet"}` / `gmtpu fleet status` document."""
+        with self._lock:
+            replicas = [{
+                "replica": h.replica_id,
+                "addr": f"{h.host}:{h.port}",
+                "state": h.state,
+                "pid": h.pid,
+                "spawn": h.spawn,
+                # thread replicas bind their metrics endpoint
+                # asynchronously during init: read the live value off
+                # the server rather than the spawn-time snapshot
+                "metrics_port": (
+                    getattr(h.server, "metrics_port", None)
+                    if h.server is not None else h.metrics_port),
+                "routed": h.routed,
+                "retried_onto": h.retried_onto,
+                "shed": h.shed,
+                "burn_gated": h.burn_gated,
+                "incarnation": h.incarnation,
+            } for h in self._replicas.values()]
+        return {
+            "replicas": replicas,
+            "ready": sum(1 for r in replicas
+                         if r["state"] in ("ready", "degraded")),
+            "total": len(replicas),
+        }

@@ -1,0 +1,399 @@
+"""One fleet replica: a QueryService behind a TCP JSON-lines listener.
+
+`ReplicaServer` is BOTH deployment shapes: the in-process thread
+replica the supervisor spawns for CI/chaos/tests, and the body of the
+`python -m geomesa_tpu.fleet.replica` worker process (`main()` below —
+the spawn discipline `parallel/launch.py` established: the parent
+passes ports/ids on argv, the child prints one machine-readable ready
+line on stdout and logs to stderr).
+
+Lifecycle (fleet/health.py): the listener binds IMMEDIATELY (port 0 =
+ephemeral, reported in the ready line and `describe()`), but the
+replica answers only control verbs (hello/stats/drain) until it is
+`ready` — query traffic during `starting`/`warming` gets a typed,
+retryable rejection via the protocol's admission gate. With a warmup
+manifest configured, `ready` is gated on `gmtpu warmup --check`
+semantics: the manifest replays AND a second pass proves zero residual
+recompiles before the first query is admitted. A replica whose warmup
+check fails goes `dead`, loudly — serving cold is the failure mode the
+gate exists to prevent.
+
+`drain()` is the graceful exit (stop admitting -> finish in-flight ->
+close -> dead); `abort()` is the chaos path (sockets slammed shut
+mid-flight, service dropped without drain — the in-process stand-in
+for kill -9)."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+from typing import Optional
+
+from geomesa_tpu.fleet.health import validate_transition
+from geomesa_tpu.fleet.wire import POLL_TIMEOUT_S, JsonLineConn
+
+_ACCEPT_TIMEOUT_S = 0.25
+_INIT_WAIT_S = 120.0  # connection handlers wait this long for the service
+
+
+class ReplicaServer:
+    """A serving replica: store + QueryService + listener + the typed
+    state machine. Thread-safe; one instance per replica."""
+
+    def __init__(self, store, config=None, replica_id: str = "r0",
+                 host: str = "127.0.0.1", port: int = 0,
+                 warmup_manifest: Optional[str] = None,
+                 metrics_port: Optional[int] = None,
+                 warmup_hold: Optional[threading.Event] = None):
+        """`store` is a store instance OR a zero-arg factory (thread
+        fleets give each replica its own DataStore over the shared
+        catalog, so queues/caches/counters are per-replica like real
+        processes). `warmup_hold`, when given, parks the replica in
+        `warming` until set — chaos uses it to prove the refusal
+        window is observable, not a race."""
+        self._store_factory = store if callable(store) else (lambda: store)
+        self.config = config
+        self.replica_id = replica_id
+        self.host = host
+        self._requested_port = port
+        self.port: Optional[int] = None
+        self.warmup_manifest = warmup_manifest
+        self.metrics_port_requested = metrics_port
+        self.metrics_port: Optional[int] = None
+        self.warmup_hold = warmup_hold
+        self.store = None
+        self.svc = None
+        self.warmup_report = None
+        self.error: Optional[str] = None
+        self._state = "starting"
+        self._state_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._ready_or_dead = threading.Event()
+        self._svc_built = threading.Event()
+        self._listener: Optional[socket.socket] = None
+        self._metrics_server = None
+        self._threads = []
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+        self._drain_lock = threading.Lock()
+
+    # -- state machine -----------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._state_lock:
+            return self._state
+
+    def _transition(self, new: str, reason: str = "") -> None:
+        with self._state_lock:
+            self._state = validate_transition(self._state, new)
+        if new in ("ready", "dead"):
+            self._ready_or_dead.set()
+
+    def wait_built(self, timeout: float = 600.0) -> bool:
+        """Block until the service (and its metrics endpoint, when
+        requested) exists — the worker's ready line must carry the
+        BOUND metrics port, not a pre-init null."""
+        return self._svc_built.wait(timeout)
+
+    def wait_state(self, *states: str, timeout: float = 60.0) -> str:
+        """Block until the replica reaches one of `states` (or any
+        terminal state); returns the state reached."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            s = self.state
+            if s in states or s == "dead":
+                return s
+            time.sleep(0.01)
+        return self.state
+
+    # -- protocol control surface (serve_connection's `control`) -----------
+
+    def describe(self) -> dict:
+        return {
+            "replica": self.replica_id,
+            "state": self.state,
+            "pid": os.getpid(),
+            "port": self.port,
+            "metrics_port": self.metrics_port,
+        }
+
+    def admitting(self) -> Optional[str]:
+        """None when query traffic is welcome; otherwise the typed
+        refusal reason (== the state name: warming/draining/...)."""
+        s = self.state
+        if s in ("ready", "degraded"):
+            return None
+        return "shutting_down" if s == "dead" else s
+
+    def drain(self) -> dict:
+        """Graceful exit: stop admitting, finish in-flight, close the
+        service, die. Idempotent — a second drain reports the state it
+        finds."""
+        with self._drain_lock:
+            # decide under the lock; the blocking close runs outside it
+            s = self.state
+            if s in ("draining", "dead"):
+                return {"replica": self.replica_id, "state": self.state,
+                        "drained": False}
+            if s in ("starting", "warming"):
+                # not serving yet: nothing in flight to finish
+                self._transition("dead", "drained before ready")
+                self._stop.set()
+                return {"replica": self.replica_id, "state": "dead",
+                        "drained": True}
+            self._transition("draining", "admin drain")
+        svc = self.svc
+        served = 0
+        if svc is not None:
+            svc.close(drain=True)
+            served = svc.stats().get("completed", 0)
+        self._transition("dead", "drain complete")
+        self._stop.set()
+        ms = self._metrics_server
+        if ms is not None:
+            ms.stop()
+        return {"replica": self.replica_id, "state": "dead",
+                "drained": True, "completed": served}
+
+    def abort(self) -> None:
+        """The kill -9 stand-in: slam every socket shut mid-flight and
+        drop the service without draining. In-flight requests on this
+        replica are the router's problem now — which is the point."""
+        with self._state_lock:
+            if self._state != "dead":
+                self._state = "dead"
+        self._ready_or_dead.set()
+        self._stop.set()
+        self._close_listener()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            c.close()
+        if self.svc is not None:
+            try:
+                self.svc.close(drain=False, timeout_s=0.0)
+            except Exception:  # noqa: BLE001 — abort is best-effort
+                pass
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+
+    def stop(self) -> None:
+        """Supervisor cleanup: drain if still serving, then join."""
+        if self.state not in ("dead",):
+            try:
+                self.drain()
+            except Exception:  # noqa: BLE001 — stop must not raise
+                self.abort()
+        self._stop.set()
+        self._close_listener()
+        with self._conns_lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout=5.0)
+
+    # -- serving -----------------------------------------------------------
+
+    def start(self) -> int:
+        """Bind the listener (returns the bound port) and kick off the
+        init thread; serving readiness follows the state machine."""
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.settimeout(_ACCEPT_TIMEOUT_S)
+        listener.bind((self.host, self._requested_port))
+        listener.listen(64)
+        self.port = listener.getsockname()[1]
+        self._listener = listener
+        for name, target in (("init", self._init),
+                             ("accept", self._accept_loop)):
+            t = threading.Thread(
+                target=target, daemon=True,
+                name=f"gmtpu-replica-{self.replica_id}-{name}")
+            t.start()
+            with self._conns_lock:
+                self._threads.append(t)
+        return self.port
+
+    def _init(self) -> None:
+        from geomesa_tpu.serve.service import QueryService, ServeConfig
+
+        try:
+            self.store = self._store_factory()
+            self.svc = QueryService(
+                self.store, self.config or ServeConfig())
+            if self.metrics_port_requested is not None:
+                from geomesa_tpu.telemetry.export import MetricsServer
+
+                self._metrics_server = MetricsServer(
+                    port=self.metrics_port_requested,
+                    stats_fn=self.svc.stats,
+                    pre_scrape=self.svc.export_gauges,
+                    slo_fn=(self.svc.slo.report
+                            if self.svc.slo is not None else None))
+                self.metrics_port = self._metrics_server.start()
+                self.svc.metrics_port = self.metrics_port
+            self._svc_built.set()
+            if self.warmup_manifest:
+                self._transition("warming", "warmup manifest replay")
+                if self.warmup_hold is not None:
+                    # park (observably) in warming until released
+                    while not self.warmup_hold.wait(POLL_TIMEOUT_S):
+                        if self._stop.is_set():
+                            return
+                # the `gmtpu warmup --check` gate: replay, then prove a
+                # second pass compiles NOTHING
+                self.warmup_report = self.svc.warmup(
+                    self.warmup_manifest, check=True)
+                if not self.warmup_report.ok:
+                    self.error = (
+                        "warmup --check failed: "
+                        f"{self.warmup_report.residual_recompiles} "
+                        f"residual recompile(s)")
+                    self._transition("dead", self.error)
+                    return
+            if self.state in ("starting", "warming"):
+                self._transition("ready", "serving")
+        except Exception as e:  # noqa: BLE001 — a dead replica, typed
+            self.error = f"{type(e).__name__}: {e}"
+            self._svc_built.set()
+            try:
+                self._transition("dead", self.error)
+            except Exception:
+                with self._state_lock:
+                    self._state = "dead"
+                self._ready_or_dead.set()
+
+    def _close_listener(self) -> None:
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            listener = self._listener
+            if listener is None:
+                return
+            try:
+                sock, _addr = listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed under us: shutting down
+            conn = JsonLineConn(sock)
+            t = threading.Thread(
+                target=self._handle, args=(conn,), daemon=True,
+                name=f"gmtpu-replica-{self.replica_id}-conn")
+            with self._conns_lock:
+                self._conns.add(conn)
+                # prune finished handlers (long-lived replicas serve
+                # many short connections; no Thread object per
+                # connection forever)
+                self._threads = [x for x in self._threads
+                                 if x.is_alive()]
+                self._threads.append(t)
+            t.start()
+        self._close_listener()
+
+    def _handle(self, conn: JsonLineConn) -> None:
+        from geomesa_tpu.serve.protocol import serve_connection
+
+        try:
+            if not self._svc_built.wait(_INIT_WAIT_S) or self.svc is None:
+                conn.send({"ok": False, "error": "rejected",
+                           "reason": self.admitting() or "starting",
+                           "retryable": True,
+                           "message": "replica failed to initialize"})
+                return
+            def write_line(s: str) -> None:
+                # a peer that vanished (client hung up; abort() slammed
+                # the socket) makes its responses undeliverable — that
+                # is the ROUTER's failover problem, not a dispatcher
+                # error worth a stack trace per in-flight future
+                try:
+                    conn.send_line(s)
+                except OSError:
+                    pass
+
+            serve_connection(
+                self.store, self.svc, conn.lines(self._stop),
+                write_line, control=self)
+        except Exception:  # noqa: BLE001 — one conn, not the replica
+            pass
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            conn.close()
+
+
+def main(argv=None) -> int:
+    """`python -m geomesa_tpu.fleet.replica`: one replica worker
+    process. Prints exactly one JSON ready line on stdout —
+    `{"event": "replica_listening", "port": ..., "pid": ...}` — which
+    is the parent supervisor's spawn contract (parallel/launch.py
+    discipline); everything else goes to stderr."""
+    import argparse
+    import sys
+    import time
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--catalog", "-c", required=True)
+    ap.add_argument("--replica-id", default="r0")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--warmup", default=None, metavar="MANIFEST")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="0 = ephemeral (reported in the ready line); "
+                         "N replicas on one host must not share a "
+                         "fixed port")
+    ap.add_argument("--mesh", default=None, metavar="auto|N|off")
+    ap.add_argument("--slo", default=None, metavar="SPEC")
+    ap.add_argument("--max-queue", type=int, default=128)
+    ap.add_argument("--force-cpu", action="store_true",
+                    help="pin jax to the host CPU platform (CI smokes)")
+    args = ap.parse_args(argv)
+    if args.force_cpu:
+        from geomesa_tpu.parallel.launch import _force_cpu
+
+        _force_cpu()
+    from geomesa_tpu.plan.datastore import DataStore
+    from geomesa_tpu.serve.service import ServeConfig
+
+    server = ReplicaServer(
+        lambda: DataStore(args.catalog, use_device_cache=True),
+        ServeConfig(max_queue=args.max_queue,
+                    mesh=args.mesh, slo=args.slo),
+        replica_id=args.replica_id, host=args.host, port=args.port,
+        warmup_manifest=args.warmup, metrics_port=args.metrics_port)
+    port = server.start()
+    # the ready line is the spawn contract: wait for the service so
+    # metrics_port carries the BOUND ephemeral port (the listener
+    # port above is available immediately either way)
+    server.wait_built()
+    print(json.dumps({
+        "event": "replica_listening", "replica": args.replica_id,
+        "host": args.host, "port": port, "pid": os.getpid(),
+        "metrics_port": server.metrics_port,
+    }), flush=True)
+    state = server.wait_state("ready", timeout=600.0)
+    print(f"replica {args.replica_id}: {state}"
+          + (f" ({server.error})" if server.error else ""),
+          file=sys.stderr, flush=True)
+    if state == "dead":
+        return 1
+    try:
+        while server.state != "dead":
+            time.sleep(0.25)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

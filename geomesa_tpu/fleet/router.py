@@ -1,0 +1,630 @@
+"""The fleet router: one client-facing endpoint over N replicas.
+
+A thin process speaking the existing JSON-lines protocol on both
+sides: clients talk to the router exactly as they would to one `gmtpu
+serve` replica; the router multiplexes every client's requests over
+one persistent admin connection per replica (the wire's `id` field is
+the correlation key — it was designed as a pipelined protocol, so the
+router just rewrites ids).
+
+Routing is per-request, three stages (docs/SERVING.md "Replica
+fleets"):
+
+1. **shard affinity** — rendezvous hash of (type, op, coarse spatial
+   cell) over the live replica set. Same query shape -> same replica
+   while membership is stable, so compiled kernel buckets, device
+   cache lines and quarantine state stay warm; membership churn moves
+   only the 1/N of keys that hashed to the lost replica.
+2. **SLO-burn-aware shedding** — a replica whose fast+slow burn gates
+   fire (probed from its stats verb; the PR-10 ladder exports the
+   signal) is skipped while any healthy peer exists. If EVERY replica
+   is burning, traffic still flows — shedding to nowhere is an outage.
+3. **least-loaded spill** — the affinity pick is overridden when its
+   router-side outstanding count exceeds the least-loaded candidate
+   by `spill_threshold` (affinity is a cache hint, not a hot-spot
+   mandate).
+
+Failover is drain-then-redistribute: when a replica link drops, every
+in-flight request on it fails TYPED as retryable `unavailable`; the
+router retries idempotent ops (all the query verbs — this wire has no
+write verbs, which is what makes retry-once safe: zero
+double-executed writes by construction) ONCE on a healthy peer if the
+request's deadline allows, and answers the typed error otherwise.
+Nothing is ever silently dropped: every request the router accepted
+produces exactly one response line."""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+from zlib import crc32
+
+from geomesa_tpu.fleet.health import burn_gates_fired
+from geomesa_tpu.fleet.membership import Membership, ReplicaHandle
+from geomesa_tpu.fleet.wire import JsonLineConn, connect_json
+
+# ops the router may re-send after a replica death: the read-only query
+# surface. Retrying is safe because these execute no writes; subscribe
+# verbs are replica-sticky and deliberately NOT proxied (docs/
+# ROBUSTNESS.md "what is and is not exactly-once across failover")
+IDEMPOTENT_OPS = frozenset(
+    ("query", "execute", "count", "knn", "stats"))
+_SUBSCRIBE_OPS = frozenset(
+    ("subscribe", "unsubscribe", "poll", "subscriptions"))
+
+_DEFAULT_DEADLINE_S = 30.0
+_PROBE_INTERVAL_S = 0.5
+_PROBE_DEAD_AFTER = 3       # consecutive probe misses -> link torn down
+_SPILL_THRESHOLD = 4        # affinity yields to least-loaded past this
+_ACCEPT_TIMEOUT_S = 0.25
+
+
+class _Pending:
+    """One routed request awaiting its replica response."""
+
+    __slots__ = ("client", "orig_id", "doc", "op", "attempts",
+                 "deadline", "probe_cb")
+
+    def __init__(self, client, orig_id, doc, op, deadline,
+                 probe_cb=None):
+        self.client = client
+        self.orig_id = orig_id
+        self.doc = doc
+        self.op = op
+        self.attempts = 0
+        self.deadline = deadline
+        self.probe_cb = probe_cb
+
+
+class ReplicaLink:
+    """The router's persistent connection to one replica: a writer
+    (any router thread) + one reader thread demultiplexing responses
+    by token. Death (EOF, socket error, probe starvation) runs the
+    router's redistribute hook exactly once."""
+
+    def __init__(self, router: "FleetRouter", handle: ReplicaHandle):
+        self.router = router
+        self.handle = handle
+        self.conn = connect_json(handle.host, handle.port)
+        self.pending: Dict[str, _Pending] = {}
+        self._lock = threading.Lock()
+        self._down = False
+        self._stop = threading.Event()
+        # replica-role handshake BEFORE the reader demux starts: the
+        # hello reply is the one response read synchronously
+        hello = self.conn.request(
+            {"id": "hello", "op": "hello", "role": "router"},
+            timeout_s=10.0)
+        self.hello = hello
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"gmtpu-fleet-link-{handle.replica_id}")
+        self._reader.start()
+
+    @property
+    def alive(self) -> bool:
+        with self._lock:
+            return not self._down
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return sum(1 for p in self.pending.values()
+                       if p.probe_cb is None)
+
+    def send(self, token: str, p: _Pending) -> bool:
+        """Register + transmit. Ownership discipline (the
+        exactly-one-response invariant): presence in `pending` IS
+        ownership. The death sweep (_mark_down) claims every pending
+        it finds; a failed transmit re-claims its own pending only if
+        the sweep has not already — whoever holds the pending (and
+        only they) re-dispatches, so a send racing a link death can
+        never fork one request into two retries and hand the client a
+        duplicate response. Returns True when this call transmitted
+        and still owns the pending; False when the sweep claimed it
+        mid-send (the sweep's redistribution completes the request —
+        the caller must neither re-dispatch nor count the send);
+        raises OSError when the caller must re-dispatch."""
+        with self._lock:
+            if self._down:
+                raise OSError("link down")
+            self.pending[token] = p
+        doc = dict(p.doc)
+        doc["id"] = token
+        try:
+            self.conn.send(doc)
+        except OSError:
+            with self._lock:
+                owned = self.pending.pop(token, None) is not None
+            self.close()
+            if owned:
+                raise  # caller still owns p: it re-dispatches
+            return False   # the death sweep claimed p: ITS retry runs
+        return True
+
+    def _read_loop(self) -> None:
+        # try/finally: the reader MUST reach _mark_down on any exit —
+        # a reader that dies without it leaves the link reporting
+        # alive with stranded pendings nothing will ever redistribute
+        try:
+            for got in self.conn.docs(self._stop):
+                token = got.get("id")
+                if token is None:
+                    continue  # push frame: not proxied
+                with self._lock:
+                    p = self.pending.pop(token, None)
+                if p is None:
+                    continue
+                try:
+                    self.router._deliver(self, p, got)
+                except Exception:  # noqa: BLE001 — one response, not
+                    pass           # the whole link's reader
+        finally:
+            self._mark_down()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.conn.close()
+        self._mark_down()
+
+    def _mark_down(self) -> None:
+        with self._lock:
+            if self._down:
+                return
+            self._down = True
+            orphans = [p for p in self.pending.values()]
+            self.pending.clear()
+        self._stop.set()
+        self.conn.close()
+        self.router._on_link_down(self, orphans)
+
+    def take_expired_probes(self, max_age_s: float) -> int:
+        """Drop probe pendings older than `max_age_s`; returns how many
+        were starved (the monitor's wedge signal)."""
+        now = time.monotonic()
+        with self._lock:
+            stale = [t for t, p in self.pending.items()
+                     if p.probe_cb is not None
+                     and p.deadline + max_age_s < now]
+            for t in stale:
+                self.pending.pop(t, None)
+        return len(stale)
+
+
+class FleetRouter:
+    """Client-facing TCP server + per-replica links + health monitor."""
+
+    def __init__(self, membership: Optional[Membership] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 probe_interval_s: float = _PROBE_INTERVAL_S,
+                 spill_threshold: int = _SPILL_THRESHOLD,
+                 default_deadline_s: float = _DEFAULT_DEADLINE_S,
+                 supervisor=None):
+        self.membership = membership or Membership()
+        self.host = host
+        self._requested_port = port
+        self.port: Optional[int] = None
+        self.probe_interval_s = probe_interval_s
+        self.spill_threshold = spill_threshold
+        self.default_deadline_s = default_deadline_s
+        self.supervisor = supervisor
+        self._tokens = itertools.count(1)
+        self._stop = threading.Event()
+        self._listener: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self._counters_lock = threading.Lock()
+        # "retried" is deliberately absent: it is DERIVED from
+        # membership's per-replica retried_onto in stats(), so the two
+        # surfaces cannot diverge (a retry placed by whichever death
+        # sweep won an ownership race counts exactly once, where the
+        # send landed)
+        self._counters = {"requests": 0, "routed": 0,
+                          "shed": 0, "unavailable": 0, "probes": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> int:
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.settimeout(_ACCEPT_TIMEOUT_S)
+        listener.bind((self.host, self._requested_port))
+        listener.listen(128)
+        self.port = listener.getsockname()[1]
+        self._listener = listener
+        for name, target in (("accept", self._accept_loop),
+                             ("health", self._health_loop)):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"gmtpu-fleet-router-{name}")
+            t.start()
+            with self._counters_lock:
+                self._threads.append(t)
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        for h in self.membership.all():
+            if h.link is not None:
+                h.link.close()
+        with self._counters_lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout=5.0)
+
+    def attach(self, handle: ReplicaHandle) -> ReplicaLink:
+        """Dial a replica and wire it into the routing table. The
+        hello handshake's reported state seeds the membership view."""
+        link = ReplicaLink(self, handle)
+        handle.link = link
+        state = link.hello.get("state")
+        if state in ("warming", "ready"):
+            self.membership.transition(handle.replica_id, state, "hello")
+        return link
+
+    # -- client side -------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            listener = self._listener
+            if listener is None:
+                return
+            try:
+                sock, _addr = listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn = JsonLineConn(sock)
+            t = threading.Thread(
+                target=self._client_loop, args=(conn,), daemon=True,
+                name="gmtpu-fleet-client")
+            t.start()
+            with self._counters_lock:
+                # prune finished handlers: a long-lived router serving
+                # many short CLI/status connections must not grow a
+                # Thread object per connection forever
+                self._threads = [x for x in self._threads
+                                 if x.is_alive()]
+                self._threads.append(t)
+
+    def _client_loop(self, conn: JsonLineConn) -> None:
+        session = {"admin": False}
+        try:
+            n = 0
+            for doc in conn.docs(self._stop):
+                n += 1
+                try:
+                    self.route(doc, conn, session,
+                               default_id=n)
+                except Exception as e:  # noqa: BLE001 — per-request
+                    self._safe_send(conn, {
+                        "id": doc.get("id", n), "ok": False,
+                        "error": "error", "message": str(e)})
+        finally:
+            conn.close()
+
+    def _safe_send(self, client, doc: dict) -> None:
+        try:
+            client.send(doc)
+        except OSError:
+            # hung up, or blew the write deadline mid-frame: the
+            # stream may be torn at a non-boundary — close it so no
+            # later response gets glued to a partial line
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 — already broken
+                pass
+
+    # -- routing -----------------------------------------------------------
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        with self._counters_lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def route(self, doc: dict, client, session: dict,
+              default_id=None) -> None:
+        rid = doc.get("id", default_id)
+        op = doc.get("op", "query")
+        self._bump("requests")
+        if op == "hello":
+            role = str(doc.get("role", "client"))
+            if role in ("router", "admin"):
+                session["admin"] = True
+            self._safe_send(client, {
+                "id": rid, "ok": True, "role": role, "router": True,
+                "admin": session["admin"],
+                **{k: v for k, v in self.membership.snapshot().items()
+                   if k in ("ready", "total")}})
+            return
+        if op == "fleet":
+            self._safe_send(client, {
+                "id": rid, "ok": True, **self.stats()})
+            return
+        if op == "restart":
+            if not session.get("admin"):
+                self._safe_send(client, {
+                    "id": rid, "ok": False, "error": "rejected",
+                    "reason": "admin_required",
+                    "message": "rolling restart needs an admin "
+                               "connection (hello with role admin)"})
+                return
+            if self.supervisor is None:
+                self._safe_send(client, {
+                    "id": rid, "ok": False, "error": "error",
+                    "message": "no supervisor attached to this router"})
+                return
+            result = self.supervisor.rolling_restart()
+            self._safe_send(client, {"id": rid, **result})
+            return
+        if op == "drain":
+            # NEVER proxied: the router's replica links are
+            # admin-privileged (hello role=router), so forwarding a
+            # client's drain would launder it past the replica-side
+            # admin gate and let any client kill replicas one by one
+            self._safe_send(client, {
+                "id": rid, "ok": False, "error": "rejected",
+                "reason": ("admin_required" if not session.get("admin")
+                           else "unsupported"),
+                "message": "the router does not proxy drain: use "
+                           "`gmtpu fleet restart` (admin), or drain a "
+                           "replica on ITS port directly"})
+            return
+        if op in _SUBSCRIBE_OPS:
+            # standing queries are replica-sticky state the router
+            # cannot fail over exactly-once; refuse typed rather than
+            # proxy a stream whose replay semantics we cannot honor
+            self._safe_send(client, {
+                "id": rid, "ok": False, "error": "rejected",
+                "reason": "unsupported",
+                "message": "standing queries are replica-sticky: "
+                           "connect to a replica directly "
+                           "(docs/ROBUSTNESS.md fleet section)"})
+            return
+        deadline = time.monotonic() + (
+            float(doc["timeoutMs"]) / 1000.0 if doc.get("timeoutMs")
+            else self.default_deadline_s)
+        p = _Pending(client, rid, doc, op, deadline)
+        if not self._dispatch(p, exclude=()):
+            self._answer_unavailable(p, "no_replicas")
+
+    def _dispatch(self, p: _Pending, exclude) -> bool:
+        """Pick a replica and send; walks the candidate order on torn
+        sockets so a racing death never bounces a request back to the
+        client while a healthy peer exists."""
+        tried = set(exclude)
+        while True:
+            target = self._pick(p.doc, tried)
+            if target is None:
+                return False
+            token = f"fl{next(self._tokens)}"
+            try:
+                owned = target.link.send(token, p)
+            except OSError:
+                tried.add(target.replica_id)
+                continue
+            if owned:
+                # count only sends we still own: when the death sweep
+                # claimed the pending mid-send, ITS dispatch does the
+                # counting (and p.attempts now belongs to it)
+                self._bump("routed")
+                self.membership.note_routed(
+                    target.replica_id, retried=p.attempts > 0)
+            return True
+
+    def _pick(self, doc: dict,
+              exclude) -> Optional[ReplicaHandle]:
+        live = [h for h in self.membership.routable()
+                if h.link is not None and h.link.alive
+                and h.replica_id not in exclude]
+        if not live:
+            return None
+        key = self._affinity_key(doc)
+        ranked = sorted(
+            live,
+            key=lambda h: crc32(
+                f"{key}|{h.replica_id}".encode()) if key else 0,
+            reverse=True)
+        # SLO-burn shedding: skip gated replicas while a healthy peer
+        # exists (each skip of the affinity-preferred replica counts)
+        healthy = [h for h in ranked
+                   if not h.burn_gated and h.state == "ready"]
+        pool = healthy or ranked
+        if healthy and ranked[0] not in healthy:
+            self._bump("shed")
+            self.membership.note_shed(ranked[0].replica_id)
+        best = pool[0]
+        if len(pool) > 1:
+            least = min(pool, key=lambda h: h.link.outstanding())
+            if (best.link.outstanding()
+                    > least.link.outstanding() + self.spill_threshold):
+                best = least
+        return best
+
+    @staticmethod
+    def _affinity_key(doc: dict) -> Optional[str]:
+        """Stable per-request cache-affinity key: type + op + the
+        coarse spatial cell for kNN (10-degree bins — one replica owns
+        a neighborhood's warm kernel bucket) or the filter text."""
+        t = doc.get("typeName")
+        if t is None:
+            return None  # stats etc: pure least-loaded
+        op = doc.get("op", "query")
+        if op == "knn":
+            try:
+                x = float(doc["x"][0])
+                y = float(doc["y"][0])
+                cell = f"{int(x // 10)}:{int(y // 10)}"
+            except (KeyError, IndexError, TypeError, ValueError):
+                cell = ""
+            return f"{t}|knn|{cell}"
+        return f"{t}|{op}|{doc.get('cql', '')}"
+
+    # -- responses + failover ----------------------------------------------
+
+    def _deliver(self, link: ReplicaLink, p: _Pending,
+                 got: dict) -> None:
+        if p.probe_cb is not None:
+            p.probe_cb(got)
+            return
+        if (not got.get("ok") and got.get("retryable")
+                and got.get("reason") in ("warming", "draining",
+                                          "starting", "shutting_down")
+                and p.attempts < 1
+                and time.monotonic() < p.deadline):
+            # a replica that went draining/warming between pick and
+            # dispatch answers typed-retryable: move the request to a
+            # peer instead of bouncing the lifecycle race to the client
+            p.attempts += 1
+            if self._dispatch(p, exclude=(link.handle.replica_id,)):
+                return
+        out = dict(got)
+        out["id"] = p.orig_id
+        self._safe_send(p.client, out)
+
+    def _on_link_down(self, link: ReplicaLink,
+                      orphans: List[_Pending]) -> None:
+        """Drain-then-redistribute: the dead replica's in-flight
+        requests either retry ONCE on a healthy peer (idempotent op,
+        deadline allows) or fail typed `unavailable` — never silently
+        dropped."""
+        rid = link.handle.replica_id
+        self.membership.transition(rid, "dead", "link down")
+        try:
+            from geomesa_tpu.telemetry.recorder import RECORDER
+
+            RECORDER.note_event("fleet.link.down", replica=rid,
+                                inflight=len(orphans))
+        # gt: waive GT14
+        # (deliberate degrade: the postmortem breadcrumb must not block
+        # the redistribute that un-blocks the orphaned clients)
+        except Exception:
+            pass
+        for p in orphans:
+            if p.probe_cb is not None:
+                continue
+            if (p.op in IDEMPOTENT_OPS and p.attempts < 1
+                    and time.monotonic() < p.deadline):
+                p.attempts += 1
+                if self._dispatch(p, exclude=(rid,)):
+                    continue
+            self._answer_unavailable(p, "replica_unavailable")
+
+    def _answer_unavailable(self, p: _Pending, reason: str) -> None:
+        self._bump("unavailable")
+        from geomesa_tpu.utils.metrics import metrics
+
+        metrics.counter("fleet.unavailable", reason=reason)
+        self._safe_send(p.client, {
+            "id": p.orig_id, "ok": False, "error": "unavailable",
+            "reason": reason, "retryable": True,
+            "message": "replica lost mid-request; retry is safe "
+                       "(idempotent read) — the fleet is "
+                       "redistributing"})
+
+    # -- health probes -----------------------------------------------------
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            for h in self.membership.all():
+                link = h.link
+                if link is None or not link.alive:
+                    continue
+                if h.state == "dead":
+                    continue
+                starved = link.take_expired_probes(
+                    self.probe_interval_s * _PROBE_DEAD_AFTER)
+                if starved and self.membership.note_probe(
+                        h.replica_id, ok=False) >= _PROBE_DEAD_AFTER:
+                    # wedged, not merely slow: tear the link down so
+                    # in-flight work redistributes instead of waiting
+                    # on a socket that will never answer
+                    link.close()
+                    continue
+                self._probe(h, link)
+
+    def _probe(self, h: ReplicaHandle, link: ReplicaLink) -> None:
+        self._bump("probes")
+
+        def on_stats(got: dict) -> None:
+            from geomesa_tpu.fleet.health import ReplicaStateError
+
+            stats = got.get("stats") or {}
+            rep = stats.get("replica") or {}
+            state = rep.get("state")
+            if (state in ("warming", "ready")
+                    and h.state in ("starting", "warming")):
+                # lifecycle progress is replica-reported; the
+                # degraded<->ready overlay below is the router's own
+                # judgment and must not be fought by self-reports
+                self.membership.transition(h.replica_id, state, "probe")
+            elif state in ("draining", "dead"):
+                try:
+                    self.membership.transition(h.replica_id, state,
+                                               "probe")
+                except ReplicaStateError:
+                    # the probe reports REALITY, possibly having
+                    # missed intermediate steps (warming -> drained
+                    # before we ever saw ready): dead is legal from
+                    # every state
+                    self.membership.transition(h.replica_id, "dead",
+                                               "probe")
+                return
+            self.membership.note_probe(
+                h.replica_id, ok=True,
+                burn_gated=burn_gates_fired(stats.get("slo") or {}))
+
+        token = f"pr{next(self._tokens)}"
+        p = _Pending(None, None, {"op": "stats"}, "stats",
+                     time.monotonic(), probe_cb=on_stats)
+        try:
+            link.send(token, p)
+        except OSError:
+            pass  # link death path handles redistribution
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._counters_lock:
+            counters = dict(self._counters)
+        snap = self.membership.snapshot()
+        counters["retried"] = sum(r["retried_onto"]
+                                  for r in snap["replicas"])
+        return {"router": counters, **snap}
+
+    def export_gauges(self) -> None:
+        from geomesa_tpu.utils.metrics import metrics
+
+        snap = self.stats()
+        metrics.gauge("fleet.replicas.ready", float(snap["ready"]))
+        metrics.gauge("fleet.replicas.total", float(snap["total"]))
+        for name, v in snap["router"].items():
+            metrics.gauge("fleet.router", float(v), counter=name)
+
+
+class FleetClient:
+    """A synchronous JSON-lines client for a router (or a bare
+    replica): the CLI's `gmtpu fleet status|restart` path and the
+    bench/chaos drivers. One request at a time per instance."""
+
+    def __init__(self, host: str, port: int,
+                 timeout_s: float = 10.0):
+        self.conn = connect_json(host, port, timeout_s=timeout_s)
+        self._ids = itertools.count(1)
+
+    def hello(self, role: str = "client") -> dict:
+        return self.request({"op": "hello", "role": role})
+
+    def request(self, doc: dict, timeout_s: float = 60.0) -> dict:
+        doc = dict(doc)
+        doc.setdefault("id", f"c{next(self._ids)}")
+        return self.conn.request(doc, timeout_s=timeout_s)
+
+    def close(self) -> None:
+        self.conn.close()

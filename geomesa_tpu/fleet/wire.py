@@ -1,0 +1,150 @@
+"""Bounded-blocking JSON-lines sockets for the fleet tier.
+
+Every socket in `fleet/` carries a timeout (lint rule GT20 enforces
+it): an unbounded `connect`/`recv` in the router would wedge the whole
+fleet behind one dead peer. Reads poll with a short timeout and a stop
+event instead of blocking forever, and the line buffer is hand-rolled
+(`makefile()` readers lose buffered bytes when a timeout interrupts a
+read mid-line; a byte buffer split on newline cannot tear)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Iterator, Optional
+
+# how long one recv() may block before re-checking the stop event; the
+# latency floor for noticing a drain/abort, not a request deadline
+POLL_TIMEOUT_S = 0.25
+CONNECT_TIMEOUT_S = 5.0
+# total budget for ONE outbound frame: a peer that cannot drain its
+# socket for this long is wedged, not slow — the caller may tear the
+# connection down (router failover) rather than block forever
+WRITE_TIMEOUT_S = 30.0
+_RECV_CHUNK = 65536
+
+
+def connect_json(host: str, port: int,
+                 timeout_s: float = CONNECT_TIMEOUT_S) -> "JsonLineConn":
+    """Dial a replica/router endpoint with a bounded connect."""
+    sock = socket.create_connection((host, port), timeout=timeout_s)
+    return JsonLineConn(sock)
+
+
+class JsonLineConn:
+    """One JSON-lines conversation over a connected socket: `send`
+    serializes whole documents under a lock (interleaved writers —
+    the router's request path vs its probe loop — may share one
+    connection), `docs()` yields parsed lines until EOF, error, or the
+    caller's stop event."""
+
+    def __init__(self, sock: socket.socket,
+                 poll_timeout_s: float = POLL_TIMEOUT_S):
+        self.sock = sock
+        self.sock.settimeout(poll_timeout_s)
+        self._wlock = threading.Lock()
+        self._buf = b""
+        self._closed = False
+
+    def send(self, doc: dict) -> None:
+        self._write((json.dumps(doc) + "\n").encode())
+
+    def send_line(self, line: str) -> None:
+        self._write((line.rstrip("\n") + "\n").encode())
+
+    def _write(self, data: bytes) -> None:
+        """Whole-frame write under the short socket poll timeout:
+        `sendall` would raise mid-frame on a backpressured peer and
+        TEAR THE FRAMING (the next write lands glued to a partial
+        line, and the reader drops both). `send()` reports progress,
+        so partial writes resume; a peer that accepts nothing for
+        WRITE_TIMEOUT_S raises OSError with the stream positioned at
+        a frame boundary for nobody — the caller must close the
+        connection, never keep writing."""
+        import time
+
+        with self._wlock:
+            deadline = time.monotonic() + WRITE_TIMEOUT_S
+            view = memoryview(data)
+            while view:
+                try:
+                    n = self.sock.send(view)
+                except socket.timeout:
+                    if time.monotonic() > deadline:
+                        raise OSError(
+                            "write timed out: peer not draining")
+                    continue
+                view = view[n:]
+
+    def lines(self, stop: Optional[threading.Event] = None
+              ) -> Iterator[str]:
+        """Decoded lines until EOF / socket error / stop. A timeout is
+        not an error — it is the poll that keeps shutdown bounded."""
+        while stop is None or not stop.is_set():
+            nl = self._buf.find(b"\n")
+            if nl >= 0:
+                line = self._buf[:nl]
+                # gt: waive GT07
+                # (reader-confined: exactly ONE thread drives
+                # lines()/docs() per connection by contract, so the
+                # read buffer never crosses threads; _wlock guards
+                # the WRITE side only — taking it here would stall
+                # reads behind every concurrent send)
+                self._buf = self._buf[nl + 1:]
+                yield line.decode("utf-8", "replace")
+                continue
+            try:
+                chunk = self.sock.recv(_RECV_CHUNK)
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # peer vanished: EOF for the caller
+            if not chunk:
+                return
+            # gt: waive GT07
+            # (reader-confined, see above)
+            self._buf += chunk
+
+    def docs(self, stop: Optional[threading.Event] = None
+             ) -> Iterator[dict]:
+        for line in self.lines(stop):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue  # torn line from an aborted peer: skip
+
+    def request(self, doc: dict, timeout_s: float = 30.0) -> dict:
+        """One synchronous round trip (probe/CLI use — NOT the router's
+        multiplexed request path). Skips interleaved push frames; the
+        deadline is enforced by a timer-driven stop event, so a peer
+        that never answers cannot hold the caller past `timeout_s`."""
+        self.send(doc)
+        want = doc.get("id")
+        stop = threading.Event()
+        timer = threading.Timer(timeout_s, stop.set)
+        timer.start()
+        try:
+            for got in self.docs(stop):
+                if want is None or got.get("id") == want:
+                    return got
+        finally:
+            timer.cancel()
+        raise TimeoutError(
+            f"no response to {doc.get('op')!r} within {timeout_s}s")
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass

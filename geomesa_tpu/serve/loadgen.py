@@ -456,6 +456,150 @@ def run_subscribe(
     return rep
 
 
+def run_fleet_bench(
+    catalog: str,
+    type_name: str,
+    n_replicas: int = 2,
+    duration_s: float = 5.0,
+    clients: int = 8,
+    k: int = 8,
+    kill: bool = True,
+    kill_window_s: float = 2.0,
+    seed: int = 0,
+) -> dict:
+    """`gmtpu bench-serve --fleet N`: closed-loop clients over the
+    ROUTER's wire (real sockets, real failover), one replica killed
+    abruptly at half-time. The report separates overall latency from
+    the p99 DURING the kill window — the number the fleet exists for —
+    and asserts the accounting the chaos certification relies on:
+    every request answered (zero dropped), zero un-typed errors.
+    Thread-spawn replicas: same code path as deployment minus process
+    spin-up, so the comparison measures routing + failover, not jax
+    import time."""
+    import time as _time
+
+    from geomesa_tpu.fleet import FleetConfig, FleetSupervisor
+    from geomesa_tpu.fleet.wire import connect_json
+
+    sup = FleetSupervisor(FleetConfig(
+        n_replicas=n_replicas, catalog=catalog,
+        probe_interval_s=0.25))
+    lock = threading.Lock()
+    lat: List[tuple] = []      # (t_done, latency_s, ok)
+    counts = {"sent": 0, "ok": 0, "unavailable": 0, "rejected": 0,
+              "timeout": 0, "untyped": 0, "answered": 0}
+    kill_at = [None]
+    try:
+        port = sup.start()
+        # warm EVERY replica before the measured window: kernel jits
+        # are process-wide (thread spawn) but filter-compile and
+        # residency caches are per-replica — an unwarmed replica would
+        # charge its cold compiles to the measured p99 (and leave the
+        # kill window empty of completions on slow CI hosts)
+        for h in sup.membership.all():
+            wconn = connect_json(h.host, h.port)
+            try:
+                for wid, wdoc in (
+                    ("w1", {"op": "knn", "typeName": type_name,
+                            "cql": "BBOX(geom, -180, -90, 180, 90)",
+                            "x": [1.5], "y": [2.5], "k": k}),
+                    ("w2", {"op": "count", "typeName": type_name,
+                            "cql": "BBOX(geom, -180, -90, 180, 90)"}),
+                ):
+                    wconn.request({"id": wid, **wdoc}, timeout_s=300.0)
+            finally:
+                wconn.close()
+        stop = threading.Event()
+
+        def client(cid: int):
+            rng = np.random.default_rng(seed * 9973 + cid)
+            conn = connect_json("127.0.0.1", port)
+            i = 0
+            try:
+                while not stop.is_set():
+                    qx = float(rng.uniform(-60, 60))
+                    qy = float(rng.uniform(-60, 60))
+                    doc = {"id": f"c{cid}-{i}", "op": "knn",
+                           "typeName": type_name,
+                           "cql": "BBOX(geom, -180, -90, 180, 90)",
+                           "x": [qx], "y": [qy], "k": k,
+                           "timeoutMs": 30_000}
+                    with lock:
+                        counts["sent"] += 1
+                    t0 = _time.monotonic()
+                    try:
+                        got = conn.request(doc, timeout_s=60.0)
+                    except (OSError, TimeoutError):
+                        with lock:
+                            counts["untyped"] += 1
+                        return
+                    dt = _time.monotonic() - t0
+                    with lock:
+                        counts["answered"] += 1
+                        if got.get("ok"):
+                            counts["ok"] += 1
+                            lat.append((_time.monotonic(), dt, True))
+                        elif got.get("error") in ("unavailable",
+                                                  "rejected", "timeout"):
+                            counts[got["error"]] += 1
+                            lat.append((_time.monotonic(), dt, False))
+                        else:
+                            counts["untyped"] += 1
+                    i += 1
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=client, args=(c,),
+                                    daemon=True)
+                   for c in range(clients)]
+        t_start = _time.monotonic()
+        for t in threads:
+            t.start()
+        if kill and n_replicas > 1:
+            _time.sleep(duration_s / 2.0)
+            victim = next(h.replica_id for h in sup.membership.all()
+                          if h.state in ("ready", "degraded"))
+            kill_at[0] = _time.monotonic()
+            sup.kill_replica(victim, graceful=False)
+        deadline = t_start + duration_s
+        while _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=90.0)
+        wall = _time.monotonic() - t_start
+        router = sup.stats()["router"]
+    finally:
+        sup.close()
+
+    ok_lat = np.asarray([d for _, d, ok in lat if ok], np.float64) * 1e3
+
+    def q(arr, p):
+        return round(float(np.percentile(arr, p)), 3) if len(arr) else 0.0
+
+    doc = {
+        "mode": "fleet",
+        "replicas": n_replicas,
+        "duration_s": round(wall, 3),
+        "killed": kill and n_replicas > 1,
+        **counts,
+        "dropped": counts["sent"] - counts["answered"]
+        - counts["untyped"],
+        "throughput_qps": round(counts["ok"] / wall, 2) if wall else 0.0,
+        "p50_ms": q(ok_lat, 50), "p99_ms": q(ok_lat, 99),
+        "retried": router["retried"],
+        "shed": router["shed"],
+    }
+    if kill_at[0] is not None:
+        in_window = np.asarray(
+            [d for t, d, ok in lat if ok
+             and kill_at[0] <= t <= kill_at[0] + kill_window_s],
+            np.float64) * 1e3
+        doc["p99_during_kill_ms"] = q(in_window, 99)
+        doc["served_during_kill"] = int(len(in_window))
+    return doc
+
+
 # -- request factories -----------------------------------------------------
 
 

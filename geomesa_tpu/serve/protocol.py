@@ -43,6 +43,17 @@ quarantine, pipeline — and the SLO burn report when `--slo` loaded a
 spec), so a wire client can watch its own error budget without a
 separate metrics scrape.
 
+Fleet verbs (docs/SERVING.md "Replica fleets"): `{"op": "hello",
+"role": "router"}` is the replica-role handshake — the response
+carries the replica's id + health state, and a `router`/`admin` role
+marks the CONNECTION admin. `{"op": "drain"}` (admin-only; rejected
+typed on plain client connections) drains gracefully: stop admitting,
+finish every in-flight request, then close — so the fleet router and
+`gmtpu fleet restart` never need process signals. A replica that is
+not `ready` (warming until `gmtpu warmup --check` semantics pass, or
+draining) refuses query traffic with a typed, retryable rejection
+instead of serving cold or torn.
+
 Errors are per-request, never fatal to the stream: a malformed line
 yields an ok=false response and the loop continues — one bad client
 request must not drop everyone else's connection.
@@ -351,6 +362,13 @@ class _SubscribeSession:
                 self.svc.subscriptions = None
 
 
+ADMIN_ROLES = ("router", "admin")
+
+# ops a non-ready replica still answers (health probes, handshakes and
+# lifecycle verbs must work WHILE warming/draining — that is the point)
+CONTROL_OPS = ("hello", "drain", "stats")
+
+
 def serve_lines(
     store,
     lines: Iterable[str],
@@ -364,10 +382,39 @@ def serve_lines(
     Returns the number of requests processed. A caller that needs the
     service before the loop starts (the `--metrics-port` endpoint binds
     its stats provider to it) passes one in; ownership transfers — the
-    loop drains and closes it either way."""
+    loop drains and closes it either way.
+
+    The stdin/file conversation is the process owner's, so it is
+    admin: `{"op": "drain"}` here drains the service in place (new
+    requests answer typed `shutting_down` while in-flight work
+    finishes)."""
     svc = service if service is not None else QueryService(store, config)
+    try:
+        return serve_connection(store, svc, lines, write, admin=True)
+    finally:
+        svc.close(drain=True)
+
+
+def serve_connection(
+    store,
+    svc: QueryService,
+    lines: Iterable[str],
+    write,
+    admin: bool = False,
+    control=None,
+) -> int:
+    """One JSON-lines conversation over a SHARED QueryService: the
+    replica server runs one of these per accepted socket (the service
+    outlives the connection — closing it is the caller's job; contrast
+    `serve_lines`, which owns its service). `control` is the replica's
+    lifecycle surface (fleet/replica.py): `describe()` feeds the hello
+    handshake, `admitting()` gates query traffic on the health state
+    machine, `drain()` implements the admin drain verb. `admin` seeds
+    the connection's role; a hello with role router/admin upgrades
+    it."""
     out_lock = threading.Lock()
     processed = 0
+    is_admin = admin
 
     def respond(doc: dict) -> None:
         with out_lock:
@@ -414,16 +461,67 @@ def serve_lines(
             try:
                 doc = json.loads(line)
                 rid = doc.get("id", processed)
-                if doc.get("op") in SUBSCRIBE_OPS:
+                op = doc.get("op")
+                if op == "hello":
+                    # replica-role handshake: the response names the
+                    # replica + its health state; router/admin roles
+                    # upgrade the connection to admin (drain rights)
+                    role = str(doc.get("role", "client"))
+                    if role in ADMIN_ROLES:
+                        is_admin = True
+                    out = {"id": rid, "ok": True, "role": role,
+                           "admin": is_admin}
+                    if control is not None:
+                        out.update(control.describe())
+                    respond(out)
+                    continue
+                if op == "drain":
+                    if not is_admin:
+                        # lifecycle is the supervisor's, not a
+                        # client's: reject typed, keep serving
+                        respond({"id": rid, "ok": False,
+                                 "error": "rejected",
+                                 "reason": "admin_required",
+                                 "message": "drain needs an admin "
+                                            "connection (hello with "
+                                            "role router/admin)"})
+                        continue
+                    if control is not None:
+                        respond({"id": rid, "ok": True,
+                                 **control.drain()})
+                    else:
+                        # standalone serve: drain the service in place
+                        # — stop admitting, finish in-flight, close
+                        svc.close(drain=True)
+                        respond({"id": rid, "ok": True,
+                                 "state": "drained"})
+                    continue
+                if control is not None and op not in CONTROL_OPS:
+                    refusal = control.admitting()
+                    if refusal is not None:
+                        # a replica that is warming (gmtpu warmup
+                        # --check not yet green) or draining refuses
+                        # traffic TYPED and retryable — the router
+                        # redistributes; nothing serves cold
+                        respond({"id": rid, "ok": False,
+                                 "error": "rejected",
+                                 "reason": refusal,
+                                 "retryable": True,
+                                 "message": f"replica not ready "
+                                            f"({refusal})"})
+                        continue
+                if op in SUBSCRIBE_OPS:
                     subs.handle(rid, doc)
                     continue
-                if doc.get("op") == "stats":
+                if op == "stats":
                     # introspection verb: the service's live counters
                     # (+ SLO burn report when a spec is loaded) without
                     # a scrape endpoint — wire clients watch their own
                     # error budget on the connection they already hold
-                    respond({"id": rid, "ok": True,
-                             "stats": svc.stats()})
+                    stats = svc.stats()
+                    if control is not None:
+                        stats["replica"] = control.describe()
+                    respond({"id": rid, "ok": True, "stats": stats})
                     continue
                 req = parse_request(doc)
                 fut = svc.submit(req)
@@ -433,5 +531,4 @@ def serve_lines(
                                         else processed, e))
     finally:
         subs.close()
-        svc.close(drain=True)
     return processed

@@ -203,6 +203,12 @@ class QueryService:
         # the wire layer when the first subscribe verb arrives, so
         # stats()/debug endpoints surface subscription state
         self.subscriptions = None
+        # the bound /metrics port, when the owner started a
+        # MetricsServer for this service (gmtpu serve --metrics-port,
+        # fleet replicas). With port=0 the OS picks — N replicas on one
+        # host must not collide on a fixed port — so the bound value is
+        # reported here and in the startup line, not assumed
+        self.metrics_port: Optional[int] = None
         # pipelined dispatch path (serve/pipeline.py): the default for
         # kNN windows; its completer thread starts lazily on the first
         # pipelined window
@@ -951,6 +957,8 @@ class QueryService:
         out["queue_depth"] = len(self.queue)
         out["degrade_level"] = self.degrade_level()
         out["quarantine"] = self.quarantine.stats()
+        if self.metrics_port is not None:
+            out["metrics_port"] = self.metrics_port
         subs = self.subscriptions  # racing close() may null the attr
         if subs is not None:
             out["subscriptions"] = subs.stats()

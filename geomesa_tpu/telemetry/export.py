@@ -181,7 +181,11 @@ class MetricsServer:
                     metrics.to_prometheus().encode())
         if path == "/healthz":
             doc = {"ok": True,
-                   "uptime_s": round(monotonic() - self._started_at, 3)}
+                   "uptime_s": round(monotonic() - self._started_at, 3),
+                   # the BOUND port (port=0 requests an ephemeral one):
+                   # a prober that reached us learns the canonical
+                   # address other tools should use
+                   "endpoint": {"host": self.host, "port": self.port}}
             if self.stats_fn is not None:
                 try:
                     doc["serve"] = self.stats_fn()
@@ -220,7 +224,9 @@ class MetricsServer:
         stats + breaker states + recorder occupancy, one JSON doc."""
         from geomesa_tpu.utils.metrics import metrics
 
-        doc: dict = {"metrics": json.loads(metrics.to_json())}
+        doc: dict = {"metrics": json.loads(metrics.to_json()),
+                     "endpoint": {"host": self.host, "port": self.port,
+                                  "url": self.url}}
         if self.stats_fn is not None:
             try:
                 doc["serve"] = self.stats_fn()

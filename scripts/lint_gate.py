@@ -307,6 +307,117 @@ def sentinel_smoke() -> int:
     return 1 if failures else 0
 
 
+def fleet_smoke() -> int:
+    """A 2-replica thread fleet on CPU over a tiny store, one scripted
+    abrupt replica kill mid-burst (docs/ROBUSTNESS.md "Replica
+    fleets"): every request must come back as a result or a typed
+    retryable error — zero un-typed, zero dropped, zero duplicate
+    responses — and the router's gauges must stay consistent with the
+    answers the client actually saw (routed >= answered requests,
+    retried reflected in membership). Stderr-only like the other
+    smokes."""
+    _pin_cpu()
+    import json
+    import tempfile
+    import threading
+    import time
+
+    import numpy as np
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.fleet import FleetConfig, FleetSupervisor
+    from geomesa_tpu.fleet.wire import connect_json
+    from geomesa_tpu.plan.datastore import DataStore
+
+    failures = []
+    rng = np.random.default_rng(7)
+    n = 384
+    burst = 16
+    sft = SimpleFeatureType.from_spec(
+        "fleetsmoke", "name:String,score:Double,dtg:Date,*geom:Point")
+    with tempfile.TemporaryDirectory() as tmp:
+        ds = DataStore(tmp, use_device_cache=True)
+        ds.create_schema(sft).write(FeatureBatch.from_pydict(sft, {
+            "name": rng.choice(["a", "b"], n).tolist(),
+            "score": rng.uniform(-10, 10, n),
+            "dtg": rng.integers(
+                1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack([rng.uniform(-170, 170, n),
+                              rng.uniform(-80, 80, n)], 1),
+        }))
+        del ds
+        sup = FleetSupervisor(FleetConfig(
+            n_replicas=2, catalog=tmp, probe_interval_s=0.2))
+        try:
+            port = sup.start()
+            conn = connect_json("127.0.0.1", port)
+            # warm both replica planners outside the measured burst
+            conn.request({"id": "w", "op": "count",
+                          "typeName": "fleetsmoke", "cql": "INCLUDE"},
+                         timeout_s=300.0)
+            qp = rng.uniform(-60, 60, (burst, 2))
+            for i in range(burst):
+                conn.send({"id": f"q{i}", "op": "knn",
+                           "typeName": "fleetsmoke", "cql": "INCLUDE",
+                           "x": [float(qp[i, 0])],
+                           "y": [float(qp[i, 1])], "k": 4,
+                           "timeoutMs": 60_000})
+            sup.kill_replica("r0", graceful=False)
+            answers = {}
+            stop = threading.Event()
+            timer = threading.Timer(120.0, stop.set)
+            timer.start()
+            for got in conn.docs(stop):
+                rid = got.get("id")
+                if rid in answers:
+                    failures.append(f"duplicate response for {rid}")
+                answers[rid] = got
+                if len(answers) >= burst:
+                    break
+            timer.cancel()
+            conn.close()
+            if len(answers) != burst:
+                failures.append(
+                    f"{burst} requests, {len(answers)} answers: "
+                    f"requests dropped during failover")
+            untyped = [r for r in answers.values()
+                       if not r.get("ok")
+                       and r.get("error") not in ("unavailable",
+                                                  "rejected",
+                                                  "timeout")]
+            if untyped:
+                failures.append(f"un-typed client error(s): "
+                                f"{untyped[:3]}")
+            snap = sup.stats()
+            routed_total = sum(r["routed"] for r in snap["replicas"])
+            if routed_total < len(answers):
+                failures.append(
+                    f"router gauges inconsistent: routed_total="
+                    f"{routed_total} < answered={len(answers)}")
+            retried_onto = sum(r["retried_onto"]
+                               for r in snap["replicas"])
+            if snap["router"]["retried"] != retried_onto:
+                failures.append(
+                    f"router gauges inconsistent: retried="
+                    f"{snap['router']['retried']} but membership "
+                    f"says {retried_onto}")
+            states = {r["replica"]: r["state"]
+                      for r in snap["replicas"]}
+            if states.get("r0") != "dead" or states.get("r1") != "ready":
+                failures.append(f"post-kill states wrong: {states}")
+            ok_n = sum(1 for r in answers.values() if r.get("ok"))
+            print(
+                f"fleet smoke: {len(answers)}/{burst} answered "
+                f"({ok_n} ok), retried={snap['router']['retried']}, "
+                f"states={states}", file=sys.stderr)
+        finally:
+            sup.close()
+    for f in failures:
+        print(f"fleet smoke: FAIL {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def warmup_smoke(manifest_path: str = SMOKE_MANIFEST) -> int:
     """`gmtpu warmup --check` against the fixture manifest, pinned to
     CPU (the fixture records interpret-mode kernels; this gate must run
@@ -359,6 +470,11 @@ def main(argv=None) -> int:
                    help="skip the perf-regression sentinel smoke "
                         "(record -> replay -> ok; synthetic 3x "
                         "slowdown -> regressed; text mode only)")
+    p.add_argument("--no-fleet-smoke", action="store_true",
+                   help="skip the replica-fleet smoke (2-replica "
+                        "fleet on CPU, one scripted kill, zero "
+                        "un-typed errors + consistent router gauges; "
+                        "text mode only)")
     args = p.parse_args(argv)
     findings = lint_paths([os.path.join(REPO_ROOT, "geomesa_tpu")])
     if args.format == "json":
@@ -376,6 +492,8 @@ def main(argv=None) -> int:
         rc = telemetry_smoke()
     if args.format == "text" and not args.no_sentinel_smoke and rc == 0:
         rc = sentinel_smoke()
+    if args.format == "text" and not args.no_fleet_smoke and rc == 0:
+        rc = fleet_smoke()
     return rc
 
 

@@ -1050,6 +1050,121 @@ class TestGT19MetricLabelConsistency:
         assert not active([f for f in fs if f.rule == "GT19"])
 
 
+class TestGT20SocketTimeouts:
+    """Unbounded socket calls in fleet scope (docs/ANALYSIS.md GT20):
+    a connect/recv with no timeout in the router blocks its reader
+    thread forever behind one dead peer — the whole fleet's failover
+    wedges with it."""
+
+    def _findings(self, src, relpath="geomesa_tpu/fleet/router.py"):
+        from geomesa_tpu.analysis.modinfo import ModInfo
+        from geomesa_tpu.analysis.rules import gt20
+
+        mod = ModInfo("/x.py", textwrap.dedent(src), relpath=relpath)
+        return list(gt20(mod, None))
+
+    DIRTY = """
+        import socket
+
+        def dial(host, port):
+            s = socket.socket()
+            s.connect((host, port))
+            return s.recv(4096)
+
+        def dial2(host, port):
+            return socket.create_connection((host, port))
+
+        def serve(listener):
+            conn, _ = listener.accept()
+            return conn
+    """
+
+    def test_unbounded_calls_flagged(self):
+        found = self._findings(self.DIRTY)
+        lines = sorted((f.rule, f.line) for f in found)
+        # connect(6), recv(7), create_connection(10), accept(13)
+        assert lines == [("GT20", 6), ("GT20", 7),
+                         ("GT20", 10), ("GT20", 13)], lines
+
+    def test_clean_counterparts(self):
+        clean = """
+            import socket
+
+            class Link:
+                def __init__(self, host, port):
+                    # cross-method: configured here, read elsewhere
+                    self.sock = socket.create_connection(
+                        (host, port), timeout=5.0)
+                    self.sock.settimeout(0.25)
+
+                def read(self):
+                    return self.sock.recv(4096)
+
+            def dial(host, port):
+                s = socket.socket()
+                s.settimeout(2.0)
+                s.connect((host, port))
+                return s.recv(64)
+
+            def dial_positional(host, port):
+                c = socket.create_connection((host, port), 5.0)
+                return c
+
+            def serve(listener):
+                listener.settimeout(0.25)
+                conn, _ = listener.accept()
+                return conn
+        """
+        assert self._findings(clean) == []
+
+    def test_setdefaulttimeout_exempts_module(self):
+        src = """
+            import socket
+
+            socket.setdefaulttimeout(3.0)
+
+            def dial(host, port):
+                s = socket.socket()
+                s.connect((host, port))
+                return s.recv(64)
+        """
+        assert self._findings(src) == []
+
+    def test_scope_is_path_limited(self):
+        # the engine talks no sockets; other layers are out of scope
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/engine/device.py") == []
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/serve/protocol.py") != []
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/fleet/wire.py") != []
+
+    def test_registration(self):
+        from geomesa_tpu.analysis.model import RULES
+        from geomesa_tpu.analysis.rules import ALL_RULES
+
+        assert "GT20" in RULES and "GT20" in ALL_RULES
+
+    def test_waiver(self, tmp_path):
+        import pathlib
+
+        sub = pathlib.Path(tmp_path) / "geomesa_tpu" / "fleet"
+        sub.mkdir(parents=True)
+        (sub / "x.py").write_text(textwrap.dedent("""
+            import socket
+
+            def dial(host, port):
+                s = socket.socket()
+                # gt: waive GT20
+                s.connect((host, port))
+                return s
+        """))
+        fs = lint_paths([str(tmp_path)], rules=["GT20"],
+                        extra_ref_paths=[])
+        assert any(f.rule == "GT20" and f.waived for f in fs)
+        assert not active([f for f in fs if f.rule == "GT20"])
+
+
 # -- self-lint --------------------------------------------------------------
 
 

@@ -1,0 +1,533 @@
+"""Replica fleet: router failover certification (docs/ROBUSTNESS.md
+"Replica fleets").
+
+The load-bearing claims, proven over real sockets on CPU:
+
+- routed answers are BIT-identical to direct single-store execution;
+- a replica killed abruptly (abort = the in-process kill -9) mid-burst
+  under the fault harness loses nothing: every client answer is either
+  a correct result (bit-identical to a single-replica run) or a typed
+  retryable error — zero un-typed, zero dropped, zero duplicates;
+- the drain verb is admin-gated and graceful (in-flight finishes, new
+  traffic refused typed);
+- a fresh replica refuses traffic (typed, retryable) until its warmup
+  check is green, and the router never routes to it before `ready`;
+- rolling restart drains one replica at a time and ends with fresh
+  incarnations serving;
+- ephemeral metrics ports (port=0) are reported in stats()/debug
+  endpoints so N replicas on one host never collide.
+
+Budget note (tier-1 wall): ONE tiny module-scoped catalog with the
+same 384-row shape / k=5 kNN buckets the chaos suite (test_faults)
+already compiled — the fleet pays sockets and routing, not kernels.
+Process-spawn coverage (real `python -m geomesa_tpu.fleet.replica`
+workers paying jax import) is marked slow.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.core.columnar import FeatureBatch
+from geomesa_tpu.core.sft import SimpleFeatureType
+from geomesa_tpu.fleet import (
+    FleetConfig, FleetSupervisor, ReplicaServer, ReplicaStateError,
+    state_number, validate_transition)
+from geomesa_tpu.fleet.health import burn_gates_fired
+from geomesa_tpu.fleet.wire import connect_json
+from geomesa_tpu.plan.datastore import DataStore
+
+N_ROWS = 384
+CQL = "BBOX(geom, -170, -80, 170, 80)"
+K = 5
+
+
+@pytest.fixture(scope="module")
+def catalog(tmp_path_factory):
+    rng = np.random.default_rng(5)
+    sft = SimpleFeatureType.from_spec(
+        "fleeted", "name:String,score:Double,dtg:Date,*geom:Point")
+    root = str(tmp_path_factory.mktemp("fleet"))
+    ds = DataStore(root, use_device_cache=True)
+    ds.create_schema(sft).write(FeatureBatch.from_pydict(sft, {
+        "name": rng.choice(["a", "b", "c"], N_ROWS).tolist(),
+        "score": rng.uniform(-10, 10, N_ROWS),
+        "dtg": rng.integers(
+            1_590_000_000_000, 1_590_080_000_000, N_ROWS),
+        "geom": np.stack([rng.uniform(-170, 170, N_ROWS),
+                          rng.uniform(-80, 80, N_ROWS)], 1),
+    }))
+    del ds
+    return root
+
+
+@pytest.fixture(scope="module")
+def oracle_store(catalog):
+    """Direct single-store execution — the bit-identity reference."""
+    return DataStore(catalog, use_device_cache=True)
+
+
+def _fleet(catalog, n=2, **kw):
+    return FleetSupervisor(FleetConfig(
+        n_replicas=n, catalog=catalog, probe_interval_s=0.2, **kw))
+
+
+def _qpts(n, seed=3):
+    return np.random.default_rng(seed).uniform(-60, 60, (n, 2))
+
+
+def _knn_doc(rid, x, y, timeout_ms=60_000):
+    return {"id": rid, "op": "knn", "typeName": "fleeted", "cql": CQL,
+            "x": [float(x)], "y": [float(y)], "k": K,
+            "timeoutMs": timeout_ms}
+
+
+class TestStateMachine:
+    def test_legal_and_illegal_transitions(self):
+        assert validate_transition("starting", "warming") == "warming"
+        assert validate_transition("warming", "ready") == "ready"
+        assert validate_transition("ready", "degraded") == "degraded"
+        assert validate_transition("degraded", "ready") == "ready"
+        assert validate_transition("ready", "draining") == "draining"
+        assert validate_transition("draining", "dead") == "dead"
+        assert validate_transition("ready", "ready") == "ready"  # no-op
+        for bad in (("ready", "warming"), ("dead", "ready"),
+                    ("warming", "degraded"), ("draining", "ready")):
+            with pytest.raises(ReplicaStateError):
+                validate_transition(*bad)
+        with pytest.raises(ReplicaStateError):
+            validate_transition("ready", "nonsense")
+        with pytest.raises(ReplicaStateError):
+            state_number("nonsense")
+
+    def test_burn_gate_reading(self):
+        assert not burn_gates_fired({})
+        assert not burn_gates_fired({"enabled": False})
+        assert burn_gates_fired({"enabled": True, "degrade_boost": 1})
+        assert burn_gates_fired({
+            "enabled": True, "degrade_boost": 0,
+            "breaching": ["knn_p99"],
+            "objectives": {"knn_p99": {"degrade": True}}})
+        # a breaching objective NOT marked degrade does not shed
+        assert not burn_gates_fired({
+            "enabled": True, "degrade_boost": 0,
+            "breaching": ["availability"],
+            "objectives": {"availability": {"degrade": False}}})
+
+
+class TestRouting:
+    def test_routed_answers_bit_identical_to_direct(
+            self, catalog, oracle_store):
+        qp = _qpts(8)
+        src = oracle_store.get_feature_source("fleeted")
+        oracle = [src.knn(CQL, qp[i:i + 1, 0], qp[i:i + 1, 1], k=K)
+                  for i in range(8)]
+        want_count = src.get_count(CQL)
+        sup = _fleet(catalog)
+        try:
+            port = sup.start()
+            cli = connect_json("127.0.0.1", port)
+            for i in range(8):
+                got = cli.request(_knn_doc(f"q{i}", qp[i, 0], qp[i, 1]),
+                                  timeout_s=300.0)
+                assert got["ok"], got
+                d, ix, _ = oracle[i]
+                assert got["indices"] == [[int(j) for j in row]
+                                          for row in ix]
+                assert got["dists"] == [
+                    [float(v) for v in row] for row in d]  # bit-exact
+            got = cli.request({"id": "c", "op": "count",
+                               "typeName": "fleeted", "cql": CQL},
+                              timeout_s=300.0)
+            assert got["ok"] and got["count"] == want_count
+            # stats routes like a query and carries the replica's view
+            got = cli.request({"id": "s", "op": "stats"})
+            assert got["ok"] and got["stats"]["replica"]["state"] == \
+                "ready"
+            snap = sup.stats()
+            assert snap["router"]["requests"] >= 10
+            assert sum(r["routed"] for r in snap["replicas"]) >= 10
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_subscribe_ops_refused_typed(self, catalog):
+        sup = _fleet(catalog, n=1)
+        try:
+            port = sup.start()
+            cli = connect_json("127.0.0.1", port)
+            got = cli.request({"id": "s1", "op": "subscribe",
+                               "typeName": "fleeted", "cql": CQL})
+            assert not got["ok"] and got["error"] == "rejected"
+            assert got["reason"] == "unsupported"
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_burn_gated_replica_sheds_to_healthy_peer(self, catalog):
+        """SLO-burn-aware routing: when the affinity-preferred replica's
+        burn gates fire, new traffic goes to a healthy peer (and the
+        skip is counted); when EVERY replica is gated, traffic still
+        flows."""
+        sup = _fleet(catalog)
+        try:
+            sup.start()
+            # find a key whose rendezvous affinity prefers r0
+            doc = None
+            for i in range(64):
+                cand = _knn_doc(f"p{i}", float(i * 7 % 60), 5.0)
+                ranked = sorted(
+                    sup.membership.routable(),
+                    key=lambda h: __import__("zlib").crc32(
+                        f"{sup.router._affinity_key(cand)}|"
+                        f"{h.replica_id}".encode()),
+                    reverse=True)
+                if ranked[0].replica_id == "r0":
+                    doc = cand
+                    break
+            assert doc is not None
+            sup.membership.get("r0").burn_gated = True
+            shed0 = sup.stats()["router"]["shed"]
+            picked = sup.router._pick(doc, exclude=())
+            assert picked.replica_id == "r1"
+            assert sup.stats()["router"]["shed"] == shed0 + 1
+            # all gated: traffic still flows (shedding to nowhere is
+            # an outage, not protection)
+            sup.membership.get("r1").burn_gated = True
+            assert sup.router._pick(doc, exclude=()) is not None
+        finally:
+            sup.close()
+
+
+class TestFailover:
+    def test_kill_mid_burst_every_answer_typed_or_exact(
+            self, catalog, oracle_store):
+        """The satellite certification: kill -9 a replica mid-burst
+        under the fault harness; every client answer is a correct
+        (bit-identical) result or a typed retryable error; zero
+        dropped, zero duplicates."""
+        from geomesa_tpu.faults import harness as _harness
+        from geomesa_tpu.faults.plan import FaultPlan, FaultRule
+
+        burst = 16
+        qp = _qpts(burst, seed=9)
+        src = oracle_store.get_feature_source("fleeted")
+        oracle = {
+            f"q{i}": src.knn(CQL, qp[i:i + 1, 0], qp[i:i + 1, 1], k=K)
+            for i in range(burst)}
+        sup = _fleet(catalog)
+        try:
+            port = sup.start()
+            cli = connect_json("127.0.0.1", port)
+            # warm both replicas so the burst measures routing, and so
+            # in-flight work is genuinely mid-kernel when the kill lands
+            for rep in sup.membership.all():
+                w = connect_json(rep.host, rep.port)
+                w.request(_knn_doc("w", 1.0, 2.0), timeout_s=300.0)
+                w.close()
+            # injected device latency keeps several requests in flight
+            # across the kill (the harness is the load shaper here; its
+            # fires need no replay determinism in this test)
+            plan = FaultPlan(seed=13, rules=[FaultRule(
+                site="device.transfer", error="latency",
+                latency_ms=15.0, every=1)])
+            with _harness.active(plan):
+                for i in range(burst):
+                    cli.send(_knn_doc(f"q{i}", qp[i, 0], qp[i, 1]))
+                sup.kill_replica("r0", graceful=False)
+                answers = {}
+                stop = threading.Event()
+                timer = threading.Timer(120.0, stop.set)
+                timer.start()
+                for got in cli.docs(stop):
+                    assert got["id"] not in answers, \
+                        f"duplicate response {got['id']}"
+                    answers[got["id"]] = got
+                    if len(answers) >= burst:
+                        break
+                timer.cancel()
+            assert len(answers) == burst, sorted(answers)
+            for rid, got in answers.items():
+                if got.get("ok"):
+                    d, ix, _ = oracle[rid]
+                    assert got["indices"] == [
+                        [int(j) for j in row] for row in ix], rid
+                    assert got["dists"] == [
+                        [float(v) for v in row] for row in d], rid
+                else:
+                    assert got.get("error") in (
+                        "unavailable", "rejected", "timeout"), got
+                    assert got.get("retryable", True), got
+            snap = sup.stats()
+            states = {r["replica"]: r["state"]
+                      for r in snap["replicas"]}
+            assert states == {"r0": "dead", "r1": "ready"}
+            # gauge consistency: retries counted on both surfaces
+            assert snap["router"]["retried"] == sum(
+                r["retried_onto"] for r in snap["replicas"])
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_drain_verb_admin_gated_and_graceful(self, catalog):
+        sup = _fleet(catalog)
+        try:
+            port = sup.start()
+            h0 = sup.membership.get("r0")
+            # a plain client may not drain
+            direct = connect_json(h0.host, h0.port)
+            got = direct.request({"id": "d0", "op": "drain"})
+            assert not got["ok"] and got["reason"] == "admin_required"
+            # an admin connection drains: hello upgrades the role
+            hello = direct.request({"id": "h", "op": "hello",
+                                    "role": "admin"})
+            assert hello["ok"] and hello["admin"] is True
+            assert hello["replica"] == "r0"
+            got = direct.request({"id": "d1", "op": "drain"},
+                                 timeout_s=120.0)
+            assert got["ok"] and got["state"] == "dead", got
+            direct.close()
+            assert h0.server.state == "dead"
+            # the survivor keeps serving through the router
+            cli = connect_json("127.0.0.1", port)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                got = cli.request(_knn_doc("a1", 3.0, 4.0),
+                                  timeout_s=120.0)
+                if got.get("ok"):
+                    break
+                assert got.get("error") in ("unavailable",), got
+            assert got["ok"], got
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_warming_gate_refuses_until_check_green(self, catalog):
+        """A fresh replica refuses traffic typed+retryable until its
+        warmup manifest replays with --check semantics green — and the
+        router never considers it routable before ready."""
+        from geomesa_tpu.compilecache.manifest import WarmupManifest
+        from geomesa_tpu.fleet.membership import ReplicaHandle
+
+        sup = _fleet(catalog, n=1)
+        try:
+            sup.start()
+            mpath = catalog + "/warm_manifest.json"
+            WarmupManifest().save(mpath)
+            hold = threading.Event()
+            rep = ReplicaServer(
+                lambda: DataStore(catalog, use_device_cache=True),
+                replica_id="w0", warmup_manifest=mpath,
+                warmup_hold=hold)
+            port = rep.start()
+            handle = ReplicaHandle(replica_id="w0", host="127.0.0.1",
+                                   port=port, spawn="thread",
+                                   server=rep)
+            sup.membership.add(handle)
+            sup.router.attach(handle)
+            assert rep.wait_state("warming", timeout=60.0) == "warming"
+            probe = connect_json("127.0.0.1", port)
+            got = probe.request(_knn_doc("w1", 1.0, 2.0))
+            assert not got["ok"] and got["reason"] == "warming"
+            assert got["retryable"] is True
+            # control verbs still answer while warming
+            st = probe.request({"id": "s", "op": "stats"})
+            assert st["ok"] and st["stats"]["replica"]["state"] == \
+                "warming"
+            assert not any(h.replica_id == "w0"
+                           for h in sup.membership.routable())
+            hold.set()
+            assert rep.wait_state("ready", timeout=120.0) == "ready"
+            assert rep.warmup_report is not None and \
+                rep.warmup_report.ok
+            got = probe.request(_knn_doc("w2", 1.0, 2.0),
+                                timeout_s=120.0)
+            assert got["ok"], got
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if any(h.replica_id == "w0"
+                       for h in sup.membership.routable()):
+                    break
+                time.sleep(0.05)
+            assert any(h.replica_id == "w0"
+                       for h in sup.membership.routable())
+            probe.close()
+            rep.stop()
+        finally:
+            sup.close()
+
+    def test_rolling_restart(self, catalog):
+        sup = _fleet(catalog)
+        try:
+            port = sup.start()
+            result = sup.rolling_restart()
+            assert result["ok"], result
+            assert len(result["rolled"]) == 2
+            assert all(r["state"] == "ready" for r in result["rolled"])
+            snap = sup.stats()
+            states = {r["replica"]: r["state"]
+                      for r in snap["replicas"]}
+            # old incarnations dead, fresh ones (r0.1, r1.1) serving
+            assert states["r0"] == "dead" and states["r1"] == "dead"
+            assert states["r0.1"] == "ready"
+            assert states["r1.1"] == "ready"
+            cli = connect_json("127.0.0.1", port)
+            got = cli.request(_knn_doc("rr", 5.0, 6.0), timeout_s=120.0)
+            assert got["ok"], got
+            cli.close()
+        finally:
+            sup.close()
+
+
+class TestProtocolDrain:
+    def test_standalone_serve_lines_drain(self, catalog):
+        """The drain verb without a fleet: `serve_lines` (stdin is the
+        process owner's, hence admin) drains in place — in-flight
+        work finishes, later requests answer typed shutting_down."""
+        from geomesa_tpu.serve.protocol import serve_lines
+
+        store = DataStore(catalog, use_device_cache=True)
+        out = []
+        lines = [
+            json.dumps({"id": "c1", "op": "count",
+                        "typeName": "fleeted", "cql": CQL}),
+            json.dumps({"id": "d1", "op": "drain"}),
+            json.dumps({"id": "c2", "op": "count",
+                        "typeName": "fleeted", "cql": CQL}),
+        ]
+        serve_lines(store, lines, out.append)
+        docs = {json.loads(s)["id"]: json.loads(s) for s in out}
+        assert docs["c1"]["ok"]
+        assert docs["d1"]["ok"] and docs["d1"]["state"] == "drained"
+        assert not docs["c2"]["ok"]
+        assert docs["c2"]["reason"] == "shutting_down"
+
+    def test_wire_restart_is_admin_gated(self, catalog):
+        from geomesa_tpu.fleet import FleetClient
+
+        sup = _fleet(catalog, n=1)
+        try:
+            port = sup.start()
+            cli = FleetClient("127.0.0.1", port)
+            got = cli.request({"op": "restart"})
+            assert not got["ok"] and got["reason"] == "admin_required"
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_router_never_proxies_drain(self, catalog):
+        """The router's replica links are admin-privileged, so
+        forwarding a client's drain would launder it past the
+        replica-side admin gate: the router must refuse the verb for
+        EVERY session and leave the replica serving."""
+        from geomesa_tpu.fleet import FleetClient
+
+        sup = _fleet(catalog, n=1)
+        try:
+            port = sup.start()
+            cli = FleetClient("127.0.0.1", port)
+            got = cli.request({"op": "drain"})
+            assert not got["ok"] and got["reason"] == "admin_required"
+            cli.hello(role="admin")
+            got = cli.request({"op": "drain"})
+            assert not got["ok"] and got["reason"] == "unsupported"
+            # the replica is untouched and still serving
+            assert sup.membership.get("r0").state == "ready"
+            got = cli.request({"id": "q", "op": "count",
+                               "typeName": "fleeted", "cql": CQL},
+                              timeout_s=120.0)
+            assert got["ok"]
+            cli.close()
+        finally:
+            sup.close()
+
+
+class TestMetricsPort:
+    def test_ephemeral_port_reported(self, catalog):
+        """Satellite: MetricsServer port=0 + the bound port reported in
+        stats() and the debug endpoints — N replicas on one host must
+        not collide on a fixed port."""
+        import urllib.request
+
+        rep = ReplicaServer(
+            lambda: DataStore(catalog, use_device_cache=True),
+            replica_id="m0", metrics_port=0)
+        rep.start()
+        try:
+            assert rep.wait_state("ready", timeout=60.0) == "ready"
+            assert rep.metrics_port not in (None, 0)
+            assert rep.svc.stats()["metrics_port"] == rep.metrics_port
+            assert rep.describe()["metrics_port"] == rep.metrics_port
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{rep.metrics_port}/healthz",
+                    timeout=10) as r:
+                doc = json.loads(r.read().decode())
+            assert doc["endpoint"]["port"] == rep.metrics_port
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{rep.metrics_port}/debug/stats",
+                    timeout=10) as r:
+                doc = json.loads(r.read().decode())
+            assert doc["endpoint"]["port"] == rep.metrics_port
+            assert doc["serve"]["metrics_port"] == rep.metrics_port
+        finally:
+            rep.stop()
+
+    def test_fleet_snapshot_reports_bound_ports(self, catalog):
+        """The {"op": "fleet"} / status document must carry each
+        replica's BOUND ephemeral metrics port (thread replicas bind
+        theirs asynchronously during init)."""
+        sup = _fleet(catalog, metrics_port=0)
+        try:
+            sup.start()
+            ports = [r["metrics_port"]
+                     for r in sup.stats()["replicas"]]
+            assert all(p not in (None, 0) for p in ports), ports
+            assert len(set(ports)) == len(ports), ports
+        finally:
+            sup.close()
+
+    def test_two_replicas_distinct_ports(self, catalog):
+        reps = [ReplicaServer(
+            lambda: DataStore(catalog, use_device_cache=True),
+            replica_id=f"mp{i}", metrics_port=0) for i in range(2)]
+        try:
+            for r in reps:
+                r.start()
+            for r in reps:
+                assert r.wait_state("ready", timeout=60.0) == "ready"
+            ports = {r.metrics_port for r in reps}
+            assert len(ports) == 2 and None not in ports
+        finally:
+            for r in reps:
+                r.stop()
+
+
+@pytest.mark.slow
+class TestProcessSpawn:
+    def test_process_fleet_kill_and_failover(self, catalog):
+        """Real OS-process replicas (jax import and all): spawn 2,
+        serve, kill -9 one, keep serving. The deployment shape."""
+        sup = FleetSupervisor(FleetConfig(
+            n_replicas=2, catalog=catalog, spawn="process",
+            probe_interval_s=0.3, force_cpu_workers=True))
+        try:
+            port = sup.start()
+            cli = connect_json("127.0.0.1", port)
+            got = cli.request(_knn_doc("p1", 1.0, 2.0),
+                              timeout_s=600.0)
+            assert got["ok"], got
+            victim = sup.membership.get("r0")
+            assert victim.pid is not None
+            sup.kill_replica("r0", graceful=False)
+            got = cli.request(_knn_doc("p2", 3.0, 4.0),
+                              timeout_s=600.0)
+            assert got["ok"], got
+            states = {r["replica"]: r["state"]
+                      for r in sup.stats()["replicas"]}
+            assert states["r0"] == "dead" and states["r1"] == "ready"
+            cli.close()
+        finally:
+            sup.close()
