@@ -93,6 +93,10 @@ RULES: Dict[str, Rule] = {
                      "fleet//serve/protocol.py scope — one dead peer "
                      "wedges the router's reader thread and with it "
                      "every client's failover"),
+        Rule("GT21", "result-cache key built from raw CQL text instead "
+                     "of the canonical ast.to_cql form: equivalent "
+                     "filter spellings fork the key space into a "
+                     "cache-miss storm (serve/approx/plan scope)"),
     )
 }
 

@@ -1295,6 +1295,95 @@ def gt20(mod: ModInfo, project) -> Iterator[Finding]:
             f"documented deliberate block")
 
 
+# GT21 scope: the layers that mint or consult result-cache keys. The
+# cache contract (geomesa_tpu.approx.cache) keys on the CANONICAL CQL
+# (ast.to_cql of the parsed filter); a site keying on raw filter TEXT
+# silently forks the key space — equivalent spellings ("a=1 AND b=2" vs
+# "a = 1  AND  b = 2") miss each other and a dashboard fleet's repeated
+# queries become a cache-miss storm.
+_GT21_PREFIXES = ("geomesa_tpu/serve/", "geomesa_tpu/approx/",
+                  "geomesa_tpu/plan/")
+
+# receivers that look like a result cache (dotted tail, lowercased)
+_GT21_CACHE_NAMES = ("result_cache", "results_cache", "rcache")
+
+_GT21_KEY_FNS = ("result_key", "cache_key")
+
+
+def _gt21_raw_cql(node: ast.AST) -> Optional[ast.AST]:
+    """First subexpression that reads RAW filter text: `<x>.cql`,
+    `<x>["cql"]`, or `<x>.get("cql", ...)`."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "cql":
+            return sub
+        if isinstance(sub, ast.Subscript):
+            sl = sub.slice
+            if isinstance(sl, ast.Constant) and sl.value == "cql":
+                return sub
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "get" and sub.args
+                and isinstance(sub.args[0], ast.Constant)
+                and sub.args[0].value == "cql"):
+            return sub
+    return None
+
+
+def _gt21_recv_tail(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr.lower()
+    if isinstance(expr, ast.Name):
+        return expr.id.lower()
+    return ""
+
+
+def gt21(mod: ModInfo, project) -> Iterator[Finding]:
+    """GT21: result-cache insertion/lookup sites keying on raw CQL
+    text instead of the canonical form.
+
+    Flags (a) calls to a cache-key builder (`result_key` /
+    `cache_key`, bare or dotted) whose arguments read raw filter text
+    (`<x>.cql`, `<x>["cql"]`, `<x>.get("cql")`), and (b) `.get()` /
+    `.put()` / `.peek()` on a result-cache-shaped receiver
+    (`*result_cache*`, `rcache`) whose key arguments do. The clean
+    form passes the Query OBJECT (the builder canonicalizes through
+    the AST) or `ast.to_cql(query.filter_ast)`. Waivable inline
+    (`# gt: waive GT21`) for a documented deliberate raw-text key."""
+    path = mod.relpath.replace("\\", "/")
+    if not any(p in path for p in _GT21_PREFIXES):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        fname = (f.attr if isinstance(f, ast.Attribute)
+                 else f.id if isinstance(f, ast.Name) else "")
+        hit = None
+        if fname in _GT21_KEY_FNS:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                hit = _gt21_raw_cql(arg)
+                if hit is not None:
+                    break
+        elif (fname in ("get", "put", "peek")
+                and isinstance(f, ast.Attribute)
+                and any(n in _gt21_recv_tail(f.value)
+                        for n in _GT21_CACHE_NAMES)):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                hit = _gt21_raw_cql(arg)
+                if hit is not None:
+                    break
+        if hit is None:
+            continue
+        yield _finding(
+            "GT21", mod, node,
+            "result-cache key built from RAW CQL text: equivalent "
+            "filter spellings fork the key space into a cache-miss "
+            "storm. Pass the Query object to approx.cache.result_key "
+            "(it canonicalizes via ast.to_cql), or canonicalize "
+            "explicitly with ast.to_cql(query.filter_ast); waive a "
+            "documented deliberate raw-text key.")
+
+
 from geomesa_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES)
 
@@ -1303,5 +1392,6 @@ ALL_RULES = {
     "GT04": gt04, "GT05": gt05, "GT06": gt06,
     "GT13": gt13, "GT14": gt14, "GT15": gt15, "GT16": gt16,
     "GT17": gt17, "GT18": gt18, "GT19": gt19, "GT20": gt20,
+    "GT21": gt21,
     **CONCURRENCY_RULES,
 }

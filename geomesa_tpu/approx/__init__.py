@@ -1,0 +1,32 @@
+"""geomesa_tpu.approx — the approximate-answer serving tier.
+
+Two pieces (docs/SERVING.md "Approximate answers"):
+
+- **Sketch answer engine** (`SketchAnswerEngine`): `count` / `density`
+  / `topk_cells` queries resolved in microseconds from per-partition
+  mergeable occupancy sketches, merged under the plan's
+  `manifest_snapshot()` (all-or-nothing per committed write version)
+  and returned with TYPED deterministic error bounds on the wire
+  (`approx=True, bound, confidence`). Routed only when the a-priori
+  bound fits the client's `tolerance` hint and the SLO exactness
+  budget is healthy — budget spent means MORE traffic to the exact
+  device path, never silent accuracy loss.
+- **Exact result cache** (`ResultCache`): count/execute results keyed
+  on (typeName, canonical CQL, hints, manifest version) — invalidation
+  is exact by construction, not TTL; repeated dashboard queries cost a
+  dict lookup and return bit-identical results.
+"""
+
+from geomesa_tpu.approx.cache import ResultCache, result_key
+from geomesa_tpu.approx.engine import (
+    ApproxCount, SketchAnswerEngine, sketch_eligible)
+from geomesa_tpu.approx.sketches import (
+    PartitionSketch, PartitionSketchStore, StaleSketch, entry_token,
+    merge_count_bounds, resample_bounds, topk_cell_bounds, world_cells)
+
+__all__ = [
+    "ApproxCount", "PartitionSketch", "PartitionSketchStore",
+    "ResultCache", "SketchAnswerEngine", "StaleSketch", "entry_token",
+    "merge_count_bounds", "resample_bounds", "result_key",
+    "sketch_eligible", "topk_cell_bounds", "world_cells",
+]

@@ -255,7 +255,10 @@ def register(sub: "argparse._SubParsersAction") -> None:
     bserve_p.add_argument("--k", type=int, default=8, help="kNN k")
     bserve_p.add_argument("--mode", default="closed",
                           choices=["closed", "open", "sustained",
-                                   "subscribe"])
+                                   "subscribe", "approx"])
+    bserve_p.add_argument("--tolerance", type=float, default=0.1,
+                          help="approx mode: tolerant clients' accuracy "
+                               "contract (bound <= tolerance * answer)")
     bserve_p.add_argument("--subs", type=int, default=8,
                           help="subscribe mode: standing subscriptions "
                                "(bbox/dwithin geofences + density "
@@ -652,6 +655,8 @@ def _bench_serve(args) -> int:
         args.rows = min(args.rows, 32)
     if args.mode == "subscribe":
         return _bench_subscribe(args)
+    if args.mode == "approx":
+        return _bench_approx(args)
     if getattr(args, "fleet", None):
         return _bench_fleet(args)
     with contextlib.ExitStack() as stack:
@@ -971,6 +976,141 @@ def _bench_subscribe(args) -> int:
     return 0
 
 
+def _bench_approx(args) -> int:
+    """`gmtpu bench-serve --mode approx`: tolerant vs exact count
+    clients over one synthetic (or supplied) store — the sketch-tier
+    speedup headline, tier shares, zero bound violations, and the
+    result-cache second-pass hit. The measured run disables the result
+    cache so `exact_p50` is the honest device-scan number; a short
+    second pass with the cache on reports the repeated-dashboard-query
+    hit rate."""
+    import contextlib
+    import tempfile
+
+    import numpy as np
+
+    from geomesa_tpu.plan import DataStore
+    from geomesa_tpu.serve.loadgen import run_approx
+    from geomesa_tpu.serve.service import QueryService, ServeConfig
+
+    with contextlib.ExitStack() as stack:
+        if args.catalog:
+            if not args.feature_name:
+                print("error: --catalog needs --feature-name",
+                      file=sys.stderr)
+                return 2
+            store = DataStore(args.catalog, use_device_cache=True)
+            type_name = args.feature_name
+        else:
+            from geomesa_tpu.core.columnar import FeatureBatch
+            from geomesa_tpu.core.sft import SimpleFeatureType
+
+            tmp = stack.enter_context(tempfile.TemporaryDirectory())
+            rng = np.random.default_rng(11)
+            sft = SimpleFeatureType.from_spec(
+                "bench", "name:String,score:Double,dtg:Date,*geom:Point")
+            store = DataStore(tmp, use_device_cache=True)
+            src = store.create_schema(sft)
+            src.write(FeatureBatch.from_pydict(sft, {
+                "name": rng.choice(["a", "b", "c"], args.n).tolist(),
+                "score": rng.uniform(-10, 10, args.n),
+                "dtg": rng.integers(
+                    1_590_000_000_000, 1_600_000_000_000, args.n),
+                "geom": np.stack([rng.uniform(-170, 170, args.n),
+                                  rng.uniform(-80, 80, args.n)], 1),
+            }))
+            type_name = sft.name
+        cqls = ["BBOX(geom, -180, -90, 180, 90)",
+                "BBOX(geom, -60, -30, 60, 30)",
+                "BBOX(geom, 0, 0, 90, 45)"]
+        planner = store.get_feature_source(type_name).planner
+        from geomesa_tpu.plan.query import Query
+
+        # exact oracle + warm (compiles, sketches, device cache) stay
+        # outside the measured window
+        exact_counts = {c: int(planner.count(Query(type_name, c)))
+                        for c in cqls}
+        record_baseline = getattr(args, "record_baseline", None)
+        sentinel_path = getattr(args, "sentinel", None)
+        profiling = record_baseline or sentinel_path
+        if profiling:
+            from geomesa_tpu.telemetry import RECORDER, TRACER
+            from geomesa_tpu.telemetry.prof import PROFILER
+
+            RECORDER.clear()
+            TRACER.enable()
+            PROFILER.reset()
+            PROFILER.enable()
+        svc = QueryService(store, ServeConfig(
+            max_wait_ms=args.max_wait_ms, result_cache=0))
+        try:
+            rep = run_approx(
+                svc, type_name, cqls, duration_s=args.duration,
+                clients=args.clients, tolerance=args.tolerance,
+                exact_counts=exact_counts)
+        finally:
+            svc.close(drain=True)
+        print(json.dumps({"run": "approx", **rep.to_json()}))
+        # second pass, cache ON: repeated exact queries must hit
+        svc2 = QueryService(store, ServeConfig(max_wait_ms=0.0))
+        try:
+            for c in cqls:
+                svc2.count(type_name, c).result(timeout=300)
+            for c in cqls:
+                svc2.count(type_name, c).result(timeout=300)
+            cache = svc2.stats().get("cache", {})
+        finally:
+            svc2.close(drain=True)
+        print(json.dumps({"run": "approx_cache_pass",
+                          "hits": cache.get("hits", 0),
+                          "misses": cache.get("misses", 0)}))
+        ok = (rep.bound_violations == 0 and rep.tier_sketch > 0
+              and cache.get("hits", 0) >= len(cqls))
+        print(json.dumps({
+            "run": "approx_verdict", "ok": ok,
+            "speedup_p50": round(rep.approx_speedup_p50, 1),
+            "bound_violations": rep.bound_violations,
+            "tiers": {"sketch": rep.tier_sketch,
+                      "cached": rep.tier_cached,
+                      "exact": rep.tier_exact}}))
+        if profiling:
+            from geomesa_tpu.telemetry import TRACER
+            from geomesa_tpu.telemetry import sentinel as snt
+            from geomesa_tpu.telemetry.prof import PROFILER
+
+            profile_doc = PROFILER.snapshot(include_samples=True)
+            PROFILER.disable()
+            TRACER.disable()
+            doc = snt.baseline_from_profile(
+                profile_doc, latency_samples_ms=rep.samples_ms,
+                extra_samples={
+                    "approx.count.sketch": rep.approx_samples_ms,
+                    "approx.count.exact": rep.exact_samples_ms,
+                },
+                extra={"mode": "approx", "n": args.n,
+                       "tolerance": args.tolerance,
+                       "speedup_p50": round(rep.approx_speedup_p50, 2)})
+            if record_baseline:
+                path = snt.save_baseline(record_baseline, doc)
+                print(json.dumps({"run": "baseline", "path": path,
+                                  "metrics": len(doc["metrics"])}))
+            if sentinel_path:
+                baseline = snt.load_baseline(sentinel_path)
+                kw = {}
+                if getattr(args, "sentinel_threshold", None):
+                    kw["threshold"] = args.sentinel_threshold
+                report = snt.compare(baseline, doc, **kw)
+                print(json.dumps({"run": "sentinel",
+                                  "baseline": sentinel_path, **report}))
+                print(snt.render_verdicts(report), file=sys.stderr)
+                # the correctness verdict (bound violations / tier
+                # shares / cache pass) gates the exit alongside the
+                # latency sentinel: a bound-violating build must fail
+                # CI even when the distributions look fine
+                return max(snt.exit_code(report), 0 if ok else 1)
+        return 0 if ok else 1
+
+
 def _top(args) -> int:
     """Curses-free polling dashboard over a `--metrics-port` endpoint:
     qps (from completed-request deltas between polls), latency
@@ -1078,6 +1218,26 @@ def _top_frame(doc: dict, prev, dt) -> str:
             f"  mesh       shape {tuple(mesh.get('shape', ()))} "
             f"({mesh.get('devices', 0)} dev)"
             f"   windows {md} mesh / {ml} local{lane_s}")
+    approx = serve.get("approx")
+    if approx:
+        tiers = approx.get("tiers", {})
+        total = sum(tiers.values())
+        cache = serve.get("cache", {})
+        shares = ("  ".join(
+            f"{k} {v} ({v / total:.0%})" for k, v in tiers.items())
+            if total else "no completed requests yet")
+        if not approx.get("enabled", True):
+            state = "   approx DISABLED (config)"
+        elif not approx.get("allowed_now", True):
+            state = "   EXACTNESS BUDGET SPENT (serving exact)"
+        else:
+            state = ""
+        lines.append(
+            f"  approx     {shares}"
+            + (f"   cache {cache.get('hits', 0)}h/"
+               f"{cache.get('misses', 0)}m/{cache.get('entries', 0)}e"
+               if cache else "")
+            + state)
     subs = serve.get("subscriptions")
     if subs:
         by = subs.get("by_status", {})

@@ -45,6 +45,13 @@ class ReplicaHandle:
     burn_gated: bool = False    # SLO fast+slow burn gates firing
     probe_failures: int = 0
     last_probe_s: float = 0.0
+    # approximate-tier shares off the stats probe (docs/SERVING.md
+    # "Approximate answers"): fraction of this replica's completed
+    # requests served from sketches / the result cache — a replica
+    # whose sketch share collapses while its peers' holds is burning
+    # exactness budget or missing sketches, visible fleet-wide
+    approx_share: float = 0.0
+    cached_share: float = 0.0
     # lifecycle bookkeeping: incarnation counts respawns of one slot
     slot: int = 0
     incarnation: int = 0
@@ -156,10 +163,12 @@ class Membership:
         metrics.counter("fleet.shed", replica=replica_id)
 
     def note_probe(self, replica_id: str, ok: bool,
-                   burn_gated: bool = False) -> int:
+                   burn_gated: bool = False,
+                   tiers: Optional[dict] = None) -> int:
         """Record one health-probe outcome; returns the consecutive
         failure count (the monitor declares death past its threshold).
-        A successful probe also applies the degraded/ready overlay."""
+        A successful probe also applies the degraded/ready overlay and
+        refreshes the replica's serving-tier shares."""
         with self._lock:
             h = self._replicas.get(replica_id)
             if h is None:
@@ -168,10 +177,24 @@ class Membership:
             if ok:
                 h.probe_failures = 0
                 h.burn_gated = burn_gated
+                if tiers:
+                    total = sum(tiers.values())
+                    if total:
+                        h.approx_share = tiers.get("sketch", 0) / total
+                        h.cached_share = tiers.get("cached", 0) / total
             else:
                 h.probe_failures += 1
             failures = h.probe_failures
             state = h.state
+            approx_share = h.approx_share
+        if ok and tiers:
+            try:
+                from geomesa_tpu.utils.metrics import metrics
+
+                metrics.gauge("fleet.replica.approx_share",
+                              approx_share, replica=replica_id)
+            except Exception:
+                pass
         if ok and state in ("ready", "degraded"):
             self.transition(
                 replica_id, "degraded" if burn_gated else "ready",
@@ -199,6 +222,8 @@ class Membership:
                 "retried_onto": h.retried_onto,
                 "shed": h.shed,
                 "burn_gated": h.burn_gated,
+                "approx_share": round(h.approx_share, 4),
+                "cached_share": round(h.cached_share, 4),
                 "incarnation": h.incarnation,
             } for h in self._replicas.values()]
         return {

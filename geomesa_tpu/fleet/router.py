@@ -578,7 +578,8 @@ class FleetRouter:
                 return
             self.membership.note_probe(
                 h.replica_id, ok=True,
-                burn_gated=burn_gates_fired(stats.get("slo") or {}))
+                burn_gated=burn_gates_fired(stats.get("slo") or {}),
+                tiers=(stats.get("approx") or {}).get("tiers"))
 
         token = f"pr{next(self._tokens)}"
         p = _Pending(None, None, {"op": "stats"}, "stats",

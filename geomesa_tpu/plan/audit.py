@@ -83,6 +83,14 @@ class ServeEvent:
     # on these.
     mesh_shape: str = ""
     shards: str = ""
+    # approximate-answer tier (docs/SERVING.md "Approximate answers"):
+    # approx=True — the answer came from sketches with a typed bound
+    # (no device work); cache_hit=True — resolved from the version-
+    # exact result cache (no dispatch at all). Together with the
+    # default exact path these are the three serving tiers a latency
+    # investigation slices on.
+    approx: bool = False
+    cache_hit: bool = False
     user: str = ""
     timestamp: float = 0.0
 

@@ -69,6 +69,19 @@ class QueryHints:
     # exact count: force full evaluation for counts instead of estimates
     exact_count: bool = True
 
+    # approximate-answer tier (docs/SERVING.md "Approximate answers"):
+    # the client's accuracy contract — a count/density answer may be
+    # served from sketches IFF its a-priori error bound fits
+    # `bound <= tolerance * answer`; None (default) demands exactness.
+    # The serve layer strips this hint while the SLO exactness budget
+    # is spent (budget exhaustion routes MORE traffic to the exact
+    # path). Answers served under it carry approx/bound/confidence.
+    tolerance: Optional[float] = None
+    # top-k densest sketch-grid cells intersecting the query bbox — a
+    # sketch-native aggregation (QueryResult kind "topk_cells"); with
+    # no/unfit tolerance it computes exactly via a device density scan
+    topk_cells: Optional[int] = None
+
     # index override (upstream: QUERY_INDEX)
     query_index: Optional[str] = None
 

@@ -73,13 +73,24 @@ class QueryPlan:
 
 @dataclasses.dataclass
 class QueryResult:
-    kind: str  # features | density | stats | bin | arrow | count
+    kind: str  # features | density | stats | bin | arrow | count | topk_cells
     features: Optional[FeatureBatch] = None
     grid: Optional[np.ndarray] = None
     stats: object = None
     bin_bytes: Optional[bytes] = None
     arrow_bytes: Optional[bytes] = None
     count: int = 0
+    # approximate-answer tier (docs/SERVING.md "Approximate answers"):
+    # approx=True means this answer came from sketches and the exact
+    # answer is GUARANTEED within +/- `bound` (count units / grid-cell
+    # weight) at `confidence` (1.0: deterministic interval)
+    approx: bool = False
+    bound: float = 0.0
+    confidence: float = 1.0
+    # the manifest_snapshot() commit version this result was pinned to
+    # (None for storages without versioning) — what makes the serve
+    # result cache's invalidation exact-by-construction
+    version: Optional[int] = None
 
 
 class QueryPlanner:
@@ -290,6 +301,24 @@ class QueryPlanner:
         check_timeout("planning")
 
         hints = query.hints
+        # approximate-answer tier (docs/SERVING.md "Approximate
+        # answers"): a tolerance hint routes count/density (and the
+        # sketch-native topk_cells kind) through the sketch engine —
+        # microseconds, no device work — IFF the a-priori bound fits;
+        # every fallthrough (ineligible / bound_exceeded /
+        # stale_sketch) is metered and pays the exact path below
+        if hints.topk_cells or (hints.tolerance is not None
+                                and (hints.count_only or hints.is_density)):
+            result = None
+            if hints.tolerance is not None:
+                result = self.approx_engine().answer(plan, query)
+            if result is None and hints.topk_cells:
+                result = self._topk_exact(query, plan, timeout_ms)
+            if result is not None:
+                t_done = time.perf_counter()
+                self._record(query, plan, hints, int(result.count),
+                             t0, t_plan, t_plan, t_done)
+                return result
         # HBM-resident path: per-partition cached device batches skip the
         # parquet scan entirely (sampling falls back: every-nth is defined
         # over the global match order, not per partition)
@@ -302,12 +331,71 @@ class QueryPlanner:
             t_done = time.perf_counter()
             self._record(query, plan, hints, mask_count,
                          t0, t_plan, t_scan, t_done)
-            return result
+            return self._stamp_version(result, plan)
 
         with device_trace("query"):
-            return self._execute_scan(
-                query, plan, hints, t0, t_plan, check_timeout
+            return self._stamp_version(
+                self._execute_scan(
+                    query, plan, hints, t0, t_plan, check_timeout
+                ),
+                plan,
             )
+
+    @staticmethod
+    def _stamp_version(result: QueryResult, plan: QueryPlan) -> QueryResult:
+        """Pin the result to the plan's committed write version so the
+        serve result cache keys it exactly (approx/cache.py)."""
+        if result.version is None and plan.manifest is not None:
+            result.version = getattr(plan.manifest, "version", None)
+        return result
+
+    def approx_engine(self):
+        """The lazily-built sketch answer engine (one per planner, like
+        the stats manager; geomesa_tpu.approx.engine)."""
+        with self._mutex:
+            if not hasattr(self, "_approx_engine"):
+                from geomesa_tpu.approx.engine import SketchAnswerEngine
+
+                self._approx_engine = SketchAnswerEngine(self)
+            return self._approx_engine
+
+    def _topk_exact(self, query: Query, plan: QueryPlan,
+                    timeout_ms: Optional[int]) -> QueryResult:
+        """Exact topk_cells fallback: one device density scan over the
+        sketch-aligned world grid (the filter mask restricts it to
+        matching rows), then an exact host top-k — same cell geometry
+        as the sketch path, so the two tiers rank the same cells."""
+        from geomesa_tpu.approx.sketches import DEFAULT_BINS
+
+        eng = self.approx_engine()
+        b = (eng.store.bins_per_dim if eng.store is not None
+             else DEFAULT_BINS)
+        k = int(query.hints.topk_cells)
+        dq = dataclasses.replace(
+            query,
+            hints=dataclasses.replace(
+                query.hints, topk_cells=None, tolerance=None,
+                count_only=False, density_bbox=(-180.0, -90.0, 180.0, 90.0),
+                density_width=b, density_height=b))
+        r = self._execute_deadlined(dq, None, timeout_ms)
+        cells: List[dict] = []
+        if r.grid is not None:
+            grid = np.asarray(r.grid)
+            for rr, cc in zip(*np.nonzero(grid)):
+                cells.append({
+                    "row": int(rr), "col": int(cc),
+                    "bbox": [-180.0 + cc * 360.0 / b,
+                             -90.0 + rr * 180.0 / b,
+                             -180.0 + (cc + 1) * 360.0 / b,
+                             -90.0 + (rr + 1) * 180.0 / b],
+                    "count": int(round(float(grid[rr, cc]))),
+                    "bound": 0,
+                })
+            cells.sort(key=lambda d: (-d["count"], d["row"], d["col"]))
+            cells = cells[:k]
+        return QueryResult("topk_cells", stats=cells,
+                           count=sum(c["count"] for c in cells),
+                           version=r.version)
 
     def _execute_scan(self, query, plan, hints, t0, t_plan, check_timeout):
         import jax.numpy as jnp
@@ -1189,7 +1277,42 @@ class QueryPlanner:
         """EXACT_COUNT path; with exact_count=False and INCLUDE, serve the
         manifest count (the stats-estimate analog). geomesa.force.count
         makes every count exact regardless of hints. `timeout_ms`
-        propagates a serve-layer deadline into the nested execute."""
+        propagates a serve-layer deadline into the nested execute.
+        A sketch-served answer (tolerance hint, docs/SERVING.md
+        "Approximate answers") returns as an `ApproxCount` — an int
+        subclass carrying `.bound`/`.confidence`, so every existing
+        consumer keeps working."""
+        r = self.count_result(query, timeout_ms=timeout_ms)
+        n = int(r.count)
+        if r.approx:
+            from geomesa_tpu.approx.engine import ApproxCount
+
+            return ApproxCount(n, int(r.bound), r.confidence)
+        return n
+
+    def approx_count_result(self, query: Query) -> Optional[QueryResult]:
+        """Admission-time sketch peek (serve/service.py): the
+        microsecond count path ONLY — returns None on any fallthrough
+        so the caller queues the request for the exact dispatch path.
+        Types with configured interceptors decline here (the fast path
+        must not run a non-idempotent chain the queued path would run
+        again); they still reach the sketch tier via count_result."""
+        if query.hints.tolerance is None:
+            return None
+        if self.interceptors and not query.intercepted:
+            return None
+        # build=False: a cold/stale partition must not run a parquet
+        # rescan on the SUBMIT thread — the queued dispatch path
+        # builds (metered) where exact scans already run
+        return self.approx_engine().fast_count(query, build=False)
+
+    def count_result(self, query: Query,
+                     timeout_ms: Optional[int] = None) -> QueryResult:
+        """`count` with provenance: a fresh QueryResult(kind="count")
+        carrying the committed manifest version the answer was pinned
+        to (the serve result cache's key — approx/cache.py) and any
+        approx bound. The serve batcher calls this; `count()` derives
+        the plain/ApproxCount int from it."""
         from geomesa_tpu.utils.config import SystemProperties
 
         from geomesa_tpu.plan.interceptor import run_interceptors
@@ -1207,9 +1330,32 @@ class QueryPlanner:
             # configured types must count through the masked path
             and not (self.storage.sft.user_data or {}).get("geomesa.vis.attr")
         ):
-            return self.storage.count
+            snap_fn = getattr(self.storage, "manifest_snapshot", None)
+            if snap_fn is not None:
+                # one snapshot pins count AND version atomically
+                snap = snap_fn()
+                n = sum(int(e["count"]) for files in snap.values()
+                        for e in files)
+                version = getattr(snap, "version", None)
+            else:
+                n = self.storage.count
+                version = None
+            if query.max_features is not None:
+                n = min(n, query.max_features)
+            return QueryResult("count", count=n, version=version)
+        if query.hints.tolerance is not None:
+            # the microsecond path: memoized sketch merge, no planner
+            # pipeline — falls through metered when the bound does not
+            # fit or a partition's sketch is stale (approx/engine.py)
+            r = self.approx_engine().fast_count(query)
+            if r is not None:
+                return r
+        # tolerance stripped: fast_count above WAS the sketch attempt —
+        # leaving the hint on would re-enter the engine inside execute()
+        # (a second full merge and a double-counted fallthrough reason)
         counting = dataclasses.replace(
-            query, hints=dataclasses.replace(query.hints, count_only=True)
+            query, hints=dataclasses.replace(
+                query.hints, count_only=True, tolerance=None)
         )
         r = self.execute(counting, timeout_ms=timeout_ms)
         if r.kind == "features":
@@ -1220,7 +1366,9 @@ class QueryPlanner:
         # via finish_features; the count_only short-circuit must match)
         if query.max_features is not None:
             n = min(n, query.max_features)
-        return n
+        return QueryResult("count", count=n, version=r.version,
+                           approx=r.approx, bound=r.bound,
+                           confidence=r.confidence)
 
     # -- internals ---------------------------------------------------------
 

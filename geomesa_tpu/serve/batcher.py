@@ -243,15 +243,72 @@ def _oom_fallback(source, reqs: List[ServeRequest],
         r.future.set_result(out)
 
 
+# result-cache value-size gates: the LRU bounds entry COUNT, so
+# entries must be individually small or a handful of wide execute
+# results pins gigabytes. Feature results cap at the wire's row
+# ceiling; grids/payloads at a few MB. Oversized results simply
+# re-execute — correctness is untouched.
+_CACHE_MAX_ROWS = 10_000          # == protocol.MAX_FEATURE_ROWS
+_CACHE_MAX_GRID_CELLS = 1 << 20   # 1M f64 cells = 8 MB
+_CACHE_MAX_BYTES = 8 << 20        # arrow/bin payloads
+
+
+def _cacheable_value(provenance) -> bool:
+    feats = getattr(provenance, "features", None)
+    if feats is not None and len(feats) > _CACHE_MAX_ROWS:
+        return False
+    grid = getattr(provenance, "grid", None)
+    if grid is not None and grid.size > _CACHE_MAX_GRID_CELLS:
+        return False
+    for attr in ("arrow_bytes", "bin_bytes"):
+        b = getattr(provenance, attr, None)
+        if b is not None and len(b) > _CACHE_MAX_BYTES:
+            return False
+    return True
+
+
+def _cache_put(lead: ServeRequest, provenance, value) -> None:
+    """Populate the service's version-exact result cache from one
+    executed dispatch. `provenance` is the QueryResult carrying the
+    manifest version the PLAN pinned — keying on a version read any
+    later could stamp a pre-write key onto post-write data. Approx,
+    degraded and oversized results never cache (the cache's contract
+    is exact bit-identical replay within a bounded memory envelope)."""
+    cache = lead.cache
+    if (cache is None or lead.degraded or provenance.approx
+            or provenance.version is None
+            or not _cacheable_value(provenance)):
+        return
+    from geomesa_tpu.approx.cache import result_key
+
+    cache.put(result_key(lead.kind, lead.query, provenance.version),
+              value)
+
+
 def _execute_shared(source, reqs: List[ServeRequest],
                     timeout_ms: Optional[int]) -> None:
     """count/execute dedup: one planner run, every rider gets the same
-    (immutable) result object."""
+    (immutable) result object. Successful exact results populate the
+    version-exact result cache (docs/SERVING.md "Approximate
+    answers"); sketch-served answers mark every rider `approx` for
+    ServeEvent/SLO attribution."""
     lead = reqs[0]
     if lead.kind == "count":
-        out = source.planner.count(lead.query, timeout_ms=timeout_ms)
+        qr = source.planner.count_result(lead.query, timeout_ms=timeout_ms)
+        if qr.approx:
+            from geomesa_tpu.approx.engine import ApproxCount
+
+            out = ApproxCount(int(qr.count), int(qr.bound), qr.confidence)
+        else:
+            out = int(qr.count)
+        provenance = qr
     else:
         out = source.planner.execute(lead.query, timeout_ms=timeout_ms)
+        provenance = out
+    if provenance.approx:
+        for r in reqs:
+            r.approx = True
+    _cache_put(lead, provenance, out)
     with TRACER.span("merge", members=len(reqs)):
         for r in reqs:
             r.future.set_result(out)

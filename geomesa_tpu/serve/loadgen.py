@@ -73,6 +73,21 @@ class LoadReport:
     batches: int = 0
     events_total: int = 0
     events_per_s: float = 0.0
+    # approx mode (docs/SERVING.md "Approximate answers"): tolerant vs
+    # exact client split — the headline is approx_speedup_p50 (sketch
+    # tier vs exact device scan at identical bound-respecting
+    # accuracy) plus the serving-tier shares
+    approx_ok: int = 0
+    exact_ok: int = 0
+    approx_p50_ms: float = 0.0
+    approx_p99_ms: float = 0.0
+    exact_p50_ms: float = 0.0
+    exact_p99_ms: float = 0.0
+    approx_speedup_p50: float = 0.0
+    tier_sketch: int = 0
+    tier_cached: int = 0
+    tier_exact: int = 0
+    bound_violations: int = 0
     # sentinel input (telemetry/sentinel.py): a bounded sample of the
     # raw end-to-end latencies, so `bench-serve --record-baseline` can
     # commit a DISTRIBUTION (median + overlap comparison) instead of
@@ -80,10 +95,22 @@ class LoadReport:
     # samples — order statistics, not a random subsample, so two runs
     # of the same workload produce comparable vectors.
     samples_ms: List[float] = dataclasses.field(default_factory=list)
+    # approx mode: per-tier latency sample vectors for the sentinel's
+    # approx.* reservoir families (a regressed sketch path fails CI)
+    approx_samples_ms: List[float] = dataclasses.field(default_factory=list)
+    exact_samples_ms: List[float] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> dict:
         doc = dataclasses.asdict(self)
         doc.pop("samples_ms", None)  # report lines stay readable
+        doc.pop("approx_samples_ms", None)
+        doc.pop("exact_samples_ms", None)
+        if self.mode != "approx":
+            for k in ("approx_ok", "exact_ok", "approx_p50_ms",
+                      "approx_p99_ms", "exact_p50_ms", "exact_p99_ms",
+                      "approx_speedup_p50", "tier_sketch", "tier_cached",
+                      "tier_exact", "bound_violations"):
+                doc.pop(k, None)
         return doc
 
 
@@ -453,6 +480,129 @@ def run_subscribe(
                 mgr.unsubscribe(s.sub_id)
             except KeyError:
                 pass  # TTL-expired mid-run
+    return rep
+
+
+def run_approx(
+    service: QueryService,
+    type_name: str,
+    cqls: List[str],
+    duration_s: float = 5.0,
+    clients: int = 8,
+    tolerance: float = 0.1,
+    requests_per_client: Optional[int] = None,
+    exact_counts: Optional[Dict[str, int]] = None,
+) -> LoadReport:
+    """`bench-serve --mode approx`: a closed-loop workload mixing
+    TOLERANT count clients (hints.tolerance — eligible for the sketch
+    tier) and EXACT clients (the device-scan path) over a cycling CQL
+    list, reporting per-tier p50/p99 and the sketch-vs-exact speedup at
+    bound-respecting accuracy. `exact_counts` (cql -> exact answer,
+    computed outside the measured window) arms per-answer bound
+    verification: every approx answer whose interval does not contain
+    the exact answer counts as a bound violation (must be zero)."""
+    from geomesa_tpu.plan.hints import QueryHints
+    from geomesa_tpu.plan.query import Query
+
+    tally = _Tally()
+    base = service.stats()
+    deadline = time.monotonic() + duration_s
+    approx_lat: List[float] = []
+    exact_lat: List[float] = []
+    violations = [0]
+    lock = threading.Lock()
+
+    def client(cid: int):
+        tolerant = cid % 2 == 0
+        i = 0
+        while True:
+            if requests_per_client is not None:
+                if i >= requests_per_client:
+                    return
+            elif time.monotonic() >= deadline:
+                return
+            cql = cqls[(cid + i) % len(cqls)]
+            hints = (QueryHints(tolerance=tolerance) if tolerant
+                     else QueryHints())
+            req = ServeRequest(kind="count",
+                               query=Query(type_name, cql, hints=hints))
+            with tally.lock:
+                tally.sent += 1
+            t0 = time.monotonic()
+            try:
+                fut = service.submit(req)
+            except QueryRejected:
+                with tally.lock:
+                    tally.rejected += 1
+                i += 1
+                continue
+            try:
+                value = fut.result()
+                dt = time.monotonic() - t0
+                with tally.lock:
+                    tally.lat_s.append(dt)
+                served_approx = getattr(value, "approx", False)
+                # classify by the TIER that answered, not the client's
+                # intent: a tolerant request whose bound did not fit
+                # paid the exact path and belongs in the exact leg —
+                # the speedup headline is sketch-tier vs device-scan
+                with lock:
+                    (approx_lat if served_approx
+                     else exact_lat).append(dt)
+                if served_approx and exact_counts is not None:
+                    exact = exact_counts.get(cql)
+                    if exact is not None and \
+                            abs(int(value) - exact) > value.bound:
+                        with lock:
+                            violations[0] += 1
+            except QueryTimeout:
+                with tally.lock:
+                    tally.timeouts += 1
+            except QueryRejected:
+                with tally.lock:
+                    tally.rejected += 1
+            except Exception:
+                with tally.lock:
+                    tally.errors += 1
+            i += 1
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+    stats = service.stats()
+    delta = {k: stats.get(k, 0) - base.get(k, 0)
+             for k in ("dispatches", "coalesced")}
+    rep = _report("approx", wall, tally.lat_s, tally.sent,
+                  tally.rejected, tally.timeouts, tally.errors, delta)
+
+    def q(arr, p):
+        return (float(np.percentile(np.asarray(arr) * 1000.0, p))
+                if arr else 0.0)
+
+    rep.approx_ok = len(approx_lat)
+    rep.exact_ok = len(exact_lat)
+    rep.approx_p50_ms = q(approx_lat, 50)
+    rep.approx_p99_ms = q(approx_lat, 99)
+    rep.exact_p50_ms = q(exact_lat, 50)
+    rep.exact_p99_ms = q(exact_lat, 99)
+    if rep.approx_p50_ms > 0:
+        rep.approx_speedup_p50 = rep.exact_p50_ms / rep.approx_p50_ms
+    tiers = (stats.get("approx") or {}).get("tiers", {})
+    base_tiers = (base.get("approx") or {}).get("tiers", {})
+    rep.tier_sketch = tiers.get("sketch", 0) - base_tiers.get("sketch", 0)
+    rep.tier_cached = tiers.get("cached", 0) - base_tiers.get("cached", 0)
+    rep.tier_exact = tiers.get("exact", 0) - base_tiers.get("exact", 0)
+    rep.bound_violations = violations[0]
+    for arr, dest in ((approx_lat, rep.approx_samples_ms),
+                      (exact_lat, rep.exact_samples_ms)):
+        s = np.sort(np.asarray(arr, np.float64) * 1000.0)
+        stride = max(1, len(s) // 512)
+        dest.extend(round(float(v), 4) for v in s[::stride])
     return rep
 
 

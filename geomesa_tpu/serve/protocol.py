@@ -120,7 +120,15 @@ def _rows_json(batch, limit: int):
 
 def _payload(kind: str, result, limit: int) -> dict:
     if kind == "count":
-        return {"count": int(result)}
+        doc = {"count": int(result)}
+        if getattr(result, "approx", False):
+            # typed error bound on the wire (docs/SERVING.md
+            # "Approximate answers"): the exact count is guaranteed in
+            # [count - bound, count + bound]
+            doc["approx"] = True
+            doc["bound"] = result.bound
+            doc["confidence"] = result.confidence
+        return doc
     if kind == "knn":
         dists, idx, _batch = result
         return {
@@ -137,6 +145,12 @@ def _payload(kind: str, result, limit: int) -> dict:
         out["total"] = float(result.grid.sum())
     elif result.kind == "stats":
         out["stats"] = str(result.stats)
+    elif result.kind == "topk_cells":
+        out["cells"] = result.stats
+    if getattr(result, "approx", False):
+        out["approx"] = True
+        out["bound"] = float(result.bound)
+        out["confidence"] = float(result.confidence)
     return out
 
 
@@ -147,8 +161,20 @@ def parse_request(doc: dict) -> ServeRequest:
     if kind is None:
         raise ValueError(f"unknown op {op!r}")
     type_name = doc["typeName"]
+    kw = {}
+    if doc.get("tolerance") is not None or doc.get("topkCells"):
+        # approximate-answer tier hints (docs/SERVING.md "Approximate
+        # answers"): tolerance = the client's accuracy contract,
+        # topkCells = the sketch-native top-k-cells aggregation
+        from geomesa_tpu.plan.hints import QueryHints
+
+        kw["hints"] = QueryHints(
+            tolerance=(float(doc["tolerance"])
+                       if doc.get("tolerance") is not None else None),
+            topk_cells=(int(doc["topkCells"])
+                        if doc.get("topkCells") else None))
     query = Query(type_name, doc.get("cql", "INCLUDE"),
-                  max_features=doc.get("maxFeatures"))
+                  max_features=doc.get("maxFeatures"), **kw)
     priority = doc.get("priority", "normal")
     if isinstance(priority, str):
         priority = PRIORITIES.index(priority)
@@ -205,7 +231,9 @@ def _parse_density(doc: dict):
     return DensityWindow(
         bbox=tuple(float(v) for v in d["bbox"]),
         width=int(d["width"]), height=int(d["height"]),
-        weight_attr=d.get("weight"), decay=d.get("decay"))
+        weight_attr=d.get("weight"), decay=d.get("decay"),
+        tolerance=(float(d["tolerance"])
+                   if d.get("tolerance") is not None else None))
 
 
 class _SubscribeSession:
@@ -441,6 +469,8 @@ def serve_connection(
                     doc.update(_payload(req.kind, fut.result(), limit))
                     if req.degraded:
                         doc["degraded"] = True
+                    if req.cache_hit:
+                        doc["cached"] = True
                     respond(doc)
             finally:
                 if req.trace is not None:

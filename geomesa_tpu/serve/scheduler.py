@@ -104,6 +104,22 @@ class ServeRequest:
     # plan and overrides; mesh_shape/shards end up on the ServeEvent.
     shards: str = ""
     mesh_shape: str = ""
+    # approximate-answer tier + result cache (docs/SERVING.md
+    # "Approximate answers"): the service attaches its ResultCache to
+    # count/execute requests so the batcher can populate it with the
+    # version the planner actually pinned; cache_hit marks a request
+    # resolved without any dispatch, approx marks a sketch-served
+    # answer (both ride the ServeEvent)
+    cache: object = None
+    cache_hit: bool = False
+    approx: bool = False
+    # degradation-ladder sketch rung (docs/SERVING.md "Degradation
+    # ladder"): nonzero = the ladder injected the tolerance hint at
+    # this level. The request is marked `degraded` — and spends the
+    # SLO exactness budget — only if a sketch answer is actually
+    # SERVED; a bound that does not fit runs exact, unmarked, with
+    # the budget untouched.
+    sketch_rung: int = 0
 
     def __post_init__(self):
         if self.kind not in ("execute", "count", "knn"):

@@ -129,6 +129,22 @@ class ServeConfig:
     subscribe_outbox: int = 1024
     subscribe_rate: Optional[float] = None
     subscribe_poll_ms: Optional[float] = None
+    # approximate-answer tier (docs/SERVING.md "Approximate answers"):
+    # approx=True lets tolerant queries (hints.tolerance) serve from
+    # sketches with typed bounds; while the SLO exactness budget is
+    # spent the tolerance hint is STRIPPED at admission (budget
+    # exhaustion moves traffic to the exact path, never to silent
+    # accuracy loss). approx_degrade_tolerance is the degradation
+    # ladder's first rung — BEFORE loose-bbox: an allow_degraded
+    # count/density under overload gets a sketch answer with a bound
+    # instead of a silently loosened exact scan.
+    approx: bool = True
+    approx_degrade_tolerance: float = 0.1
+    # version-exact result cache: count/execute results keyed on
+    # (typeName, canonical CQL, hints, manifest version) — repeated
+    # dashboard queries cost a dict lookup, invalidation is exact by
+    # construction (a write bumps the version). 0 disables.
+    result_cache: int = 256
 
 
 def _quarantine_key(req: ServeRequest):
@@ -169,6 +185,14 @@ class QueryService:
         self.quarantine = QuarantineRegistry(
             strikes=max(self.config.quarantine_after, 1),
             ttl_s=self.config.quarantine_ttl_s)
+        # version-exact result cache (geomesa_tpu.approx.cache):
+        # admission peeks it before queueing, the dispatch loop
+        # populates it, and a hit never enters a coalescing window
+        self.result_cache = None
+        if self.config.result_cache > 0:
+            from geomesa_tpu.approx.cache import ResultCache
+
+            self.result_cache = ResultCache(self.config.result_cache)
         self.audit = getattr(store, "audit", None)
         if self.config.trace:
             TRACER.enable()
@@ -368,6 +392,12 @@ class QueryService:
         if trace is None:
             try:
                 self._admit(req)
+                hit, value = self._cache_peek(req)
+                if hit:
+                    return self._resolve_cached(req, value)
+                value = self._approx_peek(req)
+                if value is not None:
+                    return self._resolve_approx(req, value)
                 return self._enqueue(req)
             except QueryRejected:
                 self._observe_slo(req, "rejected", 0.0)
@@ -382,6 +412,13 @@ class QueryService:
             with TRACER.scope(trace):
                 with TRACER.span("admit"):
                     self._admit(req)
+            hit, value = self._cache_peek(req)
+            if hit:
+                return self._resolve_cached(req, value)
+            with TRACER.scope(trace):
+                value = self._approx_peek(req)
+            if value is not None:
+                return self._resolve_approx(req, value)
             return self._enqueue(req)
         except BaseException as e:
             if isinstance(e, QueryRejected):
@@ -420,6 +457,28 @@ class QueryService:
                 "shed", "sustained overload: batch class shed")
         if level >= 1 and self.config.degrade and req.allow_degraded:
             self._degrade(req, level)
+        # approximate-answer governor (docs/SERVING.md "Approximate
+        # answers"): a spent exactness budget STRIPS the tolerance hint
+        # — the request pays the exact path; approximation is a
+        # budgeted contract, never silent degradation. Config-disabled
+        # approx strips too but counts separately — "budget_exact"
+        # must mean the GOVERNOR acted, or a disabled service reads as
+        # perpetual budget exhaustion on dashboards.
+        if req.query.hints.tolerance is not None and not self._approx_ok():
+            req.query = dataclasses.replace(
+                req.query, hints=dataclasses.replace(
+                    req.query.hints, tolerance=None))
+            if not self.config.approx:
+                self._bump("approx_disabled")
+            else:
+                self._bump("approx_budget_exact")
+                from geomesa_tpu.utils.metrics import metrics
+
+                metrics.counter("approx.budget_exact")
+        if req.kind in ("count", "execute") and self.result_cache is not None:
+            # the batcher populates the cache with the version the
+            # planner's plan actually pinned (exact-by-construction)
+            req.cache = self.result_cache
         if self.mesh is not None:
             # shard-affinity admission (docs/SERVING.md "Sharded
             # serving"): tag the query with the chips owning its tiles
@@ -478,6 +537,170 @@ class QueryService:
                             priority=priority, deadline=deadline,
                             allow_degraded=allow_degraded)
 
+    # -- approximate tier + result cache -----------------------------------
+
+    def _approx_ok(self) -> bool:
+        """Sketch serving allowed right now? Config master switch AND
+        the SLO exactness budget (spent budget routes exact)."""
+        if not self.config.approx:
+            return False
+        if self.slo is None:
+            return True
+        return not self.slo.exactness_spent()
+
+    def _sketch_rung_ok(self, req: ServeRequest) -> bool:
+        """Can the sketch tier plausibly answer this request? The
+        ladder's rung choice: an ELIGIBLE filter takes the sketch rung
+        (typed bound), an ineligible one keeps the legacy loose-bbox/
+        sampling rewrite — the ladder must not lose its shedding lever
+        on filters the sketches cannot see. Memoized filter parse, no
+        sketch builds, no I/O."""
+        try:
+            source = self.store.get_feature_source(req.query.type_name)
+            eng = source.planner.approx_engine()
+            if eng.store is None:
+                return False
+            eligible = eng._parse_filter(req.query)[0]
+            return bool(eligible)
+        # gt: waive GT14
+        # (deliberate degrade: rung SELECTION is best-effort — any
+        # failure here falls back to the legacy degrade rewrite)
+        except Exception:
+            return False
+
+    def _cache_key(self, req: ServeRequest):
+        """The request's result-cache key at the CURRENT committed
+        manifest version, or None when uncacheable (knn, tolerant,
+        unversioned storage). Recomputed fresh at every peek — a key
+        minted before a concurrent write must never serve the old
+        version's entry after the write committed."""
+        if self.result_cache is None or req.kind == "knn":
+            return None
+        try:
+            source = self.store.get_feature_source(req.query.type_name)
+        except Exception:
+            return None  # the dispatch path raises the typed error
+        storage = getattr(source, "storage", None)
+        mv = getattr(storage, "manifest_version", None)
+        if not callable(mv):
+            return None
+        from geomesa_tpu.approx.cache import result_key
+
+        return result_key(req.kind, req.query, mv())
+
+    def _cache_peek(self, req: ServeRequest, count_miss: bool = True):
+        """(hit, value) against the version-exact result cache."""
+        if self.result_cache is None or req.kind == "knn":
+            return False, None
+        return self.result_cache.get(self._cache_key(req),
+                                     count_miss=count_miss)
+
+    def _approx_peek(self, req: ServeRequest):
+        """Admission-time sketch resolution (docs/SERVING.md
+        "Approximate answers"): a tolerant COUNT answers on the submit
+        thread in microseconds — it never queues, never coalesces, and
+        never waits behind an exact device scan. Returns the
+        ApproxCount or None (every fallthrough pays the normal queued
+        path, where the planner retries the sketch tier with full plan
+        context)."""
+        if req.kind != "count" or req.query.hints.tolerance is None:
+            return None
+        try:
+            source = self.store.get_feature_source(req.query.type_name)
+            planner = getattr(source, "planner", None)
+            fn = getattr(planner, "approx_count_result", None)
+            if fn is None:
+                return None
+            qr = fn(req.query)
+        # gt: waive GT14
+        # (deliberate degrade: the admission peek is an optimization —
+        # any failure here falls through to the queued dispatch path,
+        # which surfaces the typed error to the right future)
+        except Exception:
+            return None
+        if qr is None:
+            return None
+        from geomesa_tpu.approx.engine import ApproxCount
+
+        return ApproxCount(int(qr.count), int(qr.bound), qr.confidence)
+
+    def _resolve_approx(self, req: ServeRequest, value) -> Future:
+        """Resolve a sketch-served request at admission: full tier
+        bookkeeping (metrics, SLO exactness spend, trace, audit), no
+        queue, no dispatch."""
+        from geomesa_tpu.utils.metrics import metrics
+
+        req.approx = True
+        if req.sketch_rung:
+            # the ladder's speculative rung actually served: NOW the
+            # request is a degraded answer (typed bound) and the
+            # exactness budget spend is honest
+            req.degraded = True
+            self._bump("degraded")
+            metrics.counter("serve.degraded")
+        self._bump("approx_served")
+        self._bump("completed")
+        metrics.counter("serve.requests", kind=req.kind, status="ok")
+        metrics.counter("serve.tier", tier="sketch")
+        metrics.histogram("serve.latency").update(0.0)
+        self._observe_slo(req, "ok", 0.0)
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_result(value)
+        if req.trace is not None:
+            RECORDER.record(req.trace.finish(status="ok", approx=True))
+        if self.audit is not None:
+            self.audit.write(ServeEvent(
+                trace_id=(req.trace.trace_id
+                          if req.trace is not None else ""),
+                type_name=req.query.type_name,
+                kind=req.kind,
+                tenant=req.tenant,
+                priority=PRIORITIES[req.priority],
+                queue_ms=0.0,
+                exec_ms=0.0,
+                batch_size=1,
+                status="ok",
+                degraded=req.degraded,
+                approx=True,
+            ))
+        return req.future
+
+    def _resolve_cached(self, req: ServeRequest, value,
+                        queue_ms: float = 0.0) -> Future:
+        """Resolve a request straight from the result cache: no queue,
+        no coalescing window, no dispatch — full bookkeeping (metrics,
+        SLO, trace, audit) still applies so tier shares stay honest."""
+        from geomesa_tpu.utils.metrics import metrics
+
+        req.cache_hit = True
+        self._bump("cache_hits")
+        self._bump("completed")
+        metrics.counter("serve.requests", kind=req.kind, status="ok")
+        metrics.counter("serve.tier", tier="cached")
+        latency_s = queue_ms / 1000.0
+        metrics.histogram("serve.latency").update(latency_s)
+        self._observe_slo(req, "ok", latency_s)
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_result(value)
+        if req.trace is not None:
+            RECORDER.record(req.trace.finish(status="ok", cache_hit=True))
+        if self.audit is not None:
+            self.audit.write(ServeEvent(
+                trace_id=(req.trace.trace_id
+                          if req.trace is not None else ""),
+                type_name=req.query.type_name,
+                kind=req.kind,
+                tenant=req.tenant,
+                priority=PRIORITIES[req.priority],
+                queue_ms=queue_ms,
+                exec_ms=0.0,
+                batch_size=1,
+                status="ok",
+                degraded=req.degraded,
+                cache_hit=True,
+            ))
+        return req.future
+
     # -- degradation ladder ------------------------------------------------
 
     def degrade_level(self) -> int:
@@ -503,12 +726,37 @@ class QueryService:
         return level
 
     def _degrade(self, req: ServeRequest, level: int) -> None:
-        """Rewrite hints toward cheaper execution. Only plain feature /
-        count requests degrade — aggregations (density/stats/bin/arrow)
-        have result shapes a hint rewrite would corrupt."""
+        """Rewrite hints toward cheaper execution. The FIRST rung —
+        before loose-bbox — is the sketch tier (docs/SERVING.md
+        "Approximate answers"): an eligible count/density gets a
+        tolerance hint and serves from sketches WITH a typed bound,
+        which beats silently dropping the exact residual check; the
+        planner's fallthrough keeps it safe when the bound does not
+        fit. Aggregations with shapes a rewrite would corrupt
+        (stats/bin/arrow) never degrade."""
         h = req.query.hints
-        if h.is_density or h.is_stats or h.is_bin or h.is_arrow:
+        if h.is_stats or h.is_bin or h.is_arrow:
             return
+        sketchable = (req.kind == "count"
+                      or (req.kind == "execute" and h.is_density
+                          and h.density_weight is None))
+        if (sketchable and h.tolerance is None and self._approx_ok()
+                and self._sketch_rung_ok(req)):
+            # the rung is SPECULATIVE: it injects the tolerance hint
+            # and records the level, but degraded/budget accounting
+            # happens only where a sketch answer is actually served
+            # (_resolve_approx / _finish_window) — a bound that does
+            # not fit must not flag an EXACT answer degraded or spend
+            # the exactness budget it never used
+            if self.config.quarantine_after and req.quarantine_key is None:
+                req.quarantine_key = _quarantine_key(req)
+            req.query = dataclasses.replace(
+                req.query, hints=dataclasses.replace(
+                    h, tolerance=self.config.approx_degrade_tolerance))
+            req.sketch_rung = level
+            return
+        if h.is_density:
+            return  # loose-bbox/sampling would corrupt the grid
         # stash the PRE-degrade fingerprint: strikes must land on the
         # same key admission checks (see ServeRequest.quarantine_key)
         if self.config.quarantine_after and req.quarantine_key is None:
@@ -529,8 +777,12 @@ class QueryService:
         """Feed one resolved request into the SLO engine's sliding
         windows (no-op without a spec; a tuple append with one)."""
         if self.slo is not None:
+            # a sketch-served answer spends the exactness budget like a
+            # ladder-degraded one: approximation is budgeted, and the
+            # closed loop (exactness_spent -> tolerance stripped) is
+            # what keeps it from becoming silent degradation
             self.slo.observe(req.kind, status, latency_s,
-                             degraded=req.degraded)
+                             degraded=req.degraded or req.approx)
 
     # -- dispatch loop -----------------------------------------------------
 
@@ -635,6 +887,24 @@ class QueryService:
                 RECORDER.record(r.trace.finish(status="timeout"))
         if not live:
             return
+        if (lead.kind in ("count", "execute")
+                and self.result_cache is not None
+                and lead.query.hints.tolerance is None):
+            # second-chance peek: a twin that dispatched while this
+            # request queued may have populated the cache — resolve
+            # the whole window (members share the coalescing key, so
+            # one current-version key answers them all) without any
+            # device work. The batcher therefore never coalesces a
+            # cache-hit. Misses are unmetered here (admission already
+            # counted them).
+            hit, value = self._cache_peek(lead, count_miss=False)
+            if hit:
+                t_hit = time.monotonic()
+                for r in live:
+                    self._resolve_cached(
+                        r, value,
+                        queue_ms=(t_hit - r.enqueued_at) * 1000.0)
+                return
         t0 = time.monotonic()
         now_ns = time.perf_counter_ns()
         for r in live + counts:
@@ -843,6 +1113,19 @@ class QueryService:
                         self.quarantine.strike(key)
             else:
                 self._bump("completed")
+                if r.approx:
+                    self._bump("approx_served")
+                    if r.sketch_rung and not r.degraded:
+                        # rung request sketch-served on the DISPATCH
+                        # path (cold sketch built there): degraded
+                        # accounting lands with the serve, same as
+                        # the admission-resolved case
+                        r.degraded = True
+                        self._bump("degraded")
+                        metrics.counter("serve.degraded")
+                metrics.counter(
+                    "serve.tier",
+                    tier="sketch" if r.approx else "exact")
             # SLO accounting distinguishes rejection from failure even
             # where the wire status does not: a pipelined window failed
             # by shutdown/drain fans QueryRejected out to its members
@@ -873,7 +1156,8 @@ class QueryService:
                     r.trace.adopt(
                         adopted, clamp_start_ns=r.trace.root.start_ns)
                 RECORDER.record(r.trace.finish(
-                    status=status, batch=members, degraded=r.degraded))
+                    status=status, batch=members, degraded=r.degraded,
+                    approx=r.approx))
             if self.audit is not None:
                 self.audit.write(ServeEvent(
                     trace_id=(r.trace.trace_id
@@ -898,6 +1182,8 @@ class QueryService:
                     # counts too — they resolved from the same program)
                     mesh_shape=r.mesh_shape or lead.mesh_shape,
                     shards=r.shards or lead.shards,
+                    approx=r.approx,
+                    cache_hit=r.cache_hit,
                 ))
 
     def _record_queries(self, live: List[ServeRequest],
@@ -957,6 +1243,22 @@ class QueryService:
         out["queue_depth"] = len(self.queue)
         out["degrade_level"] = self.degrade_level()
         out["quarantine"] = self.quarantine.stats()
+        # serving-tier shares (docs/SERVING.md "Approximate answers"):
+        # sketch / cached / exact out of everything completed — the
+        # numbers /debug/approx, `gmtpu top` and the fleet router's
+        # stats probe read
+        sketch = out.get("approx_served", 0)
+        cached = out.get("cache_hits", 0)
+        completed = out.get("completed", 0)
+        out["approx"] = {
+            "enabled": self.config.approx,
+            "allowed_now": self._approx_ok(),
+            "budget_exact": out.get("approx_budget_exact", 0),
+            "tiers": {"sketch": sketch, "cached": cached,
+                      "exact": max(completed - sketch - cached, 0)},
+        }
+        if self.result_cache is not None:
+            out["cache"] = self.result_cache.stats()
         if self.metrics_port is not None:
             out["metrics_port"] = self.metrics_port
         subs = self.subscriptions  # racing close() may null the attr
@@ -1002,6 +1304,11 @@ class QueryService:
             metrics.gauge("serve.pipeline.inflight", float(p["inflight"]))
             metrics.gauge("serve.pipeline.max_inflight",
                           float(p["max_inflight"]))
+        if self.result_cache is not None:
+            c = self.result_cache.stats()
+            metrics.gauge("serve.cache.entries", float(c["entries"]))
+        metrics.gauge("serve.approx.allowed",
+                      1.0 if self._approx_ok() else 0.0)
         q = self.quarantine.stats()
         metrics.gauge("fault.quarantine.active", float(q["quarantined"]))
         metrics.gauge("fault.quarantine.striking", float(q["striking"]))

@@ -113,6 +113,17 @@ class _TypeState:
         self.treedef = None
         self.pred_subs: List[str] = []
         self.dens_subs: List[str] = []
+        # approximate-density shared state (docs/SERVING.md
+        # "Approximate answers"): ONE host-side world occupancy grid +
+        # fid->cell map per type, folded from deltas with plain numpy —
+        # every approx_density subscriber resamples it, so a
+        # thousand-subscriber density fan-out costs ZERO device
+        # dispatches per poll. Mutated only under the eval lock; the
+        # per-fid last-cell map makes re-application idempotent
+        # (exactly-once survives a partially applied window retry).
+        self.approx_grid: Optional[np.ndarray] = None
+        self.approx_cells: Dict[str, Tuple[int, int]] = {}
+        self.approx_seeded = False
 
 
 class DeltaEvaluator:
@@ -265,6 +276,13 @@ class DeltaEvaluator:
         (one-shot semantics), so subsequent folds are pure increments.
         Also the re-sync path after a crashed or overflowed fold."""
         sft = self.store.get_schema(sub.type_name)
+        if sub.density is not None and sub.density.approx:
+            # sketch-backed window: seed the SHARED per-type grid once
+            # (host-side, no device work), then this sub's resample
+            st = self._state(sub.type_name)
+            self._seed_approx_shared(st, sft)
+            self._apply_approx(st, sub, offer=False)
+            return
         snap = self.store.cache(sub.type_name).snapshot()
         if sub.density is not None:
             cells = None
@@ -410,7 +428,11 @@ class DeltaEvaluator:
 
             aot.unregister(st.fused_name)
         pred = [s for s in subs if s.density is None]
-        dens = [s for s in subs if s.density is not None]
+        # approx windows NEVER join the fused device kernel — they fold
+        # host-side into the shared grid (the whole point: no per-poll
+        # device dispatch for dashboard density fan-out)
+        dens = [s for s in subs
+                if s.density is not None and not s.density.approx]
         filters = [self._filter_for(st.type_name, s.cql, sft) for s in pred]
         windows = [s.density for s in dens]
         geom = _geom_name(sft)
@@ -537,6 +559,9 @@ class DeltaEvaluator:
             with st.buf_lock:
                 st.buffer.clear()
                 st.overflowed = False
+            # the shared approx grid missed the overflowed window too:
+            # force its next bootstrap to re-seed from the live snapshot
+            st.approx_seeded = False
             for sub in subs:
                 try:
                     self.bootstrap(sub)
@@ -595,11 +620,18 @@ class DeltaEvaluator:
               cleared: bool) -> int:
         sft = self.store.get_schema(st.type_name)
         _EVAL_SITE.fire()
-        delta, dev, fids = self._delta_batch(sft, changed)
+        # an all-approx subscription set never touches the device — not
+        # even the delta upload: the shared host grid is the entire
+        # evaluation, so the thousand-subscriber dashboard fan-out pays
+        # zero device work per poll
+        needs_device = any(
+            s.density is None or not s.density.approx for s in subs)
+        delta, dev, fids = self._delta_batch(sft, changed,
+                                             device=needs_device)
         try:
             pred, masks, bands, cells = (
                 self._eval_fused(st, sft, subs, version, delta, dev)
-                if delta is not None else (
+                if (delta is not None and needs_device) else (
                     [s for s in subs if s.density is None], None, None,
                     None))
         except Exception as e:
@@ -616,7 +648,10 @@ class DeltaEvaluator:
             self._fold_fallback(st, sft, subs, delta, dev, fids,
                                 changed, removed, cleared)
             return len(changed) + len(removed) + (1 if cleared else 0)
-        dens = [s for s in subs if s.density is not None]
+        dens = [s for s in subs
+                if s.density is not None and not s.density.approx]
+        approx_dens = [s for s in subs
+                       if s.density is not None and s.density.approx]
         # the per-subscription apply phase gets the same strike
         # protection as the fallback path: a predicate that crashes
         # only HERE (host-band refinement, density weights) must be
@@ -643,6 +678,21 @@ class DeltaEvaluator:
                                     cleared)
             except Exception as e:  # noqa: BLE001 — strike, don't spread
                 self._strike(sub, e)
+        if approx_dens:
+            # sketch-backed windows: ONE shared host fold per type
+            # (idempotent — per-fid last-cell map), then a per-sub
+            # resample + typed approx_density frame. No device work.
+            changed_any = self._fold_approx_shared(
+                st, sft, delta, fids, removed, cleared)
+            for sub in approx_dens:
+                try:
+                    if sub._resync_pending():
+                        self._resync(sub)
+                        continue
+                    if changed_any:
+                        self._apply_approx(st, sub)
+                except Exception as e:  # noqa: BLE001 — strike, not spread
+                    self._strike(sub, e)
         return len(changed) + len(removed) + (1 if cleared else 0)
 
     def _refined_row(self, st, sub, masks, bands, i, delta, fids):
@@ -730,6 +780,102 @@ class DeltaEvaluator:
             })
             self._bump("events")
 
+    # -- approximate density (shared host grid, no device) -----------------
+
+    def _approx_bins(self) -> int:
+        from geomesa_tpu.approx.sketches import DEFAULT_BINS
+
+        return DEFAULT_BINS
+
+    def _host_cells(self, sft, batch, n: int):
+        """World-grid cells of the first `n` rows, pure numpy — THE
+        shared sketch binning (approx.sketches.world_cells), so the
+        subscribe tier's grid and the serve tier's partition sketches
+        can never bin differently."""
+        from geomesa_tpu.approx.sketches import world_cells
+
+        col = batch.columns[_geom_name(sft)]
+        return world_cells(np.asarray(col.x)[:n], np.asarray(col.y)[:n],
+                           self._approx_bins())
+
+    def _seed_approx_shared(self, st: _TypeState, sft) -> None:
+        """Build the shared grid + fid->cell map from the live
+        snapshot (idempotent; under the per-type eval lock)."""
+        if st.approx_seeded:
+            return
+        b = self._approx_bins()
+        grid = np.zeros((b, b), np.float64)
+        cells: Dict[str, Tuple[int, int]] = {}
+        snap = self.store.cache(st.type_name).snapshot()
+        if snap is not None and len(snap):
+            rows, cols = self._host_cells(sft, snap, len(snap))
+            for j, fid in enumerate(_batch_fids(snap)):
+                grid[rows[j], cols[j]] += 1.0
+                cells[fid] = (int(rows[j]), int(cols[j]))
+        st.approx_grid = grid
+        st.approx_cells = cells
+        st.approx_seeded = True
+
+    def _fold_approx_shared(self, st: _TypeState, sft, delta, fids,
+                            removed, cleared: bool) -> bool:
+        """Fold one delta window into the shared grid — plain numpy,
+        O(delta), IDEMPOTENT (the fid->cell map records each feature's
+        last-applied cell, so re-applying a retried window lands in the
+        same state). Returns whether anything moved."""
+        self._seed_approx_shared(st, sft)
+        grid = st.approx_grid
+        cells = st.approx_cells
+        changed_any = False
+        if cleared:
+            if cells or grid.any():
+                changed_any = True
+            grid[:] = 0.0
+            cells.clear()
+        for fid in removed:
+            old = cells.pop(fid, None)
+            if old is not None:
+                grid[old] -= 1.0
+                changed_any = True
+        if delta is not None and len(fids):
+            rows, cols = self._host_cells(sft, delta, len(fids))
+            for j, fid in enumerate(fids):
+                new = (int(rows[j]), int(cols[j]))
+                old = cells.get(fid)
+                if old == new:
+                    continue
+                if old is not None:
+                    grid[old] -= 1.0
+                grid[new] += 1.0
+                cells[fid] = new
+                changed_any = True
+        return changed_any
+
+    def _apply_approx(self, st: _TypeState, sub: Subscription,
+                      offer: bool = True) -> None:
+        """Resample the shared grid onto one subscription's window and
+        push the typed `approx_density` frame carrying the bound."""
+        from geomesa_tpu.approx.sketches import resample_bounds
+
+        d = sub.density
+        grid, bound = resample_bounds(
+            st.approx_grid, None, d.bbox, d.width, d.height)
+        with sub._lock:
+            sub.grid = grid
+        if not offer:
+            return
+        total = float(grid.sum())
+        sub.offer({
+            "event": "approx_density",
+            "approx": True,
+            "total": total,
+            "cells": int(np.count_nonzero(grid)),
+            "bound": float(bound),
+            "confidence": 1.0,
+            "within_tolerance": bound <= d.tolerance * max(total, 1.0),
+        })
+        self._bump("events")
+        self._bump("approx_frames")
+
     # -- degraded per-subscription path ------------------------------------
 
     def _fold_fallback(self, st, sft, subs, delta, dev, fids,
@@ -738,7 +884,33 @@ class DeltaEvaluator:
         poisonous predicate is struck (and quarantined after the
         configured strikes — docs/ROBUSTNESS.md); everything healthy
         still folds this window exactly once."""
+        approx_dens = [s for s in subs
+                       if s.density is not None and s.density.approx]
+        if approx_dens:
+            # approx windows never rode the crashed fused kernel — the
+            # shared host fold serves them exactly as on the clean
+            # path. Only a SHARED-fold failure strikes the whole set
+            # (the state is shared); per-sub resync/apply failures are
+            # isolated per subscription, same as every other path.
+            shared_err = None
+            try:
+                changed_any = self._fold_approx_shared(
+                    st, sft, delta, fids, removed, cleared)
+            except Exception as e:  # noqa: BLE001 — shared state failed
+                shared_err = e
+            for sub in approx_dens:
+                try:
+                    if shared_err is not None:
+                        self._strike(sub, shared_err)
+                    elif sub._resync_pending():
+                        self._resync(sub)
+                    elif changed_any:
+                        self._apply_approx(st, sub)
+                except Exception as e:  # noqa: BLE001 — strike, not spread
+                    self._strike(sub, e)
         for sub in subs:
+            if sub.density is not None and sub.density.approx:
+                continue
             try:
                 if sub._resync_pending():
                     self._resync(sub)
@@ -818,19 +990,25 @@ class DeltaEvaluator:
 
     # -- delta construction ------------------------------------------------
 
-    def _delta_batch(self, sft, changed: "dict[str, dict]"):
+    def _delta_batch(self, sft, changed: "dict[str, dict]",
+                     device: bool = True):
         """Columnar delta: the window's changed rows as one pow2-padded
-        FeatureBatch + DeviceBatch (f32 coords — the serving dtype)."""
+        FeatureBatch + DeviceBatch (f32 coords — the serving dtype).
+        `device=False` (all-approx subscription sets) skips the upload
+        entirely — the host fold needs only the batch and fids."""
         if not changed:
             return None, None, []
         from geomesa_tpu.core.columnar import FeatureBatch
-        from geomesa_tpu.engine.device import to_device
 
         fids = list(changed)
         data = {a.name: [changed[f].get(a.name) for f in fids]
                 for a in sft.attributes}
         batch = FeatureBatch.from_pydict(sft, data, fids=fids)
         padded = batch.pad_to(next_pow2(max(len(batch), _PAD_MIN)))
+        if not device:
+            return padded, None, fids
+        from geomesa_tpu.engine.device import to_device
+
         # gt: waive GT09
         # (deliberate: delta upload under the per-type eval lock — the
         # fold serialization boundary; see module docstring)

@@ -62,6 +62,14 @@ class DensityWindow:
     # subtraction (the exact incremental contract — and the parity test
     # — applies only when decay is None)
     decay: Optional[float] = None
+    # approximate mode (docs/SERVING.md "Approximate answers"): a
+    # tolerance turns this into a SKETCH-BACKED window — per poll the
+    # evaluator folds the delta into one shared host-side occupancy
+    # grid per type (NO device dispatch, however many subscribers) and
+    # pushes typed `approx_density` frames carrying the resample bound.
+    # Incompatible with weight_attr/decay (per-subscription semantics a
+    # shared grid cannot carry) — validated at subscribe time.
+    tolerance: Optional[float] = None
 
     def __post_init__(self):
         if self.width < 1 or self.height < 1:
@@ -71,6 +79,18 @@ class DensityWindow:
             raise ValueError(f"degenerate density bbox {self.bbox}")
         if self.decay is not None and not 0.0 < self.decay <= 1.0:
             raise ValueError("decay must be in (0, 1]")
+        if self.tolerance is not None:
+            if self.tolerance <= 0.0:
+                raise ValueError("density tolerance must be > 0")
+            if self.weight_attr is not None or self.decay is not None:
+                raise ValueError(
+                    "approximate density (tolerance) does not support "
+                    "weight_attr or decay — the shared sketch grid is "
+                    "unweighted and exact-incremental")
+
+    @property
+    def approx(self) -> bool:
+        return self.tolerance is not None
 
 
 class Subscription:
@@ -139,7 +159,9 @@ class Subscription:
 
     @property
     def mode(self) -> str:
-        return "density" if self.density is not None else "predicate"
+        if self.density is None:
+            return "predicate"
+        return "approx_density" if self.density.approx else "density"
 
     def fingerprint(self) -> tuple:
         """Quarantine key: the predicate identity, NOT the sub id — a
@@ -148,7 +170,7 @@ class Subscription:
         if self.density is not None:
             d = self.density
             return ("subscribe", self.type_name, "density", d.bbox,
-                    d.width, d.height, d.weight_attr)
+                    d.width, d.height, d.weight_attr, d.tolerance)
         return ("subscribe", self.type_name, "predicate", self.cql)
 
     # -- lifecycle ---------------------------------------------------------

@@ -19,9 +19,11 @@ Three consumers, three formats:
   `/debug/stats` (the JSON the `gmtpu top` terminal view polls),
   `/debug/gap` (the dispatch-gap report over recorded traces),
   `/debug/slo` (the SLO engine's objective/burn report — telemetry/
-  slo.py) and `/debug/prof` (the continuous profiler's lifetime
-  distributions — telemetry/prof.py). No new dependencies:
-  ThreadingHTTPServer + the shared metrics registry.
+  slo.py), `/debug/approx` (approximate-tier shares + result-cache
+  counters — docs/SERVING.md "Approximate answers") and `/debug/prof`
+  (the continuous profiler's lifetime distributions —
+  telemetry/prof.py). No new dependencies: ThreadingHTTPServer + the
+  shared metrics registry.
 """
 
 from __future__ import annotations
@@ -208,6 +210,27 @@ class MetricsServer:
         if path == "/debug/slo":
             doc = ({"enabled": False} if self.slo_fn is None
                    else self.slo_fn())
+            return (200, "application/json", json.dumps(doc).encode())
+        if path == "/debug/approx":
+            # serving-tier shares (docs/SERVING.md "Approximate
+            # answers"): sketch vs cached vs exact, the result-cache
+            # hit/miss/evict counters, and whether the SLO exactness
+            # governor currently allows sketch serving
+            doc = {"enabled": False}
+            if self.stats_fn is not None:
+                try:
+                    stats = self.stats_fn()
+                    doc = dict(stats.get("approx") or {"enabled": False})
+                    tiers = doc.get("tiers") or {}
+                    total = sum(tiers.values())
+                    if total:
+                        doc["shares"] = {
+                            k: round(v / total, 4)
+                            for k, v in tiers.items()}
+                    if "cache" in stats:
+                        doc["cache"] = stats["cache"]
+                except Exception as e:
+                    doc = {"enabled": False, "error": str(e)}
             return (200, "application/json", json.dumps(doc).encode())
         if path == "/debug/prof":
             from geomesa_tpu.telemetry.prof import PROFILER

@@ -56,11 +56,16 @@ DEFAULT_MIN_N = 8
 
 def baseline_from_profile(profile: dict,
                           latency_samples_ms: Optional[List[float]] = None,
-                          extra: Optional[dict] = None) -> dict:
+                          extra: Optional[dict] = None,
+                          extra_samples: Optional[
+                              Dict[str, List[float]]] = None) -> dict:
     """Flatten a ContinuousProfiler snapshot (include_samples=True)
     into the baseline's metric table. `latency_samples_ms` adds the
     load report's end-to-end `serve.latency` samples — the headline
-    the sentinel guards even when tracing is off."""
+    the sentinel guards even when tracing is off. `extra_samples` adds
+    named latency-vector families wholesale (e.g. the approx bench's
+    `approx.count.sketch` / `approx.count.exact` reservoirs — a
+    regressed sketch path then fails CI like any other family)."""
     metrics: Dict[str, dict] = {}
 
     def put(name: str, snap: dict) -> None:
@@ -80,6 +85,15 @@ def baseline_from_profile(profile: dict,
     if latency_samples_ms:
         s = sorted(latency_samples_ms)
         metrics["serve.latency"] = {
+            "n": len(s),
+            "median_ms": s[len(s) // 2],
+            "samples_ms": [round(v, 4) for v in s],
+        }
+    for name, samples in (extra_samples or {}).items():
+        if not samples:
+            continue
+        s = sorted(samples)
+        metrics[name] = {
             "n": len(s),
             "median_ms": s[len(s) // 2],
             "samples_ms": [round(v, 4) for v in s],

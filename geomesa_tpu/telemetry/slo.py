@@ -247,6 +247,7 @@ class SloEngine:
         # admission path pays one clock read + compare.
         self.boost_ttl_s = 0.25
         self._boost_cache: Tuple[float, int] = (-1e18, 0)
+        self._exact_cache: Tuple[float, bool] = (-1e18, False)
 
     # -- ingest ------------------------------------------------------------
 
@@ -382,6 +383,32 @@ class SloEngine:
             boost = 1
         self._boost_cache = (now, boost)
         return boost
+
+    def exactness_spent(self) -> bool:
+        """True when any EXACTNESS objective's error budget is fully
+        spent — the approximate-answer tier's governor (docs/SERVING.md
+        "Approximate answers"): sketch-served answers observe as
+        degraded, so they spend this budget; once it is gone the serve
+        layer strips tolerance hints and traffic moves to the EXACT
+        path until the budget window recovers. Same clock-TTL cache
+        discipline as degrade_boost (admission consults this per
+        tolerant request)."""
+        now = self.clock()
+        cached_at, value = self._exact_cache
+        if now - cached_at < self.boost_ttl_s:
+            return value
+        ctx = self._context()
+        spent = False
+        for obj in self.spec.objectives.values():
+            if obj.kind != "exactness":
+                continue
+            rates = self.burn_rates(obj, _ctx=ctx)
+            if (rates["n_slow"] >= obj.min_count
+                    and self.budget_remaining(obj, _ctx=ctx) <= 0.0):
+                spent = True
+                break
+        self._exact_cache = (now, spent)
+        return spent
 
     # -- export ------------------------------------------------------------
 

@@ -418,6 +418,79 @@ def fleet_smoke() -> int:
     return 1 if failures else 0
 
 
+def approx_smoke() -> int:
+    """The approximate-answer tier loop (docs/SERVING.md "Approximate
+    answers"): a tolerant count workload over a tiny store must serve
+    from SKETCHES with every reported bound containing the exact
+    replayed answer, and a repeated exact query must hit the
+    version-exact result cache with a bit-identical result on the
+    second pass. Stderr-only like the other smokes."""
+    _pin_cpu()
+    import tempfile
+
+    import numpy as np
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.plan.datastore import DataStore
+    from geomesa_tpu.plan.hints import QueryHints
+    from geomesa_tpu.plan.query import Query
+    from geomesa_tpu.serve.scheduler import ServeRequest
+    from geomesa_tpu.serve.service import QueryService, ServeConfig
+
+    failures = []
+    rng = np.random.default_rng(17)
+    n = 2048
+    sft = SimpleFeatureType.from_spec(
+        "approxsmoke", "name:String,dtg:Date,*geom:Point")
+    cqls = ["BBOX(geom, -180, -90, 180, 90)",
+            "BBOX(geom, -60, -30, 60, 30)"]
+    with tempfile.TemporaryDirectory() as tmp:
+        store = DataStore(tmp, use_device_cache=True)
+        src = store.create_schema(sft)
+        src.write(FeatureBatch.from_pydict(sft, {
+            "name": rng.choice(["a", "b"], n).tolist(),
+            "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack([rng.uniform(-170, 170, n),
+                              rng.uniform(-80, 80, n)], 1),
+        }))
+        svc = QueryService(store, ServeConfig(max_wait_ms=1.0))
+        try:
+            served = 0
+            for cql in cqls:
+                req = ServeRequest(kind="count", query=Query(
+                    "approxsmoke", cql,
+                    hints=QueryHints(tolerance=0.2)))
+                got = svc.submit(req).result(timeout=300)
+                exact = svc.count("approxsmoke", cql).result(timeout=300)
+                if not getattr(got, "approx", False):
+                    failures.append(
+                        f"tolerant count {cql!r} not sketch-served")
+                    continue
+                served += 1
+                if abs(int(got) - int(exact)) > got.bound:
+                    failures.append(
+                        f"bound violated for {cql!r}: approx {int(got)} "
+                        f"+/- {got.bound} vs exact replay {int(exact)}")
+            # second pass: the exact queries above populated the cache
+            for cql in cqls:
+                svc.count("approxsmoke", cql).result(timeout=300)
+            cache = svc.stats().get("cache", {})
+            if cache.get("hits", 0) < len(cqls):
+                failures.append(
+                    f"repeated exact queries did not hit the result "
+                    f"cache: {cache}")
+            tiers = svc.stats()["approx"]["tiers"]
+        finally:
+            svc.close(drain=True)
+    print(f"approx smoke: {served} sketch-served (tiers {tiers}), "
+          f"cache {cache.get('hits', 0)}h/{cache.get('misses', 0)}m",
+          file=sys.stderr)
+    for f in failures:
+        print(f"approx smoke: FAIL {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def warmup_smoke(manifest_path: str = SMOKE_MANIFEST) -> int:
     """`gmtpu warmup --check` against the fixture manifest, pinned to
     CPU (the fixture records interpret-mode kernels; this gate must run
@@ -475,6 +548,11 @@ def main(argv=None) -> int:
                         "fleet on CPU, one scripted kill, zero "
                         "un-typed errors + consistent router gauges; "
                         "text mode only)")
+    p.add_argument("--no-approx-smoke", action="store_true",
+                   help="skip the approximate-answer smoke (sketch-"
+                        "served tolerant counts with bounds verified "
+                        "against exact replay + result-cache hit on "
+                        "the second pass; text mode only)")
     args = p.parse_args(argv)
     findings = lint_paths([os.path.join(REPO_ROOT, "geomesa_tpu")])
     if args.format == "json":
@@ -494,6 +572,8 @@ def main(argv=None) -> int:
         rc = sentinel_smoke()
     if args.format == "text" and not args.no_fleet_smoke and rc == 0:
         rc = fleet_smoke()
+    if args.format == "text" and not args.no_approx_smoke and rc == 0:
+        rc = approx_smoke()
     return rc
 
 

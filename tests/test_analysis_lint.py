@@ -1184,3 +1184,90 @@ class TestSelfLint:
         gt05 = [f for f in active(fs) if f.rule == "GT05"]
         assert not gt05, "\n".join(f.render() for f in gt05)
         assert any(f.waived and f.rule == "GT03" for f in fs)
+
+
+class TestGT21RawCqlCacheKeys:
+    """Result-cache keys built from raw CQL text (docs/ANALYSIS.md
+    GT21): equivalent filter spellings fork the key space — a dashboard
+    fleet's repeated queries become a cache-miss storm instead of dict
+    hits."""
+
+    def _findings(self, src, relpath="geomesa_tpu/serve/service.py"):
+        from geomesa_tpu.analysis.modinfo import ModInfo
+        from geomesa_tpu.analysis.rules import gt21
+
+        mod = ModInfo("/x.py", textwrap.dedent(src), relpath=relpath)
+        return list(gt21(mod, None))
+
+    DIRTY = """
+        from geomesa_tpu.approx.cache import result_key
+
+        def peek(result_cache, req, version):
+            key = result_key(req.kind, req.query.cql, version)
+            return result_cache.get(key)
+
+        def peek_wire(result_cache, doc, version):
+            return result_cache.get(
+                ("count", doc["typeName"], doc["cql"], version))
+
+        def put_wire(result_cache, doc, out, version):
+            result_cache.put(
+                ("count", doc.get("cql"), version), out)
+    """
+
+    def test_raw_cql_keys_flagged(self):
+        found = self._findings(self.DIRTY)
+        # result_key(.cql) line 5, .get(doc["cql"]) line 9, .put(.get("cql")) line 13
+        lines = sorted(f.line for f in found)
+        assert len(found) == 3, found
+        assert all(f.rule == "GT21" for f in found)
+        assert lines == [5, 9, 13], lines
+
+    def test_clean_counterparts(self):
+        clean = """
+            from geomesa_tpu.approx.cache import result_key
+            from geomesa_tpu.cql import ast
+
+            def peek(result_cache, req, version):
+                # the Query OBJECT canonicalizes inside result_key
+                key = result_key(req.kind, req.query, version)
+                return result_cache.get(key)
+
+            def peek_explicit(result_cache, query, version):
+                cql = ast.to_cql(query.filter_ast)
+                return result_cache.get(("count", cql, version))
+
+            def unrelated(sub, filters):
+                # .cql reads OUTSIDE cache-key construction never fire
+                return filters[(sub.type_name, sub.cql)]
+        """
+        assert self._findings(clean) == []
+
+    def test_scope_is_path_limited(self):
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/subscribe/evaluator.py") == []
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/approx/cache.py") != []
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/plan/planner.py") != []
+
+    def test_registration(self):
+        from geomesa_tpu.analysis.model import RULES
+        from geomesa_tpu.analysis.rules import ALL_RULES
+
+        assert "GT21" in RULES and "GT21" in ALL_RULES
+
+    def test_waiver(self, tmp_path):
+        import pathlib
+
+        sub = pathlib.Path(tmp_path) / "geomesa_tpu" / "serve"
+        sub.mkdir(parents=True)
+        (sub / "x.py").write_text(textwrap.dedent("""
+            def peek(result_cache, doc, version):
+                # gt: waive GT21
+                return result_cache.get(("count", doc["cql"], version))
+        """))
+        fs = lint_paths([str(tmp_path)], rules=["GT21"],
+                        extra_ref_paths=[])
+        assert any(f.rule == "GT21" and f.waived for f in fs)
+        assert not active([f for f in fs if f.rule == "GT21"])

@@ -305,11 +305,17 @@ class TestService:
         svc.count("served", "score > 1")
         svc.count("served", "score > 2")
         assert svc.degrade_level() == 1
-        # level 1: consenting requests get downgraded hints
+        # level 1: consenting requests get downgraded hints. CQL
+        # carries an attribute predicate (`score > -5`) the sketches
+        # cannot see, so the ladder keeps the LEGACY loose-bbox rung
+        # for it (the sketch rung takes only sketch-eligible filters —
+        # docs/SERVING.md "Approximate answers"; tests/test_approx.py
+        # covers that branch)
         fut_req = svc._request("count", Query("served", CQL),
                                allow_degraded=True)
         svc.submit(fut_req)
         assert fut_req.degraded and fut_req.query.hints.loose_bbox
+        assert fut_req.sketch_rung == 0
         assert svc.degrade_level() == 2
         # level 2: batch class is shed with the typed reason
         with pytest.raises(QueryRejected) as ei:
