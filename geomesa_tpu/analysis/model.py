@@ -97,6 +97,12 @@ RULES: Dict[str, Rule] = {
                      "of the canonical ast.to_cql form: equivalent "
                      "filter spellings fork the key space into a "
                      "cache-miss storm (serve/approx/plan scope)"),
+        Rule("GT22", "per-row serialization in a wire-encode loop "
+                     "(serve//subscribe/ scope): json.dumps inside a "
+                     "loop or a per-iteration dict comprehension pays "
+                     "N encodes for N rows/subscribers — encode once "
+                     "and ship columnar frames / fan through PushMux "
+                     "(docs/SERVING.md \"Columnar wire\")"),
     )
 }
 

@@ -1384,6 +1384,84 @@ def gt21(mod: ModInfo, project) -> Iterator[Finding]:
             "documented deliberate raw-text key.")
 
 
+# GT22 scope: the wire-encode layers — where bulk payloads (execute
+# results, push frames) are serialized onto connections. The columnar
+# wire (serve/columnar.py) exists precisely so the hot path never pays
+# a Python dict + json.dumps PER ROW / PER SUBSCRIBER; this rule keeps
+# the pattern from creeping back (docs/SERVING.md "Columnar wire").
+_GT22_PREFIXES = ("geomesa_tpu/serve/", "geomesa_tpu/subscribe/")
+
+
+def _gt22_is_dumps(call: ast.Call) -> bool:
+    """True for json.dumps(...) / dumps(...)."""
+    f = call.func
+    return ((isinstance(f, ast.Attribute) and f.attr == "dumps")
+            or (isinstance(f, ast.Name) and f.id == "dumps"))
+
+
+def gt22(mod: ModInfo, project) -> Iterator[Finding]:
+    """GT22: per-row serialization in a wire-encode loop.
+
+    Flags, inside `geomesa_tpu/serve/` and `geomesa_tpu/subscribe/`:
+    (a) a `json.dumps(...)` call lexically inside a `for`/`while`
+    body — serializing row-by-row (or frame-by-frame per subscriber)
+    is the N-encodes pattern the PushMux/columnar framing removed:
+    encode ONCE outside the loop, or route through
+    `serve.columnar.PushMux`; and (b) a dict comprehension nested
+    inside a `for`/`while` body or as the element of a list/generator
+    comprehension — materializing one Python dict per feature on the
+    encode path (the columnar codecs keep rows in column buffers).
+    Function/class boundaries reset the loop context: a helper that
+    dumps once per CALL is fine even when its callers loop. Waivable
+    inline (`# gt: waive GT22`) for a documented deliberate per-row
+    encode (e.g. the bounded JSON fallback)."""
+    path = mod.relpath.replace("\\", "/")
+    if not any(p in path for p in _GT22_PREFIXES):
+        return
+
+    findings: List[Finding] = []
+
+    def walk(node: ast.AST, in_loop: bool, in_comp: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                # new lexical scope: its body runs once per CALL, not
+                # once per iteration of an enclosing loop
+                walk(child, False, False)
+                continue
+            loop_here = in_loop or isinstance(child, (ast.For,
+                                                      ast.While))
+            comp_here = in_comp or isinstance(child, (ast.ListComp,
+                                                      ast.GeneratorExp,
+                                                      ast.SetComp))
+            if (isinstance(child, ast.Call) and in_loop
+                    and _gt22_is_dumps(child)):
+                findings.append(_finding(
+                    "GT22", mod, child,
+                    "json.dumps inside a loop on the wire-encode "
+                    "path: N rows (or N subscribers) pay N encodes — "
+                    "encode ONCE outside the loop, ship the bulk "
+                    "payload as a columnar frame "
+                    "(serve/columnar.py), or fan push frames through "
+                    "PushMux; waive a documented deliberate per-row "
+                    "encode"))
+            elif isinstance(child, ast.DictComp) and (in_loop
+                                                      or in_comp):
+                findings.append(_finding(
+                    "GT22", mod, child,
+                    "dict comprehension per loop iteration on the "
+                    "wire-encode path: one Python dict per feature "
+                    "is the row-materialization the columnar wire "
+                    "removes — keep rows in column buffers "
+                    "(serve/columnar.py codecs) and build dicts only "
+                    "at the decode edge; waive a documented "
+                    "deliberate per-row build"))
+            walk(child, loop_here, comp_here)
+
+    walk(mod.tree, False, False)
+    yield from findings
+
+
 from geomesa_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES)
 
@@ -1392,6 +1470,6 @@ ALL_RULES = {
     "GT04": gt04, "GT05": gt05, "GT06": gt06,
     "GT13": gt13, "GT14": gt14, "GT15": gt15, "GT16": gt16,
     "GT17": gt17, "GT18": gt18, "GT19": gt19, "GT20": gt20,
-    "GT21": gt21,
+    "GT21": gt21, "GT22": gt22,
     **CONCURRENCY_RULES,
 }

@@ -56,12 +56,19 @@ def register(sub: "argparse._SubParsersAction") -> None:
                              "help": "ISO-8601 instant (e.g. "
                                      "2020-06-01T00:00:00Z)"})])
     cmd(
-        "ingest", "ingest files through a converter",
+        "ingest", "ingest files through a converter (or Arrow IPC "
+                  "record-batch files columnar, no converter needed)",
         _ingest,
         [cat, feat,
-         (["--converter", "-C"], {"required": True,
+         (["--converter", "-C"], {"required": False, "default": None,
           "help": "converter config JSON file, or a well-known name "
-                  "(gdelt|ais|nyctaxi)"}),
+                  "(gdelt|ais|nyctaxi); optional for .arrow/.ipc "
+                  "inputs, which ingest columnar via write_batch"}),
+         (["--arrow"], {"action": "store_true",
+          "help": "treat every input as an Arrow IPC stream file "
+                  "(the columnar bulk-ingest path — record-batch "
+                  "buffers flow in as NumPy views, no per-feature "
+                  "dicts; docs/SERVING.md \"Columnar wire\")"}),
          (["--workers"], {"type": int, "default": 1,
           "help": "parallel converter threads (distributed-ingest analog)"}),
          (["--no-resume"], {"action": "store_true",
@@ -255,7 +262,15 @@ def register(sub: "argparse._SubParsersAction") -> None:
     bserve_p.add_argument("--k", type=int, default=8, help="kNN k")
     bserve_p.add_argument("--mode", default="closed",
                           choices=["closed", "open", "sustained",
-                                   "subscribe", "approx"])
+                                   "subscribe", "approx", "wire"])
+    bserve_p.add_argument("--wire-rows", type=int, default=100_000,
+                          help="wire mode: rows per bulk execute "
+                               "response (the JSON-vs-columnar encode "
+                               "comparison; docs/SERVING.md "
+                               "\"Columnar wire\")")
+    bserve_p.add_argument("--push-sinks", type=int, default=1000,
+                          help="wire mode: fan-out subscriber count "
+                               "(one encode per frame, asserted)")
     bserve_p.add_argument("--tolerance", type=float, default=0.1,
                           help="approx mode: tolerant clients' accuracy "
                                "contract (bound <= tolerance * answer)")
@@ -653,10 +668,15 @@ def _bench_serve(args) -> int:
         args.subs = min(args.subs, 4)
         args.batches = min(args.batches, 6)
         args.rows = min(args.rows, 32)
+    if args.smoke and args.mode == "wire":
+        args.wire_rows = min(args.wire_rows, 20_000)
+        args.push_sinks = min(args.push_sinks, 128)
     if args.mode == "subscribe":
         return _bench_subscribe(args)
     if args.mode == "approx":
         return _bench_approx(args)
+    if args.mode == "wire":
+        return _bench_wire(args)
     if getattr(args, "fleet", None):
         return _bench_fleet(args)
     with contextlib.ExitStack() as stack:
@@ -974,6 +994,102 @@ def _bench_subscribe(args) -> int:
                         subscriptions=args.subs, batches=args.batches)
     print(json.dumps({"run": "subscribe", **rep.to_json()}))
     return 0
+
+
+def _bench_wire(args) -> int:
+    """`gmtpu bench-serve --mode wire`: the JSON-lines vs columnar
+    record-batch comparison over one bulk execute result (rows/s,
+    bytes/s, encode p50/p99) plus the PushMux fan-out (events/s at
+    --push-sinks subscribers, one encode per frame asserted). The
+    verdict gates on decoded-parity, the >=5x rows/s acceptance
+    floor, and the one-encode invariant; with --record-baseline /
+    --sentinel the wire.encode.* sample families ride the sentinel so
+    a slowed encoder fails CI like any other hot-path regression."""
+    import contextlib
+    import tempfile
+
+    import numpy as np
+
+    from geomesa_tpu.plan import DataStore
+    from geomesa_tpu.serve import columnar as colwire
+    from geomesa_tpu.serve.loadgen import run_wire
+
+    if not colwire.have_pyarrow():
+        # typed skip, mirroring the wire smoke: a json-only host has
+        # nothing to compare — not a failure
+        print(json.dumps({"run": "wire", "skipped": True,
+                          "reason": "pyarrow_unavailable"}))
+        return 0
+    with contextlib.ExitStack() as stack:
+        if args.catalog:
+            if not args.feature_name:
+                print("error: --catalog needs --feature-name",
+                      file=sys.stderr)
+                return 2
+            store = DataStore(args.catalog, use_device_cache=True)
+            type_name = args.feature_name
+        else:
+            from geomesa_tpu.core.columnar import FeatureBatch
+            from geomesa_tpu.core.sft import SimpleFeatureType
+
+            tmp = stack.enter_context(tempfile.TemporaryDirectory())
+            n = max(args.n, args.wire_rows)
+            rng = np.random.default_rng(11)
+            sft = SimpleFeatureType.from_spec(
+                "bench", "name:String,score:Double,dtg:Date,*geom:Point")
+            store = DataStore(tmp, use_device_cache=True)
+            src = store.create_schema(sft)
+            src.write(FeatureBatch.from_pydict(sft, {
+                "name": rng.choice(["a", "b", "c"], n).tolist(),
+                "score": rng.uniform(-10, 10, n),
+                "dtg": rng.integers(
+                    1_590_000_000_000, 1_600_000_000_000, n),
+                "geom": np.stack([rng.uniform(-170, 170, n),
+                                  rng.uniform(-80, 80, n)], 1),
+            }))
+            type_name = "bench"
+        rep = run_wire(store, type_name, rows=args.wire_rows,
+                       push_sinks=args.push_sinks)
+        print(json.dumps({"run": "wire", **rep.to_json()}))
+        ok = (rep.wire_parity_ok
+              and rep.wire_speedup >= 5.0
+              and rep.push_encodes == rep.push_frames)
+        print(json.dumps({
+            "run": "wire_verdict", "ok": ok,
+            "speedup": round(rep.wire_speedup, 1),
+            "parity": rep.wire_parity_ok,
+            "one_encode": rep.push_encodes == rep.push_frames,
+            "push_events_per_s": round(rep.push_events_per_s)}))
+        record_baseline = getattr(args, "record_baseline", None)
+        sentinel_path = getattr(args, "sentinel", None)
+        if record_baseline or sentinel_path:
+            from geomesa_tpu.telemetry import sentinel as snt
+
+            doc = snt.baseline_from_profile(
+                {},
+                extra_samples={
+                    "wire.encode.json": rep.wire_json_samples_ms,
+                    "wire.encode.columnar": rep.wire_columnar_samples_ms,
+                    "wire.push.publish": rep.push_publish_samples_ms,
+                },
+                extra={"mode": "wire", "rows": rep.wire_rows,
+                       "push_sinks": rep.push_sinks,
+                       "speedup": round(rep.wire_speedup, 2)})
+            if record_baseline:
+                path = snt.save_baseline(record_baseline, doc)
+                print(json.dumps({"run": "baseline", "path": path,
+                                  "metrics": len(doc["metrics"])}))
+            if sentinel_path:
+                baseline = snt.load_baseline(sentinel_path)
+                kw = {}
+                if getattr(args, "sentinel_threshold", None):
+                    kw["threshold"] = args.sentinel_threshold
+                report = snt.compare(baseline, doc, **kw)
+                print(json.dumps({"run": "sentinel",
+                                  "baseline": sentinel_path, **report}))
+                print(snt.render_verdicts(report), file=sys.stderr)
+                return max(snt.exit_code(report), 0 if ok else 1)
+        return 0 if ok else 1
 
 
 def _bench_approx(args) -> int:
@@ -1627,6 +1743,50 @@ def _ingest(args) -> int:
     from geomesa_tpu.convert import converter_from_config, schemas
 
     ds = _store(args)
+    arrow_files = [p for p in args.files
+                   if getattr(args, "arrow", False)
+                   or p.endswith((".arrow", ".ipc"))]
+    if arrow_files:
+        # columnar bulk ingest: record batches go straight into the
+        # store as NumPy views via DataStore.write_batch — no
+        # converter, no per-feature Python dicts (docs/SERVING.md
+        # "Columnar wire")
+        if set(arrow_files) != set(args.files):
+            print("error: cannot mix Arrow IPC and converter inputs "
+                  "in one ingest", file=sys.stderr)
+            return 2
+        if args.feature_name not in ds.get_type_names():
+            # the IPC stream embeds the SFT spec in its schema
+            # metadata (arrow_io.arrow_schema) — create the schema
+            # from it, or refuse TYPED instead of a raw traceback
+            import pyarrow as pa
+
+            from geomesa_tpu.core.sft import SimpleFeatureType
+
+            with open(arrow_files[0], "rb") as f:
+                meta = pa.ipc.open_stream(f).schema.metadata or {}
+            spec = meta.get(b"geomesa.sft.spec")
+            if spec is None:
+                print(f"error: schema {args.feature_name!r} does not "
+                      f"exist and {arrow_files[0]} carries no "
+                      f"geomesa.sft.spec metadata — run create-schema "
+                      f"first", file=sys.stderr)
+                return 2
+            ds.create_schema(SimpleFeatureType.from_spec(
+                args.feature_name, spec.decode()))
+        total = batches = 0
+        for path in arrow_files:
+            with open(path, "rb") as f:
+                rows, nb = ds.write_batch(args.feature_name, f.read())
+            total += rows
+            batches += nb
+        print(f"ingested {total} features ({batches} record batches, "
+              f"columnar) into {args.feature_name}")
+        return 0
+    if not args.converter:
+        print("error: --converter is required for non-Arrow inputs",
+              file=sys.stderr)
+        return 2
     if args.converter in schemas.WELL_KNOWN:
         sft, config = schemas.WELL_KNOWN[args.converter]
         sft = type(sft)(args.feature_name, sft.attributes, sft.user_data)

@@ -70,13 +70,17 @@ def arrow_schema(sft: SimpleFeatureType, include_fid: bool = True) -> pa.Schema:
                                        b"geomesa.sft.spec": sft.to_spec().encode()})
 
 
-def to_arrow(batch: FeatureBatch) -> pa.RecordBatch:
+def to_arrow(batch: FeatureBatch,
+             schema: Optional[pa.Schema] = None) -> pa.RecordBatch:
     # Padding is a transient device-shape concern, not a persistence concern:
     # compact to valid rows so no fabricated features reach the wire.
     if batch.valid is not None and not batch.valid.all():
         batch = batch.select(batch.valid)
     arrays: List[pa.Array] = []
-    schema = arrow_schema(batch.sft, include_fid=batch.fids is not None)
+    # `schema` lets hot callers (the columnar wire's per-typeName cache)
+    # skip re-deriving it per batch; it must match the derived one
+    if schema is None:
+        schema = arrow_schema(batch.sft, include_fid=batch.fids is not None)
     for a in batch.sft.attributes:
         col = batch.columns[a.name]
         if isinstance(col, GeometryColumn):
@@ -251,6 +255,20 @@ def merge_sorted_ipc(streams: List[bytes]) -> bytes:
             pa.record_batch(merged.columns, schema=schema)
         )
     return sink.getvalue()
+
+
+def ipc_feature_batches(
+    payload: bytes, sft: Optional[SimpleFeatureType] = None
+) -> Iterable[FeatureBatch]:
+    """FeatureBatches decoded from one Arrow IPC stream (the columnar
+    wire's bulk-ingest payload). Numeric and point-geometry columns
+    come out as NumPy views over the IPC buffers where pyarrow allows
+    zero-copy — no per-feature Python objects on the ingest path."""
+    import io
+
+    reader = pa.ipc.open_stream(io.BytesIO(payload))
+    for rb in reader:
+        yield from_arrow(rb, sft)
 
 
 def write_ipc(path: str, batches: Iterable[FeatureBatch]) -> None:

@@ -321,9 +321,23 @@ class ReplicaServer:
                 except OSError:
                     pass
 
+            def write_frame(buf: bytes) -> None:
+                # columnar wire frames (header + payload in one
+                # buffer); same undeliverable-peer stance as lines
+                try:
+                    conn.send_bytes(buf)
+                except OSError:
+                    pass
+
+            def read_frame(n: int) -> bytes:
+                # inbound binary payloads (bulk ingest, kNN staging
+                # buffers): bounded like every fleet socket read
+                return conn.read_exact(n, self._stop)
+
             serve_connection(
                 self.store, self.svc, conn.lines(self._stop),
-                write_line, control=self)
+                write_line, control=self,
+                write_bytes=write_frame, read_bytes=read_frame)
         except Exception:  # noqa: BLE001 — one conn, not the replica
             pass
         finally:

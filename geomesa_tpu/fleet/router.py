@@ -53,7 +53,8 @@ from geomesa_tpu.fleet.wire import JsonLineConn, connect_json
 IDEMPOTENT_OPS = frozenset(
     ("query", "execute", "count", "knn", "stats"))
 _SUBSCRIBE_OPS = frozenset(
-    ("subscribe", "unsubscribe", "poll", "subscriptions"))
+    ("subscribe", "unsubscribe", "poll", "subscriptions",
+     "attach", "detach"))
 
 _DEFAULT_DEADLINE_S = 30.0
 _PROBE_INTERVAL_S = 0.5
@@ -66,10 +67,10 @@ class _Pending:
     """One routed request awaiting its replica response."""
 
     __slots__ = ("client", "orig_id", "doc", "op", "attempts",
-                 "deadline", "probe_cb")
+                 "deadline", "probe_cb", "payload")
 
     def __init__(self, client, orig_id, doc, op, deadline,
-                 probe_cb=None):
+                 probe_cb=None, payload=None):
         self.client = client
         self.orig_id = orig_id
         self.doc = doc
@@ -77,6 +78,10 @@ class _Pending:
         self.attempts = 0
         self.deadline = deadline
         self.probe_cb = probe_cb
+        # columnar wire (docs/SERVING.md "Columnar wire"): an inbound
+        # binary frame payload, forwarded OPAQUELY — immutable bytes,
+        # so a retry-once redispatch re-sends the identical frame
+        self.payload = payload
 
 
 class ReplicaLink:
@@ -134,7 +139,12 @@ class ReplicaLink:
         doc = dict(p.doc)
         doc["id"] = token
         try:
-            self.conn.send(doc)
+            # binary request frames forward opaquely (send_frame is one
+            # locked write: header + payload can never tear)
+            if p.payload is not None:
+                self.conn.send_frame(doc, p.payload)
+            else:
+                self.conn.send(doc)
         except OSError:
             with self._lock:
                 owned = self.pending.pop(token, None) is not None
@@ -310,9 +320,13 @@ class FleetRouter:
         finally:
             conn.close()
 
-    def _safe_send(self, client, doc: dict) -> None:
+    def _safe_send(self, client, doc: dict,
+                   payload: Optional[bytes] = None) -> None:
         try:
-            client.send(doc)
+            if payload is not None:
+                client.send_frame(doc, payload)
+            else:
+                client.send(doc)
         except OSError:
             # hung up, or blew the write deadline mid-frame: the
             # stream may be torn at a non-boundary — close it so no
@@ -332,6 +346,10 @@ class FleetRouter:
               default_id=None) -> None:
         rid = doc.get("id", default_id)
         op = doc.get("op", "query")
+        # inbound binary frame payload (attached by docs()): held
+        # separately so the doc stays JSON-serializable; forwarded
+        # opaquely — the router never parses columnar payloads
+        payload = doc.pop("_payload", None)
         self._bump("requests")
         if op == "hello":
             role = str(doc.get("role", "client"))
@@ -340,8 +358,25 @@ class FleetRouter:
             self._safe_send(client, {
                 "id": rid, "ok": True, "role": role, "router": True,
                 "admin": session["admin"],
+                # passthrough is OPAQUE: the router forwards frames
+                # byte-for-byte without pyarrow; the replica's typed
+                # per-request downgrade is authoritative
+                "wire": ["json", "columnar"],
                 **{k: v for k, v in self.membership.snapshot().items()
                    if k in ("ready", "total")}})
+            return
+        if op == "ingest":
+            # the query wire has NO write verbs by design — that is
+            # what makes the router's retry-once failover safe (zero
+            # double-executed writes). Bulk ingest therefore goes to a
+            # replica's own port (or the CLI), never through the
+            # router; refuse typed rather than silently double-write
+            self._safe_send(client, {
+                "id": rid, "ok": False, "error": "rejected",
+                "reason": "unsupported",
+                "message": "the router does not proxy ingest (write "
+                           "verbs break retry-once failover safety): "
+                           "ingest against a replica port directly"})
             return
         if op == "fleet":
             self._safe_send(client, {
@@ -390,7 +425,7 @@ class FleetRouter:
         deadline = time.monotonic() + (
             float(doc["timeoutMs"]) / 1000.0 if doc.get("timeoutMs")
             else self.default_deadline_s)
-        p = _Pending(client, rid, doc, op, deadline)
+        p = _Pending(client, rid, doc, op, deadline, payload=payload)
         if not self._dispatch(p, exclude=()):
             self._answer_unavailable(p, "no_replicas")
 
@@ -485,8 +520,11 @@ class FleetRouter:
             if self._dispatch(p, exclude=(link.handle.replica_id,)):
                 return
         out = dict(got)
+        # columnar response frames pass through opaquely: the payload
+        # rides beside the rewritten header, byte-for-byte
+        payload = out.pop("_payload", None)
         out["id"] = p.orig_id
-        self._safe_send(p.client, out)
+        self._safe_send(p.client, out, payload)
 
     def _on_link_down(self, link: ReplicaLink,
                       orphans: List[_Pending]) -> None:

@@ -53,7 +53,27 @@ class JsonLineConn:
     def send_line(self, line: str) -> None:
         self._write((line.rstrip("\n") + "\n").encode())
 
-    def _write(self, data: bytes) -> None:
+    def send_bytes(self, data: bytes) -> None:
+        """Raw pre-encoded bytes (a columnar wire frame: header line +
+        payload already concatenated) — one locked write, so the
+        framing cannot interleave with a concurrent send()."""
+        self._write(data)
+
+    def send_frame(self, doc: dict, payload: Optional[bytes]) -> None:
+        """A header doc + raw payload under ONE lock hold (the
+        router's opaque passthrough: the payload is forwarded
+        byte-for-byte, never parsed, and never concat-copied — a
+        multi-MB Arrow frame transits with zero extra memcpy).
+        `doc["frame"]["nbytes"]` is re-stamped from the actual payload
+        so a rewritten header stays consistent."""
+        if payload is None:
+            self.send(doc)
+            return
+        from geomesa_tpu.serve.columnar import frame_header_bytes
+
+        self._write(frame_header_bytes(doc, payload), payload)
+
+    def _write(self, *parts: bytes) -> None:
         """Whole-frame write under the short socket poll timeout:
         `sendall` would raise mid-frame on a backpressured peer and
         TEAR THE FRAMING (the next write lands glued to a partial
@@ -61,21 +81,24 @@ class JsonLineConn:
         so partial writes resume; a peer that accepts nothing for
         WRITE_TIMEOUT_S raises OSError with the stream positioned at
         a frame boundary for nobody — the caller must close the
-        connection, never keep writing."""
+        connection, never keep writing. Multiple `parts` (a frame
+        header + its payload) go out under ONE lock hold, so framing
+        cannot tear and the caller pays no concat copy."""
         import time
 
         with self._wlock:
             deadline = time.monotonic() + WRITE_TIMEOUT_S
-            view = memoryview(data)
-            while view:
-                try:
-                    n = self.sock.send(view)
-                except socket.timeout:
-                    if time.monotonic() > deadline:
-                        raise OSError(
-                            "write timed out: peer not draining")
-                    continue
-                view = view[n:]
+            for data in parts:
+                view = memoryview(data)
+                while view:
+                    try:
+                        n = self.sock.send(view)
+                    except socket.timeout:
+                        if time.monotonic() > deadline:
+                            raise OSError(
+                                "write timed out: peer not draining")
+                        continue
+                    view = view[n:]
 
     def lines(self, stop: Optional[threading.Event] = None
               ) -> Iterator[str]:
@@ -106,16 +129,55 @@ class JsonLineConn:
             # (reader-confined, see above)
             self._buf += chunk
 
+    def read_exact(self, n: int,
+                   stop: Optional[threading.Event] = None) -> bytes:
+        """Exactly `n` raw payload bytes following a frame header line
+        (docs/SERVING.md "Columnar wire"). Same bounded-poll discipline
+        as lines(); raises OSError when the peer vanishes mid-frame —
+        the stream is torn at a non-boundary and MUST be closed."""
+        while len(self._buf) < n:
+            if stop is not None and stop.is_set():
+                raise OSError("stopped mid-frame")
+            try:
+                chunk = self.sock.recv(_RECV_CHUNK)
+            except socket.timeout:
+                continue
+            except OSError:
+                raise OSError("peer vanished mid-frame")
+            if not chunk:
+                raise OSError("EOF mid-frame")
+            # gt: waive GT07
+            # (reader-confined, see lines())
+            self._buf += chunk
+        out = bytes(self._buf[:n])
+        # gt: waive GT07
+        # (reader-confined, see lines())
+        self._buf = self._buf[n:]
+        return out
+
     def docs(self, stop: Optional[threading.Event] = None
              ) -> Iterator[dict]:
+        """Parsed docs until EOF/stop. Frame-aware: a doc whose
+        `frame.nbytes` announces a binary payload has it read from the
+        stream and attached under the non-JSON key `"_payload"` —
+        callers forwarding the doc must pop it first (the router's
+        passthrough and request() both do)."""
         for line in self.lines(stop):
             line = line.strip()
             if not line:
                 continue
             try:
-                yield json.loads(line)
+                doc = json.loads(line)
             except ValueError:
                 continue  # torn line from an aborted peer: skip
+            fr = doc.get("frame") if isinstance(doc, dict) else None
+            if fr and fr.get("nbytes"):
+                try:
+                    doc["_payload"] = self.read_exact(
+                        int(fr["nbytes"]), stop)
+                except OSError:
+                    return  # torn mid-frame: EOF for the caller
+            yield doc
 
     def request(self, doc: dict, timeout_s: float = 30.0) -> dict:
         """One synchronous round trip (probe/CLI use — NOT the router's

@@ -168,6 +168,30 @@ class DataStore:
             self._sources[sft.name] = src
         return src
 
+    def write_batch(self, type_name: str, data) -> "tuple[int, int]":
+        """Columnar bulk ingest (docs/SERVING.md "Columnar wire"):
+        `data` is a pyarrow RecordBatch, a list of them, or raw Arrow
+        IPC stream bytes (the wire's `op=ingest` payload). Column
+        buffers decode as NumPy views (numeric + point-geometry
+        columns are zero-copy where pyarrow allows) — no per-feature
+        Python dict materialization between the wire and the store.
+        Returns (rows, batches) written."""
+        from geomesa_tpu.core.arrow_io import from_arrow, ipc_feature_batches
+
+        src = self.get_feature_source(type_name)
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            fbs = ipc_feature_batches(bytes(data), src.sft)
+        elif isinstance(data, (list, tuple)):
+            fbs = (from_arrow(rb, src.sft) for rb in data)
+        else:
+            fbs = (from_arrow(data, src.sft),)
+        rows = batches = 0
+        for fb in fbs:
+            src.write(fb)
+            rows += len(fb)
+            batches += 1
+        return rows, batches
+
     def get_feature_source(self, name: str) -> FeatureSource:
         with self._lock:
             src = self._sources.get(name)

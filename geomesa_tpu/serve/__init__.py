@@ -22,7 +22,8 @@ from geomesa_tpu.serve.pipeline import DispatchPipeline
 from geomesa_tpu.serve.service import QueryService, ServeConfig, self_check
 from geomesa_tpu.serve.loadgen import (
     LoadReport, count_request_factory, knn_request_factory,
-    run_closed_loop, run_open_loop, run_sustained)
+    run_closed_loop, run_open_loop, run_sustained, run_wire)
+from geomesa_tpu.serve.columnar import PushMux, wire_capabilities
 
 __all__ = [
     "PRIORITIES", "AdmissionQueue", "QueryRejected", "RateLimiter",
@@ -30,5 +31,6 @@ __all__ = [
     "fused_count_key", "DispatchPipeline",
     "QueryService", "ServeConfig", "self_check", "LoadReport",
     "knn_request_factory", "count_request_factory",
-    "run_closed_loop", "run_open_loop", "run_sustained",
+    "run_closed_loop", "run_open_loop", "run_sustained", "run_wire",
+    "PushMux", "wire_capabilities",
 ]

@@ -99,17 +99,55 @@ class LoadReport:
     # approx.* reservoir families (a regressed sketch path fails CI)
     approx_samples_ms: List[float] = dataclasses.field(default_factory=list)
     exact_samples_ms: List[float] = dataclasses.field(default_factory=list)
+    # wire mode (docs/SERVING.md "Columnar wire"): JSON-lines vs
+    # columnar record-batch encode throughput over identical results,
+    # plus the push fan-out (frames x sinks through the one-encode
+    # PushMux) — the headline is wire_speedup (rows/s ratio) and the
+    # one-encode invariant (push_encodes == frames published)
+    wire_rows: int = 0
+    wire_json_rows_s: float = 0.0
+    wire_columnar_rows_s: float = 0.0
+    wire_speedup: float = 0.0
+    wire_json_bytes: int = 0
+    wire_columnar_bytes: int = 0
+    wire_json_p50_ms: float = 0.0
+    wire_json_p99_ms: float = 0.0
+    wire_columnar_p50_ms: float = 0.0
+    wire_columnar_p99_ms: float = 0.0
+    push_sinks: int = 0
+    push_frames: int = 0
+    push_encodes: int = 0
+    push_events_per_s: float = 0.0
+    wire_parity_ok: bool = True
+    wire_json_samples_ms: List[float] = dataclasses.field(
+        default_factory=list)
+    wire_columnar_samples_ms: List[float] = dataclasses.field(
+        default_factory=list)
+    push_publish_samples_ms: List[float] = dataclasses.field(
+        default_factory=list)
 
     def to_json(self) -> dict:
         doc = dataclasses.asdict(self)
         doc.pop("samples_ms", None)  # report lines stay readable
         doc.pop("approx_samples_ms", None)
         doc.pop("exact_samples_ms", None)
+        doc.pop("wire_json_samples_ms", None)
+        doc.pop("wire_columnar_samples_ms", None)
+        doc.pop("push_publish_samples_ms", None)
         if self.mode != "approx":
             for k in ("approx_ok", "exact_ok", "approx_p50_ms",
                       "approx_p99_ms", "exact_p50_ms", "exact_p99_ms",
                       "approx_speedup_p50", "tier_sketch", "tier_cached",
                       "tier_exact", "bound_violations"):
+                doc.pop(k, None)
+        if self.mode != "wire":
+            for k in ("wire_rows", "wire_json_rows_s",
+                      "wire_columnar_rows_s", "wire_speedup",
+                      "wire_json_bytes", "wire_columnar_bytes",
+                      "wire_json_p50_ms", "wire_json_p99_ms",
+                      "wire_columnar_p50_ms", "wire_columnar_p99_ms",
+                      "push_sinks", "push_frames", "push_encodes",
+                      "push_events_per_s", "wire_parity_ok"):
                 doc.pop(k, None)
         return doc
 
@@ -480,6 +518,128 @@ def run_subscribe(
                 mgr.unsubscribe(s.sub_id)
             except KeyError:
                 pass  # TTL-expired mid-run
+    return rep
+
+
+def run_wire(
+    store,
+    type_name: str,
+    rows: int = 100_000,
+    iters_json: int = 3,
+    iters_columnar: int = 10,
+    push_sinks: int = 1000,
+    push_frames: int = 50,
+    push_fids: int = 64,
+) -> LoadReport:
+    """`bench-serve --mode wire` (docs/SERVING.md "Columnar wire"):
+    encode ONE bulk `execute` result both ways — the JSON-lines path
+    (per-row dict + json.dumps, exactly what the legacy wire ships)
+    vs the columnar Arrow record-batch frame — over identical rows,
+    and report rows/s, bytes and encode p50/p99 for each, plus a
+    PushMux fan-out run (`push_frames` enter-frames to `push_sinks`
+    in-process subscribers) whose one-encode-per-frame invariant is
+    part of the verdict. Decoded columnar rows are parity-checked
+    against the JSON rows before anything is timed "ok"."""
+    import json as _json
+
+    from geomesa_tpu.plan.query import Query
+    from geomesa_tpu.serve import columnar as colwire
+    from geomesa_tpu.serve.protocol import _payload
+
+    if not colwire.have_pyarrow():
+        # same stance as the wire itself: capability absence is typed,
+        # never a mid-bench ModuleNotFoundError traceback
+        raise RuntimeError(
+            "bench-serve --mode wire needs pyarrow (this host's wire "
+            "capability is json-only)")
+    source = store.get_feature_source(type_name)
+    result = source.planner.execute(
+        Query(type_name, "INCLUDE", max_features=rows))
+    n = len(result.features) if result.features is not None else 0
+
+    def one_json() -> "tuple[bytes, float]":
+        t0 = time.monotonic()
+        doc = {"id": "b", "ok": True}
+        doc.update(_payload("execute", result, rows))
+        buf = (_json.dumps(doc) + "\n").encode()
+        return buf, time.monotonic() - t0
+
+    def one_columnar() -> "tuple[bytes, float]":
+        t0 = time.monotonic()
+        fields, payload = colwire.encode_execute_frame(
+            result.features, rows)
+        doc = {"id": "b", "ok": True, "kind": "features",
+               "count": fields["rows"] if "rows" in fields else n}
+        doc["frame"] = fields
+        buf = colwire.frame_bytes(doc, payload)
+        return buf, time.monotonic() - t0
+
+    # parity BEFORE timing: a fast encoder that decodes wrong is not a
+    # result (acceptance: decoded columnar == JSON rows, bit-identical)
+    jbuf, _ = one_json()
+    cbuf, _ = one_columnar()
+    jrows = _json.loads(jbuf.decode())["features"]
+    (cdoc, cpayload), = colwire.parse_stream(cbuf)
+    crows = colwire.decode_execute_payload(cpayload)
+    parity_ok = crows == jrows
+    j_ms = []
+    for _ in range(max(iters_json, 1)):
+        jbuf, dt = one_json()
+        j_ms.append(dt * 1000.0)
+    c_ms = []
+    for _ in range(max(iters_columnar, 1)):
+        cbuf, dt = one_columnar()
+        c_ms.append(dt * 1000.0)
+    j_med = float(np.median(j_ms))
+    c_med = float(np.median(c_ms))
+    json_rows_s = n / (j_med / 1000.0) if j_med > 0 else 0.0
+    col_rows_s = n / (c_med / 1000.0) if c_med > 0 else 0.0
+
+    # push fan-out: one frame encoded once, fanned to every sink
+    # (unthreaded in-process sinks — the encode counter is the claim
+    # under test; threaded writer isolation is tests/test_wire.py's)
+    mux = colwire.PushMux(queue_limit=push_frames + 8)
+    sunk = [0]
+
+    def sink_write(buf: bytes) -> None:
+        sunk[0] += len(buf)
+
+    sinks = [mux.register(sink_write, mode=colwire.WIRE_JSON,
+                          threaded=False) for _ in range(push_sinks)]
+    fids = [f"bench-f{i}" for i in range(push_fids)]
+    p_ms = []
+    t0 = time.monotonic()
+    for i in range(push_frames):
+        f0 = time.monotonic()
+        mux.publish({"event": "enter", "subscription": "bench-sub",
+                     "seq": i + 1, "fids": fids}, sinks)
+        p_ms.append((time.monotonic() - f0) * 1000.0)
+    push_wall = max(time.monotonic() - t0, 1e-9)
+    mux_stats = mux.stats()
+    mux.close()
+
+    rep = _report("wire", sum(j_ms) / 1000.0 + sum(c_ms) / 1000.0,
+                  [v / 1000.0 for v in c_ms],
+                  iters_json + iters_columnar, 0, 0, 0, {})
+    rep.wire_rows = n
+    rep.wire_json_rows_s = json_rows_s
+    rep.wire_columnar_rows_s = col_rows_s
+    rep.wire_speedup = (col_rows_s / json_rows_s
+                        if json_rows_s > 0 else 0.0)
+    rep.wire_json_bytes = len(jbuf)
+    rep.wire_columnar_bytes = len(cbuf)
+    rep.wire_json_p50_ms = float(np.percentile(j_ms, 50))
+    rep.wire_json_p99_ms = float(np.percentile(j_ms, 99))
+    rep.wire_columnar_p50_ms = float(np.percentile(c_ms, 50))
+    rep.wire_columnar_p99_ms = float(np.percentile(c_ms, 99))
+    rep.push_sinks = push_sinks
+    rep.push_frames = push_frames
+    rep.push_encodes = mux_stats["encodes"]
+    rep.push_events_per_s = push_frames * push_sinks / push_wall
+    rep.wire_parity_ok = parity_ok
+    rep.wire_json_samples_ms = sorted(j_ms)
+    rep.wire_columnar_samples_ms = sorted(c_ms)
+    rep.push_publish_samples_ms = sorted(p_ms)
     return rep
 
 

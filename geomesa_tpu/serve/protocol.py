@@ -54,6 +54,17 @@ not `ready` (warming until `gmtpu warmup --check` semantics pass, or
 draining) refuses query traffic with a typed, retryable rejection
 instead of serving cold or torn.
 
+Columnar wire (docs/SERVING.md "Columnar wire"): the `hello` response
+advertises `"wire": ["json", "columnar"]` when pyarrow is available; a
+request (or the whole connection, via `{"op": "hello", "wire":
+"columnar"}`) opts into binary record-batch framing for the bulk
+payloads — `execute` feature results as Arrow IPC, density/topk grids
+as single raw buffers, kNN query points and bulk `ingest` record
+batches inbound, and push-frame fid columns. Everything else — and
+every environment without pyarrow or a binary sink — stays plain
+JSON lines, downgraded TYPED via `"wireFallback"` so a columnar
+client knows it got the fallback rather than silently re-parsing.
+
 Errors are per-request, never fatal to the stream: a malformed line
 yields an ok=false response and the loop continues — one bad client
 request must not drop everyone else's connection.
@@ -71,6 +82,7 @@ import numpy as np
 
 from geomesa_tpu.plan.planner import QueryTimeout
 from geomesa_tpu.plan.query import Query
+from geomesa_tpu.serve import columnar as colwire
 from geomesa_tpu.serve.scheduler import (
     PRIORITIES, QueryRejected, ServeRequest)
 from geomesa_tpu.serve.service import QueryService, ServeConfig
@@ -154,7 +166,42 @@ def _payload(kind: str, result, limit: int) -> dict:
     return out
 
 
-def parse_request(doc: dict) -> ServeRequest:
+def _columnar_payload(kind: str, result, limit: int):
+    """(response fields, frame payload) for a columnar-mode request —
+    or (None, None) when this result kind has no columnar encoding
+    (count/knn/stats answers are already tiny; they stay JSON with no
+    fallback marker). The fields mirror the JSON `_payload` exactly,
+    minus the bulk data that moved into the frame."""
+    if kind in ("count", "knn"):
+        return None, None
+    out = {"kind": result.kind, "count": int(result.count)}
+    if result.kind == "features":
+        feats = result.features
+        # same count semantics as the JSON path: the TOTAL match count,
+        # even when the shipped rows are capped at `limit` (the frame's
+        # own `rows` field carries the shipped count)
+        out["count"] = len(feats) if feats is not None else 0
+        desc, payload = colwire.encode_execute_frame(feats, limit)
+    elif result.kind == "density" and result.grid is not None:
+        # keep the JSON summary fields (shape/total) so a decoded
+        # columnar response is a superset of the JSON one
+        out["shape"] = list(result.grid.shape)
+        out["total"] = float(result.grid.sum())
+        desc, payload = colwire.encode_density_frame(result.grid)
+    elif result.kind == "topk_cells":
+        desc, payload = colwire.encode_topk_frame(result.stats)
+    else:
+        return None, None
+    out["frame"] = desc
+    if getattr(result, "approx", False):
+        out["approx"] = True
+        out["bound"] = float(result.bound)
+        out["confidence"] = float(result.confidence)
+    return out, payload
+
+
+def parse_request(doc: dict,
+                  payload: Optional[bytes] = None) -> ServeRequest:
     op = doc.get("op", "query")
     kind = {"query": "execute", "execute": "execute",
             "count": "count", "knn": "knn"}.get(op)
@@ -162,17 +209,30 @@ def parse_request(doc: dict) -> ServeRequest:
         raise ValueError(f"unknown op {op!r}")
     type_name = doc["typeName"]
     kw = {}
-    if doc.get("tolerance") is not None or doc.get("topkCells"):
-        # approximate-answer tier hints (docs/SERVING.md "Approximate
-        # answers"): tolerance = the client's accuracy contract,
-        # topkCells = the sketch-native top-k-cells aggregation
+    if (doc.get("tolerance") is not None or doc.get("topkCells")
+            or doc.get("density")):
+        # aggregation + approximate-answer hints (docs/SERVING.md):
+        # tolerance = the client's accuracy contract, topkCells = the
+        # sketch-native top-k-cells aggregation, density = a one-shot
+        # DensityScan window (same spec shape as the subscribe verb's
+        # standing window) whose grid ships as ONE columnar buffer on
+        # a columnar connection
         from geomesa_tpu.plan.hints import QueryHints
 
+        hkw = {}
+        d = doc.get("density")
+        if d:
+            hkw.update(
+                density_bbox=tuple(float(v) for v in d["bbox"]),
+                density_width=int(d["width"]),
+                density_height=int(d["height"]),
+                density_weight=d.get("weight"))
         kw["hints"] = QueryHints(
             tolerance=(float(doc["tolerance"])
                        if doc.get("tolerance") is not None else None),
             topk_cells=(int(doc["topkCells"])
-                        if doc.get("topkCells") else None))
+                        if doc.get("topkCells") else None),
+            **hkw)
     query = Query(type_name, doc.get("cql", "INCLUDE"),
                   max_features=doc.get("maxFeatures"), **kw)
     priority = doc.get("priority", "normal")
@@ -189,8 +249,16 @@ def parse_request(doc: dict) -> ServeRequest:
 
         req.deadline = time.monotonic() + float(timeout_ms) / 1000.0
     if kind == "knn":
-        req.qx = np.asarray(doc["x"], np.float64)
-        req.qy = np.asarray(doc["y"], np.float64)
+        if payload is not None and doc.get("frame"):
+            # columnar request staging: the x/y sections decode as
+            # zero-copy f64 views that flow straight into the
+            # batcher's stack_queries / the pipeline's prepare stage —
+            # no per-point JSON number parsing on the hot path
+            req.qx, req.qy = colwire.decode_knn_sections(
+                doc["frame"], payload)
+        else:
+            req.qx = np.asarray(doc["x"], np.float64)
+            req.qy = np.asarray(doc["y"], np.float64)
         if req.qx.shape != req.qy.shape or req.qx.ndim != 1:
             raise ValueError("knn x/y must be equal-length 1-d arrays")
         req.k = int(doc.get("k", 10))
@@ -241,12 +309,20 @@ class _SubscribeSession:
     SubscriptionManager on the first subscribe verb (sharing the
     QueryService's tenant buckets and quarantine tuning), runs the
     auto-poll pump when configured, and flushes outboxes into the
-    response stream."""
+    response stream.
 
-    def __init__(self, store, svc: QueryService, respond):
+    `push` is the PUSH-FRAME sink (events without an `id`): it routes
+    through the service's PushMux so each frame is encoded once and
+    fanned to this connection plus any attached mirrors — even the
+    single-subscriber JSON path takes the one-encode buffer
+    (docs/SERVING.md "Columnar wire"). `respond` stays the direct
+    request/response writer."""
+
+    def __init__(self, store, svc: QueryService, respond, push=None):
         self.store = store
         self.svc = svc
         self.respond = respond
+        self.push = push if push is not None else respond
         self.manager = None
         self._stop = threading.Event()
         self._pump = None
@@ -301,13 +377,13 @@ class _SubscribeSession:
             self.manager.poll_now()
         except Exception as e:  # noqa: BLE001 — typed surface, stream lives
             try:
-                self.respond({"event": "poll_error",
-                              "error": type(e).__name__,
-                              "message": str(e)})
+                self.push({"event": "poll_error",
+                           "error": type(e).__name__,
+                           "message": str(e)})
             except Exception:
                 return 0  # sink broken: frames stay queued, retry next tick
         try:
-            return self.manager.flush(self.respond)
+            return self.manager.flush(self.push)
         except Exception:  # noqa: BLE001 — pump thread must survive
             # a raising sink loses the frame in flight (the connection
             # is broken anyway); undrained frames stay in their bounded
@@ -348,7 +424,7 @@ class _SubscribeSession:
                 ack=lambda s: self.respond(
                     {"id": rid, "ok": True,
                      "subscription": s.sub_id, "mode": s.mode}))
-            mgr.flush(self.respond)  # deliver the initial state frame
+            mgr.flush(self.push)  # deliver the initial state frame
         elif op == "unsubscribe":
             try:
                 sub = mgr.unsubscribe(doc["subscription"])
@@ -359,13 +435,13 @@ class _SubscribeSession:
                 self.respond({"id": rid, "ok": False, "error": "error",
                               "message": "no such subscription"})
                 return
-            mgr.flush(self.respond)  # parting frames
+            mgr.flush(self.push)  # parting frames
             self.respond({"id": rid, "ok": True,
                           "subscription": sub.sub_id,
                           "status": sub.status})
         elif op == "poll":
             applied = mgr.poll_now()
-            frames = mgr.flush(self.respond)
+            frames = mgr.flush(self.push)
             self.respond({"id": rid, "ok": True, "applied": applied,
                           "frames": frames})
         else:  # subscriptions: introspection
@@ -378,7 +454,7 @@ class _SubscribeSession:
         if self.manager is not None:
             # final flush so cancelled/expired frames are not lost
             try:
-                self.manager.flush(self.respond)
+                self.manager.flush(self.push)
             # gt: waive GT14
             # (deliberate degrade: the stream is closing — a broken
             # write sink must not mask the manager close that releases
@@ -395,6 +471,175 @@ ADMIN_ROLES = ("router", "admin")
 # ops a non-ready replica still answers (health probes, handshakes and
 # lifecycle verbs must work WHILE warming/draining — that is the point)
 CONTROL_OPS = ("hello", "drain", "stats")
+
+
+class _WireState:
+    """Per-connection columnar-wire state (docs/SERVING.md "Columnar
+    wire"): the negotiated session mode, the byte writer shared with
+    the line writer under one lock (frames and lines interleave on one
+    stream — the framing must never tear), and this connection's
+    PushMux sinks. The OWNER sink (its own subscriptions' frames) is
+    synchronous so the manager's flush-requeue contract holds; the
+    MIRROR sink (frames attached from other connections) is threaded —
+    a slow mirror backs up only its own bounded queue."""
+
+    def __init__(self, svc: QueryService, write, write_bytes, out_lock):
+        self.svc = svc
+        self.write = write
+        self.write_bytes = write_bytes
+        self.out_lock = out_lock
+        self.mode = colwire.WIRE_JSON
+        self.mux = None
+        # sink registration is reached from TWO threads (the reader
+        # thread's poll/subscribe flush and the --live-poll-ms pump):
+        # lazy init needs its own guard or a race registers an orphan
+        # sink that leaks in the service-wide mux
+        self._sink_lock = threading.Lock()
+        self.owner_sink: Optional[str] = None
+        # one mirror sink per wire MODE: a second attach asking for a
+        # different encoding gets its own sink, so the response's
+        # wireMode always states the encoding actually delivered
+        self.mirror_sinks: dict = {}
+
+    def can_columnar(self) -> bool:
+        return self.write_bytes is not None and colwire.have_pyarrow()
+
+    def fallback_reason(self) -> str:
+        return ("pyarrow_unavailable" if not colwire.have_pyarrow()
+                else "no_binary_sink")
+
+    def request_mode(self, doc: dict) -> str:
+        """The wire mode one request resolved to (per-request opt-in
+        overrides the session default)."""
+        return str(doc.get("wire", self.mode))
+
+    def write_buf(self, buf: bytes) -> None:
+        """One encoded frame/line onto the stream, under the same lock
+        as respond() — columnar JSON fallback sinks decode to the
+        identical text line the legacy path wrote."""
+        with self.out_lock:
+            if self.write_bytes is not None:
+                self.write_bytes(buf)
+            else:
+                self.write(buf.decode("utf-8"))
+
+    def _mux(self):
+        if self.mux is None:
+            self.mux = self.svc.wire_mux()
+        return self.mux
+
+    def push(self, frame: dict) -> None:
+        """Push-frame sink: route through the mux so the frame is
+        encoded ONCE and fanned to this connection + attached mirrors
+        (the one-encode path holds even for a lone JSON subscriber)."""
+        mux = self._mux()
+        with self._sink_lock:
+            if self.owner_sink is None:
+                mode = (self.mode if self.can_columnar()
+                        else colwire.WIRE_JSON)
+                self.owner_sink = mux.register(
+                    self.write_buf, mode=mode, threaded=False)
+            owner = self.owner_sink
+        mux.route(frame, owner=owner)
+
+    def ensure_mirror(self, mode: str) -> str:
+        mux = self._mux()
+        with self._sink_lock:
+            sink = self.mirror_sinks.get(mode)
+            if sink is None:
+                sink = mux.register(
+                    self.write_buf, mode=mode, threaded=True)
+                self.mirror_sinks[mode] = sink
+            return sink
+
+    def mirror_detach(self, subscription_id: str) -> None:
+        """Detach every mode's mirror sink from one subscription."""
+        if self.mux is None:
+            return
+        with self._sink_lock:
+            sinks = list(self.mirror_sinks.values())
+        for sink in sinks:
+            self.mux.detach(sink, subscription_id)
+
+    def close(self) -> None:
+        if self.mux is None:
+            return
+        with self._sink_lock:
+            sinks = [self.owner_sink] + list(self.mirror_sinks.values())
+        for sink in sinks:
+            if sink is not None:
+                self.mux.unregister(sink)
+
+
+def _handle_ingest(store, rid, doc: dict, payload: Optional[bytes],
+                   respond) -> None:
+    """Columnar bulk ingest: `{"op": "ingest", "typeName": ...,
+    "frame": {...}}` + an Arrow IPC stream payload. Record-batch
+    column buffers flow into the store as NumPy views (DataStore.
+    write_batch) — no per-feature Python dicts on the write path.
+    Raises for the caller's per-request error isolation."""
+    if payload is None:
+        raise ValueError(
+            "op=ingest needs a binary frame payload (an Arrow IPC "
+            "stream; see docs/SERVING.md \"Columnar wire\")")
+    if not colwire.have_pyarrow():
+        respond({"id": rid, "ok": False, "error": "rejected",
+                 "reason": "pyarrow_unavailable",
+                 "message": "columnar ingest needs pyarrow on the "
+                            "server; use the converter ingest path"})
+        return
+    type_name = doc["typeName"]
+    wb = getattr(store, "write_batch", None)
+    if wb is not None:
+        rows, batches = wb(type_name, payload)
+    else:
+        # live (Kafka) and other non-DataStore stores have no
+        # write_batch — decode here and write per record batch through
+        # their own source.write path (the column buffers are still
+        # NumPy views; only the dispatch differs)
+        from geomesa_tpu.core.arrow_io import ipc_feature_batches
+
+        src = store.get_feature_source(type_name)
+        rows = batches = 0
+        for fb in ipc_feature_batches(payload, src.sft):
+            src.write(fb)
+            rows += len(fb)
+            batches += 1
+    from geomesa_tpu.utils.metrics import metrics
+
+    metrics.counter("wire.ingest.rows", rows)
+    metrics.counter("wire.ingest.bytes", len(payload))
+    respond({"id": rid, "ok": True, "rows": rows, "batches": batches})
+
+
+def _handle_attach(svc: QueryService, wire: _WireState, rid, op: str,
+                   doc: dict, respond) -> None:
+    """`attach`/`detach`: mirror one subscription's push frames onto
+    THIS connection (the cross-connection fan-out — the subscription
+    itself lives on its owner connection's manager). One evaluation +
+    one encode serve every mirror (PushMux)."""
+    sub_id = doc.get("subscription")
+    mgr = svc.subscriptions
+    sub = mgr.registry.maybe(sub_id) if (mgr is not None
+                                         and sub_id) else None
+    if op == "detach":
+        if sub_id:
+            wire.mirror_detach(sub_id)
+        respond({"id": rid, "ok": True, "subscription": sub_id})
+        return
+    if sub is None:
+        respond({"id": rid, "ok": False, "error": "error",
+                 "message": "no such subscription"})
+        return
+    mode = wire.request_mode(doc)
+    out = {"id": rid, "ok": True, "subscription": sub_id}
+    if mode == colwire.WIRE_COLUMNAR and not wire.can_columnar():
+        mode = colwire.WIRE_JSON
+        out["wireFallback"] = wire.fallback_reason()
+    sink = wire.ensure_mirror(mode)
+    out["sinks"] = svc.wire_mux().attach(sink, sub_id)
+    out["wireMode"] = mode
+    respond(out)
 
 
 def serve_lines(
@@ -430,6 +675,8 @@ def serve_connection(
     write,
     admin: bool = False,
     control=None,
+    write_bytes=None,
+    read_bytes=None,
 ) -> int:
     """One JSON-lines conversation over a SHARED QueryService: the
     replica server runs one of these per accepted socket (the service
@@ -438,8 +685,12 @@ def serve_connection(
     lifecycle surface (fleet/replica.py): `describe()` feeds the hello
     handshake, `admitting()` gates query traffic on the health state
     machine, `drain()` implements the admin drain verb. `admin` seeds
-    the connection's role; a hello with role router/admin upgrades
-    it."""
+    the connection's role; a hello with role router/admin upgrades it.
+
+    `write_bytes`/`read_bytes` are the binary-frame transport (socket
+    connections pass the JsonLineConn's raw read/write): without them
+    the columnar wire downgrades typed to JSON and inbound binary
+    frames are refused — a text transport keeps working unchanged."""
     out_lock = threading.Lock()
     processed = 0
     is_admin = admin
@@ -448,7 +699,14 @@ def serve_connection(
         with out_lock:
             write(json.dumps(doc) + "\n")
 
-    subs = _SubscribeSession(store, svc, respond)
+    wire = _WireState(svc, write, write_bytes, out_lock)
+
+    def respond_frame(doc: dict, payload: bytes) -> None:
+        # ONE buffer, one locked write: the header line and its raw
+        # payload can never interleave with a concurrent response
+        wire.write_buf(colwire.frame_bytes(doc, payload))
+
+    subs = _SubscribeSession(store, svc, respond, push=wire.push)
 
     def on_done(rid, req):
         def cb(fut):
@@ -466,12 +724,33 @@ def serve_connection(
                 else:
                     limit = req.query.max_features or MAX_FEATURE_ROWS
                     doc = {"id": rid, "ok": True}
-                    doc.update(_payload(req.kind, fut.result(), limit))
+                    payload = None
+                    if req.wire == colwire.WIRE_COLUMNAR:
+                        e0_ns = (perf_counter_ns()
+                                 if req.trace is not None else 0)
+                        fields, payload = _columnar_payload(
+                            req.kind, fut.result(), limit)
+                        if payload is not None:
+                            doc.update(fields)
+                            if req.trace is not None:
+                                # the encode span feeds the profiler's
+                                # phase.wire.encode sentinel family
+                                req.trace.record(
+                                    "wire.encode", e0_ns,
+                                    perf_counter_ns(), kind=req.kind)
+                    if payload is None:
+                        doc.update(_payload(req.kind, fut.result(), limit))
+                        fb = getattr(req, "wire_fallback", None)
+                        if fb is not None:
+                            doc["wireFallback"] = fb
                     if req.degraded:
                         doc["degraded"] = True
                     if req.cache_hit:
                         doc["cached"] = True
-                    respond(doc)
+                    if payload is not None:
+                        respond_frame(doc, payload)
+                    else:
+                        respond(doc)
             finally:
                 if req.trace is not None:
                     # serialization + line write, per rider (callbacks
@@ -492,15 +771,37 @@ def serve_connection(
                 doc = json.loads(line)
                 rid = doc.get("id", processed)
                 op = doc.get("op")
+                payload = None
+                fr = doc.get("frame")
+                if fr and fr.get("nbytes"):
+                    # inbound binary frame: the payload bytes follow
+                    # this header line and MUST be consumed before the
+                    # next line read, or the stream framing tears
+                    if read_bytes is None:
+                        raise ValueError(
+                            "binary frames need a socket transport; "
+                            "this stream is text-only")
+                    payload = read_bytes(int(fr["nbytes"]))
                 if op == "hello":
                     # replica-role handshake: the response names the
                     # replica + its health state; router/admin roles
-                    # upgrade the connection to admin (drain rights)
+                    # upgrade the connection to admin (drain rights).
+                    # It also advertises + negotiates the wire: a
+                    # columnar ask is honored when pyarrow and a
+                    # binary sink exist, downgraded TYPED otherwise
                     role = str(doc.get("role", "client"))
                     if role in ADMIN_ROLES:
                         is_admin = True
                     out = {"id": rid, "ok": True, "role": role,
-                           "admin": is_admin}
+                           "admin": is_admin,
+                           "wire": colwire.wire_capabilities()}
+                    if doc.get("wire") == colwire.WIRE_COLUMNAR:
+                        if wire.can_columnar():
+                            wire.mode = colwire.WIRE_COLUMNAR
+                            out["wireMode"] = colwire.WIRE_COLUMNAR
+                        else:
+                            out["wireMode"] = colwire.WIRE_JSON
+                            out["wireFallback"] = wire.fallback_reason()
                     if control is not None:
                         out.update(control.describe())
                     respond(out)
@@ -540,6 +841,12 @@ def serve_connection(
                                  "message": f"replica not ready "
                                             f"({refusal})"})
                         continue
+                if op == "ingest":
+                    _handle_ingest(store, rid, doc, payload, respond)
+                    continue
+                if op in ("attach", "detach"):
+                    _handle_attach(svc, wire, rid, op, doc, respond)
+                    continue
                 if op in SUBSCRIBE_OPS:
                     subs.handle(rid, doc)
                     continue
@@ -553,7 +860,14 @@ def serve_connection(
                         stats["replica"] = control.describe()
                     respond({"id": rid, "ok": True, "stats": stats})
                     continue
-                req = parse_request(doc)
+                req = parse_request(doc, payload)
+                if wire.request_mode(doc) == colwire.WIRE_COLUMNAR:
+                    if wire.can_columnar():
+                        req.wire = colwire.WIRE_COLUMNAR
+                    else:
+                        # typed downgrade: the JSON response will say
+                        # WHY it is not a frame (tests assert this)
+                        req.wire_fallback = wire.fallback_reason()
                 fut = svc.submit(req)
                 fut.add_done_callback(on_done(rid, req))
             except Exception as e:  # noqa: BLE001 — per-request isolation
@@ -561,4 +875,5 @@ def serve_connection(
                                         else processed, e))
     finally:
         subs.close()
+        wire.close()
     return processed

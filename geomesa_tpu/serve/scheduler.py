@@ -120,6 +120,13 @@ class ServeRequest:
     # SERVED; a bound that does not fit runs exact, unmarked, with
     # the budget untouched.
     sketch_rung: int = 0
+    # columnar wire (docs/SERVING.md "Columnar wire"): "columnar" when
+    # the request opted into binary record-batch framing for its bulk
+    # payload (execute features / density / topk grids). The protocol
+    # layer sets it AND downgrades it typed when the capability is
+    # absent; the dispatch path never reads it — encoding is a
+    # response-time concern.
+    wire: str = "json"
 
     def __post_init__(self):
         if self.kind not in ("execute", "count", "knn"):

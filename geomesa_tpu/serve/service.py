@@ -227,6 +227,11 @@ class QueryService:
         # the wire layer when the first subscribe verb arrives, so
         # stats()/debug endpoints surface subscription state
         self.subscriptions = None
+        # columnar-wire push fan-out (docs/SERVING.md "Columnar wire"):
+        # ONE PushMux per service, shared by every connection so a
+        # subscription's frames can mirror onto attached connections —
+        # built lazily by wire_mux() on the first push/attach
+        self._push_mux = None
         # the bound /metrics port, when the owner started a
         # MetricsServer for this service (gmtpu serve --metrics-port,
         # fleet replicas). With port=0 the OS picks — N replicas on one
@@ -313,6 +318,10 @@ class QueryService:
             # windows already launched still sync (no torn responses);
             # runs after the dispatch thread stopped submitting
             self.pipeline.close()
+        with self._state_lock:
+            mux = self._push_mux
+        if mux is not None:
+            mux.close()  # joins the per-sink writer threads
         # restore the bare engine jits (owner only); the tracker object
         # (and its counters) stays readable after close
         self._release_tracker()
@@ -700,6 +709,20 @@ class QueryService:
                 cache_hit=True,
             ))
         return req.future
+
+    # -- columnar wire -----------------------------------------------------
+
+    def wire_mux(self):
+        """The service-wide push fan-out (serve/columnar.py PushMux):
+        one per service, lazily built — frames encode once and fan to
+        every connection sink attached to their subscription."""
+        with self._state_lock:
+            if self._push_mux is None:
+                from geomesa_tpu.serve.columnar import PushMux
+
+                self._push_mux = PushMux(
+                    queue_limit=self.config.subscribe_outbox)
+            return self._push_mux
 
     # -- degradation ladder ------------------------------------------------
 
@@ -1264,6 +1287,10 @@ class QueryService:
         subs = self.subscriptions  # racing close() may null the attr
         if subs is not None:
             out["subscriptions"] = subs.stats()
+        with self._state_lock:
+            mux = self._push_mux
+        if mux is not None:
+            out["wire"] = mux.stats()
         if self.pipeline is not None:
             out["pipeline"] = self.pipeline.stats()
         if self.tracker is not None:

@@ -23,7 +23,8 @@ tests/test_lint_gate.py and is runnable standalone:
 
     python scripts/lint_gate.py [--format json|sarif]
         [--no-warmup-smoke] [--no-chaos-smoke] [--no-telemetry-smoke]
-        [--no-sentinel-smoke]
+        [--no-sentinel-smoke] [--no-fleet-smoke] [--no-approx-smoke]
+        [--no-wire-smoke]
 
 Rule catalog + waiver syntax: docs/ANALYSIS.md.
 """
@@ -491,6 +492,124 @@ def approx_smoke() -> int:
     return 1 if failures else 0
 
 
+def wire_smoke() -> int:
+    """The columnar-wire loop (docs/SERVING.md "Columnar wire"): a
+    negotiated columnar session over an in-process stream must answer
+    bulk execute/density responses as binary frames whose DECODED
+    payloads are bit-identical to a JSON-lines replay of the same
+    queries, and a PushMux fan-out to 64 in-process subscribers must
+    serialize each frame exactly once (encode-call counter asserted).
+    Stderr-only like the other smokes."""
+    _pin_cpu()
+    import json
+    import tempfile
+
+    import numpy as np
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.plan.datastore import DataStore
+    from geomesa_tpu.serve import columnar as colwire
+    from geomesa_tpu.serve.protocol import serve_connection
+    from geomesa_tpu.serve.service import QueryService, ServeConfig
+
+    failures = []
+    if not colwire.have_pyarrow():
+        # typed skip, same stance as the wire itself: json-only
+        # environments downgrade, they do not fail
+        print("wire smoke: pyarrow unavailable — columnar capability "
+              "off, smoke skipped typed", file=sys.stderr)
+        return 0
+    rng = np.random.default_rng(13)
+    n = 1024
+    sft = SimpleFeatureType.from_spec(
+        "wiresmoke", "name:String,score:Double,dtg:Date,*geom:Point")
+    dens = {"bbox": [-180, -90, 180, 90], "width": 64, "height": 32}
+    with tempfile.TemporaryDirectory() as tmp:
+        store = DataStore(tmp, use_device_cache=True)
+        store.create_schema(sft).write(FeatureBatch.from_pydict(sft, {
+            "name": rng.choice(["a", "b", "c"], n).tolist(),
+            "score": rng.uniform(-10, 10, n),
+            "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack([rng.uniform(-170, 170, n),
+                              rng.uniform(-80, 80, n)], 1),
+        }))
+        svc = QueryService(store, ServeConfig(max_wait_ms=1.0))
+        mem = colwire.MemoryWire()
+        mem.add({"id": "h", "op": "hello", "wire": "columnar"})
+        mem.add({"id": "qc", "op": "query", "typeName": "wiresmoke",
+                 "cql": "INCLUDE", "maxFeatures": n})
+        mem.add({"id": "qj", "op": "query", "typeName": "wiresmoke",
+                 "cql": "INCLUDE", "maxFeatures": n, "wire": "json"})
+        mem.add({"id": "dc", "op": "query", "typeName": "wiresmoke",
+                 "cql": "INCLUDE", "density": dens})
+        mem.add({"id": "dj", "op": "query", "typeName": "wiresmoke",
+                 "cql": "INCLUDE", "density": dens, "wire": "json"})
+        out = bytearray()
+        try:
+            serve_connection(store, svc, mem.lines(),
+                             lambda s: out.extend(s.encode()),
+                             write_bytes=out.extend,
+                             read_bytes=mem.read_exact)
+            # push fan-out: 64 in-process subscribers, one encode per
+            # frame (the mux's own counter is the assertion)
+            mux = svc.wire_mux()
+            got = [0] * 64
+            sinks = []
+            for i in range(64):
+                def make(i=i):
+                    def w(buf: bytes) -> None:
+                        got[i] += len(buf)
+                    return w
+                sinks.append(mux.register(make(), mode="json",
+                                          threaded=False))
+            frames = 10
+            for k in range(frames):
+                mux.publish({"event": "enter", "subscription": "s",
+                             "seq": k + 1, "fids": ["a", "b"]}, sinks)
+            st = mux.stats()
+            if st["encodes"] != frames:
+                failures.append(
+                    f"fan-out encoded {st['encodes']}x for {frames} "
+                    f"frames at 64 sinks (want one encode per frame)")
+            if len(set(got)) != 1 or got[0] == 0:
+                failures.append(f"sinks saw unequal bytes: {set(got)}")
+        finally:
+            svc.close(drain=True)
+    resp = {d.get("id"): (d, p)
+            for d, p in colwire.parse_stream(bytes(out))}
+    hello = resp["h"][0]
+    if hello.get("wireMode") != "columnar" \
+            or "columnar" not in hello.get("wire", ()):
+        failures.append(f"hello did not negotiate columnar: {hello}")
+    qc, qp = resp["qc"]
+    qj = resp["qj"][0]
+    if qp is None or qj.get("features") is None:
+        failures.append("execute responses missing frame/features")
+    elif colwire.decode_execute_payload(qp) != qj["features"]:
+        failures.append("columnar execute decode != JSON replay")
+    dc, dp = resp["dc"]
+    dj = resp["dj"][0]
+    if dp is None:
+        failures.append("density response missing frame")
+    else:
+        grid = colwire.decode_density_payload(dc["frame"], dp)
+        if (dc["shape"] != dj["shape"] or dc["total"] != dj["total"]
+                or float(grid.sum()) != dj["total"]):
+            failures.append(
+                f"columnar density decode != JSON replay: "
+                f"{dc['shape']}/{dc['total']} vs "
+                f"{dj['shape']}/{dj['total']}")
+    print(
+        f"wire smoke: {len(resp)} response(s), execute parity over "
+        f"{qc.get('count')} rows, density {dc.get('shape')}, fan-out "
+        f"64 sinks x {frames} frames -> {st['encodes']} encode(s)",
+        file=sys.stderr)
+    for f in failures:
+        print(f"wire smoke: FAIL {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def warmup_smoke(manifest_path: str = SMOKE_MANIFEST) -> int:
     """`gmtpu warmup --check` against the fixture manifest, pinned to
     CPU (the fixture records interpret-mode kernels; this gate must run
@@ -553,6 +672,11 @@ def main(argv=None) -> int:
                         "served tolerant counts with bounds verified "
                         "against exact replay + result-cache hit on "
                         "the second pass; text mode only)")
+    p.add_argument("--no-wire-smoke", action="store_true",
+                   help="skip the columnar-wire smoke (negotiated "
+                        "columnar session with decoded parity vs a "
+                        "JSON replay + one-encode push fan-out to 64 "
+                        "in-process subscribers; text mode only)")
     args = p.parse_args(argv)
     findings = lint_paths([os.path.join(REPO_ROOT, "geomesa_tpu")])
     if args.format == "json":
@@ -574,6 +698,8 @@ def main(argv=None) -> int:
         rc = fleet_smoke()
     if args.format == "text" and not args.no_approx_smoke and rc == 0:
         rc = approx_smoke()
+    if args.format == "text" and not args.no_wire_smoke and rc == 0:
+        rc = wire_smoke()
     return rc
 
 

@@ -1271,3 +1271,105 @@ class TestGT21RawCqlCacheKeys:
                         extra_ref_paths=[])
         assert any(f.rule == "GT21" and f.waived for f in fs)
         assert not active([f for f in fs if f.rule == "GT21"])
+
+
+class TestGT22PerRowWireEncode:
+    """Per-row serialization in a wire-encode loop (docs/ANALYSIS.md
+    GT22): the columnar wire removed the per-feature dict +
+    per-subscriber json.dumps pattern from the hot path — this rule
+    keeps it from creeping back into serve//subscribe/."""
+
+    def _findings(self, src, relpath="geomesa_tpu/serve/protocol.py"):
+        from geomesa_tpu.analysis.modinfo import ModInfo
+        from geomesa_tpu.analysis.rules import gt22
+
+        mod = ModInfo("/x.py", textwrap.dedent(src), relpath=relpath)
+        return list(gt22(mod, None))
+
+    DIRTY = """
+        import json
+
+        def flush(subs, frame, write):
+            for sub in subs:
+                write(json.dumps(frame) + "\\n")
+
+        def rows_json(batch, names):
+            out = []
+            for i in range(len(batch)):
+                out.append({n: batch[n][i] for n in names})
+            return out
+
+        def rows_comp(batch, names, n):
+            return [{k: batch[k][i] for k in names} for i in range(n)]
+    """
+
+    def test_per_row_encode_flagged(self):
+        found = self._findings(self.DIRTY)
+        lines = sorted(f.line for f in found)
+        assert len(found) == 3, found
+        assert all(f.rule == "GT22" for f in found)
+        # dumps-in-loop line 6, dictcomp-in-loop line 11, dictcomp-in-
+        # listcomp line 15
+        assert lines == [6, 11, 15], lines
+
+    def test_clean_counterparts(self):
+        clean = """
+            import json
+
+            def flush_once(subs, frame, offer):
+                # ONE encode, the same buffer fans to every sink
+                buf = (json.dumps(frame) + "\\n").encode()
+                for sub in subs:
+                    offer(sub, buf)
+
+            def respond(doc, write):
+                # one dumps per CALL is fine even when callers loop
+                write(json.dumps(doc) + "\\n")
+
+            def explicit_rows(batch, names, n):
+                # the JSON fallback's explicit per-row dict build
+                # (protocol._rows_json shape) stays legal: the rule
+                # targets comprehension-built row dicts + in-loop dumps
+                rows = []
+                for i in range(n):
+                    row = {}
+                    for name in names:
+                        row[name] = batch[name][i]
+                    rows.append(row)
+                return rows
+
+            TOP = {k: v for k, v in [("a", 1)]}
+        """
+        assert self._findings(clean) == []
+
+    def test_scope_is_path_limited(self):
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/plan/planner.py") == []
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/subscribe/manager.py") != []
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/serve/loadgen.py") != []
+
+    def test_registration(self):
+        from geomesa_tpu.analysis.model import RULES
+        from geomesa_tpu.analysis.rules import ALL_RULES
+
+        assert "GT22" in RULES and "GT22" in ALL_RULES
+
+    def test_waiver(self, tmp_path):
+        import pathlib
+
+        sub = pathlib.Path(tmp_path) / "geomesa_tpu" / "serve"
+        sub.mkdir(parents=True)
+        (sub / "x.py").write_text(textwrap.dedent("""
+            import json
+
+            def flush(subs, frame, write):
+                for sub in subs:
+                    # gt: waive GT22
+                    write(json.dumps(frame) + "\\n")
+        """))
+        fs = lint_paths([str(tmp_path)], rules=["GT22"],
+                        extra_ref_paths=[])
+        assert any(f.rule == "GT22" and f.waived for f in fs)
+        assert not active([f for f in fs if f.rule == "GT22"])
