@@ -103,6 +103,15 @@ RULES: Dict[str, Rule] = {
                      "N encodes for N rows/subscribers — encode once "
                      "and ship columnar frames / fan through PushMux "
                      "(docs/SERVING.md \"Columnar wire\")"),
+        Rule("GT23", "blocking host sync (block_until_ready / future "
+                     ".result() / device_get) or naked per-window "
+                     "device_put/to_device inside the ring feed loop "
+                     "scope (serve/ringloop.py): the persistent serve "
+                     "loop's per-window work is ONLY a stager slot "
+                     "write + one pre-compiled dispatch — waits belong "
+                     "to the completer's harvest, transfers to the "
+                     "ring stager (docs/SERVING.md \"Persistent serve "
+                     "loop\")"),
     )
 }
 

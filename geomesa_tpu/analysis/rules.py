@@ -1462,6 +1462,80 @@ def gt22(mod: ModInfo, project) -> Iterator[Finding]:
     yield from findings
 
 
+# GT23 scope: the persistent serve loop's feed seam (serve/ringloop.py).
+# The ring's whole point is that per-window Python work is ONLY a slot
+# write + one pre-compiled dispatch — the harvest read belongs to the
+# completer, and the slot write goes through QueryStager's designated
+# staging path (which carries the retry fabric, the transfer fault site
+# and the depth-R rotation contract). A blocking host sync inside the
+# feed scope re-serializes the loop exactly like GT16's hazard, and a
+# NAKED per-window device_put/to_device there bypasses the ring —
+# un-rotated, un-metered, un-donated buffers that silently turn the
+# ring back into per-window transfers. Same shape as GT16, extended
+# with the transfer calls.
+_GT23_PATH = "geomesa_tpu/serve/ringloop.py"
+_GT23_MARKERS = ("feed", "slot")
+_GT23_BLOCKING = {
+    "block_until_ready": "device sync",
+    "result": "future wait",
+    "device_get": "host read",
+    "device_put": "per-window device transfer (use the ring stager)",
+    "to_device": "per-window device transfer (use the ring stager)",
+}
+
+
+def _gt23_feed_functions(mod: ModInfo):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        name = node.name.lstrip("_")
+        if any(m in name for m in _GT23_MARKERS):
+            yield node
+
+
+def gt23(mod: ModInfo, project) -> Iterator[Finding]:
+    """GT23: blocking host sync or naked per-window transfer inside the
+    ring feed loop scope.
+
+    Flags `.block_until_ready()`, `.result()` (futures; `set_result`
+    is a resolve and is not matched), `jax.device_get` / bare
+    `device_get`, and `device_put` / `to_device` calls lexically inside
+    the feed-scope functions of serve/ringloop.py (names containing
+    feed/slot). The slot write must go through the stager's staging
+    path (`.stage(...)` — retry fabric, fault site, depth-R rotation);
+    blocking belongs to the completer's harvest. Waivable inline
+    (`# gt: waive GT23`) for a documented deliberate call."""
+    path = mod.relpath.replace("\\", "/")
+    if _GT23_PATH not in path:
+        return
+    seen: Set[int] = set()
+    for fn in _gt23_feed_functions(mod):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            ident = None
+            if isinstance(f, ast.Attribute):
+                ident = f.attr
+            elif isinstance(f, ast.Name):
+                ident = f.id if f.id in ("device_get", "device_put",
+                                         "to_device") else None
+            what = _GT23_BLOCKING.get(ident or "")
+            if what is None or node.lineno in seen:
+                continue
+            seen.add(node.lineno)
+            yield _finding(
+                "GT23", mod, node,
+                f"blocking/transfer call ({ident}: {what}) inside ring "
+                f"feed scope {fn.name!r}: per-window work in the "
+                f"persistent serve loop is ONLY a slot write through "
+                f"the stager + one pre-compiled dispatch — a host sync "
+                f"re-serializes the loop and a naked transfer bypasses "
+                f"the ring's rotation/donation contract. Move waits to "
+                f"the completer's harvest, transfers into the stager, "
+                f"or waive a documented deliberate call")
+
+
 from geomesa_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES)
 
@@ -1470,6 +1544,6 @@ ALL_RULES = {
     "GT04": gt04, "GT05": gt05, "GT06": gt06,
     "GT13": gt13, "GT14": gt14, "GT15": gt15, "GT16": gt16,
     "GT17": gt17, "GT18": gt18, "GT19": gt19, "GT20": gt20,
-    "GT21": gt21, "GT22": gt22,
+    "GT21": gt21, "GT22": gt22, "GT23": gt23,
     **CONCURRENCY_RULES,
 }

@@ -116,6 +116,25 @@ class SketchAnswerEngine:
             self.store = PartitionSketchStore(planner.storage, **kw)
         except (ValueError, AttributeError):
             self.store = None  # non-point / sketchless storage: disabled
+        if self.store is not None:
+            # sketch-warm spin-up (ROADMAP item 2 remaining rung): a
+            # fleet replica whose predecessor persisted the sidecar
+            # starts with version-exact sketches installed instead of
+            # paying the pinned partition rescans on first use; a
+            # stale/missing sidecar is the cold path, typed
+            try:
+                loaded, stale = self.store.load_sidecar()
+                if loaded or stale:
+                    from geomesa_tpu.utils.metrics import metrics
+
+                    metrics.counter("approx.sidecar.loaded", loaded)
+                    metrics.counter("approx.sidecar.stale", stale)
+            # gt: waive GT14
+            # (deliberate degrade: the sidecar is a warm-start
+            # optimization — a corrupt/unreadable file must cost a
+            # rebuild, never engine construction)
+            except Exception:
+                pass
 
     # -- metering ----------------------------------------------------------
 
@@ -145,9 +164,13 @@ class SketchAnswerEngine:
     def _sketches(self, plan) -> List:
         """A version-exact sketch per pruned partition, built on demand
         from the plan's pinned snapshot. Raises StaleSketch when any
-        partition cannot be served at the snapshot's version."""
+        partition cannot be served at the snapshot's version. After a
+        merge that built anything, the sidecar persists ONCE (not per
+        partition — a cold P-partition store must pay one file write,
+        not P rewrites of the whole store)."""
         manifest = plan.manifest
         out = []
+        built = 0
         for name in plan.partitions:
             entries = manifest.get(name, [])
             if not entries:
@@ -157,7 +180,10 @@ class SketchAnswerEngine:
                 if not self.allow_build:
                     raise StaleSketch(name, "builds disabled")
                 sk = self._build_metered(name, entries)
+                built += 1
             out.append(sk)
+        if built:
+            self._save_sidecar()
         return out
 
     def _build_metered(self, name, entries):
@@ -176,6 +202,20 @@ class SketchAnswerEngine:
         except Exception:
             pass
         return sk
+
+    def _save_sidecar(self) -> None:
+        """Persist the sketch store so the NEXT process (fleet replica
+        spin-up, a restart) loads version-exact sketches instead of
+        re-scanning partitions. Called once per merge that built
+        anything."""
+        try:
+            self.store.save_sidecar()
+        # gt: waive GT14
+        # (deliberate degrade: sidecar persistence must never fail the
+        # answer that triggered the build — an unwritable catalog dir
+        # just means the next process starts cold)
+        except Exception:
+            pass
 
     # -- answers -----------------------------------------------------------
 
@@ -349,6 +389,7 @@ class SketchAnswerEngine:
                     parts = self.planner.storage.prune_partitions(
                         bbox, interval, manifest=snap)
                     sketches = []
+                    built = 0
                     for name in parts:
                         entries = snap.get(name, [])
                         if not entries:
@@ -358,7 +399,10 @@ class SketchAnswerEngine:
                             if not (build and self.allow_build):
                                 raise StaleSketch(name, "builds disabled")
                             sk = self._build_metered(name, entries)
+                            built += 1
                         sketches.append(sk)
+                    if built:
+                        self._save_sidecar()  # once per merge, not per build
                     bounds = merge_count_bounds(sketches, bbox, interval)
                 except StaleSketch:
                     # admission peek: a missing sketch here is routine

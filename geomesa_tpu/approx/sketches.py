@@ -23,6 +23,8 @@ bounds are a-priori guarantees, not confidence heuristics.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -109,6 +111,8 @@ class PartitionSketchStore:
         self.max_parts = max_parts
         self._lock = threading.Lock()
         self._sketches: Dict[str, PartitionSketch] = {}
+        self._sidecar_loaded = 0
+        self._sidecar_stale = 0
         sft = storage.sft
         g = sft.default_geometry
         if g is None or g.type != "Point":
@@ -197,7 +201,124 @@ class PartitionSketchStore:
     def stats(self) -> dict:
         with self._lock:
             return {"partitions": len(self._sketches),
-                    "bins_per_dim": self.bins_per_dim}
+                    "bins_per_dim": self.bins_per_dim,
+                    "sidecar_loaded": self._sidecar_loaded,
+                    "sidecar_stale": self._sidecar_stale}
+
+    # -- manifest-versioned sidecar (fleet warm spin-up) -------------------
+    # ROADMAP item 2's remaining rung: sketches were per-process, rebuilt
+    # from pinned reads on first use — every fleet replica paid the full
+    # partition rescan cold. The sidecar persists each partition's
+    # sketch WITH its manifest entry token; a loading process installs
+    # only entries whose token still matches the CURRENT committed
+    # manifest, so a stale entry (racing write, compaction) is a typed
+    # skip-and-rebuild, never a torn load. One atomic file (tmp +
+    # os.replace), exactly like the device-cache manifest.
+
+    SIDECAR = ".approx_sketches.json"
+    SIDECAR_VERSION = 1
+
+    @property
+    def sidecar_path(self) -> Optional[str]:
+        root = getattr(self.storage, "root", None)
+        if not root:
+            return None
+        return os.path.join(root, self.SIDECAR)
+
+    def save_sidecar(self, path: Optional[str] = None) -> Optional[str]:
+        """Persist every cached sketch with its version token. Snapshot
+        under the lock, serialize+write outside it (the file I/O must
+        not stall concurrent merges — the GT09 discipline)."""
+        path = path or self.sidecar_path
+        if path is None:
+            return None
+        with self._lock:
+            snapshot = dict(self._sketches)
+        doc = {
+            "sidecar_version": self.SIDECAR_VERSION,
+            "bins_per_dim": self.bins_per_dim,
+            "partitions": {
+                name: {
+                    "token": [[f, int(c)] for f, c in sk.token],
+                    "rows": int(sk.rows),
+                    "has_time": bool(sk.has_time),
+                    "grids": {str(b): g.ravel().tolist()
+                              for b, g in sk.grids.items()},
+                }
+                for name, sk in snapshot.items()
+            },
+        }
+        import tempfile
+
+        # unique tmp in the SAME directory (os.replace needs one
+        # filesystem): two savers — fleet replicas sharing a catalog,
+        # two builder threads — must never interleave writes into one
+        # tmp file; the last atomic replace wins with a complete document
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path) or ".",
+            prefix=os.path.basename(path) + ".", suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
+    def load_sidecar(self, path: Optional[str] = None
+                     ) -> Tuple[int, int]:
+        """Install sidecar sketches whose token matches the CURRENT
+        committed manifest; returns (loaded, stale). Stale, malformed
+        or schema-drifted entries are skipped typed — a rebuild on
+        first use is the worst case, exactly the cold behavior."""
+        path = path or self.sidecar_path
+        if path is None or not os.path.exists(path):
+            return 0, 0
+        snap_fn = getattr(self.storage, "manifest_snapshot", None)
+        if snap_fn is None:
+            return 0, 0
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return 0, 0
+        if doc.get("sidecar_version") != self.SIDECAR_VERSION \
+                or doc.get("bins_per_dim") != self.bins_per_dim:
+            return 0, 0
+        snap = snap_fn()
+        b = self.bins_per_dim
+        loaded = stale = 0
+        has_time_now = self._dtg is not None
+        for name, meta in doc.get("partitions", {}).items():
+            token = tuple((f, int(c)) for f, c in meta.get("token", ()))
+            if token != entry_token(snap.get(name, [])) \
+                    or bool(meta.get("has_time")) != has_time_now:
+                stale += 1
+                continue
+            try:
+                grids = {
+                    int(bk): np.asarray(flat, np.int64).reshape(b, b)
+                    for bk, flat in meta["grids"].items()
+                }
+                sk = PartitionSketch(token, int(meta["rows"]), grids, b,
+                                     has_time=has_time_now)
+            except (KeyError, TypeError, ValueError):
+                stale += 1
+                continue
+            with self._lock:
+                if len(self._sketches) >= self.max_parts and \
+                        name not in self._sketches:
+                    self._sketches.pop(next(iter(self._sketches)))
+                self._sketches[name] = sk
+            loaded += 1
+        with self._lock:
+            self._sidecar_loaded += loaded
+            self._sidecar_stale += stale
+        return loaded, stale
 
 
 # -- merge + bound math ------------------------------------------------------

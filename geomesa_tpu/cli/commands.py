@@ -293,6 +293,19 @@ def register(sub: "argparse._SubParsersAction") -> None:
     bserve_p.add_argument("--no-pipeline", action="store_true",
                           help="serial dispatch (pipelined is the "
                                "default for kNN windows)")
+    bserve_p.add_argument("--ring", action="store_true",
+                          help="sustained mode: also run a ring-off "
+                               "(pipelined) baseline and report the "
+                               "dispatches_per_window ratio — the "
+                               "persistent serve loop's headline "
+                               "(docs/SERVING.md \"Persistent serve "
+                               "loop\"); with --record-baseline the "
+                               "ring.dispatch.* sentinel family is "
+                               "recorded too")
+    bserve_p.add_argument("--no-ring", action="store_true",
+                          help="disable the persistent serve loop for "
+                               "the measured run (ring programs are "
+                               "the default for eligible kNN windows)")
     bserve_p.add_argument("--duration", type=float, default=5.0,
                           help="seconds per measured run")
     bserve_p.add_argument("--max-wait-ms", type=float, default=2.0)
@@ -778,9 +791,16 @@ def _bench_serve(args) -> int:
             return rep
 
         mesh_spec = getattr(args, "mesh", "off")
+        ring_on = not getattr(args, "no_ring", False)
+        if getattr(args, "ring", False) and not ring_on:
+            # --ring measures the ring against a ring-off baseline; a
+            # ring-disabled measured run would report a ~1.0 ratio that
+            # reads as "no benefit" instead of the conflict it is
+            print("error: --ring and --no-ring conflict", file=sys.stderr)
+            return 2
         coalesced = run("coalesced", ServeConfig(
             max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
-            pipeline=pipe, mesh=mesh_spec))
+            pipeline=pipe, ring=ring_on, mesh=mesh_spec))
         profile_doc = None
         if profiling:
             # snapshot (and stop) the profiler NOW: the serial/single-
@@ -790,6 +810,29 @@ def _bench_serve(args) -> int:
 
             profile_doc = PROFILER.snapshot(include_samples=True)
             PROFILER.disable()
+        if getattr(args, "ring", False) and args.mode == "sustained":
+            # the persistent-serve-loop headline (docs/SERVING.md
+            # "Persistent serve loop"): identical sustained workload,
+            # ring OFF — per-window dispatch count must be strictly
+            # higher there. Runs after the profiler snapshot like the
+            # serial comparison (the baseline is deliberately slower)
+            ringless = run("pipelined_baseline", ServeConfig(
+                max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+                pipeline=pipe, ring=False, mesh=mesh_spec))
+            doc = {
+                "run": "ring_comparison",
+                "ring_dispatches_per_window":
+                    coalesced.dispatches_per_window,
+                "pipelined_dispatches_per_window":
+                    ringless.dispatches_per_window,
+                "ring_windows": coalesced.ring_windows,
+                "ring_fallbacks": coalesced.ring_fallbacks,
+            }
+            if ringless.dispatches_per_window > 0:
+                doc["dispatch_ratio"] = round(
+                    coalesced.dispatches_per_window
+                    / ringless.dispatches_per_window, 3)
+            print(json.dumps(doc))
         if not args.no_compare:
             single = None
             if coalesced.mesh_devices > 1:
@@ -861,10 +904,24 @@ def _bench_serve(args) -> int:
 
             if not tracing:
                 TRACER.disable()
+            extra_samples = {}
+            if coalesced.dispatches_per_window > 0:
+                # ring.dispatch.*: the per-window dispatch count is a
+                # deterministic structural constant, replicated to the
+                # run's window count so the sentinel's min_n gate
+                # applies — a ring regression (e.g. silently falling
+                # back to the pipelined 4-op shape) moves the whole
+                # vector and fails the median-ratio comparison
+                wins = max(int(coalesced.pipelined_windows
+                               or coalesced.dispatches or 1), 1)
+                extra_samples["ring.dispatch.per_window"] = (
+                    [coalesced.dispatches_per_window] * min(wins, 64))
             doc = snt.baseline_from_profile(
                 profile_doc, latency_samples_ms=coalesced.samples_ms,
+                extra_samples=extra_samples,
                 extra={"mode": args.mode, "n": args.n,
                        "kind": args.kind,
+                       "ring_windows": coalesced.ring_windows,
                        "throughput_qps": round(
                            coalesced.throughput_qps, 2)})
             if record_baseline:

@@ -200,6 +200,42 @@ class ExecutableRegistry:
         metrics.counter("compilecache.serve.variants")
         return vname
 
+    # -- ring tier (docs/SERVING.md "Persistent serve loop") ---------------
+
+    RING_PREFIX = "@ring"
+
+    def ring_variant(self, name: str, depth: int, fn,
+                     donate_argnums: Sequence[int] = (),
+                     static_argnames: Sequence[str] = ()) -> str:
+        """Register (idempotently) the persistent-ring variant of `name`
+        and return its registry key (`<name>@ring{depth}[+donate]`).
+
+        The ring serve loop (serve/ringloop.py) dispatches ONE long-lived
+        executable per (kernel, bucket, dtype, mesh_shape) whose query
+        inputs cycle through a fixed ring of `depth` staging slots. The
+        DEPTH joins the key because it is the donation contract: with
+        donation on, slot N's buffer is consumed by window N's program
+        and the stager re-offers it only after the depth-bounded
+        pipeline has synced that window — an executable armed for depth
+        R must never answer a lookup for a different rotation period.
+        The donation flag keys apart too: a donating executable must
+        never answer a non-donating lookup (same rule as the @serve
+        tier). Donation is a no-op (with a JAX warning) on backends
+        without support (CPU) — callers gate on `jax.default_backend()`
+        and the CPU CI form is the slot-reuse structure alone."""
+        donate = tuple(donate_argnums)
+        vname = f"{name}{self.RING_PREFIX}{int(depth)}" + (
+            "+donate" if donate else "")
+        with self._lock:
+            if vname in self._kernels:
+                return vname
+        from geomesa_tpu.utils.metrics import metrics
+
+        self.register(vname, fn, static_argnames=static_argnames,
+                      donate_argnums=donate)
+        metrics.counter("compilecache.ring.variants")
+        return vname
+
     # -- mesh tier (docs/SERVING.md "Sharded serving") ---------------------
 
     MESH_PREFIX = "@mesh"

@@ -218,6 +218,47 @@ def make_density_sharded(mesh: Mesh):
     return run
 
 
+def density_grid_slotted(
+    x: jax.Array,
+    y: jax.Array,
+    weights: jax.Array,
+    mask: jax.Array,
+    bbox_slot: jax.Array,
+    width: int,
+    height: int,
+) -> jax.Array:
+    """Slot-parameterized `density_grid`: the query envelope is a
+    DEVICE [4] f32 array (xmin, ymin, xmax, ymax) — a ring-slot input —
+    instead of a static trace constant, so one long-lived executable
+    per (grid shape, bucket) can serve every envelope without a new
+    compile per window. GROUNDWORK for a density ring tier
+    (docs/SERVING.md "Persistent serve loop" — today's ring dispatches
+    kNN windows only; nothing registers this kernel yet).
+    Bit-compatibility caveat a future caller MUST gate on: cell edges
+    derive from f32 envelope arithmetic here versus the static path's
+    python f64-then-f32 folding, so results match the static kernel
+    only when the envelope round-trips f32 exactly (the common
+    tile-aligned case) — that parity is what
+    tests/test_ringloop.py::TestDensitySlotParity pins. Raw (un-jitted)
+    on purpose: the ExecutableRegistry's ring tier owns its
+    jit/donation wrapping."""
+    xmin = bbox_slot[0]
+    ymin = bbox_slot[1]
+    xmax = bbox_slot[2]
+    ymax = bbox_slot[3]
+    dx = (xmax - xmin) / width
+    dy = (ymax - ymin) / height
+    col = jnp.floor((x - xmin) / dx).astype(jnp.int32)
+    row = jnp.floor((y - ymin) / dy).astype(jnp.int32)
+    inb = (col >= 0) & (col < width) & (row >= 0) & (row < height) & mask
+    col = jnp.clip(col, 0, width - 1)
+    row = jnp.clip(row, 0, height - 1)
+    w = jnp.where(inb, weights.astype(jnp.float32), 0.0)
+    flat = jnp.zeros(height * width, jnp.float32)
+    flat = flat.at[row * width + col].add(w)
+    return flat.reshape(height, width)
+
+
 @functools.partial(jax.jit, static_argnames=("radius_pixels",))
 def gaussian_blur(grid: jax.Array, radius_pixels: int) -> jax.Array:
     """Separable gaussian spread (DensityProcess radiusPixels analog)."""

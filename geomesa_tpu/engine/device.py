@@ -138,6 +138,12 @@ class QueryStager:
     that slot only after the depth-bounded pipeline has synced the
     window that consumed it).
 
+    The persistent serve loop (serve/ringloop.py) reuses this exact
+    discipline generalized to depth R: its ring of donated slot buffers
+    IS a QueryStager at `depth=R`, so the slot handed to window N is
+    never the slot window N+1 is transferring into as long as R bounds
+    the windows in flight (docs/SERVING.md "Persistent serve loop").
+
     The dtype discipline matches the serial path exactly
     (`jnp.asarray(np.asarray(qx), jnp.float32)`): host f64 → f32 cast on
     host, then device_put — so pipelined results are bit-identical.
@@ -176,6 +182,9 @@ class QueryStager:
             return (jax.device_put(jnp.asarray(qx32), device),
                     jax.device_put(jnp.asarray(qy32), device))
 
+        from geomesa_tpu.utils.metrics import note_device_op
+
+        note_device_op()
         with TRACER.span("device.transfer", rows=int(qx32.shape[0]),
                          staged=True):
             pair = retry_call(

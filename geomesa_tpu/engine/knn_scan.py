@@ -481,6 +481,36 @@ def knn_exact_refine(qx_np, qy_np, x_np, y_np, fd, fi, k):
     return dists, idx, certified
 
 
+# -- ring-loop kernel variants (docs/SERVING.md "Persistent serve loop") ----
+# The persistent serve loop dispatches ONE long-lived executable per
+# (kernel, bucket, dtype, mesh_shape) and feeds it query slots from a
+# fixed ring of staging buffers. These raw (un-jitted) callables are the
+# forms the ExecutableRegistry's ring tier compiles for it: argnums 0/1
+# (the slot's qx/qy) are the ONLY per-window inputs — the feature-set
+# arguments (x, y, mask) are pre-bound device references the ring
+# program re-passes unchanged every window, so XLA sees a stable
+# parameter layout and (with donation, non-CPU) reuses the slot HBM
+# across windows. The math is knn_sparse_scan / knn_fullscan_tiled
+# exactly — a distinct callable only so the ring registration can carry
+# its own donation contract without re-keying the base kernels.
+
+
+def knn_ring_scan(qx, qy, x, y, mask, k, tile_capacity, m_blocks,
+                  interpret):
+    """Slot-parameterized sparse scan for the ring tier (see above).
+    Same contract as `knn_sparse_scan`: (dists, idx, overflow)."""
+    return knn_sparse_scan(
+        qx, qy, x, y, mask, k=k, tile_capacity=tile_capacity,
+        m_blocks=m_blocks, interpret=interpret)
+
+
+def knn_ring_fullscan(qx, qy, x, y, mask, k, m_blocks, interpret):
+    """Slot-parameterized dense scan for the ring tier (see above).
+    Same contract as `knn_fullscan_tiled`: (dists, idx)."""
+    return knn_fullscan_tiled(
+        qx, qy, x, y, mask, k=k, m_blocks=m_blocks, interpret=interpret)
+
+
 def default_interpret() -> bool:
     """Pallas interpret mode when the default device is CPU (Mosaic
     kernels lower only on TPU) — used by product paths that run the same

@@ -675,6 +675,101 @@ class QueryPlanner:
             result = self._aggregate(sb.batch, sb.dev, mask, query)
         return result, total, t_scan
 
+    def _knn_mask_setup(self, plan, query):
+        """Residency/scan + f64-exact filter mask for one kNN dispatch —
+        the shared prelude of `_knn_launch` (per window) and `ring_arm`
+        (once per armed ring program). Returns (sb, batch, dev, mask,
+        is_empty); `sb` is None on the uncached scan path and `is_empty`
+        short-circuits the caller's empty-result contract. The mask here
+        is final: band corrections are scattered in (f64-exact at the
+        f32 boundary) and visibility is folded, which is what lets both
+        the fused count reduction and the ring tier's frozen-mask
+        contract hold on every route."""
+        import jax.numpy as jnp
+
+        from geomesa_tpu.engine.device import to_device
+        from geomesa_tpu.plan.runner import visibility_mask
+        from geomesa_tpu.utils.metrics import note_device_op
+
+        sb = None
+        if self.cache is not None:
+            with TRACER.span("residency"):
+                self.cache.ensure(plan.partitions, manifest=plan.manifest)
+                sb = self.cache.superbatch()
+            if sb is None:
+                return None, None, None, None, True
+            allowed = np.zeros(max(len(sb.ids), 1), bool)
+            for name in plan.partitions:
+                i = sb.ids.get(name)
+                if i is not None:
+                    allowed[i] = True
+            if not allowed.any():
+                return None, None, None, None, True
+            batch, dev = sb.batch, sb.dev
+            with TRACER.span("kernel.dispatch", kernel="filter.mask"):
+                mask = (
+                    plan.compiled.mask(dev, batch)
+                    if plan.compiled is not None
+                    else dev["__valid__"]
+                )
+                mask = mask & jnp.asarray(allowed)[sb.pids]
+            note_device_op()
+            if plan.compiled is not None and plan.compiled.has_band:
+                # f64 band refinement, device-resident: exact values
+                # scatter into the mask at their indices, ANDed with the
+                # partition component gathered at just those rows (the
+                # old fetch-patch-reupload refine plus the full
+                # np.asarray(sb.pids) fetch moved ~3n bytes through the
+                # tunnel per query — 23.6 s at 67M, round-5 profile)
+                bidx, bexact = plan.compiled.band_corrections(dev, batch)
+                if len(bidx):
+                    import jax as _jax
+
+                    pid_at = _jax.device_get(
+                        sb.pids[jnp.asarray(bidx)])
+                    note_device_op()
+                    # row validity must survive the scatter here exactly
+                    # as on the scan branch and in knn_scan: without it
+                    # an invalid superbatch row inside the f32 band is
+                    # resurrected with its f64 filter value
+                    if batch.valid is not None:
+                        bexact = bexact & batch.valid[bidx]
+                    mask = mask.at[jnp.asarray(bidx)].set(
+                        jnp.asarray(bexact & allowed[pid_at]))
+        else:
+            with TRACER.span("scan"):
+                batches = list(
+                    self.storage.scan(
+                        plan.bbox, plan.interval,
+                        columns=_needed_columns(
+                            query, plan, self.storage.sft),
+                    )
+                )
+            if not batches:
+                return None, None, None, None, True
+            batch = FeatureBatch.concat(batches)
+            batch = batch.pad_to(_next_pow2(len(batch)))
+            dev = to_device(batch, coord_dtype=self.coord_dtype)
+            with TRACER.span("kernel.dispatch", kernel="filter.mask"):
+                mask = (
+                    plan.compiled.mask(dev, batch)
+                    if plan.compiled is not None
+                    else dev["__valid__"]
+                )
+                mask = mask & dev["__valid__"]
+            note_device_op()
+            if plan.compiled is not None and plan.compiled.has_band:
+                bidx, bexact = plan.compiled.band_corrections(dev, batch)
+                if len(bidx):
+                    if batch.valid is not None:
+                        bexact = bexact & batch.valid[bidx]
+                    mask = mask.at[jnp.asarray(bidx)].set(
+                        jnp.asarray(bexact))
+        vm = visibility_mask(self.storage.sft, batch, query.hints)
+        if vm is not None:
+            mask = mask & jnp.asarray(vm)
+        return sb, batch, dev, mask, False
+
     def knn(
         self,
         query: "Query | str",
@@ -792,11 +887,10 @@ class QueryPlanner:
         fallback keeps that safe)."""
         import jax.numpy as jnp
 
-        from geomesa_tpu.engine.device import to_device
         from geomesa_tpu.engine.knn_scan import (
             capacity_bucket, count_match_tiles, default_interpret,
             knn_fullscan_tiled, knn_sparse_launch)
-        from geomesa_tpu.plan.runner import visibility_mask
+        from geomesa_tpu.utils.metrics import note_device_op
 
         if isinstance(query, str):
             query = Query(self.storage.sft.name, query)
@@ -835,81 +929,10 @@ class QueryPlanner:
                 fused=want_mask_count,
             )
 
-        sb = None
-        if self.cache is not None:
-            with TRACER.span("residency"):
-                self.cache.ensure(plan.partitions, manifest=plan.manifest)
-                sb = self.cache.superbatch()
-            if sb is None:
-                return empty()
-            allowed = np.zeros(max(len(sb.ids), 1), bool)
-            for name in plan.partitions:
-                i = sb.ids.get(name)
-                if i is not None:
-                    allowed[i] = True
-            if not allowed.any():
-                return empty()
-            batch, dev = sb.batch, sb.dev
-            with TRACER.span("kernel.dispatch", kernel="filter.mask"):
-                mask = (
-                    plan.compiled.mask(dev, batch)
-                    if plan.compiled is not None
-                    else dev["__valid__"]
-                )
-                mask = mask & jnp.asarray(allowed)[sb.pids]
-            if plan.compiled is not None and plan.compiled.has_band:
-                # f64 band refinement, device-resident: exact values
-                # scatter into the mask at their indices, ANDed with the
-                # partition component gathered at just those rows (the
-                # old fetch-patch-reupload refine plus the full
-                # np.asarray(sb.pids) fetch moved ~3n bytes through the
-                # tunnel per query — 23.6 s at 67M, round-5 profile)
-                bidx, bexact = plan.compiled.band_corrections(dev, batch)
-                if len(bidx):
-                    import jax as _jax
-
-                    pid_at = _jax.device_get(
-                        sb.pids[jnp.asarray(bidx)])
-                    # row validity must survive the scatter here exactly
-                    # as on the scan branch and in knn_scan: without it
-                    # an invalid superbatch row inside the f32 band is
-                    # resurrected with its f64 filter value
-                    if batch.valid is not None:
-                        bexact = bexact & batch.valid[bidx]
-                    mask = mask.at[jnp.asarray(bidx)].set(
-                        jnp.asarray(bexact & allowed[pid_at]))
-        else:
-            with TRACER.span("scan"):
-                batches = list(
-                    self.storage.scan(
-                        plan.bbox, plan.interval,
-                        columns=_needed_columns(
-                            query, plan, self.storage.sft),
-                    )
-                )
-            if not batches:
-                return empty()
-            batch = FeatureBatch.concat(batches)
-            batch = batch.pad_to(_next_pow2(len(batch)))
-            dev = to_device(batch, coord_dtype=self.coord_dtype)
-            with TRACER.span("kernel.dispatch", kernel="filter.mask"):
-                mask = (
-                    plan.compiled.mask(dev, batch)
-                    if plan.compiled is not None
-                    else dev["__valid__"]
-                )
-                mask = mask & dev["__valid__"]
-            if plan.compiled is not None and plan.compiled.has_band:
-                bidx, bexact = plan.compiled.band_corrections(dev, batch)
-                if len(bidx):
-                    if batch.valid is not None:
-                        bexact = bexact & batch.valid[bidx]
-                    mask = mask.at[jnp.asarray(bidx)].set(
-                        jnp.asarray(bexact))
+        sb, batch, dev, mask, is_empty = self._knn_mask_setup(plan, query)
+        if is_empty:
+            return empty()
         check_timeout("scan")
-        vm = visibility_mask(self.storage.sft, batch, query.hints)
-        if vm is not None:
-            mask = mask & jnp.asarray(vm)
 
         x = dev[f"{g.name}__x"]
         y = dev[f"{g.name}__y"]
@@ -983,6 +1006,7 @@ class QueryPlanner:
                         interpret=interp,
                     )
                     fb_qx, fb_qy = jqx, jqy
+            note_device_op()
             launch.arm_sparse(fd, fi, ov, fb_qx, fb_qy, x, y, mask,
                               cap=seed_cap, caps_key=key, mb=mb,
                               interp=interp)
@@ -999,6 +1023,7 @@ class QueryPlanner:
                         jqx, jqy, x, y, mask, k=kk, m_blocks=mb,
                         interpret=interp,
                     )
+            note_device_op()
             launch.arm_dense(fd, fi)
         return launch
 
@@ -1124,6 +1149,9 @@ class QueryPlanner:
         fd, fi, ov = out[0], out[1], out[2]
         count_dev = out[3] if want_mask_count else None
         metrics.counter("knn.mesh.dispatches")
+        from geomesa_tpu.utils.metrics import note_device_op
+
+        note_device_op()
         launch = KnnLaunch(self, k=k, kk=kk, impl="mesh", batch=batch,
                            count_dev=count_dev, hq=_host_q(qx, qy))
         launch.mesh_shape = mesh_shape
@@ -1201,10 +1229,167 @@ class QueryPlanner:
             fd, fi, ov, seed_cap = knn_sparse_launch(
                 jqx, jqy, lx, ly, lm, k=kk, tile_capacity=seed_cap,
                 m_blocks=mb, interpret=interp)
+        from geomesa_tpu.utils.metrics import note_device_op
+
+        note_device_op()
         launch.arm_sparse(fd, fi, ov, jqx, jqy, lx, ly, lm,
                           cap=seed_cap, caps_key=key, mb=mb,
                           interp=interp)
         return launch
+
+    def ring_arm(self, query: "Query | str", q_padded: int, k: int = 10,
+                 impl: str = "sparse", donate: bool = False,
+                 depth: int = 4) -> "RingProgram":
+        """Arm ONE persistent serve program for a (type, canonical CQL,
+        hints, k, impl, Q-bucket[, mesh_shape]) window class
+        (docs/SERVING.md "Persistent serve loop"): plan → residency →
+        the f64-exact filter mask → capacity calibration → AOT handle
+        under the registry's ring tier, all exactly ONCE. Per window the
+        ring loop then pays a slot write + one executable invocation +
+        the completer's harvest read — none of the per-window plan/
+        residency/mask work the pipelined route repeats.
+
+        Raises RingIneligible (typed — the caller keeps the PR-7
+        pipelined route) when the window class cannot hold the frozen
+        contract: configured interceptors (must run per request),
+        storage without committed manifest versioning (staleness would
+        be undetectable), no device cache / no resident superbatch
+        (nothing to pre-bind), a non-point geometry, or a mesh window
+        whose tiles live on a single shard (the shard-affinity route is
+        already one cheap local dispatch and keeps per-chip
+        attribution exact)."""
+        from geomesa_tpu.engine.knn_scan import (
+            capacity_bucket, count_match_tiles, default_interpret,
+            shard_match_tiles)
+
+        import jax.numpy as jnp
+
+        if isinstance(query, str):
+            query = Query(self.storage.sft.name, query)
+        if self.interceptors:
+            raise RingIneligible("interceptors")
+        mv_fn = getattr(self.storage, "manifest_version", None)
+        if mv_fn is None:
+            raise RingIneligible("no_version")
+        if self.cache is None:
+            raise RingIneligible("no_device_cache")
+        self._enable_compile_cache()
+        plan = self.plan(query)
+        query = plan.query
+        g = self.storage.sft.default_geometry
+        if g is None or g.type != "Point":
+            raise RingIneligible("non_point")
+        sb, batch, dev, mask, is_empty = self._knn_mask_setup(plan, query)
+        if is_empty or sb is None:
+            # nothing resident/matching: the empty window is already
+            # one cheap early-out on the pipelined route (with a cache
+            # present, sb None only ever co-occurs with is_empty)
+            raise RingIneligible("empty")
+        x = dev[f"{g.name}__x"]
+        y = dev[f"{g.name}__y"]
+        kk = min(k, x.shape[0])
+        mb = max(64, kk)
+        interp = default_interpret()
+        if impl == "auto":
+            impl = self._knn_impl_from_stats(plan)
+        prog = RingProgram(self, plan, sb, batch, k=k, kk=kk, impl=impl,
+                           mb=mb, interp=interp, depth=depth,
+                           mversion=int(mv_fn()))
+        # the fused-count rider precompute: the mask is FROZEN for this
+        # program's lifetime (version-checked per window), so the
+        # cross-kind count is one arm-time reduction, not a per-window
+        # device op — the one deliberate host sync the arm pays
+        prog.mask_count = int(np.asarray(jnp.sum(mask, dtype=jnp.int64)))
+        import jax
+
+        from geomesa_tpu.compilecache.registry import registry
+
+        qabs = jax.ShapeDtypeStruct((int(q_padded),), jnp.float32)
+        if getattr(sb, "mesh", None) is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from geomesa_tpu.engine.knn_scan import (
+                make_knn_fullscan_sharded, make_knn_serve_sharded)
+            from geomesa_tpu.parallel.mesh import SHARD_AXIS
+
+            mesh = sb.mesh
+            shards = sb.shards_for(plan.partitions)
+            if len(shards) <= 1:
+                raise RingIneligible("shard_affinity")
+            prog.route = "mesh"
+            prog.mesh_shape = tuple(int(s) for s in mesh.devices.shape)
+            prog.shards = shards
+            prog.placement = NamedSharding(mesh, P())
+            # pre-pin the frozen mask to the row sharding ONCE (the
+            # per-window re-pin the mesh route pays today)
+            prog.mask = jax.device_put(
+                mask, NamedSharding(mesh, P(SHARD_AXIS)))
+            prog.x, prog.y = x, y
+            d = int(mesh.devices.size)
+            prog.caps_key = (ast.to_cql(plan.filter), kk,
+                             ("mesh",) + prog.mesh_shape)
+            # same capacity policy as every other route (_caps_seed
+            # creates the cache; sync's write-back shares it): reuse a
+            # warm seed, calibrate once otherwise
+            cap = self._caps_seed(prog.caps_key)
+            if cap is None:
+                cap = capacity_bucket(int(np.asarray(
+                    shard_match_tiles(mask, d))))
+            prog.cap = cap
+            base = registry.mesh_variant(
+                "knn_scan.knn_serve_sharded", mesh,
+                fn=make_knn_serve_sharded(mesh),
+                static_argnames=("k", "tile_capacity", "m_blocks",
+                                 "want_count", "interpret"))
+            # mesh ring entries never donate: the overflow fallback
+            # re-reads the staged pair, and the collective program's
+            # replicated inputs are not serve-owned per chip
+            vname = registry.ring_variant(
+                base, depth, fn=make_knn_serve_sharded(mesh),
+                static_argnames=("k", "tile_capacity", "m_blocks",
+                                 "want_count", "interpret"))
+            prog.handle = registry.compile(
+                vname, qabs, qabs, x, y, prog.mask, k=kk,
+                tile_capacity=cap, m_blocks=mb, want_count=False,
+                interpret=interp)
+            prog.dense_fn = make_knn_fullscan_sharded(mesh)
+            prog.mesh = mesh
+        else:
+            from geomesa_tpu.engine.knn_scan import (
+                knn_ring_fullscan, knn_ring_scan)
+
+            prog.x, prog.y, prog.mask = x, y, mask
+            donate_argnums = (0, 1) if donate else ()
+            if impl == "sparse":
+                prog.route = "sparse"
+                prog.caps_key = (ast.to_cql(plan.filter), kk)
+                cap = self._caps_seed(prog.caps_key)
+                if cap is None:
+                    cap = capacity_bucket(int(np.asarray(
+                        count_match_tiles(mask))))
+                prog.cap = cap
+                vname = registry.ring_variant(
+                    "knn_scan.knn_ring_scan", depth, fn=knn_ring_scan,
+                    donate_argnums=donate_argnums,
+                    static_argnames=("k", "tile_capacity", "m_blocks",
+                                     "interpret"))
+                prog.handle = registry.compile(
+                    vname, qabs, qabs, x, y, mask, k=kk,
+                    tile_capacity=cap, m_blocks=mb, interpret=interp)
+            else:
+                prog.route = "fullscan"
+                vname = registry.ring_variant(
+                    "knn_scan.knn_ring_fullscan", depth,
+                    fn=knn_ring_fullscan,
+                    donate_argnums=donate_argnums,
+                    static_argnames=("k", "m_blocks", "interpret"))
+                prog.handle = registry.compile(
+                    vname, qabs, qabs, x, y, mask, k=kk, m_blocks=mb,
+                    interpret=interp)
+        from geomesa_tpu.utils.metrics import metrics
+
+        metrics.counter("serve.ring.armed")
+        return prog
 
     def _knn_impl_from_stats(self, plan: "QueryPlan") -> str:
         """Stats-typed sparse-vs-fullscan decision (VERDICT r4 task 6).
@@ -1498,7 +1683,7 @@ class KnnLaunch:
                  "mask_count", "fused_ok", "_ready", "_fd", "_fi", "_ov",
                  "_cap", "_caps_key", "_jqx", "_jqy", "_x", "_y",
                  "_mask", "_mb", "_interp", "_count_dev", "_dense",
-                 "_hq", "idx_offset", "mesh_shape", "shards")
+                 "_hq", "idx_offset", "mesh_shape", "shards", "ring")
 
     def __init__(self, planner, k, kk, impl, batch, count_dev=None,
                  hq=None):
@@ -1524,6 +1709,10 @@ class KnnLaunch:
         self.idx_offset = 0         # shard-affinity global-index base
         self.mesh_shape: tuple = ()
         self.shards: tuple = ()
+        # ring-route marker (docs/SERVING.md "Persistent serve loop"):
+        # sync stamps its span so the gap report's ring-mode
+        # attribution can separate harvest reads from pipeline syncs
+        self.ring = False
 
     @classmethod
     def ready(cls, planner, result, fused: bool = False) -> "KnnLaunch":
@@ -1574,9 +1763,14 @@ class KnnLaunch:
         from geomesa_tpu.engine.knn_scan import knn_sparse_finish
 
         extra = (self._count_dev,) if self._count_dev is not None else ()
-        with TRACER.span("device.sync",
-                         shards=",".join(map(str, self.shards))
-                         if self.shards else ""):
+        from geomesa_tpu.utils.metrics import note_device_op
+
+        note_device_op()
+        attrs = {"shards": ",".join(map(str, self.shards))
+                 if self.shards else ""}
+        if self.ring:
+            attrs["ring"] = True
+        with TRACER.span("device.sync", **attrs):
             if self._dense is not None:
                 # mesh program: ONE combined read (results + any-shard
                 # overflow + fused count); overflow routes to the
@@ -1628,6 +1822,159 @@ class KnnLaunch:
         self._dense = None
         self._ready = (dists, idx, self.batch)
         return self._ready
+
+
+class RingIneligible(RuntimeError):
+    """Typed refusal: this window class cannot take the persistent ring
+    route (docs/SERVING.md "Persistent serve loop"). Carries the
+    metered reason; the serve loop falls back to the PR-7 pipelined
+    dispatch — slower per window, never wrong."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"ring-ineligible: {reason}")
+        self.reason = reason
+
+
+class RingProgram:
+    """One armed persistent serve program (planner.ring_arm).
+
+    Everything a window would otherwise recompute per dispatch is
+    frozen here: the plan's partitions, the resident superbatch, the
+    f64-exact filter mask (band corrections + visibility folded), the
+    calibrated sparse capacity, the fused-count scalar, and the AOT
+    executable under the registry ring tier. `launch()` is the whole
+    per-window device interaction: ONE executable invocation over the
+    pre-bound feature buffers plus the staged slot pair. `fresh()` is
+    the per-window staleness gate — a lock-peek plus an int compare,
+    never residency work — and a False answer sends the window back to
+    the pipelined route, whose plan/ensure pass rebuilds residency and
+    lets the ring loop re-arm against the new version.
+
+    Bit-identity holds by construction: the kernel, mask, capacity and
+    merge are exactly the serial route's, the staged slot carries the
+    same host-f64→f32 cast, and sync runs the same overflow ladder and
+    `_canonical_dists` f64 recompute every other route runs."""
+
+    __slots__ = ("planner", "plan", "sb", "batch", "k", "kk", "impl",
+                 "mb", "interp", "depth", "mversion", "mask_count",
+                 "route", "handle", "x", "y", "mask", "cap", "caps_key",
+                 "placement", "mesh", "mesh_shape", "shards",
+                 "dense_fn")
+
+    def __init__(self, planner, plan, sb, batch, k, kk, impl, mb,
+                 interp, depth, mversion):
+        self.planner = planner
+        self.plan = plan
+        self.sb = sb
+        self.batch = batch
+        self.k = k
+        self.kk = kk
+        self.impl = impl
+        self.mb = mb
+        self.interp = interp
+        self.depth = depth
+        self.mversion = mversion
+        self.mask_count = 0
+        self.route = "sparse"
+        self.handle = None
+        self.x = self.y = self.mask = None
+        self.cap = None
+        self.caps_key = None
+        self.placement = None        # staging placement (mesh: replicated)
+        self.mesh = None
+        self.mesh_shape: tuple = ()
+        self.shards: tuple = ()
+        self.dense_fn = None         # mesh overflow program builder
+
+    def fresh(self) -> bool:
+        """Cheap per-window staleness gate: the superbatch reference
+        must still be the cache's CURRENT one (a residency change mints
+        a new object) and the storage commit version must be the armed
+        one (a write that has not re-tiered residency yet must still
+        route to the pipelined path, whose plan/ensure applies it)."""
+        cache = self.planner.cache
+        if cache is None or cache.superbatch_peek() is not self.sb:
+            return False
+        try:
+            return int(self.planner.storage.manifest_version()) \
+                == self.mversion
+        except Exception:
+            return False
+
+    def launch(self, staged, qx, qy, timeout_ms: Optional[int] = None,
+               want_mask_count: bool = False) -> "KnnLaunch":
+        """Per-window ring dispatch: one AOT executable invocation on
+        the pre-bound buffers + the staged slot. Returns a KnnLaunch
+        whose sync is byte-identical to the serial route's (same
+        overflow ladder, same `_canonical_dists`). The fused count
+        resolves from the arm-time scalar — zero per-window device
+        work for count riders."""
+        from geomesa_tpu.utils.metrics import metrics, note_device_op
+
+        deadline = (time.monotonic() + timeout_ms / 1000.0
+                    if timeout_ms else None)
+        jqx, jqy = staged
+        launch = KnnLaunch(self.planner, k=self.k, kk=self.kk,
+                           impl=self.impl, batch=self.batch,
+                           hq=_host_q(qx, qy))
+        launch.ring = True
+        launch.deadline = deadline
+        if want_mask_count:
+            launch.fused_ok = True
+            launch.mask_count = self.mask_count
+        shard_list = ",".join(map(str, self.shards)) \
+            if self.shards else ""
+        with TRACER.span("kernel.dispatch", kernel="knn_ring",
+                         q=int(jqx.shape[0]), k=self.kk,
+                         shards=shard_list):
+            if self.route == "mesh":
+                fd, fi, ov = self.handle.call(
+                    jqx, jqy, self.x, self.y, self.mask)
+                launch.mesh_shape = self.mesh_shape
+                launch.shards = self.shards
+                launch.arm_mesh(fd, fi, ov, self._dense_fallback(jqx, jqy),
+                                cap=self.cap, caps_key=self.caps_key)
+                metrics.counter("knn.mesh.dispatches")
+            elif self.route == "fullscan":
+                fd, fi = self.handle.call(
+                    jqx, jqy, self.x, self.y, self.mask)
+                launch.arm_dense(fd, fi)
+            else:
+                fd, fi, ov = self.handle.call(
+                    jqx, jqy, self.x, self.y, self.mask)
+                # the staged slot may be DONATED to the program — the
+                # overflow fallback must never re-read it, so the
+                # handle keeps host f32 copies (same values; the
+                # fullscan converts on entry). Overflow is structurally
+                # unreachable here (the capacity was calibrated from
+                # THIS frozen mask), but the ladder stays armed.
+                launch.arm_sparse(
+                    fd, fi, ov,
+                    np.asarray(qx, np.float32), np.asarray(qy, np.float32),
+                    self.x, self.y, self.mask,
+                    cap=self.cap, caps_key=self.caps_key, mb=self.mb,
+                    interp=self.interp)
+        note_device_op()
+        metrics.counter("serve.ring.windows")
+        return launch
+
+    def _dense_fallback(self, jqx, jqy):
+        """Mesh overflow contract, armed lazily: compiled only if a
+        window ever observes the (structurally unreachable) overflow
+        flag — the cold path must not tax every arm."""
+        def run():
+            from geomesa_tpu.compilecache.registry import registry
+
+            dname = registry.mesh_variant(
+                "knn_scan.knn_fullscan_sharded", self.mesh,
+                fn=self.dense_fn,
+                static_argnames=("k", "m_blocks", "interpret"))
+            h = registry.compile(dname, jqx, jqy, self.x, self.y,
+                                 self.mask, k=self.kk, m_blocks=self.mb,
+                                 interpret=self.interp)
+            return h.call(jqx, jqy, self.x, self.y, self.mask)
+
+        return run
 
 
 def _loosen_bbox(f: ast.Filter, geom_name: str) -> ast.Filter:

@@ -63,6 +63,22 @@ def compat_key(req: ServeRequest) -> Optional[tuple]:
             q.max_features, q.crs)
 
 
+def ring_key(req: ServeRequest, q_padded: int) -> Optional[tuple]:
+    """Ring-program window-class key (docs/SERVING.md "Persistent serve
+    loop"): the kNN compat key extended with the padded stacked-query
+    bucket — an AOT ring executable is shape-specific, so window sizes
+    that pad to different pow2 buckets arm separate programs (the
+    bucket floor keeps that a handful of entries, exactly like the
+    kernel jit cache). None = this request never rides the ring
+    (non-kNN, or a filter the canonicalizer cannot key)."""
+    if req.kind != "knn":
+        return None
+    base = compat_key(req)
+    if base is None:
+        return None
+    return base + (int(q_padded),)
+
+
 def fused_count_key(req: ServeRequest) -> Optional[tuple]:
     """Cross-kind fusion (docs/SERVING.md "Pipelined dispatch"): the
     compat key of a COUNT request that may ride this kNN request's

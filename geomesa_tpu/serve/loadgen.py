@@ -58,6 +58,15 @@ class LoadReport:
     windows_in_flight_max: int = 0
     pipelined_windows: int = 0
     fused_counts: int = 0
+    # persistent serve loop (docs/SERVING.md "Persistent serve loop"):
+    # how many windows rode a ring program, how many fell back typed,
+    # and the per-window device-interaction count (`serve.device.ops`
+    # delta / windows) — the number `bench-serve --mode sustained
+    # --ring` compares against the pipelined baseline and the
+    # `ring.dispatch.*` sentinel family gates
+    ring_windows: int = 0
+    ring_fallbacks: int = 0
+    dispatches_per_window: float = 0.0
     # sharded serving (docs/SERVING.md "Sharded serving"): the mesh the
     # service dispatched on (0 = single-chip) and the headline pts/s
     # normalized per shard — the capacity-multiplier number the
@@ -150,6 +159,18 @@ class LoadReport:
                       "push_events_per_s", "wire_parity_ok"):
                 doc.pop(k, None)
         return doc
+
+
+def device_ops_count() -> float:
+    """Process-lifetime `serve.device.ops` counter: one tick per
+    serve-path device interaction (staged transfer, kernel/program
+    dispatch, combined sync read — utils.metrics.note_device_op). The
+    delta across a measured run over the window count is
+    `dispatches_per_window`, the ring-vs-pipeline headline."""
+    from geomesa_tpu.utils.metrics import metrics
+
+    with metrics._lock:
+        return float(metrics.counters.get("serve.device.ops", 0.0))
 
 
 def mesh_dispatch_count() -> float:
@@ -335,6 +356,7 @@ def run_sustained(
     tally = _Tally()
     base = service.stats()
     mesh_base = mesh_dispatch_count()
+    ops_base = device_ops_count()
     pipe = getattr(service, "pipeline", None)
     if pipe is not None:
         # the in-flight high-water must be THIS run's, not the service
@@ -412,6 +434,22 @@ def run_sustained(
     # lifetime totals would credit a warmup pass to the measured run
     rep.fused_counts = int(p.get("fused_counts", 0)
                            - pbase.get("fused_counts", 0))
+    ring = p.get("ring") or {}
+    ring_base = pbase.get("ring") or {}
+    rep.ring_windows = int(ring.get("windows", 0)
+                           - ring_base.get("windows", 0))
+    rep.ring_fallbacks = (
+        sum((ring.get("fallbacks") or {}).values())
+        - sum((ring_base.get("fallbacks") or {}).values()))
+    # per-window device interactions: the measured run's
+    # serve.device.ops delta over its window count (pipelined windows
+    # when the pipeline ran, dispatch count on the serial stack) — the
+    # ring route's claim is this number strictly below the pipelined
+    # baseline's on identical work
+    windows = rep.pipelined_windows or rep.dispatches
+    if windows > 0:
+        rep.dispatches_per_window = round(
+            (device_ops_count() - ops_base) / windows, 3)
     mesh = getattr(service, "mesh", None)
     if mesh is not None and mesh_dispatch_count() > mesh_base:
         # topology is reported from the LAUNCH route, not the resolved

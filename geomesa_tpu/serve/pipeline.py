@@ -103,13 +103,25 @@ class DispatchPipeline:
     `depth` bounds windows in flight (submit blocks when full)."""
 
     def __init__(self, service, depth: int = 2,
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None, ring: bool = True,
+                 ring_depth: int = 4):
         from geomesa_tpu.engine.device import QueryStager
 
         self.service = service
         self.depth = max(2, int(depth))
         self._donate = donate       # None = auto (backend supports it)
         self._stager = QueryStager(depth=self.depth)
+        # persistent serve loop (serve/ringloop.py): eligible kNN
+        # windows dispatch over a long-lived ring program instead of
+        # the per-window transfer+launch below; ring-ineligible windows
+        # fall back typed to this pipeline unchanged
+        self.ring = None
+        if ring:
+            from geomesa_tpu.serve.ringloop import RingLoop
+
+            self.ring = RingLoop(service,
+                                 depth=max(int(ring_depth), self.depth),
+                                 donate=donate)
         self._slots = threading.BoundedSemaphore(self.depth)
         self._completions: SimpleQueue = SimpleQueue()
         self._lock = threading.Lock()
@@ -141,6 +153,8 @@ class DispatchPipeline:
         with self._lock:
             self._closed = True
             worker = self._worker
+        if self.ring is not None:
+            self.ring.close()
         if worker is not None and worker.is_alive():
             self._completions.put(_STOP)
             worker.join(timeout=timeout_s)
@@ -217,8 +231,14 @@ class DispatchPipeline:
         try:
             self._prepare(win)
             if win.running:
-                self._transfer(win)
-                self._launch(win)
+                # ring route first (docs/SERVING.md "Persistent serve
+                # loop"): slot write + one pre-compiled dispatch; a
+                # typed refusal (ineligible/stale) keeps the pipelined
+                # transfer+launch, and a feed ERROR lands in the same
+                # failure ladder a launch error would
+                if self.ring is None or not self.ring.try_feed(win):
+                    self._transfer(win)
+                    self._launch(win)
             ok = True
         except BaseException as e:  # noqa: BLE001 — serial-path parity
             self._note_meters(win, stall_token, rec_token)
@@ -487,7 +507,7 @@ class DispatchPipeline:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "depth": self.depth,
                 "windows": self._windows,
                 "inflight": self._inflight,
@@ -496,3 +516,6 @@ class DispatchPipeline:
                 "fused_declined": self._fused_declined,
                 "stager": self._stager.stats(),
             }
+        if self.ring is not None:
+            out["ring"] = self.ring.stats()
+        return out

@@ -107,6 +107,16 @@ class ServeConfig:
     pipeline: bool = True
     pipeline_depth: int = 2
     pipeline_donate: Optional[bool] = None
+    # persistent serve loop (docs/SERVING.md "Persistent serve loop"):
+    # eligible kNN window classes dispatch over ONE long-lived ring
+    # program (frozen plan/mask/capacity, AOT handle, depth-`ring_depth`
+    # ring of donated staging slots) — per window only a slot write +
+    # one executable invocation + the completer's harvest read.
+    # Ineligible or stale windows fall back typed to the pipeline
+    # above; ring=False disables the tier entirely (serial-determinism
+    # and chaos runs that already disable the pipeline get it for free)
+    ring: bool = True
+    ring_depth: int = 4
     # sharded serving (docs/SERVING.md "Sharded serving"): route live
     # traffic through the multi-chip engine. "auto" = single-chip on 1
     # device, sharded over every device when >1 (the `gmtpu serve`
@@ -247,7 +257,9 @@ class QueryService:
 
             self.pipeline = DispatchPipeline(
                 self, depth=self.config.pipeline_depth,
-                donate=self.config.pipeline_donate)
+                donate=self.config.pipeline_donate,
+                ring=self.config.ring,
+                ring_depth=self.config.ring_depth)
         # compilation management: compiled executables must survive
         # restarts (the cache is idempotent/never-failing to enable)
         try:
@@ -1331,6 +1343,10 @@ class QueryService:
             metrics.gauge("serve.pipeline.inflight", float(p["inflight"]))
             metrics.gauge("serve.pipeline.max_inflight",
                           float(p["max_inflight"]))
+            ring = p.get("ring")
+            if ring is not None:
+                metrics.gauge("serve.ring.programs",
+                              float(ring["programs"]))
         if self.result_cache is not None:
             c = self.result_cache.stats()
             metrics.gauge("serve.cache.entries", float(c["entries"]))

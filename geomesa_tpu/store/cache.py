@@ -136,6 +136,16 @@ class DeviceCacheManager:
         # code segments from different partitions remain comparable
         self._vocab: Dict[str, list] = {}
         self.upload_count = 0  # partitions transferred host->device
+        # host->device transfer accounting (ROADMAP item 4 foundation):
+        # rows that actually crossed the tunnel. The incremental mesh
+        # GROWTH path appends only the delta tile, so these counters
+        # must NOT scale with resident size on append — regression-
+        # asserted in tests/test_device_cache.py
+        self.upload_rows = 0
+        # last mesh superbatch layout, kept for the delta-append path:
+        # (mesh, names tuple, {name: (padded, files tuple)}, concat row
+        # count BEFORE mesh padding, dev dict, padded_total)
+        self._mesh_prev = None
         self._flat = all(
             (not a.is_geometry) or a.type == "Point"
             for a in storage.sft.attributes
@@ -176,6 +186,7 @@ class DeviceCacheManager:
             for e in self._entries.values():
                 e.dev = None
         self._super = None
+        self._mesh_prev = None  # layout-invalidating: full re-tier
         self._version += 1
         # flight-recorder lifecycle event (docs/OBSERVABILITY.md): a
         # re-tier drops residency and re-uploads on the next
@@ -262,6 +273,7 @@ class DeviceCacheManager:
             # partition — double-buffer under the lock)
             dev = to_device(padded, **kw)
             self.upload_count += 1
+            self.upload_rows += len(padded)
         return CacheEntry(
             files=self._partition_files(name, manifest),
             count=n,
@@ -335,11 +347,22 @@ class DeviceCacheManager:
         else:
             self._entries.pop(partition, None)
         self._super = None
+        # a forced invalidation must actually free device state: the
+        # delta-append path would otherwise keep the dropped rows alive
+        self._mesh_prev = None
         self._version += 1
 
     @_locked
     def get(self, partition: str) -> Optional[CacheEntry]:
         return self._entries.get(partition)
+
+    @_locked
+    def superbatch_peek(self) -> Optional[SuperBatch]:
+        """The CURRENT superbatch if one is built, else None — no
+        residency work, no rebuild. The ring serve loop's per-window
+        freshness gate (docs/SERVING.md "Persistent serve loop") must
+        stay a lock acquire + identity compare, never an upload."""
+        return self._super
 
     @_locked
     def superbatch(self) -> Optional[SuperBatch]:
@@ -385,6 +408,7 @@ class DeviceCacheManager:
             # queries — see class docstring)
             dev = to_device(batch, **kw)
             self.upload_count += 1
+            self.upload_rows += len(batch)
         self._super = SuperBatch(
             batch=batch,
             dev=dev,
@@ -403,16 +427,20 @@ class DeviceCacheManager:
         layout is what makes sharded kNN indices bit-identical to the
         single-chip path; ownership is the row-range → shard map.
 
-        Known growth-phase cost: unlike the single-chip flat path's
-        per-partition segments + device-side concat, each residency
-        CHANGE here re-uploads the full host concat (row ownership
-        shifts with the total row count, so prior shard placements are
-        stale anyway). "One upload per manifest snapshot" holds at
-        steady state; a workload that grows residency one partition at
-        a time pays O(resident_rows) per newly-touched partition while
-        warming (`upload_count` meters it). The incremental rung —
-        shard-aligned segment placement so ownership survives appends —
-        is listed on ROADMAP item 1."""
+        Growth-phase cost (ROADMAP item 4 foundation): a residency
+        GROWTH — new partitions appended at the end of the sorted
+        layout, every already-resident entry byte-identical — uploads
+        ONLY the delta tile (the new rows + fresh mesh padding) and
+        reassembles the sharded arrays device-side from the previous
+        superbatch's buffers, so `upload_rows` does not scale with
+        resident size on append (regression-asserted in
+        tests/test_device_cache.py). Everything else — a changed or
+        removed partition, a name sorting into the middle, a mesh
+        change — is layout-invalidating and takes the full host-concat
+        re-upload (prior row ownership is stale there anyway). Old rows
+        re-placed from device buffers are bit-identical to a fresh
+        upload: the host copies are unchanged and the dict vocab is
+        grow-only, so previously-uploaded codes never re-encode."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -440,8 +468,34 @@ class DeviceCacheManager:
         # NamedSharding placement covers the whole batch — host rows go
         # straight to their owning chip, no single-device staging hop
         row = NamedSharding(self.mesh, P(SHARD_AXIS))
-        dev = to_device(batch, device=row, **kw)  # gt: waive GT09
-        self.upload_count += 1
+        prev = self._mesh_growth_prev(names)
+        if prev is not None:
+            # delta-append: host→device transfer covers ONLY the rows
+            # past the previous concat (new partitions + the new mesh
+            # padding); the old rows re-place from the previous device
+            # buffers over ICI/device copies, never the tunnel
+            old_concat = prev["concat_rows"]
+            tail = batch.select(np.arange(old_concat, len(batch)))
+            tail_dev = to_device(tail, **kw)  # gt: waive GT09
+            self.upload_count += 1
+            self.upload_rows += len(tail)
+            dev = {
+                # gt: waive GT09
+                # (device-side reassembly under the residency lock —
+                # same guarded-swap contract as the uploads above)
+                k: jax.device_put(jnp.concatenate(
+                    [prev["dev"][k][:old_concat], tail_dev[k]]), row)
+                for k in tail_dev
+            }
+            pids = jax.device_put(jnp.concatenate(  # gt: waive GT09
+                [prev["pids"][:old_concat],
+                 jnp.asarray(pids_host[old_concat:])]), row)
+        else:
+            dev = to_device(batch, device=row, **kw)  # gt: waive GT09
+            self.upload_count += 1
+            self.upload_rows += len(batch)
+            pids = jax.device_put(  # gt: waive GT09
+                jnp.asarray(pids_host), row)
         shard_rows = padded_total // d
         owners: Dict[str, tuple] = {}
         off = 0
@@ -451,7 +505,6 @@ class DeviceCacheManager:
                 range(lo // shard_rows,
                       min((hi - 1) // shard_rows + 1, d)))
             off = hi
-        pids = jax.device_put(jnp.asarray(pids_host), row)  # gt: waive GT09
         self._super = SuperBatch(
             batch=batch,
             dev=dev,
@@ -462,7 +515,37 @@ class DeviceCacheManager:
             shard_rows=shard_rows,
             owners=owners,
         )
+        self._mesh_prev = {
+            "mesh": self.mesh,
+            "names": tuple(names),
+            "meta": {n: (e.padded, tuple(e.files))
+                     for n, e in zip(names, entries)},
+            "concat_rows": total,
+            "dev": dev,
+            "pids": pids,
+        }
         return self._super
+
+    def _mesh_growth_prev(self, names) -> Optional[dict]:
+        """The previous mesh layout IF the pending rebuild is a pure
+        GROWTH against it: same mesh, the old name sequence is a strict
+        prefix of the new sorted one (appends only — a name sorting
+        into the middle shifts every later partition's rows), and every
+        previously-resident entry is byte-identical (same padded length
+        and file list). Anything else returns None → full re-upload."""
+        prev = self._mesh_prev
+        if prev is None or prev["mesh"] is not self.mesh:
+            return None
+        pn = prev["names"]
+        if len(names) <= len(pn) or tuple(names[: len(pn)]) != pn:
+            return None
+        for name in pn:
+            e = self._entries.get(name)
+            meta = prev["meta"][name]
+            if e is None or e.padded != meta[0] \
+                    or tuple(e.files) != meta[1]:
+                return None
+        return prev
 
     @_locked
     def resident(self) -> List[str]:
@@ -474,6 +557,8 @@ class DeviceCacheManager:
             "partitions": len(self._entries),
             "rows": sum(e.count for e in self._entries.values()),
             "padded_rows": sum(e.padded for e in self._entries.values()),
+            "uploads": self.upload_count,
+            "upload_rows": self.upload_rows,
             "layout_version": LAYOUT_VERSION,
         }
 

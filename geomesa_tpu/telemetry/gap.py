@@ -37,8 +37,12 @@ from typing import Dict, Iterable, List, Tuple
 __all__ = ["gap_report", "render_gap", "DEVICE_PHASES"]
 
 # span names that represent the device-facing part of a dispatch window;
-# everything else inside the window is host work (the "gap")
-DEVICE_PHASES = ("kernel.dispatch", "device.sync", "device.transfer")
+# everything else inside the window is host work (the "gap").
+# `ring.slot` is the persistent serve loop's slot write (docs/SERVING.md
+# "Persistent serve loop") — a staged transfer by another name, so it
+# counts as device-facing exactly like device.transfer.
+DEVICE_PHASES = ("kernel.dispatch", "device.sync", "device.transfer",
+                 "ring.slot")
 
 
 def _doc(trace) -> dict:
@@ -143,6 +147,14 @@ def gap_report(traces: Iterable) -> dict:
     # as ITS lane's total, not a fleet-wide average. Whole-mesh windows
     # credit every owning shard; shard-affinity windows credit one.
     shard_lanes: Dict[str, Dict[str, float]] = {}
+    # ring-mode attribution (docs/OBSERVABILITY.md "Ring mode"): the
+    # persistent serve loop's per-window cost splits into slot-wait
+    # (ring.slot — the staged write into the ring), kernel (the one
+    # pre-compiled dispatch, kernel.dispatch tagged knn_ring) and
+    # harvest (the completer's combined read, device.sync tagged ring).
+    # Aggregated over the same deduped span set as the phases table.
+    ring = {"windows": 0, "slot_ms": 0.0, "kernel_ms": 0.0,
+            "harvest_ms": 0.0}
     for d in docs:
         proc = str(d.get("trace_id", "")).split("-", 1)[0]
         root = d["root"]
@@ -168,7 +180,16 @@ def gap_report(traces: Iterable) -> dict:
                 s["name"], {"count": 0, "total_ms": 0.0})
             p["count"] += 1
             p["total_ms"] += dur_ms
-            ids = (s.get("attrs") or {}).get("shards", "")
+            attrs = s.get("attrs") or {}
+            if s["name"] == "ring.slot":
+                ring["windows"] += 1
+                ring["slot_ms"] += dur_ms
+            elif s["name"] == "kernel.dispatch" \
+                    and attrs.get("kernel") == "knn_ring":
+                ring["kernel_ms"] += dur_ms
+            elif s["name"] == "device.sync" and attrs.get("ring"):
+                ring["harvest_ms"] += dur_ms
+            ids = attrs.get("shards", "")
             if ids and s["name"] in DEVICE_PHASES:
                 for sid in str(ids).split(","):
                     lane = shard_lanes.setdefault(
@@ -261,6 +282,12 @@ def gap_report(traces: Iterable) -> dict:
             "multi_window_ms": round(multi_window_ns / 1e6, 3),
             "transfer_overlap_ms": round(transfer_overlap_ns / 1e6, 3),
         },
+        "ring": {
+            "windows": ring["windows"],
+            "slot_ms": round(ring["slot_ms"], 3),
+            "kernel_ms": round(ring["kernel_ms"], 3),
+            "harvest_ms": round(ring["harvest_ms"], 3),
+        },
         "shards": {
             sid: {"count": lane["count"],
                   "device_ms": round(lane["device_ms"], 3)}
@@ -295,6 +322,12 @@ def render_gap(report: dict) -> str:
             f"flight ({p['multi_window_ms']:.1f} ms with >=2 open, "
             f"{p['transfer_overlap_ms']:.1f} ms of transfer overlapped "
             f"other windows)")
+    r = report.get("ring") or {}
+    if r.get("windows", 0) >= 1:
+        lines.append(
+            f"ring: {r['windows']} window(s) — slot {r['slot_ms']:.1f} "
+            f"ms, kernel {r['kernel_ms']:.1f} ms, harvest "
+            f"{r['harvest_ms']:.1f} ms")
     lanes = report.get("shards") or {}
     if lanes:
         parts = ", ".join(

@@ -47,7 +47,11 @@ from typing import Dict, List, Optional
 
 __all__ = ["Reservoir", "ContinuousProfiler", "PROFILER", "render_prof"]
 
-DEVICE_PHASES = ("kernel.dispatch", "device.sync", "device.transfer")
+# ring.slot is the persistent serve loop's slot write — device-facing
+# like device.transfer (docs/SERVING.md "Persistent serve loop"); its
+# kernel family (knn_ring) folds from the kernel.dispatch attr as usual
+DEVICE_PHASES = ("kernel.dispatch", "device.sync", "device.transfer",
+                 "ring.slot")
 _DEVICE_SET = frozenset(DEVICE_PHASES)
 RESERVOIR_K = 256
 _SEEN_CAP = 4096          # recently-seen span ids (rider dedup window)

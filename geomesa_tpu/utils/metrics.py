@@ -311,3 +311,15 @@ def _le(bound: float) -> str:
 
 
 metrics = MetricsRegistry()
+
+
+def note_device_op(n: int = 1) -> None:
+    """Meter `n` serve-path device interactions (a staged transfer, a
+    kernel/program dispatch, a band-correction read, the combined sync
+    read) into the `serve.device.ops` counter — the per-window dispatch
+    accounting `bench-serve`'s `dispatches_per_window` is derived from
+    (docs/SERVING.md "Persistent serve loop"). Centralized so every
+    dispatch route (serial, pipelined, mesh, ring) increments through
+    one seam and the ring-vs-pipeline comparison can never drift on
+    counting convention."""
+    metrics.counter("serve.device.ops", n)

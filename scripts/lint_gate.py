@@ -220,14 +220,19 @@ def sentinel_smoke() -> int:
         # window, so every per-phase reservoir collects >= min_n
         # samples and the comparison verdicts instead of answering
         # insufficient-data (a single coalesced window would fold one
-        # sample per phase)
-        svc = QueryService(store, ServeConfig(max_wait_ms=1.0))
+        # sample per phase). result_cache=0 + 8 exact counts keep the
+        # plan/residency/filter.mask families sampled past min_n now
+        # that ring-served kNN windows pay them only at arm time
+        # (docs/SERVING.md "Persistent serve loop")
+        svc = QueryService(store, ServeConfig(max_wait_ms=1.0,
+                                              result_cache=0))
         qp = rng.uniform(-60, 60, (10, 2))
         cql = "BBOX(geom, -180, -90, 180, 90)"
         for i in range(10):
             svc.knn("sentsmoke", cql, qp[i:i + 1, 0],
                     qp[i:i + 1, 1], k=4).result(timeout=180)
-        svc.count("sentsmoke", cql).result(timeout=180)
+        for _ in range(8):
+            svc.count("sentsmoke", cql).result(timeout=180)
         svc.close(drain=True)
 
     TRACER.enable()
@@ -610,6 +615,100 @@ def wire_smoke() -> int:
     return 1 if failures else 0
 
 
+def ring_smoke() -> int:
+    """The persistent serve loop end to end (docs/SERVING.md
+    "Persistent serve loop"): a small sequential kNN workload through
+    the ring path must (a) serve every window past warmup over ONE
+    armed ring program, (b) answer bit-identical to a serial-path
+    replay of the same queries, and (c) measure dispatches_per_window
+    strictly below an identical ring-off (pipelined) run — the
+    structural form of the dispatch-amortization claim CPU CI can
+    assert. Stderr-only like the other smokes."""
+    _pin_cpu()
+    import tempfile
+
+    import numpy as np
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.plan.datastore import DataStore
+    from geomesa_tpu.serve.loadgen import device_ops_count
+    from geomesa_tpu.serve.service import QueryService, ServeConfig
+
+    failures = []
+    rng = np.random.default_rng(23)
+    n = 512
+    windows = 18
+    sft = SimpleFeatureType.from_spec(
+        "ringsmoke", "name:String,dtg:Date,*geom:Point")
+    cql = "BBOX(geom, -180, -90, 180, 90)"
+    with tempfile.TemporaryDirectory() as tmp:
+        store = DataStore(tmp, use_device_cache=True)
+        src = store.create_schema(sft)
+        src.write(FeatureBatch.from_pydict(sft, {
+            "name": rng.choice(["a", "b"], n).tolist(),
+            "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack([rng.uniform(-170, 170, n),
+                              rng.uniform(-80, 80, n)], 1),
+        }))
+        pts = rng.uniform(-60, 60, (windows, 2))
+        planner = store.get_feature_source("ringsmoke").planner
+        from geomesa_tpu.plan.query import Query
+
+        serial = [planner.knn(Query("ringsmoke", cql), pts[i:i + 1, 0],
+                              pts[i:i + 1, 1], k=4)
+                  for i in range(windows)]
+
+        def run(cfg):
+            svc = QueryService(store, cfg)
+            try:
+                # warm pass: arm/compile outside the measured loop
+                for i in range(2):
+                    svc.knn("ringsmoke", cql, pts[i:i + 1, 0],
+                            pts[i:i + 1, 1], k=4).result(timeout=300)
+                o0 = device_ops_count()
+                out = []
+                for i in range(windows):
+                    out.append(svc.knn(
+                        "ringsmoke", cql, pts[i:i + 1, 0],
+                        pts[i:i + 1, 1], k=4).result(timeout=300))
+                per_window = (device_ops_count() - o0) / windows
+                return out, per_window, svc.stats()["pipeline"]
+            finally:
+                svc.close(drain=True)
+
+        ring_res, ring_pw, ring_stats = run(ServeConfig(max_wait_ms=1.0))
+        pipe_res, pipe_pw, _ = run(
+            ServeConfig(max_wait_ms=1.0, ring=False))
+    for i, ((d, ix, _b), (sd, six, _sb)) in enumerate(
+            zip(ring_res, serial)):
+        if not (np.array_equal(d, sd) and np.array_equal(ix, six)):
+            failures.append(f"ring window {i} != serial replay")
+            break
+    for i, ((d, ix, _b), (pd, pix, _pb)) in enumerate(
+            zip(ring_res, pipe_res)):
+        if not (np.array_equal(d, pd) and np.array_equal(ix, pix)):
+            failures.append(f"ring window {i} != pipelined replay")
+            break
+    ring = ring_stats.get("ring") or {}
+    if ring.get("windows", 0) < windows:
+        failures.append(
+            f"only {ring.get('windows')} of {windows} windows rode "
+            f"the ring (fallbacks: {ring.get('fallbacks')})")
+    if not ring_pw < pipe_pw:
+        failures.append(
+            f"dispatches_per_window not below the pipelined baseline: "
+            f"ring {ring_pw} vs pipelined {pipe_pw}")
+    print(
+        f"ring smoke: {ring.get('windows')}/{windows} ring window(s) "
+        f"over {ring.get('armed')} armed program(s), "
+        f"dispatches/window ring={ring_pw:.2f} vs "
+        f"pipelined={pipe_pw:.2f}", file=sys.stderr)
+    for f in failures:
+        print(f"ring smoke: FAIL {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def warmup_smoke(manifest_path: str = SMOKE_MANIFEST) -> int:
     """`gmtpu warmup --check` against the fixture manifest, pinned to
     CPU (the fixture records interpret-mode kernels; this gate must run
@@ -677,6 +776,12 @@ def main(argv=None) -> int:
                         "columnar session with decoded parity vs a "
                         "JSON replay + one-encode push fan-out to 64 "
                         "in-process subscribers; text mode only)")
+    p.add_argument("--no-ring-smoke", action="store_true",
+                   help="skip the persistent-serve-loop smoke "
+                        "(sequential kNN windows over one armed ring "
+                        "program: bit-identity vs serial + "
+                        "dispatches_per_window strictly below the "
+                        "pipelined baseline; text mode only)")
     args = p.parse_args(argv)
     findings = lint_paths([os.path.join(REPO_ROOT, "geomesa_tpu")])
     if args.format == "json":
@@ -700,6 +805,8 @@ def main(argv=None) -> int:
         rc = approx_smoke()
     if args.format == "text" and not args.no_wire_smoke and rc == 0:
         rc = wire_smoke()
+    if args.format == "text" and not args.no_ring_smoke and rc == 0:
+        rc = ring_smoke()
     return rc
 
 

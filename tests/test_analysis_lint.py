@@ -732,6 +732,7 @@ class TestGT16PipelineStageBlocking:
         from geomesa_tpu.analysis.rules import ALL_RULES
 
         assert "GT16" in RULES and "GT16" in ALL_RULES
+        assert "GT23" in RULES and "GT23" in ALL_RULES
         import pathlib
         import tempfile
 
@@ -746,6 +747,85 @@ class TestGT16PipelineStageBlocking:
             fs = lint_paths([td], rules=["GT16"], extra_ref_paths=[])
             assert any(f.rule == "GT16" and f.waived for f in fs)
             assert not active([f for f in fs if f.rule == "GT16"])
+
+
+# -- GT23 -------------------------------------------------------------------
+
+
+class TestGT23RingFeedBlocking:
+    """Blocking host syncs or naked per-window transfers inside the
+    ring feed loop scope (docs/SERVING.md "Persistent serve loop"):
+    per-window work is ONLY a stager slot write + one pre-compiled
+    dispatch."""
+
+    def _findings(self, src,
+                  relpath="geomesa_tpu/serve/ringloop.py"):
+        from geomesa_tpu.analysis.modinfo import ModInfo
+        from geomesa_tpu.analysis.rules import gt23
+
+        mod = ModInfo("/x.py", textwrap.dedent(src), relpath=relpath)
+        return list(gt23(mod, None))
+
+    DIRTY = """
+        import jax
+
+        def try_feed(self, win):
+            fd = self.handle.call(win.qx)
+            fd.block_until_ready()
+
+        def _slot_write(self, win):
+            return jax.device_put(win.qx)
+
+        def _feed_one(self, win):
+            win.staged = to_device(win.batch)
+            return win.future.result()
+    """
+
+    def test_blocking_and_transfers_in_feed_scope_flagged(self):
+        found = self._findings(self.DIRTY)
+        assert sorted((f.rule, f.line) for f in found) == [
+            ("GT23", 6), ("GT23", 9), ("GT23", 12), ("GT23", 13)]
+
+    def test_clean_counterparts(self):
+        clean = """
+            def try_feed(self, win):
+                # the DESIGNATED slot write: the stager owns the
+                # device_put (retry fabric + rotation contract)
+                win.staged = self._stager.stage(key, win.qx, win.qy)
+                win.launch = prog.launch(win.staged, win.qx, win.qy)
+                return True
+
+            def _arm(self, key, win):
+                # arm scope is NOT feed scope: the one-time setup may
+                # sync (calibration, fused-count precompute)
+                return planner.ring_arm(win.lead.query)
+
+            def _sync(self, win):
+                win.launch.sync()
+        """
+        assert self._findings(clean) == []
+
+    def test_scope_is_path_limited(self):
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/serve/pipeline.py") == []
+        assert self._findings(
+            self.DIRTY, "geomesa_tpu/plan/planner.py") == []
+
+    def test_waiver(self):
+        import pathlib
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            sub = pathlib.Path(td) / "geomesa_tpu" / "serve"
+            sub.mkdir(parents=True)
+            (sub / "ringloop.py").write_text(textwrap.dedent("""
+                def try_feed(self, win):
+                    # gt: waive GT23
+                    win.fd.block_until_ready()
+            """))
+            fs = lint_paths([td], rules=["GT23"], extra_ref_paths=[])
+            assert any(f.rule == "GT23" and f.waived for f in fs)
+            assert not active([f for f in fs if f.rule == "GT23"])
 
 
 # -- GT17 -------------------------------------------------------------------

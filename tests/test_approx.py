@@ -322,6 +322,80 @@ class TestStaleFallthrough:
         assert abs(int(a2) - pl.count(Query("apx", CQLS[1]))) <= a2.bound
 
 
+# -- sidecar persistence ------------------------------------------------------
+
+
+class TestSidecarPersistence:
+    """The manifest-versioned sketch sidecar (ROADMAP item 2 remaining
+    rung): a second process loads version-exact sketches from disk
+    instead of re-scanning partitions; a stale entry is a typed
+    skip-and-rebuild, never a torn load."""
+
+    def test_warm_spinup_answers_without_builds(self, tmp_path):
+        import os
+
+        from geomesa_tpu.plan.datastore import DataStore
+
+        sft = SimpleFeatureType.from_spec("apx", SFT_SPEC)
+        root = str(tmp_path / "cat")
+        ds = DataStore(root, use_device_cache=True)
+        src = ds.create_schema(sft)
+        src.write(_batch(sft, 21, 1024))
+        q = Query("apx", CQLS[1], hints=QueryHints(tolerance=0.25))
+        pl = src.planner
+        a1 = pl.count(q)
+        assert isinstance(a1, ApproxCount)
+        eng1 = pl.approx_engine()
+        assert os.path.exists(eng1.store.sidecar_path)
+
+        # "replica spin-up": a fresh process-equivalent store over the
+        # same catalog — the sidecar pre-installs every sketch, so the
+        # first tolerant answer runs ZERO partition builds
+        ds2 = DataStore(root, use_device_cache=True)
+        pl2 = ds2.get_feature_source("apx").planner
+        eng2 = pl2.approx_engine()
+        st = eng2.store.stats()
+        assert st["sidecar_loaded"] >= 1 and st["sidecar_stale"] == 0
+        # every partition already serves version-exact from the loaded
+        # sidecar — the first tolerant answer needs ZERO builds
+        snap = pl2.storage.manifest_snapshot()
+        assert all(eng2.store.get(n, snap[n]) is not None for n in snap)
+        eng2.allow_build = False  # a build attempt would now raise/route exact
+        try:
+            a2 = pl2.count(q)
+        finally:
+            eng2.allow_build = True
+        assert isinstance(a2, ApproxCount)
+        assert int(a2) == int(a1) and a2.bound == a1.bound
+
+    def test_stale_sidecar_is_typed_rebuild(self, tmp_path):
+        from geomesa_tpu.plan.datastore import DataStore
+
+        sft = SimpleFeatureType.from_spec("apx", SFT_SPEC)
+        root = str(tmp_path / "cat")
+        ds = DataStore(root, use_device_cache=True)
+        src = ds.create_schema(sft)
+        src.write(_batch(sft, 31, 512, narrow_dtg=True))
+        q = Query("apx", CQLS[0], hints=QueryHints(tolerance=0.25))
+        pl = src.planner
+        assert isinstance(pl.count(q), ApproxCount)
+        # the write happens AFTER the sidecar was persisted: its
+        # token no longer matches the committed manifest
+        src.write(_batch(sft, 32, 256, narrow_dtg=True))
+
+        ds2 = DataStore(root, use_device_cache=True)
+        src2 = ds2.get_feature_source("apx")
+        eng2 = src2.planner.approx_engine()
+        st = eng2.store.stats()
+        assert st["sidecar_stale"] >= 1  # never installed torn
+        # the stale partition rebuilds from a pinned read on first use;
+        # the answer stays bound-correct against the exact count
+        a = src2.planner.count(q)
+        assert isinstance(a, ApproxCount)
+        exact = src2.planner.count(Query("apx", CQLS[0]))
+        assert abs(int(a) - int(exact)) <= a.bound
+
+
 # -- result cache ------------------------------------------------------------
 
 

@@ -170,6 +170,84 @@ def test_cached_loose_bbox_falls_back_exact(stores):
     assert a.count == b.count
 
 
+class TestMeshGrowthDelta:
+    """Mesh residency GROWTH (ROADMAP item 4 foundation): appending new
+    partitions uploads only the delta tile — host→device row counters
+    must NOT scale with resident size on append. Layout-invalidating
+    changes (rewriting an existing partition) still take the full
+    re-tier."""
+
+    def test_append_uploads_delta_not_resident_size(self, tmp_path):
+        import numpy as np
+
+        from geomesa_tpu.core.columnar import FeatureBatch
+        from geomesa_tpu.core.sft import SimpleFeatureType
+        from geomesa_tpu.parallel.mesh import serve_mesh
+        from geomesa_tpu.plan.datastore import DataStore
+        from geomesa_tpu.store.partition import DateTimeScheme
+
+        sft = SimpleFeatureType.from_spec(
+            "t", "actor:String,score:Double,dtg:Date,*geom:Point"
+        )
+        rng = np.random.default_rng(7)
+
+        def mk(n, month, actors=("AA", "BB")):
+            t0 = np.datetime64(f"2020-{month:02d}-10").astype(
+                "datetime64[ms]").astype(np.int64)
+            return FeatureBatch.from_pydict(sft, {
+                "actor": rng.choice(list(actors), n).tolist(),
+                "score": rng.uniform(-5, 5, n),
+                "dtg": t0 + rng.integers(0, 86_400_000, n),
+                "geom": np.stack([rng.uniform(-10, 10, n),
+                                  rng.uniform(-10, 10, n)], 1),
+            })
+
+        ds = DataStore(str(tmp_path / "cat"), use_device_cache=True)
+        src = ds.create_schema(sft, DateTimeScheme("yyyy/MM"))
+        src.write(mk(50, 6))
+        mesh = serve_mesh(4)
+        assert mesh is not None  # conftest forces 8 host devices
+        ds.set_mesh(mesh)
+        q = "BBOX(geom, -20, -20, 20, 20)"
+        n0 = src.get_count(q)
+        cache = src.planner.cache
+        assert cache.superbatch_peek() is not None
+        assert cache.superbatch_peek().mesh is mesh
+        r0 = cache.upload_rows
+
+        # two equal-size appends: each delta must be the APPEND's rows
+        # (plus pow2/mesh padding), not the resident total — equal
+        # appends therefore cost equal uploads even as residency grows
+        deltas = []
+        counts = [n0]
+        for month in (7, 8):
+            src.write(mk(40, month))
+            counts.append(src.get_count(q))
+            r1 = cache.upload_rows
+            deltas.append(r1 - r0)
+            r0 = r1
+        resident = sum(
+            e.padded for e in cache._entries.values())
+        assert deltas[0] == deltas[1], deltas
+        assert deltas[1] < resident, (deltas, resident)
+        assert counts[-1] >= counts[0]
+
+        # bit-exact parity with the host path over the grown store
+        ds2 = DataStore(str(tmp_path / "cat"), use_device_cache=False)
+        assert ds2.get_feature_source("t").get_count(q) == counts[-1]
+
+        # layout-invalidating change: an EXISTING partition's files
+        # move → the full host concat re-uploads (ownership is stale)
+        src.write(mk(30, 6))
+        n_final = src.get_count(q)
+        full_delta = cache.upload_rows - r0
+        assert full_delta > deltas[1], (full_delta, deltas)
+        # fresh host-path store AFTER the write (a pre-write instance
+        # would pin the older manifest)
+        ds3 = DataStore(str(tmp_path / "cat"), use_device_cache=False)
+        assert ds3.get_feature_source("t").get_count(q) == n_final
+
+
 class TestIncrementalSegments:
     """Round-3 (VERDICT #3): residency changes must not re-upload
     unchanged partition segments, and dict codes must stay consistent
