@@ -1,0 +1,311 @@
+"""Incremental lint engine: a content-hash cache that makes warm
+`gmtpu lint --incremental` runs (and the CI gate's repeated format
+renders) drop from a ~20-30s full analysis to well under a second on an
+unchanged tree, with findings **byte-identical** to a cold scan — the
+tests assert `render_json(cold) == render_json(incremental)` on warm,
+touched, and edited trees.
+
+Cache file: `.gmtpu-lintcache` at the repo root (JSON, written
+atomically tmp+`os.replace`, git-ignored). It stores, keyed on the
+sha256 of every file in the scan set AND the reference universe (the
+rest of the repo — GT05's liveness counts and the GT08 lock graph read
+it), plus the waiver file's hash and a config signature (rule selection,
+scan paths, cache schema):
+
+- the final post-pipeline findings (the warm-replay payload),
+- per-file findings of every *file-local* rule, pre file-waiver,
+- per-file `spmd.ModuleSummary` dicts (GT24-GT27's cross-file index
+  rebuilds from these for unchanged files instead of re-walking ASTs),
+- per-file GT05 reference-count summaries (the reference universe
+  rebuilds by summation instead of a fresh whole-repo AST walk).
+
+Three tiers, strictly ordered by how much changed:
+
+1. **Warm** — nothing changed anywhere: replay the cached final
+   findings. Zero parses, zero rule runs.
+2. **Partial** — some files changed but the project jit-def universe is
+   intact (`jit_sig` matches: the (name, file, file-hash) set of every
+   jit def — the only cross-file state the file-local rules consume):
+   re-parse the tree, then rerun file-local rules only on changed files
+   (cached findings replay for the rest), rebuild GT05 counts and SPMD
+   summaries from cache for unchanged files, and rerun the genuinely
+   cross-file rules (GT05/GT07-GT12/GT19/GT24-GT27) — whose per-module
+   output can change when *any* file changes — over everything. (The
+   concurrency index keeps AST anchors for its finding messages, so it
+   rebuilds from the fresh parse rather than from serialized summaries.)
+3. **Cold** — no cache, config changed, or the jit universe shifted:
+   the full pipeline, identical to `lint_paths`.
+
+Every non-warm run rewrites the cache, so the gate's sequence of
+text/json/sarif renders pays for one analysis, not three.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from geomesa_tpu.analysis.linter import (
+    _check_inline_waiver_tokens, _iter_py_files, build_project,
+    finalize_findings, find_repo_root, lint_paths, module_reference_counts,
+    resolve_waiver_file)
+from geomesa_tpu.analysis.model import Finding
+from geomesa_tpu.analysis.rules import ALL_RULES
+from geomesa_tpu.analysis.spmd import SPMD_SCHEMA, ModuleSummary
+
+__all__ = ["lint_paths_incremental", "DEFAULT_CACHE_FILENAME"]
+
+# bump on any change to what the cache stores or what the replay paths
+# assume — an old cache must fall through to a cold scan, never mis-replay
+CACHE_SCHEMA = 1
+
+DEFAULT_CACHE_FILENAME = ".gmtpu-lintcache"
+
+# Rules whose findings for a module depend ONLY on that module's source
+# plus the project jit-def universe (name -> JitDef; pinned by the
+# cache's jit_sig). Everything else — GT05 (reference universe),
+# GT07-GT12 (concurrency index), GT19 (registry index), GT24-GT27 (SPMD
+# call graph) — is cross-file: its per-module findings can change when a
+# DIFFERENT module changes, so those rules rerun on every non-warm run.
+PER_FILE_RULES = frozenset({
+    "GT01", "GT02", "GT03", "GT04", "GT06",
+    "GT13", "GT14", "GT15", "GT16", "GT17", "GT18",
+    "GT20", "GT21", "GT22", "GT23",
+})
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    try:
+        with open(path, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 16), b""):
+                h.update(chunk)
+    except OSError:
+        return ""
+    return h.hexdigest()
+
+
+def _hash_tree(paths: List[str],
+               repo_root: str) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """(scan hashes, reference hashes), both relpath -> sha256, walked
+    in exactly `build_project`'s order and dedup discipline so the cache
+    key covers precisely the files a cold scan would read."""
+
+    def rel(af: str) -> str:
+        return os.path.relpath(af, repo_root).replace(os.sep, "/")
+
+    scan: Dict[str, str] = {}
+    seen: Set[str] = set()
+    for p in paths:
+        for f in _iter_py_files(p):
+            af = os.path.abspath(f)
+            if af in seen:
+                continue
+            seen.add(af)
+            scan[rel(af)] = _sha256_file(af)
+    refs: Dict[str, str] = {}
+    for f in _iter_py_files(repo_root):
+        af = os.path.abspath(f)
+        if af in seen:
+            continue
+        seen.add(af)
+        refs[rel(af)] = _sha256_file(af)
+    return scan, refs
+
+
+def _config_sig(selected: List[str], paths: List[str]) -> str:
+    doc = {"schema": CACHE_SCHEMA, "rules": selected,
+           "paths": sorted(os.path.abspath(p) for p in paths)}
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode()).hexdigest()
+
+
+def _jit_signature(project, scan_hashes: Dict[str, str]) -> str:
+    """Pins the jit-def universe the file-local rules consult: every
+    JitDef is derived solely from its defining file, so the (name, file,
+    file-hash) set changing is exactly when a cached per-file finding
+    could go stale through `project.jit_by_name`."""
+    entries = []
+    for m in project.modules:
+        if not m.jit_defs:
+            continue
+        h = scan_hashes.get(m.relpath, "")
+        for jd in m.jit_defs:
+            entries.append(f"{jd.name}|{m.relpath}|{h}")
+    return hashlib.sha256("\n".join(sorted(entries)).encode()).hexdigest()
+
+
+def _finding_to(f: Finding) -> dict:
+    return {"rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+            "message": f.message, "severity": f.severity,
+            "waived": f.waived, "waived_by": f.waived_by}
+
+
+def _finding_from(d: dict) -> Finding:
+    return Finding(rule=d["rule"], path=d["path"], line=int(d["line"]),
+                   col=int(d["col"]), message=d["message"],
+                   severity=d.get("severity", "warn"),
+                   waived=bool(d.get("waived")),
+                   waived_by=d.get("waived_by", ""))
+
+
+def _load_cache(path: str) -> Optional[dict]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def _write_cache(path: str, doc: dict) -> None:
+    """Atomic (tmp+rename); a failure to persist is a slower next run,
+    never a wrong one — so it degrades silently."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        # gt: waive GT27
+        # (the lint cache is a per-checkout build artifact — multi-host
+        # runtimes never share it; CI runs lint on one box)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def lint_paths_incremental(paths: List[str],
+                           rules: Optional[List[str]] = None,
+                           waiver_file: Optional[str] = None,
+                           include_waived: bool = True,
+                           cache_path: Optional[str] = None,
+                           ) -> List[Finding]:
+    """Drop-in `lint_paths` with the content-hash cache (see module
+    docstring). Outside a repo root there is nowhere canonical to put
+    the cache — falls back to the cold path."""
+    paths = list(paths)
+    repo_root = find_repo_root(paths[0]) if paths else None
+    if repo_root is None:
+        return lint_paths(paths, rules=rules, waiver_file=waiver_file,
+                          include_waived=include_waived)
+    cache_path = cache_path or os.path.join(repo_root,
+                                            DEFAULT_CACHE_FILENAME)
+    selected = rules or sorted(ALL_RULES)
+    scan_hashes, ref_hashes = _hash_tree(paths, repo_root)
+    if not scan_hashes:
+        raise FileNotFoundError(
+            f"gmtpu-lint: no .py files found under {paths!r}")
+    wf = resolve_waiver_file(paths, waiver_file)
+    waiver_sha = _sha256_file(wf) if wf else ""
+    cfg = _config_sig(selected, paths)
+    cache = _load_cache(cache_path)
+    usable = (cache is not None
+              and cache.get("schema") == CACHE_SCHEMA
+              and cache.get("config") == cfg)
+
+    # -- tier 1: warm replay -----------------------------------------------
+    if (usable and cache.get("waiver_sha") == waiver_sha
+            and cache.get("files") == scan_hashes
+            and cache.get("ref_files") == ref_hashes):
+        findings = [_finding_from(d) for d in cache.get("findings", [])]
+        if not include_waived:
+            findings = [f for f in findings if not f.waived]
+        return findings
+
+    # -- tiers 2/3: re-parse, then reuse whatever is still valid -----------
+    project = build_project(paths, repo_root=repo_root)
+    if not project.modules:
+        raise FileNotFoundError(
+            f"gmtpu-lint: no .py files found under {paths!r}")
+    old_files = cache.get("files", {}) if usable else {}
+    old_refs = cache.get("ref_files", {}) if usable else {}
+    changed = {r for r, h in scan_hashes.items() if old_files.get(r) != h}
+    changed_refs = {r for r, h in ref_hashes.items()
+                    if old_refs.get(r) != h}
+
+    # SPMD summaries are pure per-file extractions — reusable for any
+    # unchanged file regardless of what else moved
+    if usable:
+        spmd_cached: Dict[str, ModuleSummary] = {}
+        for r, d in (cache.get("spmd") or {}).items():
+            if r in changed or r not in scan_hashes:
+                continue
+            if not isinstance(d, dict) or d.get("schema") != SPMD_SCHEMA:
+                continue
+            try:
+                spmd_cached[r] = ModuleSummary.from_dict(d)
+            except (KeyError, TypeError, ValueError):
+                continue
+        if spmd_cached:
+            project._gt_spmd_summaries = spmd_cached
+
+    jit_sig = _jit_signature(project, scan_hashes)
+    perfile_ok = usable and cache.get("jit_sig") == jit_sig
+
+    # GT05 reference universe: sum per-file count summaries (cached for
+    # unchanged files — valid only while the jit-name set is pinned by
+    # jit_sig — freshly counted for changed ones)
+    wanted = set(project.jit_by_name)
+    old_counts = cache.get("refcounts", {}) if perfile_ok else {}
+    refcounts: Dict[str, Dict[str, int]] = {}
+    total: Dict[str, int] = {}
+    for m in project.modules + project.ref_modules:
+        r = m.relpath
+        counts = None
+        if r not in changed and r not in changed_refs and r in old_counts:
+            c = old_counts[r]
+            if isinstance(c, dict):
+                counts = {k: int(v) for k, v in c.items()}
+        if counts is None:
+            counts = module_reference_counts(m, wanted)
+        refcounts[r] = counts
+        for k, v in counts.items():
+            total[k] = total.get(k, 0) + v
+    project._refs = total
+
+    cached_perfile = cache.get("perfile", {}) if perfile_ok else {}
+    findings: List[Finding] = []
+    new_perfile: Dict[str, Dict[str, List[dict]]] = {}
+    for mod in project.modules:
+        _check_inline_waiver_tokens(mod)
+        r = mod.relpath
+        slot = new_perfile.setdefault(r, {})
+        file_cache = cached_perfile.get(r, {})
+        for code in selected:
+            if (code in PER_FILE_RULES and r not in changed
+                    and code in file_cache):
+                fs = [_finding_from(d) for d in file_cache[code]]
+            else:
+                fs = []
+                for f in ALL_RULES[code](mod, project):
+                    if mod.is_waived(f.rule, f.line):
+                        f.waived = True
+                        f.waived_by = f"inline:{mod.relpath}:{f.line}"
+                    fs.append(f)
+            if code in PER_FILE_RULES:
+                # serialized NOW — pre file-waiver, post inline-waiver:
+                # exactly the state a replay must re-enter the pipeline in
+                slot[code] = [_finding_to(f) for f in fs]
+            findings.extend(fs)
+    finalize_findings(findings, paths, wf)
+
+    spmd_out = getattr(project, "_gt_spmd_summaries", None) or {}
+    _write_cache(cache_path, {
+        "schema": CACHE_SCHEMA,
+        "config": cfg,
+        "waiver_sha": waiver_sha,
+        "jit_sig": jit_sig,
+        "files": scan_hashes,
+        "ref_files": ref_hashes,
+        "findings": [_finding_to(f) for f in findings],
+        "perfile": new_perfile,
+        "refcounts": refcounts,
+        "spmd": {r: s.to_dict() for r, s in spmd_out.items()},
+    })
+    if not include_waived:
+        findings = [f for f in findings if not f.waived]
+    return findings

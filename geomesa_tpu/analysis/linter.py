@@ -50,35 +50,46 @@ class Project:
     def _count_references(self) -> Dict[str, int]:
         counts: Dict[str, int] = {}
         wanted = set(self.jit_by_name)
-
-        def bump(n: str) -> None:
-            if n in wanted:
-                counts[n] = counts.get(n, 0) + 1
-
         for m in self.modules + self.ref_modules:
-            for node in ast.walk(m.tree):
-                if isinstance(node, ast.Name) and isinstance(
-                        node.ctx, ast.Load):
-                    bump(node.id)
-                elif isinstance(node, ast.Attribute) and isinstance(
-                        node.ctx, ast.Load):
-                    bump(node.attr)
-                elif isinstance(node, ast.ImportFrom):
-                    for a in node.names:
-                        bump(a.name)
-                elif (isinstance(node, ast.Assign)
-                      and len(node.targets) == 1
-                      and isinstance(node.targets[0], ast.Name)
-                      and node.targets[0].id == "__all__"
-                      and isinstance(node.value, (ast.List, ast.Tuple))):
-                    for e in node.value.elts:
-                        if isinstance(e, ast.Constant) and isinstance(
-                                e.value, str):
-                            bump(e.value)
-        # a jitted def's own wrapping (`x = jax.jit(_fn)`) loads `_fn`,
-        # not `x`; decorated defs are not Name loads — no self-counts to
-        # subtract for the bound names themselves
+            for n, c in module_reference_counts(m, wanted).items():
+                counts[n] = counts.get(n, 0) + c
         return counts
+
+
+def module_reference_counts(m: ModInfo, wanted: Set[str]) -> Dict[str, int]:
+    """Per-module reference counts for `wanted` names (the GT05 liveness
+    universe). A module-scoped function (not a Project method) so the
+    incremental engine can cache one count dict per file and rebuild the
+    project total from cache for unchanged files."""
+    counts: Dict[str, int] = {}
+
+    def bump(n: str) -> None:
+        if n in wanted:
+            counts[n] = counts.get(n, 0) + 1
+
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load):
+            bump(node.id)
+        elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load):
+            bump(node.attr)
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                bump(a.name)
+        elif (isinstance(node, ast.Assign)
+              and len(node.targets) == 1
+              and isinstance(node.targets[0], ast.Name)
+              and node.targets[0].id == "__all__"
+              and isinstance(node.value, (ast.List, ast.Tuple))):
+            for e in node.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(
+                        e.value, str):
+                    bump(e.value)
+    # a jitted def's own wrapping (`x = jax.jit(_fn)`) loads `_fn`,
+    # not `x`; decorated defs are not Name loads — no self-counts to
+    # subtract for the bound names themselves
+    return counts
 
 
 def _iter_py_files(path: str) -> Iterable[str]:
@@ -175,12 +186,31 @@ def lint_paths(paths: List[str],
                     f.waived = True
                     f.waived_by = f"inline:{mod.relpath}:{f.line}"
                 findings.append(f)
-    entries, severities = [], {}
+    finalize_findings(findings, paths, waiver_file)
+    if not include_waived:
+        findings = [f for f in findings if not f.waived]
+    return findings
+
+
+def resolve_waiver_file(paths: List[str],
+                        waiver_file: Optional[str]) -> Optional[str]:
+    """An explicit waiver file wins; otherwise the repo-root default, if
+    present."""
     if waiver_file is None:
         root = find_repo_root(paths[0]) if paths else None
         cand = os.path.join(root, DEFAULT_WAIVER_FILENAME) if root else None
         if cand and os.path.exists(cand):
             waiver_file = cand
+    return waiver_file
+
+
+def finalize_findings(findings: List[Finding], paths: List[str],
+                      waiver_file: Optional[str]) -> List[Finding]:
+    """The post-merge tail of the pipeline: file waivers, severity
+    overrides, canonical sort. In-place; shared by the cold scan and the
+    incremental engine so both paths render byte-identically."""
+    entries, severities = [], {}
+    waiver_file = resolve_waiver_file(paths, waiver_file)
     if waiver_file:
         entries, severities = load_waiver_file(waiver_file)
     apply_file_waivers(findings, entries)
@@ -188,8 +218,6 @@ def lint_paths(paths: List[str],
         f.severity = severities.get(
             f.rule, RULES[f.rule].severity if f.rule in RULES else f.severity)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    if not include_waived:
-        findings = [f for f in findings if not f.waived]
     return findings
 
 
@@ -300,8 +328,18 @@ def run_cli(args) -> int:
         if unknown:
             raise ValueError(f"unknown rule(s): {', '.join(unknown)} "
                              f"(have {', '.join(sorted(ALL_RULES))})")
+    if getattr(args, "spmd", False):
+        # the SPMD pass subset (docs/ANALYSIS.md "Reading an SPMD
+        # report"); composes with --rules as a union
+        spmd_codes = [c for c in ("GT24", "GT25", "GT26", "GT27")
+                      if c in ALL_RULES]
+        rules = sorted(set(rules or []) | set(spmd_codes))
+    lint_fn = lint_paths
+    if getattr(args, "incremental", False):
+        from geomesa_tpu.analysis.incremental import lint_paths_incremental
+        lint_fn = lint_paths_incremental
     try:
-        findings = lint_paths(
+        findings = lint_fn(
             list(args.paths) or ["geomesa_tpu"],
             rules=rules,
             waiver_file=getattr(args, "waivers", None),
@@ -342,3 +380,12 @@ def add_lint_arguments(p) -> None:
                    help="output format (sarif: CI annotation surfaces)")
     p.add_argument("--show-waived", action="store_true",
                    help="include waived findings in text output")
+    p.add_argument("--incremental", action="store_true",
+                   help="use the content-hash lint cache "
+                        "(.gmtpu-lintcache at the repo root): an "
+                        "unchanged tree replays cached findings without "
+                        "re-parsing; findings are byte-identical to a "
+                        "cold scan")
+    p.add_argument("--spmd", action="store_true",
+                   help="run the interprocedural SPMD pass "
+                        "(GT24-GT27; union with --rules)")

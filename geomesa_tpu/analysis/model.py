@@ -112,6 +112,34 @@ RULES: Dict[str, Rule] = {
                      "to the completer's harvest, transfers to the "
                      "ring stager (docs/SERVING.md \"Persistent serve "
                      "loop\")"),
+        Rule("GT24", "unbound collective axis: a jax.lax collective "
+                     "(psum/all_gather/ppermute/axis_index/...) whose "
+                     "axis name no enclosing shard_map/pjit wrap and no "
+                     "calling context binds — traces only under a mesh "
+                     "that defines the axis; on a pod it fails or hangs "
+                     "at first dispatch"),
+        Rule("GT25", "process-divergent control flow: a branch on "
+                     "jax.process_index()/process_count() or an "
+                     "os.environ read whose arms differ in collective-"
+                     "relevant effects (collectives issued, "
+                     "jax.config.update) on a distributed-reachable "
+                     "path — processes take different sides and the "
+                     "pod's collective sequences stop matching (the "
+                     "static deadlock detector; CPU CI runs one "
+                     "process and can never see it)"),
+        Rule("GT26", "sharding-spec drift: in_specs/out_specs/"
+                     "PartitionSpec/NamedSharding naming a mesh axis "
+                     "the constructing mesh (or any project mesh) does "
+                     "not define, or a literal in_specs tuple whose "
+                     "arity disagrees with the mapped function's "
+                     "positional parameters"),
+        Rule("GT27", "ungated process-local side effect: an atomic "
+                     "persist (tmp + os.replace) or port bind on a "
+                     "multi-process-reachable path (parallel//store//"
+                     "compilecache//serve//telemetry//approx/ scope) "
+                     "without a parallel.is_coordinator()/"
+                     "process_index()==0 gate — every host of a pod "
+                     "performs it against shared storage"),
     )
 }
 

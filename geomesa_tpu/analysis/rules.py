@@ -1538,6 +1538,7 @@ def gt23(mod: ModInfo, project) -> Iterator[Finding]:
 
 from geomesa_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES)
+from geomesa_tpu.analysis.spmd import SPMD_RULES  # noqa: E402
 
 ALL_RULES = {
     "GT01": gt01, "GT02": gt02, "GT03": gt03,
@@ -1546,4 +1547,5 @@ ALL_RULES = {
     "GT17": gt17, "GT18": gt18, "GT19": gt19, "GT20": gt20,
     "GT21": gt21, "GT22": gt22, "GT23": gt23,
     **CONCURRENCY_RULES,
+    **SPMD_RULES,
 }

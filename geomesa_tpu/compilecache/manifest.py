@@ -144,6 +144,13 @@ class WarmupManifest:
     def save(self, path: str) -> None:
         from geomesa_tpu.faults import RetryPolicy, retry_call
         from geomesa_tpu.faults import harness as _faults
+        from geomesa_tpu.parallel.distributed import is_coordinator
+
+        if not is_coordinator():
+            # SPMD compiles identical programs on every host, so the
+            # warmup manifests would match byte-for-byte — one writer
+            # keeps shared cache dirs race-free (GT27)
+            return
 
         def attempt():
             _faults.inject("compilecache.manifest.write")

@@ -8,6 +8,7 @@ replacing client-coordinated fan-in merges. There is no NCCL/MPI: ICI/DCN via
 XLA is the whole communication backend (SURVEY.md §5.8).
 """
 
+from geomesa_tpu.parallel.distributed import is_coordinator
 from geomesa_tpu.parallel.mesh import (
     SHARD_AXIS,
     default_mesh,
@@ -19,6 +20,7 @@ from geomesa_tpu.parallel.mesh import (
 __all__ = [
     "SHARD_AXIS",
     "default_mesh",
+    "is_coordinator",
     "shard_device_batch",
     "shard_batch_host",
     "replicated",

@@ -121,6 +121,13 @@ def run_worker(coordinator: str, num_processes: int, process_id: int) -> None:
         num_processes=num_processes,
         process_id=process_id,
     )
+    # before any real kernel: prove every process compiled from the same
+    # program-shaping config (x64 knobs, jax version) — divergent env
+    # across hosts deadlocks at the first psum, invisibly (GT25); this
+    # check fails loudly instead
+    from geomesa_tpu.parallel.distributed import assert_uniform_runtime
+
+    assert_uniform_runtime()
     smoke_step()
 
 

@@ -570,6 +570,14 @@ class DeviceCacheManager:
 
     @_locked
     def save_manifest(self) -> None:
+        from geomesa_tpu.parallel.distributed import is_coordinator
+
+        if not is_coordinator():
+            # multi-host: residency is globally consistent (every host
+            # computes the same superbatch layout), so the manifests
+            # would be byte-identical — one writer is the contract
+            # anyway (GT27)
+            return
         doc = {
             "layout_version": LAYOUT_VERSION,
             "coord_dtype": str(np.dtype(self.coord_dtype).name)

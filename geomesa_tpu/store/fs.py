@@ -217,6 +217,15 @@ class FileSystemStorage:
         hold self._lock so the json serialization sees one consistent
         manifest (a concurrent append would otherwise blow up the dict
         iteration); `create` runs before the store is shared."""
+        from geomesa_tpu.parallel.distributed import is_coordinator
+
+        if not is_coordinator():
+            # multi-host runtimes READ the FS store (each host feeds
+            # from its process_partitions slice); mutation is single-
+            # writer before serving. The gate keeps a non-coordinator
+            # host from clobbering the shared manifest with its
+            # partial view of the partition set (GT27)
+            return
         meta = {
             "version": 1,
             "name": self.sft.name,

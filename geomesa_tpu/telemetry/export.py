@@ -289,6 +289,10 @@ class MetricsServer:
             def log_message(self, fmt, *args):  # quiet: stderr is for
                 pass                            # the serve loop's use
 
+        # gt: waive GT27
+        # (deliberate per-process bind: every host of a pod exposes its
+        # own metrics endpoint — scrape configs enumerate hosts; the
+        # one-box multi-process smoke does not start the exporter)
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]

@@ -136,10 +136,19 @@ class FlightRecorder:
             doc["reason"] = reason
         doc["pid"] = os.getpid()
         doc["dumped_at"] = time.time()
+        from geomesa_tpu.parallel.distributed import process_suffix
+
         path = path or self._default_dump_path()
+        root, ext = os.path.splitext(path)
+        # a flight dump is per-host forensics — a coordinator gate would
+        # throw away every other host's evidence, so instead each
+        # process writes its own file on a pod (single-process: no-op)
+        path = f"{root}{process_suffix()}{ext}"
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(doc, f)
+        # gt: waive GT27
+        # (targets are disjoint per process via process_suffix() above)
         os.replace(tmp, path)
         return path
 

@@ -112,6 +112,12 @@ def baseline_from_profile(profile: dict,
 
 
 def save_baseline(path: str, doc: dict) -> str:
+    from geomesa_tpu.parallel.distributed import is_coordinator
+
+    if not is_coordinator():
+        # multi-host: one BASELINE file, one writer (GT27) — verdicts
+        # compare against shared history, which process 0 curates
+        return path
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)

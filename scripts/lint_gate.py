@@ -5,10 +5,19 @@ Runs EVERY registered rule — the JAX hazards GT01..GT06, the concurrency
 pass GT07..GT12 (lock discipline, lock-order cycles, blocking-under-lock,
 per-call locks, callback-under-lock, unguarded shared state), the
 serving-hot-path rule GT13 and the robustness rule GT14 (swallowed
-errors / unbounded retry loops at the store/kafka/serve boundaries) —
-and exits nonzero on any unwaived finding,
-printing each with file:line and rule code. In text mode a clean lint is
-followed by three smokes: the warmup smoke (`gmtpu warmup --check`
+errors / unbounded retry loops at the store/kafka/serve boundaries),
+and the interprocedural SPMD pass GT24..GT27 (unbound collective axes,
+process-divergent control flow, sharding-spec drift, ungated process-
+local side effects — docs/ANALYSIS.md "Reading an SPMD report") —
+and exits nonzero on any unwaived finding, printing each with file:line
+and rule code. The lint itself runs through the incremental engine
+(analysis/incremental.py): warm runs on an unchanged tree replay the
+content-hash cache in well under a second, with findings byte-identical
+to a cold scan. In text mode a clean lint is
+followed by the smokes: the spmd smoke (lint a known-dirty miniature
+repo fixture, require all four SPMD rules to fire and the gate verdict
+to go nonzero — the pass itself stays honest), the warmup smoke
+(`gmtpu warmup --check`
 semantics against the committed fixture manifest on CPU, proving the
 manifest record→replay→check loop stays green), the chaos smoke
 (`gmtpu chaos --check` semantics replaying scripts/chaos_smoke_plan.json
@@ -22,9 +31,9 @@ docs/OBSERVABILITY.md "Sentinel"). Rides the tier-1 pytest run via
 tests/test_lint_gate.py and is runnable standalone:
 
     python scripts/lint_gate.py [--format json|sarif]
-        [--no-warmup-smoke] [--no-chaos-smoke] [--no-telemetry-smoke]
-        [--no-sentinel-smoke] [--no-fleet-smoke] [--no-approx-smoke]
-        [--no-wire-smoke]
+        [--no-spmd-smoke] [--no-warmup-smoke] [--no-chaos-smoke]
+        [--no-telemetry-smoke] [--no-sentinel-smoke] [--no-fleet-smoke]
+        [--no-approx-smoke] [--no-wire-smoke]
 
 Rule catalog + waiver syntax: docs/ANALYSIS.md.
 """
@@ -709,6 +718,77 @@ def ring_smoke() -> int:
     return 1 if failures else 0
 
 
+def spmd_smoke() -> int:
+    """Prove the SPMD pass still bites: lint a known-dirty fixture — a
+    miniature repo skeleton (pyproject.toml + geomesa_tpu/parallel/
+    launch.py, so the multi-process reachability and path scoping are
+    exercised for real) seeded with one true positive per rule — and
+    require the gate verdict to go nonzero with ALL FOUR rules firing.
+    Pure AST analysis: no jax import, runs in milliseconds. Guards
+    against the pass silently going blind (a refactor that stops a rule
+    matching would otherwise read as a cleaner tree)."""
+    import tempfile
+    import textwrap
+
+    from geomesa_tpu.analysis.linter import exit_code, lint_paths
+
+    dirty = textwrap.dedent('''\
+        import os
+
+        import jax
+        import numpy as np
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+        def merge(x):
+            return lax.psum(x, "shard")  # GT24: axis bound nowhere
+
+
+        def kernel(a):
+            return lax.psum(a, "data")
+
+
+        def run():
+            mesh = Mesh(np.array(jax.devices()), ("data",))
+            spec = NamedSharding(mesh, P("ghost"))  # GT26: axis drift
+            wrapped = shard_map(kernel, mesh=mesh,
+                                in_specs=(P("data"), P("data")),
+                                out_specs=P("data"))  # GT26: arity
+            if jax.process_index() == 0:  # GT25: divergent programs
+                jax.config.update("jax_enable_x64", True)
+            return wrapped, spec
+
+
+        def persist(path, doc):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(doc)
+            os.replace(tmp, path)  # GT27: ungated persist
+        ''')
+    want = {"GT24", "GT25", "GT26", "GT27"}
+    with tempfile.TemporaryDirectory() as tmp:
+        with open(os.path.join(tmp, "pyproject.toml"), "w") as fh:
+            fh.write("[project]\nname = \"spmd-smoke\"\n")
+        pkg = os.path.join(tmp, "geomesa_tpu", "parallel")
+        os.makedirs(pkg)
+        with open(os.path.join(pkg, "launch.py"), "w") as fh:
+            fh.write(dirty)
+        findings = lint_paths([os.path.join(tmp, "geomesa_tpu")],
+                              rules=sorted(want), extra_ref_paths=[])
+        fired = {f.rule for f in findings if not f.waived}
+        rc = exit_code(findings, "warn")
+    missing = sorted(want - fired)
+    print(f"spmd smoke: {len(findings)} finding(s) on the dirty "
+          f"fixture, rules fired: {sorted(fired)}", file=sys.stderr)
+    if rc == 0 or missing:
+        print(f"spmd smoke: FAIL the dirty fixture must trip the gate "
+              f"(exit {rc}, missing {missing})", file=sys.stderr)
+        return 1
+    return 0
+
+
 def warmup_smoke(manifest_path: str = SMOKE_MANIFEST) -> int:
     """`gmtpu warmup --check` against the fixture manifest, pinned to
     CPU (the fixture records interpret-mode kernels; this gate must run
@@ -741,12 +821,17 @@ def warmup_smoke(manifest_path: str = SMOKE_MANIFEST) -> int:
 
 
 def main(argv=None) -> int:
+    from geomesa_tpu.analysis.incremental import lint_paths_incremental
     from geomesa_tpu.analysis.linter import (
-        exit_code, lint_paths, render_json, render_sarif, render_text)
+        exit_code, render_json, render_sarif, render_text)
 
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--format", default="text",
                    choices=["text", "json", "sarif"])
+    p.add_argument("--no-spmd-smoke", action="store_true",
+                   help="skip the SPMD-pass smoke (known-dirty fixture "
+                        "must fire GT24..GT27 and trip the gate; text "
+                        "mode only)")
     p.add_argument("--no-warmup-smoke", action="store_true",
                    help="skip the warmup-manifest smoke (it runs only "
                         "in text mode; json/sarif stdout stays pure)")
@@ -783,7 +868,12 @@ def main(argv=None) -> int:
                         "dispatches_per_window strictly below the "
                         "pipelined baseline; text mode only)")
     args = p.parse_args(argv)
-    findings = lint_paths([os.path.join(REPO_ROOT, "geomesa_tpu")])
+    # incremental: a warm cache replays findings byte-identical to a
+    # cold scan (asserted by tests/test_analysis_spmd.py), so repeated
+    # gate runs — and the json/sarif renders CI takes after a green
+    # text run — pay for one analysis, not one per invocation
+    findings = lint_paths_incremental(
+        [os.path.join(REPO_ROOT, "geomesa_tpu")])
     if args.format == "json":
         print(render_json(findings))
     elif args.format == "sarif":
@@ -791,6 +881,8 @@ def main(argv=None) -> int:
     else:
         print(render_text(findings))
     rc = exit_code(findings, "warn")
+    if args.format == "text" and not args.no_spmd_smoke and rc == 0:
+        rc = spmd_smoke()
     if args.format == "text" and not args.no_warmup_smoke and rc == 0:
         rc = warmup_smoke()
     if args.format == "text" and not args.no_chaos_smoke and rc == 0:
