@@ -1,0 +1,1192 @@
+"""Interprocedural dtype/shape-provenance dataflow pass: GT28..GT31.
+
+The serve stack's two hardest invariants are enforced at runtime only
+(JitTracker recompile counters, f64 parity tests): **zero recompiles on
+the hot path** — every array reaching a jit/AOT/ring dispatch must have
+a *bucketed* shape (pad_to / next_pow2 / stack_queries / a registry
+bucket key), never a raw request-determined one — and **bit-exact f64
+answers over f32 kernels** — final distances come from the canonical
+host-side f64 recompute over the *original* f64 inputs, never from
+upcasting an already-rounded f32 value. Both break invisibly on CPU CI:
+a raw shape only storms the compile cache under real traffic, and an
+f32→f64 launder only drifts an ulp.
+
+This pass is the static closer. It runs an abstract interpreter over
+each module (pure AST, shared `modinfo`/`spmd` project index — the code
+under analysis is never imported) assigning every array-producing
+expression a provenance value:
+
+- shape origin: ``raw`` (len(), np.asarray over wire payloads,
+  np.frombuffer, np.concatenate of request lists) vs ``bucketed``
+  (next_pow2 / pad_to / stack_queries / registry bucket keys);
+- dtype origin: ``f64`` (exact), ``f32`` (cast — sticky: upcasting
+  later does NOT clear it), ``weak`` (python literals);
+- transfer origin: ``host`` (a jax.device_get result).
+
+Provenance propagates through assignments, tuple/dict packing, staging
+seams (slot writes `self._slots[i] = x`, batcher stacks), and calls:
+per-function summaries record parameter-passthrough return provenance
+(``param:<name>`` / ``call:<target>`` markers), and a project index —
+built on the SPMD extractor's import/call-resolution machinery and the
+same caller-propagation discipline as `SpmdIndex.func_bound` — resolves
+the markers across module boundaries, summary-based and depth-bounded.
+
+Rules riding the lattice:
+
+- **GT28** — a raw (unbucketed) dynamic shape reaching a jit/AOT/ring
+  dispatch in serve//plan//subscribe//engine/ scope: the static
+  recompile-storm detector.
+- **GT29** — an f32-cast value flowing into an exact-f64 consumer (an
+  `.astype(float64)` / `np.asarray(x, np.float64)` upcast, or a callee
+  parameter named `*_f64`) without passing the canonical f64 recompute:
+  upcasting rounded f32 restores nothing — the value keeps its sticky
+  ``f32`` tag and the report's provenance chain walks back to the cast.
+- **GT30** — an AOT/ring registry lookup whose literal key names a
+  variant (`@serve` / `@ring<depth>` / `@mesh...`) no
+  `registry.register`/`serve_variant`/`ring_variant`/`mesh_variant`
+  site in the project (scan set *or* reference universe) can produce —
+  GT13 made interprocedural: the warmup manifest can never warm that
+  caller; first traffic pays a KeyError or an inline compile.
+- **GT31** — a device→host→device bounce: a `jax.device_get` result
+  transitively re-entering `device_put` or a dispatch — two transfers
+  where zero were needed.
+
+Findings carry their provenance chain in `Finding.extra["chain"]`
+(`[{path, line, note}, ...]`), rendered as SARIF `relatedLocations` so
+a CI annotation walks from the sink to the leak's origin.
+
+Summaries are plain-dict serializable (`ModuleFlow.to_dict`/`from_dict`)
+so the incremental cache persists them per file like the SPMD
+summaries, and the cross-file index rebuilds for unchanged files
+without re-walking their ASTs (analysis/incremental.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from geomesa_tpu.analysis.model import Finding
+from geomesa_tpu.analysis.modinfo import ModInfo
+from geomesa_tpu.analysis.spmd import (
+    _Extractor as _SpmdExtractor, _dotted, _terminal)
+
+# bump when the summary shape changes: cached summaries from an older
+# engine must not feed the index (analysis/incremental.py keys on this)
+DATAFLOW_SCHEMA = 1
+
+# hot-path scope for the shape/transfer rules (GT28/GT31): the serving
+# pipeline. One-shot scripts and tests dispatch raw shapes legitimately.
+_HOT_PREFIXES = ("geomesa_tpu/serve/", "geomesa_tpu/plan/",
+                 "geomesa_tpu/subscribe/", "geomesa_tpu/engine/")
+
+# shape bucketers: calls that quantize a dynamic extent onto the small
+# static set the warmup manifests cover
+_BUCKET_FNS = {"next_pow2", "_next_pow2", "pad_to", "stack_queries",
+               "capacity_bucket", "bucket_capacity", "round_up_pow2"}
+
+# numpy/jnp constructors whose result shape is the (dynamic) input's
+_RAW_MAKERS = {"asarray", "array", "frombuffer", "fromiter",
+               "ascontiguousarray", "concatenate", "stack",
+               "column_stack", "vstack", "hstack"}
+
+# constructors whose shape comes from their first (extent) argument
+_EXTENT_MAKERS = {"zeros", "ones", "full", "empty", "arange"}
+
+# provenance-preserving builtins/ufuncs (shape math over extents)
+_PASSTHROUGH_FNS = {"int", "max", "min", "abs", "round", "float"}
+
+_REG_APIS = ("register", "serve_variant", "ring_variant", "mesh_variant")
+
+
+def _terminal_name(target: str) -> str:
+    """Tail identifier of a resolved callee ('pkg/mod:a.b' -> 'b')."""
+    return target.rsplit(":", 1)[-1].rsplit(".", 1)[-1]
+
+
+def _is_registry_recv(node: ast.AST) -> bool:
+    """`registry.compile(...)` receivers: the shared ExecutableRegistry
+    and its conventional aliases — NOT `re.compile` / builtins."""
+    t = _terminal(node)
+    return bool(t) and (t == "registry" or t.endswith("registry")
+                        or t in ("aot", "reg", "_reg"))
+
+
+def _dtype_tag(node: Optional[ast.AST]) -> Optional[str]:
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value
+    else:
+        name = (_dotted(node) or _terminal(node) or "").split(".")[-1]
+    if name in ("float64", "double", "f64"):
+        return "f64"
+    if name in ("float32", "float16", "bfloat16", "f32"):
+        return "f32"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-module summary model (dict-serializable for the incremental cache)
+# ---------------------------------------------------------------------------
+
+# A provenance value is serialized as `[tags, chain]`: `tags` a sorted
+# list of markers ("raw"/"bucketed"/"f32"/"f64"/"weak"/"host"/
+# "aot-handle"/"param:<name>"/"call:<target>"/"regname:<key>"), `chain`
+# a list of `[line, note]` origin steps (capped — a report needs the
+# leak site, not a trace).
+
+
+@dataclass
+class FlowSite:
+    """A consumer site the rules examine: a resolved call with tagged
+    arguments, an AOT compile/call, a device_put, or an f64 upcast."""
+    line: int
+    col: int
+    fn: str                      # enclosing function qname or "<module>"
+    kind: str                    # "call"|"aot_compile"|"aot_call"|
+    #                              "device_put"|"f64cast"
+    target: str = ""             # resolved callee (summary-local) or ""
+    terminal: str = ""           # terminal callee name (jit_by_name key)
+    name: str = ""               # literal registry key for aot_compile
+    args: List[list] = field(default_factory=list)
+    kwargs: Dict[str, list] = field(default_factory=dict)
+
+
+@dataclass
+class FuncFlow:
+    qname: str
+    line: int
+    params: List[str] = field(default_factory=list)
+    returns: List[str] = field(default_factory=list)
+    ret_chain: List[list] = field(default_factory=list)
+
+
+@dataclass
+class ModuleFlow:
+    schema: int
+    relpath: str
+    module: str
+    import_names: Dict[str, str] = field(default_factory=dict)
+    functions: Dict[str, FuncFlow] = field(default_factory=dict)
+    sites: List[FlowSite] = field(default_factory=list)
+    regs: List[list] = field(default_factory=list)
+    #      [api, name|None, depth|None, line]
+
+    def to_dict(self) -> dict:
+        def enc(obj):
+            if isinstance(obj, (FlowSite, FuncFlow)):
+                return {k: enc(v) for k, v in vars(obj).items()}
+            if isinstance(obj, (list, tuple)):
+                return [enc(v) for v in obj]
+            if isinstance(obj, dict):
+                return {k: enc(v) for k, v in obj.items()}
+            return obj
+        return enc(vars(self))
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModuleFlow":
+        d = dict(d)
+        d["functions"] = {k: FuncFlow(**v)
+                          for k, v in d["functions"].items()}
+        d["sites"] = [FlowSite(**s) for s in d["sites"]]
+        return cls(**d)
+
+
+# ---------------------------------------------------------------------------
+# extraction: one abstract-interpretation walk per module
+# ---------------------------------------------------------------------------
+
+_Val = Tuple[Set[str], List[list]]
+
+_CHAIN_CAP = 5
+
+
+def _val(tags: Set[str], chain: List[list]) -> _Val:
+    return tags, chain[:_CHAIN_CAP]
+
+
+def _union(vals) -> _Val:
+    tags: Set[str] = set()
+    chain: List[list] = []
+    for t, c in vals:
+        tags |= t
+        for step in c:
+            if step not in chain:
+                chain.append(step)
+    return _val(tags, chain)
+
+
+def collect_registrations(tree: ast.AST) -> List[list]:
+    """Registry key registrations from a raw AST: `[api, name, depth,
+    line]` rows (name/depth None when not statically literal), plus an
+    `install_defaults` wildcard row. Shared by the extractor and the
+    rule-time reference-universe sweep (GT30 must see registrations in
+    modules outside the scan set — the GT05 discipline)."""
+    consts: Dict[str, str] = {}
+    body = getattr(tree, "body", ())
+    for node in body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            consts[node.targets[0].id] = node.value.value
+    out: List[list] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        term = _terminal(node.func)
+        if term == "install_defaults":
+            out.append(["install_defaults", None, None, node.lineno])
+            continue
+        if term not in _REG_APIS or not isinstance(node.func,
+                                                   ast.Attribute):
+            continue
+        name = None
+        if node.args:
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                name = a0.value
+            elif isinstance(a0, ast.Name):
+                name = consts.get(a0.id)
+        depth = None
+        if term == "ring_variant" and len(node.args) >= 2:
+            d = node.args[1]
+            if isinstance(d, ast.Constant) and isinstance(d.value, int):
+                depth = d.value
+        out.append([term, name, depth, node.lineno])
+    return out
+
+
+class _FlowExtractor:
+    """Two abstract-interpretation passes over one module (the second
+    pass sees class-attribute provenance collected by the first, so
+    staging seams like `self._slots[i] = qx` propagate across methods);
+    sites are recorded on the final pass only."""
+
+    def __init__(self, mod: ModInfo):
+        self.mod = mod
+        self.base = _SpmdExtractor(mod)
+        self.base._collect_imports()
+        self.base._collect_axis_constants()
+        self.base._collect_functions()
+        self.flow = ModuleFlow(
+            schema=DATAFLOW_SCHEMA, relpath=mod.relpath,
+            module=self.base.module,
+            import_names=dict(self.base.summary.import_names))
+        self._class_attrs: Dict[Tuple[str, str], _Val] = {}
+        self._module_env: Dict[str, _Val] = {}
+        self._record = False
+        self._cur_fn = "<module>"
+        self._cur_cls: Optional[str] = None
+        self._cur_ret: Optional[_Val] = None
+
+    # -- driver -------------------------------------------------------------
+
+    def run(self) -> ModuleFlow:
+        for record in (False, True):
+            self._record = record
+            self.flow.sites = []
+            self.flow.regs = collect_registrations(self.mod.tree)
+            self.flow.functions = {}
+            self._cur_fn, self._cur_cls = "<module>", None
+            self._module_env = {}
+            self._cur_ret = None
+            self._flow_body(self.mod.tree.body, self._module_env)
+            for fn_node, q in self.base._qname_of.items():
+                self._flow_function(fn_node, q)
+        return self.flow
+
+    def _flow_function(self, fn_node: ast.AST, qname: str) -> None:
+        self._cur_fn = qname
+        self._cur_cls = self.base._class_of.get(fn_node)
+        a = fn_node.args
+        params = [p.arg for p in a.posonlyargs + a.args]
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]
+        kwonly = [p.arg for p in a.kwonlyargs]
+        env: Dict[str, _Val] = {
+            p: ({f"param:{p}"}, []) for p in params + kwonly}
+        # closure captures: a nested def reading an enclosing function's
+        # parameter gets a marker (not "untagged", which would make a
+        # downstream np.asarray default to raw). The marker resolves
+        # against the NESTED function's callers — which never bind it —
+        # so it joins to empty: conservative no-fire, matching the
+        # analysis's no-callers policy for unknowable provenance.
+        outer = self.mod.enclosing_function(fn_node)
+        while outer is not None:
+            oa = outer.args
+            for p in oa.posonlyargs + oa.args + oa.kwonlyargs:
+                if p.arg not in env and p.arg not in ("self", "cls"):
+                    env[p.arg] = ({f"param:{p.arg}"}, [])
+            outer = self.mod.enclosing_function(outer)
+        self._cur_ret = (set(), [])
+        self._flow_body(fn_node.body, env)
+        if self._record:
+            tags, chain = self._cur_ret
+            self.flow.functions[qname] = FuncFlow(
+                qname=qname, line=fn_node.lineno,
+                params=params + kwonly,
+                returns=sorted(tags), ret_chain=chain[:_CHAIN_CAP])
+        self._cur_ret = None
+
+    # -- statements ----------------------------------------------------------
+
+    def _flow_body(self, stmts, env: Dict[str, _Val]) -> None:
+        for st in stmts:
+            self._flow_stmt(st, env)
+
+    def _flow_stmt(self, st: ast.stmt, env: Dict[str, _Val]) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # own entry in the function table / class walk
+        if isinstance(st, ast.Assign):
+            v = self._eval(st.value, env)
+            for t in st.targets:
+                self._assign(t, v, env)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._assign(st.target, self._eval(st.value, env), env)
+        elif isinstance(st, ast.AugAssign):
+            v = self._eval(st.value, env)
+            key = self._target_key(st.target)
+            if key is not None:
+                env[key] = _union([env.get(key, (set(), [])), v])
+        elif isinstance(st, ast.Return):
+            if st.value is not None:
+                v = self._eval(st.value, env)
+                if self._cur_ret is not None:
+                    self._cur_ret = _union([self._cur_ret, v])
+        elif isinstance(st, ast.Expr):
+            self._eval(st.value, env)
+        elif isinstance(st, ast.If):
+            self._eval(st.test, env)
+            self._flow_body(st.body, env)
+            self._flow_body(st.orelse, env)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            it = self._eval(st.iter, env)
+            self._assign(st.target, it, env)
+            self._flow_body(st.body, env)
+            self._flow_body(st.orelse, env)
+        elif isinstance(st, ast.While):
+            self._eval(st.test, env)
+            self._flow_body(st.body, env)
+            self._flow_body(st.orelse, env)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                v = self._eval(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, v, env)
+            self._flow_body(st.body, env)
+        elif isinstance(st, ast.Try):
+            self._flow_body(st.body, env)
+            for h in st.handlers:
+                self._flow_body(h.body, env)
+            self._flow_body(st.orelse, env)
+            self._flow_body(st.finalbody, env)
+        elif isinstance(st, ast.Raise) and st.exc is not None:
+            self._eval(st.exc, env)
+
+    def _target_key(self, t: ast.AST) -> Optional[str]:
+        if isinstance(t, ast.Name):
+            return t.id
+        if isinstance(t, ast.Attribute):
+            return _dotted(t)
+        return None
+
+    def _assign(self, t: ast.AST, v: _Val, env: Dict[str, _Val]) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._assign(e, v, env)
+            return
+        if isinstance(t, ast.Starred):
+            self._assign(t.value, v, env)
+            return
+        if isinstance(t, ast.Subscript):
+            # staging seam: a slot write (`slots[i] = qx`,
+            # `self._ring[slot] = staged`) taints the container
+            key = self._target_key(t.value)
+            if key is not None:
+                env[key] = _union([env.get(key, (set(), [])), v])
+            return
+        key = self._target_key(t)
+        if key is None:
+            return
+        env[key] = v
+        if (isinstance(t, ast.Attribute) and self._cur_cls
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            ck = (self._cur_cls, t.attr)
+            self._class_attrs[ck] = _union(
+                [self._class_attrs.get(ck, (set(), [])), v])
+
+    # -- expressions ---------------------------------------------------------
+
+    def _eval(self, node: ast.AST, env: Dict[str, _Val]) -> _Val:
+        if isinstance(node, ast.Name):
+            if node.id in env:
+                return env[node.id]
+            return self._module_env.get(node.id, (set(), []))
+        if isinstance(node, ast.Attribute):
+            d = _dotted(node)
+            if d is not None and d in env:
+                return env[d]
+            if (self._cur_cls and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                ca = self._class_attrs.get((self._cur_cls, node.attr))
+                if ca is not None:
+                    return ca
+            recv = self._eval(node.value, env)
+            if node.attr == "shape":
+                return (recv[0] & {"raw", "bucketed"}, recv[1])
+            return recv
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env)
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, float):
+                return ({"weak"}, [])
+            return (set(), [])
+        if isinstance(node, ast.BinOp):
+            return _union([self._eval(node.left, env),
+                           self._eval(node.right, env)])
+        if isinstance(node, ast.BoolOp):
+            return _union([self._eval(v, env) for v in node.values])
+        if isinstance(node, (ast.UnaryOp, ast.Await, ast.Starred)):
+            inner = getattr(node, "operand", None) or node.value
+            return self._eval(inner, env)
+        if isinstance(node, ast.Compare):
+            return _union([self._eval(node.left, env)]
+                          + [self._eval(c, env) for c in node.comparators])
+        if isinstance(node, ast.Subscript):
+            v = self._eval(node.value, env)
+            self._eval(node.slice, env)
+            return v
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return _union([self._eval(e, env) for e in node.elts])
+        if isinstance(node, ast.Dict):
+            return _union([self._eval(v, env)
+                           for v in node.values if v is not None])
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            for g in node.generators:
+                self._eval(g.iter, env)
+            return self._eval(node.elt, env)
+        if isinstance(node, ast.DictComp):
+            for g in node.generators:
+                self._eval(g.iter, env)
+            return self._eval(node.value, env)
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, env)
+            return _union([self._eval(node.body, env),
+                           self._eval(node.orelse, env)])
+        if isinstance(node, ast.NamedExpr):
+            v = self._eval(node.value, env)
+            self._assign(node.target, v, env)
+            return v
+        return (set(), [])
+
+    def _np_like(self, func: ast.AST) -> bool:
+        if not isinstance(func, ast.Attribute):
+            return False
+        if self.mod.is_numpy_ref(func.value) or \
+                self.mod.is_jnp_ref(func.value):
+            return True
+        base = _terminal(func.value)
+        return base in ("np", "numpy", "jnp")
+
+    def _apply_dtype(self, tags: Set[str], chain: List[list],
+                     dt: Optional[str], line: int) -> None:
+        if dt == "f64":
+            tags.add("f64")
+        elif dt == "f32":
+            tags.discard("f64")
+            tags.add("f32")
+            chain.append([line, "f32 cast"])
+
+    def _site(self, node: ast.Call, kind: str, args: List[_Val],
+              kwargs: Dict[str, _Val], target: str = "",
+              terminal: str = "", name: str = "") -> None:
+        if not self._record:
+            return
+        self.flow.sites.append(FlowSite(
+            line=node.lineno, col=node.col_offset, fn=self._cur_fn,
+            kind=kind, target=target, terminal=terminal, name=name,
+            args=[[sorted(t), c] for t, c in args],
+            kwargs={k: [sorted(t), c] for k, (t, c) in kwargs.items()}))
+
+    def _eval_call(self, node: ast.Call, env: Dict[str, _Val]) -> _Val:
+        line = node.lineno
+        args: List[_Val] = []
+        for a in node.args:
+            args.append(self._eval(
+                a.value if isinstance(a, ast.Starred) else a, env))
+        kwargs: Dict[str, _Val] = {}
+        for kw in node.keywords:
+            v = self._eval(kw.value, env)
+            if kw.arg:
+                kwargs[kw.arg] = v
+        term = _terminal(node.func) or ""
+        dt_kw = None
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                dt_kw = _dtype_tag(kw.value)
+
+        # registry registrations + variant constructors (GT30 universe).
+        # Variant constructors return the composed key: tag it so a
+        # later `registry.compile(vname, ...)` resolves the literal.
+        if term in _REG_APIS and isinstance(node.func, ast.Attribute):
+            name = None
+            if node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) and isinstance(
+                        a0.value, str):
+                    name = a0.value
+                elif isinstance(a0, ast.Name):
+                    name = self.base.summary.axis_constants.get(a0.id)
+                if name is None and args:
+                    for t in args[0][0]:
+                        if t.startswith("regname:"):
+                            name = t[len("regname:"):]
+            if term == "register" or name is None:
+                return (set(), [])
+            if term == "serve_variant":
+                return ({f"regname:{name}@serve"}, [])
+            if term == "mesh_variant":
+                return ({f"regname:{name}@mesh*"}, [])
+            depth = "*"
+            if len(node.args) >= 2:
+                d1 = node.args[1]
+                if isinstance(d1, ast.Constant) and isinstance(
+                        d1.value, int):
+                    depth = str(d1.value)
+            return ({f"regname:{name}@ring{depth}*"}, [])
+
+        # AOT registry lookups / handle dispatches
+        if (term == "compile" and isinstance(node.func, ast.Attribute)
+                and _is_registry_recv(node.func.value)):
+            name = ""
+            if node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) and isinstance(
+                        a0.value, str):
+                    name = a0.value
+                else:
+                    for t in args[0][0]:
+                        if t.startswith("regname:"):
+                            name = t[len("regname:"):]
+            self._site(node, "aot_compile", args, kwargs,
+                       terminal=term, name=name)
+            return ({"aot-handle"}, [])
+        if term == "call" and isinstance(node.func, ast.Attribute):
+            recv = self._eval(node.func.value, env)
+            if "aot-handle" in recv[0]:
+                self._site(node, "aot_call", args, kwargs, terminal=term)
+                return (set(), [])
+
+        # transfers
+        if term == "device_get":
+            tags, chain = _union(args + list(kwargs.values()))
+            tags = set(tags) | {"host"}
+            chain = chain + [[line, "host copy: jax.device_get"]]
+            return _val(tags, chain)
+        if term in ("device_put", "to_device"):
+            self._site(node, "device_put", args, kwargs, terminal=term)
+            tags, chain = _union(args)
+            return _val(set(tags) - {"host"}, chain)
+
+        # dtype casts
+        if term == "astype" and isinstance(node.func, ast.Attribute):
+            recv = self._eval(node.func.value, env)
+            dt = _dtype_tag(node.args[0]) if node.args else dt_kw
+            tags, chain = set(recv[0]), list(recv[1])
+            if dt == "f64":
+                self._site(node, "f64cast", [recv], {}, terminal=term)
+                tags.add("f64")
+                chain.append([line, "f64 upcast"])
+            else:
+                self._apply_dtype(tags, chain, dt, line)
+            return _val(tags, chain)
+        if term in ("float64", "float32") and self._np_like(node.func):
+            inner = _union(args)
+            tags, chain = set(inner[0]), list(inner[1])
+            if term == "float64":
+                if args:
+                    self._site(node, "f64cast", [args[0]], {},
+                               terminal=term)
+                tags.add("f64")
+                chain.append([line, "f64 upcast"])
+            else:
+                self._apply_dtype(tags, chain, "f32", line)
+            return _val(tags, chain)
+
+        # shape producers
+        if term == "len":
+            return ({"raw"}, [[line, "raw dynamic size: len(...)"]])
+        bucket_name = term if term in _BUCKET_FNS else ""
+        if not bucket_name:
+            # `from utils.padding import next_pow2 as _np2` style aliases:
+            # recognize the bucket helper by its resolved definition name
+            resolved = self.base._resolve_callee(node) or ""
+            tail = _terminal_name(resolved)
+            if tail in _BUCKET_FNS:
+                bucket_name = tail
+        if bucket_name:
+            inner = _union(args)
+            tags = {"bucketed"} | (inner[0] & {"host"})
+            if bucket_name == "stack_queries":
+                tags.add("f64")  # batcher stacks cast to np.float64
+            return _val(tags, [[line, f"bucketed: {bucket_name}(...)"]])
+        if term == "ShapeDtypeStruct":
+            shape = args[0] if args else (set(), [])
+            tags = shape[0] & {"raw", "bucketed"}
+            chain = list(shape[1])
+            dt = dt_kw or (_dtype_tag(node.args[1])
+                           if len(node.args) >= 2 else None)
+            self._apply_dtype(tags, chain, dt, line)
+            return _val(tags, chain)
+        if self._np_like(node.func) and term in _RAW_MAKERS:
+            inner = _union(args)
+            shape = inner[0] & {"raw", "bucketed"}
+            markers = {t for t in inner[0]
+                       if t.startswith(("param:", "call:"))}
+            # Default-raw only for genuinely local unknowns (wire payload
+            # decodes, recv buffers): a marker-carrying input defers its
+            # shape verdict to caller/callee resolution — store batches
+            # are capacity-bucketed at ingest and must not read as raw
+            # just because the pad site is out of interprocedural reach.
+            # frombuffer/fromiter are extent-from-bytes: always raw.
+            always_raw = term in ("frombuffer", "fromiter")
+            tags = set(shape)
+            if always_raw or (not shape and not markers):
+                tags.add("raw")
+            tags |= markers
+            tags |= inner[0] & {"host", "f32", "f64"}
+            chain = list(inner[1])
+            if "raw" in tags and "raw" not in shape:
+                chain.append([line, f"raw shape: {term}(...)"])
+            dt = dt_kw
+            if dt is None and term in ("asarray", "array") and \
+                    len(node.args) >= 2:
+                dt = _dtype_tag(node.args[1])
+            if dt == "f64":
+                self._site(node, "f64cast", [inner], {}, terminal=term)
+                tags.add("f64")
+                chain.append([line, "f64 upcast"])
+            else:
+                self._apply_dtype(tags, chain, dt, line)
+            return _val(tags, chain)
+        if self._np_like(node.func) and term in _EXTENT_MAKERS:
+            extent = args[0] if args else (set(), [])
+            tags = {t for t in extent[0]
+                    if t in ("raw", "bucketed")
+                    or t.startswith(("param:", "call:"))}
+            chain = list(extent[1])
+            dt = dt_kw
+            if dt is None and term == "full" and len(node.args) >= 3:
+                dt = _dtype_tag(node.args[2])
+            self._apply_dtype(tags, chain, dt, line)
+            return _val(tags, chain)
+        if term in _PASSTHROUGH_FNS:
+            return _union(args)
+
+        # generic calls: record when any argument carries provenance
+        # (the interprocedural edges param-resolution walks), return a
+        # summary marker for resolved project callees
+        target = self.base._resolve_callee(node) or ""
+        tagged = any(v[0] for v in args) or \
+            any(v[0] for v in kwargs.values())
+        if tagged and (target or term):
+            self._site(node, "call", args, kwargs, target=target,
+                       terminal=term)
+        if target:
+            return ({f"call:{target}"}, [])
+        vals = list(args) + list(kwargs.values())
+        if isinstance(node.func, ast.Attribute):
+            vals.append(self._eval(node.func.value, env))
+        return _union(vals)
+
+
+def extract_flow(mod: ModInfo) -> ModuleFlow:
+    return _FlowExtractor(mod).run()
+
+
+# ---------------------------------------------------------------------------
+# project index
+# ---------------------------------------------------------------------------
+
+
+class DataflowIndex:
+    """Cross-module provenance context built from per-module flow
+    summaries. The incremental engine feeds cached summaries for
+    unchanged files via `project._gt_dataflow_summaries`; a cold scan
+    extracts them all. Marker resolution is summary-based and
+    depth-bounded, the same caller-propagation discipline as
+    `SpmdIndex.func_bound`."""
+
+    MAX_DEPTH = 4
+
+    def __init__(self, flows: List[ModuleFlow]):
+        self.by_module: Dict[str, ModuleFlow] = {
+            f.module: f for f in flows}
+        self.by_relpath: Dict[str, ModuleFlow] = {
+            f.relpath: f for f in flows}
+        self.calls_to: Dict[str, List[Tuple[ModuleFlow, FlowSite]]] = {}
+        for fl in flows:
+            for site in fl.sites:
+                if not site.target:
+                    continue
+                gid = self._global_id(fl, site.target)
+                if gid is not None:
+                    self.calls_to.setdefault(gid, []).append((fl, site))
+        self._param_memo: Dict[Tuple[str, str],
+                               Tuple[Set[str], List[dict]]] = {}
+        self._ret_memo: Dict[str, Tuple[Set[str], List[dict]]] = {}
+
+    def _global_id(self, fl: ModuleFlow,
+                   target: str) -> Optional[str]:
+        """Resolve a summary-local call target to "module:qname"
+        (mirrors SpmdIndex._global_id, one __init__ re-export hop)."""
+        if ":" in target:
+            mod_name, name = target.rsplit(":", 1)
+            dst = self.by_module.get(mod_name)
+            if dst is None:
+                return None
+            if name in dst.functions:
+                return f"{dst.module}:{name}"
+            src2 = dst.import_names.get(name)
+            if src2:
+                dst2 = self.by_module.get(src2)
+                if dst2 and name in dst2.functions:
+                    return f"{dst2.module}:{name}"
+            return None
+        if target in fl.functions:
+            return f"{fl.module}:{target}"
+        return None
+
+    def _func(self, gid: str) -> Optional[Tuple[ModuleFlow, FuncFlow]]:
+        mod_name, qname = gid.split(":", 1)
+        fl = self.by_module.get(mod_name)
+        if fl is None:
+            return None
+        ff = fl.functions.get(qname)
+        return (fl, ff) if ff is not None else None
+
+    # -- marker resolution ----------------------------------------------------
+
+    def resolve(self, fl: ModuleFlow, fn_q: str, tags, chain,
+                depth: Optional[int] = None,
+                _stack: Optional[frozenset] = None,
+                ) -> Tuple[Set[str], List[dict]]:
+        """Resolve `param:`/`call:` markers of a value computed inside
+        `fl`:`fn_q` to concrete provenance tags + a cross-file chain of
+        {path, line, note} steps."""
+        depth = self.MAX_DEPTH if depth is None else depth
+        stack = _stack or frozenset()
+        out: Set[str] = set()
+        steps = [{"path": fl.relpath, "line": int(c[0]),
+                  "note": str(c[1])} for c in chain]
+        if depth <= 0:
+            return out, steps[:2 * _CHAIN_CAP]
+        for t in sorted(tags):
+            if t.startswith("call:"):
+                gid = self._global_id(fl, t[len("call:"):])
+                if gid is not None and ("ret", gid) not in stack:
+                    rt, rs = self.return_tags(
+                        gid, depth - 1, stack | {("ret", gid)})
+                    out |= rt
+                    steps += rs
+            elif t.startswith("param:"):
+                pt, ps = self.param_tags(
+                    fl.module, fn_q, t[len("param:"):], depth - 1, stack)
+                out |= pt
+                steps += ps
+            else:
+                out.add(t)
+        return out, steps[:2 * _CHAIN_CAP]
+
+    def param_tags(self, module: str, qname: str, pname: str,
+                   depth: int, stack: frozenset,
+                   ) -> Tuple[Set[str], List[dict]]:
+        """Provenance of a parameter = join over every in-project call
+        site's matching argument (context-insensitive; no call sites ->
+        unresolved -> empty, conservative no-fire)."""
+        gid = f"{module}:{qname}"
+        key = (gid, pname)
+        if key in self._param_memo:
+            return self._param_memo[key]
+        if depth <= 0 or key in stack:
+            return set(), []
+        got = self._func(gid)
+        if got is None:
+            return set(), []
+        fl, ff = got
+        if pname not in ff.params:
+            return set(), []
+        pos = ff.params.index(pname)
+        out: Set[str] = set()
+        steps: List[dict] = []
+        for cfl, site in self.calls_to.get(gid, ()):
+            val = site.kwargs.get(pname)
+            if val is None and pos < len(site.args):
+                val = site.args[pos]
+            if val is None:
+                continue
+            t, s = self.resolve(cfl, site.fn, set(val[0]), val[1],
+                                depth - 1, stack | {key})
+            if t - out:
+                out |= t
+                steps = s + [{"path": cfl.relpath, "line": site.line,
+                              "note": f"passed into {qname}"
+                                      f"({pname}=...) here"}]
+        self._param_memo[key] = (out, steps)
+        return out, steps
+
+    def return_tags(self, gid: str, depth: int, stack: frozenset,
+                    ) -> Tuple[Set[str], List[dict]]:
+        if gid in self._ret_memo:
+            return self._ret_memo[gid]
+        got = self._func(gid)
+        if got is None:
+            return set(), []
+        fl, ff = got
+        out, steps = self.resolve(fl, ff.qname, set(ff.returns),
+                                  ff.ret_chain, depth, stack)
+        self._ret_memo[gid] = (out, steps)
+        return out, steps
+
+    def site_val(self, fl: ModuleFlow, site: FlowSite,
+                 val: list) -> Tuple[Set[str], List[dict]]:
+        return self.resolve(fl, site.fn, set(val[0]), val[1])
+
+    # -- dispatch classification ---------------------------------------------
+
+    def is_dispatch(self, site: FlowSite, project) -> bool:
+        if site.kind in ("aot_compile", "aot_call"):
+            return True
+        if site.kind != "call":
+            return False
+        jits = getattr(project, "jit_by_name", {})
+        if site.terminal and site.terminal in jits:
+            return True
+        if site.target:
+            tail = site.target.rsplit(":", 1)[-1].rsplit(".", 1)[-1]
+            return tail in jits
+        return False
+
+
+def dataflow_index(project) -> DataflowIndex:
+    """Memoized on the project — the dataflow and SPMD engines share
+    one `build_project` pass (and this index is built at most once per
+    lint run; the incremental cache feeds summaries for unchanged
+    files)."""
+    idx = getattr(project, "_gt_dataflow", None)
+    if idx is None:
+        cached: Dict[str, ModuleFlow] = getattr(
+            project, "_gt_dataflow_summaries", None) or {}
+        flows = []
+        for m in project.modules:
+            f = cached.get(m.relpath)
+            if f is None or f.schema != DATAFLOW_SCHEMA:
+                f = extract_flow(m)
+            flows.append(f)
+        idx = project._gt_dataflow = DataflowIndex(flows)
+        project._gt_dataflow_summaries = {
+            f.relpath: f for f in flows}
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# GT30 registration universe (scan set + reference universe)
+# ---------------------------------------------------------------------------
+
+
+class _RegUniverse:
+    def __init__(self, rows: List[list]):
+        self.names: Set[str] = set()
+        self.serve: Set[str] = set()
+        self.ring: Set[Tuple[str, Optional[int]]] = set()
+        self.mesh: Set[str] = set()
+        self.dyn_register = False
+        self.dyn_serve = False
+        self.dyn_ring = False
+        self.dyn_mesh = False
+        for api, name, depth, _line in rows:
+            if api == "install_defaults":
+                self.dyn_register = True
+                continue
+            if name is None:
+                if api == "register":
+                    self.dyn_register = True
+                elif api == "serve_variant":
+                    self.dyn_serve = True
+                elif api == "ring_variant":
+                    self.dyn_ring = True
+                elif api == "mesh_variant":
+                    self.dyn_mesh = True
+                continue
+            self.names.add(name)
+            if api == "serve_variant":
+                self.serve.add(name)
+            elif api == "ring_variant":
+                self.ring.add((name, depth))
+            elif api == "mesh_variant":
+                self.mesh.add(name)
+
+
+def registration_universe(project) -> _RegUniverse:
+    uni = getattr(project, "_gt_dataflow_regs", None)
+    if uni is None:
+        idx = dataflow_index(project)
+        rows: List[list] = []
+        for rel in sorted(idx.by_relpath):
+            rows.extend(idx.by_relpath[rel].regs)
+        for m in project.ref_modules:
+            rows.extend(collect_registrations(m.tree))
+        uni = project._gt_dataflow_regs = _RegUniverse(rows)
+    return uni
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+def _finding(rule: str, mod: ModInfo, line: int, col: int, msg: str,
+             chain: Optional[List[dict]] = None) -> Finding:
+    f = Finding(rule=rule, path=mod.relpath, line=line, col=col,
+                message=msg)
+    if chain:
+        f.extra["chain"] = chain
+    return f
+
+
+def _hot(mod: ModInfo) -> bool:
+    return mod.relpath.replace("\\", "/").startswith(_HOT_PREFIXES)
+
+
+def _mods_by_relpath(project) -> dict:
+    cached = getattr(project, "_gt_df_modmap", None)
+    if cached is None:
+        cached = {}
+        for m in list(project.modules) + list(project.ref_modules):
+            cached.setdefault(m.relpath, m)
+        project._gt_df_modmap = cached
+    return cached
+
+
+def _chain_waived(project, rule: str, chain) -> bool:
+    """Origin waivers: a `# gt: waive GTnn` on ANY step of the
+    provenance chain (e.g. the len() origin, or the caller boundary
+    that passes the raw value in) suppresses the downstream dispatch
+    finding. Waive where the shape is born — one directive at the
+    origin instead of one per library dispatch it reaches."""
+    mods = _mods_by_relpath(project)
+    for step in chain or []:
+        m = mods.get(step.get("path"))
+        if m is not None and m.is_waived(rule, step.get("line", 0)):
+            return True
+    return False
+
+
+def _site_vals(site: FlowSite):
+    start = 1 if site.kind == "aot_compile" else 0
+    for i, val in enumerate(site.args[start:], start):
+        yield f"arg {i}", val
+    for k, val in sorted(site.kwargs.items()):
+        yield f"{k}=", val
+
+
+def gt28(mod: ModInfo, project) -> Iterator[Finding]:
+    """Raw (unbucketed) dynamic shape reaching a jit/AOT/ring dispatch
+    on the hot path: every distinct raw extent compiles a fresh
+    executable — the recompile storm the warmup manifests exist to
+    prevent. Fix: quantize through pad_to/next_pow2/stack_queries
+    before the dispatch (results are sliced back; the kernel shape set
+    stays the manifest's)."""
+    if not _hot(mod):
+        return
+    idx = dataflow_index(project)
+    s = idx.by_relpath.get(mod.relpath)
+    if s is None:
+        return
+    for site in s.sites:
+        if not idx.is_dispatch(site, project):
+            continue
+        for label, val in _site_vals(site):
+            tags, chain = idx.site_val(s, site, val)
+            if "raw" in tags and "bucketed" not in tags:
+                if _chain_waived(project, "GT28", chain):
+                    continue
+                what = site.name or site.terminal or site.target
+                yield _finding(
+                    "GT28", mod, site.line, site.col,
+                    f"raw (unbucketed) dynamic shape reaches dispatch "
+                    f"{what!r} ({label}) in {site.fn!r}: every distinct "
+                    f"extent compiles a fresh executable under traffic "
+                    f"— pad through pad_to/next_pow2/stack_queries so "
+                    f"the shape set stays the warmup manifest's",
+                    chain=chain)
+                break
+
+
+def gt29(mod: ModInfo, project) -> Iterator[Finding]:
+    """f32-cast value flowing into an exact-f64 consumer. The f32 tag is
+    sticky: `.astype(float64)` / `np.asarray(x, np.float64)` over an
+    already-rounded f32 value restores nothing — the canonical recompute
+    (`_canonical_dists`) must run over the ORIGINAL f64 inputs. Fires at
+    the laundering upcast and at callee parameters named `*_f64`."""
+    idx = dataflow_index(project)
+    s = idx.by_relpath.get(mod.relpath)
+    if s is None:
+        return
+    for site in s.sites:
+        if site.kind == "f64cast":
+            if not site.args:
+                continue
+            tags, chain = idx.site_val(s, site, site.args[0])
+            if "f32" in tags and "f64" not in tags \
+                    and not _chain_waived(project, "GT29", chain):
+                yield _finding(
+                    "GT29", mod, site.line, site.col,
+                    f"f64 upcast of an f32-cast value in {site.fn!r}: "
+                    f"the input was already rounded to f32 — upcasting "
+                    f"does not restore exactness; run the canonical f64 "
+                    f"recompute over the original f64 inputs instead",
+                    chain=chain)
+            continue
+        if site.kind not in ("call", "aot_compile", "aot_call"):
+            continue
+        gid = idx._global_id(s, site.target) if site.target else None
+        got = idx._func(gid) if gid else None
+        if got is None:
+            continue
+        _, ff = got
+        for pos, val in enumerate(site.args):
+            pname = ff.params[pos] if pos < len(ff.params) else ""
+            if not pname.endswith("f64"):
+                continue
+            tags, chain = idx.site_val(s, site, val)
+            if "f32" in tags and "f64" not in tags \
+                    and not _chain_waived(project, "GT29", chain):
+                yield _finding(
+                    "GT29", mod, site.line, site.col,
+                    f"f32-cast value passed as exact-f64 parameter "
+                    f"{pname!r} of {ff.qname!r}: the consumer assumes "
+                    f"full f64 precision but the value was rounded to "
+                    f"f32 upstream — feed the original f64 input (or "
+                    f"its canonical recompute)",
+                    chain=chain)
+        for pname, val in sorted(site.kwargs.items()):
+            if not pname.endswith("f64"):
+                continue
+            tags, chain = idx.site_val(s, site, val)
+            if "f32" in tags and "f64" not in tags \
+                    and not _chain_waived(project, "GT29", chain):
+                yield _finding(
+                    "GT29", mod, site.line, site.col,
+                    f"f32-cast value passed as exact-f64 parameter "
+                    f"{pname!r} of {ff.qname!r}: upcasting rounded f32 "
+                    f"does not restore exactness — feed the original "
+                    f"f64 input (or its canonical recompute)",
+                    chain=chain)
+
+
+def _check_key(name: str, uni: _RegUniverse) -> Optional[str]:
+    """None when some registration site can produce `name`; else a
+    human-readable reason it is unmatchable."""
+    parts = name.split("@")
+    prefix = parts[0]
+    if not parts[1:]:
+        if uni.dyn_register or name in uni.names:
+            return None
+        return (f"base key {name!r} is registered nowhere "
+                f"(no registry.register site names it)")
+    for m in parts[1:]:
+        if m == "serve":
+            if not (uni.dyn_serve or prefix in uni.serve):
+                return (f"no serve_variant registration exists for "
+                        f"base {prefix!r}")
+        elif m.startswith("ring"):
+            spec = m[len("ring"):].split("+", 1)[0]
+            try:
+                depth = int(spec)
+            except ValueError:
+                return None  # dynamic depth spelled literally: skip
+            if not (uni.dyn_ring or (prefix, depth) in uni.ring
+                    or (prefix, None) in uni.ring):
+                return (f"no ring_variant registration for base "
+                        f"{prefix!r} at depth {depth}")
+        elif m.startswith("mesh"):
+            if not (uni.dyn_mesh or prefix in uni.mesh):
+                return (f"no mesh_variant registration exists for "
+                        f"base {prefix!r}")
+        else:
+            return None  # unknown marker: out of contract, skip
+        prefix = f"{prefix}@{m}"
+    return None
+
+
+def gt30(mod: ModInfo, project) -> Iterator[Finding]:
+    """AOT/ring registry lookup whose literal key no registration site
+    in the project (scan set or reference universe) can produce — GT13
+    made interprocedural. The warmup manifest can never warm this
+    caller: first traffic pays a KeyError or an inline compile. Keys
+    composed from variant-constructor returns are definitionally
+    registered and are skipped; dynamic registrations (install_defaults
+    sweeps, computed names) wildcard their key space."""
+    idx = dataflow_index(project)
+    s = idx.by_relpath.get(mod.relpath)
+    if s is None:
+        return
+    uni = registration_universe(project)
+    for site in s.sites:
+        if site.kind != "aot_compile" or not site.name:
+            continue
+        if "*" in site.name:
+            continue  # composed from a variant-constructor return
+        reason = _check_key(site.name, uni)
+        if reason is not None:
+            yield _finding(
+                "GT30", mod, site.line, site.col,
+                f"registry lookup {site.name!r} in {site.fn!r} can "
+                f"match no registration key shape in the project: "
+                f"{reason} — the warmup manifest can never warm this "
+                f"call site (KeyError or inline compile under traffic)")
+
+
+def gt31(mod: ModInfo, project) -> Iterator[Finding]:
+    """device→host→device bounce: a jax.device_get result transitively
+    re-entering device_put or a dispatch on the hot path — two
+    transfers (plus a host sync) where zero were needed. Keep the value
+    on device: reuse the device reference (the launch holds it), or
+    donate/alias through the stager."""
+    if not _hot(mod):
+        return
+    idx = dataflow_index(project)
+    s = idx.by_relpath.get(mod.relpath)
+    if s is None:
+        return
+    for site in s.sites:
+        is_put = site.kind == "device_put"
+        if not is_put and not idx.is_dispatch(site, project):
+            continue
+        for label, val in _site_vals(site):
+            tags, chain = idx.site_val(s, site, val)
+            if "host" in tags:
+                if _chain_waived(project, "GT31", chain):
+                    continue
+                what = ("jax.device_put" if is_put else
+                        site.name or site.terminal or site.target)
+                yield _finding(
+                    "GT31", mod, site.line, site.col,
+                    f"device→host→device bounce in {site.fn!r}: a "
+                    f"jax.device_get result re-enters the device "
+                    f"through {what!r} ({label}) — keep the device "
+                    f"reference (the launch still holds it) instead of "
+                    f"paying a round-trip transfer plus a host sync",
+                    chain=chain)
+                break
+
+
+DATAFLOW_RULES = {"GT28": gt28, "GT29": gt29, "GT30": gt30,
+                  "GT31": gt31}

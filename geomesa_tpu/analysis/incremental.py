@@ -51,7 +51,8 @@ from geomesa_tpu.analysis.linter import (
     _check_inline_waiver_tokens, _iter_py_files, build_project,
     finalize_findings, find_repo_root, lint_paths, module_reference_counts,
     resolve_waiver_file)
-from geomesa_tpu.analysis.model import Finding
+from geomesa_tpu.analysis.dataflow import DATAFLOW_SCHEMA, ModuleFlow
+from geomesa_tpu.analysis.model import ANALYSIS_VERSION, Finding
 from geomesa_tpu.analysis.rules import ALL_RULES
 from geomesa_tpu.analysis.spmd import SPMD_SCHEMA, ModuleSummary
 
@@ -59,7 +60,7 @@ __all__ = ["lint_paths_incremental", "DEFAULT_CACHE_FILENAME"]
 
 # bump on any change to what the cache stores or what the replay paths
 # assume — an old cache must fall through to a cold scan, never mis-replay
-CACHE_SCHEMA = 1
+CACHE_SCHEMA = 2
 
 DEFAULT_CACHE_FILENAME = ".gmtpu-lintcache"
 
@@ -115,6 +116,18 @@ def _hash_tree(paths: List[str],
     return scan, refs
 
 
+def _ruleset_sig() -> str:
+    """Fingerprint of the rule set that wrote the cache: the registered
+    rule codes plus ANALYSIS_VERSION (bumped by any PR that changes
+    rule semantics). The cache keys on target-file content — without
+    this stamp, upgrading gmtpu-lint would replay stale findings from
+    an older rule set as a byte-identical \"warm\" result. A mismatched
+    or corrupt stamp falls through to a cold scan."""
+    doc = {"version": ANALYSIS_VERSION, "rules": sorted(ALL_RULES)}
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode()).hexdigest()
+
+
 def _config_sig(selected: List[str], paths: List[str]) -> str:
     doc = {"schema": CACHE_SCHEMA, "rules": selected,
            "paths": sorted(os.path.abspath(p) for p in paths)}
@@ -138,9 +151,14 @@ def _jit_signature(project, scan_hashes: Dict[str, str]) -> str:
 
 
 def _finding_to(f: Finding) -> dict:
-    return {"rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
-            "message": f.message, "severity": f.severity,
-            "waived": f.waived, "waived_by": f.waived_by}
+    d = {"rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+         "message": f.message, "severity": f.severity,
+         "waived": f.waived, "waived_by": f.waived_by}
+    if f.extra:
+        # dataflow provenance chains ride along so a warm replay's
+        # SARIF render carries the same relatedLocations as a cold scan
+        d["extra"] = f.extra
+    return d
 
 
 def _finding_from(d: dict) -> Finding:
@@ -148,7 +166,8 @@ def _finding_from(d: dict) -> Finding:
                    col=int(d["col"]), message=d["message"],
                    severity=d.get("severity", "warn"),
                    waived=bool(d.get("waived")),
-                   waived_by=d.get("waived_by", ""))
+                   waived_by=d.get("waived_by", ""),
+                   extra=d.get("extra") or {})
 
 
 def _load_cache(path: str) -> Optional[dict]:
@@ -205,6 +224,7 @@ def lint_paths_incremental(paths: List[str],
     cache = _load_cache(cache_path)
     usable = (cache is not None
               and cache.get("schema") == CACHE_SCHEMA
+              and cache.get("ruleset") == _ruleset_sig()
               and cache.get("config") == cfg)
 
     # -- tier 1: warm replay -----------------------------------------------
@@ -242,6 +262,20 @@ def lint_paths_incremental(paths: List[str],
                 continue
         if spmd_cached:
             project._gt_spmd_summaries = spmd_cached
+        # dataflow flow summaries: same discipline as the SPMD ones
+        df_cached: Dict[str, ModuleFlow] = {}
+        for r, d in (cache.get("dataflow") or {}).items():
+            if r in changed or r not in scan_hashes:
+                continue
+            if not isinstance(d, dict) or \
+                    d.get("schema") != DATAFLOW_SCHEMA:
+                continue
+            try:
+                df_cached[r] = ModuleFlow.from_dict(d)
+            except (KeyError, TypeError, ValueError):
+                continue
+        if df_cached:
+            project._gt_dataflow_summaries = df_cached
 
     jit_sig = _jit_signature(project, scan_hashes)
     perfile_ok = usable and cache.get("jit_sig") == jit_sig
@@ -294,8 +328,10 @@ def lint_paths_incremental(paths: List[str],
     finalize_findings(findings, paths, wf)
 
     spmd_out = getattr(project, "_gt_spmd_summaries", None) or {}
+    df_out = getattr(project, "_gt_dataflow_summaries", None) or {}
     _write_cache(cache_path, {
         "schema": CACHE_SCHEMA,
+        "ruleset": _ruleset_sig(),
         "config": cfg,
         "waiver_sha": waiver_sha,
         "jit_sig": jit_sig,
@@ -305,6 +341,7 @@ def lint_paths_incremental(paths: List[str],
         "perfile": new_perfile,
         "refcounts": refcounts,
         "spmd": {r: s.to_dict() for r, s in spmd_out.items()},
+        "dataflow": {r: s.to_dict() for r, s in df_out.items()},
     })
     if not include_waived:
         findings = [f for f in findings if not f.waived]

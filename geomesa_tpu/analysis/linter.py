@@ -284,6 +284,19 @@ def render_sarif(findings: List[Finding]) -> str:
                 },
             }],
         }
+        chain = (f.extra or {}).get("chain") or []
+        if chain:
+            # the provenance chain (dataflow rules): CI annotation
+            # surfaces walk from the sink to the leak's origin
+            r["relatedLocations"] = [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": str(step.get("path", f.path))},
+                    "region": {
+                        "startLine": max(1, int(step.get("line", 1)))},
+                },
+                "message": {"text": str(step.get("note", ""))},
+            } for step in chain if isinstance(step, dict)]
         if f.waived:
             r["suppressions"] = [{
                 "kind": "inSource",
@@ -319,6 +332,58 @@ def exit_code(findings: List[Finding], fail_on: str) -> int:
     return 0
 
 
+def changed_paths(scan_paths: List[str], ref: str) -> List[str]:
+    """Resolve `gmtpu lint --changed[=REF]` to the changed .py files
+    inside the scan scope: `git diff --name-only REF` plus untracked
+    files. The scan SET narrows; the reference universe does not —
+    `build_project` still pulls the whole repo in as ref modules, so
+    the universe-backed rules (GT05 liveness, GT13/GT30 registration
+    keys) keep their full context and a narrowed run never invents a
+    false finding from missing context. The caller-graph passes
+    (GT24-GT29, GT31) resolve within the scan set — a changed-only run
+    is a fast pre-commit filter; the CI gate lints the full tree."""
+    import subprocess
+    root = find_repo_root(scan_paths[0]) if scan_paths else None
+    root = root or os.getcwd()
+    # The empty-tree hash: what the default ref degrades to on a repo
+    # whose HEAD is unborn (initial commit), so the pre-commit hook
+    # sample works on the very first commit instead of dying on
+    # `git diff HEAD`. An explicit bad REF still errors.
+    _EMPTY_TREE = "4b825dc642cb6eb9a060e54bf8d69288fbee4904"
+    try:
+        if ref == "HEAD":
+            head = subprocess.run(
+                ["git", "-C", root, "rev-parse", "--verify", "-q", "HEAD"],
+                capture_output=True, text=True, timeout=30)
+            if head.returncode != 0:
+                ref = _EMPTY_TREE
+        diff = subprocess.run(
+            ["git", "-C", root, "diff", "--name-only", ref, "--"],
+            capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "-C", root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise RuntimeError(f"gmtpu-lint: --changed needs git: {e}")
+    if diff.returncode != 0:
+        raise RuntimeError(
+            f"gmtpu-lint: git diff --name-only {ref} failed: "
+            f"{diff.stderr.strip()}")
+    scopes = [os.path.abspath(p) for p in scan_paths]
+    out: List[str] = []
+    names = diff.stdout.splitlines() + untracked.stdout.splitlines()
+    for name in sorted(set(names)):
+        if not name.endswith(".py"):
+            continue
+        af = os.path.abspath(os.path.join(root, name))
+        if not os.path.exists(af):
+            continue  # deleted files have nothing to lint
+        if any(af == sc or af.startswith(sc + os.sep) for sc in scopes):
+            out.append(af)
+    return out
+
+
 def run_cli(args) -> int:
     """Shared by `gmtpu lint` and `python -m geomesa_tpu.analysis`."""
     rules = None
@@ -334,13 +399,31 @@ def run_cli(args) -> int:
         spmd_codes = [c for c in ("GT24", "GT25", "GT26", "GT27")
                       if c in ALL_RULES]
         rules = sorted(set(rules or []) | set(spmd_codes))
+    if getattr(args, "dataflow", False):
+        # the provenance dataflow pass subset (docs/ANALYSIS.md
+        # "Reading a provenance report"); composes with --rules
+        df_codes = [c for c in ("GT28", "GT29", "GT30", "GT31")
+                    if c in ALL_RULES]
+        rules = sorted(set(rules or []) | set(df_codes))
+    paths = list(args.paths) or ["geomesa_tpu"]
+    changed_ref = getattr(args, "changed", None)
+    if changed_ref is not None:
+        try:
+            paths = changed_paths(paths, changed_ref)
+        except RuntimeError as e:
+            print(e, file=sys.stderr)
+            return 2
+        if not paths:
+            print("gmtpu-lint: no changed .py files in scope",
+                  file=sys.stderr)
+            return 0
     lint_fn = lint_paths
     if getattr(args, "incremental", False):
         from geomesa_tpu.analysis.incremental import lint_paths_incremental
         lint_fn = lint_paths_incremental
     try:
         findings = lint_fn(
-            list(args.paths) or ["geomesa_tpu"],
+            paths,
             rules=rules,
             waiver_file=getattr(args, "waivers", None),
         )
@@ -389,3 +472,13 @@ def add_lint_arguments(p) -> None:
     p.add_argument("--spmd", action="store_true",
                    help="run the interprocedural SPMD pass "
                         "(GT24-GT27; union with --rules)")
+    p.add_argument("--dataflow", action="store_true",
+                   help="run the interprocedural dtype/shape-"
+                        "provenance pass (GT28-GT31; union with "
+                        "--rules)")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="lint only files changed vs REF (git diff "
+                        "--name-only, default HEAD) plus untracked "
+                        "files; cross-file rules keep the whole-repo "
+                        "reference universe")

@@ -13,6 +13,12 @@ from typing import Dict
 
 SEVERITIES = ("info", "warn", "error")
 
+# Rule-set fingerprint component for the incremental cache: bump on any
+# PR that adds/changes rule semantics so a cache written by an older
+# rule set can never replay stale findings as a byte-identical "warm"
+# result (analysis/incremental.py stamps it into .gmtpu-lintcache).
+ANALYSIS_VERSION = "18.0"
+
 
 @dataclass(frozen=True)
 class Rule:
@@ -140,6 +146,28 @@ RULES: Dict[str, Rule] = {
                      "without a parallel.is_coordinator()/"
                      "process_index()==0 gate — every host of a pod "
                      "performs it against shared storage"),
+        Rule("GT28", "recompile storm (static): a raw (unbucketed) "
+                     "dynamic shape — len()/np.asarray over wire "
+                     "payloads — reaches a jit/AOT/ring dispatch on "
+                     "the hot path; every distinct extent compiles a "
+                     "fresh executable — pad through pad_to/next_pow2/"
+                     "stack_queries so the shape set stays the warmup "
+                     "manifest's"),
+        Rule("GT29", "f64 exactness leak: an f32-cast value flows into "
+                     "an exact-f64 consumer (f64 upcast site or a "
+                     "*_f64 parameter) without passing the canonical "
+                     "f64 recompute — upcasting rounded f32 restores "
+                     "nothing; answers drift an ulp"),
+        Rule("GT30", "unmatchable registry key: an AOT/ring lookup "
+                     "names a variant key no registry.register/"
+                     "serve_variant/ring_variant/mesh_variant site in "
+                     "the project can produce — the warmup manifest "
+                     "can never warm this caller (KeyError or inline "
+                     "compile under traffic)"),
+        Rule("GT31", "device→host→device bounce: a jax.device_get "
+                     "result transitively re-enters device_put or a "
+                     "dispatch — two transfers plus a host sync where "
+                     "zero were needed; keep the device reference"),
     )
 }
 

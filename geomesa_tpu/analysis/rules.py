@@ -1539,6 +1539,7 @@ def gt23(mod: ModInfo, project) -> Iterator[Finding]:
 from geomesa_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES)
 from geomesa_tpu.analysis.spmd import SPMD_RULES  # noqa: E402
+from geomesa_tpu.analysis.dataflow import DATAFLOW_RULES  # noqa: E402
 
 ALL_RULES = {
     "GT01": gt01, "GT02": gt02, "GT03": gt03,
@@ -1548,4 +1549,5 @@ ALL_RULES = {
     "GT21": gt21, "GT22": gt22, "GT23": gt23,
     **CONCURRENCY_RULES,
     **SPMD_RULES,
+    **DATAFLOW_RULES,
 }

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from geomesa_tpu.utils.jaxcompat import shard_map as _shard_map
 
 from geomesa_tpu.engine.geodesy import EARTH_RADIUS_M, haversine_m
+from geomesa_tpu.utils.padding import next_pow2
 
 INF = jnp.float32(jnp.inf)
 
@@ -285,11 +286,19 @@ def knn_indexed(
     if not flags.any():
         return kd, ki
     rows = np.nonzero(flags)[0]
+    # pow2-bucket the fallback set: the uncertain-query count varies per
+    # round, and both the gathered query extent and the tile parameter
+    # shape the exact-path executable — raw counts would compile one per
+    # distinct count. Padded slots re-run rows[0]; their results are
+    # dropped by the slice before the scatter-back.
+    nb = next_pow2(max(len(rows), 1))
+    rpad = np.concatenate(
+        [rows, np.full(nb - len(rows), rows[0], rows.dtype)])
     fd, fi = knn(
-        jnp.take(qx, jnp.asarray(rows)), jnp.take(qy, jnp.asarray(rows)),
+        jnp.take(qx, jnp.asarray(rpad)), jnp.take(qy, jnp.asarray(rpad)),
         dx, dy, mask, k=k,
-        query_tile=max(1, min(1024, len(rows))),
+        query_tile=max(1, min(1024, nb)),
     )
-    kd = jnp.asarray(kd).at[jnp.asarray(rows)].set(fd)
-    ki = jnp.asarray(ki).at[jnp.asarray(rows)].set(fi)
+    kd = jnp.asarray(kd).at[jnp.asarray(rows)].set(fd[: len(rows)])
+    ki = jnp.asarray(ki).at[jnp.asarray(rows)].set(fi[: len(rows)])
     return kd, ki

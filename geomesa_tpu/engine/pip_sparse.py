@@ -539,6 +539,10 @@ def pip_layer_grouped(
                         np.full((tc_pad, cap_k), n_etiles, np.int32),
                     ])
                 jid = _jnp.asarray(ids)
+                # per-layer tiling: point/edge tile counts are fixed
+                # by the loaded polygon layer (chunks pow2-padded
+                # above) — compiles track layer loads, not traffic
+                # gt: waive GT28
                 cc, bb = _pip_grouped_call(
                     _jnp.take(pxt, jid, axis=0),
                     _jnp.take(pyt, jid, axis=0),
@@ -767,7 +771,9 @@ def pip_layer_assign(
                 pin = np.concatenate(
                     [pin, np.zeros((tc_pad, cap_c), np.int32)])
             jid = _jnp.asarray(ids)
-            # cap_c is pow2-bucketed: one trace per bucket, bounded
+            # cap_c is pow2-bucketed: one trace per bucket, bounded;
+            # tile extents are per-layer constants (see grouped path)
+            # gt: waive GT28
             aa, nn, bb = _pip_assign_call(  # gt: waive GT01
                 _jnp.take(pxt, jid, axis=0), _jnp.take(pyt, jid, axis=0),
                 ax1, ay1, ax2, ay2,

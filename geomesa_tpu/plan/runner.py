@@ -95,10 +95,15 @@ def density_device_grid(sft: SimpleFeatureType, batch, dev, dev_mask, hints,
     from geomesa_tpu.engine.density import density_grid_auto as density_grid
 
     g = sft.default_geometry
+    # size the ones-weight off the staged coordinate array, not
+    # len(batch): the device arrays carry whatever capacity bucket the
+    # batch was padded to, and tying the weight extent to them keeps the
+    # dispatch shape set identical to the coordinates' (a raw len() here
+    # would compile a fresh executable per distinct batch length)
     w = (
         dev[hints.density_weight].astype(jnp.float32)
         if hints.density_weight
-        else jnp.ones(len(batch), jnp.float32)
+        else jnp.ones_like(dev[f"{g.name}__x"], dtype=jnp.float32)
     )
     geom_col = batch.columns[g.name]
     if mesh is not None and geom_col.is_point:
@@ -424,7 +429,11 @@ def aggregate(
             )
 
         d = sft.default_dtg
-        dtg = dev[d.name] if d else jnp.zeros(len(batch), jnp.int64)
+        # dtg extent tied to the staged coordinate array (see the ones-
+        # weight note in density_device_grid): len(batch) is a raw
+        # dynamic size and would fork the bin_pack executable per batch
+        dtg = (dev[d.name] if d
+               else jnp.zeros_like(dev[f"{g.name}__x"], dtype=jnp.int64))
         label = track_codes(hints.bin_label) if hints.bin_label else None
         packed = bin_pack(
             track_codes(hints.bin_track),
@@ -479,6 +488,8 @@ def run_stats(batch, dev, mask: np.ndarray, expression: str):
         Z3HistogramStat,
     )
 
+    from geomesa_tpu.utils.padding import next_pow2
+
     seq = parse_stats(expression)
     jmask = jnp.asarray(mask)
     for s in seq.stats:
@@ -486,12 +497,17 @@ def run_stats(batch, dev, mask: np.ndarray, expression: str):
             col = batch.columns[s.dtg]
             bins, _ = to_binned_time(np.asarray(col), TimePeriod.parse(s.period))
             ub = np.unique(bins)
-            # one kernel call over contiguous remapped bin indices
+            # one kernel call over contiguous remapped bin indices; the
+            # bin count is a static (output-shaping) argument, so it is
+            # pow2-bucketed — a raw len(ub) would compile a fresh
+            # executable per distinct time-bin count (padded bins see no
+            # codes and contribute all-zero grids that are never read)
             remap = {int(b): i for i, b in enumerate(ub)}
             tb = np.vectorize(remap.__getitem__, otypes=[np.int32])(bins)
             grids = est.z3_histogram(
                 dev[f"{s.geom}__x"], dev[f"{s.geom}__y"],
-                jnp.asarray(tb), jmask, len(ub), s.bins_per_dim,
+                jnp.asarray(tb), jmask, next_pow2(max(len(ub), 1)),
+                s.bins_per_dim,
             )
             grids = np.asarray(grids)
             for i, b in enumerate(ub):
@@ -499,9 +515,14 @@ def run_stats(batch, dev, mask: np.ndarray, expression: str):
             continue
         col = batch.columns.get(s.attribute) if s.attribute else None
         if isinstance(s, (TopK, EnumerationStat, Frequency)) and isinstance(col, DictColumn):
+            # vocab size is a static kernel argument: pow2-bucket it so
+            # dictionary growth across batches reuses one executable
+            # (codes >= len(vocab) cannot occur; padded count slots stay
+            # zero and are sliced off)
             counts = np.asarray(
                 est.masked_value_counts(
-                    jnp.asarray(col.codes), jmask, max(len(col.vocab), 1)
+                    jnp.asarray(col.codes), jmask,
+                    next_pow2(max(len(col.vocab), 1))
                 )
             )
             s.observe_counts(col.vocab, counts[: len(col.vocab)])
@@ -519,10 +540,13 @@ def run_stats(batch, dev, mask: np.ndarray, expression: str):
             else:  # Count()
                 s.observe_moments(int(mask.sum()), 0.0, 0.0)
         elif isinstance(s, Cardinality) and isinstance(col, DictColumn):
-            # distinct codes present under the mask (exact for dict cols)
+            # distinct codes present under the mask (exact for dict
+            # cols); vocab size pow2-bucketed as above — zip() below
+            # stops at the real vocab, ignoring padded zero slots
             counts = np.asarray(
                 est.masked_value_counts(
-                    jnp.asarray(col.codes), jmask, max(len(col.vocab), 1)
+                    jnp.asarray(col.codes), jmask,
+                    next_pow2(max(len(col.vocab), 1))
                 )
             )
             present = [v for v, c in zip(col.vocab, counts) if c > 0]

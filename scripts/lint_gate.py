@@ -6,11 +6,14 @@ pass GT07..GT12 (lock discipline, lock-order cycles, blocking-under-lock,
 per-call locks, callback-under-lock, unguarded shared state), the
 serving-hot-path rule GT13 and the robustness rule GT14 (swallowed
 errors / unbounded retry loops at the store/kafka/serve boundaries),
-and the interprocedural SPMD pass GT24..GT27 (unbound collective axes,
+the interprocedural SPMD pass GT24..GT27 (unbound collective axes,
 process-divergent control flow, sharding-spec drift, ungated process-
-local side effects — docs/ANALYSIS.md "Reading an SPMD report") —
-and exits nonzero on any unwaived finding, printing each with file:line
-and rule code. The lint itself runs through the incremental engine
+local side effects — docs/ANALYSIS.md "Reading an SPMD report"), and
+the provenance dataflow pass GT28..GT31 (raw shapes reaching hot-path
+dispatches, f32→f64 exactness laundering, unmatchable registry keys,
+device→host→device bounces — docs/ANALYSIS.md "Reading a provenance
+report") — and exits nonzero on any unwaived finding, printing each
+with file:line and rule code. The lint itself runs through the incremental engine
 (analysis/incremental.py): warm runs on an unchanged tree replay the
 content-hash cache in well under a second, with findings byte-identical
 to a cold scan. In text mode a clean lint is
@@ -31,9 +34,9 @@ docs/OBSERVABILITY.md "Sentinel"). Rides the tier-1 pytest run via
 tests/test_lint_gate.py and is runnable standalone:
 
     python scripts/lint_gate.py [--format json|sarif]
-        [--no-spmd-smoke] [--no-warmup-smoke] [--no-chaos-smoke]
-        [--no-telemetry-smoke] [--no-sentinel-smoke] [--no-fleet-smoke]
-        [--no-approx-smoke] [--no-wire-smoke]
+        [--no-spmd-smoke] [--no-dataflow-smoke] [--no-warmup-smoke]
+        [--no-chaos-smoke] [--no-telemetry-smoke] [--no-sentinel-smoke]
+        [--no-fleet-smoke] [--no-approx-smoke] [--no-wire-smoke]
 
 Rule catalog + waiver syntax: docs/ANALYSIS.md.
 """
@@ -789,6 +792,106 @@ def spmd_smoke() -> int:
     return 0
 
 
+def dataflow_smoke() -> int:
+    """Prove the provenance dataflow pass still bites: lint a known-
+    dirty serve-scope fixture seeded with one true positive per rule
+    (GT28 raw shape into an AOT dispatch, GT29 f32→f64 laundering
+    upcast, GT30 unmatchable registry key, GT31 device→host→device
+    bounce) and require ALL FOUR to fire with a nonzero gate verdict;
+    then lint the bucketed/registered/device-resident clean twin and
+    require silence. The dirty SARIF render must carry the GT29
+    provenance chain as relatedLocations — the report format the docs
+    teach ("Reading a provenance report") is asserted here, not just
+    rendered. Pure AST analysis: no jax import, runs in milliseconds."""
+    import json
+    import tempfile
+    import textwrap
+
+    from geomesa_tpu.analysis.linter import (
+        exit_code, lint_paths, render_sarif)
+
+    dirty = textwrap.dedent('''\
+        import jax
+        import numpy as np
+
+        from geomesa_tpu.compilecache.registry import registry
+
+
+        def handle(payload):
+            qx = np.frombuffer(payload)           # raw wire extent
+            handle_ = registry.compile("knn.score@serve", qx)  # GT28+GT30
+            out = handle_.call(qx)
+            host = jax.device_get(out)
+            back = jax.device_put(host)           # GT31: bounce
+            small = qx.astype(np.float32)
+            exact = small.astype(np.float64)      # GT29: launder
+            return back, exact
+        ''')
+    clean = textwrap.dedent('''\
+        import numpy as np
+
+        from geomesa_tpu.compilecache.registry import registry
+        from geomesa_tpu.utils.padding import next_pow2
+
+
+        def score(qx):
+            return qx * 2.0
+
+
+        registry.serve_variant("knn.score", fn=score)
+
+
+        def pad_to(a, size):
+            return np.concatenate([a, np.zeros(size - len(a))])
+
+
+        def handle(payload):
+            raw = np.frombuffer(payload)
+            qx = pad_to(raw, next_pow2(max(len(raw), 1)))
+            handle_ = registry.compile("knn.score@serve", qx)
+            out = handle_.call(qx)
+            exact = np.asarray(payload, np.float64)
+            return out, exact
+        ''')
+    want = {"GT28", "GT29", "GT30", "GT31"}
+
+    def run(src):
+        with tempfile.TemporaryDirectory() as tmp:
+            with open(os.path.join(tmp, "pyproject.toml"), "w") as fh:
+                fh.write("[project]\nname = \"dataflow-smoke\"\n")
+            pkg = os.path.join(tmp, "geomesa_tpu", "serve")
+            os.makedirs(pkg)
+            with open(os.path.join(pkg, "handler.py"), "w") as fh:
+                fh.write(src)
+            return lint_paths([os.path.join(tmp, "geomesa_tpu")],
+                              rules=sorted(want), extra_ref_paths=[])
+
+    findings = run(dirty)
+    fired = {f.rule for f in findings if not f.waived}
+    rc = exit_code(findings, "warn")
+    sarif = json.loads(render_sarif(findings))
+    chains = [r for r in sarif["runs"][0]["results"]
+              if r["ruleId"] == "GT29" and r.get("relatedLocations")]
+    leftover = [f.render() for f in run(clean) if not f.waived]
+    missing = sorted(want - fired)
+    print(f"dataflow smoke: {len(findings)} finding(s) on the dirty "
+          f"fixture, rules fired: {sorted(fired)}, clean twin: "
+          f"{len(leftover)} finding(s)", file=sys.stderr)
+    if rc == 0 or missing:
+        print(f"dataflow smoke: FAIL the dirty fixture must trip the "
+              f"gate (exit {rc}, missing {missing})", file=sys.stderr)
+        return 1
+    if not chains:
+        print("dataflow smoke: FAIL GT29 SARIF result carries no "
+              "relatedLocations provenance chain", file=sys.stderr)
+        return 1
+    if leftover:
+        print(f"dataflow smoke: FAIL clean twin not clean: {leftover}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def warmup_smoke(manifest_path: str = SMOKE_MANIFEST) -> int:
     """`gmtpu warmup --check` against the fixture manifest, pinned to
     CPU (the fixture records interpret-mode kernels; this gate must run
@@ -832,6 +935,11 @@ def main(argv=None) -> int:
                    help="skip the SPMD-pass smoke (known-dirty fixture "
                         "must fire GT24..GT27 and trip the gate; text "
                         "mode only)")
+    p.add_argument("--no-dataflow-smoke", action="store_true",
+                   help="skip the dataflow-pass smoke (known-dirty "
+                        "serve fixture must fire GT28..GT31 with a "
+                        "GT29 SARIF provenance chain, clean twin must "
+                        "stay silent; text mode only)")
     p.add_argument("--no-warmup-smoke", action="store_true",
                    help="skip the warmup-manifest smoke (it runs only "
                         "in text mode; json/sarif stdout stays pure)")
@@ -883,6 +991,8 @@ def main(argv=None) -> int:
     rc = exit_code(findings, "warn")
     if args.format == "text" and not args.no_spmd_smoke and rc == 0:
         rc = spmd_smoke()
+    if args.format == "text" and not args.no_dataflow_smoke and rc == 0:
+        rc = dataflow_smoke()
     if args.format == "text" and not args.no_warmup_smoke and rc == 0:
         rc = warmup_smoke()
     if args.format == "text" and not args.no_chaos_smoke and rc == 0:

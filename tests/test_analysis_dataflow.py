@@ -1,0 +1,1005 @@
+"""Provenance dataflow pass tests (gmtpu-lint GT28..GT31).
+
+Per rule: a dirty fixture (exact rule codes + line numbers), a clean
+twin for every precision guard (bucketing recognition, interprocedural
+marker resolution, registration universes, hot-path scoping), the
+anchor-waiver channel, and the chain-origin waiver channel (a
+`# gt: waive GTnn` where the shape is BORN suppresses the downstream
+dispatch finding, including across files). The pre-fix shapes of every
+true positive this pass found on the shipped tree — the len(batch)
+ones-weight and bin-dtg extents in plan/runner, the unbucketed
+histogram/vocab static args in run_stats, the raw uncertain-query
+fallback tile in engine/grid_index — are replayed as faithful excerpts
+so a regression that stops a rule matching its real catch fails here,
+not in production review.
+
+Also here: the incremental engine's dataflow contract — warm and
+partial runs byte-identical to a cold scan with the provenance chains
+(SARIF relatedLocations) surviving the cache round trip, warm replay
+with zero re-analysis, the ruleset-fingerprint stamp invalidating
+caches written by an older rule set, two concurrent lint processes
+racing the tmp+rename cache write — plus the single-build discipline
+(SPMD and dataflow passes share one `build_project`, one flow
+extraction per module) and the `--changed` scope resolver.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from geomesa_tpu.analysis.incremental import (
+    DEFAULT_CACHE_FILENAME, _ruleset_sig, lint_paths_incremental)
+from geomesa_tpu.analysis.linter import (
+    changed_paths, lint_paths, render_json, render_sarif)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DATAFLOW = ["GT28", "GT29", "GT30", "GT31"]
+SPMD = ["GT24", "GT25", "GT26", "GT27"]
+
+
+def write_tree(tmp_path, files):
+    """Materialize a miniature repo: pyproject.toml marks the root so
+    fixture modules get project-relative paths (geomesa_tpu/...) — the
+    hot-path scoping (GT28/GT31) and module-name resolution key on
+    them."""
+    (tmp_path / "pyproject.toml").write_text(
+        "[project]\nname = \"dataflow-fixture\"\n")
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def lint_tree(tmp_path, files, rules=DATAFLOW, **kw):
+    write_tree(tmp_path, files)
+    return lint_paths([str(tmp_path / "geomesa_tpu")], rules=rules,
+                      extra_ref_paths=[], **kw)
+
+
+def active(findings):
+    return [f for f in findings if not f.waived]
+
+
+def codes_lines(findings):
+    return {(f.rule, f.line) for f in active(findings)}
+
+
+# -- GT28: raw shape reaching a dispatch -------------------------------------
+
+
+DIRTY_GT28 = """\
+    import jax
+    import numpy as np
+
+
+    @jax.jit
+    def score(x):
+        return x * 2.0
+
+
+    def handle(payload):
+        qx = np.frombuffer(payload)
+        return score(qx)
+"""
+
+
+class TestGT28RawShapeDispatch:
+    def test_raw_wire_extent_reaches_jit(self, tmp_path):
+        fs = lint_tree(tmp_path,
+                       {"geomesa_tpu/serve/handler.py": DIRTY_GT28})
+        assert codes_lines(fs) == {("GT28", 12)}
+        (f,) = active(fs)
+        # the provenance chain walks back to the frombuffer origin
+        chain = f.extra["chain"]
+        assert any(s["line"] == 11 and "frombuffer" in s["note"]
+                   for s in chain)
+
+    def test_clean_bucketed_twin(self, tmp_path):
+        fs = lint_tree(tmp_path, {"geomesa_tpu/serve/handler.py": """\
+            import jax
+            import numpy as np
+
+
+            @jax.jit
+            def score(x):
+                return x * 2.0
+
+
+            def next_pow2(n):
+                p = 1
+                while p < n:
+                    p *= 2
+                return p
+
+
+            def pad_to(x, n):
+                return np.concatenate([x, np.zeros(n - len(x))])
+
+
+            def handle(payload):
+                raw = np.frombuffer(payload)
+                qx = pad_to(raw, next_pow2(max(len(raw), 1)))
+                return score(qx)
+        """})
+        assert not active(fs)
+
+    def test_interprocedural_raw_through_helper(self, tmp_path):
+        # the shape is born in one module and dispatched in another:
+        # the param:qx marker resolves against launch's callers
+        fs = lint_tree(tmp_path, {
+            "geomesa_tpu/serve/entry.py": """\
+                import numpy as np
+
+                from geomesa_tpu.serve.work import launch
+
+
+                def handle(payload):
+                    qx = np.frombuffer(payload)
+                    return launch(qx)
+            """,
+            "geomesa_tpu/serve/work.py": """\
+                import jax
+
+
+                @jax.jit
+                def score(x):
+                    return x * 2.0
+
+
+                def launch(qx):
+                    return score(qx)
+            """,
+        })
+        assert codes_lines(fs) == {("GT28", 10)}
+        (f,) = active(fs)
+        assert f.path.endswith("work.py")
+        # the cross-file chain names the caller that passed the raw in
+        assert any(s["path"].endswith("entry.py")
+                   for s in f.extra["chain"])
+
+    def test_path_scope_cold_module_silent(self, tmp_path):
+        # one-shot scripts and CLI helpers dispatch raw shapes
+        # legitimately: the same code outside serve//plan//subscribe//
+        # engine/ does not fire
+        fs = lint_tree(tmp_path,
+                       {"geomesa_tpu/cli/handler.py": DIRTY_GT28},
+                       rules=["GT28"])
+        assert not fs
+
+    def test_anchor_waiver(self, tmp_path):
+        fs = lint_tree(tmp_path, {"geomesa_tpu/serve/handler.py": """\
+            import jax
+            import numpy as np
+
+
+            @jax.jit
+            def score(x):
+                return x * 2.0
+
+
+            def handle(payload):
+                qx = np.frombuffer(payload)
+                return score(qx)  # gt: waive GT28
+        """})
+        assert not active(fs)
+        assert [(f.rule, f.waived) for f in fs] == [("GT28", True)]
+
+    def test_origin_chain_waiver(self, tmp_path):
+        # waive where the shape is BORN: a directive on the raw origin
+        # suppresses the downstream dispatch finding entirely
+        fs = lint_tree(tmp_path, {"geomesa_tpu/serve/handler.py": """\
+            import jax
+            import numpy as np
+
+
+            @jax.jit
+            def score(x):
+                return x * 2.0
+
+
+            def handle(payload):
+                # request-scoped probe: bounded by the protocol cap
+                # gt: waive GT28
+                qx = np.frombuffer(payload)
+                return score(qx)
+        """})
+        assert not fs
+
+    def test_origin_chain_waiver_cross_file(self, tmp_path):
+        # the origin waiver reaches dispatches in OTHER modules: one
+        # directive at the birth site instead of one per consumer
+        fs = lint_tree(tmp_path, {
+            "geomesa_tpu/serve/entry.py": """\
+                import numpy as np
+
+                from geomesa_tpu.serve.work import launch
+
+
+                def handle(payload):
+                    # request-scoped probe: bounded by the protocol cap
+                    # gt: waive GT28
+                    qx = np.frombuffer(payload)
+                    return launch(qx)
+            """,
+            "geomesa_tpu/serve/work.py": """\
+                import jax
+
+
+                @jax.jit
+                def score(x):
+                    return x * 2.0
+
+
+                def launch(qx):
+                    return score(qx)
+            """,
+        })
+        assert not fs
+
+
+# -- GT29: f32 laundered into an exact-f64 consumer --------------------------
+
+
+DIRTY_GT29 = """\
+    import numpy as np
+
+
+    def refine(q):
+        small = np.asarray(q, np.float32)
+        exact = small.astype(np.float64)
+        return exact
+"""
+
+
+class TestGT29F32Launder:
+    def test_astype_launder(self, tmp_path):
+        fs = lint_tree(tmp_path,
+                       {"geomesa_tpu/serve/refine.py": DIRTY_GT29})
+        assert codes_lines(fs) == {("GT29", 6)}
+        (f,) = active(fs)
+        # the chain walks back to the rounding cast
+        assert any(s["line"] == 5 and "f32 cast" in s["note"]
+                   for s in f.extra["chain"])
+
+    def test_clean_f64_from_source(self, tmp_path):
+        fs = lint_tree(tmp_path, {"geomesa_tpu/serve/refine.py": """\
+            import numpy as np
+
+
+            def refine(q):
+                canon = np.asarray(q, np.float64)
+                out = canon.astype(np.float64)
+                return out
+        """})
+        assert not fs
+
+    def test_interprocedural_f64_param(self, tmp_path):
+        # an f32-cast value fed to a callee parameter named *_f64:
+        # the consumer's name states the exactness contract
+        fs = lint_tree(tmp_path, {
+            "geomesa_tpu/serve/dists.py": """\
+                def canonical(dists_f64):
+                    return dists_f64.sum()
+            """,
+            "geomesa_tpu/serve/refine.py": """\
+                import numpy as np
+
+                from geomesa_tpu.serve.dists import canonical
+
+
+                def go(q):
+                    small = np.asarray(q, np.float32)
+                    return canonical(small)
+            """,
+        })
+        assert codes_lines(fs) == {("GT29", 8)}
+
+    def test_clean_f64_param_fed_f64(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "geomesa_tpu/serve/dists.py": """\
+                def canonical(dists_f64):
+                    return dists_f64.sum()
+            """,
+            "geomesa_tpu/serve/refine.py": """\
+                import numpy as np
+
+                from geomesa_tpu.serve.dists import canonical
+
+
+                def go(q):
+                    exact = np.asarray(q, np.float64)
+                    return canonical(exact)
+            """,
+        })
+        assert not fs
+
+    def test_anchor_waiver(self, tmp_path):
+        fs = lint_tree(tmp_path, {"geomesa_tpu/serve/refine.py": """\
+            import numpy as np
+
+
+            def refine(q):
+                small = np.asarray(q, np.float32)
+                exact = small.astype(np.float64)  # gt: waive GT29
+                return exact
+        """})
+        assert not active(fs)
+        assert [(f.rule, f.waived) for f in fs] == [("GT29", True)]
+
+    def test_origin_chain_waiver(self, tmp_path):
+        fs = lint_tree(tmp_path, {"geomesa_tpu/serve/refine.py": """\
+            import numpy as np
+
+
+            def refine(q):
+                # probe only feeds a tolerance check
+                # gt: waive GT29
+                small = np.asarray(q, np.float32)
+                exact = small.astype(np.float64)
+                return exact
+        """})
+        assert not fs
+
+    def test_sarif_carries_provenance_chain(self, tmp_path):
+        fs = lint_tree(tmp_path,
+                       {"geomesa_tpu/serve/refine.py": DIRTY_GT29})
+        doc = json.loads(render_sarif(fs))
+        (result,) = [r for r in doc["runs"][0]["results"]
+                     if r["ruleId"] == "GT29"]
+        related = result["relatedLocations"]
+        assert related, "GT29 must render its chain as relatedLocations"
+        assert any("f32 cast" in loc["message"]["text"]
+                   for loc in related)
+        assert all(
+            loc["physicalLocation"]["artifactLocation"]["uri"].endswith(
+                "refine.py") for loc in related)
+
+
+# -- GT30: unmatchable registry key ------------------------------------------
+
+
+class TestGT30UnmatchableKey:
+    def test_unregistered_serve_variant(self, tmp_path):
+        fs = lint_tree(tmp_path, {"geomesa_tpu/serve/lookup.py": """\
+            def fetch(registry, q):
+                handle = registry.compile("knn.score@serve", q)
+                return handle.call(q)
+        """})
+        assert codes_lines(fs) == {("GT30", 2)}
+        (f,) = active(fs)
+        assert "serve_variant" in f.message
+
+    def test_ring_depth_mismatch(self, tmp_path):
+        # registered at depth 2, looked up at depth 4: the manifest
+        # can never warm the caller's key
+        fs = lint_tree(tmp_path, {
+            "geomesa_tpu/serve/reg.py": """\
+                def install(registry, fn):
+                    registry.register("knn.score", fn)
+                    registry.ring_variant("knn.score", 2, fn=fn)
+            """,
+            "geomesa_tpu/serve/lookup.py": """\
+                def fetch(registry, q):
+                    h = registry.compile("knn.score@ring4", q)
+                    return h.call(q)
+            """,
+        })
+        assert codes_lines(fs) == {("GT30", 2)}
+        (f,) = active(fs)
+        assert f.path.endswith("lookup.py")
+        assert "depth 4" in f.message
+
+    def test_clean_registered_in_scan_set(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "geomesa_tpu/serve/reg.py": """\
+                def install(registry, fn):
+                    registry.register("knn.score", fn)
+                    registry.serve_variant("knn.score", fn=fn)
+                    registry.ring_variant("knn.score", 2, fn=fn)
+            """,
+            "geomesa_tpu/serve/lookup.py": """\
+                def fetch(registry, q):
+                    a = registry.compile("knn.score@serve", q)
+                    b = registry.compile("knn.score@ring2", q)
+                    return a.call(q), b.call(q)
+            """,
+        })
+        assert not fs
+
+    def test_registration_in_reference_universe(self, tmp_path):
+        # the GT05 discipline: a subset scan must still see
+        # registration sites OUTSIDE the scan set
+        files = {
+            "geomesa_tpu/serve/lookup.py": """\
+                def fetch(registry, q):
+                    h = registry.compile("knn.score@serve", q)
+                    return h.call(q)
+            """,
+            "tools/install.py": """\
+                def install(registry, fn):
+                    registry.serve_variant("knn.score", fn=fn)
+            """,
+        }
+        write_tree(tmp_path, files)
+        scan = [str(tmp_path / "geomesa_tpu")]
+        blind = lint_paths(scan, rules=["GT30"], extra_ref_paths=[])
+        assert codes_lines(blind) == {("GT30", 2)}
+        seeing = lint_paths(scan, rules=["GT30"],
+                            extra_ref_paths=[str(tmp_path / "tools")])
+        assert not seeing
+
+    def test_dynamic_registration_wildcards(self, tmp_path):
+        # computed registration names wildcard that variant space;
+        # install_defaults wildcards the base key space
+        fs = lint_tree(tmp_path, {
+            "geomesa_tpu/serve/reg.py": """\
+                def install(registry, fn, name):
+                    registry.install_defaults()
+                    registry.serve_variant(name, fn=fn)
+            """,
+            "geomesa_tpu/serve/lookup.py": """\
+                def fetch(registry, q):
+                    a = registry.compile("anything.goes@serve", q)
+                    b = registry.compile("some.base.key", q)
+                    return a.call(q), b.call(q)
+            """,
+        })
+        assert not fs
+
+    def test_base_key_registered_nowhere(self, tmp_path):
+        fs = lint_tree(tmp_path, {"geomesa_tpu/serve/lookup.py": """\
+            def fetch(registry, q):
+                h = registry.compile("ghost.key", q)
+                return h.call(q)
+        """})
+        assert codes_lines(fs) == {("GT30", 2)}
+        (f,) = active(fs)
+        assert "registered nowhere" in f.message
+
+    def test_anchor_waiver(self, tmp_path):
+        fs = lint_tree(tmp_path, {"geomesa_tpu/serve/lookup.py": """\
+            def fetch(registry, q):
+                h = registry.compile("ghost.key@serve", q)  # gt: waive GT30
+                return h.call(q)
+        """})
+        assert not active(fs)
+        assert [(f.rule, f.waived) for f in fs] == [("GT30", True)]
+
+
+# -- GT31: device->host->device bounce ---------------------------------------
+
+
+DIRTY_GT31 = """\
+    import jax
+
+
+    @jax.jit
+    def score(x):
+        return x * 2.0
+
+
+    def pump(out):
+        host = jax.device_get(out)
+        back = jax.device_put(host)
+        return score(host), back
+"""
+
+
+class TestGT31HostBounce:
+    def test_bounce_through_put_and_dispatch(self, tmp_path):
+        fs = lint_tree(tmp_path,
+                       {"geomesa_tpu/serve/pump.py": DIRTY_GT31})
+        assert codes_lines(fs) == {("GT31", 11), ("GT31", 12)}
+        for f in active(fs):
+            assert any("device_get" in s["note"]
+                       for s in f.extra["chain"])
+
+    def test_clean_host_only_consumer(self, tmp_path):
+        # fetching to host for a host-side consumer is the normal exit
+        # path; only RE-ENTERING the device is the bounce
+        fs = lint_tree(tmp_path, {"geomesa_tpu/serve/pump.py": """\
+            import jax
+
+
+            def finish(out):
+                host = jax.device_get(out)
+                return host.tolist()
+        """})
+        assert not fs
+
+    def test_path_scope_cold_module_silent(self, tmp_path):
+        fs = lint_tree(tmp_path,
+                       {"geomesa_tpu/store/pump.py": DIRTY_GT31},
+                       rules=["GT31"])
+        assert not fs
+
+    def test_origin_chain_waiver(self, tmp_path):
+        fs = lint_tree(tmp_path, {"geomesa_tpu/serve/pump.py": """\
+            import jax
+
+
+            def pump(out):
+                # snapshot seam: the host copy is the checkpoint format
+                # gt: waive GT31
+                host = jax.device_get(out)
+                return jax.device_put(host)
+        """})
+        assert not fs
+
+
+# -- pre-fix replays of the true positives this pass found -------------------
+
+
+class TestPreFixReplays:
+    """Faithful excerpts of the shipped-tree true positives, pre-fix:
+    a regression that stops GT28 matching its real catches fails here."""
+
+    def test_density_ones_weight_len_batch(self, tmp_path):
+        # plan/runner.py density_device_grid, pre-fix: the ones-weight
+        # sized by len(batch) instead of the staged coordinate array
+        fs = lint_tree(tmp_path, {
+            "geomesa_tpu/engine/density.py": """\
+                import jax
+
+
+                @jax.jit
+                def density_grid(x, y, w):
+                    return (x * w).sum() + y.sum()
+            """,
+            "geomesa_tpu/plan/runner.py": """\
+                import jax.numpy as jnp
+
+                from geomesa_tpu.engine.density import density_grid
+
+
+                def density_device_grid(dev, batch, g):
+                    w = jnp.ones(len(batch), jnp.float32)
+                    return density_grid(dev[g + "__x"], dev[g + "__y"], w)
+            """,
+        })
+        assert codes_lines(fs) == {("GT28", 8)}
+
+    def test_density_ones_weight_fixed_shape_clean(self, tmp_path):
+        # the shipped fix: tie the weight extent to the staged device
+        # array (whatever capacity bucket the batch was padded to)
+        fs = lint_tree(tmp_path, {
+            "geomesa_tpu/engine/density.py": """\
+                import jax
+
+
+                @jax.jit
+                def density_grid(x, y, w):
+                    return (x * w).sum() + y.sum()
+            """,
+            "geomesa_tpu/plan/runner.py": """\
+                import jax.numpy as jnp
+
+                from geomesa_tpu.engine.density import density_grid
+
+
+                def density_device_grid(dev, batch, g):
+                    w = jnp.ones_like(dev[g + "__x"], dtype=jnp.float32)
+                    return density_grid(dev[g + "__x"], dev[g + "__y"], w)
+            """,
+        })
+        assert not fs
+
+    def test_bin_dtg_zeros_len_batch(self, tmp_path):
+        # plan/runner.py bin path, pre-fix: the dtg placeholder sized
+        # by len(batch) forked the bin_pack executable per batch length
+        fs = lint_tree(tmp_path, {
+            "geomesa_tpu/engine/bin.py": """\
+                import jax
+
+
+                @jax.jit
+                def bin_pack(track, dtg, y, x):
+                    return track.sum() + dtg.sum() + y.sum() + x.sum()
+            """,
+            "geomesa_tpu/plan/runner.py": """\
+                import jax.numpy as jnp
+
+                from geomesa_tpu.engine.bin import bin_pack
+
+
+                def run_bin(dev, batch, g, d=None):
+                    dtg = dev[d] if d else jnp.zeros(len(batch), jnp.int64)
+                    return bin_pack(jnp.asarray(batch), dtg, dev[g + "__y"],
+                                    dev[g + "__x"])
+            """,
+        })
+        assert codes_lines(fs) == {("GT28", 8)}
+
+    def test_stats_unbucketed_static_args(self, tmp_path):
+        # plan/runner.py run_stats, pre-fix: len(ub) time-bin count and
+        # the per-column vocab size fed as static args — every distinct
+        # value compiled a fresh histogram/value-count executable
+        fs = lint_tree(tmp_path, {"geomesa_tpu/plan/stats.py": """\
+            import jax
+
+
+            @jax.jit
+            def z3_histogram(z, tb, mask, nbins):
+                return z.sum() + tb.sum() + mask.sum() + nbins
+
+
+            @jax.jit
+            def masked_value_counts(codes, mask, nvals):
+                return codes.sum() + mask.sum() + nvals
+
+
+            def run_stats(dev, ub, vocab, jmask):
+                grids = z3_histogram(dev["z"], dev["tb"], jmask, len(ub))
+                counts = masked_value_counts(dev["codes"], jmask,
+                                             max(len(vocab), 1))
+                return grids, counts
+        """})
+        assert codes_lines(fs) == {("GT28", 15), ("GT28", 16)}
+
+    def test_stats_bucketed_static_args_clean(self, tmp_path):
+        # the shipped fix: pow2-bucket both static args (the result
+        # slice drops the padded tail)
+        fs = lint_tree(tmp_path, {"geomesa_tpu/plan/stats.py": """\
+            import jax
+
+
+            @jax.jit
+            def z3_histogram(z, tb, mask, nbins):
+                return z.sum() + tb.sum() + mask.sum() + nbins
+
+
+            @jax.jit
+            def masked_value_counts(codes, mask, nvals):
+                return codes.sum() + mask.sum() + nvals
+
+
+            def next_pow2(n):
+                p = 1
+                while p < n:
+                    p *= 2
+                return p
+
+
+            def run_stats(dev, ub, vocab, jmask):
+                grids = z3_histogram(dev["z"], dev["tb"], jmask,
+                                     next_pow2(max(len(ub), 1)))
+                counts = masked_value_counts(dev["codes"], jmask,
+                                             next_pow2(max(len(vocab), 1)))
+                return grids, counts
+        """})
+        assert not fs
+
+    def test_grid_index_fallback_tile(self, tmp_path):
+        # engine/grid_index.py knn_indexed, pre-fix: the uncertain-query
+        # fallback gathered a raw row set and sized query_tile from it
+        fs = lint_tree(tmp_path, {"geomesa_tpu/engine/gridx.py": """\
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+
+            @jax.jit
+            def knn(qx, qy, k=8, query_tile=64):
+                return qx.sum() + qy.sum()
+
+
+            def knn_indexed(qx, qy, flags, k):
+                rows = np.nonzero(flags)[0]
+                return knn(
+                    jnp.take(qx, jnp.asarray(rows)),
+                    jnp.take(qy, jnp.asarray(rows)),
+                    k=k,
+                    query_tile=max(1, min(1024, len(rows))),
+                )
+        """})
+        assert codes_lines(fs) == {("GT28", 13)}
+
+    def test_grid_index_fallback_bucketed_clean(self, tmp_path):
+        # the shipped fix: pow2-pad the fallback row set (padded slots
+        # re-run rows[0]; the slice drops them before the scatter-back)
+        fs = lint_tree(tmp_path, {"geomesa_tpu/engine/gridx.py": """\
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+
+            @jax.jit
+            def knn(qx, qy, k=8, query_tile=64):
+                return qx.sum() + qy.sum()
+
+
+            def next_pow2(n):
+                p = 1
+                while p < n:
+                    p *= 2
+                return p
+
+
+            def knn_indexed(qx, qy, flags, k):
+                rows = np.nonzero(flags)[0]
+                nb = next_pow2(max(len(rows), 1))
+                rpad = np.concatenate(
+                    [rows, np.full(nb - len(rows), rows[0], rows.dtype)])
+                return knn(
+                    jnp.take(qx, jnp.asarray(rpad)),
+                    jnp.take(qy, jnp.asarray(rpad)),
+                    k=k,
+                    query_tile=max(1, min(1024, nb)),
+                )
+        """})
+        assert not fs
+
+
+# -- the shipped tree itself -------------------------------------------------
+
+
+class TestSelfLint:
+    def test_shipped_tree_clean_under_dataflow(self):
+        fs = lint_paths([os.path.join(REPO_ROOT, "geomesa_tpu")],
+                        rules=DATAFLOW)
+        assert not active(fs), render_json(active(fs))
+        # the deliberate data-axis shapes (calibration plans, per-layer
+        # tiling) and accumulation-only upcasts are documented waivers
+        assert any(f.waived for f in fs)
+
+
+# -- incremental engine with the dataflow pass -------------------------------
+
+
+class TestIncrementalDataflow:
+    FILES = {
+        "geomesa_tpu/serve/handler.py": DIRTY_GT28,
+        "geomesa_tpu/serve/refine.py": DIRTY_GT29,
+        "geomesa_tpu/cql/util.py": """\
+            def ident(x):
+                return x
+        """,
+    }
+
+    def test_warm_and_partial_byte_identical(self, tmp_path):
+        write_tree(tmp_path, self.FILES)
+        scan = [str(tmp_path / "geomesa_tpu")]
+        cold = lint_paths(scan, rules=DATAFLOW)
+        assert codes_lines(cold) == {("GT28", 12), ("GT29", 6)}
+        # provenance chains ride Finding.extra, never the JSON render
+        assert '"extra"' not in render_json(cold)
+        inc1 = lint_paths_incremental(scan, rules=DATAFLOW)
+        assert (tmp_path / DEFAULT_CACHE_FILENAME).exists()
+        inc2 = lint_paths_incremental(scan, rules=DATAFLOW)  # warm
+        assert render_json(cold) == render_json(inc1) == render_json(inc2)
+
+        # edit: a new f32-launder must surface through the cache, and
+        # the replayed findings must still match a cold scan
+        mod = tmp_path / "geomesa_tpu" / "cql" / "util.py"
+        mod.write_text(textwrap.dedent("""\
+            import numpy as np
+
+
+            def launder(q):
+                small = np.asarray(q, np.float32)
+                return small.astype(np.float64)
+        """))
+        inc3 = lint_paths_incremental(scan, rules=DATAFLOW)
+        cold3 = lint_paths(scan, rules=DATAFLOW)
+        assert render_json(cold3) == render_json(inc3)
+        assert any(f.path.endswith("util.py") for f in active(inc3))
+        assert codes_lines(inc1) <= codes_lines(inc3)
+
+    def test_warm_replay_does_not_reparse(self, tmp_path, monkeypatch):
+        write_tree(tmp_path, self.FILES)
+        scan = [str(tmp_path / "geomesa_tpu")]
+        lint_paths_incremental(scan, rules=DATAFLOW)
+        import geomesa_tpu.analysis.incremental as inc_mod
+
+        def boom(*a, **k):
+            raise AssertionError("warm replay must not build a project")
+
+        monkeypatch.setattr(inc_mod, "build_project", boom)
+        warm = lint_paths_incremental(scan, rules=DATAFLOW)
+        assert codes_lines(warm) == {("GT28", 12), ("GT29", 6)}
+
+    def test_chain_survives_cache_roundtrip(self, tmp_path):
+        # a warm replay's SARIF must carry the same relatedLocations as
+        # a cold scan: Finding.extra rides the cache
+        write_tree(tmp_path, self.FILES)
+        scan = [str(tmp_path / "geomesa_tpu")]
+        cold = lint_paths(scan, rules=DATAFLOW)
+        lint_paths_incremental(scan, rules=DATAFLOW)
+        warm = lint_paths_incremental(scan, rules=DATAFLOW)
+        assert render_sarif(warm) == render_sarif(cold)
+        assert "relatedLocations" in render_sarif(warm)
+
+    def test_ruleset_stamp_invalidates_stale_cache(self, tmp_path):
+        # satellite: a cache written by an older rule set must fall
+        # through to a cold scan, never warm-replay stale findings
+        write_tree(tmp_path, self.FILES)
+        scan = [str(tmp_path / "geomesa_tpu")]
+        cold = lint_paths(scan, rules=DATAFLOW)
+        lint_paths_incremental(scan, rules=DATAFLOW)
+        cache = tmp_path / DEFAULT_CACHE_FILENAME
+        doc = json.loads(cache.read_text())
+        assert doc["ruleset"] == _ruleset_sig()
+        # doctor the stamp AND the payload: a buggy warm replay would
+        # now return zero findings
+        doc["ruleset"] = "written-by-an-older-rule-set"
+        doc["findings"] = []
+        cache.write_text(json.dumps(doc))
+        inc = lint_paths_incremental(scan, rules=DATAFLOW)
+        assert render_json(inc) == render_json(cold)
+        # and the rewrite restamped the cache: next run replays warm
+        doc2 = json.loads(cache.read_text())
+        assert doc2["ruleset"] == _ruleset_sig()
+        assert doc2["findings"]
+
+    def test_concurrent_processes_race_cache_write(self, tmp_path):
+        # satellite: two lint processes racing the tmp+rename cache
+        # write — both report byte-identical to a cold scan and the
+        # surviving cache is uncorrupted (pid-suffixed tmp names)
+        write_tree(tmp_path, self.FILES)
+        scan_dir = str(tmp_path / "geomesa_tpu")
+        cold = render_json(lint_paths([scan_dir], rules=DATAFLOW))
+        prog = textwrap.dedent("""\
+            import sys
+
+            from geomesa_tpu.analysis.incremental import \\
+                lint_paths_incremental
+            from geomesa_tpu.analysis.linter import render_json
+
+            fs = lint_paths_incremental(
+                [sys.argv[1]],
+                rules=["GT28", "GT29", "GT30", "GT31"])
+            sys.stdout.write(render_json(fs))
+        """)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", prog, scan_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=REPO_ROOT, env=env) for _ in range(2)]
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err
+            outs.append(out)
+        assert outs[0] == cold
+        assert outs[1] == cold
+        doc = json.loads((tmp_path / DEFAULT_CACHE_FILENAME).read_text())
+        assert doc["findings"]
+        # no orphaned tmp files leaked by the race
+        leftovers = [n for n in os.listdir(tmp_path)
+                     if n.startswith(DEFAULT_CACHE_FILENAME + ".tmp")]
+        assert not leftovers
+
+
+# -- single-build discipline -------------------------------------------------
+
+
+class TestSingleBuild:
+    def test_spmd_and_dataflow_share_one_project_pass(
+            self, tmp_path, monkeypatch):
+        # one build_project per lint run and one flow extraction per
+        # module, however many dataflow rules consume the index
+        write_tree(tmp_path, {
+            "geomesa_tpu/serve/handler.py": DIRTY_GT28,
+            "geomesa_tpu/parallel/ops.py": """\
+                import jax
+                from jax import lax
+
+
+                def merge(x):
+                    return lax.psum(x, "shard")
+            """,
+        })
+        import geomesa_tpu.analysis.dataflow as df_mod
+        import geomesa_tpu.analysis.linter as lint_mod
+
+        builds = []
+        real_build = lint_mod.build_project
+
+        def counting_build(*a, **k):
+            builds.append(1)
+            return real_build(*a, **k)
+
+        extracted = []
+        real_extract = df_mod.extract_flow
+
+        def counting_extract(mod):
+            extracted.append(mod.relpath)
+            return real_extract(mod)
+
+        monkeypatch.setattr(lint_mod, "build_project", counting_build)
+        monkeypatch.setattr(df_mod, "extract_flow", counting_extract)
+        fs = lint_paths([str(tmp_path / "geomesa_tpu")],
+                        rules=sorted(set(SPMD) | set(DATAFLOW)),
+                        extra_ref_paths=[])
+        assert {f.rule for f in active(fs)} == {"GT24", "GT28"}
+        assert len(builds) == 1
+        assert sorted(extracted) == [
+            "geomesa_tpu/parallel/ops.py",
+            "geomesa_tpu/serve/handler.py",
+        ]
+
+
+# -- `gmtpu lint --changed` scope resolution ---------------------------------
+
+
+class TestChangedPaths:
+    def _git(self, cwd, *args):
+        r = subprocess.run(
+            ["git", "-c", "user.email=t@fixture", "-c", "user.name=t",
+             *args],
+            cwd=cwd, capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stderr
+        return r.stdout
+
+    def test_changed_scope_and_untracked(self, tmp_path):
+        write_tree(tmp_path, {
+            "geomesa_tpu/serve/handler.py": DIRTY_GT28,
+            "geomesa_tpu/cql/util.py": """\
+                def ident(x):
+                    return x
+            """,
+        })
+        (tmp_path / "tool.py").write_text("X = 1\n")
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "add", "-A")
+        self._git(tmp_path, "commit", "-q", "-m", "seed")
+        # modify one tracked file in scope, add one untracked file in
+        # scope, modify one OUT of scope
+        (tmp_path / "geomesa_tpu" / "cql" / "util.py").write_text(
+            "def ident(x):\n    return x  # touched\n")
+        new = tmp_path / "geomesa_tpu" / "serve" / "fresh.py"
+        new.write_text("Y = 2\n")
+        (tmp_path / "tool.py").write_text("X = 3\n")
+        got = changed_paths([str(tmp_path / "geomesa_tpu")], "HEAD")
+        rels = sorted(os.path.relpath(p, tmp_path).replace(os.sep, "/")
+                      for p in got)
+        assert rels == ["geomesa_tpu/cql/util.py",
+                        "geomesa_tpu/serve/fresh.py"]
+
+    def test_unborn_head_falls_back_to_empty_tree(self, tmp_path):
+        # the pre-commit hook's default ref is HEAD, which does not
+        # exist before the initial commit — changed_paths degrades to
+        # the empty tree so the very first commit lints its staged
+        # files instead of dying on `git diff HEAD`
+        write_tree(tmp_path, {
+            "geomesa_tpu/serve/handler.py": DIRTY_GT28,
+        })
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "add", "-A")
+        got = changed_paths([str(tmp_path / "geomesa_tpu")], "HEAD")
+        rels = sorted(os.path.relpath(p, tmp_path).replace(os.sep, "/")
+                      for p in got)
+        assert rels == ["geomesa_tpu/serve/handler.py"]
+        # an explicitly bad ref still errors
+        with pytest.raises(RuntimeError, match="no-such-ref"):
+            changed_paths([str(tmp_path / "geomesa_tpu")], "no-such-ref")
+
+    def test_narrow_scan_keeps_registration_universe(self, tmp_path):
+        # the guarantee a changed-only run DOES keep: the registration
+        # universe (GT30, like GT05/GT13) spans the whole repo, so a
+        # one-file scan of the lookup module still sees the
+        # registration site in the unchanged module and stays clean —
+        # narrowing never invents a false unmatchable-key finding
+        write_tree(tmp_path, {
+            "geomesa_tpu/serve/reg.py": """\
+                def install(registry, fn):
+                    registry.serve_variant("knn.score", fn=fn)
+            """,
+            "geomesa_tpu/serve/lookup.py": """\
+                def fetch(registry, q):
+                    h = registry.compile("knn.score@serve", q)
+                    return h.call(q)
+            """,
+        })
+        narrow = lint_paths(
+            [str(tmp_path / "geomesa_tpu" / "serve" / "lookup.py")],
+            rules=["GT30"])
+        assert not narrow
