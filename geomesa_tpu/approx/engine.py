@@ -20,6 +20,7 @@ traffic to the EXACT path, never to silent accuracy loss.
 
 from __future__ import annotations
 
+import math
 import time
 from typing import List, Optional
 
@@ -109,6 +110,12 @@ class SketchAnswerEngine:
         self._lock = threading.Lock()
         self._parsed: dict = {}
         self._count_memo: dict = {}
+        # per-(partition, attribute) HyperLogLog sketches for the
+        # distinct tier (fast_distinct), token-matched like the
+        # occupancy store: equal entry tokens imply the partition's
+        # on-disk bytes are exactly what the sketch observed. Bounded,
+        # oldest-first eviction.
+        self._hll_parts: dict = {}
         try:
             kw = {}
             if bins_per_dim is not None:
@@ -362,6 +369,8 @@ class SketchAnswerEngine:
         # never counts the same reason twice.
         meter = build
         hints = query.hints
+        if hints.distinct is not None:
+            return self.fast_distinct(query, build=build)
         if self.store is None:
             return self._miss("ineligible", meter)
         if hints.sampling or hints.loose_bbox or hints.is_stats \
@@ -426,6 +435,130 @@ class SketchAnswerEngine:
 
             return QueryResult("count", count=est, approx=True,
                                bound=float(bound), confidence=1.0,
+                               version=version)
+
+    # -- the distinct tier -------------------------------------------------
+
+    # HLL precision for the distinct tier: p=12 -> 4096 registers,
+    # relative standard error 1.04/sqrt(4096) ~ 1.6%. The wire bound is
+    # the 3-sigma interval, shipped with confidence 0.99 (conservative
+    # for a ~0.997 normal tail).
+    _HLL_P = 12
+    _HLL_RSE = 1.04 / math.sqrt(1 << _HLL_P)
+
+    def _partition_hll(self, name, entries, attr: str, build: bool):
+        """One partition's Cardinality sketch over `attr`: version-exact
+        (entry-token-matched, like the occupancy store) and built from a
+        PINNED scan of exactly `entries`' files. Raises StaleSketch on a
+        cold miss with builds deferred (the admission peek) or a pinned
+        read lost to compaction."""
+        from geomesa_tpu.approx.sketches import entry_token
+        from geomesa_tpu.stats.sketches import Cardinality
+
+        token = entry_token(entries)
+        key = (name, attr)
+        with self._lock:
+            got = self._hll_parts.get(key)
+        if got is not None and got[0] == token:
+            return got[1]
+        if not (build and self.allow_build):
+            raise StaleSketch(name, "builds disabled")
+        sk = Cardinality(attr, p=self._HLL_P)
+        t0 = time.perf_counter()
+        try:
+            batches = list(self.planner.storage.scan_partitions(
+                [name], manifest={name: list(entries)}))
+        except OSError as e:
+            raise StaleSketch(name, f"pinned read failed ({e})") from e
+        from geomesa_tpu.core.columnar import DictColumn
+
+        for batch in batches:
+            if batch.valid is not None and not batch.valid.all():
+                batch = batch.select(batch.valid)
+            col = batch.columns[attr]
+            if isinstance(col, DictColumn):
+                vals = np.asarray(col.decode(), dtype=object)
+                sk.observe(vals[vals != None])  # noqa: E711 — elementwise
+            else:
+                sk.observe(np.asarray(col))
+        try:
+            from geomesa_tpu.utils.metrics import metrics
+
+            metrics.counter("approx.hll_built")
+            metrics.histogram("approx.build").update(
+                time.perf_counter() - t0)
+        except Exception:
+            pass
+        with self._lock:
+            while len(self._hll_parts) > 512:
+                self._hll_parts.pop(next(iter(self._hll_parts)))
+            self._hll_parts[key] = (token, sk)
+        return sk
+
+    def fast_distinct(self, query, build: bool = True):
+        """`distinct`-hinted counts: estimate COUNT(DISTINCT attr) by
+        merging the version-exact per-partition HyperLogLog sketches
+        under ONE manifest snapshot — Cardinality.merge is a register
+        max, associative and lossless, so the merged estimate equals
+        the estimate of one sketch over the whole store. INCLUDE
+        filters only: a predicate changes WHICH rows count, and the
+        partition sketches observed them all. The 3-sigma bound must
+        fit the tolerance, like every other sketch answer; otherwise
+        the caller pays the exact feature scan + host unique count
+        (plan/planner.py count_result)."""
+        meter = build
+        hints = query.hints
+        attr = hints.distinct
+        if hints.sampling or hints.loose_bbox or hints.is_stats \
+                or hints.is_bin or hints.is_arrow or hints.is_density \
+                or hints.topk_cells or query.max_features is not None:
+            return self._miss("ineligible", meter)
+        sft = self.planner.storage.sft
+        if (sft.user_data or {}).get("geomesa.vis.attr"):
+            return self._miss("ineligible", meter)
+        if attr not in sft:
+            return self._miss("ineligible", meter)
+        if not isinstance(query.filter_ast, ast.Include):
+            return self._miss("ineligible", meter)
+        tol = hints.tolerance
+        if tol is None:
+            return self._miss("ineligible", meter)
+        snap_fn = getattr(self.planner.storage, "manifest_snapshot", None)
+        if snap_fn is None:
+            return self._miss("no_snapshot", meter)
+        t0 = time.perf_counter()
+        with TRACER.span("approx.answer"):
+            snap = snap_fn()
+            version = getattr(snap, "version", None)
+            mkey = ("distinct", query.type_name, attr, version)
+            with self._lock:
+                est = self._count_memo.get(mkey)
+            if est is None:
+                from geomesa_tpu.stats.sketches import Cardinality
+
+                merged = Cardinality(attr, p=self._HLL_P)
+                try:
+                    for name, entries in snap.items():
+                        if entries:
+                            merged.merge(self._partition_hll(
+                                name, entries, attr, build))
+                except StaleSketch:
+                    # same cold-vs-raced split as the count tier
+                    return self._miss("cold" if not build
+                                      else "stale_sketch")
+                est = int(round(merged.result()))
+                with self._lock:
+                    if len(self._count_memo) > 512:
+                        self._count_memo.clear()
+                    self._count_memo[mkey] = est
+            bound = int(math.ceil(3.0 * self._HLL_RSE * est))
+            if bound > tol * max(est, 1):
+                return self._miss("bound_exceeded", meter)
+            self._served("distinct", t0)
+            from geomesa_tpu.plan.planner import QueryResult
+
+            return QueryResult("count", count=est, approx=True,
+                               bound=float(bound), confidence=0.99,
                                version=version)
 
     def _region(self, plan):

@@ -280,6 +280,13 @@ def register(sub: "argparse._SubParsersAction") -> None:
                                "windows, cycling)")
     bserve_p.add_argument("--batches", type=int, default=20,
                           help="subscribe mode: kafka batches folded")
+    bserve_p.add_argument("--lanes", action="store_true",
+                          help="subscribe mode: vmapped-lane vs "
+                               "fused-slot comparison at "
+                               "S in {64, 1024, 8192} (docs/SERVING.md "
+                               "\"Standing queries\"); the fused leg "
+                               "is capped at S<=1024 — its compile "
+                               "cost grows super-linearly with S")
     bserve_p.add_argument("--rows", type=int, default=64,
                           help="subscribe mode: rows per kafka batch")
     bserve_p.add_argument("--clients", type=int, default=16,
@@ -685,6 +692,8 @@ def _bench_serve(args) -> int:
         args.wire_rows = min(args.wire_rows, 20_000)
         args.push_sinks = min(args.push_sinks, 128)
     if args.mode == "subscribe":
+        if args.lanes:
+            return _bench_subscribe_lanes(args)
         return _bench_subscribe(args)
     if args.mode == "approx":
         return _bench_approx(args)
@@ -1051,6 +1060,69 @@ def _bench_subscribe(args) -> int:
                         subscriptions=args.subs, batches=args.batches)
     print(json.dumps({"run": "subscribe", **rep.to_json()}))
     return 0
+
+
+def _bench_subscribe_lanes(args) -> int:
+    """`gmtpu bench-serve --mode subscribe --lanes`: the vmapped-lane
+    vs fused-slot comparison (docs/SERVING.md "Standing queries") at
+    S in {64, 1024, 8192} same-class bbox geofences. Each leg runs the
+    identical protocol — register-before-seed, first poll, steady
+    polls, one membership-churn event — on a fresh store, so events
+    match across modes and `speedup` is a wall-clock ratio. The fused
+    leg is capped at S<=1024: its trace+compile grows super-linearly
+    with S (~1 s at S=64, ~120 s at S=1024 on CPU CI), so beyond the
+    cap the sweep reports the lane leg only rather than extrapolating.
+    The verdict gates on the >=10x events/s floor at S=1024 and on
+    lane dispatches-per-poll staying S-independent (<=4 for one
+    geofence class)."""
+    import numpy as np
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.kafka.store import KafkaDataStore
+    from geomesa_tpu.serve.loadgen import run_subscribe_lanes
+
+    sft = SimpleFeatureType.from_spec(
+        "bench_live", "name:String,score:Double,dtg:Date,*geom:Point")
+    n = args.rows
+
+    def make_store():
+        store = KafkaDataStore()
+        store.create_schema(sft)
+        return store
+
+    def make_batch(i: int) -> FeatureBatch:
+        rng = np.random.default_rng(997 * i + 13)
+        return FeatureBatch.from_pydict(sft, {
+            "name": rng.choice(["a", "b", "c"], n).tolist(),
+            "score": rng.uniform(-10, 10, n),
+            "dtg": rng.integers(
+                1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack([rng.uniform(-60, 60, n),
+                              rng.uniform(-30, 30, n)], 1),
+        }, fids=[f"v{j}" for j in range(n)])
+
+    fused_cap = 1024
+    reports = {}
+    for s in (64, 1024, 8192):
+        rep = run_subscribe_lanes(
+            make_store, "bench_live", make_batch, subscriptions=s,
+            batches=4, fused=s <= fused_cap)
+        reports[s] = rep
+        print(json.dumps(rep), flush=True)
+    at_1024 = reports[1024]
+    verdict = {
+        "run": "lanes_verdict",
+        "speedup_at_1024": at_1024.get("speedup"),
+        "lane_dispatches_per_poll_at_8192":
+            reports[8192]["lanes"]["dispatches_per_poll"],
+        "floor": 10.0,
+    }
+    verdict["ok"] = bool(
+        (at_1024.get("speedup") or 0.0) >= verdict["floor"]
+        and verdict["lane_dispatches_per_poll_at_8192"] <= 4.0)
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1
 
 
 def _bench_wire(args) -> int:

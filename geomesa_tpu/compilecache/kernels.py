@@ -20,6 +20,7 @@ ENGINE_MODULES: Tuple[str, ...] = (
     "geomesa_tpu.engine.grid_index",
     "geomesa_tpu.engine.knn",
     "geomesa_tpu.engine.knn_scan",
+    "geomesa_tpu.engine.lanes",
     "geomesa_tpu.engine.pip_pallas",
     "geomesa_tpu.engine.pip_sparse",
     "geomesa_tpu.engine.raster",

@@ -1,0 +1,124 @@
+"""Vmapped parametric geofence lane kernels.
+
+One kernel per geofence CLASS, batched over an [S]-axis parameter
+table — the evaluation half of ROADMAP item 3 (the transport half is
+PR 13's PushMux). The fused standing-query kernel spends one slot per
+predicate, so its trace/compile cost and its rebuild-on-churn cost are
+O(S) in registered geofences; a lane evaluates every same-class
+geofence as ONE [S, N] broadcast whose compiled program is independent
+of S — registration churn is a parameter-ROW write, never a retrace.
+
+Bit-identity contract (the subscribe parity tests pin it): each lane
+reproduces cql/compile.py's per-predicate arithmetic exactly —
+identical f32 elementwise ops in identical order, so a lane row equals
+the one-shot compiled filter's mask for the same predicate. Bands
+mirror the compiled filter's f32 ambiguity bands (bbox edge ulp bands,
+polygon BAND_EPS terms; dwithin compiles with NO band) so the
+evaluator's f64 host refinement patches exactly the same rows.
+
+Layout notes: parameters ride [S, P] f32 tables (rows = geofences),
+padded to pow2 [S]-buckets with an `active` mask column — inactive and
+never-assigned rows compute garbage that the mask AND discards. The
+[S, N] broadcast is pure elementwise work that XLA tiles onto the VPU;
+polygon lanes inline the dense crossing-number formula over an
+[S, 4, E] edge table (pad edges are degenerate points at a far-away
+coordinate: zero crossings, zero band) instead of calling
+pip.points_in_polygon under vmap, which could route into the Pallas
+streamed-tile kernel whose block shapes assume a flat [N].
+
+Module-level jits only: this module is in compilecache ENGINE_MODULES,
+so the ExecutableRegistry default sweep registers each lane as
+``lanes.lane_<class>`` (AOT-keyed by the ([S]-bucket, N-bucket) shape
+signature — `gmtpu warmup --check` covers lanes) and the JitTracker
+recompile counters see every lane call.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from geomesa_tpu.engine.geodesy import haversine_m
+from geomesa_tpu.engine.pip import BAND_EPS
+
+# the evaluator dispatches these by class name (`lane_{cls}` getattr,
+# which is what lets the JitTracker's module-attribute wrap intercept
+# lane calls) — the export list is the static record of that surface
+__all__ = ["lane_bbox", "lane_dwithin", "lane_polygon"]
+
+
+@jax.jit
+def lane_bbox(prm, active, x, y, valid):
+    """BBOX lane: [S, 8] params vs [N] points -> (mask, band) [S, N].
+
+    Row layout: (x0, x1, y0, y1, ex0, ex1, ey0, ey1) — the bbox
+    extents plus cql.compile.f32_ulp_band half-widths per edge. Mask
+    and band are the compiled bbox predicate's exact f32 arithmetic,
+    ANDed with the row's active flag and the batch validity column
+    (the compiled filter's top-level `& dev[VALID]`).
+    """
+    X = x[None, :]
+    Y = y[None, :]
+    x0, x1 = prm[:, 0:1], prm[:, 1:2]
+    y0, y1 = prm[:, 2:3], prm[:, 3:4]
+    mask = (X >= x0) & (X <= x1) & (Y >= y0) & (Y <= y1)
+    band = (
+        (jnp.abs(X - x0) <= prm[:, 4:5]) | (jnp.abs(X - x1) <= prm[:, 5:6])
+        | (jnp.abs(Y - y0) <= prm[:, 6:7]) | (jnp.abs(Y - y1) <= prm[:, 7:8])
+    )
+    live = active[:, None] & valid[None, :]
+    return mask & live, band & live
+
+
+@jax.jit
+def lane_dwithin(prm, active, x, y, valid):
+    """DWITHIN lane: [S, 3] (lon, lat, meters) vs [N] points.
+
+    The compiled single-point DWITHIN is `haversine_m(x, y, px, py)
+    <= d` with NO ambiguity band (bands come only from bbox/polygon
+    predicates), so the lane's band is all-False — parity with the
+    one-shot path is pure f32 mask equality.
+    """
+    m = haversine_m(x[None, :], y[None, :],
+                    prm[:, 0:1], prm[:, 1:2]) <= prm[:, 2:3]
+    live = active[:, None] & valid[None, :]
+    mask = m & live
+    return mask, jnp.zeros_like(mask)
+
+
+@jax.jit
+def lane_polygon(edges, active, x, y, valid):
+    """Polygon lane: [S, 4, E] edge tables vs [N] points.
+
+    Inlines pip.points_in_polygon's dense crossing-number formula and
+    points_in_polygon_band's flag terms with an extra [S] axis. Pad
+    edges (rows shorter than the E-bucket, and unassigned rows) are
+    degenerate points at a far-away coordinate: their crossing
+    condition is identically False and both band terms miss, so
+    padding changes neither the integer crossing sum nor the band.
+    """
+    px = x[None, :, None]                 # [1, N, 1]
+    py = y[None, :, None]
+    x1 = edges[:, 0][:, None, :]          # [S, 1, E]
+    y1 = edges[:, 1][:, None, :]
+    x2 = edges[:, 2][:, None, :]
+    y2 = edges[:, 3][:, None, :]
+    cond = (y1 <= py) != (y2 <= py)
+    t = (py - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
+    xc = x1 + t * (x2 - x1)
+    crossings = jnp.sum(cond & (xc > px), axis=2)
+    mask = (crossings % 2) == 1
+    eps = BAND_EPS
+    near_flat = (
+        (jnp.abs(py - y1) <= eps)
+        & (jnp.abs(py - y2) <= eps)
+        & (px >= jnp.minimum(x1, x2) - eps)
+        & (px <= jnp.maximum(x1, x2) + eps)
+    )
+    err = eps * (
+        1.0 + jnp.abs(x2 - x1) / jnp.maximum(jnp.abs(y2 - y1), eps)
+    )
+    near_cross = cond & (jnp.abs(xc - px) <= err)
+    band = jnp.any(near_flat | near_cross, axis=2)
+    live = active[:, None] & valid[None, :]
+    return mask & live, band & live

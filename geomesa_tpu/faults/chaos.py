@@ -469,15 +469,21 @@ def _subscribe_phase(plan: FaultPlan, report: ChaosReport,
         report.invariant_failures.append(
             "subscribe phase: post-outage matched set diverged "
             "(missed or double-applied events)")
-    # one coalesced device dispatch per committed fold: the warm fold
-    # plus the healing fold (the faulted poll never folded)
+    # one committed fold with one dispatch per evaluation path: the
+    # healed poll folds BOTH windows once and dispatches the bbox
+    # geofence's lane plus the fused remainder carrying the density
+    # window (docs/SERVING.md "Standing queries" lanes) — the faulted
+    # poll never folded
     folds = ev["folds"] - base_ev["folds"]
     dispatches = ev["dispatches"] - base_ev["dispatches"]
-    if folds != 1 or dispatches != 1:
+    lane_disp = (ev.get("lane_dispatches", 0)
+                 - base_ev.get("lane_dispatches", 0))
+    if folds != 1 or dispatches != 2 or lane_disp != 1:
         report.invariant_failures.append(
-            f"subscribe phase: expected 1 in-harness fold/dispatch "
-            f"(the healed poll), saw folds={folds} "
-            f"dispatches={dispatches}")
+            f"subscribe phase: expected 1 in-harness fold with one "
+            f"lane + one fused dispatch (the healed poll), saw "
+            f"folds={folds} dispatches={dispatches} "
+            f"lane_dispatches={lane_disp}")
     if len(blog) != _SUB_FAULT_FIRES:
         report.invariant_failures.append(
             f"subscribe phase: expected {_SUB_FAULT_FIRES} kafka.poll "

@@ -81,6 +81,13 @@ class QueryHints:
     # sketch-native aggregation (QueryResult kind "topk_cells"); with
     # no/unfit tolerance it computes exactly via a device density scan
     topk_cells: Optional[int] = None
+    # DISTINCT count of one attribute's values. With a tolerance hint
+    # the answer may resolve at admission from per-partition
+    # HyperLogLog sketches (stats/sketches.py Cardinality merged under
+    # the manifest snapshot — approx/engine.py fast_distinct) with a
+    # typed [lo, hi] bound on the wire; otherwise it pays an exact
+    # feature scan + host unique count
+    distinct: Optional[str] = None
 
     # index override (upstream: QUERY_INDEX)
     query_index: Optional[str] = None

@@ -1507,10 +1507,14 @@ class QueryPlanner:
         # intercepted marker makes the nested execute() -> plan() pass a
         # no-op, so non-idempotent interceptors apply exactly once
         query = run_interceptors(query, self.interceptors)
+        if query.hints.distinct is not None:
+            self._validate_distinct(query.hints.distinct)
         if (
             not query.hints.exact_count
             and not SystemProperties.FORCE_COUNT.get()
             and isinstance(query.filter_ast, ast.Include)
+            # a manifest row count is NOT a distinct-value count
+            and query.hints.distinct is None
             # a manifest count knows nothing about auths: visibility-
             # configured types must count through the masked path
             and not (self.storage.sft.user_data or {}).get("geomesa.vis.attr")
@@ -1535,6 +1539,11 @@ class QueryPlanner:
             r = self.approx_engine().fast_count(query)
             if r is not None:
                 return r
+        if query.hints.distinct is not None:
+            # the sketch attempt above fell through (or no tolerance
+            # was offered): distinct counts pay an exact feature scan
+            # plus a host-side unique over the named column
+            return self._distinct_exact(query, timeout_ms=timeout_ms)
         # tolerance stripped: fast_count above WAS the sketch attempt —
         # leaving the hint on would re-enter the engine inside execute()
         # (a second full merge and a double-counted fallthrough reason)
@@ -1554,6 +1563,51 @@ class QueryPlanner:
         return QueryResult("count", count=n, version=r.version,
                            approx=r.approx, bound=r.bound,
                            confidence=r.confidence)
+
+    def _validate_distinct(self, attr: str) -> None:
+        """A bad `distinct` hint is the CLIENT's error and must answer
+        the request typed — not surface as a KeyError from a scan."""
+        from geomesa_tpu.core.sft import GEOMETRY_TYPES
+
+        sft = self.storage.sft
+        if attr not in sft:
+            raise ValueError(
+                f"distinct attribute {attr!r} not in schema "
+                f"{sft.name!r}")
+        if sft.attribute(attr).type in GEOMETRY_TYPES:
+            raise ValueError(
+                f"distinct over geometry attribute {attr!r} is not "
+                f"supported")
+
+    def _distinct_exact(self, query: Query,
+                        timeout_ms: Optional[int] = None) -> QueryResult:
+        """Exact COUNT(DISTINCT attr): execute the query as features and
+        unique-count the named column on the host. The fallback behind
+        the HLL tier (approx/engine.py fast_distinct) — predicated,
+        visibility-masked and interceptor-rewritten queries all land
+        here, because the row set execute() returns is already the
+        exact one."""
+        attr = query.hints.distinct
+        q = dataclasses.replace(
+            query, hints=dataclasses.replace(
+                query.hints, tolerance=None, distinct=None,
+                count_only=False))
+        r = self.execute(q, timeout_ms=timeout_ms)
+        feats = r.features
+        n = 0
+        if feats is not None and len(feats):
+            import numpy as np
+
+            from geomesa_tpu.core.columnar import DictColumn
+
+            col = feats.columns[attr]
+            if isinstance(col, DictColumn):
+                vals = np.asarray(col.decode(), dtype=object)
+                vals = vals[vals != None]  # noqa: E711 — elementwise
+                n = len(np.unique(vals.astype(str)))
+            else:
+                n = len(np.unique(np.asarray(col)))
+        return QueryResult("count", count=n, version=r.version)
 
     # -- internals ---------------------------------------------------------
 

@@ -559,6 +559,128 @@ def run_subscribe(
     return rep
 
 
+def run_subscribe_lanes(
+    make_store,
+    type_name: str,
+    make_batch: Callable[[int], object],
+    subscriptions: int = 1024,
+    batches: int = 4,
+    extent=(-60.0, 28.0, -30.0, 9.0),
+    seed: int = 5,
+    fused: bool = True,
+    churn: bool = True,
+) -> dict:
+    """Lane-vs-fused-slot comparison (`gmtpu bench-serve --mode subscribe
+    --lanes`, docs/SERVING.md "Standing queries"): register S same-class
+    bbox geofences on a FRESH store per mode, then time the identical
+    protocol under `SubscribeConfig(lanes=...)` both ways — first poll
+    (where the fused path pays an S-proportional trace+compile and the
+    lane path a single S-independent batched kernel), `batches` steady
+    polls, and optionally one membership-churn event (register + cancel
+    + poll: a full S-wide rebuild for fused slots, a parameter-row write
+    for lanes). Events are identical across modes by construction, so
+    `speedup` is the lane/fused events-per-second ratio over matching
+    windows. Subscriptions register BEFORE the seed batch lands: the
+    empty-store bootstrap is then a bookkeeping write, keeping the first
+    measured poll about evaluation, not baseline transfer.
+
+    `fused=False` skips the fused leg entirely — its compile cost grows
+    super-linearly with S (measured ~1 s at S=64, ~11 s at S=256,
+    ~120 s at S=1024 on CPU CI), so sweeps cap the fused mode and run
+    lane-only beyond the cap rather than silently extrapolating."""
+    from geomesa_tpu.subscribe import SubscribeConfig, SubscriptionManager
+
+    x_lo, x_hi, y_lo, y_hi = extent
+
+    def _mode(lanes: bool) -> dict:
+        store = make_store()
+        mgr = SubscriptionManager(store, SubscribeConfig(
+            max_subscriptions=subscriptions + 8, lanes=lanes))
+        geom = store.get_schema(type_name).default_geometry.name
+        rng = np.random.default_rng(seed)
+        registered = []
+        for _ in range(subscriptions):
+            x0 = float(rng.uniform(x_lo, x_hi))
+            y0 = float(rng.uniform(y_lo, y_hi))
+            registered.append(mgr.subscribe(
+                type_name,
+                f"BBOX({geom}, {x0}, {y0}, {x0 + 2}, {y0 + 2})"))
+        store.write(type_name, make_batch(10_001))
+        frames: List[dict] = []
+        base = mgr.evaluator.stats()
+        polls = 0
+        t_start = time.monotonic()
+        mgr.poll_now()
+        mgr.flush(frames.append)
+        first_poll_s = time.monotonic() - t_start
+        polls += 1
+        for i in range(batches):
+            store.write(type_name, make_batch(i))
+            mgr.poll_now()
+            mgr.flush(frames.append)
+            polls += 1
+        churn_poll_s = None
+        if churn:
+            x0 = float(rng.uniform(x_lo, x_hi))
+            y0 = float(rng.uniform(y_lo, y_hi))
+            mgr.subscribe(
+                type_name,
+                f"BBOX({geom}, {x0}, {y0}, {x0 + 2}, {y0 + 2})")
+            mgr.unsubscribe(registered[0].sub_id)
+            store.write(type_name, make_batch(batches))
+            t0 = time.monotonic()
+            mgr.poll_now()
+            mgr.flush(frames.append)
+            churn_poll_s = time.monotonic() - t0
+            polls += 1
+        wall = time.monotonic() - t_start
+        ev = mgr.evaluator.stats()
+        # enter/exit transitions only, as run_subscribe counts them —
+        # registration-time `state` frames are bookkeeping, and on the
+        # register-before-seed protocol they are empty anyway
+        events = 0
+        for f in frames:
+            if f.get("event") in ("enter", "exit"):
+                events += len(f.get("fids", ()))
+        dispatches = ev.get("dispatches", 0) - base.get("dispatches", 0)
+        out = {
+            "mode": "lanes" if lanes else "fused",
+            "polls": polls,
+            "wall_s": round(wall, 3),
+            "events_total": events,
+            "events_per_s": round(events / wall, 1) if wall > 0 else 0.0,
+            "dispatches": dispatches,
+            "dispatches_per_poll":
+                round(dispatches / polls, 3) if polls else 0.0,
+            "lane_dispatches": ev.get("lane_dispatches", 0)
+            - base.get("lane_dispatches", 0),
+            "first_poll_s": round(first_poll_s, 3),
+        }
+        if churn_poll_s is not None:
+            out["churn_poll_s"] = round(churn_poll_s, 3)
+        mgr.close()
+        return out
+
+    lanes_rep = _mode(True)
+    out = {
+        "run": "subscribe_lanes",
+        "subscriptions": subscriptions,
+        "batches": batches,
+        "lanes": lanes_rep,
+        "fused": None,
+    }
+    if fused:
+        fused_rep = _mode(False)
+        out["fused"] = fused_rep
+        if fused_rep["events_per_s"] > 0:
+            out["speedup"] = round(
+                lanes_rep["events_per_s"] / fused_rep["events_per_s"], 1)
+    else:
+        out["note"] = ("fused leg skipped: S-proportional compile cost "
+                       "exceeds the bench budget at this S")
+    return out
+
+
 def run_wire(
     store,
     type_name: str,

@@ -136,10 +136,13 @@ def _payload(kind: str, result, limit: int) -> dict:
         if getattr(result, "approx", False):
             # typed error bound on the wire (docs/SERVING.md
             # "Approximate answers"): the exact count is guaranteed in
-            # [count - bound, count + bound]
+            # [lo, hi] = [count - bound, count + bound] — shipped
+            # pre-computed so clients need no arithmetic to act on it
             doc["approx"] = True
             doc["bound"] = result.bound
             doc["confidence"] = result.confidence
+            doc["lo"] = max(0, int(result) - int(result.bound))
+            doc["hi"] = int(result) + int(result.bound)
         return doc
     if kind == "knn":
         dists, idx, _batch = result
@@ -163,6 +166,8 @@ def _payload(kind: str, result, limit: int) -> dict:
         out["approx"] = True
         out["bound"] = float(result.bound)
         out["confidence"] = float(result.confidence)
+        out["lo"] = max(0, int(result.count) - int(result.bound))
+        out["hi"] = int(result.count) + int(result.bound)
     return out
 
 
@@ -197,6 +202,8 @@ def _columnar_payload(kind: str, result, limit: int):
         out["approx"] = True
         out["bound"] = float(result.bound)
         out["confidence"] = float(result.confidence)
+        out["lo"] = max(0, int(result.count) - int(result.bound))
+        out["hi"] = int(result.count) + int(result.bound)
     return out, payload
 
 
@@ -210,13 +217,15 @@ def parse_request(doc: dict,
     type_name = doc["typeName"]
     kw = {}
     if (doc.get("tolerance") is not None or doc.get("topkCells")
-            or doc.get("density")):
+            or doc.get("density") or doc.get("distinct")):
         # aggregation + approximate-answer hints (docs/SERVING.md):
         # tolerance = the client's accuracy contract, topkCells = the
-        # sketch-native top-k-cells aggregation, density = a one-shot
-        # DensityScan window (same spec shape as the subscribe verb's
-        # standing window) whose grid ships as ONE columnar buffer on
-        # a columnar connection
+        # sketch-native top-k-cells aggregation, distinct = count the
+        # DISTINCT values of one attribute (HLL-resolved at admission
+        # when a tolerance allows it; exact otherwise), density = a
+        # one-shot DensityScan window (same spec shape as the
+        # subscribe verb's standing window) whose grid ships as ONE
+        # columnar buffer on a columnar connection
         from geomesa_tpu.plan.hints import QueryHints
 
         hkw = {}
@@ -232,6 +241,7 @@ def parse_request(doc: dict,
                        if doc.get("tolerance") is not None else None),
             topk_cells=(int(doc["topkCells"])
                         if doc.get("topkCells") else None),
+            distinct=doc.get("distinct"),
             **hkw)
     query = Query(type_name, doc.get("cql", "INCLUDE"),
                   max_features=doc.get("maxFeatures"), **kw)
@@ -286,7 +296,8 @@ def _error_response(rid, exc) -> dict:
     return {"id": rid, "ok": False, "error": "error", "message": str(exc)}
 
 
-SUBSCRIBE_OPS = ("subscribe", "unsubscribe", "poll", "subscriptions")
+SUBSCRIBE_OPS = ("subscribe", "unsubscribe", "poll", "subscriptions",
+                 "export_subscription")
 
 
 def _parse_density(doc: dict):
@@ -421,6 +432,7 @@ class _SubscribeSession:
                 rate=doc.get("rate"),
                 outbox_limit=doc.get("outboxLimit"),
                 initial_state=bool(doc.get("initialState", True)),
+                handoff=doc.get("handoff"),
                 ack=lambda s: self.respond(
                     {"id": rid, "ok": True,
                      "subscription": s.sub_id, "mode": s.mode}))
@@ -444,6 +456,24 @@ class _SubscribeSession:
             frames = mgr.flush(self.push)
             self.respond({"id": rid, "ok": True, "applied": applied,
                           "frames": frames})
+        elif op == "export_subscription":
+            # failover handoff (docs/ROBUSTNESS.md): serialize one
+            # predicate subscription's matched-set snapshot so the
+            # client can re-subscribe against another replica with
+            # `handoff` and continue its sequence numbers there
+            sub = mgr.registry.maybe(doc.get("subscription"))
+            if sub is None:
+                self.respond({"id": rid, "ok": False, "error": "error",
+                              "message": "no such subscription"})
+                return
+            try:
+                snap = sub.handoff_snapshot()
+            except ValueError as e:
+                self.respond({"id": rid, "ok": False, "error": "error",
+                              "message": str(e)})
+                return
+            self.respond({"id": rid, "ok": True,
+                          "subscription": sub.sub_id, "handoff": snap})
         else:  # subscriptions: introspection
             self.respond({"id": rid, "ok": True, **mgr.stats()})
 

@@ -3,12 +3,17 @@
 A client registers a long-lived predicate (CQL / BBOX / DWITHIN
 geofence) or a density/heatmap window and receives incremental push
 updates — enter/exit events, density folds — as Kafka batches fold in.
-Every poll evaluates ALL registered standing queries in ONE fused
-device dispatch (docs/SERVING.md "Standing queries").
+Every poll evaluates parametric geofences (bbox / dwithin / polygon)
+as one [S]-batched lane dispatch per class and everything else in ONE
+fused device dispatch (docs/SERVING.md "Standing queries").
 
     registry.py   Subscription state: matched-fid sets, decayed grids,
-                  bounded outboxes, rate limits, lifecycle + TTL
-    evaluator.py  delta-driven fused evaluation hooked on
+                  bounded outboxes, rate limits, lifecycle + TTL,
+                  matched-set handoff snapshots
+    lanes.py      lane classification + pow2 [S]-row parameter tables
+                  (host side of engine/lanes.py; membership is a row
+                  write, never a recompile)
+    evaluator.py  delta-driven lane + fused evaluation hooked on
                   KafkaDataStore.poll (ExecutableRegistry-routed,
                   exactly-once per batch, quarantine fallback)
     manager.py    admission (tenant buckets, bounds, quarantine),

@@ -10,15 +10,21 @@ The evaluation contract (docs/SERVING.md "Standing queries"):
   that listener bodies stay non-blocking), and the store's post-fold
   hook pumps the evaluator OUTSIDE the store lock.
 
-- One poll = ONE coalesced device dispatch, independent of how many
-  subscriptions are registered: the window's changed rows stack into a
-  single columnar delta (pow2-padded, so shapes repeat), and a FUSED
-  kernel — every registered predicate's compiled mask + f32 boundary
-  band, plus every density window's cell binning — is built per
-  (type, registry version), registered with the compilecache
-  ExecutableRegistry, and AOT-compiled per shape bucket. A steady
-  subscription set therefore never recompiles per batch; membership
-  changes bump the version and rebuild exactly once.
+- One poll = a HANDFUL of coalesced device dispatches, independent of
+  how many subscriptions are registered: the window's changed rows
+  stack into a single columnar delta (pow2-padded, so shapes repeat),
+  lane-eligible geofences (bbox / dwithin / polygon — subscribe/
+  lanes.py) evaluate as one [S]-axis-batched kernel per CLASS
+  (engine/lanes.py) whose compiled program is independent of S —
+  registration churn is a parameter-row write, zero recompiles within
+  an [S]-bucket — and only the irregular remainder (compound CQL,
+  attribute predicates, density windows) rides the FUSED kernel:
+  every remaining predicate's compiled mask + f32 boundary band, plus
+  every density window's cell binning, built per remainder-membership
+  signature, registered with the compilecache ExecutableRegistry, and
+  AOT-compiled per shape bucket. A steady subscription set therefore
+  never recompiles per batch; lane-only churn never rebuilds the
+  fused kernel at all.
 
 - Exactly-once: buffered events are consumed only after a successful
   evaluation. An injected `kafka.poll` fault fails the poll BEFORE the
@@ -106,13 +112,22 @@ class _TypeState:
         # refreshed by each pump; a stale True costs one bounded
         # buffer until the next pump clears it.
         self.armed = False
-        # fused-kernel cache: rebuilt when the registry version moves
+        # fused-kernel cache: rebuilt when the REMAINDER membership
+        # (the subscriptions actually riding the fused kernel) moves —
+        # lane-side churn bumps the registry version but must never
+        # rebuild the fused program, so the cache keys on the
+        # remainder sub-id signature, not the version
         self.version = -1
+        self.fused_sig: Optional[tuple] = None
         self.fused_name: Optional[str] = None
         self.fused_fn = None
         self.treedef = None
         self.pred_subs: List[str] = []
         self.dens_subs: List[str] = []
+        # vmapped-lane membership (subscribe/lanes.py): same-shape
+        # geofence classes as [S]-bucketed parameter tables; mutated
+        # only under the eval lock
+        self.lanes = None
         # approximate-density shared state (docs/SERVING.md
         # "Approximate answers"): ONE host-side world occupancy grid +
         # fid->cell map per type, folded from deltas with plain numpy —
@@ -132,9 +147,13 @@ class DeltaEvaluator:
 
     def __init__(self, store, registry: SubscriptionRegistry,
                  quarantine=None, quarantine_after: int = 3,
-                 quarantine_ttl_s: float = 600.0):
+                 quarantine_ttl_s: float = 600.0, lanes: bool = True):
         self.store = store
         self.registry = registry
+        # vmapped parametric lanes (subscribe/lanes.py): off forces
+        # every predicate onto the fused-slot path — the bench's
+        # lane-vs-slot comparison and the parity tests use this
+        self._lanes_enabled = lanes
         # quarantine_after=0 disables quarantine (the serve layer's
         # contract): strikes are never counted, a crashing predicate
         # just re-seeds and retries each fold
@@ -410,15 +429,19 @@ class DeltaEvaluator:
 
     def _fused_for(self, st: _TypeState, sft, subs: List[Subscription],
                    version: int):
-        """(Re)build the fused evaluation kernel when the registry
-        version moved; otherwise return the cached registration. The
-        kernel closes over predicate structure and density geometry;
-        per-batch VALUES (vocab tables, device columns) arrive as
-        arguments, so repeated shapes are AOT-registry hits. `version`
-        and `subs` come from ONE atomic registry read — equal versions
-        imply identical membership, so a cached kernel is always built
-        from exactly this subscription list."""
-        if st.fused_name is not None and st.version == version:
+        """(Re)build the fused evaluation kernel when its MEMBERSHIP
+        (the remainder subscriptions riding it) moved; otherwise
+        return the cached registration. The kernel closes over
+        predicate structure and density geometry; per-batch VALUES
+        (vocab tables, device columns) arrive as arguments, so
+        repeated shapes are AOT-registry hits. Keyed on the sub-id
+        signature, NOT the registry version: sub ids are never reused,
+        so signature equality implies identical membership (and
+        pause/resume round-trips re-hit the cached kernel), while
+        lane-side churn — which bumps the version every registration —
+        never rebuilds the fused program."""
+        sig = tuple(s.sub_id for s in subs)
+        if st.fused_name is not None and st.fused_sig == sig:
             return st.fused_name
         if st.fused_name is not None:
             # membership moved: the stale version's kernel and its AOT
@@ -464,7 +487,11 @@ class DeltaEvaluator:
 
         st.fused_fn = fused
         st.version = version
+        st.fused_sig = sig
         st.treedef = None  # re-derived at the first call
+        # the version keeps the name unique across rebuilds (a
+        # membership change always bumps it); equal signatures never
+        # reach here, so a stale name is never re-registered
         st.fused_name = (f"subscribe.eval.{st.type_name}"
                          f".e{self._nonce}.v{version}")
         st.pred_subs = [s.sub_id for s in pred]
@@ -629,11 +656,21 @@ class DeltaEvaluator:
         delta, dev, fids = self._delta_batch(sft, changed,
                                              device=needs_device)
         try:
+            # lane-eligible geofences first: one [S]-batched dispatch
+            # per CLASS (membership reconciled as row writes), then the
+            # fused kernel over only the irregular remainder — skipped
+            # entirely when nothing rides it
+            lane_members, remainder = self._lane_sync(st, sft, subs)
+            lane_rows = self._eval_lanes(st, sft, lane_members, dev)
+            fused_live = any(
+                s.density is None or not s.density.approx
+                for s in remainder)
             pred, masks, bands, cells = (
-                self._eval_fused(st, sft, subs, version, delta, dev)
-                if (delta is not None and needs_device) else (
-                    [s for s in subs if s.density is None], None, None,
-                    None))
+                self._eval_fused(st, sft, remainder, version, delta,
+                                 dev)
+                if (delta is not None and fused_live) else (
+                    [s for s in remainder if s.density is None], None,
+                    None, None))
         except Exception as e:
             if _infra_error(e):
                 # infrastructure answer (device transfer, raced read,
@@ -641,17 +678,34 @@ class DeltaEvaluator:
                 # state was applied — propagate so _pump_locked keeps
                 # the buffer and the next poll retries the window
                 raise
-            # a crashing fused kernel: degrade to per-subscription
-            # evaluation so the poisonous predicate is identified and
-            # struck while healthy subscriptions still fold this window
+            # a crashing fused or lane kernel: degrade to
+            # per-subscription evaluation so the poisonous predicate is
+            # identified and struck while healthy subscriptions still
+            # fold this window
             self._bump("fallbacks")
             self._fold_fallback(st, sft, subs, delta, dev, fids,
                                 changed, removed, cleared)
             return len(changed) + len(removed) + (1 if cleared else 0)
-        dens = [s for s in subs
+        dens = [s for s in remainder
                 if s.density is not None and not s.density.approx]
         approx_dens = [s for s in subs
                        if s.density is not None and s.density.approx]
+        # lane subscriptions: per-row slices of the lane masks get the
+        # same f64 band refinement and strike protection as fused rows
+        for _group, members in lane_members:
+            for sub, _row in members:
+                try:
+                    if sub._resync_pending():
+                        self._resync(sub)
+                        continue
+                    pair = lane_rows.get(sub.sub_id)
+                    mask = (self._refine_mask(st, sub, pair[0], pair[1],
+                                              delta, fids)
+                            if pair is not None else np.zeros(0, bool))
+                    self._apply_predicate(sub, fids, mask, removed,
+                                          cleared)
+                except Exception as e:  # noqa: BLE001 — strike
+                    self._strike(sub, e)
         # the per-subscription apply phase gets the same strike
         # protection as the fallback path: a predicate that crashes
         # only HERE (host-band refinement, density weights) must be
@@ -695,20 +749,115 @@ class DeltaEvaluator:
                     self._strike(sub, e)
         return len(changed) + len(removed) + (1 if cleared else 0)
 
+    # -- lanes -------------------------------------------------------------
+
+    def _lane_sync(self, st: _TypeState, sft, subs):
+        """Reconcile lane membership against this fold's atomic
+        registry snapshot (row writes only — subscribe/lanes.py);
+        returns ([(group, [(sub, row)])], remainder). Lanes disabled
+        (SubscribeConfig.lanes=False) routes everything fused."""
+        if not self._lanes_enabled:
+            return [], list(subs)
+        if st.lanes is None:
+            from geomesa_tpu.subscribe.lanes import LaneTable
+
+            st.lanes = LaneTable()
+
+        def spec_for(sub):
+            from geomesa_tpu.subscribe.lanes import classify
+
+            f = self._filter_for(st.type_name, sub.cql, sft)
+            return classify(f.filter_ast, sft)
+
+        return st.lanes.sync(subs, spec_for)
+
+    def _eval_lanes(self, st: _TypeState, sft, lane_members, dev):
+        """One device dispatch per lane CLASS — an [S]-batched kernel
+        whose compiled program is independent of S (engine/lanes.py) —
+        fetched once and sliced per member row. Returns
+        {sub_id: (mask_row, band_row)} over the padded delta."""
+        if dev is None or not lane_members:
+            return {}
+        import jax
+
+        from geomesa_tpu.engine import lanes as lane_kernels
+        from geomesa_tpu.engine.device import VALID
+
+        g = _geom_name(sft)
+        x, y, valid = dev[f"{g}__x"], dev[f"{g}__y"], dev[VALID]
+        out = {}
+        for group, members in lane_members:
+            kern = getattr(lane_kernels, f"lane_{group.cls}")
+            self._bump("dispatches")
+            self._bump("lane_dispatches")
+            t0 = time.perf_counter()
+            with TRACER.span("subscribe.lane.eval", cls=group.cls,
+                             rows=len(members), bucket=group.cap):
+                # gt: waive GT09
+                # (deliberate: the lane dispatch runs under the
+                # per-type eval lock — fold order is the exactly-once
+                # contract, same stance as the fused dispatch)
+                mask, band = jax.device_get(
+                    kern(group.params, group.active, x, y, valid))
+            try:
+                from geomesa_tpu.utils.metrics import metrics
+
+                metrics.histogram("lane.eval").update(
+                    time.perf_counter() - t0)
+            except Exception:
+                pass  # observability must never fail the fold
+            for sub, row in members:
+                out[sub.sub_id] = (mask[row], band[row])
+        return out
+
+    def lane_stats(self) -> dict:
+        """Lanes introspection (manager.stats `lanes` section): per-
+        class row counts/capacities plus the typed `lane_ineligible`
+        reasons for the currently-registered predicate set."""
+        with self._types_lock:
+            states = list(self._types.values())
+        classes: Dict[str, dict] = {}
+        ineligible: Dict[str, int] = {}
+        for st in states:
+            if st.lanes is None:
+                continue
+            s = st.lanes.stats()
+            for cls, c in s["classes"].items():
+                agg = classes.setdefault(cls, {"rows": 0, "capacity": 0})
+                agg["rows"] += c["rows"]
+                agg["capacity"] += c["capacity"]
+            for why, n in s["ineligible"].items():
+                ineligible[why] = ineligible.get(why, 0) + n
+        return {"enabled": self._lanes_enabled, "classes": classes,
+                "ineligible": ineligible}
+
+    # -- refinement --------------------------------------------------------
+
     def _refined_row(self, st, sub, masks, bands, i, delta, fids):
-        """One predicate's delta mask with f32 boundary-band rows
-        re-evaluated exactly in f64 on host (the planner's refinement
-        discipline, applied to just the delta)."""
+        """One fused-slot predicate's delta mask with f32 boundary-band
+        rows re-evaluated exactly in f64 on host (the planner's
+        refinement discipline, applied to just the delta)."""
         if masks is None:
             return np.zeros(0, bool)
+        return self._refine_mask(st, sub, masks[i], bands[i], delta,
+                                 fids)
+
+    def _refine_mask(self, st, sub, mask_row, band_row, delta, fids):
+        """Shared by the fused and lane apply phases: copy the row,
+        re-evaluate its band-flagged entries in f64 (cql/hosteval)."""
         n = len(fids)
-        mask = np.asarray(masks[i][:n]).copy()
-        band = np.asarray(bands[i][:n])
+        mask = np.asarray(mask_row[:n]).copy()
+        band = np.asarray(band_row[:n])
         idx = np.nonzero(band)[0]
         if len(idx):
             from geomesa_tpu.cql.hosteval import eval_filter_host
 
-            sub_filter = self._filters[(st.type_name, sub.cql)]
+            # via _filter_for, not the dict: past _MAX_FILTERS live
+            # predicates the cache evicts, and an evicted-but-needed
+            # filter must recompile, not strike the subscription
+            sub_filter = self._filter_for(
+                st.type_name, sub.cql,
+                self.store.get_schema(st.type_name))
             mask[idx] = eval_filter_host(
                 sub_filter.filter_ast, delta.select(idx))
         return mask

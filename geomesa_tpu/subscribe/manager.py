@@ -39,6 +39,10 @@ class SubscribeConfig:
     # limiter is passed in)
     tenant_rate: Optional[float] = None
     tenant_burst: float = 8.0
+    # vmapped parametric lanes (subscribe/lanes.py): off forces every
+    # predicate onto the fused-slot path — the bench's lane-vs-slot
+    # comparison and parity tests flip this
+    lanes: bool = True
 
 
 class SubscriptionManager:
@@ -56,7 +60,8 @@ class SubscriptionManager:
         self.evaluator = DeltaEvaluator(
             store, self.registry,
             quarantine_after=self.config.quarantine_after,
-            quarantine_ttl_s=self.config.quarantine_ttl_s)
+            quarantine_ttl_s=self.config.quarantine_ttl_s,
+            lanes=self.config.lanes)
         # serializes concurrent flushes (the --live-poll-ms pump thread
         # vs an explicit `poll` verb on the reader thread): without it,
         # two drains of the same outbox can interleave their writes
@@ -75,6 +80,7 @@ class SubscriptionManager:
         rate: Optional[float] = None,
         outbox_limit: Optional[int] = None,
         initial_state: bool = True,
+        handoff: Optional[dict] = None,
         ack: Optional[Callable[[Subscription], None]] = None,
     ) -> Subscription:
         """Register a standing query. Raises the serving layer's typed
@@ -82,6 +88,15 @@ class SubscriptionManager:
         subscription_limit / quarantined / shutting_down analog), and
         ValueError for an invalid predicate — validation happens HERE,
         not at the first fold.
+
+        `handoff` re-homes a standing query from another replica
+        (docs/ROBUSTNESS.md): a Subscription.handoff_snapshot dict
+        whose canonical CQL must match this registration's predicate.
+        The new subscription continues the client's sequence numbers
+        from the snapshot's delivered watermark and its first frame is
+        a full `state` resync built from THIS replica's live snapshot,
+        so the client reconciles instead of starting over. Predicate
+        subscriptions only (density grids re-seed anyway).
 
         `ack` (the wire layer's subscribe response) runs under the
         flush lock, BEFORE any flusher — in particular the
@@ -99,6 +114,28 @@ class SubscriptionManager:
             rate=rate if rate is not None else self.config.rate,
             rate_burst=self.config.rate_burst,
             initial_state=initial_state)
+        if handoff is not None:
+            if density is not None:
+                raise ValueError(
+                    "density subscriptions do not hand off: the grid "
+                    "re-seeds from the live snapshot on re-subscribe")
+            from geomesa_tpu.cql import parse_cql
+            from geomesa_tpu.cql.ast import to_cql
+
+            canon = to_cql(parse_cql(cql))
+            if handoff.get("type") != type_name:
+                raise ValueError(
+                    f"handoff type {handoff.get('type')!r} does not "
+                    f"match subscribe type {type_name!r}")
+            if handoff.get("cql") != canon:
+                raise ValueError(
+                    f"handoff predicate {handoff.get('cql')!r} does "
+                    f"not match subscribe predicate {canon!r}")
+            # continue the client's numbering from the last frame the
+            # old replica DELIVERED; the state resync frame queued
+            # below (always — it replaces the missed tail) is the
+            # next seq the client sees
+            sub._seq = int(handoff.get("watermark", 0))
         if self.config.quarantine_after:
             detail = self.evaluator.quarantine.blocked(sub.fingerprint())
             if detail is not None:
@@ -137,7 +174,7 @@ class SubscriptionManager:
                     f"subscription table at capacity "
                     f"({self.config.max_subscriptions})")
             self.evaluator.admit(sub)
-            if initial_state:
+            if initial_state or handoff is not None:
                 sub.queue_state_frame()
             if ack is not None:
                 ack(sub)
@@ -255,5 +292,6 @@ class SubscriptionManager:
     def stats(self) -> dict:
         out = self.registry.stats()
         out["evaluator"] = self.evaluator.stats()
+        out["lanes"] = self.evaluator.lane_stats()
         out["quarantine"] = self.evaluator.quarantine.stats()
         return out

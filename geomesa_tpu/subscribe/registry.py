@@ -306,6 +306,37 @@ class Subscription:
             frame["fids"] = sorted(self.matched)
         return frame
 
+    def handoff_snapshot(self) -> dict:
+        """Serializable failover hand-off (docs/ROBUSTNESS.md): the
+        canonical predicate, the matched-fid baseline, and the seq /
+        delivered-watermark pair. A fleet router re-homes the standing
+        query onto a survivor by re-subscribing WITH this snapshot
+        (manager.subscribe `handoff=`): the acceptor seeds its sequence
+        counter from the watermark and answers with a full `state`
+        resync frame, so the client reconciles instead of starting
+        over. Predicate subscriptions only — a density grid's float
+        state is replica-local by design and re-seeds from the live
+        snapshot anyway."""
+        if self.density is not None:
+            raise ValueError(
+                "density subscriptions do not hand off: the grid "
+                "re-seeds from the live snapshot on re-subscribe")
+        from geomesa_tpu.cql import parse_cql
+        from geomesa_tpu.cql.ast import to_cql
+
+        with self._lock:
+            return {
+                "type": self.type_name,
+                # canonical form: the acceptor validates predicate
+                # identity by string equality, not parse-tree walks
+                "cql": to_cql(parse_cql(self.cql)),
+                "matched": sorted(self.matched),
+                "seq": self._seq,
+                # last DELIVERED seq: frames still queued were never
+                # pushed, so the acceptor's state frame re-covers them
+                "watermark": self._seq - len(self._outbox),
+            }
+
     def requeue(self, frames: List[dict]) -> None:
         """Put back frames a failed flush drained but could not push
         (front of the queue, original order, seq already stamped) — a

@@ -155,6 +155,12 @@ def gap_report(traces: Iterable) -> dict:
     # Aggregated over the same deduped span set as the phases table.
     ring = {"windows": 0, "slot_ms": 0.0, "kernel_ms": 0.0,
             "harvest_ms": 0.0}
+    # vmapped-lane attribution (docs/SERVING.md "Standing queries"):
+    # each subscribe.lane.eval span is one per-class batched dispatch
+    # stamped with its class and row count — aggregated per class so a
+    # hot lane (say, 8k dwithin rows) shows up as ITS class's total,
+    # next to the fused remainder in the phases table.
+    lane_evals: Dict[str, Dict[str, float]] = {}
     for d in docs:
         proc = str(d.get("trace_id", "")).split("-", 1)[0]
         root = d["root"]
@@ -189,6 +195,13 @@ def gap_report(traces: Iterable) -> dict:
                 ring["kernel_ms"] += dur_ms
             elif s["name"] == "device.sync" and attrs.get("ring"):
                 ring["harvest_ms"] += dur_ms
+            if s["name"] == "subscribe.lane.eval":
+                lane = lane_evals.setdefault(
+                    str(attrs.get("cls", "?")),
+                    {"count": 0, "total_ms": 0.0, "rows": 0})
+                lane["count"] += 1
+                lane["total_ms"] += dur_ms
+                lane["rows"] += int(attrs.get("rows", 0) or 0)
             ids = attrs.get("shards", "")
             if ids and s["name"] in DEVICE_PHASES:
                 for sid in str(ids).split(","):
@@ -293,6 +306,12 @@ def gap_report(traces: Iterable) -> dict:
                   "device_ms": round(lane["device_ms"], 3)}
             for sid, lane in sorted(shard_lanes.items())
         },
+        "lanes": {
+            cls: {"count": lane["count"],
+                  "total_ms": round(lane["total_ms"], 3),
+                  "rows": lane["rows"]}
+            for cls, lane in sorted(lane_evals.items())
+        },
     }
 
 
@@ -334,6 +353,13 @@ def render_gap(report: dict) -> str:
             f"shard {sid}: {lane['device_ms']:.1f} ms"
             f"/{lane['count']}" for sid, lane in lanes.items())
         lines.append(f"shard lanes: {parts}")
+    sub_lanes = report.get("lanes") or {}
+    if sub_lanes:
+        parts = ", ".join(
+            f"{cls}: {lane['total_ms']:.1f} ms/{lane['count']} eval(s)"
+            f" over {lane['rows']} row(s)"
+            for cls, lane in sub_lanes.items())
+        lines.append(f"subscribe lanes: {parts}")
     if g["windows"] and g["gap_fraction"] > 0.5:
         lines.append(
             "  NOTE: >50% of dispatch-window time is host gap — the "

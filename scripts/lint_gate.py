@@ -30,13 +30,17 @@ traced serve workload whose /metrics scrape must parse and whose
 dispatch-gap report must be non-empty — docs/OBSERVABILITY.md), and
 the sentinel smoke (record a perf baseline, replay it to an `ok`
 verdict, then prove a synthetic 3x phase slowdown exits nonzero —
-docs/OBSERVABILITY.md "Sentinel"). Rides the tier-1 pytest run via
+docs/OBSERVABILITY.md "Sentinel"), and the lane smoke (the vmapped-lane
+vs fused-slot standing-query comparison at S=256 with membership churn:
+>=10x events/s floor, identical event totals, lane dispatches/poll <=4
+— docs/SERVING.md "Standing queries"). Rides the tier-1 pytest run via
 tests/test_lint_gate.py and is runnable standalone:
 
     python scripts/lint_gate.py [--format json|sarif]
         [--no-spmd-smoke] [--no-dataflow-smoke] [--no-warmup-smoke]
         [--no-chaos-smoke] [--no-telemetry-smoke] [--no-sentinel-smoke]
         [--no-fleet-smoke] [--no-approx-smoke] [--no-wire-smoke]
+        [--no-ring-smoke] [--no-lane-smoke]
 
 Rule catalog + waiver syntax: docs/ANALYSIS.md.
 """
@@ -721,6 +725,81 @@ def ring_smoke() -> int:
     return 1 if failures else 0
 
 
+def lane_smoke() -> int:
+    """The vmapped-lane loop (docs/SERVING.md "Standing queries"): the
+    lane-vs-fused-slot comparison at S=256 same-class bbox geofences
+    with one membership-churn event in both measured windows — the
+    lane leg must clear the >=10x events/s floor (the fused leg pays
+    an S-proportional trace+compile on the first poll and a full
+    rebuild on churn; the lane leg one batched kernel + a parameter-
+    row write), lane dispatches-per-poll must stay <=4 (one geofence
+    class => one batched dispatch per poll), and both legs must push
+    the IDENTICAL event total (the speedup is not bought with dropped
+    events). S=256 keeps the fused leg near ~20 s; the S=1024 floor
+    itself rides tier-1 via tests/test_subscribe.py. Stderr-only like
+    the other smokes."""
+    _pin_cpu()
+    import numpy as np
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.kafka.store import KafkaDataStore
+    from geomesa_tpu.serve.loadgen import run_subscribe_lanes
+
+    failures = []
+    sft = SimpleFeatureType.from_spec(
+        "lanesmoke", "name:String,score:Double,dtg:Date,*geom:Point")
+    n = 256
+
+    def make_store():
+        store = KafkaDataStore()
+        store.create_schema(sft)
+        return store
+
+    def make_batch(i: int) -> FeatureBatch:
+        rng = np.random.default_rng(997 * i + 13)
+        return FeatureBatch.from_pydict(sft, {
+            "name": rng.choice(["a", "b", "c"], n).tolist(),
+            "score": rng.uniform(-10, 10, n),
+            "dtg": rng.integers(
+                1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack([rng.uniform(-60, 60, n),
+                              rng.uniform(-30, 30, n)], 1),
+        }, fids=[f"v{j}" for j in range(n)])
+
+    rep = run_subscribe_lanes(make_store, "lanesmoke", make_batch,
+                              subscriptions=256, batches=2)
+    lanes, fused = rep["lanes"], rep["fused"]
+    if lanes["events_total"] != fused["events_total"]:
+        failures.append(
+            f"event totals diverge: lanes {lanes['events_total']} vs "
+            f"fused {fused['events_total']}")
+    if rep.get("speedup", 0.0) < 10.0:
+        failures.append(
+            f"lane events/s floor missed: {rep.get('speedup')}x < 10x "
+            f"(lanes {lanes['events_per_s']}/s vs fused "
+            f"{fused['events_per_s']}/s)")
+    if lanes["dispatches_per_poll"] > 4.0:
+        failures.append(
+            f"lane dispatches-per-poll {lanes['dispatches_per_poll']} "
+            f"> 4 for one geofence class")
+    if lanes["lane_dispatches"] < lanes["polls"]:
+        failures.append(
+            f"lane path not exercised: {lanes['lane_dispatches']} lane "
+            f"dispatch(es) over {lanes['polls']} poll(s)")
+    print(
+        f"lane smoke: S=256 speedup {rep.get('speedup')}x "
+        f"(lanes first_poll {lanes['first_poll_s']}s churn "
+        f"{lanes.get('churn_poll_s')}s vs fused {fused['first_poll_s']}s"
+        f"/{fused.get('churn_poll_s')}s), "
+        f"{lanes['events_total']} event(s) both legs, lane "
+        f"dispatches/poll {lanes['dispatches_per_poll']}",
+        file=sys.stderr)
+    for f in failures:
+        print(f"lane smoke: FAIL {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def spmd_smoke() -> int:
     """Prove the SPMD pass still bites: lint a known-dirty fixture — a
     miniature repo skeleton (pyproject.toml + geomesa_tpu/parallel/
@@ -975,6 +1054,12 @@ def main(argv=None) -> int:
                         "program: bit-identity vs serial + "
                         "dispatches_per_window strictly below the "
                         "pipelined baseline; text mode only)")
+    p.add_argument("--no-lane-smoke", action="store_true",
+                   help="skip the vmapped-lane smoke (lane vs fused-"
+                        "slot standing-query comparison at S=256 with "
+                        "membership churn: >=10x events/s floor, "
+                        "identical event totals, lane dispatches/poll "
+                        "<=4; text mode only)")
     args = p.parse_args(argv)
     # incremental: a warm cache replays findings byte-identical to a
     # cold scan (asserted by tests/test_analysis_spmd.py), so repeated
@@ -1009,6 +1094,8 @@ def main(argv=None) -> int:
         rc = wire_smoke()
     if args.format == "text" and not args.no_ring_smoke and rc == 0:
         rc = ring_smoke()
+    if args.format == "text" and not args.no_lane_smoke and rc == 0:
+        rc = lane_smoke()
     return rc
 
 

@@ -211,6 +211,65 @@ class TestGT28RawShapeDispatch:
         """})
         assert not fs
 
+    def test_lane_param_table_len_sized(self, tmp_path):
+        # the vmapped-lane hazard (docs/SERVING.md "Standing
+        # queries"): a len(subs)-sized parameter table reaching the
+        # [S]-batched lane dispatch recompiles on EVERY membership
+        # change — exactly the per-subscription compile the lanes
+        # exist to eliminate
+        fs = lint_tree(tmp_path, {"geomesa_tpu/subscribe/lanetab.py": """\
+            import jax
+            import numpy as np
+
+
+            @jax.jit
+            def lane_bbox(params, active, x, y):
+                hit = ((x[None, :] >= params[:, 0:1])
+                       & (x[None, :] <= params[:, 1:2]))
+                return hit & active[:, None]
+
+
+            def evaluate(subs, x, y):
+                params = np.zeros((len(subs), 8), np.float32)
+                active = np.ones(len(subs), bool)
+                return lane_bbox(params, active, x, y)
+        """})
+        assert ("GT28", 15) in codes_lines(fs)
+        f = next(f for f in active(fs) if f.rule == "GT28")
+        assert any("len" in s["note"] for s in f.extra["chain"])
+
+    def test_lane_param_table_clean_bucketed_twin(self, tmp_path):
+        # the shipped discipline: pow2 [S]-bucket capacity + an active
+        # mask column, so membership churn is a row write and the
+        # compiled program only changes when the bucket grows
+        fs = lint_tree(tmp_path, {"geomesa_tpu/subscribe/lanetab.py": """\
+            import jax
+            import numpy as np
+
+
+            @jax.jit
+            def lane_bbox(params, active, x, y):
+                hit = ((x[None, :] >= params[:, 0:1])
+                       & (x[None, :] <= params[:, 1:2]))
+                return hit & active[:, None]
+
+
+            def next_pow2(n):
+                p = 1
+                while p < n:
+                    p *= 2
+                return p
+
+
+            def evaluate(subs, x, y):
+                cap = next_pow2(max(len(subs), 8))
+                params = np.zeros((cap, 8), np.float32)
+                active = np.zeros(cap, bool)
+                active[: len(subs)] = True
+                return lane_bbox(params, active, x, y)
+        """})
+        assert not active(fs)
+
     def test_origin_chain_waiver_cross_file(self, tmp_path):
         # the origin waiver reaches dispatches in OTHER modules: one
         # directive at the birth site instead of one per consumer

@@ -662,3 +662,111 @@ class TestApproxDensitySubscribe:
         with pytest.raises(ValueError):
             DensityWindow((-1.0, -1.0, 1.0, 1.0), 4, 4, tolerance=0.1,
                           decay=0.5)
+
+
+class TestDistinct:
+    """DISTINCT counts (QueryHints.distinct, docs/SERVING.md
+    "Approximate answers"): a tolerance hint resolves at admission from
+    per-partition HyperLogLog sketches merged under the manifest
+    snapshot with a typed [lo, hi] bound; without one (or with a
+    predicate — the HLL path is Include-only) the answer pays an exact
+    feature scan + host unique count."""
+
+    @pytest.fixture(scope="class")
+    def dstore(self, tmp_path_factory):
+        from geomesa_tpu.plan.datastore import DataStore
+
+        sft = SimpleFeatureType.from_spec("dst", SFT_SPEC)
+        ds = DataStore(str(tmp_path_factory.mktemp("distinct")),
+                       use_device_cache=True)
+        src = ds.create_schema(sft)
+        rng = np.random.default_rng(3)
+        n = 4096
+        names = [f"u{int(v)}" for v in rng.integers(0, 1500, n)]
+        src.write(FeatureBatch.from_pydict(sft, {
+            "name": names,
+            "score": rng.uniform(-10, 10, n),
+            "dtg": rng.integers(T0, T1, n),
+            "geom": np.stack([rng.uniform(-170, 170, n),
+                              rng.uniform(-80, 80, n)], 1),
+        }))
+        return ds, len(set(names))
+
+    def test_hll_resolve_within_bound(self, dstore):
+        ds, truth = dstore
+        planner = ds.get_feature_source("dst").planner
+        res = planner.count_result(Query(
+            "dst", "INCLUDE",
+            hints=QueryHints(distinct="name", tolerance=0.1)))
+        assert res.approx and res.bound > 0
+        assert res.confidence == pytest.approx(0.99)
+        assert abs(res.count - truth) <= res.bound, (
+            f"HLL estimate {res.count} +/- {res.bound} missed exact "
+            f"{truth}")
+        # memoized under the manifest version: bit-identical repeat
+        again = planner.count_result(Query(
+            "dst", "INCLUDE",
+            hints=QueryHints(distinct="name", tolerance=0.1)))
+        assert (again.count, again.bound) == (res.count, res.bound)
+
+    def test_exact_without_tolerance(self, dstore):
+        ds, truth = dstore
+        planner = ds.get_feature_source("dst").planner
+        res = planner.count_result(Query(
+            "dst", "INCLUDE", hints=QueryHints(distinct="name")))
+        assert not getattr(res, "approx", False)
+        assert res.count == truth
+
+    def test_predicate_routes_exact(self, dstore):
+        ds, _truth = dstore
+        src = ds.get_feature_source("dst")
+        cql = "BBOX(geom, -60, -30, 60, 30)"
+        feats = src.get_features(Query("dst", cql)).features
+        want = len(set(np.asarray(
+            feats.columns["name"].decode(), dtype=object)))
+        # tolerance offered, but the HLL tier is Include-only: the
+        # filtered distinct must come back exact, never estimated
+        res = src.planner.count_result(Query(
+            "dst", cql, hints=QueryHints(distinct="name",
+                                         tolerance=0.1)))
+        assert not getattr(res, "approx", False)
+        assert res.count == want
+
+    def test_validation_is_typed(self, dstore):
+        ds, _truth = dstore
+        planner = ds.get_feature_source("dst").planner
+        with pytest.raises(ValueError, match="not in schema"):
+            planner.count_result(Query(
+                "dst", "INCLUDE", hints=QueryHints(distinct="nosuch")))
+        with pytest.raises(ValueError, match="geometry"):
+            planner.count_result(Query(
+                "dst", "INCLUDE", hints=QueryHints(distinct="geom")))
+
+    def test_wire_carries_lo_hi(self, dstore):
+        import json as _json
+
+        from geomesa_tpu.serve.protocol import serve_lines
+
+        ds, truth = dstore
+        out = []
+
+        def lines():
+            yield _json.dumps({"id": "d1", "op": "count",
+                               "typeName": "dst", "cql": "INCLUDE",
+                               "distinct": "name", "tolerance": 0.1})
+            yield _json.dumps({"id": "d2", "op": "count",
+                               "typeName": "dst", "cql": "INCLUDE",
+                               "distinct": "name"})
+
+        serve_lines(ds, lines(), out.append, ServeConfig(pipeline=False))
+        by_id = {d["id"]: d for d in map(_json.loads, out)}
+        d1 = by_id["d1"]
+        assert d1["ok"] and d1["approx"]
+        # the typed bound rides the wire as a [lo, hi] interval that
+        # must contain the exact answer
+        assert d1["lo"] <= truth <= d1["hi"]
+        assert d1["lo"] == max(0, d1["count"] - d1["bound"])
+        assert d1["hi"] == d1["count"] + d1["bound"]
+        d2 = by_id["d2"]
+        assert d2["ok"] and d2["count"] == truth
+        assert not d2.get("approx") and "lo" not in d2

@@ -24,7 +24,8 @@ def test_lint_gate_passes_on_shipped_tree():
     # tests/test_slo_observability.py sentinel record/replay/verdict;
     # tests/test_fleet.py kill-mid-burst failover; tests/test_wire.py
     # columnar parity + one-encode fan-out; tests/test_ringloop.py ring
-    # bit-identity + dispatches_per_window); repeating them in a cold
+    # bit-identity + dispatches_per_window; tests/test_subscribe.py
+    # lane-vs-fused floor + parity); repeating them in a cold
     # subprocess would only re-pay jax startup + kernel compiles
     # against the suite's wall-clock budget. All smokes still guard
     # standalone `python scripts/lint_gate.py` CI runs.
@@ -32,7 +33,8 @@ def test_lint_gate_passes_on_shipped_tree():
                         "--no-dataflow-smoke", "--no-chaos-smoke",
                         "--no-telemetry-smoke", "--no-sentinel-smoke",
                         "--no-fleet-smoke", "--no-approx-smoke",
-                        "--no-wire-smoke", "--no-ring-smoke"],
+                        "--no-wire-smoke", "--no-ring-smoke",
+                        "--no-lane-smoke"],
                        capture_output=True, text=True, cwd=REPO_ROOT)
     assert r.returncode == 0, (
         f"lint gate failed:\n{r.stdout}\n{r.stderr}")
