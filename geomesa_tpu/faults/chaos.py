@@ -755,7 +755,7 @@ def run_chaos(plan: FaultPlan, requests: int = 32, replay: bool = True,
 #
 # `gmtpu chaos --fleet`: the replica-kill certification. A 2-replica
 # thread fleet (same process semantics as deployment: own stores, own
-# queues, the real wire protocol over real sockets) serves four phases:
+# queues, the real wire protocol over real sockets) serves five phases:
 #
 #   1. route   — sequential mixed traffic; every answer ok; both
 #                replicas take traffic (rendezvous affinity spreads
@@ -776,7 +776,16 @@ def run_chaos(plan: FaultPlan, requests: int = 32, replay: bool = True,
 #                traffic demonstrably refuses traffic (typed,
 #                retryable `warming`) until `gmtpu warmup --check`
 #                semantics pass, and the router never routes to it
-#                before `ready`.
+#                before `ready`;
+#   5. subscribe-kill — a geofence standing query subscribed THROUGH
+#                the router over a shared Kafka live layer, owner
+#                replica killed abruptly mid-stream. The router
+#                re-homes the subscription onto the survivor from its
+#                checkpoint; a host oracle replays the client's frame
+#                stream and asserts ZERO missed / duplicate / phantom
+#                enter-exit transitions modulo exactly ONE state
+#                resync, seq strictly monotonic across the kill, and
+#                zero client-side handoff choreography.
 #
 # The whole sequence runs twice with the same seed; the harness fire
 # logs must match exactly (invariant 3's replay discipline).
@@ -974,6 +983,10 @@ def _run_fleet_pass(plan: FaultPlan, root: str, report: ChaosReport,
                     f"traffic ({got})")
         probe.close()
         cli.close()
+
+        # phase 5: subscribe-kill — fleet-native standing queries
+        # survive an abrupt owner death with at most one resync
+        _fleet_subscribe_kill_phase(plan, report, say)
         return log
     finally:
         if extra is not None:
@@ -981,6 +994,144 @@ def _run_fleet_pass(plan: FaultPlan, root: str, report: ChaosReport,
                 extra.abort()
             except Exception:
                 pass
+        sup.close()
+
+
+_FLEET_SUB_BATCHES = 4          # geofence stream batches (kill after #2)
+_FLEET_SUB_FIDS = 24
+
+
+def _fleet_subscribe_kill_phase(plan: FaultPlan, report: ChaosReport,
+                                say) -> None:
+    """A geofence stream subscribed through the router across an
+    abrupt owner kill. Host-oracle replay of the client's frames
+    certifies the re-home contract: zero missed/dup/phantom
+    transitions, exactly one state resync, seq monotonic — with the
+    client doing nothing but reading its one connection."""
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.fleet import FleetConfig, FleetSupervisor
+    from geomesa_tpu.fleet.router import FleetClient
+    from geomesa_tpu.kafka.store import KafkaDataStore
+
+    sft = SimpleFeatureType.from_spec(
+        "geofence", "name:String,score:Double,dtg:Date,*geom:Point")
+    fence = (-20.0, -15.0, 25.0, 20.0)
+    cql = f"BBOX(geom, {fence[0]}, {fence[1]}, {fence[2]}, {fence[3]})"
+    rng = np.random.default_rng(plan.seed + 97)
+    fids = [f"v{i}" for i in range(_FLEET_SUB_FIDS)]
+
+    def batch(k: int) -> FeatureBatch:
+        # same fid population every batch: vessels MOVE, so the fence
+        # sees enter AND exit transitions each fold
+        return FeatureBatch.from_pydict(sft, {
+            "name": rng.choice(["a", "b", "c"],
+                               _FLEET_SUB_FIDS).tolist(),
+            "score": rng.uniform(-5, 5, _FLEET_SUB_FIDS),
+            "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000,
+                                _FLEET_SUB_FIDS),
+            "geom": np.stack([rng.uniform(-60, 60, _FLEET_SUB_FIDS),
+                              rng.uniform(-30, 30, _FLEET_SUB_FIDS)],
+                             1),
+        }, fids=list(fids))
+
+    def inside(b: FeatureBatch) -> set:
+        g = b.columns[sft.default_geometry.name]
+        x = np.asarray(g.x)
+        y = np.asarray(g.y)
+        keep = ((x >= fence[0]) & (x <= fence[2])
+                & (y >= fence[1]) & (y <= fence[3]))
+        return {f for f, k in zip(b.fids.decode(), keep) if k}
+
+    store = KafkaDataStore()
+    src = store.create_schema(sft)
+    sup = FleetSupervisor(FleetConfig(
+        n_replicas=2, store_factory=lambda: store,
+        probe_interval_s=0.1))
+    frames: List[dict] = []
+    fail = report.invariant_failures.append
+    try:
+        port = sup.start()
+        cli = FleetClient("127.0.0.1", port, timeout_s=30.0)
+        got = cli.request({"op": "subscribe", "typeName": "geofence",
+                           "cql": cql}, on_push=frames.append)
+        if not got.get("ok"):
+            fail(f"fleet subscribe phase: subscribe refused ({got})")
+            return
+        sid = got["subscription"]
+        owner = got["replica"]
+        oracle = None
+        killed = False
+        for k in range(_FLEET_SUB_BATCHES):
+            b = batch(k)
+            oracle = inside(b)
+            src.write(b)
+            if k == 2 and not killed:
+                # let one checkpoint ride the stats probe, then kill
+                # the owner abruptly mid-stream and wait for the
+                # router's re-home to land on the survivor
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    row = sup.membership.sub_owner(sid)
+                    if row is not None and row.checkpoint is not None:
+                        break
+                    time.sleep(0.02)
+                sup.kill_replica(owner, graceful=False)
+                killed = True
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    row = sup.membership.sub_owner(sid)
+                    if row is not None and row.replica_id != owner:
+                        break
+                    time.sleep(0.02)
+                row = sup.membership.sub_owner(sid)
+                if row is None or row.replica_id == owner:
+                    fail("fleet subscribe phase: subscription was not "
+                         "re-homed after the owner kill")
+                    return
+            got = cli.request({"op": "poll"}, on_push=frames.append)
+            report.requests += 1
+            if got.get("ok"):
+                report.ok += 1
+            else:
+                fail(f"fleet subscribe phase: poll {k} failed ({got})")
+        cli.close()
+
+        evs = [f for f in frames if f.get("subscription") == sid]
+        seqs = [f.get("seq") for f in evs]
+        if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+            fail(f"fleet subscribe phase: client seq not strictly "
+                 f"monotonic across the kill ({seqs})")
+        resyncs = sum(1 for f in evs[1:] if f.get("event") == "state")
+        if resyncs != 1:
+            fail(f"fleet subscribe phase: expected exactly one state "
+                 f"resync from the kill, saw {resyncs}")
+        state: set = set()
+        for f in evs:
+            ev = f.get("event")
+            if ev == "state":
+                state = set(f["fids"])
+            elif ev == "enter":
+                dup = set(f["fids"]) & state
+                if dup:
+                    fail(f"fleet subscribe phase: duplicate enter "
+                         f"transitions for {sorted(dup)}")
+                state |= set(f["fids"])
+            elif ev == "exit":
+                ghost = set(f["fids"]) - state
+                if ghost:
+                    fail(f"fleet subscribe phase: phantom exit "
+                         f"transitions for {sorted(ghost)}")
+                state -= set(f["fids"])
+        if oracle is not None and state != oracle:
+            fail(f"fleet subscribe phase: replayed matched set "
+                 f"diverged from the host oracle (missed="
+                 f"{sorted(oracle - state)}, extra="
+                 f"{sorted(state - oracle)})")
+        st = sup.stats()["router"]
+        say(f"fleet subscribe phase: {len(evs)} frames, "
+            f"1 resync, rehomed={st['rehome_succeeded']}")
+    finally:
         sup.close()
 
 

@@ -61,6 +61,29 @@ class ReplicaHandle:
         return self.state in ("ready", "degraded")
 
 
+@dataclasses.dataclass
+class SubscriptionOwner:
+    """Typed ownership row for one router-homed standing query: WHICH
+    replica currently evaluates it, under which replica-local id, plus
+    the last checkpointed handoff snapshot the death sweep re-homes
+    from (docs/ROBUSTNESS.md "Standing queries"). The row is the
+    routing table of record — the router's per-client connection state
+    (sinks, seq counters) lives with the router; this is what survives
+    a replica and seeds the replay."""
+
+    sub_id: str              # router-side id, stable across re-homes
+    replica_id: str          # current owner
+    replica_sub_id: str      # the owner's local subscription id
+    mode: str = "predicate"  # "predicate" | "density"
+    paused: bool = False
+    # last handoff snapshot off the stats-probe piggyback; None until
+    # the first probe lands (a kill before then re-homes checkpoint-
+    # less: the survivor's state resync still reconciles)
+    checkpoint: Optional[dict] = None
+    checkpoint_at: float = 0.0   # monotonic; staleness gauge input
+    rehomes: int = 0             # times this row moved replicas
+
+
 class Membership:
     """Thread-safe replica table. The router and supervisor share one;
     `snapshot()` is the `gmtpu fleet status` / `{"op": "fleet"}`
@@ -69,6 +92,9 @@ class Membership:
     def __init__(self):
         self._lock = threading.Lock()
         self._replicas: Dict[str, ReplicaHandle] = {}
+        # standing-query ownership (router-homed subscriptions), keyed
+        # by the router-side stable sub id
+        self._subs: Dict[str, SubscriptionOwner] = {}
 
     # -- table -------------------------------------------------------------
 
@@ -201,11 +227,106 @@ class Membership:
                 reason="slo burn gates" if burn_gated else "probe ok")
         return failures
 
+    # -- standing-query ownership ------------------------------------------
+
+    def own_sub(self, owner: SubscriptionOwner) -> SubscriptionOwner:
+        """Record (or re-point) one standing query's owning replica;
+        exports the `fleet.subs.owned{replica}` gauges."""
+        with self._lock:
+            self._subs[owner.sub_id] = owner
+        self._export_subs_owned()
+        return owner
+
+    def move_sub(self, sub_id: str, replica_id: str,
+                 replica_sub_id: str) -> Optional[SubscriptionOwner]:
+        """Re-home one row onto a survivor (the death sweep / rolling
+        restart path). Unknown ids are ignored — the client may have
+        unsubscribed while the re-home was in flight."""
+        with self._lock:
+            row = self._subs.get(sub_id)
+            if row is None:
+                return None
+            row.replica_id = replica_id
+            row.replica_sub_id = replica_sub_id
+            row.rehomes += 1
+        self._export_subs_owned()
+        return row
+
+    def drop_sub(self, sub_id: str) -> Optional[SubscriptionOwner]:
+        with self._lock:
+            row = self._subs.pop(sub_id, None)
+        if row is not None:
+            self._export_subs_owned()
+        return row
+
+    def sub_owner(self, sub_id: str) -> Optional[SubscriptionOwner]:
+        with self._lock:
+            return self._subs.get(sub_id)
+
+    def subs_owned_by(self, replica_id: str) -> List[SubscriptionOwner]:
+        with self._lock:
+            return [row for row in self._subs.values()
+                    if row.replica_id == replica_id]
+
+    def set_sub_paused(self, sub_id: str, paused: bool) -> None:
+        with self._lock:
+            row = self._subs.get(sub_id)
+            if row is not None:
+                row.paused = paused
+
+    def note_checkpoint(self, sub_id: str, snapshot: dict) -> bool:
+        """Store one handoff snapshot off the stats-probe piggyback
+        (bounded staleness: at most one probe interval + the replica's
+        seq-watermark cadence behind the live outbox). Returns whether
+        a row was updated."""
+        with self._lock:
+            row = self._subs.get(sub_id)
+            if row is None:
+                return False
+            row.checkpoint = snapshot
+            row.checkpoint_at = time.monotonic()
+            row.paused = snapshot.get("status") == "paused"
+        return True
+
+    def _export_subs_owned(self) -> None:
+        from geomesa_tpu.utils.metrics import metrics
+
+        with self._lock:
+            counts: Dict[str, int] = {rid: 0 for rid in self._replicas}
+            for row in self._subs.values():
+                counts[row.replica_id] = counts.get(row.replica_id, 0) + 1
+        for rid, n in counts.items():
+            metrics.gauge("fleet.subs.owned", float(n), replica=rid)
+
+    def export_checkpoint_staleness(self) -> Dict[str, float]:
+        """Per-replica seconds since the OLDEST owned checkpoint was
+        refreshed (0.0 with nothing owned / nothing checkpointed yet);
+        also exports the `fleet.subs.checkpoint_staleness{replica}`
+        gauges."""
+        from geomesa_tpu.utils.metrics import metrics
+
+        now = time.monotonic()
+        with self._lock:
+            oldest: Dict[str, float] = {}
+            for row in self._subs.values():
+                if not row.checkpoint_at:
+                    continue
+                age = now - row.checkpoint_at
+                if age > oldest.get(row.replica_id, -1.0):
+                    oldest[row.replica_id] = age
+        for rid, age in oldest.items():
+            metrics.gauge("fleet.subs.checkpoint_staleness", age,
+                          replica=rid)
+        return oldest
+
     # -- introspection -----------------------------------------------------
 
     def snapshot(self) -> dict:
         """The `{"op": "fleet"}` / `gmtpu fleet status` document."""
         with self._lock:
+            owned: Dict[str, int] = {}
+            for row in self._subs.values():
+                owned[row.replica_id] = owned.get(row.replica_id, 0) + 1
             replicas = [{
                 "replica": h.replica_id,
                 "addr": f"{h.host}:{h.port}",
@@ -225,10 +346,15 @@ class Membership:
                 "approx_share": round(h.approx_share, 4),
                 "cached_share": round(h.cached_share, 4),
                 "incarnation": h.incarnation,
+                "subs_owned": owned.get(h.replica_id, 0),
             } for h in self._replicas.values()]
+            subscriptions = len(self._subs)
+            rehomes = sum(row.rehomes for row in self._subs.values())
         return {
             "replicas": replicas,
             "ready": sum(1 for r in replicas
                          if r["state"] in ("ready", "degraded")),
             "total": len(replicas),
+            "subscriptions": subscriptions,
+            "sub_rehomes": rehomes,
         }

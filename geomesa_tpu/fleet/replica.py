@@ -120,6 +120,11 @@ class ReplicaServer:
             "pid": os.getpid(),
             "port": self.port,
             "metrics_port": self.metrics_port,
+            # subscribe(handoff=) re-home capability: the router only
+            # replays orphaned standing queries onto replicas that
+            # advertise this (a pre-upgrade replica would reject the
+            # handoff field untyped)
+            "rehome": True,
         }
 
     def admitting(self) -> Optional[str]:

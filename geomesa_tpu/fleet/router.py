@@ -31,7 +31,24 @@ write verbs, which is what makes retry-once safe: zero
 double-executed writes by construction) ONCE on a healthy peer if the
 request's deadline allows, and answers the typed error otherwise.
 Nothing is ever silently dropped: every request the router accepted
-produces exactly one response line."""
+produces exactly one response line.
+
+Standing queries (docs/ROBUSTNESS.md "Standing queries") are
+fleet-native: the router is a full subscribe endpoint. A `subscribe`
+homes onto a replica via the same rendezvous affinity, the owner is
+recorded as typed ownership state in the membership table, and push
+frames off the owning link are proxied to the client with the
+SUBSCRIPTION ID AND SEQ REWRITTEN — the client-visible seq is the
+router's own monotonic counter, so it never regresses across a
+failover regardless of the owner's numbering. Replicas piggyback
+handoff-snapshot checkpoints on the stats probe (seq-watermark
+cadence, no new RPC); when the death sweep fires, each orphaned
+subscription replays onto a survivor through `subscribe(handoff=...)`
+seeded from the last checkpoint (density windows re-seed from the
+survivor's live snapshot instead), and the survivor's one `state`
+resync frame reconciles anything folded past the watermark: the
+client sees at most one resync per kill and zero handoff
+choreography."""
 
 from __future__ import annotations
 
@@ -43,18 +60,27 @@ from typing import Dict, List, Optional
 from zlib import crc32
 
 from geomesa_tpu.fleet.health import burn_gates_fired
-from geomesa_tpu.fleet.membership import Membership, ReplicaHandle
+from geomesa_tpu.fleet.membership import (
+    Membership, ReplicaHandle, SubscriptionOwner)
 from geomesa_tpu.fleet.wire import JsonLineConn, connect_json
 
 # ops the router may re-send after a replica death: the read-only query
 # surface. Retrying is safe because these execute no writes; subscribe
-# verbs are replica-sticky and deliberately NOT proxied (docs/
-# ROBUSTNESS.md "what is and is not exactly-once across failover")
+# verbs get their own re-home discipline below (docs/ROBUSTNESS.md
+# "what is and is not exactly-once across failover")
 IDEMPOTENT_OPS = frozenset(
     ("query", "execute", "count", "knn", "stats"))
-_SUBSCRIBE_OPS = frozenset(
+# the routed subscribe surface: homed by affinity, re-homed on death.
+# attach/detach stay refused — a mirror rides ONE replica connection's
+# push mux by construction and has no cross-replica meaning
+_SUB_VERBS = frozenset(
     ("subscribe", "unsubscribe", "poll", "subscriptions",
-     "attach", "detach"))
+     "export_subscription", "pause", "resume"))
+_SUBSCRIBE_OPS = _SUB_VERBS | frozenset(("attach", "detach"))
+# terminal push frames: ownership ends with the stream
+_TERMINAL_EVENTS = ("expired", "quarantined")
+# replica-side lifecycle refusals worth walking to the next candidate
+_RETRY_REASONS = ("warming", "draining", "starting", "shutting_down")
 
 _DEFAULT_DEADLINE_S = 30.0
 _PROBE_INTERVAL_S = 0.5
@@ -64,13 +90,19 @@ _ACCEPT_TIMEOUT_S = 0.25
 
 
 class _Pending:
-    """One routed request awaiting its replica response."""
+    """One routed request awaiting its replica response. Custody
+    callbacks: `probe_cb` (health probe — silent on link death),
+    `on_reply`/`on_down` (subscribe-surface requests that need their
+    own delivery/death handling instead of the default forward +
+    retry-once)."""
 
     __slots__ = ("client", "orig_id", "doc", "op", "attempts",
-                 "deadline", "probe_cb", "payload")
+                 "deadline", "probe_cb", "payload", "on_reply",
+                 "on_down")
 
     def __init__(self, client, orig_id, doc, op, deadline,
-                 probe_cb=None, payload=None):
+                 probe_cb=None, payload=None, on_reply=None,
+                 on_down=None):
         self.client = client
         self.orig_id = orig_id
         self.doc = doc
@@ -82,6 +114,8 @@ class _Pending:
         # binary frame payload, forwarded OPAQUELY — immutable bytes,
         # so a retry-once redispatch re-sends the identical frame
         self.payload = payload
+        self.on_reply = on_reply
+        self.on_down = on_down
 
 
 class ReplicaLink:
@@ -162,7 +196,15 @@ class ReplicaLink:
             for got in self.conn.docs(self._stop):
                 token = got.get("id")
                 if token is None:
-                    continue  # push frame: not proxied
+                    # push frame off this replica's standing queries:
+                    # route to the owning client (seq rewritten), in
+                    # arrival order — one reader thread per link IS the
+                    # per-subscription ordering guarantee
+                    try:
+                        self.router._on_push(self, got)
+                    except Exception:  # noqa: BLE001 — one frame, not
+                        pass           # the whole link's reader
+                    continue
                 with self._lock:
                     p = self.pending.pop(token, None)
                 if p is None:
@@ -203,6 +245,37 @@ class ReplicaLink:
         return len(stale)
 
 
+class RouterSub:
+    """One router-homed standing query: the stable client-facing id,
+    the client push sink, and the CLIENT-VISIBLE seq counter. Every
+    forwarded frame is restamped from `seq` under `lock`, so the
+    stream the client sees stays monotonic across any number of
+    re-homes — replica-local numbering never leaks. The ownership /
+    checkpoint row of record lives in the membership table
+    (SubscriptionOwner); this object is the router's connection-side
+    half."""
+
+    __slots__ = ("sub_id", "client", "session", "doc", "mode",
+                 "paused", "seq", "resyncs", "replica_id",
+                 "replica_sub_id", "closed", "lock")
+
+    def __init__(self, sub_id: str, client: JsonLineConn,
+                 session: dict, doc: dict, mode: str,
+                 paused: bool = False):
+        self.sub_id = sub_id
+        self.client = client
+        self.session = session
+        self.doc = doc              # forwardable subscribe request
+        self.mode = mode            # "predicate" | "density"
+        self.paused = paused
+        self.seq = 0
+        self.resyncs = 0
+        self.replica_id: Optional[str] = None
+        self.replica_sub_id: Optional[str] = None
+        self.closed = False
+        self.lock = threading.Lock()
+
+
 class FleetRouter:
     """Client-facing TCP server + per-replica links + health monitor."""
 
@@ -211,7 +284,7 @@ class FleetRouter:
                  probe_interval_s: float = _PROBE_INTERVAL_S,
                  spill_threshold: int = _SPILL_THRESHOLD,
                  default_deadline_s: float = _DEFAULT_DEADLINE_S,
-                 supervisor=None):
+                 supervisor=None, rehome: bool = True):
         self.membership = membership or Membership()
         self.host = host
         self._requested_port = port
@@ -220,18 +293,34 @@ class FleetRouter:
         self.spill_threshold = spill_threshold
         self.default_deadline_s = default_deadline_s
         self.supervisor = supervisor
+        # rehome=False is the pre-upgrade router shape: subscribe verbs
+        # refuse typed `unsupported` and the hello advertises no
+        # `rehome` capability (the back-compat regression test pins it)
+        self.rehome = rehome
         self._tokens = itertools.count(1)
         self._stop = threading.Event()
         self._listener: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self._counters_lock = threading.Lock()
+        # standing-query tables: stable client id -> RouterSub, plus
+        # the push-routing index keyed (owner replica, replica sub id).
+        # The index mutates only on subscribe acks — delivered on the
+        # SAME reader thread as the frames that follow, so a frame can
+        # never outrun its own routing entry
+        self._subs_lock = threading.Lock()
+        self._subs: Dict[str, RouterSub] = {}
+        self._sub_index: Dict[tuple, RouterSub] = {}
+        self._rsub_ids = itertools.count(1)
         # "retried" is deliberately absent: it is DERIVED from
         # membership's per-replica retried_onto in stats(), so the two
         # surfaces cannot diverge (a retry placed by whichever death
         # sweep won an ownership race counts exactly once, where the
         # send landed)
         self._counters = {"requests": 0, "routed": 0,
-                          "shed": 0, "unavailable": 0, "probes": 0}
+                          "shed": 0, "unavailable": 0, "probes": 0,
+                          "rehome_attempted": 0,
+                          "rehome_succeeded": 0,
+                          "rehome_failed": 0}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -305,7 +394,7 @@ class FleetRouter:
                 self._threads.append(t)
 
     def _client_loop(self, conn: JsonLineConn) -> None:
-        session = {"admin": False}
+        session = {"admin": False, "subs": set()}
         try:
             n = 0
             for doc in conn.docs(self._stop):
@@ -318,6 +407,10 @@ class FleetRouter:
                         "id": doc.get("id", n), "ok": False,
                         "error": "error", "message": str(e)})
         finally:
+            # a hung-up client's standing queries die with it: cancel
+            # on the owning replicas so outboxes do not fill for a
+            # sink nobody reads
+            self._drop_client_subs(session)
             conn.close()
 
     def _safe_send(self, client, doc: dict,
@@ -355,7 +448,7 @@ class FleetRouter:
             role = str(doc.get("role", "client"))
             if role in ("router", "admin"):
                 session["admin"] = True
-            self._safe_send(client, {
+            out = {
                 "id": rid, "ok": True, "role": role, "router": True,
                 "admin": session["admin"],
                 # passthrough is OPAQUE: the router forwards frames
@@ -363,7 +456,14 @@ class FleetRouter:
                 # per-request downgrade is authoritative
                 "wire": ["json", "columnar"],
                 **{k: v for k, v in self.membership.snapshot().items()
-                   if k in ("ready", "total")}})
+                   if k in ("ready", "total")}}
+            if self.rehome:
+                # capability flag: this router homes standing queries
+                # and re-homes them across failover. Absent on
+                # pre-upgrade routers — clients gate on it before
+                # subscribing through the fleet port
+                out["rehome"] = True
+            self._safe_send(client, out)
             return
         if op == "ingest":
             # the query wire has NO write verbs by design — that is
@@ -412,15 +512,19 @@ class FleetRouter:
                            "replica on ITS port directly"})
             return
         if op in _SUBSCRIBE_OPS:
-            # standing queries are replica-sticky state the router
-            # cannot fail over exactly-once; refuse typed rather than
-            # proxy a stream whose replay semantics we cannot honor
-            self._safe_send(client, {
-                "id": rid, "ok": False, "error": "rejected",
-                "reason": "unsupported",
-                "message": "standing queries are replica-sticky: "
-                           "connect to a replica directly "
-                           "(docs/ROBUSTNESS.md fleet section)"})
+            if op in ("attach", "detach") or not self.rehome:
+                # attach/detach carry a client-materialized wire
+                # handoff whose exactly-once replay the router cannot
+                # audit; with rehome disabled the whole surface keeps
+                # the pre-upgrade refusal (back-compat contract)
+                self._safe_send(client, {
+                    "id": rid, "ok": False, "error": "rejected",
+                    "reason": "unsupported",
+                    "message": "standing queries are replica-sticky: "
+                               "connect to a replica directly "
+                               "(docs/ROBUSTNESS.md fleet section)"})
+                return
+            self._route_subscribe(op, rid, doc, client, session)
             return
         deadline = time.monotonic() + (
             float(doc["timeoutMs"]) / 1000.0 if doc.get("timeoutMs")
@@ -508,6 +612,11 @@ class FleetRouter:
         if p.probe_cb is not None:
             p.probe_cb(got)
             return
+        if p.on_reply is not None:
+            # subscribe-surface custody: the callback owns the reply
+            # (ack registration, candidate walk, client answer)
+            p.on_reply(link, got)
+            return
         if (not got.get("ok") and got.get("retryable")
                 and got.get("reason") in ("warming", "draining",
                                           "starting", "shutting_down")
@@ -547,12 +656,25 @@ class FleetRouter:
         for p in orphans:
             if p.probe_cb is not None:
                 continue
+            if p.on_down is not None:
+                # subscribe-surface custody: in-flight (re)subscribes
+                # walk to the next candidate themselves — the generic
+                # IDEMPOTENT_OPS retry must not double-place them
+                try:
+                    p.on_down(rid)
+                except Exception:  # noqa: BLE001 — one sub, not the sweep
+                    pass
+                continue
             if (p.op in IDEMPOTENT_OPS and p.attempts < 1
                     and time.monotonic() < p.deadline):
                 p.attempts += 1
                 if self._dispatch(p, exclude=(rid,)):
                     continue
             self._answer_unavailable(p, "replica_unavailable")
+        if self.rehome and not self._stop.is_set():
+            # the tentpole: every standing query homed on the dead
+            # replica replays onto a survivor from its last checkpoint
+            self._rehome_owned(rid)
 
     def _answer_unavailable(self, p: _Pending, reason: str) -> None:
         self._bump("unavailable")
@@ -566,10 +688,558 @@ class FleetRouter:
                        "(idempotent read) — the fleet is "
                        "redistributing"})
 
+    # -- standing queries (subscribe surface) ------------------------------
+
+    def _route_subscribe(self, op: str, rid, doc: dict, client,
+                         session: dict) -> None:
+        """Entry for every routed subscribe verb (rehome enabled)."""
+        if op == "subscribe":
+            fwd = {k: v for k, v in doc.items() if k != "id"}
+            mode = "density" if doc.get("density") else "predicate"
+            rsub = RouterSub(f"rs{next(self._rsub_ids)}", client,
+                             session, fwd, mode,
+                             paused=bool(doc.get("paused", False)))
+            with self._subs_lock:
+                self._subs[rsub.sub_id] = rsub
+            session["subs"].add(rsub.sub_id)
+            ctx = {"rsub": rsub, "rid": rid, "tried": set(),
+                   "handoff": None, "done": None}
+            if not self._sub_dispatch(ctx):
+                self._sub_fail(ctx, None)
+            return
+        if op == "subscriptions":
+            with self._subs_lock:
+                rows = [{"subscription": s.sub_id, "mode": s.mode,
+                         "replica": s.replica_id, "seq": s.seq,
+                         "paused": s.paused, "resyncs": s.resyncs}
+                        for s in self._subs.values()
+                        if s.session is session]
+            self._safe_send(client, {
+                "id": rid, "ok": True, "subscriptions": rows,
+                "total": len(rows)})
+            return
+        if op == "poll":
+            self._sub_poll(rid, client, session)
+            return
+        self._sub_forward(op, rid, doc, client, session)
+
+    def _sub_dispatch(self, ctx: dict) -> bool:
+        """Place (or replay) one standing query on a replica; walks
+        candidates on torn sockets. Returns False when no candidate is
+        left — the caller fails the sub typed."""
+        rsub: RouterSub = ctx["rsub"]
+        tried = ctx["tried"]
+        replay = ctx["rid"] is None
+        while True:
+            target = self._pick(rsub.doc, tried)
+            if target is None:
+                return False
+            if replay and not target.link.hello.get("rehome"):
+                # a pre-upgrade replica cannot seed subscribe(handoff):
+                # skip it for replays, never strand the sub on it
+                tried.add(target.replica_id)
+                continue
+            fwd = dict(rsub.doc)
+            if replay:
+                # the survivor's one `state` resync frame reconciles
+                # anything folded past the checkpoint watermark — this
+                # is THE at-most-one-resync-per-kill mechanism
+                fwd["initialState"] = True
+                if ctx["handoff"] is not None:
+                    fwd["handoff"] = ctx["handoff"]
+                if rsub.paused:
+                    fwd["paused"] = True
+            p = _Pending(
+                None, None, fwd, "subscribe",
+                time.monotonic() + self.default_deadline_s,
+                on_reply=lambda link, got, c=ctx:
+                    self._sub_reply(link, c, got),
+                on_down=lambda dead, c=ctx:
+                    self._sub_redispatch(c, dead))
+            token = f"fl{next(self._tokens)}"
+            try:
+                owned = target.link.send(token, p)
+            except OSError:
+                tried.add(target.replica_id)
+                continue
+            if owned:
+                self._bump("routed")
+                self.membership.note_routed(
+                    target.replica_id, retried=bool(tried))
+            # not owned -> the death sweep claimed the pending; its
+            # orphan loop invokes on_down, which re-enters here
+            return True
+
+    def _sub_redispatch(self, ctx: dict, dead_rid: str) -> None:
+        ctx["tried"].add(dead_rid)
+        if not self._sub_dispatch(ctx):
+            self._sub_fail(ctx, None)
+
+    def _sub_reply(self, link: ReplicaLink, ctx: dict,
+                   got: dict) -> None:
+        """A replica answered a routed (re)subscribe. Runs on the
+        owner link's reader thread — the SAME thread that will deliver
+        this sub's push frames, so the index entry written here can
+        never lose a race against the first frame."""
+        rsub: RouterSub = ctx["rsub"]
+        rid = ctx["rid"]
+        if not got.get("ok"):
+            if got.get("reason") in _RETRY_REASONS:
+                ctx["tried"].add(link.handle.replica_id)
+                if self._sub_dispatch(ctx):
+                    return
+            self._sub_fail(ctx, got)
+            return
+        replica_id = link.handle.replica_id
+        replica_sub_id = got.get("subscription")
+        with self._subs_lock:
+            if rsub.closed:
+                # client hung up while the (re)subscribe was in
+                # flight: release the fresh registration, do not leak
+                abandoned = True
+            else:
+                abandoned = False
+                if rsub.replica_id is not None:
+                    self._sub_index.pop(
+                        (rsub.replica_id, rsub.replica_sub_id), None)
+                rsub.replica_id = replica_id
+                rsub.replica_sub_id = replica_sub_id
+                rsub.paused = got.get("status") == "paused"
+                self._sub_index[(replica_id, replica_sub_id)] = rsub
+        if abandoned:
+            self._link_fire(link, {"op": "unsubscribe",
+                                   "subscription": replica_sub_id})
+            if ctx.get("done"):
+                ctx["done"](False)
+            return
+        if rid is not None:
+            # client-originated subscribe: record ownership, ack with
+            # the STABLE router-side id (the replica's id never leaks)
+            self.membership.own_sub(SubscriptionOwner(
+                sub_id=rsub.sub_id, replica_id=replica_id,
+                replica_sub_id=replica_sub_id, mode=rsub.mode,
+                paused=rsub.paused))
+            self._safe_send(rsub.client, {
+                "id": rid, "ok": True, "subscription": rsub.sub_id,
+                "mode": rsub.mode,
+                "status": got.get("status", "active"),
+                "replica": replica_id})
+        else:
+            # re-home replay landed
+            if self.membership.move_sub(
+                    rsub.sub_id, replica_id, replica_sub_id) is None:
+                # ownership row vanished mid-replay (client
+                # unsubscribed): release the fresh registration
+                self._drop_sub(rsub, notify_replica=True)
+                if ctx.get("done"):
+                    ctx["done"](False)
+                return
+            self._bump("rehome_succeeded")
+            from geomesa_tpu.utils.metrics import metrics
+
+            metrics.counter("fleet.rehome.succeeded")
+            try:
+                from geomesa_tpu.telemetry.recorder import RECORDER
+
+                RECORDER.note_event(
+                    "fleet.subs.rehome", sub=rsub.sub_id,
+                    replica=replica_id,
+                    seeded=ctx["handoff"] is not None)
+            # gt: waive GT14
+            # (deliberate degrade: the breadcrumb must not block the
+            # re-home that just restored the client's stream)
+            except Exception:
+                pass
+        if ctx.get("done"):
+            ctx["done"](True)
+
+    def _sub_fail(self, ctx: dict, got: Optional[dict]) -> None:
+        """No candidate accepted the (re)subscribe: fail typed. A
+        client-originated subscribe answers on the request id; a
+        re-home pushes a terminal `rehome_failed` frame — the stream
+        ends loudly, never silently."""
+        rsub: RouterSub = ctx["rsub"]
+        rid = ctx["rid"]
+        self._drop_sub(rsub, notify_replica=False)
+        if rid is not None:
+            if got is not None:
+                out = dict(got)
+                out["id"] = rid
+                self._safe_send(rsub.client, out)
+            else:
+                self._safe_send(rsub.client, {
+                    "id": rid, "ok": False, "error": "unavailable",
+                    "reason": "no_replicas", "retryable": True,
+                    "message": "no replica can home this subscription"
+                               " right now; retry is safe"})
+        else:
+            self._bump("rehome_failed")
+            from geomesa_tpu.utils.metrics import metrics
+
+            metrics.counter("fleet.rehome.failed")
+            try:
+                from geomesa_tpu.telemetry.recorder import RECORDER
+
+                RECORDER.note_event("fleet.subs.rehome_failed",
+                                    sub=rsub.sub_id)
+            # gt: waive GT14
+            # (deliberate degrade: telemetry must not block the
+            # terminal frame that tells the client its stream died)
+            except Exception:
+                pass
+            with rsub.lock:
+                rsub.seq += 1
+                seq = rsub.seq
+            self._safe_send(rsub.client, {
+                "event": "rehome_failed", "subscription": rsub.sub_id,
+                "seq": seq, "ok": False,
+                "message": "owner replica died and no survivor could "
+                           "home this subscription; re-subscribe to "
+                           "resume"})
+        if ctx.get("done"):
+            ctx["done"](False)
+
+    def _sub_forward(self, op: str, rid, doc: dict, client,
+                     session: dict) -> None:
+        """Per-subscription verbs (unsubscribe / pause / resume /
+        export_subscription): forward to the owner with ids rewritten
+        both ways."""
+        sid = doc.get("subscription")
+        with self._subs_lock:
+            rsub = self._subs.get(sid) if sid else None
+        if rsub is None or rsub.session is not session:
+            self._safe_send(client, {
+                "id": rid, "ok": False, "error": "error",
+                "message": "no such subscription"})
+            return
+        h = self.membership.get(rsub.replica_id) \
+            if rsub.replica_id else None
+        link = h.link if h is not None else None
+        if link is None or not link.alive:
+            # owner mid-failover: the re-home sweep is moving it
+            self._safe_send(client, {
+                "id": rid, "ok": False, "error": "unavailable",
+                "reason": "rehoming", "retryable": True,
+                "message": "this subscription is being re-homed after"
+                           " a replica failure; retry shortly"})
+            return
+
+        def on_reply(_link, got, r=rsub):
+            out = dict(got)
+            out["id"] = rid
+            if "subscription" in out:
+                out["subscription"] = r.sub_id
+            if got.get("ok"):
+                if op in ("pause", "resume"):
+                    r.paused = got.get("status") == "paused"
+                    self.membership.set_sub_paused(r.sub_id, r.paused)
+                elif op == "unsubscribe":
+                    self._drop_sub(r, notify_replica=False)
+                elif op == "export_subscription":
+                    # renumber the snapshot into CLIENT-visible seq
+                    # space: the watermark is whatever the client has
+                    # seen; undelivered outbox depth is preserved
+                    snap = out.get("handoff")
+                    if isinstance(snap, dict):
+                        snap = dict(snap)
+                        depth = (int(snap.get("seq", 0))
+                                 - int(snap.get("watermark", 0)))
+                        snap["watermark"] = r.seq
+                        snap["seq"] = r.seq + depth
+                        out["handoff"] = snap
+            self._safe_send(client, out)
+
+        def on_down(_dead):
+            self._safe_send(client, {
+                "id": rid, "ok": False, "error": "unavailable",
+                "reason": "rehoming", "retryable": True,
+                "message": "owner replica died mid-request; the "
+                           "subscription is being re-homed — retry"})
+
+        p = _Pending(
+            client, rid, {"op": op,
+                          "subscription": rsub.replica_sub_id},
+            op, time.monotonic() + self.default_deadline_s,
+            on_reply=on_reply, on_down=on_down)
+        try:
+            if not link.send(f"fl{next(self._tokens)}", p):
+                return  # sweep claimed it: on_down answers
+        except OSError:
+            on_down(rsub.replica_id)
+
+    def _sub_poll(self, rid, client, session: dict) -> None:
+        """Fan a poll out to every replica owning one of this
+        session's subscriptions; aggregate applied counts. Push frames
+        flushed by the poll arrive via the normal push path."""
+        with self._subs_lock:
+            links = {}
+            for sid in session["subs"]:
+                rsub = self._subs.get(sid)
+                if rsub is None or rsub.replica_id is None:
+                    continue
+                h = self.membership.get(rsub.replica_id)
+                if h is not None and h.link is not None \
+                        and h.link.alive:
+                    links[rsub.replica_id] = h.link
+        if not links:
+            self._safe_send(client, {"id": rid, "ok": True,
+                                     "applied": {}, "frames": 0})
+            return
+        agg_lock = threading.Lock()
+        agg = {"applied": {}, "frames": 0, "errors": 0,
+               "left": len(links)}
+
+        def settle() -> None:
+            self._safe_send(client, {
+                "id": rid, "ok": agg["errors"] == 0,
+                "applied": agg["applied"], "frames": agg["frames"],
+                **({"errors": agg["errors"]} if agg["errors"]
+                   else {})})
+
+        def on_reply(_link, got) -> None:
+            with agg_lock:
+                if got.get("ok"):
+                    for k, v in (got.get("applied") or {}).items():
+                        agg["applied"][k] = (
+                            agg["applied"].get(k, 0) + int(v))
+                    agg["frames"] += int(got.get("frames", 0))
+                else:
+                    agg["errors"] += 1
+                agg["left"] -= 1
+                done = agg["left"] == 0
+            if done:
+                settle()
+
+        def on_down(_dead) -> None:
+            with agg_lock:
+                agg["errors"] += 1
+                agg["left"] -= 1
+                done = agg["left"] == 0
+            if done:
+                settle()
+
+        for link in links.values():
+            p = _Pending(
+                client, rid, {"op": "poll"}, "poll",
+                time.monotonic() + self.default_deadline_s,
+                on_reply=on_reply, on_down=on_down)
+            try:
+                link.send(f"fl{next(self._tokens)}", p)
+            except OSError:
+                on_down(None)
+
+    def _on_push(self, link: ReplicaLink, frame: dict) -> None:
+        """A push frame off a replica's standing queries: route by
+        (replica, replica-sub-id), rewrite the id to the stable
+        router-side one and the seq to the client-visible counter.
+        Frames from a replaced owner miss the index and drop — the
+        survivor's resync supersedes them."""
+        sid = frame.get("subscription")
+        if not sid:
+            return
+        with self._subs_lock:
+            rsub = self._sub_index.get((link.handle.replica_id, sid))
+        if rsub is None or rsub.closed:
+            return
+        out = dict(frame)
+        out["subscription"] = rsub.sub_id
+        with rsub.lock:
+            rsub.seq += 1
+            out["seq"] = rsub.seq
+            if frame.get("event") == "state":
+                rsub.resyncs += 1
+        if frame.get("event") in _TERMINAL_EVENTS:
+            # the stream ends with this frame; ownership ends with it
+            # too — a quarantined/expired sub is NOT re-homed
+            self._drop_sub(rsub, notify_replica=False)
+        self._safe_send(rsub.client, out)
+
+    def _drop_sub(self, rsub: RouterSub,
+                  notify_replica: bool) -> None:
+        with self._subs_lock:
+            rsub.closed = True
+            self._subs.pop(rsub.sub_id, None)
+            if rsub.replica_id is not None:
+                self._sub_index.pop(
+                    (rsub.replica_id, rsub.replica_sub_id), None)
+        try:
+            rsub.session["subs"].discard(rsub.sub_id)
+        except (KeyError, AttributeError):
+            pass
+        self.membership.drop_sub(rsub.sub_id)
+        if notify_replica and rsub.replica_id is not None:
+            h = self.membership.get(rsub.replica_id)
+            if h is not None and h.link is not None and h.link.alive:
+                self._link_fire(h.link, {
+                    "op": "unsubscribe",
+                    "subscription": rsub.replica_sub_id})
+
+    def _drop_client_subs(self, session: dict) -> None:
+        for sid in list(session.get("subs") or ()):
+            with self._subs_lock:
+                rsub = self._subs.get(sid)
+            if rsub is not None:
+                self._drop_sub(rsub, notify_replica=True)
+
+    def _note_checkpoints(self, replica_id: str, cps: dict) -> None:
+        """Checkpoint intake off a stats probe: fold each reported
+        handoff snapshot into the ownership table (the failover
+        seed)."""
+        noted = 0
+        for rsid, snap in cps.items():
+            with self._subs_lock:
+                rsub = self._sub_index.get((replica_id, rsid))
+            if rsub is None:
+                continue
+            if self.membership.note_checkpoint(rsub.sub_id, snap):
+                noted += 1
+                rsub.paused = snap.get("status") == "paused"
+        if noted:
+            try:
+                from geomesa_tpu.telemetry.recorder import RECORDER
+
+                RECORDER.note_event("fleet.subs.checkpoint",
+                                    replica=replica_id, subs=noted)
+            # gt: waive GT14
+            # (deliberate degrade: the probe loop must not stall on a
+            # postmortem breadcrumb)
+            except Exception:
+                pass
+
+    def _rehome_owned(self, dead_rid: str) -> None:
+        """The failover tentpole: replay every standing query the dead
+        replica owned onto a survivor, seeded from its last checkpoint
+        (predicate) or re-seeded from the survivor's live snapshot
+        (density). Runs on the dead link's reader thread, after the
+        query-orphan redistribute."""
+        rows = self.membership.subs_owned_by(dead_rid)
+        if not rows:
+            return
+        try:
+            from geomesa_tpu.telemetry.recorder import RECORDER
+
+            RECORDER.note_event("fleet.subs.orphaned",
+                                replica=dead_rid, subs=len(rows))
+        # gt: waive GT14
+        # (deliberate degrade: telemetry must not block the replays)
+        except Exception:
+            pass
+        from geomesa_tpu.utils.metrics import metrics
+
+        for row in rows:
+            with self._subs_lock:
+                rsub = self._subs.get(row.sub_id)
+            if rsub is None or rsub.closed:
+                self.membership.drop_sub(row.sub_id)
+                continue
+            self._bump("rehome_attempted")
+            metrics.counter("fleet.rehome.attempted")
+            rsub.paused = row.paused
+            handoff = row.checkpoint if row.mode == "predicate" \
+                else None
+            ctx = {"rsub": rsub, "rid": None,
+                   "tried": {dead_rid}, "handoff": handoff,
+                   "done": None}
+            if not self._sub_dispatch(ctx):
+                self._sub_fail(ctx, None)
+
+    def _link_call(self, link: ReplicaLink, doc: dict,
+                   timeout_s: float = 10.0) -> Optional[dict]:
+        """One synchronous round trip over a multiplexed link (the
+        rolling-restart drain step). None on link death or timeout."""
+        ev = threading.Event()
+        box: Dict[str, dict] = {}
+
+        def on_reply(_link, got) -> None:
+            box["got"] = got
+            ev.set()
+
+        p = _Pending(None, None, doc, doc.get("op", "?"),
+                     time.monotonic() + timeout_s,
+                     on_reply=on_reply, on_down=lambda _d: ev.set())
+        try:
+            link.send(f"fl{next(self._tokens)}", p)
+        except OSError:
+            return None
+        ev.wait(timeout_s)
+        return box.get("got")
+
+    def _link_fire(self, link: ReplicaLink, doc: dict) -> None:
+        """Fire-and-forget over a link (cleanup unsubscribes): the
+        reply is absorbed, link death is ignored."""
+        p = _Pending(None, None, doc, doc.get("op", "?"),
+                     time.monotonic() + self.default_deadline_s,
+                     on_reply=lambda _l, _g: None,
+                     on_down=lambda _d: None)
+        try:
+            link.send(f"fl{next(self._tokens)}", p)
+        except OSError:
+            pass
+
+    def rehome_replica(self, replica_id: str,
+                       timeout_s: float = 30.0) -> dict:
+        """Rolling-restart subscription drain: move every standing
+        query off a still-LIVE replica before its queries drain. Uses
+        a FRESH `export_subscription` snapshot over the live link —
+        strictly fresher than the probe checkpoint — so the survivor's
+        resync covers only the in-flight sliver. Synchronous: returns
+        {"moved", "failed"} once every sub has settled."""
+        h = self.membership.get(replica_id)
+        link = h.link if h is not None else None
+        live = link is not None and link.alive
+        moved = failed = 0
+        from geomesa_tpu.utils.metrics import metrics
+
+        for row in self.membership.subs_owned_by(replica_id):
+            with self._subs_lock:
+                rsub = self._subs.get(row.sub_id)
+            if rsub is None or rsub.closed:
+                self.membership.drop_sub(row.sub_id)
+                continue
+            handoff = row.checkpoint if row.mode == "predicate" \
+                else None
+            old_rsid = rsub.replica_sub_id
+            if live and row.mode == "predicate":
+                got = self._link_call(link, {
+                    "op": "export_subscription",
+                    "subscription": old_rsid}, timeout_s=5.0)
+                if got and got.get("ok") \
+                        and isinstance(got.get("handoff"), dict):
+                    handoff = got["handoff"]
+            self._bump("rehome_attempted")
+            metrics.counter("fleet.rehome.attempted")
+            ev = threading.Event()
+            outcome: List[bool] = []
+
+            def done(ok: bool, _ev=ev, _out=outcome) -> None:
+                _out.append(ok)
+                _ev.set()
+
+            ctx = {"rsub": rsub, "rid": None,
+                   "tried": {replica_id}, "handoff": handoff,
+                   "done": done}
+            if self._sub_dispatch(ctx):
+                ev.wait(timeout_s)
+            else:
+                self._sub_fail(ctx, None)
+            if outcome and outcome[0]:
+                moved += 1
+                if live:
+                    # release the old registration so the drain is not
+                    # held open by a stream nobody routes anymore
+                    self._link_fire(link, {"op": "unsubscribe",
+                                           "subscription": old_rsid})
+            else:
+                failed += 1
+        return {"moved": moved, "failed": failed}
+
     # -- health probes -----------------------------------------------------
 
     def _health_loop(self) -> None:
         while not self._stop.wait(self.probe_interval_s):
+            # bounded-staleness observability: how far behind the live
+            # streams the failover seeds are, per replica
+            self.membership.export_checkpoint_staleness()
             for h in self.membership.all():
                 link = h.link
                 if link is None or not link.alive:
@@ -618,6 +1288,12 @@ class FleetRouter:
                 h.replica_id, ok=True,
                 burn_gated=burn_gates_fired(stats.get("slo") or {}),
                 tiers=(stats.get("approx") or {}).get("tiers"))
+            # handoff checkpoints piggyback on the stats probe (no new
+            # RPC): the replica reports only subs whose watermark or
+            # status moved since its last report
+            cps = stats.get("subs_checkpoint") or {}
+            if cps:
+                self._note_checkpoints(h.replica_id, cps)
 
         token = f"pr{next(self._tokens)}"
         p = _Pending(None, None, {"op": "stats"}, "stats",
@@ -660,10 +1336,14 @@ class FleetClient:
     def hello(self, role: str = "client") -> dict:
         return self.request({"op": "hello", "role": role})
 
-    def request(self, doc: dict, timeout_s: float = 60.0) -> dict:
+    def request(self, doc: dict, timeout_s: float = 60.0,
+                on_push=None) -> dict:
+        """One round trip; interleaved push frames (a standing query's
+        events racing the response) go to `on_push`."""
         doc = dict(doc)
         doc.setdefault("id", f"c{next(self._ids)}")
-        return self.conn.request(doc, timeout_s=timeout_s)
+        return self.conn.request(doc, timeout_s=timeout_s,
+                                 on_push=on_push)
 
     def close(self) -> None:
         self.conn.close()

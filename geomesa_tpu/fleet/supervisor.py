@@ -57,6 +57,9 @@ class FleetConfig:
     # rolling restart: how long to wait for the survivor pool's SLO
     # burn gates to clear before calling the roll off
     slo_gate_timeout_s: float = 30.0
+    # False reverts the router to the pre-upgrade shape: subscribe
+    # verbs refuse typed and the hello advertises no rehome capability
+    rehome: bool = True
     force_cpu_workers: bool = False      # process spawn: pin CPU (CI)
 
     def __post_init__(self):
@@ -81,7 +84,7 @@ class FleetSupervisor:
             self.membership, host=config.host,
             port=config.router_port,
             probe_interval_s=config.probe_interval_s,
-            supervisor=self)
+            supervisor=self, rehome=config.rehome)
         self._slots = 0
         self._lock = threading.Lock()
 
@@ -286,11 +289,19 @@ class FleetSupervisor:
                                  "budget; roll paused — retry when "
                                  "the budget recovers",
                         "blocked_on": h.replica_id}
+            # subscription drain BEFORE the query drain: standing
+            # queries move to survivors via fresh exported snapshots
+            # (strictly fresher than the probe checkpoints), so the
+            # restart costs each client at most one state resync
+            subs = {"moved": 0, "failed": 0}
+            if getattr(self.router, "rehome", False):
+                subs = self.router.rehome_replica(h.replica_id)
             self._stop_replica(h, graceful=True)
             fresh = self.respawn(h.replica_id)
             state = self._wait_replica_ready(fresh)
             rolled.append({"old": h.replica_id,
-                           "new": fresh.replica_id, "state": state})
+                           "new": fresh.replica_id, "state": state,
+                           "subs": subs})
             if state != "ready":
                 return {"ok": False, "rolled": rolled,
                         "error": f"fresh replica {fresh.replica_id} "

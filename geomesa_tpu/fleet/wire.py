@@ -179,11 +179,14 @@ class JsonLineConn:
                     return  # torn mid-frame: EOF for the caller
             yield doc
 
-    def request(self, doc: dict, timeout_s: float = 30.0) -> dict:
+    def request(self, doc: dict, timeout_s: float = 30.0,
+                on_push=None) -> dict:
         """One synchronous round trip (probe/CLI use — NOT the router's
-        multiplexed request path). Skips interleaved push frames; the
-        deadline is enforced by a timer-driven stop event, so a peer
-        that never answers cannot hold the caller past `timeout_s`."""
+        multiplexed request path). Interleaved push frames (docs with
+        no `id` — a standing query's events racing the response) go to
+        `on_push` when given, and are skipped otherwise; the deadline
+        is enforced by a timer-driven stop event, so a peer that never
+        answers cannot hold the caller past `timeout_s`."""
         self.send(doc)
         want = doc.get("id")
         stop = threading.Event()
@@ -193,6 +196,8 @@ class JsonLineConn:
             for got in self.docs(stop):
                 if want is None or got.get("id") == want:
                     return got
+                if on_push is not None and got.get("id") is None:
+                    on_push(got)
         finally:
             timer.cancel()
         raise TimeoutError(

@@ -936,6 +936,8 @@ def run_fleet_bench(
     kill: bool = True,
     kill_window_s: float = 2.0,
     seed: int = 0,
+    store_factory=None,
+    subscribe_rider: bool = False,
 ) -> dict:
     """`gmtpu bench-serve --fleet N`: closed-loop clients over the
     ROUTER's wire (real sockets, real failover), one replica killed
@@ -945,7 +947,14 @@ def run_fleet_bench(
     every request answered (zero dropped), zero un-typed errors.
     Thread-spawn replicas: same code path as deployment minus process
     spin-up, so the comparison measures routing + failover, not jax
-    import time."""
+    import time.
+
+    `subscribe_rider` adds one standing query THROUGH the router for
+    the bench's lifetime (needs a live-layer `store_factory` — the
+    replicas must share a pollable store): the report gains a `rider`
+    block with the frames seen, resyncs paid, and whether the stream
+    survived the kill via the router's re-home — continuity measured
+    under the same query storm the latency numbers come from."""
     import time as _time
 
     from geomesa_tpu.fleet import FleetConfig, FleetSupervisor
@@ -953,6 +962,7 @@ def run_fleet_bench(
 
     sup = FleetSupervisor(FleetConfig(
         n_replicas=n_replicas, catalog=catalog,
+        store_factory=store_factory,
         probe_interval_s=0.25))
     lock = threading.Lock()
     lat: List[tuple] = []      # (t_done, latency_s, ok)
@@ -980,6 +990,36 @@ def run_fleet_bench(
             finally:
                 wconn.close()
         stop = threading.Event()
+
+        rider_frames: List[dict] = []
+        rider_sub = [None]
+        rider_cli = None
+        if subscribe_rider:
+            from geomesa_tpu.fleet.router import FleetClient
+
+            rider_cli = FleetClient("127.0.0.1", port, timeout_s=30.0)
+            got = rider_cli.request(
+                {"op": "subscribe", "typeName": type_name,
+                 "cql": "BBOX(geom, -60, -30, 60, 30)"},
+                on_push=rider_frames.append)
+            if got.get("ok"):
+                rider_sub[0] = got["subscription"]
+
+        def rider_loop():
+            # the standing query rides the storm: periodic polls keep
+            # the owner folding while the kill + re-home happen
+            while not stop.wait(0.2):
+                try:
+                    rider_cli.request({"op": "poll"},
+                                      on_push=rider_frames.append)
+                except (OSError, TimeoutError):
+                    return
+
+        rider_thread = None
+        if rider_sub[0] is not None:
+            rider_thread = threading.Thread(target=rider_loop,
+                                            daemon=True)
+            rider_thread.start()
 
         def client(cid: int):
             rng = np.random.default_rng(seed * 9973 + cid)
@@ -1037,6 +1077,13 @@ def run_fleet_bench(
         stop.set()
         for t in threads:
             t.join(timeout=90.0)
+        if rider_thread is not None:
+            rider_thread.join(timeout=30.0)
+        if rider_cli is not None:
+            try:
+                rider_cli.close()
+            except OSError:
+                pass
         wall = _time.monotonic() - t_start
         router = sup.stats()["router"]
     finally:
@@ -1067,6 +1114,20 @@ def run_fleet_bench(
             np.float64) * 1e3
         doc["p99_during_kill_ms"] = q(in_window, 99)
         doc["served_during_kill"] = int(len(in_window))
+    if subscribe_rider:
+        evs = [f for f in rider_frames
+               if f.get("subscription") == rider_sub[0]]
+        seqs = [f.get("seq") for f in evs]
+        doc["rider"] = {
+            "subscribed": rider_sub[0] is not None,
+            "frames": len(evs),
+            # resyncs past the initial state frame = failovers paid
+            "resyncs": sum(1 for f in evs[1:]
+                           if f.get("event") == "state"),
+            "seq_monotonic": seqs == sorted(seqs)
+            and len(set(seqs)) == len(seqs),
+            "rehomed": router.get("rehome_succeeded", 0),
+        }
     return doc
 
 

@@ -297,7 +297,7 @@ def _error_response(rid, exc) -> dict:
 
 
 SUBSCRIBE_OPS = ("subscribe", "unsubscribe", "poll", "subscriptions",
-                 "export_subscription")
+                 "export_subscription", "pause", "resume")
 
 
 def _parse_density(doc: dict):
@@ -433,9 +433,11 @@ class _SubscribeSession:
                 outbox_limit=doc.get("outboxLimit"),
                 initial_state=bool(doc.get("initialState", True)),
                 handoff=doc.get("handoff"),
+                paused=bool(doc.get("paused", False)),
                 ack=lambda s: self.respond(
                     {"id": rid, "ok": True,
-                     "subscription": s.sub_id, "mode": s.mode}))
+                     "subscription": s.sub_id, "mode": s.mode,
+                     "status": s.status}))
             mgr.flush(self.push)  # deliver the initial state frame
         elif op == "unsubscribe":
             try:
@@ -448,6 +450,26 @@ class _SubscribeSession:
                               "message": "no such subscription"})
                 return
             mgr.flush(self.push)  # parting frames
+            self.respond({"id": rid, "ok": True,
+                          "subscription": sub.sub_id,
+                          "status": sub.status})
+        elif op in ("pause", "resume"):
+            # lifecycle verbs for the fleet's re-home path (a paused
+            # subscription must land paused on the survivor) and for
+            # clients throttling their own streams
+            try:
+                sub = (mgr.pause if op == "pause"
+                       else mgr.resume)(doc["subscription"])
+            except KeyError:
+                self.respond({"id": rid, "ok": False, "error": "error",
+                              "message": "no such subscription"})
+                return
+            except ValueError as e:  # resume from non-paused, etc.
+                self.respond({"id": rid, "ok": False, "error": "error",
+                              "message": str(e)})
+                return
+            if op == "resume":
+                mgr.flush(self.push)  # the resume's state resync frame
             self.respond({"id": rid, "ok": True,
                           "subscription": sub.sub_id,
                           "status": sub.status})
@@ -824,6 +846,12 @@ def serve_connection(
                         is_admin = True
                     out = {"id": rid, "ok": True, "role": role,
                            "admin": is_admin,
+                           # capability flag: this server understands
+                           # subscribe(handoff=) re-homing — a fleet
+                           # router checks it before replaying a
+                           # standing query here (back-compat: its
+                           # absence means pre-upgrade)
+                           "rehome": True,
                            "wire": colwire.wire_capabilities()}
                     if doc.get("wire") == colwire.WIRE_COLUMNAR:
                         if wire.can_columnar():
@@ -888,6 +916,15 @@ def serve_connection(
                     stats = svc.stats()
                     if control is not None:
                         stats["replica"] = control.describe()
+                    if subs.manager is not None:
+                        # handoff-checkpoint piggyback (no new RPC):
+                        # THIS connection's standing queries, scoped so
+                        # a fleet router's stats probe checkpoints
+                        # exactly the subscriptions it homed over this
+                        # link; the seq-watermark cadence keeps an
+                        # unchanged subscription at zero bytes
+                        stats["subs_checkpoint"] = (
+                            subs.manager.checkpoints())
                     respond({"id": rid, "ok": True, "stats": stats})
                     continue
                 req = parse_request(doc, payload)

@@ -67,6 +67,10 @@ class SubscriptionManager:
         # two drains of the same outbox can interleave their writes
         # and deliver a subscription's frames out of seq order
         self._flush_lock = threading.Lock()
+        # checkpoints(): last (watermark, status) handed out per
+        # subscription — the seq-watermark cadence that keeps the
+        # stats-probe piggyback from re-shipping unchanged snapshots
+        self._checkpoint_marks: Dict[str, tuple] = {}
 
     # -- admission ---------------------------------------------------------
 
@@ -81,6 +85,7 @@ class SubscriptionManager:
         outbox_limit: Optional[int] = None,
         initial_state: bool = True,
         handoff: Optional[dict] = None,
+        paused: bool = False,
         ack: Optional[Callable[[Subscription], None]] = None,
     ) -> Subscription:
         """Register a standing query. Raises the serving layer's typed
@@ -97,6 +102,12 @@ class SubscriptionManager:
         a full `state` resync built from THIS replica's live snapshot,
         so the client reconciles instead of starting over. Predicate
         subscriptions only (density grids re-seed anyway).
+
+        `paused=True` registers then immediately pauses, still inside
+        the flush-excluded unit: the queued state frame stays in the
+        outbox until resume (the fleet router re-homes a paused
+        subscription with this — it lands paused, and the resume
+        resync replaces the stale frame with current state).
 
         `ack` (the wire layer's subscribe response) runs under the
         flush lock, BEFORE any flusher — in particular the
@@ -176,6 +187,8 @@ class SubscriptionManager:
             self.evaluator.admit(sub)
             if initial_state or handoff is not None:
                 sub.queue_state_frame()
+            if paused:
+                self.registry.pause(sub.sub_id)
             if ack is not None:
                 ack(sub)
         return sub
@@ -193,6 +206,44 @@ class SubscriptionManager:
         # than the pre-pause matched set / grid
         self.evaluator.resync(sub)
         return sub
+
+    def checkpoints(self) -> Dict[str, dict]:
+        """Handoff snapshots for every live PREDICATE subscription
+        whose delivered watermark advanced since the last call — the
+        seq-watermark cadence the fleet piggybacks on the stats probe
+        (docs/ROBUSTNESS.md "Standing queries"): no new RPC, bounded
+        staleness of one probe interval once the stream quiesces, and
+        an unchanged subscription ships zero bytes. Density grids are
+        skipped — they re-seed from the survivor's live snapshot on
+        re-home, so there is nothing to checkpoint. Called on the wire
+        connection's reader thread (the stats verb), same thread as
+        subscribe/unsubscribe — the marks dict needs no lock."""
+        out: Dict[str, dict] = {}
+        live = {}
+        for sub in self.registry.subs():
+            if (sub.density is not None
+                    or sub.status not in ("active", "paused")):
+                continue
+            snap = sub.handoff_snapshot()
+            live[sub.sub_id] = True
+            mark = self._checkpoint_marks.get(sub.sub_id)
+            if mark == (snap["watermark"], snap["status"]):
+                continue
+            # gt: waive GT07
+            # (reader-confined: the stats verb that calls this runs on
+            # the connection's ONE reader thread — the same thread that
+            # handles subscribe/unsubscribe — so the marks dict never
+            # crosses threads; _flush_lock guards outbox drains only,
+            # taking it here would stall the probe behind a flush)
+            self._checkpoint_marks[sub.sub_id] = (
+                snap["watermark"], snap["status"])
+            out[sub.sub_id] = snap
+        # prune marks of cancelled/expired subscriptions so a
+        # long-lived connection's table does not grow forever
+        for sid in list(self._checkpoint_marks):
+            if sid not in live:
+                del self._checkpoint_marks[sid]
+        return out
 
     # -- driving -----------------------------------------------------------
 

@@ -335,6 +335,9 @@ class Subscription:
                 # last DELIVERED seq: frames still queued were never
                 # pushed, so the acceptor's state frame re-covers them
                 "watermark": self._seq - len(self._outbox),
+                # a re-homed paused subscription must LAND paused (the
+                # fleet router reads this; the acceptor ignores it)
+                "status": self.status,
             }
 
     def requeue(self, frames: List[dict]) -> None:

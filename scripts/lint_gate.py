@@ -39,8 +39,8 @@ tests/test_lint_gate.py and is runnable standalone:
     python scripts/lint_gate.py [--format json|sarif]
         [--no-spmd-smoke] [--no-dataflow-smoke] [--no-warmup-smoke]
         [--no-chaos-smoke] [--no-telemetry-smoke] [--no-sentinel-smoke]
-        [--no-fleet-smoke] [--no-approx-smoke] [--no-wire-smoke]
-        [--no-ring-smoke] [--no-lane-smoke]
+        [--no-fleet-smoke] [--no-rehome-smoke] [--no-approx-smoke]
+        [--no-wire-smoke] [--no-ring-smoke] [--no-lane-smoke]
 
 Rule catalog + waiver syntax: docs/ANALYSIS.md.
 """
@@ -437,6 +437,137 @@ def fleet_smoke() -> int:
             sup.close()
     for f in failures:
         print(f"fleet smoke: FAIL {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+def rehome_smoke() -> int:
+    """Fleet-native standing queries (docs/ROBUSTNESS.md "Standing
+    queries"): a geofence subscription placed THROUGH the router over
+    a shared Kafka live layer must survive an abrupt owner-replica
+    kill with zero client choreography — the router re-homes it onto
+    the survivor, the client's seq stays strictly monotonic, the frame
+    stream replays to the exact matched set with at most ONE state
+    resync, and the rehome counters account for the move. Stderr-only
+    like the other smokes."""
+    _pin_cpu()
+    import time
+
+    import numpy as np
+
+    from geomesa_tpu.core.columnar import FeatureBatch
+    from geomesa_tpu.core.sft import SimpleFeatureType
+    from geomesa_tpu.fleet import FleetConfig, FleetSupervisor
+    from geomesa_tpu.fleet.router import FleetClient
+    from geomesa_tpu.kafka.store import KafkaDataStore
+
+    failures = []
+    rng = np.random.default_rng(29)
+    n = 24
+    sft = SimpleFeatureType.from_spec(
+        "rehomesmoke", "name:String,score:Double,dtg:Date,*geom:Point")
+    fence = (-20.0, -15.0, 25.0, 20.0)
+    cql = f"BBOX(geom, {fence[0]}, {fence[1]}, {fence[2]}, {fence[3]})"
+    fids = [f"v{i}" for i in range(n)]
+
+    def batch():
+        return FeatureBatch.from_pydict(sft, {
+            "name": rng.choice(["a", "b"], n).tolist(),
+            "score": rng.uniform(-5, 5, n),
+            "dtg": rng.integers(
+                1_590_000_000_000, 1_600_000_000_000, n),
+            "geom": np.stack([rng.uniform(-60, 60, n),
+                              rng.uniform(-30, 30, n)], 1),
+        }, fids=list(fids))
+
+    def inside(b):
+        g = b.columns[sft.default_geometry.name]
+        x, y = np.asarray(g.x), np.asarray(g.y)
+        keep = ((x >= fence[0]) & (x <= fence[2])
+                & (y >= fence[1]) & (y <= fence[3]))
+        return {f for f, k in zip(b.fids.decode(), keep) if k}
+
+    store = KafkaDataStore()
+    src = store.create_schema(sft)
+    sup = FleetSupervisor(FleetConfig(
+        n_replicas=2, store_factory=lambda: store,
+        probe_interval_s=0.1))
+    frames = []
+    oracle = None
+    try:
+        port = sup.start()
+        cli = FleetClient("127.0.0.1", port, timeout_s=30.0)
+        got = cli.request({"op": "subscribe",
+                           "typeName": "rehomesmoke", "cql": cql},
+                          on_push=frames.append)
+        if not got.get("ok"):
+            failures.append(f"routed subscribe refused: {got}")
+            raise SystemExit
+        sid, owner = got["subscription"], got["replica"]
+        for k in range(3):
+            b = batch()
+            oracle = inside(b)
+            src.write(b)
+            if k == 1:
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    row = sup.membership.sub_owner(sid)
+                    if row is not None and row.checkpoint is not None:
+                        break
+                    time.sleep(0.02)
+                sup.kill_replica(owner, graceful=False)
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    row = sup.membership.sub_owner(sid)
+                    if row is not None and row.replica_id != owner:
+                        break
+                    time.sleep(0.02)
+                row = sup.membership.sub_owner(sid)
+                if row is None or row.replica_id == owner:
+                    failures.append("subscription not re-homed after "
+                                    "the owner kill")
+                    raise SystemExit
+            got = cli.request({"op": "poll"}, on_push=frames.append)
+            if not got.get("ok"):
+                failures.append(f"poll {k} failed: {got}")
+        cli.close()
+        evs = [f for f in frames if f.get("subscription") == sid]
+        seqs = [f.get("seq") for f in evs]
+        if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+            failures.append(f"client seq not monotonic: {seqs}")
+        resyncs = sum(1 for f in evs[1:] if f.get("event") == "state")
+        if resyncs != 1:
+            failures.append(f"expected exactly one resync, saw "
+                            f"{resyncs}")
+        state = set()
+        for f in evs:
+            ev = f.get("event")
+            if ev == "state":
+                state = set(f["fids"])
+            elif ev == "enter":
+                if set(f["fids"]) & state:
+                    failures.append("duplicate enter transition")
+                state |= set(f["fids"])
+            elif ev == "exit":
+                if set(f["fids"]) - state:
+                    failures.append("phantom exit transition")
+                state -= set(f["fids"])
+        if oracle is not None and state != oracle:
+            failures.append(
+                f"replayed matched set diverged from oracle "
+                f"(missed={sorted(oracle - state)}, "
+                f"extra={sorted(state - oracle)})")
+        st = sup.stats()["router"]
+        if st["rehome_succeeded"] != 1:
+            failures.append(
+                f"rehome counters wrong: {st}")
+        print(f"rehome smoke: {len(evs)} frames, 1 resync, "
+              f"rehomed={st['rehome_succeeded']}", file=sys.stderr)
+    except SystemExit:
+        pass
+    finally:
+        sup.close()
+    for f in failures:
+        print(f"rehome smoke: FAIL {f}", file=sys.stderr)
     return 1 if failures else 0
 
 
@@ -1038,6 +1169,12 @@ def main(argv=None) -> int:
                         "fleet on CPU, one scripted kill, zero "
                         "un-typed errors + consistent router gauges; "
                         "text mode only)")
+    p.add_argument("--no-rehome-smoke", action="store_true",
+                   help="skip the subscription re-home smoke (a "
+                        "routed geofence standing query across an "
+                        "abrupt owner kill: zero missed/dup/phantom "
+                        "transitions, one state resync, seq monotonic;"
+                        " text mode only)")
     p.add_argument("--no-approx-smoke", action="store_true",
                    help="skip the approximate-answer smoke (sketch-"
                         "served tolerant counts with bounds verified "
@@ -1088,6 +1225,8 @@ def main(argv=None) -> int:
         rc = sentinel_smoke()
     if args.format == "text" and not args.no_fleet_smoke and rc == 0:
         rc = fleet_smoke()
+    if args.format == "text" and not args.no_rehome_smoke and rc == 0:
+        rc = rehome_smoke()
     if args.format == "text" and not args.no_approx_smoke and rc == 0:
         rc = approx_smoke()
     if args.format == "text" and not args.no_wire_smoke and rc == 0:

@@ -154,15 +154,54 @@ class TestRouting:
         finally:
             sup.close()
 
-    def test_subscribe_ops_refused_typed(self, catalog):
+    def test_wire_handoff_ops_refused_typed(self, catalog):
+        """attach/detach carry a client-materialized wire handoff the
+        router cannot audit for exactly-once replay: refused typed on
+        EVERY router, rehome or not."""
         sup = _fleet(catalog, n=1)
         try:
             port = sup.start()
             cli = connect_json("127.0.0.1", port)
-            got = cli.request({"id": "s1", "op": "subscribe",
-                               "typeName": "fleeted", "cql": CQL})
-            assert not got["ok"] and got["error"] == "rejected"
-            assert got["reason"] == "unsupported"
+            for op in ("attach", "detach"):
+                got = cli.request({"id": f"s-{op}", "op": op,
+                                   "subscription": "sub-1"})
+                assert not got["ok"] and got["error"] == "rejected"
+                assert got["reason"] == "unsupported"
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_rehome_disabled_back_compat(self, catalog):
+        """rehome=False restores the pre-upgrade surface exactly: the
+        hello advertises NO rehome capability and every subscribe verb
+        refuses typed `unsupported` — an old client scripted against
+        the refusal keeps working."""
+        sup = _fleet(catalog, n=1, rehome=False)
+        try:
+            port = sup.start()
+            cli = connect_json("127.0.0.1", port)
+            hello = cli.request({"id": "h", "op": "hello"})
+            assert hello["ok"] and "rehome" not in hello
+            for op in ("subscribe", "unsubscribe", "poll",
+                       "subscriptions", "export_subscription",
+                       "pause", "resume"):
+                got = cli.request({"id": f"s-{op}", "op": op,
+                                   "typeName": "fleeted", "cql": CQL,
+                                   "subscription": "sub-1"})
+                assert not got["ok"] and got["error"] == "rejected", got
+                assert got["reason"] == "unsupported"
+                assert "replica-sticky" in got["message"]
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_rehome_capability_advertised(self, catalog):
+        sup = _fleet(catalog, n=1)
+        try:
+            port = sup.start()
+            cli = connect_json("127.0.0.1", port)
+            hello = cli.request({"id": "h", "op": "hello"})
+            assert hello["ok"] and hello["rehome"] is True
             cli.close()
         finally:
             sup.close()
@@ -528,6 +567,550 @@ class TestProcessSpawn:
             states = {r["replica"]: r["state"]
                       for r in sup.stats()["replicas"]}
             assert states["r0"] == "dead" and states["r1"] == "ready"
+            cli.close()
+        finally:
+            sup.close()
+
+
+# -- fleet-native standing queries (router-side re-homing) -----------------
+
+SUB_SFT = SimpleFeatureType.from_spec(
+    "live", "name:String,score:Double,dtg:Date,*geom:Point")
+SUB_CQL = "BBOX(geom, -20, -15, 25, 20)"
+SUB_FIDS = [f"v{i}" for i in range(24)]
+
+
+def _sub_rows(seed, fids=SUB_FIDS):
+    rng = np.random.default_rng(seed)
+    n = len(fids)
+    return FeatureBatch.from_pydict(SUB_SFT, {
+        "name": rng.choice(["a", "b", "c"], n).tolist(),
+        "score": rng.uniform(-5, 5, n),
+        "dtg": rng.integers(1_590_000_000_000, 1_600_000_000_000, n),
+        "geom": np.stack([rng.uniform(-60, 60, n),
+                          rng.uniform(-30, 30, n)], 1),
+    }, fids=list(fids))
+
+
+def _kafka_fleet(n=2, **kw):
+    """A fleet whose replicas share ONE Kafka live layer (fold hooks
+    are a store-level list, so every replica's evaluator sees every
+    event — the deployment shape for standing queries)."""
+    from geomesa_tpu.kafka.store import KafkaDataStore
+
+    store = KafkaDataStore()
+    src = store.create_schema(SUB_SFT)
+    sup = FleetSupervisor(FleetConfig(
+        n_replicas=n, store_factory=lambda: store,
+        probe_interval_s=0.1, **kw))
+    return store, src, sup
+
+
+def _replay(frames, sid):
+    """Host-oracle replay of a client's frame stream: asserts zero
+    duplicate-enter / phantom-exit transitions, returns the final
+    matched set. State frames (initial or resync) reset by contract."""
+    state = set()
+    for f in sorted((f for f in frames
+                     if f.get("subscription") == sid
+                     and f.get("event") in ("enter", "exit", "state")),
+                    key=lambda f: f["seq"]):
+        if f["event"] == "state":
+            state = set(f["fids"])
+        elif f["event"] == "enter":
+            dup = set(f["fids"]) & state
+            assert not dup, f"duplicate enter for {sorted(dup)}"
+            state |= set(f["fids"])
+        else:
+            ghost = set(f["fids"]) - state
+            assert not ghost, f"phantom exit for {sorted(ghost)}"
+            state -= set(f["fids"])
+    return state
+
+
+def _assert_seq_monotonic(frames, sid):
+    seqs = [f["seq"] for f in frames if f.get("subscription") == sid]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs), seqs
+
+
+def _wait_rehomed(sup, sid, old_owner, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        row = sup.membership.sub_owner(sid)
+        if row is not None and row.replica_id != old_owner:
+            return row
+        time.sleep(0.02)
+    raise AssertionError(
+        f"subscription {sid} never re-homed off {old_owner}")
+
+
+def _wait_checkpoint(sup, sid, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        row = sup.membership.sub_owner(sid)
+        if row is not None and row.checkpoint is not None:
+            return row
+        time.sleep(0.02)
+    raise AssertionError(f"no checkpoint piggybacked for {sid}")
+
+
+class TestRehome:
+    """Fleet-native standing queries: the router homes, checkpoints,
+    and re-homes subscriptions across replica failover — the client
+    reads one connection and sees at most one resync per kill."""
+
+    def test_routed_parity_with_direct_subscription(self):
+        """The matched sets a routed subscription replays to are
+        bit-identical to a direct single-replica subscription fed the
+        same stream — routing adds zero semantic drift."""
+        from geomesa_tpu.kafka.store import KafkaDataStore
+        from geomesa_tpu.subscribe import SubscriptionManager
+
+        # direct reference: one manager over its own store
+        ref_store = KafkaDataStore()
+        ref_store.create_schema(SUB_SFT)
+        mgr = SubscriptionManager(ref_store)
+        ref_sub = mgr.subscribe("live", SUB_CQL)
+        ref_frames = []
+        mgr.flush(ref_frames.append)
+
+        store, src, sup = _kafka_fleet()
+        frames = []
+        try:
+            from geomesa_tpu.fleet.router import FleetClient
+
+            port = sup.start()
+            cli = FleetClient("127.0.0.1", port, timeout_s=30.0)
+            got = cli.request({"op": "subscribe", "typeName": "live",
+                               "cql": SUB_CQL},
+                              on_push=frames.append)
+            assert got["ok"], got
+            sid = got["subscription"]
+            for k in range(3):
+                b = _sub_rows(100 + k)
+                src.write(b)
+                got = cli.request({"op": "poll"},
+                                  on_push=frames.append)
+                assert got["ok"], got
+                ref_store.write("live", _sub_rows(100 + k))
+                ref_store.poll("live")
+                mgr.flush(lambda f: ref_frames.append(f))
+                # bit-identical matched set after EVERY batch
+                assert _replay(frames, sid) == \
+                    _replay(ref_frames, ref_sub.sub_id)
+            cli.close()
+        finally:
+            sup.close()
+            mgr.close()
+
+    def test_kill_rehomes_single_resync(self):
+        """The tentpole certification: abrupt owner death mid-stream →
+        the router replays the subscription onto the survivor from the
+        piggybacked checkpoint; the client sees exactly ONE resync,
+        monotonic seq, and a replay that matches the live oracle —
+        with zero client choreography."""
+        store, src, sup = _kafka_fleet()
+        frames = []
+        try:
+            from geomesa_tpu.fleet.router import FleetClient
+
+            port = sup.start()
+            cli = FleetClient("127.0.0.1", port, timeout_s=30.0)
+            got = cli.request({"op": "subscribe", "typeName": "live",
+                               "cql": SUB_CQL},
+                              on_push=frames.append)
+            assert got["ok"], got
+            sid, owner = got["subscription"], got["replica"]
+            assert sid.startswith("rs")   # the replica id never leaks
+            src.write(_sub_rows(1))
+            assert cli.request({"op": "poll"},
+                               on_push=frames.append)["ok"]
+            _wait_checkpoint(sup, sid)
+            sup.kill_replica(owner, graceful=False)
+            row = _wait_rehomed(sup, sid, owner)
+            assert row.rehomes == 1
+            src.write(_sub_rows(2))
+            assert cli.request({"op": "poll"},
+                               on_push=frames.append)["ok"]
+            evs = [f for f in frames if f.get("subscription") == sid]
+            _assert_seq_monotonic(evs, sid)
+            resyncs = sum(1 for f in evs[1:]
+                          if f.get("event") == "state")
+            assert resyncs == 1, evs
+            # replayed matched set == live snapshot oracle
+            matched = _replay(evs, sid)
+            h = sup.membership.get(row.replica_id)
+            live = h.server.svc.subscriptions.registry.maybe(
+                row.replica_sub_id)
+            assert matched == live.matched
+            # ownership + telemetry surfaces agree
+            snap = sup.stats()
+            assert snap["subscriptions"] == 1
+            assert snap["sub_rehomes"] == 1
+            assert snap["router"]["rehome_attempted"] == 1
+            assert snap["router"]["rehome_succeeded"] == 1
+            assert snap["router"]["rehome_failed"] == 0
+            owned = {r["replica"]: r["subs_owned"]
+                     for r in snap["replicas"]}
+            assert owned[row.replica_id] == 1
+            assert owned[owner] == 0
+            assert isinstance(
+                sup.membership.export_checkpoint_staleness(), dict)
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_double_failover_seq_continuity(self):
+        """Kill the owner, then kill the NEW owner: the sequence the
+        client sees stays strictly monotonic across both moves — one
+        resync per kill, never more."""
+        store, src, sup = _kafka_fleet(n=3)
+        frames = []
+        try:
+            from geomesa_tpu.fleet.router import FleetClient
+
+            port = sup.start()
+            cli = FleetClient("127.0.0.1", port, timeout_s=30.0)
+            got = cli.request({"op": "subscribe", "typeName": "live",
+                               "cql": SUB_CQL},
+                              on_push=frames.append)
+            assert got["ok"], got
+            sid, owner = got["subscription"], got["replica"]
+            for kill_round in (1, 2):
+                src.write(_sub_rows(10 + kill_round))
+                assert cli.request({"op": "poll"},
+                                   on_push=frames.append)["ok"]
+                _wait_checkpoint(sup, sid)
+                sup.kill_replica(owner, graceful=False)
+                row = _wait_rehomed(sup, sid, owner)
+                assert row.rehomes == kill_round
+                owner = row.replica_id
+            src.write(_sub_rows(13))
+            assert cli.request({"op": "poll"},
+                               on_push=frames.append)["ok"]
+            evs = [f for f in frames if f.get("subscription") == sid]
+            _assert_seq_monotonic(evs, sid)
+            resyncs = sum(1 for f in evs[1:]
+                          if f.get("event") == "state")
+            assert resyncs == 2, evs   # exactly one per kill
+            matched = _replay(evs, sid)
+            h = sup.membership.get(owner)
+            row = sup.membership.sub_owner(sid)
+            live = h.server.svc.subscriptions.registry.maybe(
+                row.replica_sub_id)
+            assert matched == live.matched
+            assert sup.stats()["router"]["rehome_succeeded"] == 2
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_lagged_overflow_then_kill_single_resync_each(self):
+        """An outbox overflow (typed `subscription_lagged` + its state
+        resync) racing a re-home stays coherent: the client sees the
+        lagged resync, then ONE re-home resync — replay is exact, seq
+        monotonic, nothing double-resynced."""
+        store, src, sup = _kafka_fleet()
+        frames = []
+        try:
+            from geomesa_tpu.fleet.router import FleetClient
+
+            port = sup.start()
+            cli = FleetClient("127.0.0.1", port, timeout_s=30.0)
+            got = cli.request({"op": "subscribe", "typeName": "live",
+                               "cql": SUB_CQL, "outboxLimit": 2},
+                              on_push=frames.append)
+            assert got["ok"], got
+            sid, owner = got["subscription"], got["replica"]
+            # fold server-side WITHOUT flushing (direct store.poll
+            # skips the replica's drain): three folds queue more than
+            # the 2-slot outbox holds -> overflow -> lagged marker
+            for k in range(3):
+                src.write(_sub_rows(30 + k))
+                store.poll("live")
+            assert cli.request({"op": "poll"},
+                               on_push=frames.append)["ok"]
+            assert any(f.get("event") == "subscription_lagged"
+                       for f in frames
+                       if f.get("subscription") == sid), frames
+            _wait_checkpoint(sup, sid)
+            sup.kill_replica(owner, graceful=False)
+            _wait_rehomed(sup, sid, owner)
+            src.write(_sub_rows(35))
+            assert cli.request({"op": "poll"},
+                               on_push=frames.append)["ok"]
+            evs = [f for f in frames if f.get("subscription") == sid]
+            _assert_seq_monotonic(evs, sid)
+            # exactly two resyncs past the initial state: the lagged
+            # recovery and the re-home — the race never stacks extras
+            resyncs = sum(1 for f in evs[1:]
+                          if f.get("event") == "state")
+            assert resyncs == 2, evs
+            row = sup.membership.sub_owner(sid)
+            live = sup.membership.get(
+                row.replica_id).server.svc.subscriptions.registry \
+                .maybe(row.replica_sub_id)
+            assert _replay(evs, sid) == live.matched
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_paused_sub_rehomes_paused_resyncs_on_resume(self):
+        """Pause rides the checkpoint: a paused subscription re-homes
+        PAUSED (no frames while the client is away) and pays its one
+        state resync when resumed."""
+        store, src, sup = _kafka_fleet()
+        frames = []
+        try:
+            from geomesa_tpu.fleet.router import FleetClient
+
+            port = sup.start()
+            cli = FleetClient("127.0.0.1", port, timeout_s=30.0)
+            got = cli.request({"op": "subscribe", "typeName": "live",
+                               "cql": SUB_CQL},
+                              on_push=frames.append)
+            assert got["ok"], got
+            sid, owner = got["subscription"], got["replica"]
+            src.write(_sub_rows(40))
+            assert cli.request({"op": "poll"},
+                               on_push=frames.append)["ok"]
+            got = cli.request({"op": "pause", "subscription": sid},
+                              on_push=frames.append)
+            assert got["ok"] and got["status"] == "paused", got
+            assert got["subscription"] == sid
+            # wait for a checkpoint carrying the paused status
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                row = sup.membership.sub_owner(sid)
+                if row is not None and row.paused \
+                        and row.checkpoint is not None:
+                    break
+                time.sleep(0.02)
+            row = sup.membership.sub_owner(sid)
+            assert row.paused and row.checkpoint is not None
+            n_before = len([f for f in frames
+                            if f.get("subscription") == sid])
+            sup.kill_replica(owner, graceful=False)
+            row = _wait_rehomed(sup, sid, owner)
+            # landed paused on the survivor: no frames delivered
+            live = sup.membership.get(
+                row.replica_id).server.svc.subscriptions.registry \
+                .maybe(row.replica_sub_id)
+            assert live.status == "paused"
+            src.write(_sub_rows(41))
+            assert cli.request({"op": "poll"},
+                               on_push=frames.append)["ok"]
+            evs = [f for f in frames if f.get("subscription") == sid]
+            assert len(evs) == n_before, "paused sub leaked frames"
+            got = cli.request({"op": "resume", "subscription": sid},
+                              on_push=frames.append)
+            assert got["ok"] and got["status"] == "active", got
+            assert cli.request({"op": "poll"},
+                               on_push=frames.append)["ok"]
+            evs = [f for f in frames if f.get("subscription") == sid]
+            _assert_seq_monotonic(evs, sid)
+            # the resume's resync covers everything folded while away
+            assert evs[-1]["event"] in ("state", "enter", "exit")
+            live = sup.membership.get(
+                row.replica_id).server.svc.subscriptions.registry \
+                .maybe(row.replica_sub_id)
+            assert _replay(evs, sid) == live.matched
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_quarantined_sub_not_rehomed(self):
+        """A quarantined subscription's stream ends with its terminal
+        frame: ownership is dropped at the frame, so the death sweep
+        has nothing to replay — a poisoned predicate cannot chase the
+        fleet through failovers."""
+        from geomesa_tpu.serve.service import ServeConfig
+
+        store, src, sup = _kafka_fleet(
+            serve_config=ServeConfig(quarantine_after=2))
+        frames = []
+
+        class _Poison:
+            filter_ast = None
+            _band_fn = None
+
+            def params(self, batch):
+                return {}
+
+            def mask_fn(self):
+                def bad(params, dev):
+                    raise RuntimeError("poisoned predicate")
+                return bad
+
+            def mask_refined(self, dev, batch):
+                raise RuntimeError("poisoned predicate")
+
+        try:
+            from geomesa_tpu.fleet.router import FleetClient
+
+            port = sup.start()
+            cli = FleetClient("127.0.0.1", port, timeout_s=30.0)
+            got = cli.request({"op": "subscribe", "typeName": "live",
+                               "cql": "score > 1.5"},
+                              on_push=frames.append)
+            assert got["ok"], got
+            sid, owner = got["subscription"], got["replica"]
+            mgr = sup.membership.get(owner).server.svc.subscriptions
+            mgr.evaluator._filters[("live", "score > 1.5")] = _Poison()
+            for k in range(3):
+                src.write(_sub_rows(50 + k))
+                assert cli.request({"op": "poll"},
+                                   on_push=frames.append)["ok"]
+            evs = [f for f in frames if f.get("subscription") == sid]
+            assert any(f.get("event") == "quarantined"
+                       for f in evs), evs
+            # ownership died with the terminal frame
+            assert sup.membership.sub_owner(sid) is None
+            assert sup.stats()["subscriptions"] == 0
+            sup.kill_replica(owner, graceful=False)
+            time.sleep(0.5)
+            st = sup.stats()["router"]
+            assert st["rehome_attempted"] == 0
+            assert st["rehome_succeeded"] == 0
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_density_window_rehomes_by_reseed(self):
+        """Density-window subscriptions have no incremental handoff
+        snapshot (registry refuses one by contract) — the re-home path
+        re-seeds from the survivor's live snapshot instead, and the
+        client still pays exactly one resync."""
+        store, src, sup = _kafka_fleet()
+        frames = []
+        try:
+            from geomesa_tpu.fleet.router import FleetClient
+
+            port = sup.start()
+            cli = FleetClient("127.0.0.1", port, timeout_s=30.0)
+            got = cli.request(
+                {"op": "subscribe", "typeName": "live",
+                 "density": {"bbox": [-60.0, -30.0, 60.0, 30.0],
+                             "width": 16, "height": 8}},
+                on_push=frames.append)
+            assert got["ok"], got
+            sid, owner = got["subscription"], got["replica"]
+            assert got["mode"] == "density"
+            src.write(_sub_rows(60))
+            assert cli.request({"op": "poll"},
+                               on_push=frames.append)["ok"]
+            sup.kill_replica(owner, graceful=False)
+            row = _wait_rehomed(sup, sid, owner)
+            assert row.mode == "density"
+            src.write(_sub_rows(61))
+            assert cli.request({"op": "poll"},
+                               on_push=frames.append)["ok"]
+            evs = [f for f in frames if f.get("subscription") == sid]
+            _assert_seq_monotonic(evs, sid)
+            # density frames after the kill keep flowing off the
+            # survivor's re-seeded window
+            assert any(f.get("event") == "density" for f in evs), evs
+            assert sup.stats()["router"]["rehome_succeeded"] == 1
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_rolling_restart_drains_subscriptions(self):
+        """Zero-downtime roll with live standing queries: every
+        subscription is exported fresh, re-homed to a survivor, and
+        still delivering after BOTH replicas have been replaced — the
+        client reads one connection throughout."""
+        store, src, sup = _kafka_fleet()
+        frames = []
+        try:
+            from geomesa_tpu.fleet.router import FleetClient
+
+            port = sup.start()
+            cli = FleetClient("127.0.0.1", port, timeout_s=30.0)
+            got = cli.request({"op": "subscribe", "typeName": "live",
+                               "cql": SUB_CQL},
+                              on_push=frames.append)
+            assert got["ok"], got
+            sid = got["subscription"]
+            src.write(_sub_rows(70))
+            assert cli.request({"op": "poll"},
+                               on_push=frames.append)["ok"]
+            result = sup.rolling_restart()
+            assert result["ok"], result
+            moved = sum(r["subs"]["moved"] for r in result["rolled"])
+            failed = sum(r["subs"]["failed"] for r in result["rolled"])
+            assert moved >= 1 and failed == 0, result
+            # the subscription is live on a fresh incarnation
+            row = sup.membership.sub_owner(sid)
+            assert row is not None
+            assert sup.membership.get(row.replica_id).state == "ready"
+            src.write(_sub_rows(71))
+            assert cli.request({"op": "poll"},
+                               on_push=frames.append)["ok"]
+            evs = [f for f in frames if f.get("subscription") == sid]
+            _assert_seq_monotonic(evs, sid)
+            live = sup.membership.get(
+                row.replica_id).server.svc.subscriptions.registry \
+                .maybe(row.replica_sub_id)
+            assert _replay(evs, sid) == live.matched
+            cli.close()
+        finally:
+            sup.close()
+
+    def test_client_disconnect_releases_ownership(self):
+        """A hung-up client's subscriptions are cancelled on the owner
+        and dropped from the ownership table — no orphan streams, no
+        leaked re-homes on a later kill."""
+        store, src, sup = _kafka_fleet()
+        try:
+            from geomesa_tpu.fleet.router import FleetClient
+
+            port = sup.start()
+            cli = FleetClient("127.0.0.1", port, timeout_s=30.0)
+            got = cli.request({"op": "subscribe", "typeName": "live",
+                               "cql": SUB_CQL})
+            assert got["ok"], got
+            sid = got["subscription"]
+            assert sup.membership.sub_owner(sid) is not None
+            cli.close()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if sup.membership.sub_owner(sid) is None:
+                    break
+                time.sleep(0.05)
+            assert sup.membership.sub_owner(sid) is None
+            assert sup.stats()["subscriptions"] == 0
+        finally:
+            sup.close()
+
+    def test_export_subscription_renumbered_to_client_seq(self):
+        """export_subscription through the router hands out a snapshot
+        in CLIENT-visible numbering (watermark = what the client has
+        seen), so a wire handoff taken through the fleet endpoint can
+        seed a direct replica subscription without seq regression."""
+        store, src, sup = _kafka_fleet()
+        frames = []
+        try:
+            from geomesa_tpu.fleet.router import FleetClient
+
+            port = sup.start()
+            cli = FleetClient("127.0.0.1", port, timeout_s=30.0)
+            got = cli.request({"op": "subscribe", "typeName": "live",
+                               "cql": SUB_CQL},
+                              on_push=frames.append)
+            assert got["ok"], got
+            sid = got["subscription"]
+            src.write(_sub_rows(80))
+            assert cli.request({"op": "poll"},
+                               on_push=frames.append)["ok"]
+            got = cli.request({"op": "export_subscription",
+                               "subscription": sid},
+                              on_push=frames.append)
+            assert got["ok"], got
+            snap = got["handoff"]
+            evs = [f for f in frames if f.get("subscription") == sid]
+            assert snap["watermark"] == max(f["seq"] for f in evs)
+            assert snap["seq"] >= snap["watermark"]
+            assert set(snap["matched"]) == _replay(evs, sid)
             cli.close()
         finally:
             sup.close()

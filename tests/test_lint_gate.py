@@ -22,7 +22,8 @@ def test_lint_gate_passes_on_shipped_tree():
     # provenance-chain assertion; tests/test_faults.py chaos
     # regression; tests/test_telemetry.py trace/scrape/gap checks;
     # tests/test_slo_observability.py sentinel record/replay/verdict;
-    # tests/test_fleet.py kill-mid-burst failover; tests/test_wire.py
+    # tests/test_fleet.py kill-mid-burst failover + subscription
+    # re-home across an owner kill (TestRehome); tests/test_wire.py
     # columnar parity + one-encode fan-out; tests/test_ringloop.py ring
     # bit-identity + dispatches_per_window; tests/test_subscribe.py
     # lane-vs-fused floor + parity); repeating them in a cold
@@ -32,7 +33,8 @@ def test_lint_gate_passes_on_shipped_tree():
     r = subprocess.run([sys.executable, GATE, "--no-spmd-smoke",
                         "--no-dataflow-smoke", "--no-chaos-smoke",
                         "--no-telemetry-smoke", "--no-sentinel-smoke",
-                        "--no-fleet-smoke", "--no-approx-smoke",
+                        "--no-fleet-smoke", "--no-rehome-smoke",
+                        "--no-approx-smoke",
                         "--no-wire-smoke", "--no-ring-smoke",
                         "--no-lane-smoke"],
                        capture_output=True, text=True, cwd=REPO_ROOT)
